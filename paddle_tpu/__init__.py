@@ -1,0 +1,136 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Top-level namespace mirrors `paddle.*` (tensor ops, nn, optimizer, amp, io,
+jit, autograd, distributed, vision, metric) while the execution model is
+TPU-first: eager ops dispatch pure-jnp kernels with a tape autograd; the
+performance path traces the same code into XLA via `jit.to_static`; all
+parallelism rides `jax.sharding` meshes + collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtypes as _dtypes_mod
+from .core.dtypes import (  # noqa: F401
+    bfloat16, bool_, complex128, complex64, float16, float32, float64,
+    get_default_dtype, int16, int32, int64, int8, set_default_dtype, uint8,
+)
+from .core.tensor import Parameter, Tensor  # noqa: F401
+from .core import flags as _flags
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.engine import grad  # noqa: F401
+
+from .ops import *  # noqa: F401,F403
+from .ops import is_tensor, add_n, accuracy  # noqa: F401
+from .ops.manipulation import shape_op as shape  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import framework  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import vision  # noqa: F401
+
+from .framework.io_utils import load, save  # noqa: F401
+
+
+class _NoGrad:
+    """paddle.no_grad: usable as context manager and decorator."""
+
+    def __call__(self, fn=None):
+        if fn is None:
+            return _flags.no_grad_guard()
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with _flags.no_grad_guard():
+                return fn(*a, **kw)
+
+        return wrapper
+
+    def __enter__(self):
+        self._cm = _flags.no_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+no_grad = _NoGrad()
+enable_grad = _flags.enable_grad_guard
+
+
+def is_grad_enabled():
+    return _flags.is_grad_enabled()
+
+
+def set_grad_enabled(mode):
+    return _flags.set_grad_enabled(mode)
+
+
+def in_dynamic_mode():
+    return not _flags.in_trace()
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
+        "(trace-to-XLA) which subsumes it.")
+
+
+def get_device():
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device):
+    return device
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def synchronize():
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def tensor_method_grad_fix():  # pragma: no cover
+    pass

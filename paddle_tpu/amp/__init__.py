@@ -1,0 +1,145 @@
+"""AMP (paddle.amp parity: `python/paddle/amp/` — auto_cast O1/O2 with per-op
+allow/block lists, GradScaler, decorate).
+
+TPU-first: bf16 is the native mixed precision — no loss scaling needed, so
+GradScaler defaults to a correct no-op pass-through when scaling is disabled
+(paddle semantics kept: enable=True + fp16 scales, bf16 doesn't).
+The O1 mechanism hooks the op-dispatch gate (`core.dispatch.set_amp_cast_hook`),
+the TPU analog of the generated AmpAutoCast branches in eager forwards
+(`paddle/fluid/eager/amp_utils.h`).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch as _dispatch
+from ..core import dtypes as _dtypes
+from ..core.tensor import Tensor
+from .grad_scaler import GradScaler, OptimizerState  # noqa: F401
+
+# Per-op lists (subset of python/paddle/amp/amp_lists.py)
+WHITE_LIST = {
+    "matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mv", "einsum",
+    "addmm", "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "c_softmax_with_cross_entropy", "cross_entropy", "layer_norm", "rms_norm",
+    "group_norm", "instance_norm", "batch_norm", "l1_loss", "mse_loss",
+    "logsumexp", "erfinv", "pow", "cumsum", "prod", "std", "var", "norm",
+}
+
+
+class _AmpState:
+    enabled = False
+    level = "O1"
+    dtype = jnp.bfloat16
+    custom_white = set()
+    custom_black = set()
+
+
+_state = _AmpState()
+
+
+def _cast_leaf(x, dtype):
+    if isinstance(x, Tensor) and jnp.issubdtype(x._value.dtype, np.floating) \
+            and x._value.dtype != jnp.dtype(dtype):
+        return x.astype(dtype)
+    return x
+
+
+def _amp_hook(op_name, args, kwargs):
+    if not _state.enabled:
+        return args, kwargs
+    import jax
+
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    black = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    if op_name in white:
+        dt = _state.dtype
+    elif op_name in black:
+        dt = jnp.float32
+    else:
+        return args, kwargs
+
+    def cast(x):
+        return _cast_leaf(x, dt)
+
+    args = jax.tree_util.tree_map(
+        cast, args, is_leaf=lambda x: isinstance(x, Tensor))
+    kwargs = jax.tree_util.tree_map(
+        cast, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+    return args, kwargs
+
+
+_dispatch.set_amp_cast_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    old = (_state.enabled, _state.level, _state.dtype, _state.custom_white,
+           _state.custom_black)
+    _state.enabled = enable
+    _state.level = level
+    _state.dtype = _dtypes.convert_dtype(dtype)
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.custom_white,
+         _state.custom_black) = old
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """O2: cast model params to the amp dtype (master fp32 weights live in the
+    optimizer's multi_precision machinery)."""
+    dtype = _dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+        if optimizers is not None:
+            opts = [optimizers] if not isinstance(optimizers, (list, tuple)) \
+                else optimizers
+            for o in opts:
+                o._multi_precision = True if master_weight is None \
+                    else master_weight
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+class debugging:
+    """paddle.amp.debugging parity subset."""
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+        import jax.numpy as jnp
+
+        v = tensor._value if isinstance(tensor, Tensor) else tensor
+        n_nan = int(jnp.sum(jnp.isnan(v)))
+        n_inf = int(jnp.sum(jnp.isinf(v)))
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"check_numerics failed for {op_type}:{var_name}: "
+                f"{n_nan} NaN, {n_inf} Inf")
+        return True

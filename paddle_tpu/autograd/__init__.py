@@ -1,0 +1,9 @@
+"""paddle.autograd parity (`python/paddle/autograd/`)."""
+from ..core.engine import backward, grad  # noqa: F401
+from ..core.flags import no_grad_guard as no_grad  # noqa: F401
+from ..core.flags import enable_grad_guard as enable_grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "PyLayer",
+           "PyLayerContext", "jacobian", "hessian", "vjp", "jvp"]

@@ -1,0 +1,85 @@
+"""Functional higher-order autograd (paddle.incubate.autograd.functional
+parity) — thin adapters over jax transforms, which are the TPU-native engine
+for jacobians/hessians."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+from ..core.tensor import Tensor
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x) if not isinstance(x, Tensor) else x
+
+
+def _functionalize(func):
+    def f(*vals):
+        with flags.trace_guard():
+            args = [Tensor(v, stop_gradient=False) for v in vals]
+            out = func(*args)
+        return _unwrap(out)
+
+    return f
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    single = isinstance(xs, Tensor)
+    xs_t = [xs] if single else list(xs)
+    vals = [t._value for t in xs_t]
+    jac = jax.jacobian(_functionalize(func), argnums=tuple(range(len(vals))))(*vals)
+    out = _wrap(jac)
+    if single:
+        return out[0] if isinstance(out, (tuple, list)) else out
+    return out
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    single = isinstance(xs, Tensor)
+    xs_t = [xs] if single else list(xs)
+    vals = [t._value for t in xs_t]
+    h = jax.hessian(_functionalize(func), argnums=tuple(range(len(vals))))(*vals)
+    out = _wrap(h)
+    if single:
+        while isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+    return out
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_t = [xs] if single else list(xs)
+    vals = [t._value for t in xs_t]
+    out, vjp_fn = jax.vjp(_functionalize(func), *vals)
+    if v is None:
+        cots = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cots = _unwrap(v)
+    grads = vjp_fn(cots)
+    grads = _wrap(list(grads))
+    return _wrap(out), (grads[0] if single else grads)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_t = [xs] if single else list(xs)
+    vals = [t._value for t in xs_t]
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        v_t = [v] if isinstance(v, Tensor) else list(v)
+        tangents = [t._value for t in v_t]
+    out, tang = jax.jvp(_functionalize(func), tuple(vals), tuple(tangents))
+    return _wrap(out), _wrap(tang)

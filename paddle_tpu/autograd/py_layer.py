@@ -1,0 +1,120 @@
+"""PyLayer: user-defined autograd functions.
+
+Role parity: `python/paddle/autograd/py_layer.py` + C++
+`paddle/fluid/eager/pylayer/`. The user's backward() becomes the vjp of a
+hand-wired GradNode in the same grad graph the op dispatcher builds.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags
+from ..core.engine import GradNode
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle also exposes arbitrary attribute stashing on ctx — allowed here
+    # by default since this is a plain python object.
+
+
+class _PyLayerVjp:
+    """vjp adapter: flat output cotangents -> user backward -> flat in-grads.
+
+    `wants_tensors` tells the engine to hand over Tensor cotangents directly;
+    under create_graph the user's backward ops are recorded so higher-order
+    grads flow through PyLayers too."""
+
+    wants_tensors = True
+
+    def __init__(self, cls, ctx, n_diff_inputs, diff_sel):
+        self.cls = cls
+        self.ctx = ctx
+        self.n_diff_inputs = n_diff_inputs
+        self.diff_sel = diff_sel  # positions of diff inputs among tensor inputs
+
+    def __call__(self, cots, create_graph=False):
+        gts = [Tensor(c) if not isinstance(c, Tensor) else c for c in cots]
+        ctx_mgr = flags.enable_grad_guard() if create_graph else \
+            flags.no_grad_guard()
+        with ctx_mgr:
+            out = self.cls.backward(self.ctx, *gts) if len(gts) > 1 else \
+                self.cls.backward(self.ctx, gts[0])
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        grads = []
+        for pos in self.diff_sel:
+            g = out[pos] if pos < len(out) else None
+            if g is None:
+                grads.append(None)
+            elif create_graph and isinstance(g, Tensor):
+                grads.append(g)
+            else:
+                grads.append(g._value if isinstance(g, Tensor) else g)
+        return tuple(grads)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        track = flags.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with flags.no_grad_guard():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+
+        if track:
+            # diff inputs = floating tensor inputs that require grad
+            diff_sel = []
+            edges = []
+            for i, t in enumerate(tensor_inputs):
+                if (not t.stop_gradient
+                        and jnp.issubdtype(t._value.dtype, np.inexact)):
+                    diff_sel.append(i)
+                    if t._grad_node is not None:
+                        edges.append(("node", t._grad_node[0], t._grad_node[1]))
+                    else:
+                        edges.append(("leaf", t))
+            out_avals = [(tuple(o._value.shape), o._value.dtype) for o in outs]
+            node = GradNode(
+                cls.__name__,
+                _PyLayerVjp(cls, ctx, len(diff_sel), diff_sel),
+                edges, len(outs), out_avals)
+            for i, o in enumerate(outs):
+                if jnp.issubdtype(o._value.dtype, np.inexact):
+                    o.stop_gradient = False
+                    o._grad_node = (node, i)
+        return outs[0] if single else tuple(outs)
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,2 @@
+from . import dtypes, engine, flags, rng  # noqa: F401
+from .tensor import Parameter, Tensor  # noqa: F401

@@ -1,0 +1,147 @@
+"""Op dispatch: the single gate every op goes through.
+
+Role parity: the generated `*_ad_func` eager forwards + C++ API dispatch of
+the reference (`paddle/fluid/eager/auto_code_generator/generator/eager_gen.py`,
+`paddle/phi/api/yaml/generator/api_base.py` — select kernel, PrepareData,
+InferMeta, launch, then build the grad node). TPU-first collapse: the "kernel"
+is a pure jnp/lax/pallas function; shape-dtype inference, lowering, and fusion
+are XLA's job; the grad node's backward fn is the op's `jax.vjp` closure.
+
+Three modes:
+  * trace  — inside `jit.to_static`/functional transforms: run raw on tracers.
+  * eager, no grad needed — run raw, wrap output.
+  * eager, grad — run under `jax.vjp` over the floating Tensor inputs and
+    record a GradNode edge-wired into the producing nodes of its inputs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags
+from .engine import GradNode
+from .tensor import Tensor
+
+_amp_cast_hook = None  # installed by paddle_tpu.amp
+
+
+def set_amp_cast_hook(fn):
+    global _amp_cast_hook
+    _amp_cast_hook = fn
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _flatten(args, kwargs):
+    return jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+
+
+def apply(name, fn, *args, **kwargs):
+    """Run `fn` (pure over jax arrays) on args that may contain Tensors
+    anywhere in their pytree structure; returns Tensor-wrapped outputs with
+    the grad graph extended when needed."""
+    if _amp_cast_hook is not None:
+        args, kwargs = _amp_cast_hook(name, args, kwargs)
+    leaves, treedef = _flatten(args, kwargs)
+    tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+    track = False
+    if tensor_pos and flags.is_grad_enabled():
+        track = any(not leaves[i].stop_gradient for i in tensor_pos)
+
+    if not track:
+        vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
+        a, kw = jax.tree_util.tree_unflatten(treedef, vals)
+        out = fn(*a, **kw)
+        if flags.in_trace():
+            # grad bookkeeping belongs to jax here; just propagate the flag
+            sg = not any(not leaves[i].stop_gradient for i in tensor_pos)
+        else:
+            sg = True
+        return _wrap_outputs(out, stop_gradient=sg)
+
+    # --- autograd path ---
+    diff_pos = [
+        i for i in tensor_pos
+        if not leaves[i].stop_gradient and jnp.issubdtype(leaves[i]._value.dtype, np.inexact)
+    ]
+    base_vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+    def pure(*dvals):
+        cur = list(base_vals)
+        for p, v in zip(diff_pos, dvals):
+            cur[p] = v
+        a, kw = jax.tree_util.tree_unflatten(treedef, cur)
+        return fn(*a, **kw)
+
+    diff_vals = [base_vals[p] for p in diff_pos]
+    out, vjp_fn = jax.vjp(pure, *diff_vals)
+
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+    edges = []
+    for p in diff_pos:
+        t = leaves[p]
+        if t._grad_node is not None:
+            edges.append(("node", t._grad_node[0], t._grad_node[1]))
+        else:
+            edges.append(("leaf", t))
+    out_avals = [(tuple(o.shape), o.dtype) for o in out_leaves]
+    node = GradNode(name, _VjpAdapter(vjp_fn, out_tree), edges,
+                    len(out_leaves), out_avals,
+                    pure_fn=pure,
+                    input_tensors=[leaves[p] for p in diff_pos])
+
+    wrapped = []
+    for i, o in enumerate(out_leaves):
+        t = Tensor(o, stop_gradient=not jnp.issubdtype(o.dtype, np.inexact))
+        if not t.stop_gradient:
+            t._grad_node = (node, i)
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_tree, wrapped)
+
+
+class _VjpAdapter:
+    """Adapts flat cotangent list -> jax.vjp cotangent pytree -> flat grads."""
+
+    __slots__ = ("vjp_fn", "out_tree")
+
+    def __init__(self, vjp_fn, out_tree):
+        self.vjp_fn = vjp_fn
+        self.out_tree = out_tree
+
+    def __call__(self, cots):
+        if not isinstance(cots, (tuple, list)):
+            cots = (cots,)
+        cot_tree = jax.tree_util.tree_unflatten(self.out_tree, list(cots))
+        return self.vjp_fn(cot_tree)
+
+
+def _wrap_outputs(out, stop_gradient):
+    def w(o):
+        if isinstance(o, Tensor):
+            return o
+        return Tensor(o, stop_gradient=stop_gradient)
+
+    return jax.tree_util.tree_map(w, out)
+
+
+def op(name=None):
+    """Decorator turning a pure-jnp function into an eager framework op."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return apply(opname, fn, *args, **kwargs)
+
+        wrapper.raw = fn  # the pure function, for jit/functional paths
+        wrapper.op_name = opname
+        return wrapper
+
+    return deco

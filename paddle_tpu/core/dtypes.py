@@ -1,0 +1,112 @@
+"""Dtype registry and default-dtype management.
+
+Role parity: paddle dtype surface (`paddle/phi/common/data_type.h`,
+`python/paddle/framework/dtype.py`). TPU-first: bfloat16 is a first-class
+dtype; float64 is discouraged (XLA TPU demotes it) but supported on CPU.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtypes (jnp dtype objects)
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "fp16": float16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str / np / jnp) to a jnp dtype.
+
+    TPU-first canonicalization: with jax x64 disabled (the TPU default),
+    int64/float64 requests map to int32/float32 — the same demotion XLA
+    performs, applied here silently so the paddle-style `int64` default
+    index dtype works without per-op warnings."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR2DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        dt = _STR2DTYPE[key]
+    else:
+        dt = jnp.dtype(dtype)
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        dt = jnp.dtype(dt)
+        if dt == jnp.dtype(np.int64):
+            return jnp.int32
+        if dt == jnp.dtype(np.float64):
+            return jnp.float32
+        if dt == jnp.dtype(np.uint64):
+            return jnp.uint32
+        if dt == jnp.dtype(np.complex128):
+            return jnp.complex64
+    return dt
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"Default dtype must be floating, got {dtype}")
+    _default_dtype = dtype
+
+
+@contextlib.contextmanager
+def default_dtype_guard(dtype):
+    old = get_default_dtype()
+    set_default_dtype(dtype)
+    try:
+        yield
+    finally:
+        set_default_dtype(old)
+
+
+def is_floating_point(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), np.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+
+
+def is_complex(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)

@@ -1,0 +1,335 @@
+"""Eager autograd engine: grad-graph nodes + queue-based backward walk.
+
+Role parity with the reference's eager autograd runtime:
+  - GradNode            ~ `GradNodeBase` (paddle/fluid/eager/grad_node_info.h:197)
+  - run_backward        ~ `egr::RunBackward` (paddle/fluid/eager/backward.cc:105)
+    (same design: build an in-degree map over the reachable grad graph, then a
+    ready-queue reverse-topological walk accumulating cotangents per node)
+  - grad()              ~ partial-grad `general_grad.h` path (paddle.grad)
+  - leaf accumulation   ~ `GradNodeAccumulation` + gradient hooks, which is the
+    DataParallel reducer hook point in the reference (backward.cc stack §3.2).
+
+TPU-first design: each node's backward function is the `jax.vjp` closure of the
+op's pure-jnp forward, so every backward step is itself an XLA computation and
+`create_graph=True` (double grad) falls out by re-entering the dispatch layer
+when calling the vjp.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+
+class GradNode:
+    """One differentiable op application in the eager grad graph."""
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "edges",
+        "n_outputs",
+        "out_avals",
+        "out_hooks",
+        "released",
+        "pure_fn",
+        "input_tensors",
+        "__weakref__",
+    )
+
+    def __init__(self, name, vjp_fn, edges, n_outputs, out_avals,
+                 pure_fn=None, input_tensors=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        # edges[i] describes where grad w.r.t. diff-input i flows:
+        #   ("leaf", tensor)          -> accumulate into tensor.grad
+        #   ("node", prev_node, idx)  -> contributes cotangent idx of prev_node
+        self.edges = edges
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.out_hooks = {}  # out_index -> [hook fns] (intermediate tensor hooks)
+        self.released = False
+        # For create_graph (higher-order AD): the op's pure function over its
+        # differentiable inputs + the input Tensors themselves (~ the saved
+        # TensorWrappers of a reference grad node). jax.vjp closures treat
+        # primals as constants, so grad-of-grad re-derives the vjp from
+        # pure_fn through the dispatch gate instead.
+        self.pure_fn = pure_fn
+        self.input_tensors = input_tensors
+
+    def zero_cotangent(self, i):
+        shape, dtype = self.out_avals[i]
+        if not jnp.issubdtype(dtype, jnp.inexact):
+            # jax.vjp expects float0 cotangents for non-differentiable outputs
+            import numpy as np
+
+            return np.zeros(shape, jax.dtypes.float0)
+        return jnp.zeros(shape, dtype)
+
+    def run_vjp(self, out_cots, create_graph=False):
+        """Call the stored vjp closure; under create_graph the call is routed
+        through the dispatch layer so the backward computation itself gets a
+        grad graph (higher-order AD, ~ generated higher-order GradNodes in the
+        reference)."""
+        cots = [
+            c if c is not None else self.zero_cotangent(i)
+            for i, c in enumerate(out_cots)
+        ]
+        if create_graph and self.pure_fn is not None:
+            from . import dispatch
+
+            pure_fn = self.pure_fn
+            out_tree = self.vjp_fn.out_tree
+
+            def gradfn(primals, cot_leaves):
+                _, vjp = jax.vjp(pure_fn, *primals)
+                cot_tree = jax.tree_util.tree_unflatten(out_tree,
+                                                        list(cot_leaves))
+                return vjp(cot_tree)
+
+            return dispatch.apply(f"{self.name}_grad", gradfn,
+                                  list(self.input_tensors), cots)
+        if getattr(self.vjp_fn, "wants_tensors", False):
+            # PyLayer-style: the backward is user python over Tensors
+            return self.vjp_fn(cots, create_graph)
+        vals = [c._value if hasattr(c, "_value") else c for c in cots]
+        return self.vjp_fn(vals)
+
+    def release(self):
+        self.vjp_fn = None
+        self.pure_fn = None
+        self.input_tensors = None
+        self.released = True
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_out={self.n_outputs}>"
+
+
+def _zeros_like_value(v):
+    return jnp.zeros(v.shape, v.dtype)
+
+
+def _build_indegree(roots):
+    """BFS the grad graph; count, per node, how many downstream node-edges feed it."""
+    indeg = {}
+    seen = set()
+    q = deque()
+    for n in roots:
+        if id(n) not in seen:
+            seen.add(id(n))
+            indeg.setdefault(id(n), 0)
+            q.append(n)
+    nodes = {id(n): n for n in roots}
+    while q:
+        n = q.popleft()
+        for edge in n.edges:
+            if edge[0] == "node":
+                prev = edge[1]
+                indeg[id(prev)] = indeg.get(id(prev), 0) + 1
+                nodes[id(prev)] = prev
+                if id(prev) not in seen:
+                    seen.add(id(prev))
+                    q.append(prev)
+    return indeg, nodes
+
+
+class _CotangentBuffer:
+    """Per-node accumulation of output cotangents (GradTensorHolder parity)."""
+
+    def __init__(self):
+        self.buf = {}  # id(node) -> {out_idx: value}
+
+    def add(self, node, idx, value):
+        slot = self.buf.setdefault(id(node), {})
+        if idx in slot:
+            slot[idx] = slot[idx] + value
+        else:
+            slot[idx] = value
+
+    def pop(self, node, out_shapes=None):
+        return self.buf.pop(id(node), {})
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 create_graph=False, inputs=None, accumulate=True):
+    """Reverse-topological walk from output tensors.
+
+    If `inputs` is given (paddle.grad path), returns grads for exactly those
+    tensors (accumulating into .grad only when accumulate=True and inputs is
+    None, matching Tensor.backward semantics).
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents.
+    cot = _CotangentBuffer()
+    roots = []
+    leaf_seed = {}  # id(tensor) -> seed grad for roots that are leaves
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                f"Tensor {t.name or ''} has stop_gradient=True; cannot backward."
+            )
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward roots; "
+                    f"got shape {tuple(t.shape)}"
+                )
+            gval = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is not None:
+            node, idx = t._grad_node
+            if node.released:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time after it "
+                    "was freed. Specify retain_graph=True on the first backward."
+                )
+            cot.add(node, idx, gval)
+            roots.append(node)
+        else:
+            leaf_seed[id(t)] = (t, gval)
+
+    indeg, _nodes = _build_indegree(roots)
+
+    # Target collection for paddle.grad(): map (node,idx)->slot and leaf ids.
+    want_by_nodeidx = {}
+    want_by_leaf = {}
+    results = None
+    if inputs is not None:
+        results = [None] * len(inputs)
+        for i, t in enumerate(inputs):
+            if t._grad_node is not None:
+                want_by_nodeidx.setdefault((id(t._grad_node[0]), t._grad_node[1]), []).append(i)
+            else:
+                want_by_leaf.setdefault(id(t), []).append(i)
+            # root tensor may itself be an input
+            if id(t) in leaf_seed:
+                results[i] = leaf_seed[id(t)][1]
+
+    def _emit_leaf(tensor, gval):
+        for hook in tensor._hooks:
+            out = hook(_wrap(gval))
+            if out is not None:
+                gval = out
+        if inputs is not None:
+            for i in want_by_leaf.get(id(tensor), ()):
+                results[i] = gval if results[i] is None else results[i] + gval
+            if not accumulate:
+                return
+        if tensor.stop_gradient:
+            return
+        if inputs is None or accumulate:
+            tensor._accumulate_grad(gval)
+
+    def _wrap(gval):
+        if isinstance(gval, Tensor):
+            return gval
+        return Tensor(gval, stop_gradient=True)
+
+    # Leaves that were direct roots.
+    for t, gval in leaf_seed.values():
+        _emit_leaf(t, gval)
+
+    ready = deque(n for n in _nodes.values() if indeg.get(id(n), 0) == 0)
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        cots = cot.pop(node)
+        if node.released:
+            raise RuntimeError(
+                "Grad graph was already freed; use retain_graph=True.")
+        # Assemble full cotangent tuple (zeros for unused outputs).
+        out_cots = []
+        for i in range(node.n_outputs):
+            v = cots.get(i)
+            if v is None:
+                v = node.zero_cotangent(i) if hasattr(node, "zero_cotangent") else None
+            out_cots.append(v)
+        # Fire intermediate-tensor hooks.
+        for i, hooks in node.out_hooks.items():
+            if out_cots[i] is not None:
+                g = out_cots[i]
+                for hook in hooks:
+                    out = hook(_wrap(g))
+                    if out is not None:
+                        g = out._value if isinstance(out, Tensor) else out
+                out_cots[i] = g
+        if inputs is not None:
+            for i in range(node.n_outputs):
+                key = (id(node), i)
+                if key in want_by_nodeidx and out_cots[i] is not None:
+                    for slot in want_by_nodeidx[key]:
+                        results[slot] = (out_cots[i] if results[slot] is None
+                                         else results[slot] + out_cots[i])
+        in_grads = node.run_vjp(out_cots, create_graph=create_graph)
+        for edge, g in zip(node.edges, in_grads):
+            if edge[0] == "leaf":
+                if g is not None:
+                    _emit_leaf(edge[1], g)
+                continue
+            _, prev, idx = edge
+            if g is not None:
+                cot.add(prev, idx, g)
+            # the in-degree decrement must happen even for a None grad (e.g. a
+            # PyLayer backward returning None), or the upstream node never
+            # becomes ready and its other consumers' grads are dropped
+            indeg[id(prev)] -= 1
+            if indeg[id(prev)] == 0:
+                ready.append(prev)
+        if not retain_graph:
+            node.release()
+
+    # Nodes never reached keep their buffers; with retain_graph=False the whole
+    # reachable graph is now released, matching reference semantics.
+    if inputs is not None:
+        return results
+    return None
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity: partial grads w.r.t. `inputs` without touching .grad."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    vals = run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                        create_graph=create_graph, inputs=inputs,
+                        accumulate=False)
+    results = []
+    for t, v in zip(inputs, vals):
+        if v is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have "
+                    "been used in the graph. Set allow_unused=True if this "
+                    "is intended.")
+            results.append(None)
+        elif isinstance(v, Tensor):
+            results.append(v)
+        else:
+            results.append(Tensor(v, stop_gradient=not create_graph))
+    return results

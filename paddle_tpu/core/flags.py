@@ -1,0 +1,81 @@
+"""Global runtime flags and modes.
+
+Role parity: `paddle/phi/core/flags.cc` (FLAGS_*) + dygraph/static mode
+switches (`python/paddle/base/framework.py` in_dynamic_or_pir_mode). Here the
+two modes are: eager (op-by-op with tape autograd) and trace (inside a
+`jax.jit`/`jax.grad` transform, where autograd and fusion belong to XLA).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        self.tracing = 0  # nesting depth of functional tracing
+
+_state = _State()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled and not _state.tracing
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    old = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = old
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    old = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = old
+
+
+def in_trace() -> bool:
+    return _state.tracing > 0
+
+
+@contextlib.contextmanager
+def trace_guard():
+    """Inside: ops run raw on jax values; no tape nodes are created."""
+    _state.tracing += 1
+    try:
+        yield
+    finally:
+        _state.tracing -= 1
+
+
+# --- FLAGS_* style runtime flags (paddle.set_flags parity) -------------------
+_flags = {
+    "FLAGS_check_nan_inf": os.environ.get("FLAGS_check_nan_inf", "0") in ("1", "true", "True"),
+    "FLAGS_eager_jit_ops": os.environ.get("FLAGS_eager_jit_ops", "0") in ("1", "true", "True"),
+}
+
+
+def set_flags(d: dict):
+    _flags.update(d)
+
+
+def get_flags(keys=None):
+    if keys is None:
+        return dict(_flags)
+    if isinstance(keys, str):
+        return {keys: _flags.get(keys)}
+    return {k: _flags.get(k) for k in keys}

@@ -1,0 +1,69 @@
+"""RNG state: functional PRNG keys behind a stateful generator facade.
+
+Role parity: `phi::Generator` (paddle/phi/core/generator.h) + `paddle.seed`.
+TPU-first: the state is a jax PRNG key (threefry), so a generator can be
+captured as an implicit input/output of a traced program (the jit layer does
+exactly that), keeping randomness correct and reproducible under compilation —
+the role paddle's TP RNG tracker (`fleet/layers/mpu/random.py`) plays is
+covered by deriving per-mesh-axis keys via fold_in.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    """Key creation is lazy: no device computation happens at import time
+    (backend init is deferred to first real use)."""
+
+    def __init__(self, seed=0):
+        self._key = None
+        self._seed = seed
+
+    def manual_seed(self, seed):
+        self._key = None
+        self._seed = seed
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self):
+        return self._seed
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
+
+    def get_state(self):
+        return self.key
+
+    def set_state(self, key):
+        self._key = key
+
+    def split(self):
+        """Return a fresh subkey; advances the internal key (works under
+        trace: the key becomes a tracer that the jit layer threads through)."""
+        self._key, sub = jax.random.split(self.key)
+        return sub
+
+    def fold_in(self, data):
+        return jax.random.fold_in(self.key, data)
+
+
+default_generator = Generator(0)
+
+
+def seed(s):
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(states):
+    default_generator.set_state(states[0])

@@ -1,0 +1,343 @@
+"""Eager Tensor: a mutable handle over an immutable jax.Array.
+
+Role parity: `paddle::Tensor` + eager `AutogradMeta`
+(`paddle/phi/api/include/tensor.h:82`, `paddle/fluid/eager/autograd_meta.h`)
+and the Python Tensor surface (`paddle/fluid/pybind/eager_method.cc`).
+
+TPU-first: the payload is always a jax.Array (device-resident, async) or a
+jax tracer (inside functional transforms) — mutation (`x[i]=v`, `add_`)
+rebinds the handle to a new functional value, which XLA turns back into
+in-place buffer updates via donation under jit.
+
+Math/manipulation methods are patched onto this class by `paddle_tpu.ops`
+(mirroring how the reference patches `python/paddle/tensor/` methods onto the
+pybind Tensor).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as _dtypes
+from . import flags
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_hooks",
+        "name",
+        "persistable",
+        "dist_attr",
+        "__weakref__",
+    )
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        dtype = _dtypes.convert_dtype(dtype)
+        if not isinstance(value, jax.Array) and not _is_tracer(value):
+            if isinstance(value, (bool, int, float, list, tuple, np.ndarray)):
+                arr = np.asarray(value)
+                if dtype is None and arr.dtype == np.float64:
+                    arr = arr.astype(np.dtype(_dtypes.get_default_dtype()))
+                value = jnp.asarray(arr, dtype=dtype)
+            else:
+                value = jnp.asarray(value, dtype=dtype)
+        elif dtype is not None and value.dtype != jnp.dtype(dtype):
+            value = value.astype(dtype)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+        self.dist_attr = None  # (mesh, placements) slot for auto-parallel
+
+    # --- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            devs = self._value.devices()
+            return next(iter(devs))
+        except Exception:
+            return None
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from .. import ops
+
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.transpose(self, perm)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # --- grad ---------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def _accumulate_grad(self, gval):
+        if isinstance(gval, Tensor):
+            gval = gval._value
+        if gval.dtype != self._value.dtype:
+            gval = gval.astype(self._value.dtype)
+        if self._grad is None:
+            self._grad = Tensor(gval, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._value + gval, stop_gradient=True)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import engine
+
+        engine.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Hook fires on this tensor's gradient during backward (leaf or not)."""
+        if self._grad_node is None:
+            self._hooks.append(hook)
+
+            def remove():
+                try:
+                    self._hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        else:
+            node, idx = self._grad_node
+            node.out_hooks.setdefault(idx, []).append(hook)
+
+            def remove():
+                try:
+                    node.out_hooks[idx].remove(hook)
+                except (KeyError, ValueError):
+                    pass
+
+        return _HookRemover(remove)
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        t.dist_attr = self.dist_attr
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .. import ops
+
+        return ops.assign(self)
+
+    # --- host interop -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __dlpack__(self, *a, **kw):
+        return self._value.__dlpack__(*a, **kw)
+
+    # --- dtype/device movement ---------------------------------------------
+    def astype(self, dtype):
+        from .. import ops
+
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        # accepts dtype strings / device strings; device moves are no-ops on
+        # the single-controller jax runtime (placement is sharding-driven)
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a.lower() in (
+                "cpu", "gpu", "tpu", "xpu", "device",
+            ) or ":" in str(a):
+                continue
+            try:
+                dt = _dtypes.convert_dtype(a)
+            except (ValueError, TypeError):
+                continue
+            if dt is not None:
+                out = out.astype(dt)
+        return out
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **kw):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # --- mutation (functional rebind) ---------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value, dtype=self._value.dtype)
+        return self
+
+    def _rebind(self, other):
+        """Adopt another tensor's value + grad linkage (in-place op result)."""
+        self._value = other._value
+        self._grad_node = other._grad_node
+        self.stop_gradient = other.stop_gradient
+        return self
+
+    def __setitem__(self, index, value):
+        from .. import ops
+
+        index = _unwrap_index(index)
+        self._rebind(ops.index_put(self, index, value))
+
+    def __getitem__(self, index):
+        from .. import ops
+
+        return ops.getitem(self, _unwrap_index(index))
+
+    # --- python protocol ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self._value.dtype}{grad_info},\n"
+            f"       {np.asarray(self._value)!r})"
+        )
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a multi-element Tensor is ambiguous")
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # numpy interop (lets np.asarray(tensor) work)
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def element_size(self):
+        return self._value.dtype.itemsize
+
+    def dim(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+    def block_until_ready(self):
+        if hasattr(self._value, "block_until_ready"):
+            self._value.block_until_ready()
+        return self
+
+
+class _HookRemover:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remove(self):
+        self._fn()
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap_index(index):
+    def u(i):
+        return i._value if isinstance(i, Tensor) else i
+
+    if isinstance(index, tuple):
+        return tuple(u(i) for i in index)
+    return u(index)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, persistable, optionally carrying
+    a named-sharding placement for the distributed recipes (~ DistAttr slot on
+    paddle's EagerParamBase, `python/paddle/base/framework.py`)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True

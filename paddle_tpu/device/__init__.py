@@ -1,0 +1,105 @@
+"""paddle.device parity (`python/paddle/device/`): device query/selection.
+
+On the jax runtime, placement is sharding-driven; set_device is advisory.
+Streams/events are no-ops — XLA owns scheduling (the reference's stream
+analyzer role, `new_executor/interpreter/stream_analyzer.cc`, is subsumed by
+the compiler).
+"""
+from __future__ import annotations
+
+import jax
+
+_current = None
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_device():
+    global _current
+    if _current is None:
+        d = jax.devices()[0]
+        _current = f"{d.platform}:{d.id}"
+    return _current
+
+
+def set_device(device):
+    global _current
+    _current = device
+    return device
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+class Stream:
+    """No-op stream (XLA schedules async execution itself)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def synchronize(device=None):
+    for d in jax.local_devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+class cuda:  # namespace shim: reference exposes paddle.device.cuda
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False

@@ -1,0 +1,60 @@
+"""Distributed environment (parity: `paddle.distributed.parallel.ParallelEnv`
++ launcher env conventions `PADDLE_TRAINER_*`).
+
+On the jax runtime, a "rank" is a host process in a multi-host program
+(`jax.process_index()`); within one host all local devices belong to the same
+process (single-controller), so most single-host "multi-rank" behavior is
+expressed as sharding over the device mesh instead. Env vars mirror the
+reference's so launcher-style scripts port over unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_rank()
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.get_world_size()
+    n = os.environ.get("PADDLE_TRAINERS_NUM")
+    if n is not None:
+        return int(n)
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]

@@ -1,0 +1,5 @@
+"""paddle.framework parity surface (dtype helpers, save/load, seeds)."""
+from ..core.dtypes import convert_dtype, get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.rng import seed  # noqa: F401
+from .io_utils import load, save  # noqa: F401
+from .random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401

@@ -1,0 +1,74 @@
+"""paddle.save / paddle.load parity (`python/paddle/framework/io.py:721,960`).
+
+Serialization: numpy-backed pickle for state dicts (cross-version stable),
+with nested dict/list structures preserved. Program/jit artifacts are handled
+by `paddle_tpu.jit.save`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), str(obj._value.dtype))
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_storable(v) for v in obj)
+    return obj
+
+
+def _from_storable(obj):
+    if isinstance(obj, _TensorPayload):
+        return Tensor(obj.data, dtype=obj.dtype)
+    if isinstance(obj, dict):
+        return {k: _from_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_storable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("data", "dtype")
+
+    def __init__(self, data, dtype):
+        # bfloat16 has no numpy wire format -> store as uint16 view
+        if dtype == "bfloat16":
+            self.data = data.view(np.uint16)
+        else:
+            self.data = data
+        self.dtype = dtype
+
+    def __reduce__(self):
+        return (_restore_payload, (self.data, self.dtype))
+
+
+def _restore_payload(data, dtype):
+    p = object.__new__(_TensorPayload)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        p.data = data.view(jnp.bfloat16)
+    else:
+        p.data = data
+    p.dtype = dtype
+    return p
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_storable(pickle.load(f))

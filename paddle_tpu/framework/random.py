@@ -1,0 +1,9 @@
+from ..core import rng as _rng
+
+
+def get_cuda_rng_state():
+    return _rng.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    _rng.set_rng_state(state)

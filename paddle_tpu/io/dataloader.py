@@ -1,0 +1,149 @@
+"""DataLoader (paddle.io.DataLoader parity: `python/paddle/io/reader.py:216`,
+iterators `dataloader_iter.py:150,358`).
+
+TPU-first: worker threads (not processes) prefetch + collate into numpy;
+device transfer is a single `jax.device_put` per batch riding XLA's async
+dispatch, playing the role of the reference's pin-memory thread + shared-mem
+tensor transport. A C++ shared-memory ring (multiprocess workers) is the
+planned upgrade for heavy CPU-bound pipelines.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, str):
+        return list(batch)
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif batch_size is None:
+            self.batch_sampler = None
+            self.batch_size = None
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no deterministic length")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _iter_single(self):
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.dataset[i]
+            return
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_workers(self):
+        """Thread-pool prefetch: index batches fan out to workers; results are
+        re-ordered to preserve determinism."""
+        assert not self._iterable_mode, \
+            "num_workers>0 with IterableDataset not supported yet"
+        index_q = queue.Queue()
+        out_q = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        batches = list(self.batch_sampler)
+        for i, b in enumerate(batches):
+            index_q.put((i, b))
+        stop = object()
+
+        def worker(wid):
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while True:
+                try:
+                    i, idxs = index_q.get_nowait()
+                except queue.Empty:
+                    out_q.put(stop)
+                    return
+                try:
+                    out_q.put((i, self._fetch(idxs)))
+                except Exception as e:  # surface worker errors
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        pending = {}
+        next_idx = 0
+        finished_workers = 0
+        total = len(batches)
+        while next_idx < total:
+            item = out_q.get()
+            if item is stop:
+                finished_workers += 1
+                if finished_workers == len(threads) and next_idx < total \
+                        and not pending:
+                    break
+                continue
+            i, data = item
+            if isinstance(data, Exception):
+                raise data
+            pending[i] = data
+            while next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+
+    def __iter__(self):
+        if self.num_workers > 0:
+            return self._iter_workers()
+        return self._iter_single()

@@ -1,0 +1,128 @@
+"""Gradient clipping (paddle.nn.clip parity: `python/paddle/nn/clip.py`).
+
+ClipGradByGlobalNorm is the hybrid-parallel-critical one: the distributed
+optimizer subclasses extend `_global_norm` with cross-mesh-axis psum
+(HybridParallelClipGrad role, `hybrid_parallel_optimizer.py:44`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply("clip_grad_value",
+                                 lambda v: jnp.clip(v, self.min, self.max), g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def f(v):
+                norm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+                factor = jnp.where(norm > self.clip_norm,
+                                   self.clip_norm / jnp.maximum(norm, 1e-12),
+                                   1.0)
+                return (v.astype(jnp.float32) * factor).astype(v.dtype)
+
+            out.append((p, apply("clip_grad_norm", f, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def _global_norm_sq(self, grads):
+        """Sum of squares over local grads; distributed subclasses add the
+        cross-axis reduction here."""
+        def f(*vs):
+            return sum(jnp.sum(jnp.square(v.astype(jnp.float32))) for v in vs)
+
+        return apply("global_norm_sq", f, *grads)
+
+    def _dygraph_clip(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        gsq = self._global_norm_sq(grads)
+
+        def scale_fn(v, s):
+            gn = jnp.sqrt(s)
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-6), 1.0)
+            return (v.astype(jnp.float32) * factor).astype(v.dtype)
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, apply("clip_by_global_norm", scale_fn, g, gsq)))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(0.0)
+
+    def norm_fn(*vs):
+        if norm_type == float("inf"):
+            return jnp.max(jnp.stack([jnp.max(jnp.abs(v)) for v in vs]))
+        return sum(jnp.sum(jnp.abs(v.astype(jnp.float32)) ** norm_type)
+                   for v in vs) ** (1.0 / norm_type)
+
+    total = apply("total_norm", norm_fn, *grads)
+    clip_coef = float(max_norm) / (float(total.numpy()) + 1e-6)
+    if clip_coef < 1.0:
+        for p in parameters:
+            if p.grad is not None:
+                p.grad = Tensor(p.grad._value * clip_coef)
+    return total
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._value, -clip_value, clip_value))

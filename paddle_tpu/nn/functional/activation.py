@@ -1,0 +1,221 @@
+"""Activation functionals (paddle.nn.functional.activation parity:
+`python/paddle/nn/functional/activation.py`). All map to VPU-friendly
+elementwise XLA ops that fuse into adjacent matmuls."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+from ...core import rng as _rng
+
+__all__ = [
+    "relu", "relu_", "relu6", "leaky_relu", "prelu", "elu", "selu", "celu",
+    "gelu", "silu", "swish", "mish", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "softsign", "softplus",
+    "softmax", "log_softmax", "log_sigmoid", "sigmoid", "tanh", "glu",
+    "gumbel_softmax", "maxout", "rrelu", "thresholded_relu", "swiglu",
+]
+
+
+@op("relu")
+def relu(x, name=None):
+    return jnp.maximum(x, 0)
+
+
+def relu_(x, name=None):
+    return x._rebind(relu(x))
+
+
+@op("relu6")
+def relu6(x, name=None):
+    return jnp.clip(x, 0, 6)
+
+
+@op("leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+@op("prelu")
+def prelu(x, weight, data_format="NCHW", name=None):
+    if weight.size > 1:
+        shape = [1] * x.ndim
+        axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[axis] = weight.shape[0]
+        weight = weight.reshape(shape)
+    return jnp.where(x >= 0, x, weight * x)
+
+
+@op("elu")
+def elu(x, alpha=1.0, name=None):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op("celu")
+def celu(x, alpha=1.0, name=None):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+@op("gelu")
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@op("silu")
+def silu(x, name=None):
+    return x * jax.nn.sigmoid(x)
+
+
+@op("swish")
+def swish(x, name=None):
+    return x * jax.nn.sigmoid(x)
+
+
+@op("mish")
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op("hardswish")
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+@op("hardsigmoid")
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0, 1)
+
+
+@op("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@op("hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+@op("softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0))
+
+
+@op("tanhshrink")
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@op("softsign")
+def softsign(x, name=None):
+    return x / (1 + jnp.abs(x))
+
+
+@op("softplus")
+def softplus(x, beta=1, threshold=20, name=None):
+    # double-where: keep the untaken exp branch finite (where-grad trap)
+    big = x * beta > threshold
+    safe = jnp.where(big, jnp.zeros((), x.dtype), x)
+    return jnp.where(big, x, jnp.log1p(jnp.exp(beta * safe)) / beta)
+
+
+@op("softmax")
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...core import dtypes as _dt
+
+        x = x.astype(_dt.convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...core import dtypes as _dt
+
+        x = x.astype(_dt.convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("log_sigmoid")
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@op("sigmoid")
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@op("tanh")
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@op("glu")
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@op("swiglu")
+def swiglu(x, y=None, name=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return x * jax.nn.sigmoid(x) * y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.dispatch import apply
+
+    key = _rng.default_generator.split()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(
+                y_hard, idx, jnp.asarray(1.0, y.dtype), axis=axis,
+                inplace=False)
+            # straight-through estimator
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply("gumbel_softmax", f, x)
+
+
+@op("maxout")
+def maxout(x, groups, axis=1, name=None):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core.dispatch import apply
+
+    if not training:
+        neg = (lower + upper) / 2.0
+        return leaky_relu(x, neg)
+    key = _rng.default_generator.split()
+
+    def f(v):
+        a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+        return jnp.where(v >= 0, v, a * v)
+
+    return apply("rrelu", f, x)
+
+
+@op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return jnp.where(x > threshold, x, value)

@@ -1,0 +1,125 @@
+"""Attention functionals.
+
+Parity targets: `paddle.nn.functional.scaled_dot_product_attention` /
+`flash_attention` (python/paddle/nn/functional/flash_attention.py:146, backed
+by third_party/flashattn CUDA kernels) and the fused rope op
+(`paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu`).
+
+TPU-first: on TPU the flash path dispatches a Pallas blockwise-softmax kernel
+(`paddle_tpu.ops.pallas.flash_attention`); elsewhere a jnp reference
+implementation with identical semantics.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, op
+
+__all__ = [
+    "scaled_dot_product_attention", "flash_attention",
+    "fused_rotary_position_embedding", "apply_rotary_pos_emb",
+]
+
+
+def _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale):
+    # q,k,v: [B, S, H, D] (paddle flash-attention layout)
+    d = q.shape[-1]
+    scale = scale or (1.0 / math.sqrt(d))
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs [batch, seq, heads, head_dim] (reference layout)."""
+    from ...ops import pallas as _pl
+
+    def f(q, k, v, m):
+        if _pl.flash_attention_available(q):
+            return _pl.flash_attention_fwd(q, k, v, m, is_causal)
+        return _sdpa_ref(q, k, v, m, dropout_p, is_causal, None)
+
+    return apply("scaled_dot_product_attention", f, query, key, value,
+                 attn_mask)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def _rope_rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _rope_rotate_interleaved(x):
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack([-x2, x1], axis=-1)
+    return out.reshape(x.shape)
+
+
+@op("fused_rotary_position_embedding")
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    name=None):
+    """q/k/v: [B, S, H, D]. Matches incubate.nn.functional.
+    fused_rotary_position_embedding semantics (fused_rope_kernel.cu)."""
+    b, s, h, d = q.shape
+    if sin is None or cos is None:
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)  # [S, D/2]
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        cos = jnp.cos(emb)[None, :, None, :]
+        sin = jnp.sin(emb)[None, :, None, :]
+    else:
+        cos = jnp.reshape(cos, (1, -1, 1, d))
+        sin = jnp.reshape(sin, (1, -1, 1, d))
+    if position_ids is not None:
+        cos = jnp.squeeze(cos, axis=(0, 2))[position_ids][:, :, None, :]
+        sin = jnp.squeeze(sin, axis=(0, 2))[position_ids][:, :, None, :]
+    cos = cos.astype(q.dtype)
+    sin = sin.astype(q.dtype)
+
+    rot = _rope_rotate_half if use_neox_rotary_style else \
+        _rope_rotate_interleaved
+
+    def emb_one(x):
+        if x is None:
+            return None
+        return x * cos + rot(x) * sin
+
+    return tuple(emb_one(x) for x in (q, k, v))
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, position_ids=None):
+    out = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos,
+                                          position_ids=position_ids)
+    return out[0], out[1]

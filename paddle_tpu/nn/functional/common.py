@@ -1,0 +1,191 @@
+"""Common functionals: linear, embedding, dropout, padding, folding
+(paddle.nn.functional.common parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng as _rng
+from ...core.dispatch import apply, op
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad  # noqa: F401 (re-export)
+
+__all__ = [
+    "linear", "embedding", "bilinear", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "pad", "unfold", "fold", "cosine_similarity",
+    "label_smooth", "one_hot", "sequence_mask", "normalize",
+]
+
+
+@op("linear")
+def linear(x, weight, bias=None, name=None):
+    # weight layout [in, out] — matches the reference's nn.Linear storage
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+@op("bilinear")
+def bilinear(x1, x2, weight, bias=None, name=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if training or mode != "downscale_in_infer" or p == 0.0:
+            return x if isinstance(x, Tensor) else Tensor(x)
+        # downscale_in_infer: train uses the raw mask, infer scales by (1-p)
+        return apply("dropout_infer", lambda v: v * (1.0 - p), x)
+    key = _rng.default_generator.split()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype)).astype(v.dtype)
+        return jnp.where(keep, v, jnp.zeros((), v.dtype))
+
+    return apply("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _rng.default_generator.split()
+
+    def f(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / (scale * ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5))
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply("alpha_dropout", f, x)
+
+
+@op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    # x: [N, C, H, W] -> [N, C*kh*kw, L]
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pads = (p, p, p, p)
+    elif len(p) == 2:
+        pads = (p[0], p[0], p[1], p[1])
+    else:
+        pads = tuple(p)
+    x = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])))
+    n, c, h, w = x.shape
+    oh = (h - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w - (dw * (kw - 1) + 1)) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+@op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pads = (p, p, p, p)
+    elif len(p) == 2:
+        pads = (p[0], p[0], p[1], p[1])
+    else:
+        pads = tuple(p)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    hh, ww = oh + pads[0] + pads[1], ow + pads[2] + pads[3]
+    nh = (hh - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ww - (dw * (kw - 1) + 1)) // sw + 1
+    out = jnp.zeros((n, c, hh, ww), x.dtype)
+    xr = x.reshape(n, c, kh, kw, nh, nw)
+    for i in range(kh):
+        for j in range(kw):
+            hs = i * dh
+            ws = j * dw
+            out = out.at[:, :, hs:hs + nh * sh:sh, ws:ws + nw * sw:sw].add(
+                xr[:, :, i, j])
+    return out[:, :, pads[0]:hh - pads[1], pads[2]:ww - pads[3]]
+
+
+@op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@op("one_hot")
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@op("sequence_mask")
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtypes as _dt
+
+    m = int(maxlen) if maxlen is not None else None
+    if m is None:
+        m = int(jnp.max(x))
+    r = jnp.arange(m)
+    mask = r[None, :] < x[..., None]
+    return mask.astype(_dt.convert_dtype(dtype))
+
+
+@op("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)

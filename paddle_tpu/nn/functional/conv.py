@@ -1,0 +1,164 @@
+"""Convolutions (paddle.nn.functional.conv parity). All lower to
+`lax.conv_general_dilated`, which XLA tiles onto the MXU — the TPU analog of
+the reference's cuDNN dispatch (`paddle/phi/kernels/gpudnn/conv_kernel.cu`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v if len(v) == n else tuple(v[i % len(v)] for i in range(n))
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _dimnums(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else \
+            ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else \
+        ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    dn = _dimnums(n, channel_last)
+    if channel_last:
+        # weights are stored OI... (paddle layout); transpose for channel-last
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        weight = jnp.transpose(weight, perm)
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tup(stride, n),
+        padding=_pad_cfg(padding, n),
+        rhs_dilation=_tup(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format in ("NLC",))
+
+
+@op("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format == "NHWC")
+
+
+@op("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format == "NDHWC")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last, output_size=None):
+    dn = _dimnums(n, channel_last)
+    strides = _tup(stride, n)
+    dil = _tup(dilation, n)
+    opad = _tup(output_padding, n)
+    # paddle weight layout for transpose conv: [in, out/groups, *k]
+    k = weight.shape[2:]
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+        lo_hi = None
+    else:
+        lo_hi = _pad_cfg(padding, n)
+
+    if lo_hi is not None:
+        # transpose-conv padding math: pad = dilation*(k-1) - pad
+        pad_cfg = [
+            (dil[i] * (k[i] - 1) - lo_hi[i][0],
+             dil[i] * (k[i] - 1) - lo_hi[i][1] + opad[i])
+            for i in range(n)
+        ]
+    # flip spatial dims & swap io: OIHW expected with O=out
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    if groups > 1:
+        ci = w.shape[0]
+        co_g = w.shape[1]
+        w = w.reshape((groups, ci // groups) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)  # g, co_g, ci_g, *k
+        w = w.reshape((groups * co_g, ci // groups) + tuple(k))
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    if channel_last:
+        perm = tuple(range(2, 2 + n)) + (1, 0)
+        w = jnp.transpose(w, perm)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=(1,) * n,
+        padding=pad_cfg,
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC",
+                           output_size)
+
+
+@op("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC",
+                           output_size)
+
+
+@op("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC",
+                           output_size)

@@ -1,0 +1,270 @@
+"""Loss functionals (paddle.nn.functional.loss parity:
+`python/paddle/nn/functional/loss.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "smooth_l1_loss", "margin_ranking_loss", "square_error_cost",
+    "sigmoid_focal_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+    "triplet_margin_loss", "log_loss", "npair_loss", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+@op("cross_entropy")
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    logits = input
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    n_classes = logits.shape[axis]
+    if soft_label or (label.ndim == logits.ndim and
+                      label.shape[axis] == n_classes and
+                      jnp.issubdtype(label.dtype, jnp.floating)):
+        soft = label
+        if label_smoothing:
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(soft * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(soft * weight, axis=axis)
+            loss = loss * w
+        return _reduce(loss, reduction)
+    lab = label
+    if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+        lab = jnp.squeeze(lab, axis)
+    lab = lab.astype(jnp.int32)
+    valid = lab != ignore_index
+    safe_lab = jnp.where(valid, lab, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_lab, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis)
+    if label_smoothing:
+        smooth_loss = -jnp.mean(logp, axis=axis)
+        loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+    else:
+        loss = -picked
+    if weight is not None:
+        w = weight[safe_lab]
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if weight is not None:
+            denom = jnp.sum(jnp.where(valid, weight[safe_lab], 0.0))
+        else:
+            denom = jnp.sum(valid.astype(loss.dtype))
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@op("mse_loss")
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@op("l1_loss")
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@op("nll_loss")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lab = label.astype(jnp.int32)
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, 0)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1)
+    loss = -jnp.squeeze(picked, 1)
+    if weight is not None:
+        loss = loss * weight[safe]
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(weight[safe] * valid) if weight is not None else \
+            jnp.sum(valid)
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
+@op("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    max_val = jnp.maximum(-logit, 0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val + \
+            jnp.log(jnp.exp(-max_val) + jnp.exp(-logit - max_val))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("kl_div")
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        out = label * (jnp.log(jnp.maximum(label, 1e-30)) - input)
+        loss = jnp.where(label > 0, out, 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    loss = jnp.maximum(-label * (input - other) + margin, 0)
+    return _reduce(loss, reduction)
+
+
+@op("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = (1 - label) * logit + jnp.maximum(-logit, 0) + \
+        jnp.log(jnp.exp(-jnp.abs(logit)) + 1)
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        alpha_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = alpha_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@op("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+@op("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@op("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.abs(a - b) ** p, axis=-1) + epsilon,
+                         1.0 / p)
+
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.maximum(d_pos - d_neg + margin, 0)
+    return _reduce(loss, reduction)
+
+
+@op("log_loss")
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return -label * jnp.log(input + epsilon) - \
+        (1 - label) * jnp.log(1 - input + epsilon)
+
+
+@op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1)) +
+                    jnp.mean(jnp.sum(jnp.square(positive), 1))) / 4
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1, 1) == labels.reshape(1, -1)
+    lab = lab.astype(sim.dtype)
+    lab = lab / jnp.sum(lab, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(lab * logp, axis=1))
+    return ce + reg
+
+
+@op("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label + \
+            0.5 * jnp.log(2 * jnp.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@op("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    loss = -(label * jax.nn.log_sigmoid(input) +
+             (1 - label) * jax.nn.log_sigmoid(-input))
+    loss = jnp.mean(loss, axis=-1)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)

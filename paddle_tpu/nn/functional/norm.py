@@ -1,0 +1,172 @@
+"""Normalization functionals (paddle.nn.functional.norm parity). The fused
+rms_norm/layer_norm fast paths swap in Pallas kernels on TPU (see
+`paddle_tpu.ops.pallas`), mirroring `paddle/phi/kernels/fusion/gpu/
+fused_layernorm_kernel.cu` / `incubate.nn.functional.fused_rms_norm`."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "layer_norm", "batch_norm", "instance_norm", "group_norm",
+    "local_response_norm", "rms_norm",
+]
+
+
+@op("layer_norm")
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("rms_norm")
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    axis = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    axes = tuple(range(axis, x.ndim))
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    ms = jnp.mean(jnp.square(x32), axis=axes, keepdims=True)
+    out = (x32 * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Stateful batch norm: updates running stats in-place during training
+    (reference semantics: `paddle/phi/kernels/gpu/batch_norm_kernel.cu`)."""
+    from ...core.dispatch import apply
+    from ...core.tensor import Tensor
+
+    c_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    if x.ndim == 2:
+        c_axis = 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        def f(v, w, b, rm, rv):
+            v32 = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) else v
+            mean = jnp.mean(v32, axis=axes)
+            var = jnp.var(v32, axis=axes)
+            shape = [1] * v.ndim
+            shape[c_axis] = -1
+            out = (v32 - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + epsilon)
+            out = out.astype(v.dtype)
+            if w is not None:
+                out = out * w.reshape(shape)
+            if b is not None:
+                out = out + b.reshape(shape)
+            return out, mean, var
+
+        out, bmean, bvar = apply("batch_norm", f, x, weight, bias,
+                                 running_mean, running_var)
+        # update running stats (host-side state, like the reference's
+        # mean_out/variance_out outputs written back to the same variable)
+        m = momentum
+        running_mean.set_value(
+            m * running_mean._value + (1 - m) * bmean._value)
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+        unbiased = bvar._value * (n / max(1, n - 1))
+        running_var.set_value(m * running_var._value + (1 - m) * unbiased)
+        return out
+
+    def g(v, w, b, rm, rv):
+        shape = [1] * v.ndim
+        shape[c_axis] = -1
+        v32 = v.astype(jnp.float32) if v.dtype in (jnp.bfloat16, jnp.float16) else v
+        out = (v32 - rm.reshape(shape)) * jax.lax.rsqrt(
+            rv.reshape(shape) + epsilon)
+        out = out.astype(v.dtype)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    return apply("batch_norm_infer", g, x, weight, bias, running_mean,
+                 running_var)
+
+
+@op("instance_norm")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[1] = -1
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1] * x.ndim
+        shape[1] = -1
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("group_norm")
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    if data_format == "NHWC":
+        x_t = jnp.moveaxis(x, -1, 1)
+    else:
+        x_t = x
+    n, c = x_t.shape[0], x_t.shape[1]
+    spatial = x_t.shape[2:]
+    g = x_t.reshape((n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_t.shape)
+    shape = [1] * x_t.ndim
+    shape[1] = -1
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    pad_cfg = [(0, 0)] * x.ndim
+    pad_cfg[c_axis] = (half, size - half - 1)
+    padded = jnp.pad(sq, pad_cfg)
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        sl = [slice(None)] * x.ndim
+        sl[c_axis] = slice(i, i + x.shape[c_axis])
+        acc = acc + padded[tuple(sl)]
+    div = jnp.power(k + alpha * acc, beta)
+    return x / div

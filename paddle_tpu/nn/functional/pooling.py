@@ -1,0 +1,163 @@
+"""Pooling functionals (paddle.nn.functional.pooling parity) — lowered to
+`lax.reduce_window`, XLA's native pooling primitive."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+]
+
+
+def _tup(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool(x, ksize, stride, padding, n, mode, ceil_mode=False,
+          exclusive=True, channel_last=False):
+    k = _tup(ksize, n)
+    s = _tup(stride, n) or k
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _tup(padding, n) if not isinstance(padding, int) else (padding,) * n
+        pad = [(pp, pp) for pp in p]
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = pad if isinstance(pad, str) else [(0, 0)] + list(pad) + [(0, 0)]
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    if mode == "max":
+        init = -np.inf if jnp.issubdtype(x.dtype, np.floating) else \
+            np.iinfo(np.dtype(x.dtype)).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
+                                     pads)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                   window, strides, pads)
+    if exclusive and isinstance(pads, list) and any(p != (0, 0) for p in pads):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       strides, pads)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+@op("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", ceil_mode,
+                 exclusive)
+
+
+@op("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", ceil_mode,
+                 exclusive, data_format == "NHWC")
+
+
+@op("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", ceil_mode,
+                 exclusive, data_format == "NDHWC")
+
+
+@op("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode)
+
+
+@op("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
+                 channel_last=data_format == "NHWC")
+
+
+@op("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                 channel_last=data_format == "NDHWC")
+
+
+def _adaptive(x, output_size, n, mode):
+    out_sz = _tup(output_size, n)
+    spatial = x.shape[2:]
+    out = x
+    # decompose into per-axis windows when evenly divisible; general case uses
+    # mean/max over index buckets
+    if all(s % o == 0 for s, o in zip(spatial, out_sz)):
+        k = tuple(s // o for s, o in zip(spatial, out_sz))
+        window = (1, 1) + k
+        if mode == "max":
+            return jax.lax.reduce_window(x, -np.inf, jax.lax.max, window,
+                                         window, "VALID")
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, window,
+                                       "VALID")
+        return summed / float(np.prod(k))
+    # uneven: gather per output cell (small output sizes typical)
+    for ax, o in enumerate(out_sz):
+        dim = out.shape[2 + ax]
+        starts = [int(np.floor(i * dim / o)) for i in range(o)]
+        ends = [int(np.ceil((i + 1) * dim / o)) for i in range(o)]
+        pieces = []
+        for s_, e_ in zip(starts, ends):
+            sl = [slice(None)] * out.ndim
+            sl[2 + ax] = slice(s_, e_)
+            seg = out[tuple(sl)]
+            red = jnp.max(seg, axis=2 + ax, keepdims=True) if mode == "max" \
+                else jnp.mean(seg, axis=2 + ax, keepdims=True)
+            pieces.append(red)
+        out = jnp.concatenate(pieces, axis=2 + ax)
+    return out
+
+
+@op("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+@op("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg")
+
+
+@op("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg")
+
+
+@op("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max")
+
+
+@op("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max")
+
+
+@op("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max")

@@ -1,0 +1,153 @@
+"""Vision functionals (paddle.nn.functional.vision parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import op
+
+__all__ = ["interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
+           "channel_shuffle", "affine_grid", "grid_sample"]
+
+
+@op("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if data_format in ("NHWC", "NWC", "NDHWC"):
+        spatial = x.shape[1:-1]
+        chan_last = True
+    else:
+        spatial = x.shape[2:]
+        chan_last = False
+    n_sp = len(spatial)
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n_sp
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        size = [int(s) for s in (size if isinstance(size, (list, tuple))
+                                 else [size])]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "bicubic": "cubic", "trilinear": "linear", "area": "linear"}[mode]
+    if chan_last:
+        new_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+        scale_axes = tuple(range(1, 1 + n_sp))
+    else:
+        new_shape = x.shape[:2] + tuple(size)
+        scale_axes = tuple(range(2, 2 + n_sp))
+    if mode == "nearest":
+        # index-based nearest (matches reference's pixel mapping)
+        out = x
+        for i, ax in enumerate(scale_axes):
+            in_sz = x.shape[ax]
+            out_sz = size[i]
+            idx = jnp.floor(jnp.arange(out_sz) * in_sz / out_sz).astype(jnp.int32)
+            out = jnp.take(out, idx, axis=ax)
+        return out
+    return jax.image.resize(x, new_shape, method=jmode)
+
+
+upsample = interpolate
+
+
+@op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c // (r * r), r, r, h, w)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, r, r, c // (r * r))
+    out = out.transpose(0, 1, 3, 2, 4, 5)
+    return out.reshape(n, h * r, w * r, c // (r * r))
+
+
+@op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c, h // r, r, w // r, r)
+        out = out.transpose(0, 1, 3, 5, 2, 4)
+        return out.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h // r, r, w // r, r, c)
+    out = out.transpose(0, 1, 3, 2, 4, 5)
+    return out.reshape(n, h // r, w // r, c * r * r)
+
+
+@op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, groups, c // groups, h, w)
+        out = out.transpose(0, 2, 1, 3, 4)
+        return out.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, groups, c // groups)
+    out = out.transpose(0, 1, 2, 4, 3)
+    return out.reshape(n, h, w, c)
+
+
+@op("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    n, _, h, w = [int(s) for s in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+        xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # H,W,3
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)
+    return grid
+
+
+@op("grid_sample")
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def gather(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1)
+        xc = jnp.clip(xx, 0, w - 1)
+        # per-batch gather: vmap over n
+        def one(img, yb, xb, vb):
+            g = img[:, yb, xb]  # C, Hg, Wg
+            return jnp.where(vb[None], g, 0.0)
+
+        return jax.vmap(one)(x, yc, xc, valid)
+
+    if mode == "nearest":
+        xn = jnp.round(fx).astype(jnp.int32)
+        yn = jnp.round(fy).astype(jnp.int32)
+        return gather(yn, xn)
+
+    wa = (x1 - fx) * (y1 - fy)
+    wb = (x1 - fx) * (fy - y0)
+    wc = (fx - x0) * (y1 - fy)
+    wd = (fx - x0) * (fy - y0)
+    va = gather(y0, x0)
+    vb = gather(y1, x0)
+    vc = gather(y0, x1)
+    vd = gather(y1, x1)
+    return (va * wa[:, None] + vb * wb[:, None] + vc * wc[:, None] +
+            vd * wd[:, None]).astype(x.dtype)

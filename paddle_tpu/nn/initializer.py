@@ -1,0 +1,211 @@
+"""Weight initializers (paddle.nn.initializer parity:
+`python/paddle/nn/initializer/`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def _fans(shape):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return gains.get(nonlinearity, 1.0)
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(int(s) for s in shape), self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        key = _rng.default_generator.split()
+        return (jax.random.normal(key, tuple(int(s) for s in shape),
+                                  jnp.float32) * self.std + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        key = _rng.default_generator.split()
+        lo = (self.a - self.mean) / self.std
+        hi = (self.b - self.mean) / self.std
+        z = jax.random.truncated_normal(key, lo, hi,
+                                        tuple(int(s) for s in shape), jnp.float32)
+        return (z * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        key = _rng.default_generator.split()
+        return jax.random.uniform(key, tuple(int(s) for s in shape),
+                                  jnp.float32, self.low, self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        key = _rng.default_generator.split()
+        return (jax.random.normal(key, tuple(int(s) for s in shape),
+                                  jnp.float32) * std).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        bound = self.gain * math.sqrt(6.0 / (fi + fo))
+        key = _rng.default_generator.split()
+        return jax.random.uniform(key, tuple(int(s) for s in shape),
+                                  jnp.float32, -bound, bound).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="leaky_relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        key = _rng.default_generator.split()
+        return (jax.random.normal(key, tuple(int(s) for s in shape),
+                                  jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="leaky_relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        bound = gain * math.sqrt(3.0 / fi)
+        key = _rng.default_generator.split()
+        return jax.random.uniform(key, tuple(int(s) for s in shape),
+                                  jnp.float32, -bound, bound).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        v = self.value._value if isinstance(self.value, Tensor) else \
+            jnp.asarray(np.asarray(self.value))
+        return v.reshape(tuple(int(s) for s in shape)).astype(dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        key = _rng.default_generator.split()
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        mins = min(oc // self.groups, ic)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                idx = (g * (oc // self.groups) + i, i, *centers)
+                out[idx] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+def _resolve_initializer(init, shape, dtype, is_bias):
+    if init is None:
+        fan_in, _ = _fans(shape)
+        bound = 1.0 / math.sqrt(fan_in) if fan_in > 0 else 0.0
+        return Uniform(-bound, bound) if not is_bias else Constant(0.0)
+    if isinstance(init, Initializer):
+        return init
+    if callable(init):
+        class _Wrap(Initializer):
+            def __call__(self, s, d):
+                r = init(s, d)
+                return r._value if isinstance(r, Tensor) else r
+
+        return _Wrap()
+    raise TypeError(f"invalid initializer {init!r}")

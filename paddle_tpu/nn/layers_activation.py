@@ -1,0 +1,87 @@
+"""Activation layers (paddle.nn.layer.activation parity)."""
+from __future__ import annotations
+
+from . import functional as F
+from .initializer import Constant
+from .layer_base import Layer
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "PReLU", "ELU", "SELU", "CELU", "GELU",
+    "Silu", "Swish", "Mish", "Hardswish", "Hardsigmoid", "Hardtanh",
+    "Hardshrink", "Softshrink", "Tanhshrink", "Softsign", "Softplus",
+    "Softmax", "LogSoftmax", "LogSigmoid", "Sigmoid", "Tanh", "GLU",
+    "Maxout", "RReLU", "ThresholdedReLU",
+]
+
+
+def _simple(name, fn, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            keys = list(defaults)
+            for i, a in enumerate(args):
+                merged[keys[i]] = a
+            for k, v in kwargs.items():
+                if k in merged:
+                    merged[k] = v
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU", lambda x: F.relu(x))
+ReLU6 = _simple("ReLU6", lambda x: F.relu6(x))
+LeakyReLU = _simple("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+ELU = _simple("ELU", F.elu, alpha=1.0)
+SELU = _simple("SELU", lambda x: F.selu(x))
+CELU = _simple("CELU", F.celu, alpha=1.0)
+GELU = _simple("GELU", F.gelu, approximate=False)
+Silu = _simple("Silu", lambda x: F.silu(x))
+Swish = _simple("Swish", lambda x: F.swish(x))
+Mish = _simple("Mish", lambda x: F.mish(x))
+Hardswish = _simple("Hardswish", lambda x: F.hardswish(x))
+Hardsigmoid = _simple("Hardsigmoid", lambda x: F.hardsigmoid(x))
+Hardtanh = _simple("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+Hardshrink = _simple("Hardshrink", F.hardshrink, threshold=0.5)
+Softshrink = _simple("Softshrink", F.softshrink, threshold=0.5)
+Tanhshrink = _simple("Tanhshrink", lambda x: F.tanhshrink(x))
+Softsign = _simple("Softsign", lambda x: F.softsign(x))
+Softplus = _simple("Softplus", F.softplus, beta=1, threshold=20)
+Softmax = _simple("Softmax", F.softmax, axis=-1)
+LogSoftmax = _simple("LogSoftmax", F.log_softmax, axis=-1)
+LogSigmoid = _simple("LogSigmoid", lambda x: F.log_sigmoid(x))
+Sigmoid = _simple("Sigmoid", lambda x: F.sigmoid(x))
+Tanh = _simple("Tanh", lambda x: F.tanh(x))
+GLU = _simple("GLU", F.glu, axis=-1)
+Maxout = _simple("Maxout", F.maxout, groups=2, axis=1)
+ThresholdedReLU = _simple("ThresholdedReLU", F.thresholded_relu,
+                          threshold=1.0, value=0.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, self.training)

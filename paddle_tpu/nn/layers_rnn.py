@@ -1,0 +1,250 @@
+"""Recurrent layers (paddle.nn.layer.rnn parity). Cells are exposed for
+step-wise use; full-sequence layers run `lax.scan` inside one op — static
+control flow XLA can pipeline, replacing the reference's cuDNN RNN kernels."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .initializer import Uniform
+from .layer_base import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gate_mult, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        g = gate_mult * hidden_size
+        self.weight_ih = self.create_parameter([g, input_size],
+                                               attr=weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([g, hidden_size],
+                                               attr=weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([g], attr=bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([g], attr=bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+
+        if states is None:
+            states = P.zeros([inputs.shape[0], self.hidden_size],
+                             inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else \
+            (lambda v: jnp.maximum(v, 0))
+
+        def f(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return act(z)
+
+        h = apply("simple_rnn_cell", f, inputs, states, self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+
+        if states is None:
+            z = P.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+            states = (z, z.clone())
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply("lstm_cell", f, inputs, h0, c0, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as P
+
+        if states is None:
+            states = P.zeros([inputs.shape[0], self.hidden_size], inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (h - c) * z + c
+
+        h = apply("gru_cell", f, inputs, states, self.weight_ih,
+                  self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell; runs over the time axis (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as P
+
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        for i in idxs:
+            x_t = inputs[:, i] if t_axis == 1 else inputs[i]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = P.stack(outs, axis=t_axis)
+        return out, states
+
+
+class _MultiLayerRNN(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        from .layers_common import LayerList
+
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        n_dir = 2 if self.bidirect else 1
+        self.n_dir = n_dir
+
+        def make_cell(in_sz):
+            if self.MODE == "LSTM":
+                return LSTMCell(in_sz, hidden_size, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            if self.MODE == "GRU":
+                return GRUCell(in_sz, hidden_size, weight_ih_attr,
+                               weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(in_sz, hidden_size, activation,
+                                 weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                                 bias_hh_attr)
+
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * n_dir
+            for _ in range(n_dir):
+                cells.append(make_cell(in_sz))
+        self.cells = LayerList(cells)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as P
+        from .functional import dropout as fdropout
+
+        x = inputs
+        final_h = []
+        final_c = []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.n_dir):
+                cell = self.cells[layer * self.n_dir + d]
+                init = None
+                if initial_states is not None:
+                    if self.MODE == "LSTM":
+                        h0, c0 = initial_states
+                        idx = layer * self.n_dir + d
+                        init = (h0[idx], c0[idx])
+                    else:
+                        init = initial_states[layer * self.n_dir + d]
+                rnn = RNN(cell, is_reverse=(d == 1),
+                          time_major=self.time_major)
+                out, st = rnn(x, init)
+                outs.append(out)
+                if self.MODE == "LSTM":
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            x = outs[0] if len(outs) == 1 else P.concat(outs, axis=-1)
+            if self.dropout and layer < self.num_layers - 1:
+                x = fdropout(x, self.dropout, training=self.training)
+        h = P.stack(final_h, axis=0)
+        if self.MODE == "LSTM":
+            c = P.stack(final_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN"
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "GRU"

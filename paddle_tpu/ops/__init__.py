@@ -1,0 +1,43 @@
+"""The op layer: pure-jnp kernels behind the eager dispatch gate.
+
+Role parity: `python/paddle/tensor/` + the YAML-generated C++ API
+(`paddle/phi/api/yaml/ops.yaml`). Each op body is a pure function over jax
+arrays — the same body serves eager execution, `jax.vjp` autograd, and
+functional tracing under `jit.to_static`.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .logic import is_tensor  # noqa: F401
+
+from ..core.dispatch import apply, op  # noqa: F401
+from ..core.tensor import Tensor
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (paddle.add_n)."""
+    import jax.numpy as jnp
+
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply("add_n", lambda *vs: sum(vs[1:], vs[0]), *inputs)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import jax.numpy as jnp
+
+    def f(pred, lab):
+        topk_idx = jnp.argsort(-pred, axis=-1)[:, :k]
+        lab2 = lab.reshape(-1, 1)
+        hit = jnp.any(topk_idx == lab2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply("accuracy", f, input, label)
+
+
+from ._patch import patch_tensor as _patch_tensor
+
+_patch_tensor()

@@ -1,0 +1,81 @@
+"""Patch tensor methods + operators onto Tensor (parity with how the
+reference monkey-patches `python/paddle/tensor/` functions onto the pybind
+Tensor class)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, reduction
+
+
+def _swap(f):
+    def g(self, other, *a, **kw):
+        return f(other, self, *a, **kw)
+
+    return g
+
+
+def patch_tensor():
+    modules = (math, reduction, manipulation, linalg, logic, creation)
+    # Plain method names: tensor.method(...) == ops.method(tensor, ...)
+    skip = {
+        "to_tensor", "as_tensor", "zeros", "ones", "full", "empty", "arange",
+        "linspace", "logspace", "eye", "rand", "randn", "randint", "randperm",
+        "uniform", "normal", "standard_normal", "meshgrid", "create_parameter",
+        "shape_op",
+    }
+    for mod in modules:
+        for name in getattr(mod, "__all__", []):
+            if name in skip or hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, getattr(mod, name))
+
+    # Paddle-style aliases
+    Tensor.mm = linalg.matmul
+    Tensor.pow = math.pow
+    Tensor.abs = math.abs
+
+    # Operators
+    Tensor.__add__ = math.add
+    Tensor.__radd__ = _swap(math.add)
+    Tensor.__sub__ = math.subtract
+    Tensor.__rsub__ = _swap(math.subtract)
+    Tensor.__mul__ = math.multiply
+    Tensor.__rmul__ = _swap(math.multiply)
+    Tensor.__truediv__ = math.divide
+    Tensor.__rtruediv__ = _swap(math.divide)
+    Tensor.__floordiv__ = math.floor_divide
+    Tensor.__rfloordiv__ = _swap(math.floor_divide)
+    Tensor.__mod__ = math.mod
+    Tensor.__rmod__ = _swap(math.mod)
+    Tensor.__pow__ = math.pow
+    Tensor.__rpow__ = _swap(math.pow)
+    Tensor.__matmul__ = linalg.matmul
+    Tensor.__rmatmul__ = _swap(linalg.matmul)
+    Tensor.__neg__ = math.neg
+    Tensor.__abs__ = math.abs
+    Tensor.__invert__ = logic.logical_not
+    Tensor.__and__ = logic.bitwise_and
+    Tensor.__or__ = logic.bitwise_or
+    Tensor.__xor__ = logic.bitwise_xor
+    Tensor.__lshift__ = logic.bitwise_left_shift
+    Tensor.__rshift__ = logic.bitwise_right_shift
+    Tensor.__eq__ = logic.equal
+    Tensor.__ne__ = logic.not_equal
+    Tensor.__lt__ = logic.less_than
+    Tensor.__le__ = logic.less_equal
+    Tensor.__gt__ = logic.greater_than
+    Tensor.__ge__ = logic.greater_equal
+
+    # In-place operator forms rebind the handle (paddle `x += y` semantics)
+    def _iop(f):
+        def g(self, other):
+            return self._rebind(f(self, other))
+
+        return g
+
+    Tensor.__iadd__ = _iop(math.add)
+    Tensor.__isub__ = _iop(math.subtract)
+    Tensor.__imul__ = _iop(math.multiply)
+    Tensor.__itruediv__ = _iop(math.divide)

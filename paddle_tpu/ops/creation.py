@@ -1,0 +1,292 @@
+"""Tensor creation ops (paddle.tensor.creation parity:
+`python/paddle/tensor/creation.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes as _dtypes
+from ..core import rng as _rng
+from ..core.tensor import Parameter, Tensor
+
+_I64 = _dtypes.convert_dtype("int64")  # int32 when x64 is off (TPU default)
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+    "logspace", "eye", "zeros_like", "ones_like", "full_like", "empty_like",
+    "rand", "randn", "randint", "randperm", "uniform", "normal", "standard_normal",
+    "bernoulli", "multinomial", "poisson", "assign", "clone", "tril_", "diag",
+    "diagflat", "meshgrid", "tril", "triu", "create_parameter", "complex",
+    "as_tensor",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    dtype = _dtypes.convert_dtype(dtype)
+    if dtype is None:
+        dtype = default or _dtypes.get_default_dtype()
+    return dtype
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def as_tensor(data, dtype=None):
+    if isinstance(data, Tensor) and (
+        dtype is None or jnp.dtype(_dtypes.convert_dtype(dtype)) == data.dtype
+    ):
+        return data
+    return Tensor(data, dtype=dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = v(start), v(end), v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(
+            isinstance(x, (int, np.integer)) for x in (start, end, step)
+        ) else _dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, _dt(dtype, "int64")))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    return Tensor(jnp.linspace(v(start), v(stop), int(v(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    return Tensor(jnp.logspace(v(start), v(stop), int(v(num)), base=v(base),
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def _like(x, dtype):
+    dtype = _dtypes.convert_dtype(dtype) or x._value.dtype
+    return tuple(x._value.shape), dtype
+
+
+def zeros_like(x, dtype=None, name=None):
+    shape, dt = _like(x, dtype)
+    return Tensor(jnp.zeros(shape, dt))
+
+
+def ones_like(x, dtype=None, name=None):
+    shape, dt = _like(x, dtype)
+    return Tensor(jnp.ones(shape, dt))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    shape, dt = _like(x, dtype)
+    return Tensor(jnp.full(shape, fill_value, dt))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def complex(real, imag, name=None):
+    from ..core.dispatch import apply
+
+    return apply("complex", jax.lax.complex, real, imag)
+
+
+# --- random ------------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = _rng.default_generator.split()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = (_rng.default_generator.split() if not seed
+           else jax.random.PRNGKey(seed))
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = _rng.default_generator.split()
+        return Tensor(jax.random.normal(key, shp) * s + m)
+    key = _rng.default_generator.split()
+    shp = _shape(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(key, shp, _dt(None)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = _rng.default_generator.split()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     _dt(dtype, _I64)))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _rng.default_generator.split()
+    return Tensor(jax.random.permutation(key, n).astype(_dt(dtype, _I64)))
+
+
+def bernoulli(x, name=None):
+    key = _rng.default_generator.split()
+    from ..core.dispatch import apply
+
+    return apply(
+        "bernoulli",
+        lambda v: jax.random.bernoulli(key, v).astype(v.dtype),
+        x,
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _rng.default_generator.split()
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*v.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(_I64))
+
+
+def poisson(x, name=None):
+    key = _rng.default_generator.split()
+    return Tensor(jax.random.poisson(key, x._value).astype(x._value.dtype))
+
+
+# --- misc --------------------------------------------------------------------
+
+def assign(x, output=None):
+    from ..core.dispatch import apply
+
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    out = apply("assign", lambda v: v + 0, x)
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    from ..core.dispatch import apply
+
+    def f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, v.dtype))
+            return out
+        return jnp.diagonal(v, offset=offset)
+
+    return apply("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    from ..core.dispatch import apply
+
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.dispatch import apply
+
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.dispatch import apply
+
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_(x, diagonal=0, name=None):
+    return x._rebind(tril(x, diagonal))
+
+
+def meshgrid(*args, **kwargs):
+    from ..core.dispatch import apply
+
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply("meshgrid", lambda *vs: jnp.meshgrid(*vs, indexing="ij"), *args)
+    return list(outs)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    dtype = _dt(dtype)
+    if default_initializer is not None:
+        data = default_initializer(_shape(shape), dtype)
+        if isinstance(data, Tensor):
+            data = data._value
+    elif is_bias:
+        data = jnp.zeros(_shape(shape), dtype)
+    else:
+        key = _rng.default_generator.split()
+        fan_in = _shape(shape)[0] if shape else 1
+        bound = float(np.sqrt(6.0 / max(1, fan_in)))
+        data = jax.random.uniform(key, _shape(shape), dtype, -bound, bound)
+    return Parameter(data, name=name)

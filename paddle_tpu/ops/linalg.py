@@ -1,0 +1,330 @@
+"""Linear algebra ops (paddle.tensor.linalg parity:
+`python/paddle/tensor/linalg.py`; kernels land on the MXU via XLA dot/conv)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes as _dtypes
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+_I64 = _dtypes.convert_dtype("int64")  # int32 when x64 is off (TPU default)
+
+__all__ = [
+    "matmul", "dot", "bmm", "mv", "t", "norm", "dist", "einsum", "cross",
+    "cholesky", "cholesky_solve", "qr", "svd", "pca_lowrank", "matrix_rank",
+    "inverse", "pinv", "solve", "triangular_solve", "lstsq", "lu", "lu_unpack",
+    "eig", "eigh", "eigvals", "eigvalsh", "slogdet", "det", "matrix_power",
+    "multi_dot", "histogram", "histogramdd", "bincount", "cov", "corrcoef",
+    "cdist", "householder_product", "matrix_exp", "vander", "vecdot",
+]
+
+
+@op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if jnp.ndim(x) > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if jnp.ndim(y) > 1 else y
+    return jnp.matmul(x, y)
+
+
+@op("dot")
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op("bmm")
+def bmm(x, y, name=None):
+    return jax.lax.batch_matmul(x, y)
+
+
+@op("mv")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@op("t")
+def t(x, name=None):
+    if jnp.ndim(x) < 2:
+        return x
+    return jnp.swapaxes(x, 0, 1)
+
+
+@op("norm")
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@op("dist")
+def dist(x, y, p=2, name=None):
+    d = jnp.abs(x - y)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+@op("einsum")
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@op("cross")
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=axis)
+
+
+@op("cholesky")
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@op("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@op("qr")
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@op("svd")
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = v.shape[-2], v.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        v = v - jnp.mean(v, axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s[..., :q]), \
+        Tensor(jnp.swapaxes(vt, -1, -2)[..., :q])
+
+
+@op("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol).astype(_I64)
+
+
+@op("inverse")
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@op("solve")
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    sol, res, rank_, sv = jnp.linalg.lstsq(xv, yv, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank_.astype(_I64)),
+            Tensor(sv))
+
+
+@op("lu")
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    piv = piv.astype(jnp.int32) + 1  # 1-based like the reference kernel
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], jnp.int32)
+        return lu_, piv, info
+    return lu_, piv
+
+
+@op("lu_unpack")
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    piv = lu_pivots.astype(jnp.int32) - 1
+    perm = jnp.arange(m, dtype=jnp.int32)
+
+    def body(i, p):
+        a, b = p[i], p[piv[i]]
+        return p.at[i].set(b).at[piv[i]].set(a)
+
+    perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+    P = jnp.eye(m, dtype=lu_data.dtype)[perm].T
+    return P, L, U
+
+
+def eig(x, name=None):
+    # general eig is CPU-only in XLA; host round-trip like reference's LAPACK call
+    import numpy as np
+
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    w, vec = np.linalg.eig(v)
+    return Tensor(w), Tensor(vec)
+
+
+@op("eigh")
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(x, symmetrize_input=True)
+    return w, v
+
+
+def eigvals(x, name=None):
+    import numpy as np
+
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return Tensor(np.linalg.eigvals(v))
+
+
+@op("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x)
+
+
+@op("slogdet")
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@op("det")
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@op("matrix_power")
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@op("multi_dot")
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(list(x))
+
+
+@op("histogram")
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=(lo, hi),
+                            weights=weight, density=density)
+    return hist if (density or weight is not None) else hist.astype(_I64)
+
+
+@op("histogramdd")
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+@op("bincount")
+def bincount(x, weights=None, minlength=0, name=None):
+    length = max(minlength, 1)
+    out = jnp.bincount(x.reshape(-1), weights=weights,
+                       minlength=minlength,
+                       length=None)
+    return out
+
+
+@op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@op("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    d = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d), axis=-1)
+    return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+
+@op("householder_product")
+def householder_product(x, tau, name=None):
+    m, n = x.shape[-2], x.shape[-1]
+
+    def single(a, t):
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[:, i]))
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            q = q @ h
+        return q
+
+    if x.ndim == 2:
+        return single(x, tau)
+    flat_x = x.reshape((-1,) + x.shape[-2:])
+    flat_t = tau.reshape((-1,) + tau.shape[-1:])
+    out = jax.vmap(single)(flat_x, flat_t)
+    return out.reshape(x.shape[:-2] + (m, m))
+
+
+@op("matrix_exp")
+def matrix_exp(x, name=None):
+    return jax.scipy.linalg.expm(x)
+
+
+@op("vander")
+def vander(x, n=None, increasing=False, name=None):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@op("vecdot")
+def vecdot(x, y, axis=-1, name=None):
+    return jnp.sum(x * y, axis=axis)

@@ -1,0 +1,79 @@
+"""Comparison / logical / bitwise ops (paddle.tensor.logic parity)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "isin",
+]
+
+
+def _bin(name, f):
+    @op(name)
+    def g(x, y, name=None):
+        return f(x, y)
+
+    g.__name__ = name
+    return g
+
+
+equal = _bin("equal", jnp.equal)
+not_equal = _bin("not_equal", jnp.not_equal)
+greater_than = _bin("greater_than", jnp.greater)
+greater_equal = _bin("greater_equal", jnp.greater_equal)
+less_than = _bin("less_than", jnp.less)
+less_equal = _bin("less_equal", jnp.less_equal)
+logical_and = _bin("logical_and", jnp.logical_and)
+logical_or = _bin("logical_or", jnp.logical_or)
+logical_xor = _bin("logical_xor", jnp.logical_xor)
+bitwise_and = _bin("bitwise_and", jnp.bitwise_and)
+bitwise_or = _bin("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _bin("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _bin("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _bin("bitwise_right_shift", jnp.right_shift)
+
+
+@op("logical_not")
+def logical_not(x, name=None):
+    return jnp.logical_not(x)
+
+
+@op("bitwise_not")
+def bitwise_not(x, name=None):
+    return jnp.bitwise_not(x)
+
+
+@op("equal_all")
+def equal_all(x, y, name=None):
+    return jnp.array_equal(x, y)
+
+
+@op("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@op("is_empty")
+def is_empty(x, name=None):
+    return jnp.asarray(x.size == 0)
+
+
+@op("isin")
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, invert=invert)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

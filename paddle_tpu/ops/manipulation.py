@@ -1,0 +1,657 @@
+"""Shape/layout/indexing ops (paddle.tensor.manipulation parity:
+`python/paddle/tensor/manipulation.py`)."""
+from __future__ import annotations
+
+_pyslice = slice  # the op below shadows the builtin
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dtypes
+
+_I64 = _dtypes.convert_dtype("int64")  # int32 when x64 is off (TPU default)
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "concat",
+    "stack", "vstack", "hstack", "dstack", "split", "tensor_split", "chunk",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "unflatten",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "tile",
+    "flip", "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "masked_select", "masked_fill", "masked_scatter",
+    "where", "nonzero", "take", "take_along_axis", "put_along_axis",
+    "one_hot", "topk", "sort", "argsort", "searchsorted", "bucketize",
+    "unique", "unique_consecutive", "unbind", "cast", "getitem", "slice",
+    "strided_slice", "crop", "pad", "repeat_interleave", "shard_index",
+    "flatten_", "as_complex", "as_real", "view", "view_as", "atleast_1d",
+    "atleast_2d", "atleast_3d", "tensordot", "numel", "rank", "shape_op",
+    "tolist", "diagonal", "kron", "renorm", "trace",
+]
+
+
+@op("cast")
+def cast(x, dtype):
+    return x.astype(_dtypes.convert_dtype(dtype))
+
+
+@op("reshape")
+def reshape(x, shape, name=None):
+    shape = [int(s) if not hasattr(s, "item") else int(s.item()) for s in shape] \
+        if isinstance(shape, (list, tuple)) else shape
+    return jnp.reshape(x, shape)
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@op("transpose")
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, perm)
+
+
+@op("moveaxis")
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op("swapaxes")
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@op("concat")
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+@op("stack")
+def stack(x, axis=0, name=None):
+    return jnp.stack(list(x), axis=axis)
+
+
+@op("vstack")
+def vstack(x, name=None):
+    return jnp.vstack(list(x))
+
+
+@op("hstack")
+def hstack(x, name=None):
+    return jnp.hstack(list(x))
+
+
+@op("dstack")
+def dstack(x, name=None):
+    return jnp.dstack(list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {axis} length {dim} is not divisible by "
+                f"{num_or_sections}; pass explicit section sizes or use "
+                f"tensor_split for uneven splits")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_neg = sum(1 for s in sizes if s < 0)
+        if n_neg:
+            rest = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rest if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)
+
+    def f(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]), axis=axis)
+            for i in range(len(sizes))
+        )
+
+    return list(apply("split", f, x))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    dim = x.shape[int(axis)]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+    else:
+        idxs = [0] + [int(i) for i in num_or_indices] + [dim]
+        sizes = [idxs[i + 1] - idxs[i] for i in range(len(idxs) - 1)]
+    return split(x, sizes, axis)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@op("squeeze")
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        if not axis:
+            return x + 0 if jnp.issubdtype(x.dtype, jnp.inexact) else x
+        return jnp.squeeze(x, axis=axis)
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+@op("unsqueeze")
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist() if axis.ndim else int(axis.item())
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in axis:
+            out = jnp.expand_dims(out, int(a))
+        return out
+    return jnp.expand_dims(x, int(axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+@op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    s, e = start_axis % nd, stop_axis % nd
+    new_shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
+@op("unflatten")
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    return jnp.reshape(x, x.shape[:axis] + tuple(shape) + x.shape[axis + 1:])
+
+
+@op("expand")
+def expand(x, shape, name=None):
+    shape = tuple(int(s) for s in shape)
+    cur = (1,) * (len(shape) - x.ndim) + tuple(x.shape)
+    tgt = tuple(c if s == -1 else s for s, c in zip(shape, cur))
+    return jnp.broadcast_to(jnp.reshape(x, cur), tgt)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+@op("broadcast_to")
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+@op("tile")
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@op("flip")
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@op("rot90")
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@op("roll")
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@op("gather")
+def gather(x, index, axis=0, name=None):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis))
+
+
+@op("gather_nd")
+def gather_nd(x, index, name=None):
+    idx_last = index.shape[-1]
+    flat_idx = index.reshape(-1, idx_last)
+    out = x[tuple(flat_idx[:, i] for i in range(idx_last))]
+    return out.reshape(index.shape[:-1] + x.shape[idx_last:])
+
+
+@op("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates, mode="drop")
+    return x.at[index].add(updates, mode="drop")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+@op("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    idx_last = index.shape[-1]
+    flat_idx = index.reshape(-1, idx_last)
+    flat_upd = updates.reshape((-1,) + x.shape[idx_last:])
+    return x.at[tuple(flat_idx[:, i] for i in range(idx_last))].add(flat_upd)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from . import creation
+
+    zeros = creation.zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+@op("index_select")
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index, axis=int(axis))
+
+
+@op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+@op("index_add")
+def index_add(x, index, axis, value, name=None):
+    axis = int(axis) % x.ndim
+    moved = jnp.moveaxis(x, axis, 0)
+    mv = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(mv)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@op("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    if accumulate:
+        return x.at[indices].add(value)
+    v = value
+    if hasattr(v, "dtype") and v.dtype != x.dtype:
+        v = v.astype(x.dtype)
+    return x.at[indices].set(v)
+
+
+@op("getitem")
+def getitem(x, index):
+    return x[index]
+
+
+@op("masked_select")
+def masked_select(x, mask, name=None):
+    xb, mb = jnp.broadcast_arrays(x, mask)
+    return xb[mb]
+
+
+@op("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    if hasattr(value, "dtype"):
+        value = value.astype(x.dtype)
+    return jnp.where(mask, value, x)
+
+
+@op("masked_scatter")
+def masked_scatter(x, mask, value, name=None):
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    flat_m = mask_b.reshape(-1)
+    flat_x = x.reshape(-1)
+    flat_v = value.reshape(-1)
+    pos = jnp.cumsum(flat_m) - 1
+    src = flat_v[jnp.clip(pos, 0, flat_v.shape[0] - 1)]
+    return jnp.where(flat_m, src, flat_x).reshape(x.shape)
+
+
+@op("where")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return tuple(o.astype(_I64) for o in jnp.nonzero(condition))
+    return jnp.where(condition, x, y)
+
+
+@op("nonzero")
+def nonzero(x, as_tuple=False):
+    outs = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(o.astype(_I64)[:, None] for o in outs)
+    return jnp.stack(outs, axis=1).astype(_I64)
+
+
+@op("take")
+def take(x, index, mode="raise", name=None):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(index, n)
+    elif mode == "clip":
+        idx = jnp.clip(index, 0, n - 1)
+    else:
+        idx = jnp.where(index < 0, index + n, index)
+    return flat[idx.reshape(-1)].reshape(index.shape)
+
+
+@op("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@op("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    if not hasattr(values, "shape") or jnp.ndim(values) == 0:
+        values = jnp.full(indices.shape, values, x.dtype)
+    values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    if reduce in ("add", "sum"):
+        return _scatter_along_axis(x, indices, values, axis, "add")
+    if reduce in ("mul", "multiply"):
+        return _scatter_along_axis(x, indices, values, axis, "mul")
+    return _scatter_along_axis(x, indices, values, axis, "set")
+
+
+def _scatter_along_axis(x, indices, values, axis, mode):
+    axis = axis % x.ndim
+    idx_grids = jnp.meshgrid(
+        *[jnp.arange(s) for s in indices.shape], indexing="ij")
+    idx = list(idx_grids)
+    idx[axis] = indices
+    idx = tuple(idx)
+    if mode == "add":
+        return x.at[idx].add(values)
+    if mode == "mul":
+        return x.at[idx].multiply(values)
+    return x.at[idx].set(values)
+
+
+@op("one_hot")
+def one_hot(x, num_classes, name=None):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@op("topk")
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, jax.Array):
+        k = int(k)
+    ax = -1 if axis is None else axis % x.ndim
+    moved = jnp.moveaxis(x, ax, -1)
+    if largest:
+        vals, idxs = jax.lax.top_k(moved, k)
+    else:
+        vals, idxs = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(idxs, -1, ax).astype(_I64))
+
+
+@op("sort")
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(x, axis=axis, stable=stable or descending)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@op("argsort")
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.argsort(x, axis=axis, stable=True)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(_I64)
+
+
+@op("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = values.reshape(-1, values.shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            flat_seq, flat_val).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else _I64)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Host round-trip: output size is data-dependent (not jit-safe); the
+    # reference's unique kernel is likewise dynamic-shape.
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = [Tensor(r.astype(np.int64) if i > 0 else r)
+            for i, r in enumerate(res)]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    changed = np.ones(arr.shape[axis], dtype=bool)
+    if arr.shape[axis] > 1:
+        sl = [slice(None)] * arr.ndim
+        sl_prev = list(sl)
+        sl[axis] = slice(1, None)
+        sl_prev[axis] = slice(None, -1)
+        diffs = arr[tuple(sl)] != arr[tuple(sl_prev)]
+        other_axes = tuple(i for i in range(arr.ndim) if i != axis)
+        changed[1:] = diffs.any(axis=other_axes) if other_axes else diffs
+    idx = np.nonzero(changed)[0]
+    out = np.take(arr, idx, axis=axis)
+    results = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(changed) - 1
+        results.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        counts = np.diff(np.append(idx, arr.shape[axis]))
+        results.append(Tensor(counts.astype(np.int64)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[int(axis)]
+
+    def f(v):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(v, i, i + 1, axis=axis),
+                                 axis=axis) for i in range(n))
+
+    return list(apply("unbind", f, x))
+
+
+@op("slice")
+def slice(x, axes, starts, ends):
+    sl = [_pyslice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        sl[ax] = _pyslice(int(s), int(e))
+    return x[tuple(sl)]
+
+
+@op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sl = [_pyslice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = _pyslice(int(s), int(e), int(st))
+    return x[tuple(sl)]
+
+
+@op("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or x.shape
+    sl = tuple(
+        _pyslice(int(o), int(o) + (x.shape[i] - int(o) if int(s) == -1 else int(s)))
+        for i, (o, s) in enumerate(zip(offsets, shape)))
+    return x[sl]
+
+
+@op("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    elif len(pad) == 4 and nd == 4:
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (pad[2], pad[3]), (pad[0], pad[1])]
+        else:
+            cfg = [(0, 0), (pad[2], pad[3]), (pad[0], pad[1]), (0, 0)]
+    elif len(pad) == 6 and nd == 5:
+        if data_format == "NCDHW":
+            cfg = [(0, 0), (0, 0), (pad[4], pad[5]), (pad[2], pad[3]),
+                   (pad[0], pad[1])]
+        else:
+            cfg = [(0, 0), (pad[4], pad[5]), (pad[2], pad[3]), (pad[0], pad[1]),
+                   (0, 0)]
+    elif len(pad) == 2 and nd == 3:
+        if data_format == "NCL":
+            cfg = [(0, 0), (0, 0), (pad[0], pad[1])]
+        else:
+            cfg = [(0, 0), (pad[0], pad[1]), (0, 0)]
+    else:
+        cfg = [(0, 0)] * (nd - len(pad) // 2) + \
+              [(pad[2 * i], pad[2 * i + 1]) for i in range(len(pad) // 2)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode=jmode, constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@op("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if hasattr(repeats, "shape") and jnp.ndim(repeats) > 0:
+        total = int(jnp.sum(repeats))
+        return jnp.repeat(x, repeats, axis=axis, total_repeat_length=total)
+    return jnp.repeat(x, int(repeats), axis=axis)
+
+
+@op("shard_index")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)
+
+
+@op("as_complex")
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@op("as_real")
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@op("atleast_1d")
+def atleast_1d(x, name=None):
+    return jnp.atleast_1d(x)
+
+
+@op("atleast_2d")
+def atleast_2d(x, name=None):
+    return jnp.atleast_2d(x)
+
+
+@op("atleast_3d")
+def atleast_3d(x, name=None):
+    return jnp.atleast_3d(x)
+
+
+@op("tensordot")
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op("trace")
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op("kron")
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@op("renorm")
+def renorm(x, p, axis, max_norm, name=None):
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def numel(x, name=None):
+    return Tensor(np.int64(x.size))
+
+
+def rank(x):
+    return Tensor(np.int64(x.ndim))
+
+
+def shape_op(x):
+    return Tensor(np.asarray(x.shape, np.int64))
+
+
+def tolist(x):
+    return x.tolist()

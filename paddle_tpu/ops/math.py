@@ -1,0 +1,280 @@
+"""Elementwise + scalar math ops (paddle.tensor.math parity:
+`python/paddle/tensor/math.py`, `ops.yaml` elementwise families)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core.tensor import Tensor
+from ..core import dtypes as _dtypes
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "abs", "sign", "floor", "ceil", "round", "trunc", "frac",
+    "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "erf", "erfinv", "sigmoid", "logit", "square", "reciprocal",
+    "clip", "neg", "lerp", "angle", "conj", "real", "imag",
+    "scale", "stanh", "softplus_op", "rad2deg", "deg2rad",
+    "isnan", "isinf", "isfinite", "nan_to_num", "heaviside",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    "logaddexp", "logsumexp", "diff", "gcd", "lcm", "hypot", "copysign",
+    "multiply_", "add_", "subtract_", "scale_", "clip_", "ldexp",
+    "inner", "outer", "trapezoid", "increment", "nextafter",
+    "digamma", "lgamma", "polygamma", "i0", "sgn",
+]
+
+
+def _bin(name, f):
+    @op(name)
+    def g(x, y, name=None):
+        return f(x, y)
+
+    g.__name__ = name
+    return g
+
+
+def _un(name, f):
+    @op(name)
+    def g(x, name=None):
+        return f(x)
+
+    g.__name__ = name
+    return g
+
+
+add = _bin("add", jnp.add)
+subtract = _bin("subtract", jnp.subtract)
+multiply = _bin("multiply", jnp.multiply)
+divide = _bin("divide", jnp.true_divide)
+floor_divide = _bin("floor_divide", jnp.floor_divide)
+mod = _bin("mod", jnp.mod)
+remainder = mod
+maximum = _bin("maximum", jnp.maximum)
+minimum = _bin("minimum", jnp.minimum)
+fmax = _bin("fmax", jnp.fmax)
+fmin = _bin("fmin", jnp.fmin)
+atan2 = _bin("atan2", jnp.arctan2)
+logaddexp = _bin("logaddexp", jnp.logaddexp)
+hypot = _bin("hypot", jnp.hypot)
+copysign = _bin("copysign", jnp.copysign)
+nextafter = _bin("nextafter", jnp.nextafter)
+heaviside = _bin("heaviside", jnp.heaviside)
+gcd = _bin("gcd", jnp.gcd)
+lcm = _bin("lcm", jnp.lcm)
+ldexp = _bin("ldexp", jnp.ldexp)
+
+exp = _un("exp", jnp.exp)
+expm1 = _un("expm1", jnp.expm1)
+log = _un("log", jnp.log)
+log2 = _un("log2", jnp.log2)
+log10 = _un("log10", jnp.log10)
+log1p = _un("log1p", jnp.log1p)
+sqrt = _un("sqrt", jnp.sqrt)
+rsqrt = _un("rsqrt", jax.lax.rsqrt)
+abs = _un("abs", jnp.abs)
+sign = _un("sign", jnp.sign)
+sgn = sign
+floor = _un("floor", jnp.floor)
+ceil = _un("ceil", jnp.ceil)
+round = _un("round", jnp.round)
+trunc = _un("trunc", jnp.trunc)
+frac = _un("frac", lambda v: v - jnp.trunc(v))
+sin = _un("sin", jnp.sin)
+cos = _un("cos", jnp.cos)
+tan = _un("tan", jnp.tan)
+asin = _un("asin", jnp.arcsin)
+acos = _un("acos", jnp.arccos)
+atan = _un("atan", jnp.arctan)
+sinh = _un("sinh", jnp.sinh)
+cosh = _un("cosh", jnp.cosh)
+tanh = _un("tanh", jnp.tanh)
+asinh = _un("asinh", jnp.arcsinh)
+acosh = _un("acosh", jnp.arccosh)
+atanh = _un("atanh", jnp.arctanh)
+erf = _un("erf", jax.scipy.special.erf)
+erfinv = _un("erfinv", jax.scipy.special.erfinv)
+sigmoid = _un("sigmoid", jax.nn.sigmoid)
+square = _un("square", jnp.square)
+reciprocal = _un("reciprocal", jnp.reciprocal)
+neg = _un("neg", jnp.negative)
+angle = _un("angle", jnp.angle)
+conj = _un("conj", jnp.conj)
+real = _un("real", jnp.real)
+imag = _un("imag", jnp.imag)
+rad2deg = _un("rad2deg", jnp.rad2deg)
+deg2rad = _un("deg2rad", jnp.deg2rad)
+isnan = _un("isnan", jnp.isnan)
+isinf = _un("isinf", jnp.isinf)
+isfinite = _un("isfinite", jnp.isfinite)
+digamma = _un("digamma", jax.scipy.special.digamma)
+lgamma = _un("lgamma", jax.scipy.special.gammaln)
+i0 = _un("i0", jnp.i0)
+
+
+@op("pow")
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+float_power = _bin("float_power", jnp.float_power)
+
+
+@op("logit")
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@op("clip")
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+@op("lerp")
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+@op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if bias_after_scale:
+        out = x * scale + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * scale
+    return out
+
+
+@op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@op("softplus")
+def softplus_op(x, beta=1, threshold=20, name=None):
+    # double-where keeps the untaken exp branch finite so its vjp can't
+    # poison the gradient with inf*0=NaN (classic XLA where-grad trap)
+    big = x * beta > threshold
+    safe = jnp.where(big, jnp.zeros((), x.dtype), x)
+    return jnp.where(big, x, jnp.log1p(jnp.exp(beta * safe)) / beta)
+
+
+@op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op("cumsum")
+def cumsum(x, axis=None, dtype=None, name=None):
+    dtype = _dtypes.convert_dtype(dtype)
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1), dtype=dtype)
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@op("cumprod")
+def cumprod(x, dim=None, dtype=None, name=None):
+    dtype = _dtypes.convert_dtype(dtype)
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+@op("cummax")
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    eq = x == vals
+    ind = jax.lax.cummax(jnp.where(eq, idx, -1), axis=axis)
+    return vals, ind.astype(_dtypes.convert_dtype(dtype))
+
+
+@op("cummin")
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    eq = x == vals
+    ind = jax.lax.cummax(jnp.where(eq, idx, -1), axis=axis)
+    return vals, ind.astype(_dtypes.convert_dtype(dtype))
+
+
+@op("logcumsumexp")
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+@op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@op("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@op("inner")
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@op("outer")
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@op("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0, axis=axis)
+
+
+@op("polygamma")
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def increment(x, value=1.0, name=None):
+    return x._rebind(add(x, value))
+
+
+# --- in-place variants (functional rebind) -----------------------------------
+
+def add_(x, y, name=None):
+    return x._rebind(add(x, y))
+
+
+def subtract_(x, y, name=None):
+    return x._rebind(subtract(x, y))
+
+
+def multiply_(x, y, name=None):
+    return x._rebind(multiply(x, y))
+
+
+def scale_(x, scale_v=1.0, bias=0.0, bias_after_scale=True, name=None):
+    return x._rebind(scale(x, scale_v, bias, bias_after_scale))
+
+
+def clip_(x, min=None, max=None, name=None):
+    return x._rebind(clip(x, min, max))

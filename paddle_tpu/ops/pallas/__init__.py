@@ -1,0 +1,13 @@
+"""Pallas fused-kernel tier (role parity: `paddle/phi/kernels/fusion/gpu/`).
+
+Kernels register here with jnp fallbacks so the same API works on CPU tests
+and TPU. Heavy kernels live in sibling modules (flash_attention.py, ...).
+"""
+from __future__ import annotations
+
+import jax
+
+from .flash_attention import (  # noqa: F401
+    flash_attention_available,
+    flash_attention_fwd,
+)

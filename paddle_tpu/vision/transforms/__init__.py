@@ -1,0 +1,193 @@
+"""Vision transforms (parity subset of `python/paddle/vision/transforms/`),
+numpy-based (HWC uint8/float inputs)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "to_tensor", "normalize", "resize", "hflip", "vflip",
+]
+
+
+def _np_img(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    return np.asarray(img)
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _np_img(img)
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr.astype(np.float32))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _np_img(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _np_img(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    import jax
+    import jax.numpy as jnp
+
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic"}.get(interpolation, "linear")
+    tgt = (size[0], size[1]) + arr.shape[2:]
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32), tgt, method=method)
+    return np.asarray(out).astype(arr.dtype)
+
+
+def hflip(img):
+    return _np_img(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np_img(img)[::-1].copy()
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, img):
+        arr = _np_img(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _np_img(img)
+        if self.padding:
+            p = self.padding
+            arr = np.pad(arr, ((p, p), (p, p)) + ((0, 0),) * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, max(1, h - th + 1))
+        j = np.random.randint(0, max(1, w - tw + 1))
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.random() < self.prob:
+            return hflip(img)
+        return _np_img(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.random() < self.prob:
+            return vflip(img)
+        return _np_img(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _np_img(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = _np_img(img)
+        p = self.padding
+        if isinstance(p, int):
+            cfg = ((p, p), (p, p))
+        elif len(p) == 2:
+            cfg = ((p[1], p[1]), (p[0], p[0]))
+        else:
+            cfg = ((p[1], p[3]), (p[0], p[2]))
+        cfg = cfg + ((0, 0),) * (arr.ndim - 2)
+        if self.mode == "constant":
+            return np.pad(arr, cfg, constant_values=self.fill)
+        return np.pad(arr, cfg, mode=self.mode)

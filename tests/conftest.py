@@ -1,0 +1,43 @@
+"""Test env: force a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding tests run on
+`--xla_force_host_platform_device_count=8` CPU devices (the same trick the
+reference uses for mesh emulation, cf. SURVEY.md §4 note). The axon TPU
+plugin (registered by sitecustomize at interpreter start) is unregistered
+here so tests never block on the TPU tunnel.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+
+try:  # drop the axon PJRT backend factory before jax initializes backends
+    from jax._src import xla_bridge as _xb
+
+    for reg in ("_backend_factories",):
+        d = getattr(_xb, reg, None)
+        if isinstance(d, dict):
+            d.pop("axon", None)
+except Exception:
+    pass
+
+# sitecustomize imported jax before this conftest ran, so the config already
+# captured JAX_PLATFORMS=axon — override it at the config level too.
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

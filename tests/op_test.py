@@ -1,0 +1,139 @@
+"""OpTest harness: golden-value + numeric-grad checking.
+
+Role parity: `test/legacy_test/op_test.py:420` — subclass declares the op,
+inputs, and a NumPy reference; `check_output` compares eager results,
+`check_grad` compares tape-autograd grads against central finite differences
+(`get_numeric_gradient` role, op_test.py:150). A third mode runs the op under
+`jax.jit` tracing to assert eager/compiled parity (the dygraph-vs-static leg
+of the reference harness).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as P
+
+
+def numeric_grad(fn, inputs, wrt_idx, out_reduce=None, delta=1e-3):
+    """Central finite differences of sum(fn(*inputs)) w.r.t inputs[wrt_idx]."""
+    inputs = [np.asarray(x, np.float64) for x in inputs]
+
+    def scalar(*xs):
+        out = fn(*[x.astype(np.float32) for x in xs])
+        arr = out.numpy() if hasattr(out, "numpy") else np.asarray(out)
+        if out_reduce is not None:
+            return float(out_reduce(arr))
+        return float(np.sum(arr.astype(np.float64)))
+
+    x = inputs[wrt_idx]
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + delta
+        hi = scalar(*inputs)
+        flat[i] = old - delta
+        lo = scalar(*inputs)
+        flat[i] = old
+        gflat[i] = (hi - lo) / (2 * delta)
+    return g
+
+
+class OpTest:
+    """Subclass sets:
+      op          — callable taking Tensors
+      ref         — numpy reference callable
+      inputs      — dict name -> np.ndarray (float inputs get grad-checked)
+      attrs       — extra kwargs
+      atol / rtol — tolerances
+    """
+
+    op = None
+    ref = None
+    inputs = {}
+    attrs = {}
+    atol = 1e-5
+    rtol = 1e-5
+    grad_atol = 1e-2
+    grad_rtol = 1e-2
+
+    def _tensors(self, stop_gradient=True):
+        return {k: P.to_tensor(v, stop_gradient=stop_gradient)
+                for k, v in self.inputs.items()}
+
+    def test_output(self):
+        ts = self._tensors()
+        out = type(self).op(*ts.values(), **self.attrs)
+        expected = type(self).ref(*self.inputs.values(), **self.attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        exps = expected if isinstance(expected, (list, tuple)) else [expected]
+        for o, e in zip(outs, exps):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64),
+                np.asarray(e, np.float64), atol=self.atol, rtol=self.rtol)
+
+    def test_jit_parity(self):
+        """Eager vs traced-under-jax.jit results must agree."""
+        import jax
+
+        ts = self._tensors()
+        eager = type(self).op(*ts.values(), **self.attrs)
+
+        from paddle_tpu.core import flags
+
+        def pure(*vals):
+            with flags.trace_guard():
+                wrapped = [P.Tensor(v) for v in vals]
+                out = type(self).op(*wrapped, **self.attrs)
+            if isinstance(out, (list, tuple)):
+                return [o._value for o in out]
+            return out._value
+
+        vals = [t._value for t in ts.values()]
+        jitted = jax.jit(pure)(*vals)
+        eag = eager if isinstance(eager, (list, tuple)) else [eager]
+        jit_ = jitted if isinstance(jitted, (list, tuple)) else [jitted]
+        for o, e in zip(eag, jit_):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64), np.asarray(e, np.float64),
+                atol=self.atol, rtol=self.rtol)
+
+    def test_grad(self):
+        float_keys = [k for k, v in self.inputs.items()
+                      if np.issubdtype(np.asarray(v).dtype, np.floating)]
+        if not float_keys:
+            return
+        ts = {k: P.to_tensor(v, stop_gradient=k not in float_keys)
+              for k, v in self.inputs.items()}
+        out = type(self).op(*ts.values(), **self.attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = None
+        for o in outs:
+            if not o.stop_gradient:
+                term = P.sum(o)
+                loss = term if loss is None else loss + term
+        assert loss is not None, "no differentiable output"
+        loss.backward()
+
+        def fn(*vals):
+            tensors = [P.to_tensor(v) for v in vals]
+            o = type(self).op(*tensors, **self.attrs)
+            os_ = o if isinstance(o, (list, tuple)) else [o]
+            diff = [x for x, ox in zip(os_, outs) if not ox.stop_gradient]
+            acc = None
+            for d in diff:
+                s = P.sum(d)
+                acc = s if acc is None else acc + s
+            return acc
+
+        for i, k in enumerate(self.inputs):
+            if k not in float_keys:
+                continue
+            analytic = ts[k].grad
+            assert analytic is not None, f"no grad for input {k}"
+            numeric = numeric_grad(fn, list(self.inputs.values()), i)
+            np.testing.assert_allclose(
+                np.asarray(analytic.numpy(), np.float64), numeric,
+                atol=self.grad_atol, rtol=self.grad_rtol,
+                err_msg=f"grad mismatch for {k}")

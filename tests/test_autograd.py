@@ -1,0 +1,189 @@
+"""Eager autograd engine tests (backward walk, hooks, partial grad,
+retain_graph, higher-order, PyLayer — reference capability checklist from
+SURVEY.md §2.3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.autograd import PyLayer
+
+
+def test_backward_simple():
+    x = P.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy())
+
+
+def test_grad_accumulation():
+    x = P.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_subexpression():
+    x = P.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    y = a * a  # d/dx = 2*9*x = 18x = 36
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+
+def test_retain_graph():
+    x = P.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_no_grad():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    with P.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_partial_grad():
+    x = P.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = P.to_tensor([3.0, 4.0], stop_gradient=False)
+    z = (x * y).sum()
+    gx, = P.grad(z, x)
+    np.testing.assert_allclose(gx.numpy(), y.numpy())
+    assert x.grad is None  # paddle.grad does not touch .grad
+
+
+def test_grad_intermediate():
+    x = P.to_tensor([2.0], stop_gradient=False)
+    mid = x * 3
+    out = mid * mid
+    gmid, = P.grad(out, mid)
+    np.testing.assert_allclose(gmid.numpy(), [12.0])
+
+
+def test_allow_unused():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = P.to_tensor([1.0], stop_gradient=False)
+    z = (x * 2).sum()
+    with pytest.raises(RuntimeError):
+        P.grad(z, [y])
+    z = (x * 2).sum()  # graph was consumed by the failed call
+    gx, gy = P.grad(z, [x, y], allow_unused=True)
+    assert gy is None
+
+
+def test_leaf_hook_and_remove():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 10)
+    (x * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+    h.remove()
+    x.clear_grad()
+    (x * 2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_intermediate_hook():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    mid = x * 2
+    mid.register_hook(lambda g: g * 5)
+    (mid * 3).backward()
+    # dL/dmid = 3 -> hook -> 15 -> dL/dx = 30
+    np.testing.assert_allclose(x.grad.numpy(), [30.0])
+
+
+def test_higher_order():
+    x = P.to_tensor([2.0], stop_gradient=False)
+    y = x ** 4
+    g1, = P.grad(y, x, create_graph=True)
+    g2, = P.grad(g1, x, create_graph=True)
+    g3, = P.grad(g2, x)
+    np.testing.assert_allclose(g1.numpy(), [32.0])
+    np.testing.assert_allclose(g2.numpy(), [48.0])
+    np.testing.assert_allclose(g3.numpy(), [48.0])
+
+
+def test_backward_nonscalar_with_grad_tensor():
+    x = P.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(P.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_detach():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_stop_gradient_island():
+    x = P.to_tensor([1.0], stop_gradient=False)
+    y = P.to_tensor([2.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_pylayer():
+    class TimesK(PyLayer):
+        @staticmethod
+        def forward(ctx, x, k):
+            ctx.k = k
+            ctx.save_for_backward(x)
+            return x * k
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * ctx.k
+
+    x = P.to_tensor([3.0], stop_gradient=False)
+    out = TimesK.apply(x, 5.0)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_pylayer_multi_output():
+    class SplitMul(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2, x * 3
+
+        @staticmethod
+        def backward(ctx, g1, g2):
+            return g1 * 2 + g2 * 3
+
+    x = P.to_tensor([1.0], stop_gradient=False)
+    a, b = SplitMul.apply(x)
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_jacobian_hessian():
+    from paddle_tpu.autograd import hessian, jacobian
+
+    x = P.to_tensor([1.0, 2.0], stop_gradient=False)
+    jac = jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))
+    h = hessian(lambda t: (t * t * t).sum(), x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]))
+
+
+def test_autocast_bf16():
+    import paddle_tpu.amp as amp
+
+    x = P.randn([4, 4])
+    y = P.randn([4, 4])
+    with amp.auto_cast():
+        z = P.matmul(x, y)
+    assert str(z.dtype) == "bfloat16"
+    z2 = P.matmul(x, y)
+    assert str(z2.dtype) == "float32"

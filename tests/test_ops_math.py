@@ -1,0 +1,221 @@
+"""Op kernel tests via the OpTest harness (math/reduction/linalg slice)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from op_test import OpTest
+
+rs = np.random.RandomState(7)
+
+
+class TestAdd(OpTest):
+    op = staticmethod(P.add)
+    ref = staticmethod(np.add)
+    inputs = {"x": rs.rand(3, 4).astype(np.float32),
+              "y": rs.rand(3, 4).astype(np.float32)}
+
+
+class TestAddBroadcast(OpTest):
+    op = staticmethod(P.add)
+    ref = staticmethod(np.add)
+    inputs = {"x": rs.rand(3, 4).astype(np.float32),
+              "y": rs.rand(4).astype(np.float32)}
+
+
+class TestMultiply(OpTest):
+    op = staticmethod(P.multiply)
+    ref = staticmethod(np.multiply)
+    inputs = {"x": rs.rand(5).astype(np.float32),
+              "y": rs.rand(5).astype(np.float32)}
+
+
+class TestDivide(OpTest):
+    op = staticmethod(P.divide)
+    ref = staticmethod(np.true_divide)
+    inputs = {"x": rs.rand(4, 4).astype(np.float32),
+              "y": (rs.rand(4, 4) + 0.5).astype(np.float32)}
+
+
+class TestExp(OpTest):
+    op = staticmethod(P.exp)
+    ref = staticmethod(np.exp)
+    inputs = {"x": rs.randn(3, 3).astype(np.float32)}
+
+
+class TestLog(OpTest):
+    op = staticmethod(P.log)
+    ref = staticmethod(np.log)
+    inputs = {"x": (rs.rand(3, 3) + 0.5).astype(np.float32)}
+
+
+class TestSqrt(OpTest):
+    op = staticmethod(P.sqrt)
+    ref = staticmethod(np.sqrt)
+    inputs = {"x": (rs.rand(3, 3) + 0.1).astype(np.float32)}
+
+
+class TestTanh(OpTest):
+    op = staticmethod(P.tanh)
+    ref = staticmethod(np.tanh)
+    inputs = {"x": rs.randn(3, 3).astype(np.float32)}
+
+
+class TestSigmoid(OpTest):
+    op = staticmethod(P.sigmoid)
+    ref = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+    inputs = {"x": rs.randn(3, 3).astype(np.float32)}
+
+
+class TestPow(OpTest):
+    op = staticmethod(lambda x: P.pow(x, 3.0))
+    ref = staticmethod(lambda x: np.power(x, 3.0))
+    inputs = {"x": (rs.rand(3, 3) + 0.5).astype(np.float32)}
+
+
+class TestClip(OpTest):
+    op = staticmethod(lambda x: P.clip(x, 0.2, 0.8))
+    ref = staticmethod(lambda x: np.clip(x, 0.2, 0.8))
+    inputs = {"x": rs.rand(4, 4).astype(np.float32)}
+    grad_atol = 5e-2  # kink points
+
+
+class TestMaximum(OpTest):
+    op = staticmethod(P.maximum)
+    ref = staticmethod(np.maximum)
+    inputs = {"x": rs.randn(3, 4).astype(np.float32),
+              "y": rs.randn(3, 4).astype(np.float32)}
+
+
+class TestSum(OpTest):
+    op = staticmethod(lambda x: P.sum(x, axis=1))
+    ref = staticmethod(lambda x: np.sum(x, axis=1))
+    inputs = {"x": rs.rand(3, 5).astype(np.float32)}
+
+
+class TestMean(OpTest):
+    op = staticmethod(lambda x: P.mean(x, axis=0, keepdim=True))
+    ref = staticmethod(lambda x: np.mean(x, axis=0, keepdims=True))
+    inputs = {"x": rs.rand(3, 5).astype(np.float32)}
+
+
+class TestMax(OpTest):
+    op = staticmethod(lambda x: P.max(x, axis=1))
+    ref = staticmethod(lambda x: np.max(x, axis=1))
+    inputs = {"x": rs.rand(4, 6).astype(np.float32)}
+
+
+class TestProd(OpTest):
+    op = staticmethod(lambda x: P.prod(x, axis=1))
+    ref = staticmethod(lambda x: np.prod(x, axis=1))
+    inputs = {"x": (rs.rand(3, 4) + 0.5).astype(np.float32)}
+
+
+class TestStd(OpTest):
+    op = staticmethod(lambda x: P.std(x))
+    ref = staticmethod(lambda x: np.std(x, ddof=1))
+    inputs = {"x": rs.rand(10).astype(np.float32)}
+
+
+class TestLogsumexp(OpTest):
+    op = staticmethod(lambda x: P.logsumexp(x, axis=1))
+    ref = staticmethod(
+        lambda x: np.log(np.sum(np.exp(x), axis=1)))
+    inputs = {"x": rs.randn(3, 5).astype(np.float32)}
+
+
+class TestCumsum(OpTest):
+    op = staticmethod(lambda x: P.cumsum(x, axis=1))
+    ref = staticmethod(lambda x: np.cumsum(x, axis=1))
+    inputs = {"x": rs.rand(3, 4).astype(np.float32)}
+
+
+class TestMatmul(OpTest):
+    op = staticmethod(P.matmul)
+    ref = staticmethod(np.matmul)
+    inputs = {"x": rs.rand(4, 5).astype(np.float32),
+              "y": rs.rand(5, 3).astype(np.float32)}
+
+
+class TestMatmulTranspose(OpTest):
+    op = staticmethod(lambda x, y: P.matmul(x, y, transpose_y=True))
+    ref = staticmethod(lambda x, y: x @ y.T)
+    inputs = {"x": rs.rand(4, 5).astype(np.float32),
+              "y": rs.rand(3, 5).astype(np.float32)}
+
+
+class TestBmm(OpTest):
+    op = staticmethod(P.bmm)
+    ref = staticmethod(np.matmul)
+    inputs = {"x": rs.rand(2, 3, 4).astype(np.float32),
+              "y": rs.rand(2, 4, 5).astype(np.float32)}
+
+
+class TestEinsum(OpTest):
+    op = staticmethod(lambda x, y: P.einsum("ij,jk->ik", x, y))
+    ref = staticmethod(lambda x, y: np.einsum("ij,jk->ik", x, y))
+    inputs = {"x": rs.rand(3, 4).astype(np.float32),
+              "y": rs.rand(4, 2).astype(np.float32)}
+
+
+class TestNorm(OpTest):
+    op = staticmethod(lambda x: P.norm(x, p=2, axis=1))
+    ref = staticmethod(lambda x: np.linalg.norm(x, axis=1))
+    inputs = {"x": (rs.rand(3, 4) + 0.1).astype(np.float32)}
+
+
+def test_argmax_argmin():
+    x = P.to_tensor(rs.randn(4, 6).astype(np.float32))
+    np.testing.assert_array_equal(P.argmax(x, axis=1).numpy(),
+                                  np.argmax(x.numpy(), axis=1))
+    np.testing.assert_array_equal(P.argmin(x, axis=0).numpy(),
+                                  np.argmin(x.numpy(), axis=0))
+
+
+def test_topk_sort():
+    x = P.to_tensor(rs.randn(3, 8).astype(np.float32))
+    vals, idxs = P.topk(x, 3, axis=1)
+    ref_idx = np.argsort(-x.numpy(), axis=1)[:, :3]
+    np.testing.assert_allclose(
+        vals.numpy(), np.take_along_axis(x.numpy(), ref_idx, 1), rtol=1e-6)
+    s = P.sort(x, axis=1, descending=True)
+    np.testing.assert_allclose(s.numpy(), -np.sort(-x.numpy(), axis=1),
+                               rtol=1e-6)
+
+
+def test_comparison_and_logical():
+    a = P.to_tensor([1.0, 2.0, 3.0])
+    b = P.to_tensor([3.0, 2.0, 1.0])
+    assert (a == b).numpy().tolist() == [False, True, False]
+    assert (a < b).numpy().tolist() == [True, False, False]
+    assert P.logical_and(a > 1, b > 1).numpy().tolist() == [False, True, False]
+    assert bool(P.allclose(a, a))
+
+
+def test_where_nonzero():
+    x = P.to_tensor([[0.0, 1.0], [2.0, 0.0]])
+    idx = P.nonzero(x)
+    np.testing.assert_array_equal(idx.numpy(), [[0, 1], [1, 0]])
+    w = P.where(x > 0, x, P.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [[0, 1], [2, 0]])
+
+
+def test_inplace_ops():
+    x = P.to_tensor([1.0, 2.0])
+    x += P.to_tensor([1.0, 1.0])
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    x.add_(P.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [3.0, 4.0])
+
+
+def test_setitem_getitem():
+    x = P.zeros([3, 3])
+    x[0, 0] = 5.0
+    x[1] = P.ones([3])
+    assert float(x[0, 0]) == 5.0
+    np.testing.assert_allclose(x[1].numpy(), [1, 1, 1])
+    # grad flows through setitem (rebind semantics)
+    y = P.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    z = y * 2
+    z[0] = 10.0
+    z.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [0.0, 2.0, 2.0])
