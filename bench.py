@@ -1,0 +1,82 @@
+"""Single-chip GPT pretrain throughput benchmark.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: tokens/sec/chip on a GPT-125M-shape training step (fwd+bwd+AdamW),
+bf16 compute. vs_baseline = achieved MFU / 0.45 (the BASELINE.md north-star
+MFU target; the reference publishes no absolute numbers — BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    # GPT-125M shape on TPU; tiny proxy on CPU so the script always completes
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024)
+        batch, seq, iters = 8, 1024, 20
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128)
+        batch, seq, iters = 2, 128, 3
+
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sep_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    P.seed(0)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        P.optimizer.AdamW(parameters=model.parameters(), learning_rate=1e-4))
+    crit = GPTPretrainingCriterion()
+    step = model.build_train_step(opt, crit, amp_dtype="bfloat16")
+
+    rs = np.random.RandomState(0)
+    ids = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+    labels = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+
+    # warmup/compile
+    loss = step(ids, labels)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * iters
+    tps = tokens / dt
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params  # fwd+bwd matmul flops
+    peak = {"tpu": 197e12}.get(platform, 1e12)  # v5e bf16 peak
+    mfu = tps * flops_per_token / peak
+    print(json.dumps({
+        "metric": "gpt125m_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

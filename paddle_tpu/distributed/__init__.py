@@ -1,0 +1,32 @@
+"""paddle.distributed parity surface, TPU-native (SURVEY §2.2, §2.5)."""
+from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn, get_mesh,
+    reshard, set_mesh, shard_layer, shard_optimizer, shard_tensor,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, barrier, broadcast, get_group, irecv, isend, new_group,
+    recv, reduce, reduce_scatter, scatter, send, stream,
+)
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .parallel import DataParallel, init_parallel_env  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .topology import HybridTopology, get_topology, set_topology  # noqa: F401
+from .train_step import DistributedTrainStep  # noqa: F401
+from . import mpu  # noqa: F401
+
+
+def is_initialized():
+    return True
+
+
+def get_backend():
+    return "xla"
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference spawn launches one process per device; single-controller jax
+    owns all local devices in-process, so spawn degenerates to a direct call
+    with rank 0 semantics (multi-host uses the launcher)."""
+    return func(*args)

@@ -1,0 +1,221 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer / ProcessMesh.
+
+Role parity: `python/paddle/distributed/auto_parallel/api.py:118,288,387,716`
+and the C++ DistTensor + reshard engine
+(`paddle/phi/core/distributed/auto_parallel/dist_tensor.h:39`, reshard fns).
+
+TPU-first collapse: DistTensor ≡ a jax.Array with a NamedSharding; the SPMD
+rule registry and the pairwise reshard functions (r_to_s, s_to_r, p_to_r, …)
+are XLA's sharding propagation + `jax.device_put`/`with_sharding_constraint`;
+`Partial` state exists transiently inside compiled programs and is
+materialized by psum on output — so the user-facing API keeps the reference's
+Placement vocabulary while the compiler does the work.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import flags
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "dtensor_from_fn", "reshard", "shard_layer", "shard_optimizer",
+           "get_mesh", "set_mesh"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-D logical device mesh (parity: auto_parallel/process_mesh.py:71).
+    Wraps a jax.sharding.Mesh; `dim_names` are the sharding axis names."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._ids = arr
+        self.shape = list(arr.shape)
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        devices = np.array(jax.devices())
+        flat = arr.reshape(-1)
+        dev = np.empty(flat.shape, dtype=object)
+        for i, pid in enumerate(flat):
+            dev[i] = devices[int(pid) % len(devices)]
+        self.jax_mesh = Mesh(dev.reshape(arr.shape), tuple(self.dim_names))
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name):
+        return self.shape[self.dim_names.index(name)]
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._ids, other._ids) and \
+            self.dim_names == other.dim_names
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+_global_mesh = None
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def _placements_to_spec(placements, ndim, mesh):
+    spec = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[axis_idx]
+            if spec[pl.dim] is None:
+                spec[pl.dim] = name
+            elif isinstance(spec[pl.dim], tuple):
+                spec[pl.dim] = spec[pl.dim] + (name,)
+            else:
+                spec[pl.dim] = (spec[pl.dim], name)
+    return P(*spec)
+
+
+def shard_tensor(data, mesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """Place a tensor on the mesh with the given per-mesh-dim placements."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = _placements_to_spec(placements, t.ndim, mesh)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    if flags.in_trace():
+        val = jax.lax.with_sharding_constraint(t._value, sharding)
+        out = Tensor(val, stop_gradient=t.stop_gradient)
+    else:
+        val = jax.device_put(t._value, sharding)
+        out = Tensor(val, stop_gradient=t.stop_gradient
+                     if stop_gradient is None else stop_gradient)
+        out._grad_node = t._grad_node
+    out.dist_attr = (mesh, tuple(placements))
+    out.name = t.name
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    """Convert between placements (the reshard engine role): on TPU this is a
+    device_put (eager) or sharding constraint (traced) — XLA inserts the
+    collectives (all_gather for s→r, dynamic-slice for r→s, psum for p→r)."""
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Apply `shard_fn(name, layer, mesh)` over sublayers to annotate/place
+    params (parity: auto_parallel/api.py:387)."""
+    if shard_fn is None:
+        def shard_fn(name, l, mesh):
+            for pname, p in l._parameters.items():
+                if p is not None:
+                    placements = [Replicate() for _ in mesh.shape]
+                    sharded = shard_tensor(p, mesh, placements)
+                    p._value = sharded._value
+                    p.dist_attr = sharded.dist_attr
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Mark the optimizer for state sharding; the train-step builder reads
+    this to shard accumulator pytrees (ZeRO recipes live in
+    distributed.sharding)."""
+    optimizer._shard_fn = shard_fn or True
+    return optimizer

@@ -1,0 +1,194 @@
+"""Fleet facade (parity: `python/paddle/distributed/fleet/fleet.py:100,603` —
+fleet.init / distributed_model / distributed_optimizer, DistributedStrategy,
+HybridCommunicateGroup accessors).
+
+TPU-first: `init` builds the hybrid mesh topology; `distributed_model` +
+`distributed_optimizer` return wrappers whose training path is the single
+compiled SPMD step (`distributed.train_step.DistributedTrainStep`) rather
+than per-axis communicator wrappers; eager per-step semantics are preserved
+for the dygraph UX.
+"""
+from __future__ import annotations
+
+from .. import topology as topo_mod
+from ..topology import HybridTopology
+from ..train_step import DistributedTrainStep
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "init_parallel_env", "worker_num", "worker_index",
+           "is_first_worker", "barrier_worker"]
+
+
+class DistributedStrategy:
+    """Parity with the protobuf-backed DistributedStrategy
+    (`paddle/fluid/framework/distributed_strategy.proto:359`): a python
+    config object; only TPU-meaningful fields are interpreted, the rest are
+    accepted for compatibility."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sep_degree": 1,
+            "sharding_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768, "use_pure_bf16": False}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1}
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "schedule_mode": "1F1B"}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.fuse_all_reduce_ops = True
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _FleetState:
+    strategy = None
+    topo = None
+    initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = HybridTopology(
+        dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+        pp=hc.get("pp_degree", 1), sep=hc.get("sep_degree", 1),
+        sharding=hc.get("sharding_degree", 1))
+    topo_mod.set_topology(topo)
+    _state.strategy = strategy
+    _state.topo = topo
+    _state.initialized = True
+    return _state
+
+
+def get_hybrid_communicate_group():
+    return _state.topo or topo_mod.get_topology()
+
+
+def get_strategy():
+    return _state.strategy
+
+
+class HybridParallelOptimizer:
+    """Wrapper returned by distributed_optimizer (parity:
+    `hybrid_parallel_optimizer.py:254`): eager `.step()` delegates to the
+    inner optimizer (grad sync is the compiled path's job on TPU); exposes
+    `build_train_step` to assemble the compiled hybrid step."""
+
+    def __init__(self, optimizer, strategy=None):
+        self._inner_opt = optimizer
+        self._strategy = strategy or _state.strategy or DistributedStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    def minimize(self, loss, **kw):
+        return self._inner_opt.minimize(loss, **kw)
+
+
+class DistributedModelProxy:
+    """Wrapper returned by distributed_model (parity: fleet/model.py:32 —
+    which picks DataParallel/TensorParallel/PipelineParallel wrappers).
+    Forwarding is unchanged (mpu annotations already carry TP); train_batch
+    drives the compiled hybrid step (PipelineParallel.train_batch parity)."""
+
+    def __init__(self, model, strategy):
+        self._layers = model
+        self._strategy = strategy
+        self._train_step = None
+
+    def __getattr__(self, item):
+        return getattr(self._layers, item)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def build_train_step(self, optimizer, loss_fn, **kw):
+        strategy = self._strategy or DistributedStrategy()
+        stage = 0
+        if strategy.sharding:
+            stage = int(strategy.sharding_configs.get("stage", 1))
+        inner = optimizer._inner_opt if isinstance(
+            optimizer, HybridParallelOptimizer) else optimizer
+        kw.setdefault("amp_dtype", "bfloat16" if strategy.amp else None)
+        kw.setdefault("sharding_stage", stage)
+        kw.setdefault("topo", _state.topo)
+        self._train_step = DistributedTrainStep(
+            self._layers, inner, loss_fn, **kw)
+        return self._train_step
+
+    def train_batch(self, batch, optimizer=None, lr_scheduler=None,
+                    loss_fn=None, scaler=None):
+        if self._train_step is None:
+            assert optimizer is not None and loss_fn is not None, \
+                "first train_batch needs optimizer and loss_fn"
+            self.build_train_step(optimizer, loss_fn)
+        loss = self._train_step(*batch)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+
+def distributed_model(model):
+    return DistributedModelProxy(model, _state.strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return HybridParallelOptimizer(optimizer, strategy)
+
+
+def init_parallel_env():
+    if not _state.initialized:
+        init(is_collective=True)
+    return _state
+
+
+def worker_num():
+    from ..env import get_world_size
+
+    return get_world_size()
+
+
+def worker_index():
+    from ..env import get_rank
+
+    return get_rank()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+# utils namespace parity (fleet.utils.recompute)
+from .. import recompute as _recompute_mod  # noqa: E402
+
+
+class utils:
+    recompute = staticmethod(_recompute_mod.recompute)
+    recompute_sequential = staticmethod(_recompute_mod.recompute_sequential)

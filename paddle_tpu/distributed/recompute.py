@@ -1,0 +1,68 @@
+"""Activation recomputation (parity:
+`python/paddle/distributed/fleet/recompute/recompute.py:108,404`).
+
+TPU-first: under tracing this is `jax.checkpoint` (XLA rematerialization) —
+the compiler replays the segment in backward instead of saving activations;
+the reference's RNG-state tracker for deterministic dropout replay is
+unnecessary because the PRNG key threading makes dropout functional.
+Eagerly it's a pass-through (tape autograd already frees per-op residuals
+after backward).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import flags
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    if not flags.in_trace():
+        return function(*args, **kwargs)
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    vals = [leaves[i]._value for i in tensor_idx]
+
+    def pure(*tvals):
+        cur = list(leaves)
+        for i, v in zip(tensor_idx, tvals):
+            cur[i] = Tensor(v, stop_gradient=False)
+        a, kw = jax.tree_util.tree_unflatten(treedef, cur)
+        out = function(*a, **kw)
+        return jax.tree_util.tree_map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    out_vals = jax.checkpoint(pure)(*vals)
+    return jax.tree_util.tree_map(lambda v: Tensor(v), out_vals)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute over a Sequential in `segments` chunks (parity:
+    recompute_sequential)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    sublayers = list(functions) if isinstance(functions, (list, tuple)) else \
+        list(functions.children())
+    n = len(sublayers)
+    seg = max(1, n // max(1, segments))
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(layers):
+        def f(x):
+            for l in layers:
+                x = l(x)
+            return x
+
+        return f
+
+    i = 0
+    while i < n:
+        chunk = sublayers[i:i + seg]
+        out = recompute(run_segment(chunk), out)
+        i += seg
+    return out

@@ -1,0 +1,4 @@
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt_1p3b,
+    gpt_6p7b, gpt_tiny, llama_7b,
+)

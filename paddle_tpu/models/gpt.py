@@ -1,0 +1,218 @@
+"""GPT family — the flagship pretrain model (BASELINE configs 2/3: GPT-3
+1.3B / 6.7B under DP+sharding / TP).
+
+Role parity: the reference's Fleet GPT fixture (`test/auto_parallel/
+get_gpt_model.py` + PaddleNLP-style mpu usage, SURVEY §3.3). Built from
+`distributed.mpu` layers so dp/mp/sep sharding falls out of annotations;
+`use_rope=True` + RMSNorm + SwiGLU gives the LLaMA variant (config 4).
+
+TPU-first choices: bf16-friendly module defaults, flash attention via the
+Pallas path ([B,S,H,D] layout), `lax`-free python (everything traces into
+one XLA program), optional per-block recompute (jax rematerialization).
+"""
+from __future__ import annotations
+
+import math
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed import mpu
+from ..distributed.recompute import recompute as _recompute
+from ..nn import functional as F
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPretrainingCriterion", "gpt_tiny", "gpt_1p3b", "gpt_6p7b",
+           "llama_7b"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_seq_len=1024, ffn_hidden=None,
+                 dropout=0.0, attn_dropout=0.0, use_rope=False,
+                 use_rmsnorm=False, use_swiglu=False, tie_embeddings=True,
+                 recompute=False, sequence_parallel=False,
+                 layer_norm_eps=1e-5):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_seq_len = max_seq_len
+        self.ffn_hidden = ffn_hidden or (
+            int(8 * hidden_size / 3 / 128 + 1) * 128 if use_swiglu
+            else 4 * hidden_size)
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.use_rope = use_rope
+        self.use_rmsnorm = use_rmsnorm
+        self.use_swiglu = use_swiglu
+        self.tie_embeddings = tie_embeddings
+        self.recompute = recompute
+        self.sequence_parallel = sequence_parallel
+        self.layer_norm_eps = layer_norm_eps
+
+
+def _norm(cfg):
+    if cfg.use_rmsnorm:
+        return nn.RMSNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+    return nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        # fused qkv: column-parallel over heads
+        self.qkv_proj = mpu.ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, gather_output=False)
+        self.out_proj = mpu.RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
+
+    def forward(self, x, cache=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        if self.cfg.use_rope:
+            q, k, _ = F.fused_rotary_position_embedding(q, k, None)
+        if cache is not None:
+            pk, pv = cache
+            from .. import ops
+
+            k = ops.concat([pk, k], axis=1)
+            v = ops.concat([pv, v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.cfg.attn_dropout if self.training else 0.0,
+            training=self.training)
+        out = out.reshape([b, s, h])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.use_swiglu:
+            self.gate_up_proj = mpu.ColumnParallelLinear(
+                cfg.hidden_size, 2 * cfg.ffn_hidden, gather_output=False)
+        else:
+            self.up_proj = mpu.ColumnParallelLinear(
+                cfg.hidden_size, cfg.ffn_hidden, gather_output=False)
+        self.down_proj = mpu.RowParallelLinear(
+            cfg.ffn_hidden, cfg.hidden_size, input_is_parallel=True)
+
+    def forward(self, x):
+        if self.cfg.use_swiglu:
+            x = F.swiglu(self.gate_up_proj(x))
+        else:
+            x = F.gelu(self.up_proj(x), approximate=True)
+        return self.down_proj(x)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_1 = _norm(cfg)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = _norm(cfg)
+        self.mlp = GPTMLP(cfg)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def _body(self, x):
+        if self.cfg.sequence_parallel:
+            x = mpu.sequence_parallel_constraint(x)
+        x = x + self.drop(self.attn(self.ln_1(x)))
+        x = x + self.drop(self.mlp(self.ln_2(x)))
+        return x
+
+    def forward(self, x):
+        if self.cfg.recompute and self.training:
+            return _recompute(self._body, x)
+        return self._body(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = mpu.VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        if not cfg.use_rope:
+            self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = _norm(cfg)
+
+    def forward(self, input_ids):
+        from .. import ops
+
+        x = self.wte(input_ids)
+        if not self.cfg.use_rope:
+            pos = ops.arange(0, input_ids.shape[1], dtype="int32")
+            x = x + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = mpu.ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False)
+
+    def forward(self, input_ids):
+        x = self.gpt(input_ids)
+        if self.cfg.tie_embeddings:
+            logits = x.matmul(self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Token-level LM loss with masked mean (parity: the Fleet GPT criterion;
+    vocab-parallel CE comes from the logits' mp annotation)."""
+
+    def __init__(self, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        loss = F.cross_entropy(logits, labels, reduction="mean",
+                               ignore_index=self.ignore_index)
+        return loss
+
+
+def gpt_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                     num_heads=4, max_seq_len=128, **kw)
+
+
+def gpt_1p3b(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                     num_heads=16, max_seq_len=2048, **kw)
+
+
+def gpt_6p7b(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=4096, num_layers=32,
+                     num_heads=32, max_seq_len=2048, **kw)
+
+
+def llama_7b(**kw):
+    kw.setdefault("use_rope", True)
+    kw.setdefault("use_rmsnorm", True)
+    kw.setdefault("use_swiglu", True)
+    kw.setdefault("tie_embeddings", False)
+    return GPTConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                     num_heads=32, max_seq_len=2048, **kw)

@@ -1,0 +1,182 @@
+"""Distributed stack tests on the virtual 8-device CPU mesh (SURVEY §4 note:
+mesh emulation via xla_force_host_platform_device_count)."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as P
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.distributed.auto_parallel import (
+    ProcessMesh, Replicate, Shard, reshard, shard_tensor,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_topology():
+    topology.reset_topology()
+    yield
+    topology.reset_topology()
+
+
+def _init(dp=2, mp=2, sep=1, sharding_stage=0):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": mp, "pp_degree": 1, "sep_degree": sep,
+        "sharding_degree": dp,
+    }
+    if sharding_stage:
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": sharding_stage}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def test_topology_axes():
+    _init(dp=2, mp=4)
+    topo = fleet.get_hybrid_communicate_group()
+    assert topo.spmd_mesh.shape["dp"] == 2
+    assert topo.spmd_mesh.shape["mp"] == 4
+
+
+def test_shard_tensor_and_reshard():
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    data = np.arange(64, dtype=np.float32).reshape(8, 8)
+    t = shard_tensor(data, mesh, [Shard(0), Shard(1)])
+    assert t.dist_attr is not None
+    np.testing.assert_allclose(t.numpy(), data)  # global view unchanged
+    r = reshard(t, mesh, [Replicate(), Replicate()])
+    np.testing.assert_allclose(r.numpy(), data)
+    # sharded layout actually covers distinct devices
+    assert len(t._value.sharding.device_set) == 8
+
+
+def test_collective_allreduce_eager():
+    _init(dp=8, mp=1)
+    from paddle_tpu.distributed import all_reduce
+
+    from jax.sharding import NamedSharding, PartitionSpec as Pt
+
+    topo = fleet.get_hybrid_communicate_group()
+    # a dp-sharded array: each of 8 shards holds one row
+    x = jax.device_put(
+        np.ones((8, 4), np.float32),
+        NamedSharding(topo.spmd_mesh, Pt("dp")))
+    t = P.Tensor(x)
+    all_reduce(t)
+    # psum over dp of per-shard rows: every row becomes sum of its own shard
+    # value across the axis => shape preserved, values * 1 (each shard had
+    # distinct rows) — verify shape/finite rather than exact semantics here
+    assert t.shape == [8, 4]
+    assert np.isfinite(t.numpy()).all()
+
+
+def test_dp_training_loss_decreases():
+    _init(dp=8, mp=1)
+    model = fleet.distributed_model(
+        __import__("paddle_tpu").nn.Linear(16, 4))
+    opt = fleet.distributed_optimizer(
+        P.optimizer.SGD(parameters=model.parameters(), learning_rate=0.5))
+
+    import paddle_tpu.nn as nn
+
+    loss_fn = nn.MSELoss()
+    x = P.randn([16, 16])
+    y = P.randn([16, 4])
+    losses = [float(model.train_batch((x, y), optimizer=opt,
+                                      loss_fn=loss_fn)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_tp_matches_single_device():
+    """TP-sharded GPT forward == replicated forward (numerical parity of the
+    sharding recipe — the core mpu contract)."""
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    P.seed(0)
+    cfg = gpt_tiny()
+    _init(dp=1, mp=4)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    ids = P.randint(0, cfg.vocab_size, [2, 16])
+    labels = P.randint(0, cfg.vocab_size, [2, 16])
+
+    model.eval()
+    eager_loss = float(crit(model(ids), labels))
+
+    dist_model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        P.optimizer.SGD(parameters=model.parameters(), learning_rate=0.0))
+    step = dist_model.build_train_step(opt, crit)
+    dist_loss = float(step(ids, labels))
+    np.testing.assert_allclose(dist_loss, eager_loss, rtol=2e-4)
+
+
+def test_zero_stages_shard_state():
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    P.seed(0)
+    cfg = gpt_tiny()
+    _init(dp=4, mp=2, sharding_stage=3)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        P.optimizer.AdamW(parameters=model.parameters(), learning_rate=1e-3))
+    crit = GPTPretrainingCriterion()
+    ids = P.randint(0, cfg.vocab_size, [4, 16])
+    labels = P.randint(0, cfg.vocab_size, [4, 16])
+    l0 = float(model.train_batch((ids, labels), optimizer=opt, loss_fn=crit))
+    l1 = float(model.train_batch((ids, labels)))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+    ts = model._train_step
+    p_specs = [str(v.sharding.spec) for v in ts._state["params"].values()]
+    assert any("dp" in s for s in p_specs), "stage3 must dp-shard params"
+    s_specs = [str(v.sharding.spec)
+               for sd in ts._state["opt"]["slots"].values()
+               for v in sd.values()]
+    assert any("dp" in s for s in s_specs), "opt slots must be dp-sharded"
+
+
+def test_recompute_matches():
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    _init(dp=2, mp=2)
+    crit = GPTPretrainingCriterion()
+    losses = {}
+    for rc in (False, True):
+        P.seed(0)
+        topology.reset_topology()
+        _init(dp=2, mp=2)
+        cfg = gpt_tiny(recompute=rc, dropout=0.0)
+        model = fleet.distributed_model(GPTForCausalLM(cfg))
+        opt = fleet.distributed_optimizer(
+            P.optimizer.SGD(parameters=model.parameters(), learning_rate=0.1))
+        ids = P.randint(0, cfg.vocab_size, [2, 16])
+        labels = P.randint(0, cfg.vocab_size, [2, 16])
+        P.seed(1)  # same data
+        ids = P.randint(0, cfg.vocab_size, [2, 16])
+        labels = P.randint(0, cfg.vocab_size, [2, 16])
+        l = [float(model.train_batch((ids, labels), optimizer=opt,
+                                     loss_fn=crit)) for _ in range(2)]
+        losses[rc] = l
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-4)
+
+
+def test_graft_entry():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", os.path.join(os.path.dirname(__file__), "..",
+                                        "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2
+    mod.dryrun_multichip(8)
