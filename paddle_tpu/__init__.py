@@ -37,6 +37,25 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import vision  # noqa: F401
 
+
+def __getattr__(name):
+    # heavier subsystems load lazily (they import jax mesh machinery)
+    import importlib
+
+    lazy = {"distributed", "hapi", "incubate", "models", "profiler",
+            "distribution", "sparse", "text", "audio", "quantization",
+            "geometric"}
+    if name in lazy:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi import Model
+
+        globals()["Model"] = Model
+        return Model
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
 from .framework.io_utils import load, save  # noqa: F401
 
 

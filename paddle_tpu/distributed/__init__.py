@@ -14,7 +14,9 @@ from .parallel import DataParallel, init_parallel_env  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .topology import HybridTopology, get_topology, set_topology  # noqa: F401
 from .train_step import DistributedTrainStep  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import mpu  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, PipelineParallel  # noqa: F401
 
 
 def is_initialized():

@@ -1,0 +1,26 @@
+"""Checkpoint metadata (parity: `python/paddle/distributed/checkpoint/
+metadata.py` — global shape/placement records enabling reshard-on-load)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTensorMetadata:
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTensorIndex:
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class Metadata:
+    state_dict_metadata: dict = dataclasses.field(default_factory=dict)
+    storage_metadata: dict = dataclasses.field(default_factory=dict)
+    flat_mapping: dict = dataclasses.field(default_factory=dict)

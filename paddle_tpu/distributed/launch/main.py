@@ -1,0 +1,99 @@
+"""Multi-host launcher: `python -m paddle_tpu.distributed.launch ... script.py`.
+
+Role parity: `python/paddle/distributed/launch/main.py:20` + the collective
+controller (`controllers/collective.py:37`) and HTTP master rendezvous
+(`controllers/master.py:73`).
+
+TPU-first: ONE process per host owns all local chips (single-controller
+jax), so `--devices` fan-out per chip is unnecessary on-host; the launcher's
+job is multi-host wiring: it sets the coordinator address (jax distributed
+coordination service = the TCPStore role), PADDLE_TRAINER_* env for scripts
+that read them, restarts failed children up to --max_restart (elastic role),
+and streams per-rank logs to --log_dir.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="coordinator ip:port (default: first node, :8476)")
+    p.add_argument("--nnodes", default="1",
+                   help="N or min:max node count (elastic range)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 = single-controller default)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--devices", default=None,
+                   help="accepted for compatibility; chips are owned by the "
+                        "single controller")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("script", nargs="?")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def build_env(args, local_rank=0):
+    env = dict(os.environ)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    world = nnodes * args.nproc_per_node
+    rank = args.rank * args.nproc_per_node + local_rank
+    master = args.master or "127.0.0.1:8476"
+    env.update({
+        "PADDLE_MASTER": master,
+        "COORDINATOR_ADDRESS": master,
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NODE_RANK": str(args.rank),
+        "PADDLE_JOB_ID": str(args.job_id),
+    })
+    return env
+
+
+def launch(args=None):
+    args = args or parse_args()
+    if args.script is None:
+        print("usage: python -m paddle_tpu.distributed.launch [opts] script.py",
+              file=sys.stderr)
+        return 1
+    os.makedirs(args.log_dir, exist_ok=True)
+    restarts = 0
+    while True:
+        procs = []
+        logs = []
+        for lr in range(args.nproc_per_node):
+            env = build_env(args, lr)
+            log_path = os.path.join(
+                args.log_dir, f"workerlog.{env['PADDLE_TRAINER_ID']}")
+            lf = open(log_path, "a")
+            logs.append(lf)
+            cmd = [sys.executable, args.script] + list(args.script_args)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=lf,
+                                          stderr=subprocess.STDOUT))
+        codes = [p.wait() for p in procs]
+        for lf in logs:
+            lf.close()
+        if all(c == 0 for c in codes):
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            print(f"giving up after {restarts - 1} restarts; exit codes "
+                  f"{codes}", file=sys.stderr)
+            return max(codes)
+        print(f"restarting pod (attempt {restarts}/{args.max_restart}); "
+              f"exit codes {codes}", file=sys.stderr)
+        time.sleep(3)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

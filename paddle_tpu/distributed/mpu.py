@@ -44,12 +44,15 @@ def mark_sharding(x, spec):
         return x
     from jax.sharding import PartitionSpec as P
 
-    topo = topo_mod.get_topology()
+    mesh = topo_mod.current_spmd_mesh()
+    # drop axes this mesh doesn't carry (e.g. a pipeline stage submesh)
+    spec = tuple(
+        s if (s is None or s in mesh.shape) else None for s in spec)
 
     def f(v):
         try:
             return jax.lax.with_sharding_constraint(
-                v, jax.sharding.NamedSharding(topo.spmd_mesh, P(*spec)))
+                v, jax.sharding.NamedSharding(mesh, P(*spec)))
         except Exception:
             return v
 

@@ -1,0 +1,341 @@
+"""Pipeline parallelism: 1F1B (and F-then-B) over per-stage compiled programs.
+
+Role parity: `PipelineLayer` partitioning (`fleet/meta_parallel/
+parallel_layers/pp_layers.py:237`), the 1F1B schedule
+(`pipeline_parallel.py:440` forward_backward_pipeline), and P2P
+(`pp_utils/p2p_communication.py`) — reimagined for the single-controller
+runtime:
+
+* Each stage is a `Sequential` slice compiled (jit) against its own submesh;
+  inner (dp, sep, mp) sharding still applies per stage.
+* P2P send/recv = `jax.device_put` of the boundary activation onto the next
+  stage's submesh — an async ICI transfer; no stream management (the
+  reference's SendRecvMeta/batch_isend_irecv machinery is unnecessary
+  because dispatch is async and ordered per device).
+* The schedule is an ENQUEUE ORDER: devices execute their queues in
+  dispatch order, so emitting ops in 1F1B order yields the 1F1B overlap
+  without any host-side blocking. Backward recomputes the stage forward
+  under `jax.vjp` (activation-checkpoint style), so no residual closures
+  cross jit boundaries.
+* Gradient accumulation across micro-batches happens on-device per stage;
+  the optimizer update runs per stage after the last cooldown backward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import flags
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers_common import Sequential
+from . import topology as topo_mod
+from .train_step import param_placements
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+           "segment_layers"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def segment_layers(layers, num_stages, method="uniform"):
+    """Partition a flat layer list into stages (seg-method parity:
+    uniform / layer / parameter counts, pp_layers.py seg_method)."""
+    n = len(layers)
+    if method == "parameter":
+        weights = [sum(int(np.prod(p.shape)) for p in l.parameters()) or 1
+                   for l in layers]
+    else:
+        weights = [1] * n
+    total = sum(weights)
+    target = total / num_stages
+    bounds = [0]
+    acc = 0
+    for i, w in enumerate(weights):
+        acc += w
+        if acc >= target * len(bounds) and len(bounds) < num_stages:
+            bounds.append(i + 1)
+    while len(bounds) < num_stages:
+        bounds.append(n)
+    bounds.append(n)
+    return [layers[bounds[i]:bounds[i + 1]] for i in range(num_stages)]
+
+
+class PipelineLayer(Layer):
+    """Holds the full LayerDesc list + stage partition (pp_layers parity)."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        topo = topology or topo_mod.get_topology()
+        self.num_stages = num_stages or topo.pp_degree
+        built = [d.build_layer() if isinstance(d, LayerDesc) else d
+                 for d in layers]
+        self._full_layers = built
+        self.loss_fn = loss_fn
+        stages = segment_layers(built, self.num_stages, seg_method)
+        self.stages = [Sequential(*s) for s in stages]
+        for i, s in enumerate(self.stages):
+            self.add_sublayer(f"stage_{i}", s)
+
+    def forward(self, x):
+        for s in self.stages:
+            x = s(x)
+        return x
+
+
+class _Stage:
+    """One pipeline stage: params on its submesh + compiled fwd / fwd-bwd."""
+
+    def __init__(self, module, mesh, is_last, loss_fn):
+        self.module = module
+        self.mesh = mesh
+        self.is_last = is_last
+        self.loss_fn = loss_fn
+        params, buffers = module.functional_state()
+        self.param_specs = {
+            n: P(*param_placements(p))
+            for n, p in module.named_parameters()}
+        self.params = {
+            n: jax.device_put(v, NamedSharding(mesh, self.param_specs[n]))
+            for n, v in params.items()}
+        self.buffers = {n: jax.device_put(v, NamedSharding(mesh, P()))
+                        for n, v in buffers.items()}
+        self.grads = None
+        self._fwd = jax.jit(self._fwd_fn)
+        self._fwdbwd = jax.jit(self._fwdbwd_fn)
+        self._accum = jax.jit(
+            lambda a, b: jax.tree_util.tree_map(jnp.add, a, b))
+
+    # pure stage apply
+    def _apply(self, params, x, labels=None):
+        with topo_mod.use_spmd_mesh(self.mesh):
+            with flags.trace_guard():
+                with self.module.bind_state(params, self.buffers):
+                    out = self.module(Tensor(x))
+                if self.is_last and self.loss_fn is not None:
+                    loss = self.loss_fn(out, Tensor(labels))
+                    lv = loss._value if isinstance(loss, Tensor) else loss
+                    return jnp.mean(lv.astype(jnp.float32))
+        return out._value
+
+    def _fwd_fn(self, params, x, labels=None):
+        return self._apply(params, x, labels)
+
+    def _fwdbwd_fn(self, params, x, gy, labels=None):
+        def f(p, xx):
+            return self._apply(p, xx, labels)
+
+        out, vjp = jax.vjp(f, params, x)
+        cot = gy if gy is not None else jnp.ones_like(out)
+        gparams, gx = vjp(cot)
+        return gx, gparams
+
+    def forward(self, x, labels=None):
+        return self._fwd(self.params, x, labels)
+
+    def backward(self, x, gy, labels=None):
+        gx, gparams = self._fwdbwd(self.params, x, gy, labels)
+        if self.grads is None:
+            self.grads = gparams
+        else:
+            self.grads = self._accum(self.grads, gparams)
+        return gx
+
+    def to_mesh(self, value):
+        """P2P receive: materialize a boundary tensor on this stage's mesh
+        (dp-sharded on dim 0 when divisible)."""
+        dp = self.mesh.shape.get("dp", 1)
+        spec = P("dp") if (np.ndim(value) >= 1 and dp > 1 and
+                           value.shape[0] % dp == 0) else P()
+        return jax.device_put(value, NamedSharding(self.mesh, spec))
+
+
+class PipelineParallel:
+    """1F1B runner (PipelineParallel.forward_backward_pipeline parity)."""
+
+    def __init__(self, pipeline_layer, optimizer, topo=None,
+                 num_micro_batches=None, schedule="1F1B"):
+        self.topo = topo or topo_mod.get_topology()
+        self.pp = self.topo.pp_degree
+        self.optimizer = optimizer
+        self.schedule = schedule
+        self.num_micro_batches = num_micro_batches or self.pp
+        assert isinstance(pipeline_layer, PipelineLayer)
+        self.pipe = pipeline_layer
+        self.loss_fn = pipeline_layer.loss_fn
+        self.stages = [
+            _Stage(pipeline_layer.stages[i], self.topo.stage_mesh(i),
+                   i == self.pp - 1, self.loss_fn)
+            for i in range(self.pp)
+        ]
+        self._opt_states = None
+        self._opt_update = None
+
+    # --- optimizer state per stage ------------------------------------------
+    def _ensure_opt(self):
+        if self._opt_states is None:
+            self._opt_states = [
+                self.optimizer.init_state(st.params) for st in self.stages]
+            self._opt_update = [
+                jax.jit(lambda p, g, s, lr, _o=self.optimizer:
+                        _o.apply_gradients(p, g, s, lr))
+                for _ in self.stages]
+
+    def _schedule_1f1b(self, m):
+        """Yield (stage, 'F'|'B', mb) in a dependency-valid 1F1B enqueue
+        order (pipeline_scheduler_pass 1F1B program order)."""
+        pp = self.pp
+        local = []
+        for i in range(pp):
+            warm = min(pp - 1 - i, m)
+            seq = ["F"] * warm
+            for _ in range(m - warm):
+                seq += ["F", "B"]
+            seq += ["B"] * warm
+            local.append(seq)
+        ptr = [0] * pp
+        fdone = [set() for _ in range(pp)]
+        bdone = [set() for _ in range(pp)]
+        fcount = [0] * pp
+        bcount = [0] * pp
+        done = 0
+        total = sum(len(s) for s in local)
+        order = []
+        while done < total:
+            progressed = False
+            for i in range(pp):
+                if ptr[i] >= len(local[i]):
+                    continue
+                op = local[i][ptr[i]]
+                if op == "F":
+                    mb = fcount[i]
+                    ready = (i == 0) or (mb in fdone[i - 1])
+                    if ready:
+                        order.append((i, "F", mb))
+                        fdone[i].add(mb)
+                        fcount[i] += 1
+                        ptr[i] += 1
+                        done += 1
+                        progressed = True
+                else:
+                    mb = bcount[i]
+                    ready = (mb in fdone[i]) and \
+                        (i == pp - 1 or mb in bdone[i + 1])
+                    if ready:
+                        order.append((i, "B", mb))
+                        bdone[i].add(mb)
+                        bcount[i] += 1
+                        ptr[i] += 1
+                        done += 1
+                        progressed = True
+            assert progressed, "pipeline schedule deadlock"
+        return order
+
+    def _schedule_fthenb(self, m):
+        order = [(i, "F", mb) for mb in range(m) for i in range(self.pp)]
+        order += [(i, "B", mb) for mb in range(m)
+                  for i in reversed(range(self.pp))]
+        return order
+
+    def train_batch(self, data, optimizer=None, lr_scheduler=None,
+                    scaler=None):
+        """data: (inputs, labels) full batch; split into micro-batches along
+        dim 0. Returns mean loss (train_batch parity)."""
+        self._ensure_opt()
+        inputs, labels = data
+        x = inputs._value if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        y = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        m = self.num_micro_batches
+        assert x.shape[0] % m == 0, (
+            f"batch {x.shape[0]} not divisible by {m} micro-batches")
+        mb_x = jnp.split(x, m, axis=0)
+        mb_y = jnp.split(y, m, axis=0)
+
+        acts = {}      # (stage, mb) -> input activation on stage mesh
+        outs = {}      # (stage, mb) -> output activation
+        gys = {}       # (stage, mb) -> upstream grad for stage output
+        losses = []
+        for st in self.stages:
+            st.grads = None
+
+        order = self._schedule_1f1b(m) if self.schedule == "1F1B" else \
+            self._schedule_fthenb(m)
+        for (i, op, mb) in order:
+            st = self.stages[i]
+            if op == "F":
+                if i == 0:
+                    xin = st.to_mesh(mb_x[mb])
+                else:
+                    xin = st.to_mesh(outs[(i - 1, mb)])
+                acts[(i, mb)] = xin
+                lab = st.to_mesh(mb_y[mb]) if st.is_last else None
+                out = st.forward(xin, lab)
+                outs[(i, mb)] = out
+                if st.is_last:
+                    losses.append(out)
+            else:
+                if st.is_last:
+                    gx = st.backward(acts[(i, mb)], None, st.to_mesh(mb_y[mb]))
+                else:
+                    gy = self.stages[i].to_mesh(gys[(i, mb)])
+                    gx = st.backward(acts[(i, mb)], gy)
+                if i > 0:
+                    gys[(i - 1, mb)] = gx
+                # free activations for this microbatch at this stage
+                acts.pop((i, mb), None)
+                outs.pop((i, mb), None)
+
+        # optimizer step per stage (grads averaged over micro-batches)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        inv_m = 1.0 / m
+        for i, st in enumerate(self.stages):
+            grads = jax.tree_util.tree_map(lambda g: g * inv_m, st.grads)
+            st.params, self._opt_states[i] = self._opt_update[i](
+                st.params, grads, self._opt_states[i], lr)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        total = sum(jax.device_get(l) for l in losses) / m
+        return Tensor(jnp.asarray(total, jnp.float32))
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        x = inputs._value if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        y = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        cur = x
+        for i, st in enumerate(self.stages):
+            lab = st.to_mesh(y) if st.is_last else None
+            cur = st.forward(st.to_mesh(cur), lab)
+        return Tensor(cur)
+
+    def sync_to_model(self):
+        for st in self.stages:
+            named = dict(st.module.named_parameters())
+            for n, v in st.params.items():
+                if n in named:
+                    named[n]._value = v
+
+    def state_dict(self):
+        self.sync_to_model()
+        return self.pipe.state_dict()

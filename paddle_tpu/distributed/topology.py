@@ -92,6 +92,7 @@ class HybridTopology:
 
 
 _topology = None
+_mesh_override = None  # pipeline stages trace against their submesh
 
 
 def set_topology(topo):
@@ -109,3 +110,23 @@ def get_topology():
 def reset_topology():
     global _topology
     _topology = None
+
+
+def current_spmd_mesh():
+    if _mesh_override is not None:
+        return _mesh_override
+    return get_topology().spmd_mesh
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def use_spmd_mesh(mesh):
+    global _mesh_override
+    old = _mesh_override
+    _mesh_override = mesh
+    try:
+        yield
+    finally:
+        _mesh_override = old

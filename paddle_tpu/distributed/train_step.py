@@ -118,7 +118,9 @@ class DistributedTrainStep:
             "params": params,
             "opt": {"slots": slots, "step": opt_state["step"]},
             "buffers": buffers,
-            "key": rng.default_generator.get_state(),
+            # fresh buffer: the step donates its state, so it must NOT alias
+            # the global generator's key array
+            "key": jax.random.fold_in(rng.default_generator.get_state(), 7),
         }
         return self._state
 

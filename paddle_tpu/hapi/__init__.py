@@ -1,0 +1,2 @@
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,216 @@
+"""High-level Model API (parity: `python/paddle/hapi/model.py:1054` —
+Model.prepare/fit/evaluate/predict/save/load with callbacks + metrics)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework.io_utils import load as fload, save as fsave
+from .callbacks import CallbackList, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # --- single steps --------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outs = self.network(*inputs)
+        losses = self._compute_loss(outs, labels)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return [float(l) for l in losses], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        import paddle_tpu as P
+
+        with P.no_grad():
+            outs = self.network(*_to_list(inputs))
+            losses = self._compute_loss(outs, _to_list(labels))
+        metrics = self._update_metrics(outs, _to_list(labels))
+        return [float(l) for l in losses], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        import paddle_tpu as P
+
+        with P.no_grad():
+            outs = self.network(*_to_list(inputs))
+        return [o.numpy() for o in _to_list(outs)]
+
+    def _compute_loss(self, outs, labels):
+        outs_l = _to_list(outs)
+        if self._loss is None:
+            return outs_l
+        return _to_list(self._loss(*(outs_l + labels)))
+
+    def _update_metrics(self, outs, labels):
+        res = {}
+        outs_l = _to_list(outs)
+        for m in self._metrics:
+            inp = m.compute(*(outs_l + labels))
+            r = m.update(inp) if not isinstance(inp, (list, tuple)) else \
+                m.update(*inp)
+            res[m.name()] = r
+        return res
+
+    # --- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList(_to_list(callbacks) or
+                            [ProgBarLogger(log_freq, verbose=verbose)])
+        cbks.set_model(self)
+        cbks.set_params({
+            "epochs": epochs, "steps": self._len_or_none(train_loader),
+            "verbose": verbose, "metrics": ["loss"] + [
+                m.name() for m in self._metrics],
+        })
+        cbks.on_begin("train")
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                losses, metrics = self.train_batch(inputs, labels)
+                logs = {"loss": losses, **metrics, "step": step}
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs if "logs" in dir() else {})
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, callbacks=callbacks,
+                              verbose=verbose)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_end("train")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        total_loss = 0.0
+        n = 0
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            losses, _ = self.eval_batch(inputs, labels)
+            total_loss += sum(losses)
+            n += 1
+        res = {"loss": total_loss / max(1, n)}
+        for m in self._metrics:
+            res[m.name()] = m.accumulate()
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # --- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *a, **kw):
+        return self.network.parameters(*a, **kw)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        trainable = sum(int(np.prod(p.shape))
+                        for p in self.network.parameters()
+                        if getattr(p, "trainable", True))
+        text = (f"Total params: {n_params:,}\n"
+                f"Trainable params: {trainable:,}\n"
+                f"Non-trainable params: {n_params - trainable:,}")
+        print(text)
+        return {"total_params": n_params, "trainable_params": trainable}
+
+    @staticmethod
+    def _len_or_none(loader):
+        try:
+            return len(loader)
+        except Exception:
+            return None
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 2:
+                return [batch[0]], [batch[1]]
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []

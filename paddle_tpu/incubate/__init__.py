@@ -1,0 +1,4 @@
+"""paddle.incubate parity surface."""
+from . import nn  # noqa: F401
+from .distributed.models import moe  # noqa: F401
+from .distributed.models.moe import MoELayer  # noqa: F401

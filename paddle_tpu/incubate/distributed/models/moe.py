@@ -1,0 +1,196 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Role parity: `MoELayer` (`python/paddle/incubate/distributed/models/moe/
+moe_layer.py:263`) with gshard/switch gates (`gate/`), and the
+global_scatter/global_gather alltoall dispatch ops
+(`python/paddle/distributed/utils/moe_utils.py:20,153`).
+
+TPU-first formulation: experts are ONE batched weight tensor
+[num_experts, ...] whose expert dim is annotated over the expert-parallel
+mesh axis; routing uses the GShard dense dispatch/combine einsum form
+(capacity-bucketed one-hots). Under jit, XLA lowers the dispatch einsum
+against ep-sharded experts to exactly the all_to_all the reference codes by
+hand — and fuses the surrounding math. Top-1 (Switch) and top-2 (GShard)
+gates with load-balancing aux loss.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply
+from ....core.tensor import Tensor
+from ....nn.initializer import XavierUniform
+from ....nn.layer_base import Layer
+
+__all__ = ["MoELayer", "GShardGate", "SwitchGate", "global_scatter",
+           "global_gather"]
+
+
+def _top2_gating(logits, capacity, key=None):
+    """GShard top-2 routing. logits: [T, E] f32.
+    Returns combine [T, E, C], dispatch(bool) [T, E, C], aux loss."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+    probs_wo1 = probs * (1 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+
+    # load-balance aux loss (gshard eq.)
+    density = jnp.mean(mask1, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+
+    # positions within each expert's capacity buffer
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    mask1 = mask1 * (pos1 < capacity)
+    pos1 = jnp.sum(pos1 * mask1, axis=-1)
+
+    used1 = jnp.sum(mask1, axis=0)
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1 + used1[None]) * mask2
+    mask2 = mask2 * (pos2 < capacity) * (mask2 > 0)
+    pos2 = jnp.sum(pos2 * mask2, axis=-1)
+
+    g1 = jnp.sum(probs * mask1, axis=-1)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    cap_oh1 = jax.nn.one_hot(pos1.astype(jnp.int32), capacity,
+                             dtype=probs.dtype)
+    cap_oh2 = jax.nn.one_hot(pos2.astype(jnp.int32), capacity,
+                             dtype=probs.dtype)
+    combine = (g1[:, None, None] * mask1[:, :, None] * cap_oh1[:, None, :] +
+               g2[:, None, None] * mask2[:, :, None] * cap_oh2[:, None, :])
+    dispatch = combine > 0
+    return combine, dispatch, aux
+
+
+def _top1_gating(logits, capacity):
+    """Switch routing (top-1)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+    density = jnp.mean(mask, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = jnp.sum(density * density_proxy) * E
+    pos = jnp.cumsum(mask, axis=0) * mask - mask
+    mask = mask * (pos < capacity)
+    pos = jnp.sum(pos * mask, axis=-1)
+    gate = jnp.sum(probs * mask, axis=-1)
+    cap_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=probs.dtype)
+    combine = gate[:, None, None] * mask[:, :, None] * cap_oh[:, None, :]
+    return combine, combine > 0, aux
+
+
+class _GateBase(Layer):
+    TOP_K = 2
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.5):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierUniform())
+
+    def capacity(self, num_tokens):
+        return max(4, int(self.capacity_factor * self.TOP_K * num_tokens /
+                          self.num_experts))
+
+
+class GShardGate(_GateBase):
+    TOP_K = 2
+
+    def route(self, xv, capacity):
+        logits = (xv @ self.weight._value).astype(jnp.float32)
+        return _top2_gating(logits, capacity)
+
+
+class SwitchGate(_GateBase):
+    TOP_K = 1
+
+    def route(self, xv, capacity):
+        logits = (xv @ self.weight._value).astype(jnp.float32)
+        return _top1_gating(logits, capacity)
+
+
+class MoELayer(Layer):
+    """d_model -> num_experts FFN experts -> d_model, top-k routed.
+
+    `ep_axis` names the mesh axis the expert dim is sharded over (defaults
+    to "mp" — the reference's distinct expert group maps to whichever axis
+    the deployment dedicates)."""
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 capacity_factor=1.5, ep_axis="mp", activation=None,
+                 recompute_interval=0, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        if isinstance(gate, str):
+            gate = {"gshard": GShardGate, "switch": SwitchGate}[gate](
+                d_model, num_experts, capacity_factor)
+        self.gate = gate
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=XavierUniform())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=XavierUniform())
+        self.b2 = self.create_parameter([num_experts, 1, d_model],
+                                        is_bias=True)
+        # expert dim over the ep axis: dispatch einsum becomes all_to_all
+        self.w1.dist_attr = (ep_axis, None, None)
+        self.b1.dist_attr = (ep_axis, None, None)
+        self.w2.dist_attr = (ep_axis, None, None)
+        self.b2.dist_attr = (ep_axis, None, None)
+        self.aux_loss = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        capacity = self.gate.capacity(int(np.prod(orig_shape[:-1])))
+
+        def f(xv, gw, w1, b1, w2, b2):
+            flat = xv.reshape(-1, xv.shape[-1])
+            logits = (flat @ gw).astype(jnp.float32)
+            if isinstance(self.gate, SwitchGate):
+                combine, dispatch, aux = _top1_gating(logits, capacity)
+            else:
+                combine, dispatch, aux = _top2_gating(logits, capacity)
+            combine = combine.astype(xv.dtype)
+            # dispatch: [T,E,C] x [T,M] -> [E,C,M]  (alltoall under ep)
+            buf = jnp.einsum("tec,tm->ecm", dispatch.astype(xv.dtype), flat)
+            h = jax.nn.gelu(jnp.einsum("ecm,emh->ech", buf, w1) + b1)
+            out_e = jnp.einsum("ech,ehm->ecm", h, w2) + b2
+            # combine back: [T,E,C] x [E,C,M] -> [T,M]
+            out = jnp.einsum("tec,ecm->tm", combine, out_e)
+            return out.reshape(xv.shape), aux.astype(jnp.float32)
+
+        out, aux = apply("moe_layer", f, x, self.gate.weight, self.w1,
+                         self.b1, self.w2, self.b2)
+        self.aux_loss = aux
+        return out
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """moe_utils.global_scatter parity: explicit token exchange. On TPU the
+    dense-dispatch path above subsumes this; kept for API compatibility via
+    alltoall over the group axis."""
+    from ....distributed.collective import alltoall_single
+
+    return alltoall_single(None, x, group=group)
+
+
+def global_gather(x, local_count, global_count, group=None):
+    from ....distributed.collective import alltoall_single
+
+    return alltoall_single(None, x, group=group)

@@ -1,0 +1,135 @@
+"""incubate.nn.functional parity — fused-op API surface
+(`python/paddle/incubate/nn/functional/`): on TPU these route to the Pallas
+tier or XLA-fused jnp bodies (same semantics, compiler does the fusing)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....nn.functional.attention import (  # noqa: F401
+    fused_rotary_position_embedding,
+)
+from ....nn.functional.norm import rms_norm as _rms_norm
+from ....nn.functional import layer_norm as _layer_norm
+from ....core.dispatch import apply, op
+from ....core.tensor import Tensor
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_bias_act", "fused_linear", "fused_linear_activation",
+    "swiglu", "fused_dropout_add", "masked_multihead_attention",
+    "variable_length_memory_efficient_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    """fused_rms_norm parity (residual-add + bias + rmsnorm in one op)."""
+    def f(xv, w, b, bias_v, res):
+        if bias_v is not None:
+            xv = xv + bias_v
+        if res is not None:
+            xv = xv + res
+        out = xv.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(out), axis=-1, keepdims=True)
+        out = (out * jax.lax.rsqrt(ms + epsilon)).astype(xv.dtype)
+        out = out * w
+        if b is not None:
+            out = out + b
+        if res is not None or bias_v is not None:
+            return out, xv
+        return out
+
+    return apply("fused_rms_norm", f, x, norm_weight, norm_bias, bias,
+                 residual)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    def f(xv, w, b, bias_v, res):
+        if bias_v is not None:
+            xv = xv + bias_v
+        if res is not None:
+            xv = xv + res
+        x32 = xv.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        out = ((x32 - mean) * jax.lax.rsqrt(var + epsilon)).astype(xv.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        if res is not None or bias_v is not None:
+            return out, xv
+        return out
+
+    return apply("fused_layer_norm", f, x, norm_weight, norm_bias, bias,
+                 residual)
+
+
+@op("fused_bias_act")
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    acts = {"gelu": lambda v: jax.nn.gelu(v),
+            "relu": lambda v: jnp.maximum(v, 0),
+            "silu": lambda v: v * jax.nn.sigmoid(v),
+            "swiglu": lambda v: _swiglu_val(v)}
+    return acts[act_method](x)
+
+
+def _swiglu_val(v):
+    a, b = jnp.split(v, 2, axis=-1)
+    return a * jax.nn.sigmoid(a) * b
+
+
+@op("swiglu")
+def swiglu(x, y=None, name=None):
+    if y is None:
+        return _swiglu_val(x)
+    return x * jax.nn.sigmoid(x) * y
+
+
+@op("fused_linear")
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        weight = weight.T
+    out = x @ weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    out = fused_linear(x, y, bias, trans_y)
+    from ....nn import functional as F
+
+    return {"gelu": F.gelu, "relu": F.relu}[activation](out)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn import functional as F
+
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
+    raise NotImplementedError(
+        "decode-time masked MHA lands with the serving/KV-cache milestone")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False):
+    from ....nn.functional.attention import scaled_dot_product_attention
+
+    # [B,H,S,D] reference layout -> [B,S,H,D]
+    q = query.transpose([0, 2, 1, 3])
+    k = key.transpose([0, 2, 1, 3])
+    v = value.transpose([0, 2, 1, 3])
+    out = scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                       is_causal=causal)
+    return out.transpose([0, 2, 1, 3])

@@ -31,7 +31,7 @@ class GPTConfig:
                  dropout=0.0, attn_dropout=0.0, use_rope=False,
                  use_rmsnorm=False, use_swiglu=False, tie_embeddings=True,
                  recompute=False, sequence_parallel=False,
-                 layer_norm_eps=1e-5):
+                 context_parallel=False, layer_norm_eps=1e-5):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -48,7 +48,14 @@ class GPTConfig:
         self.tie_embeddings = tie_embeddings
         self.recompute = recompute
         self.sequence_parallel = sequence_parallel
+        self.context_parallel = context_parallel
         self.layer_norm_eps = layer_norm_eps
+
+
+def _in_trace():
+    from ..core import flags
+
+    return flags.in_trace()
 
 
 def _norm(cfg):
@@ -83,10 +90,20 @@ class GPTAttention(nn.Layer):
             k = ops.concat([pk, k], axis=1)
             v = ops.concat([pv, v], axis=1)
             cache = (k, v)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.cfg.attn_dropout if self.training else 0.0,
-            training=self.training)
+        if self.cfg.context_parallel and _in_trace():
+            # ring attention over the sep axis (long-context path)
+            from ..core.dispatch import apply
+            from ..ops.pallas.ring_attention import ring_attention
+
+            out = apply(
+                "ring_attention",
+                lambda qv, kv, vv: ring_attention(qv, kv, vv, causal=True),
+                q, k, v)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.cfg.attn_dropout if self.training else 0.0,
+                training=self.training)
         out = out.reshape([b, s, h])
         out = self.out_proj(out)
         if cache is not None:
@@ -194,9 +211,57 @@ class GPTPretrainingCriterion(nn.Layer):
         return loss
 
 
+class GPTEmbeddingStage(nn.Layer):
+    """First pipeline stage: token (+position) embedding."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = mpu.VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        if not cfg.use_rope:
+            self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        from .. import ops
+
+        x = self.wte(input_ids)
+        if not self.cfg.use_rope:
+            pos = ops.arange(0, input_ids.shape[1], dtype="int32")
+            x = x + self.wpe(pos)
+        return self.drop(x)
+
+
+class GPTHeadStage(nn.Layer):
+    """Last pipeline stage: final norm + LM head."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.ln_f = _norm(cfg)
+        self.lm_head = mpu.ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, has_bias=False)
+
+    def forward(self, x):
+        return self.lm_head(self.ln_f(x))
+
+
+def gpt_pipe_layers(cfg):
+    """Flat layer list for PipelineLayer (GPTForCausalLMPipe role; pipeline
+    requires untied embeddings — the reference shares them via
+    SharedLayerDesc + grad allreduce, planned for the interleaved milestone)."""
+    assert not cfg.tie_embeddings, "pipeline GPT needs tie_embeddings=False"
+    return ([GPTEmbeddingStage(cfg)] +
+            [GPTBlock(cfg) for _ in range(cfg.num_layers)] +
+            [GPTHeadStage(cfg)])
+
+
 def gpt_tiny(**kw):
-    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
-                     num_heads=4, max_seq_len=128, **kw)
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_seq_len", 128)
+    return GPTConfig(**kw)
 
 
 def gpt_1p3b(**kw):

@@ -1,0 +1,95 @@
+"""Ring attention: context parallelism for sequences longer than one chip's
+memory (SURVEY §5 long-context note: the reference has NO ring attention —
+this is the capability-parity-plus point; its SEP axis only does Ulysses-
+style alltoall).
+
+Design: inside `shard_map` over the `sep` mesh axis, each device holds its
+local Q/K/V sequence shard; K/V blocks rotate around the ring via
+`lax.ppermute` while an online-softmax accumulator (flash-attention style,
+f32) folds in each block. Communication overlaps compute on ICI because each
+ppermute is issued before the block math that uses the previous one is
+consumed (XLA schedules the async collective-permute). Fully differentiable:
+the VJP of ppermute is the reverse rotation, so backward is a ring too.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _local_ring_attention(q, k, v, *, axis_name, causal):
+    """Per-shard body. q/k/v: [b, s_local, h, d]."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale  # [b,h,sl,d]
+
+    m0 = jnp.full((b, h, sl), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sl), jnp.float32)
+    acc0 = jnp.zeros((b, h, sl, d), jnp.float32)
+    kc0 = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vc0 = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        m, l, acc, kc, vc = carry
+        src = jnp.mod(my - i, n)  # origin shard of the kv block we hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kc)
+        if causal:
+            q_pos = my * sl + jax.lax.broadcasted_iota(
+                jnp.int32, (sl, sl), 0)
+            k_pos = src * sl + jax.lax.broadcasted_iota(
+                jnp.int32, (sl, sl), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vc)
+        kc_next = jax.lax.ppermute(kc, axis_name, perm)
+        vc_next = jax.lax.ppermute(vc, axis_name, perm)
+        return m_new, l_new, acc_new, kc_next, vc_next
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, body, (m0, l0, acc0, kc0, vc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, seq_axis="sep", causal=True,
+                   batch_axis="dp", head_axis="mp"):
+    """[B, S, H, D] global arrays (or tracers); S sharded over `seq_axis`.
+    Falls back to a single-shard flash/ref path when the mesh has no seq
+    axis."""
+    from jax import shard_map
+
+    from ...distributed import topology as topo_mod
+
+    if mesh is None:
+        mesh = topo_mod.current_spmd_mesh()
+    if seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
+        from .flash_attention import flash_attention_fwd
+
+        return flash_attention_fwd(q, k, v, None, causal)
+
+    h = q.shape[2]
+    use_head = head_axis in mesh.shape and h % mesh.shape[head_axis] == 0
+    use_batch = batch_axis in mesh.shape and \
+        q.shape[0] % mesh.shape[batch_axis] == 0
+    spec = P(batch_axis if use_batch else None, seq_axis,
+             head_axis if use_head else None, None)
+
+    fn = shard_map(
+        functools.partial(_local_ring_attention, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,222 @@
+"""paddle.profiler parity (`python/paddle/profiler/profiler.py:346`):
+Profiler with scheduler states, RecordEvent scopes, chrome-trace export.
+
+TPU-first: device timelines come from the jax/XLA profiler (xprof trace →
+TensorBoard-compatible protobuf); host-side RecordEvent scopes are recorded
+by this module and exported as chrome-tracing JSON (`export_chrome_tracing`
+parity). The two can run together: jax.profiler captures kernels while the
+host recorder captures python scopes.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class _HostEventRecorder:
+    """Host event ring (host_tracer.cc role)."""
+
+    def __init__(self):
+        self.events = []
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def start(self):
+        self.events = []
+        self.enabled = True
+
+    def stop(self):
+        self.enabled = False
+
+    def add(self, name, t0, t1, tid):
+        if self.enabled:
+            with self._lock:
+                self.events.append((name, t0, t1, tid))
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """User scope marker (platform::RecordEvent parity)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        _recorder.add(self.name, self._t0, time.perf_counter_ns(),
+                      threading.get_ident())
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """State machine over step numbers (profiler.py:79 parity)."""
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period if period else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        prof._export_chrome(path)
+        return path
+
+    return handler
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else
+            (lambda step: ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._jax_active = False
+        self._jax_dir = None
+        self.timer_only = timer_only
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self):
+        self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._begin_record()
+
+    def _begin_record(self):
+        _recorder.start()
+        if not self.timer_only:
+            try:
+                import tempfile
+
+                import jax
+
+                self._jax_dir = tempfile.mkdtemp(prefix="xprof_")
+                jax.profiler.start_trace(self._jax_dir)
+                self._jax_active = True
+            except Exception:
+                self._jax_active = False
+
+    def _end_record(self):
+        _recorder.stop()
+        if self._jax_active:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_active = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        old = self._state
+        self._step += 1
+        new = self._scheduler(self._step)
+        if old in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and new in (ProfilerState.CLOSED, ProfilerState.READY):
+            self._end_record()
+        elif old in (ProfilerState.CLOSED, ProfilerState.READY) and \
+                new in (ProfilerState.RECORD,
+                        ProfilerState.RECORD_AND_RETURN):
+            self._begin_record()
+        self._state = new
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._end_record()
+        self._state = ProfilerState.CLOSED
+
+    def _export_chrome(self, path):
+        events = [{
+            "name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
+            "ts": t0 / 1000.0, "dur": (t1 - t0) / 1000.0, "cat": "host",
+        } for (name, t0, t1, tid) in _recorder.events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "xprof_dir": self._jax_dir}, f)
+        return path
+
+    def export(self, path, format="json"):
+        return self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        agg = {}
+        for name, t0, t1, _tid in _recorder.events:
+            dur = (t1 - t0) / 1e6
+            rec = agg.setdefault(name, [0, 0.0])
+            rec[0] += 1
+            rec[1] += dur
+        lines = [f"{'name':<40} {'calls':>8} {'total(ms)':>12}"]
+        for name, (calls, total) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40} {calls:>8} {total:>12.3f}")
+        text = "\n".join(lines)
+        print(text)
+        return agg

@@ -1,0 +1,147 @@
+"""MoE (expert parallel) + ring attention tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from paddle_tpu.distributed import fleet, topology
+
+
+@pytest.fixture(autouse=True)
+def fresh_topology():
+    topology.reset_topology()
+    yield
+    topology.reset_topology()
+
+
+def _init(dp=2, mp=4, sep=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sep_degree": sep,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+
+def test_moe_forward_backward_eager():
+    from paddle_tpu.incubate import MoELayer
+
+    P.seed(0)
+    moe = MoELayer(d_model=32, d_hidden=64, num_experts=4, gate="gshard")
+    x = P.randn([4, 8, 32])
+    x.stop_gradient = False
+    out = moe(x)
+    assert out.shape == [4, 8, 32]
+    (out.sum() + P.Tensor(moe.aux_loss._value
+                          if hasattr(moe.aux_loss, "_value")
+                          else moe.aux_loss)).backward()
+    assert moe.w1.grad is not None
+    assert moe.gate.weight.grad is not None
+
+
+def test_moe_switch_gate():
+    from paddle_tpu.incubate import MoELayer
+
+    P.seed(0)
+    moe = MoELayer(d_model=16, d_hidden=32, num_experts=2, gate="switch",
+                   capacity_factor=2.0)
+    x = P.randn([2, 8, 16])
+    out = moe(x)
+    assert out.shape == [2, 8, 16]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_moe_in_sharded_train_step():
+    """MoE experts sharded over the mp axis inside the compiled step."""
+    from paddle_tpu.incubate import MoELayer
+    import paddle_tpu.nn as nn
+
+    _init(dp=2, mp=4)
+
+    class MoENet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inp = nn.Linear(16, 32)
+            self.moe = MoELayer(32, 64, num_experts=4)
+            self.out = nn.Linear(32, 8)
+
+        def forward(self, x):
+            return self.out(self.moe(self.inp(x)))
+
+    P.seed(0)
+    model = fleet.distributed_model(MoENet())
+    opt = fleet.distributed_optimizer(
+        P.optimizer.AdamW(parameters=model.parameters(), learning_rate=1e-3))
+    loss_fn = nn.MSELoss()
+    x = P.randn([8, 4, 16])
+    y = P.randn([8, 4, 8])
+    losses = [float(model.train_batch((x, y), optimizer=opt,
+                                      loss_fn=loss_fn)) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    specs = [str(v.sharding.spec)
+             for n, v in model._train_step._state["params"].items()
+             if "moe.w" in n]
+    assert all("mp" in s for s in specs), specs
+
+
+def test_ring_attention_matches_reference():
+    from paddle_tpu.ops.pallas.flash_attention import _ref_attention
+    from paddle_tpu.ops.pallas.ring_attention import ring_attention
+
+    _init(dp=2, mp=1, sep=4)
+    topo = fleet.get_hybrid_communicate_group()
+    rs = np.random.RandomState(0)
+    B, S, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+
+    for causal in (False, True):
+        out = ring_attention(q, k, v, mesh=topo.spmd_mesh, causal=causal)
+        ref = _ref_attention(q, k, v, None, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"causal={causal}")
+
+
+def test_ring_attention_grad():
+    from paddle_tpu.ops.pallas.flash_attention import _ref_attention
+    from paddle_tpu.ops.pallas.ring_attention import ring_attention
+
+    _init(dp=1, mp=1, sep=4)
+    topo = fleet.get_hybrid_communicate_group()
+    rs = np.random.RandomState(1)
+    B, S, H, D = 1, 32, 2, 8
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32)
+
+    g_ring = jax.grad(lambda *a: jnp.sum(
+        ring_attention(*a, mesh=topo.spmd_mesh, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda *a: jnp.sum(
+        _ref_attention(*a, None, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=5e-4)
+
+
+def test_gpt_with_sep_ring_attention():
+    """GPT with context-parallel attention in the compiled hybrid step."""
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    _init(dp=2, mp=2, sep=2)
+    P.seed(0)
+    cfg = gpt_tiny(sequence_parallel=True, context_parallel=True)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        P.optimizer.AdamW(parameters=model.parameters(), learning_rate=1e-3))
+    crit = GPTPretrainingCriterion()
+    ids = P.randint(0, cfg.vocab_size, [4, 32])
+    labels = P.randint(0, cfg.vocab_size, [4, 32])
+    losses = [float(model.train_batch((ids, labels), optimizer=opt,
+                                      loss_fn=crit)) for _ in range(3)]
+    assert losses[-1] < losses[0]

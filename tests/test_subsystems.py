@@ -1,0 +1,126 @@
+"""Aux subsystem tests: distributed checkpoint, hapi Model, profiler,
+launcher env, jit save/load."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet, topology
+
+
+@pytest.fixture(autouse=True)
+def fresh_topology():
+    topology.reset_topology()
+    yield
+    topology.reset_topology()
+
+
+def test_dist_checkpoint_roundtrip(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict,
+    )
+
+    P.seed(0)
+    m = nn.Linear(8, 8)
+    sd = m.state_dict()
+    save_state_dict(sd, str(tmp_path / "ckpt"))
+    m2 = nn.Linear(8, 8)
+    sd2 = m2.state_dict()
+    load_state_dict(sd2, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(sd2["weight"].numpy(), sd["weight"].numpy())
+
+
+def test_dist_checkpoint_reshard(tmp_path):
+    """Save sharded one way, load into a differently-sharded target."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as Pt
+
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict,
+    )
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                               "sep_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    topo = fleet.get_hybrid_communicate_group()
+    data = np.arange(64, dtype=np.float32).reshape(8, 8)
+    # saved dp-sharded on rows
+    src = P.Tensor(jax.device_put(
+        data, NamedSharding(topo.spmd_mesh, Pt("dp", None))))
+    save_state_dict({"w": src}, str(tmp_path / "ck2"))
+    # load into an mp-sharded-on-cols target
+    tgt = P.Tensor(jax.device_put(
+        np.zeros((8, 8), np.float32),
+        NamedSharding(topo.spmd_mesh, Pt(None, "mp"))))
+    load_state_dict({"w": tgt}, str(tmp_path / "ck2"))
+    np.testing.assert_allclose(np.asarray(tgt._value), data)
+    assert "mp" in str(tgt._value.sharding.spec)
+
+
+def test_hapi_model_fit(tmp_path):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.datasets import FakeData
+
+    P.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(48, 10))
+    model = Model(net)
+    model.prepare(
+        optimizer=P.optimizer.Adam(parameters=net.parameters(),
+                                   learning_rate=1e-2),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    data = FakeData(size=64, image_shape=(3, 4, 4), num_classes=10)
+    model.fit(data, batch_size=16, epochs=1, verbose=0)
+    res = model.evaluate(data, batch_size=16)
+    assert "loss" in res and "acc" in res
+    preds = model.predict(data, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 10)
+    model.save(str(tmp_path / "m"))
+    model.load(str(tmp_path / "m"))
+
+
+def test_profiler_chrome_export(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    prof = profiler.Profiler(
+        scheduler=profiler.make_scheduler(record=2),
+        on_trace_ready=None, timer_only=True)
+    prof.start()
+    for _ in range(2):
+        with profiler.RecordEvent("train_step"):
+            (P.randn([32, 32]) @ P.randn([32, 32])).numpy()
+        prof.step()
+    prof.stop()
+    path = prof.export(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "train_step" in names
+    agg = prof.summary()
+    assert "train_step" in agg
+
+
+def test_launcher_env_build():
+    from paddle_tpu.distributed.launch.main import build_env, parse_args
+
+    args = parse_args(["--nnodes", "2", "--rank", "1",
+                       "--master", "10.0.0.1:8476", "train.py"])
+    env = build_env(args)
+    assert env["PADDLE_TRAINER_ID"] == "1"
+    assert env["PADDLE_TRAINERS_NUM"] == "2"
+    assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    P.seed(0)
+    m = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3))
+    m.eval()
+    x = P.randn([2, 6])
+    P.jit.save(m, str(tmp_path / "net"), input_spec=[x._value])
+    loaded = P.jit.load(str(tmp_path / "net"))
+    np.testing.assert_allclose(loaded(x).numpy(), m(x).numpy(), rtol=1e-6)
