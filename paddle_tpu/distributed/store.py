@@ -1,0 +1,79 @@
+"""TCPStore (parity: `paddle/phi/core/distributed/store/tcp_store.h:121`) —
+framework-level rendezvous KV over the native C++ server/client."""
+from __future__ import annotations
+
+import time
+
+from .. import native
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=8577, is_master=False,
+                 world_size=1, timeout=120.0):
+        self.lib = native.load()
+        self.host = host
+        self.port = port
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        if is_master:
+            self._server = self.lib.tcp_store_server_start(port)
+            if not self._server:
+                raise OSError(f"TCPStore server failed to bind :{port}")
+        self._fd = self.lib.tcp_store_connect(host.encode(), port,
+                                              float(timeout))
+        if self._fd < 0:
+            raise ConnectionError(f"TCPStore connect to {host}:{port} failed")
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self.lib.tcp_store_set(self._fd, key.encode(), len(key),
+                                    value, len(value))
+        if rc != 0:
+            raise ConnectionError("TCPStore set failed")
+
+    def get(self, key):
+        import ctypes
+
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self.lib.tcp_store_get(self._fd, key.encode(), len(key), buf, cap)
+        if n < 0:
+            raise ConnectionError(f"TCPStore get({key!r}) failed: {n}")
+        return buf.raw[:n]
+
+    def add(self, key, amount):
+        v = self.lib.tcp_store_add(self._fd, key.encode(), len(key),
+                                   int(amount))
+        if v == -(2 ** 63):
+            raise ConnectionError("TCPStore add failed")
+        return int(v)
+
+    def check(self, key):
+        return bool(self.lib.tcp_store_check(self._fd, key.encode(),
+                                             len(key)))
+
+    def wait(self, keys, timeout=None):
+        deadline = time.time() + (timeout or self.timeout)
+        for k in keys if isinstance(keys, (list, tuple)) else [keys]:
+            while not self.check(k):
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore wait timeout on {k!r}")
+                time.sleep(0.05)
+
+    def barrier(self, key="_barrier", world_size=None):
+        n = world_size or self.world_size
+        arrived = self.add(f"{key}/count", 1)
+        if arrived == n:
+            self.set(f"{key}/go", b"1")
+        self.wait([f"{key}/go"])
+
+    def __del__(self):
+        try:
+            if getattr(self, "_fd", -1) >= 0:
+                self.lib.tcp_store_disconnect(self._fd)
+            if getattr(self, "_server", None):
+                self.lib.tcp_store_server_stop(self._server)
+        except Exception:
+            pass

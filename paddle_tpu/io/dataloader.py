@@ -47,6 +47,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = max(2, prefetch_factor)
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -144,6 +145,19 @@ class DataLoader:
                 next_idx += 1
 
     def __iter__(self):
+        if self.num_workers > 0 and self.use_shared_memory and \
+                not self._iterable_mode and self.batch_sampler is not None:
+            # process workers + native shm ring (GIL-free transport)
+            from .shm_queue import run_process_workers
+
+            try:
+                return run_process_workers(
+                    self.dataset, list(self.batch_sampler), self.collate_fn,
+                    self.num_workers, worker_init_fn=self.worker_init_fn)
+            except (OSError, ValueError):
+                # no native toolchain / non-module-level collate_fn:
+                # fall through to thread workers
+                pass
         if self.num_workers > 0:
             return self._iter_workers()
         return self._iter_single()

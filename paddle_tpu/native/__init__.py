@@ -1,0 +1,90 @@
+"""Native runtime tier: C++ components behind ctypes.
+
+Role parity: where the reference's runtime is C++ (shared-memory DataLoader
+transport, TCPStore rendezvous), so is ours. The library builds lazily from
+`src/` with g++ on first use and is cached under `_build/`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD, "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _compile():
+    os.makedirs(_BUILD, exist_ok=True)
+    srcs = [os.path.join(_HERE, "src", f)
+            for f in ("shm_ring.cc", "tcp_store.cc")]
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", _SO] + srcs + ["-lrt"]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _stale():
+    if not os.path.exists(_SO):
+        return True
+    so_m = os.path.getmtime(_SO)
+    for f in os.listdir(os.path.join(_HERE, "src")):
+        if os.path.getmtime(os.path.join(_HERE, "src", f)) > so_m:
+            return True
+    return False
+
+
+def load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _stale():
+            _compile()
+        lib = ctypes.CDLL(_SO)
+        # shm ring
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_uint64]
+        lib.shm_ring_attach.restype = ctypes.c_void_p
+        lib.shm_ring_attach.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_double]
+        lib.shm_ring_pop.restype = ctypes.c_int64
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_double]
+        lib.shm_ring_slot_size.restype = ctypes.c_uint64
+        lib.shm_ring_slot_size.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_count.restype = ctypes.c_uint64
+        lib.shm_ring_count.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_detach.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+        # tcp store
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [ctypes.c_uint16]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_connect.restype = ctypes.c_int
+        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                          ctypes.c_double]
+        lib.tcp_store_set.restype = ctypes.c_int64
+        lib.tcp_store_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_uint32, ctypes.c_char_p,
+                                      ctypes.c_uint64]
+        lib.tcp_store_get.restype = ctypes.c_int64
+        lib.tcp_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_uint32, ctypes.c_char_p,
+                                      ctypes.c_uint64]
+        lib.tcp_store_add.restype = ctypes.c_int64
+        lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_uint32, ctypes.c_int64]
+        lib.tcp_store_check.restype = ctypes.c_int64
+        lib.tcp_store_check.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                        ctypes.c_uint32]
+        lib.tcp_store_disconnect.argtypes = [ctypes.c_int]
+        _lib = lib
+        return _lib

@@ -1,0 +1,258 @@
+// TCP key-value store for distributed bootstrap.
+//
+// Role parity: `TCPStore` (paddle/phi/core/distributed/store/tcp_store.h:121)
+// — rank-0 hosts a KV server; clients SET/GET(blocking)/ADD/WAIT; barriers
+// are built from ADD+WAIT. This is the rendezvous layer under multi-host
+// launch (the jax coordination service covers jax's own needs; this store
+// serves framework-level rendezvous, elastic membership, and user code).
+//
+// Wire format (all little-endian):
+//   request : u8 op | u32 klen | key | u64 vlen | value
+//   response: i64 status/vlen | value
+// Ops: 0=SET 1=GET(block until present) 2=ADD(i64 delta; returns new) 3=DEL
+//      4=CHECK (returns 1/0 immediately)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread loop;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<char>> kv;
+  std::vector<std::thread> handlers;
+};
+
+bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void handle_client(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    if (!read_all(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_all(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_all(fd, &key[0], klen)) break;
+    uint64_t vlen;
+    if (!read_all(fd, &vlen, 8)) break;
+    std::vector<char> val(vlen);
+    if (vlen && !read_all(fd, val.data(), vlen)) break;
+
+    if (op == 0) {  // SET
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv[key] = std::move(val);
+      }
+      s->cv.notify_all();
+      int64_t ok = 0;
+      if (!write_all(fd, &ok, 8)) break;
+    } else if (op == 1) {  // GET blocking
+      std::vector<char> out;
+      {
+        std::unique_lock<std::mutex> g(s->mu);
+        s->cv.wait(g, [&] {
+          return s->stop.load() || s->kv.count(key) > 0;
+        });
+        if (s->stop.load()) break;
+        out = s->kv[key];
+      }
+      int64_t n = static_cast<int64_t>(out.size());
+      if (!write_all(fd, &n, 8)) break;
+      if (n && !write_all(fd, out.data(), out.size())) break;
+    } else if (op == 2) {  // ADD
+      int64_t delta = 0;
+      if (vlen == 8) memcpy(&delta, val.data(), 8);
+      int64_t cur = 0;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto it = s->kv.find(key);
+        if (it != s->kv.end() && it->second.size() == 8) {
+          memcpy(&cur, it->second.data(), 8);
+        }
+        cur += delta;
+        std::vector<char> nv(8);
+        memcpy(nv.data(), &cur, 8);
+        s->kv[key] = std::move(nv);
+      }
+      s->cv.notify_all();
+      if (!write_all(fd, &cur, 8)) break;
+    } else if (op == 3) {  // DEL
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->kv.erase(key);
+      }
+      int64_t ok = 0;
+      if (!write_all(fd, &ok, 8)) break;
+    } else if (op == 4) {  // CHECK
+      int64_t present;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        present = s->kv.count(key) ? 1 : 0;
+      }
+      if (!write_all(fd, &present, 8)) break;
+    } else {
+      break;
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tcp_store_server_start(uint16_t port) {
+  Server* s = new Server();
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+           sizeof(addr)) != 0 ||
+      listen(s->listen_fd, 128) != 0) {
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->loop = std::thread([s] {
+    while (!s->stop.load()) {
+      int fd = accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      s->handlers.emplace_back(handle_client, s, fd);
+    }
+  });
+  return s;
+}
+
+void tcp_store_server_stop(void* handle) {
+  Server* s = static_cast<Server*>(handle);
+  s->stop.store(true);
+  s->cv.notify_all();
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  if (s->loop.joinable()) s->loop.join();
+  for (auto& t : s->handlers) {
+    if (t.joinable()) t.detach();  // blocked GETs unblock via stop+notify
+  }
+  delete s;
+}
+
+// ---- client ----
+
+int tcp_store_connect(const char* ip, uint16_t port, double timeout_s) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  double waited = 0;
+  while (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    if (waited >= timeout_s) {
+      close(fd);
+      return -1;
+    }
+    usleep(100000);
+    waited += 0.1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static bool send_req(int fd, uint8_t op, const char* key, uint32_t klen,
+                     const char* val, uint64_t vlen) {
+  if (!write_all(fd, &op, 1)) return false;
+  if (!write_all(fd, &klen, 4)) return false;
+  if (klen && !write_all(fd, key, klen)) return false;
+  if (!write_all(fd, &vlen, 8)) return false;
+  if (vlen && !write_all(fd, val, vlen)) return false;
+  return true;
+}
+
+int64_t tcp_store_set(int fd, const char* key, uint32_t klen,
+                      const char* val, uint64_t vlen) {
+  if (!send_req(fd, 0, key, klen, val, vlen)) return -1;
+  int64_t status;
+  return read_all(fd, &status, 8) ? status : -1;
+}
+
+// Returns value length; caller buffer must hold it. -1 on error, -3 too small.
+int64_t tcp_store_get(int fd, const char* key, uint32_t klen, char* out,
+                      uint64_t out_cap) {
+  if (!send_req(fd, 1, key, klen, nullptr, 0)) return -1;
+  int64_t n;
+  if (!read_all(fd, &n, 8)) return -1;
+  if (n < 0) return n;
+  if (static_cast<uint64_t>(n) > out_cap) {
+    std::vector<char> sink(n);
+    read_all(fd, sink.data(), n);
+    return -3;
+  }
+  if (n && !read_all(fd, out, static_cast<size_t>(n))) return -1;
+  return n;
+}
+
+int64_t tcp_store_add(int fd, const char* key, uint32_t klen, int64_t delta) {
+  if (!send_req(fd, 2, key, klen, reinterpret_cast<char*>(&delta), 8)) {
+    return INT64_MIN;
+  }
+  int64_t cur;
+  return read_all(fd, &cur, 8) ? cur : INT64_MIN;
+}
+
+int64_t tcp_store_check(int fd, const char* key, uint32_t klen) {
+  if (!send_req(fd, 4, key, klen, nullptr, 0)) return -1;
+  int64_t present;
+  return read_all(fd, &present, 8) ? present : -1;
+}
+
+void tcp_store_disconnect(int fd) { close(fd); }
+
+}  // extern "C"
