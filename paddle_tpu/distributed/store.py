@@ -33,9 +33,13 @@ class TCPStore:
         if rc != 0:
             raise ConnectionError("TCPStore set failed")
 
-    def get(self, key):
+    def get(self, key, timeout=None):
         import ctypes
 
+        # the native GET blocks server-side with no deadline; apply the
+        # store timeout by polling CHECK first, then doing the (now
+        # immediate) blocking GET
+        self.wait([key], timeout=timeout)
         cap = 1 << 20
         buf = ctypes.create_string_buffer(cap)
         n = self.lib.tcp_store_get(self._fd, key.encode(), len(key), buf, cap)
@@ -63,11 +67,17 @@ class TCPStore:
                 time.sleep(0.05)
 
     def barrier(self, key="_barrier", world_size=None):
+        # reusable barrier with the round derived SERVER-side from one
+        # global arrival counter: this caller's position in the global
+        # arrival order fixes its round, so a relaunched rank (elastic
+        # rejoin) continues at the cluster's current round instead of
+        # restarting at 0 and desynchronizing
         n = world_size or self.world_size
-        arrived = self.add(f"{key}/count", 1)
-        if arrived == n:
-            self.set(f"{key}/go", b"1")
-        self.wait([f"{key}/go"])
+        seq = self.add(f"{key}/seq", 1)
+        r = (seq - 1) // n
+        if seq == (r + 1) * n:
+            self.set(f"{key}/go/{r}", b"1")
+        self.wait([f"{key}/go/{r}"])
 
     def __del__(self):
         try:

@@ -1,0 +1,42 @@
+"""paddle_tpu.distribution: probability distributions, transforms, KL.
+
+Role parity: `python/paddle/distribution/` (Distribution base
+`python/paddle/distribution/distribution.py`, kl registry `kl.py`,
+transforms `transform.py`). TPU-first: every density/statistic is a pure
+jnp function dispatched through the framework op gate, so log_prob/rsample
+are differentiable on the eager tape and trace cleanly under jit; sampling
+uses the functional PRNG (threefry keys from `core.rng`), never host RNG.
+"""
+from .distribution import Distribution  # noqa: F401
+from .exponential_family import ExponentialFamily  # noqa: F401
+from .univariate import (  # noqa: F401
+    Bernoulli, Beta, Binomial, Cauchy, ContinuousBernoulli, Exponential,
+    Gamma, Geometric, Gumbel, Laplace, LogNormal, Normal, Poisson, StudentT,
+    Uniform,
+)
+from .multivariate import (  # noqa: F401
+    Categorical, Dirichlet, Multinomial, MultivariateNormal,
+)
+from .independent import Independent  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily",
+    "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy",
+    "ContinuousBernoulli", "Dirichlet", "Exponential", "Gamma", "Geometric",
+    "Gumbel", "Independent", "Laplace", "LogNormal", "Multinomial",
+    "MultivariateNormal", "Normal", "Poisson", "StudentT", "Uniform",
+    "TransformedDistribution",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "kl_divergence", "register_kl",
+]

@@ -1,0 +1,113 @@
+"""Distribution base class.
+
+Role parity: `python/paddle/distribution/distribution.py` (Distribution with
+batch_shape/event_shape, sample/rsample/log_prob/prob/entropy surface).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.rng import default_generator
+from ..core.tensor import Tensor
+
+
+def _asval(x, dtype=None):
+    """Unwrap Tensor / python scalar into a jnp array (keeps tracers)."""
+    if isinstance(x, Tensor):
+        v = x._value
+    elif isinstance(x, (int, float, bool, list, tuple, np.ndarray)):
+        v = jnp.asarray(x, dtype=dtype or jnp.float32)
+    else:
+        v = x
+    if dtype is not None and v.dtype != jnp.dtype(dtype):
+        v = v.astype(dtype)
+    return v
+
+
+def _param(x):
+    """Distribution parameter → Tensor (gradient-capable handle)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(_asval(x))
+
+
+def _sample_shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base of all distributions; subclasses implement the pure-jnp kernels
+    `_log_prob(value, *params)` etc. and declare `_param_names`."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    # --- to be provided by subclasses ---------------------------------------
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-reparameterized sample (gradients stopped)."""
+        s = self.rsample(shape)
+        return s.detach() if isinstance(s, Tensor) else s
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply("dist.prob", jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # --- helpers ------------------------------------------------------------
+    def _next_key(self):
+        return default_generator.split()
+
+    def _extend_shape(self, sample_shape):
+        return (_sample_shape(sample_shape) + self._batch_shape
+                + self._event_shape)
+
+    @property
+    def stddev(self):
+        return apply("dist.stddev", jnp.sqrt, self.variance)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")

@@ -1,0 +1,45 @@
+"""Exponential-family base: entropy via Bregman identity on the log-normalizer.
+
+Role parity: `python/paddle/distribution/exponential_family.py` — entropy
+computed from natural parameters with autodiff of `_log_normalizer`. On TPU
+this is a one-liner with `jax.grad` instead of the reference's dygraph
+backward pass.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from .distribution import Distribution
+
+
+class ExponentialFamily(Distribution):
+    """Subclasses define `_natural_parameters` (tuple of Tensors),
+    `_log_normalizer(*nat)` (pure jnp) and `_mean_carrier_measure`."""
+
+    _mean_carrier_measure = 0.0
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_parameters):
+        raise NotImplementedError
+
+    def entropy(self):
+        nat = self._natural_parameters
+
+        def ent(*nvals):
+            flat = [jnp.asarray(n, jnp.float32) for n in nvals]
+
+            def lognorm_sum(*ns):
+                return jnp.sum(self._log_normalizer(*ns))
+
+            g = jax.grad(lognorm_sum, argnums=tuple(range(len(flat))))(*flat)
+            result = self._log_normalizer(*flat) - self._mean_carrier_measure
+            for n, gn in zip(flat, g):
+                result = result - n * gn
+            return result
+
+        return apply("dist.ef_entropy", ent, *nat)

@@ -1,0 +1,48 @@
+"""Independent: reinterpret batch dims as event dims.
+
+Role parity: `python/paddle/distribution/independent.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from .distribution import Distribution
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        n = len(base.batch_shape) - self.reinterpreted_batch_rank
+        super().__init__(shape[:n],
+                         shape[n:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_event(self, x):
+        k = self.reinterpreted_batch_rank
+
+        def f(v):
+            return jnp.sum(v, axis=tuple(range(-k, 0)))
+
+        return apply("independent.sum", f, x)
+
+    def log_prob(self, value):
+        return self._sum_event(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_event(self.base.entropy())

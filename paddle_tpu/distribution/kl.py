@@ -1,0 +1,183 @@
+"""KL divergence registry + closed forms.
+
+Role parity: `python/paddle/distribution/kl.py` (`register_kl` decorator
+dispatching on distribution types, `kl_divergence` entry).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.dispatch import apply
+from .independent import Independent
+from .multivariate import Categorical, Dirichlet, MultivariateNormal
+from .univariate import (
+    Bernoulli, Beta, Exponential, Gamma, Geometric, Laplace, LogNormal,
+    Normal, Uniform,
+)
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    # most-derived match wins (reference resolves by type pair lookup with
+    # mro walk)
+    best, best_fn = None, None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = (type(p).__mro__.index(pc) + type(q).__mro__.index(qc))
+            if best is None or score < best:
+                best, best_fn = score, fn
+    if best_fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return best_fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return apply("kl.normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(pa, pb, qa, qb):
+        result = jnp.log((qb - qa) / (pb - pa))
+        return jnp.where((qa <= pa) & (pb <= qb), result, jnp.inf)
+
+    return apply("kl.uniform", f, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def f(pp, qp):
+        eps = jnp.finfo(jnp.float32).tiny
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+    return apply("kl.bernoulli", f, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence_categorical(q)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def f(pa, pb, qa, qb):
+        ps, qs = pa + pb, qa + qb
+        return (jsp.betaln(qa, qb) - jsp.betaln(pa, pb)
+                + (pa - qa) * jsp.digamma(pa) + (pb - qb) * jsp.digamma(pb)
+                + (qs - ps) * jsp.digamma(ps))
+
+    return apply("kl.beta", f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def f(pc, qc):
+        p0 = jnp.sum(pc, -1)
+        return (jsp.gammaln(p0) - jnp.sum(jsp.gammaln(pc), -1)
+                - jsp.gammaln(jnp.sum(qc, -1))
+                + jnp.sum(jsp.gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (jsp.digamma(pc)
+                                       - jsp.digamma(p0[..., None])), -1))
+
+    return apply("kl.dirichlet", f, p.concentration, q.concentration)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(pc, pr, qc, qr):
+        return ((pc - qc) * jsp.digamma(pc) - jsp.gammaln(pc)
+                + jsp.gammaln(qc) + qc * (jnp.log(pr) - jnp.log(qr))
+                + pc * (qr / pr - 1))
+
+    return apply("kl.gamma", f, p.concentration, p.rate,
+                 q.concentration, q.rate)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def f(pr, qr):
+        ratio = qr / pr
+        return ratio - 1 - jnp.log(ratio)
+
+    return apply("kl.exponential", f, p.rate, q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def f(pl, ps, ql, qs):
+        adiff = jnp.abs(pl - ql)
+        return (jnp.log(qs / ps) + adiff / qs
+                + (ps / qs) * jnp.exp(-adiff / ps) - 1)
+
+    return apply("kl.laplace", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    def f(pp, qp):
+        return (-(1 - pp) / pp * (jnp.log1p(-qp) - jnp.log1p(-pp))
+                + jnp.log(pp) - jnp.log(qp))
+
+    return apply("kl.geometric", f, p.probs, q.probs)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    def f(pl, pL, ql, qL):
+        import jax
+
+        d = pl.shape[-1]
+        half_logdet_p = jnp.sum(jnp.log(jnp.diagonal(
+            pL, axis1=-2, axis2=-1)), -1)
+        half_logdet_q = jnp.sum(jnp.log(jnp.diagonal(
+            qL, axis1=-2, axis2=-1)), -1)
+        # tr(Σq^-1 Σp) = ||Lq^-1 Lp||_F^2
+        M = jax.scipy.linalg.solve_triangular(qL, pL, lower=True)
+        tr = jnp.sum(M * M, axis=(-2, -1))
+        diff = ql - pl
+        sol = jax.scipy.linalg.solve_triangular(
+            qL, diff[..., None], lower=True)[..., 0]
+        mah = jnp.sum(sol * sol, -1)
+        return 0.5 * (tr + mah - d) + half_logdet_q - half_logdet_p
+
+    return apply("kl.mvn", f, p.loc, p.scale_tril, q.loc, q.scale_tril)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_rank != q.reinterpreted_batch_rank:
+        raise NotImplementedError("mismatched reinterpreted_batch_rank")
+    inner = kl_divergence(p.base, q.base)
+    k = p.reinterpreted_batch_rank
+
+    def f(v):
+        return jnp.sum(v, axis=tuple(range(-k, 0)))
+
+    return apply("kl.independent", f, inner)

@@ -1,0 +1,276 @@
+"""Multivariate / vector-event distributions.
+
+Role parity: `python/paddle/distribution/{categorical,dirichlet,multinomial,
+multivariate_normal}.py`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.dispatch import apply
+from .distribution import Distribution, _param, _sample_shape
+from .exponential_family import ExponentialFamily
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of `logits`.
+
+    Ref: python/paddle/distribution/categorical.py. The reference mixes two
+    conventions (probs normalizes by sum `categorical.py:120`, KL uses
+    softmax `categorical.py:218-224`); this build uses log-space softmax
+    semantics consistently."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _param(logits)
+        shape = jnp.shape(self.logits._value)
+        super().__init__(shape[:-1], ())
+        self._num_events = shape[-1]
+
+    @property
+    def probs(self):
+        def f(lg):
+            p = lg - jax.scipy.special.logsumexp(lg, axis=-1, keepdims=True)
+            return jnp.exp(p)
+
+        return apply("categorical.probs", f, self.logits)
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = _sample_shape(shape) + self._batch_shape
+
+        def f(lg):
+            return jax.random.categorical(key, lg, axis=-1, shape=out_shape)
+
+        return apply("categorical.sample", f, self.logits).detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, lg):
+            lp = lg - jsp.logsumexp(lg, axis=-1, keepdims=True)
+            return jnp.take_along_axis(
+                lp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+        return apply("categorical.log_prob", f, value, self.logits)
+
+    def entropy(self):
+        def f(lg):
+            lp = lg - jsp.logsumexp(lg, axis=-1, keepdims=True)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return apply("categorical.entropy", f, self.logits)
+
+    def kl_divergence_categorical(self, other):
+        def f(lg, og):
+            lp = lg - jsp.logsumexp(lg, axis=-1, keepdims=True)
+            lq = og - jsp.logsumexp(og, axis=-1, keepdims=True)
+            return jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1)
+
+        return apply("categorical.kl", f, self.logits, other.logits)
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs).
+    Ref: python/paddle/distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        shape = jnp.shape(self.probs._value)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        n = self.total_count
+        return apply("multinomial.mean", lambda p: n * p, self.probs)
+
+    @property
+    def variance(self):
+        n = self.total_count
+        return apply("multinomial.var", lambda p: n * p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        n = self.total_count
+        out_batch = _sample_shape(shape) + self._batch_shape
+
+        def f(p):
+            k = p.shape[-1]
+            lp = jnp.log(jnp.maximum(p, jnp.finfo(jnp.float32).tiny))
+            draws = jax.random.categorical(
+                key, lp, axis=-1, shape=(n,) + out_batch)
+            one_hot = jax.nn.one_hot(draws, k, dtype=jnp.result_type(float))
+            return jnp.sum(one_hot, axis=0)
+
+        return apply("multinomial.sample", f, self.probs).detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            logc = (jsp.gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(jsp.gammaln(v + 1), -1))
+            return logc + jnp.sum(jsp.xlogy(v, p), -1)
+
+        return apply("multinomial.log_prob", f, value, self.probs)
+
+    def entropy(self):
+        # sum of per-category binomial entropies minus covariance correction
+        # is an approximation; the reference computes entropy by exhaustive
+        # support enumeration, feasible only for tiny (n, k) — do the same.
+        n = self.total_count
+
+        def f(p):
+            k = p.shape[-1]
+            if n * k > 4096:
+                raise NotImplementedError(
+                    "Multinomial.entropy: support too large to enumerate")
+            import itertools
+
+            import numpy as _np
+
+            support = [c for c in itertools.product(range(n + 1), repeat=k)
+                       if sum(c) == n]
+            v = jnp.asarray(_np.array(support, dtype=_np.float32))
+            logc = (jsp.gammaln(jnp.asarray(float(n)) + 1)
+                    - jnp.sum(jsp.gammaln(v + 1), -1))
+            lp = logc + jnp.sum(jsp.xlogy(v, p[..., None, :]), -1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return apply("multinomial.entropy", f, self.probs)
+
+
+class Dirichlet(ExponentialFamily):
+    """Dirichlet(concentration).
+    Ref: python/paddle/distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _param(concentration)
+        shape = jnp.shape(self.concentration._value)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return apply("dirichlet.mean",
+                     lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            return c * (a0 - c) / (a0 * a0 * (a0 + 1))
+
+        return apply("dirichlet.var", f, self.concentration)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape))
+            return g / jnp.sum(g, -1, keepdims=True)
+
+        return apply("dirichlet.rsample", f, self.concentration)
+
+    def log_prob(self, value):
+        def f(v, c):
+            return (jnp.sum(jsp.xlogy(c - 1, v), -1)
+                    + jsp.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jsp.gammaln(c), -1))
+
+        return apply("dirichlet.log_prob", f, value, self.concentration)
+
+    def entropy(self):
+        def f(c):
+            a0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(a0)
+                    + (a0 - k) * jsp.digamma(a0)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+
+        return apply("dirichlet.entropy", f, self.concentration)
+
+
+class MultivariateNormal(Distribution):
+    """MVN(loc, covariance_matrix | scale_tril).
+    Ref: python/paddle/distribution/multivariate_normal.py."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _param(loc)
+        if scale_tril is not None:
+            self.scale_tril = _param(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _param(covariance_matrix)
+            self.scale_tril = apply("mvn.chol", jnp.linalg.cholesky, cov)
+        elif precision_matrix is not None:
+            prec = _param(precision_matrix)
+
+            def inv_chol(p):
+                return jnp.linalg.cholesky(jnp.linalg.inv(p))
+
+            self.scale_tril = apply("mvn.prec_chol", inv_chol, prec)
+        else:
+            raise ValueError(
+                "one of covariance_matrix/precision_matrix/scale_tril "
+                "must be specified")
+        d = jnp.shape(self.loc._value)[-1]
+        batch = jnp.broadcast_shapes(
+            jnp.shape(self.loc._value)[:-1],
+            jnp.shape(self.scale_tril._value)[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def covariance_matrix(self):
+        def f(L):
+            return L @ jnp.swapaxes(L, -1, -2)
+
+        return apply("mvn.cov", f, self.scale_tril)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def f(L):
+            return jnp.sum(L * L, axis=-1)
+
+        return apply("mvn.var", f, self.scale_tril)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, L):
+            eps = jax.random.normal(key, out_shape, jnp.result_type(float))
+            return l + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return apply("mvn.rsample", f, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        def f(v, l, L):
+            d = v.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(
+                L, diff[..., None], lower=True)[..., 0]
+            m = jnp.sum(sol * sol, -1)
+            half_logdet = jnp.sum(jnp.log(
+                jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (d * math.log(2 * math.pi) + m) - half_logdet
+
+        return apply("mvn.log_prob", f, value, self.loc, self.scale_tril)
+
+    def entropy(self):
+        def f(l, L):
+            d = l.shape[-1]
+            half_logdet = jnp.sum(jnp.log(
+                jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+
+        return apply("mvn.entropy", f, self.loc, self.scale_tril)

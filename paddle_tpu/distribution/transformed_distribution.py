@@ -1,0 +1,82 @@
+"""TransformedDistribution: base distribution pushed through transforms.
+
+Role parity: `python/paddle/distribution/transformed_distribution.py`.
+Event-rank bookkeeping follows the compose rule: each transform consumes
+`_event_rank` event dims and produces `_event_rank_out` (defaults equal),
+and per-transform log-det terms are reduced over the event dims they do
+not own before accumulating.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from .distribution import Distribution
+from .transform import ChainTransform
+
+
+def _ranks(t):
+    in_r = t._event_rank
+    return in_r, getattr(t, "_event_rank_out", in_r)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(shape)
+        # forward event-rank accumulation from the base's event rank
+        rank = len(base.event_shape)
+        for t in self.transforms:
+            in_r, out_r = _ranks(t)
+            rank = max(rank, in_r) + (out_r - in_r)
+        n = len(out_shape) - rank
+        super().__init__(tuple(out_shape[:n]), tuple(out_shape[n:]))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x.detach()
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        event_rank = len(self.event_shape)
+        y = value
+        lp = None
+        # walk transforms backwards; at each step the event rank transfers
+        # from the transform's output side to its input side
+        for t in reversed(self.transforms):
+            in_r, out_r = _ranks(t)
+            x = t.inverse(y)
+            event_rank += in_r - out_r
+            ldj = t.forward_log_det_jacobian(x)
+            k = event_rank - in_r
+
+            def reduce_ldj(l, k=k):
+                if k > 0:
+                    return jnp.sum(l, axis=tuple(range(-k, 0)))
+                return l
+
+            ldj_r = apply("td.reduce_ldj", reduce_ldj, ldj)
+            lp = ldj_r if lp is None else apply(
+                "td.add", jnp.add, lp, ldj_r)
+            y = x
+        base_lp = self.base.log_prob(y)
+        k0 = event_rank - len(self.base.event_shape)
+        if k0 > 0:
+            base_lp = apply(
+                "td.base_sum",
+                lambda l: jnp.sum(l, axis=tuple(range(-k0, 0))), base_lp)
+        if lp is None:
+            return base_lp
+        return apply("td.sub", jnp.subtract, base_lp, lp)

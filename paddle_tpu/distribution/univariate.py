@@ -1,0 +1,805 @@
+"""Univariate distributions.
+
+Role parity: `python/paddle/distribution/{normal,uniform,bernoulli,beta,
+binomial,cauchy,continuous_bernoulli,exponential,gamma,geometric,gumbel,
+laplace,lognormal,poisson,student_t}.py`. Kernels are pure jnp (jax.scipy
+special functions); reparameterized sampling where the pathwise gradient
+exists (normal/uniform/gumbel/laplace/cauchy/exponential/gamma/beta use
+base-noise transforms or jax's implicit-gradient gamma sampler).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core.dispatch import apply
+from .distribution import Distribution, _asval, _param, _sample_shape
+from .exponential_family import ExponentialFamily
+
+_EULER = 0.5772156649015329
+_LOG_SQRT_2PI = 0.5 * math.log(2 * math.pi)
+
+
+def _bshape(*vals):
+    return jnp.broadcast_shapes(*(jnp.shape(v) for v in vals))
+
+
+class Normal(ExponentialFamily):
+    """N(loc, scale). Ref: python/paddle/distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc._value, self.scale._value))
+
+    @property
+    def mean(self):
+        return apply("normal.mean", lambda l, s: jnp.broadcast_to(
+            l, _bshape(l, s)), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("normal.var", lambda l, s: jnp.broadcast_to(
+            s * s, _bshape(l, s)), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            eps = jax.random.normal(key, out_shape, jnp.result_type(float))
+            return l + s * eps
+
+        return apply("normal.rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -0.5 * z * z - jnp.log(s) - _LOG_SQRT_2PI
+
+        return apply("normal.log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        def f(l, s):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), _bshape(l, s))
+
+        return apply("normal.entropy", f, self.loc, self.scale)
+
+    def cdf(self, value):
+        def f(v, l, s):
+            return 0.5 * (1 + jsp.erf((v - l) / (s * math.sqrt(2.0))))
+
+        return apply("normal.cdf", f, value, self.loc, self.scale)
+
+    def icdf(self, value):
+        def f(v, l, s):
+            return l + s * math.sqrt(2.0) * jsp.erfinv(2 * v - 1)
+
+        return apply("normal.icdf", f, value, self.loc, self.scale)
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    """U[low, high). Ref: python/paddle/distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        super().__init__(_bshape(self.low._value, self.high._value))
+
+    @property
+    def mean(self):
+        return apply("uniform.mean", lambda a, b: (a + b) / 2,
+                     self.low, self.high)
+
+    @property
+    def variance(self):
+        return apply("uniform.var", lambda a, b: (b - a) ** 2 / 12,
+                     self.low, self.high)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            u = jax.random.uniform(key, out_shape, jnp.result_type(float))
+            return a + (b - a) * u
+
+        return apply("uniform.rsample", f, self.low, self.high)
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+
+        return apply("uniform.log_prob", f, value, self.low, self.high)
+
+    def entropy(self):
+        return apply("uniform.entropy", lambda a, b: jnp.log(b - a),
+                     self.low, self.high)
+
+    def cdf(self, value):
+        def f(v, a, b):
+            return jnp.clip((v - a) / (b - a), 0.0, 1.0)
+
+        return apply("uniform.cdf", f, value, self.low, self.high)
+
+
+class Bernoulli(ExponentialFamily):
+    """Bernoulli(probs). Ref: python/paddle/distribution/bernoulli.py."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _param(probs)
+        super().__init__(jnp.shape(self.probs._value))
+
+    @property
+    def logits(self):
+        return apply("bernoulli.logits",
+                     lambda p: jnp.log(p) - jnp.log1p(-p), self.probs)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return apply("bernoulli.var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            return jax.random.bernoulli(
+                key, p, out_shape).astype(jnp.result_type(float))
+
+        return apply("bernoulli.sample", f, self.probs).detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            eps = jnp.finfo(jnp.result_type(float)).tiny
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply("bernoulli.log_prob", f, value, self.probs)
+
+    def entropy(self):
+        def f(p):
+            return -(jsp.xlogy(p, p) + jsp.xlog1py(1 - p, -p))
+
+        return apply("bernoulli.entropy", f, self.probs)
+
+    def cdf(self, value):
+        def f(v, p):
+            return jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0))
+
+        return apply("bernoulli.cdf", f, value, self.probs)
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(lambda) of Loaiza-Ganem & Cunningham.
+    Ref: python/paddle/distribution/continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _param(probs)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs._value))
+
+    def _norm_const(self, p):
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, 0.3)
+        c = jnp.where(
+            (p < lo) | (p > hi),
+            (2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe),
+            2.0 + (p - 0.5) ** 2 * 8.0 / 3.0)
+        return c
+
+    @property
+    def mean(self):
+        def f(p):
+            lo, hi = self._lims
+            safe = jnp.where((p < lo) | (p > hi), p, 0.3)
+            m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where((p < lo) | (p > hi), m, 0.5)
+
+        return apply("cb.mean", f, self.probs)
+
+    @property
+    def variance(self):
+        def f(p):
+            lo, hi = self._lims
+            safe = jnp.where((p < lo) | (p > hi), p, 0.3)
+            v = safe * (safe - 1) / (1 - 2 * safe) ** 2 + \
+                1 / (2 * jnp.arctanh(1 - 2 * safe)) ** 2
+            return jnp.where((p < lo) | (p > hi), v, 1 / 12.0)
+
+        return apply("cb.var", f, self.probs)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, jnp.result_type(float),
+                                   minval=1e-6, maxval=1 - 1e-6)
+            lo, hi = self._lims
+            safe = jnp.where((p < lo) | (p > hi), p, 0.3)
+            x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                 / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where((p < lo) | (p > hi), x, u)
+
+        return apply("cb.rsample", f, self.probs)
+
+    def log_prob(self, value):
+        def f(v, p):
+            eps = 1e-6
+            pc = jnp.clip(p, eps, 1 - eps)
+            return (jsp.xlogy(v, pc) + jsp.xlog1py(1 - v, -pc)
+                    + jnp.log(self._norm_const(pc)))
+
+        return apply("cb.log_prob", f, value, self.probs)
+
+
+class Beta(ExponentialFamily):
+    """Beta(alpha, beta). Ref: python/paddle/distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        super().__init__(_bshape(self.alpha._value, self.beta._value))
+
+    @property
+    def mean(self):
+        return apply("beta.mean", lambda a, b: a / (a + b),
+                     self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        def f(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+
+        return apply("beta.var", f, self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        k1, k2 = jax.random.split(key)
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+
+        return apply("beta.rsample", f, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return (jsp.xlogy(a - 1, v) + jsp.xlog1py(b - 1, -v)
+                    - jsp.betaln(a, b))
+
+        return apply("beta.log_prob", f, value, self.alpha, self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            s = a + b
+            return (jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b) + (s - 2) * jsp.digamma(s))
+
+        return apply("beta.entropy", f, self.alpha, self.beta)
+
+
+class Gamma(ExponentialFamily):
+    """Gamma(concentration, rate). Ref: python/paddle/distribution/gamma.py."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        super().__init__(
+            _bshape(self.concentration._value, self.rate._value))
+
+    @property
+    def mean(self):
+        return apply("gamma.mean", lambda c, r: c / r,
+                     self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply("gamma.var", lambda c, r: c / (r * r),
+                     self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(c, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape))
+            return g / r
+
+        return apply("gamma.rsample", f, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        def f(v, c, r):
+            return (jsp.xlogy(c, r) + jsp.xlogy(c - 1, v) - r * v
+                    - jsp.gammaln(c))
+
+        return apply("gamma.log_prob", f, value, self.concentration, self.rate)
+
+    # entropy comes from the ExponentialFamily Bregman identity — Gamma is
+    # the subclass that exercises that path (natural params (c-1, -r),
+    # log-normalizer gammaln(c) - c*log(r))
+    @property
+    def _natural_parameters(self):
+        return (self.concentration - 1.0, -self.rate)
+
+    def _log_normalizer(self, n1, n2):
+        return jsp.gammaln(n1 + 1) - (n1 + 1) * jnp.log(-n2)
+
+
+class Exponential(ExponentialFamily):
+    """Exp(rate). Ref: python/paddle/distribution/exponential.py."""
+
+    def __init__(self, rate):
+        self.rate = _param(rate)
+        super().__init__(jnp.shape(self.rate._value))
+
+    @property
+    def mean(self):
+        return apply("exp.mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply("exp.var", lambda r: 1.0 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(r):
+            e = jax.random.exponential(key, out_shape, jnp.result_type(float))
+            return e / r
+
+        return apply("exp.rsample", f, self.rate)
+
+    def log_prob(self, value):
+        def f(v, r):
+            return jnp.where(v >= 0, jnp.log(r) - r * v, -jnp.inf)
+
+        return apply("exp.log_prob", f, value, self.rate)
+
+    def entropy(self):
+        return apply("exp.entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        def f(v, r):
+            return jnp.where(v >= 0, 1 - jnp.exp(-r * v), 0.0)
+
+        return apply("exp.cdf", f, value, self.rate)
+
+
+class Laplace(Distribution):
+    """Laplace(loc, scale). Ref: python/paddle/distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc._value, self.scale._value))
+
+    @property
+    def mean(self):
+        return apply("laplace.mean", lambda l, s: jnp.broadcast_to(
+            l, _bshape(l, s)), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("laplace.var", lambda l, s: jnp.broadcast_to(
+            2 * s * s, _bshape(l, s)), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, out_shape, jnp.result_type(float),
+                                   minval=-0.5 + 1e-7, maxval=0.5)
+            return l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return apply("laplace.rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+
+        return apply("laplace.log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        def f(l, s):
+            return jnp.broadcast_to(1 + jnp.log(2 * s), _bshape(l, s))
+
+        return apply("laplace.entropy", f, self.loc, self.scale)
+
+    def cdf(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return apply("laplace.cdf", f, value, self.loc, self.scale)
+
+    def icdf(self, value):
+        def f(v, l, s):
+            t = v - 0.5
+            return l - s * jnp.sign(t) * jnp.log1p(-2 * jnp.abs(t))
+
+        return apply("laplace.icdf", f, value, self.loc, self.scale)
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale). Ref: python/paddle/distribution/gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc._value, self.scale._value))
+
+    @property
+    def mean(self):
+        return apply("gumbel.mean", lambda l, s: l + s * _EULER,
+                     self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("gumbel.var",
+                     lambda l, s: jnp.broadcast_to(
+                         (math.pi ** 2 / 6.0) * s * s, _bshape(l, s)),
+                     self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            g = jax.random.gumbel(key, out_shape, jnp.result_type(float))
+            return l + s * g
+
+        return apply("gumbel.rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply("gumbel.log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        def f(l, s):
+            return jnp.broadcast_to(jnp.log(s) + 1 + _EULER, _bshape(l, s))
+
+        return apply("gumbel.entropy", f, self.loc, self.scale)
+
+    def cdf(self, value):
+        def f(v, l, s):
+            return jnp.exp(-jnp.exp(-(v - l) / s))
+
+        return apply("gumbel.cdf", f, value, self.loc, self.scale)
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale). Ref: python/paddle/distribution/cauchy.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc._value, self.scale._value))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(l, s):
+            c = jax.random.cauchy(key, out_shape, jnp.result_type(float))
+            return l + s * c
+
+        return apply("cauchy.rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+
+        return apply("cauchy.log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        def f(l, s):
+            return jnp.broadcast_to(
+                jnp.log(4 * math.pi * s), _bshape(l, s))
+
+        return apply("cauchy.entropy", f, self.loc, self.scale)
+
+    def cdf(self, value):
+        def f(v, l, s):
+            return jnp.arctan((v - l) / s) / math.pi + 0.5
+
+        return apply("cauchy.cdf", f, value, self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    """LogNormal(loc, scale) = exp(Normal).
+    Ref: python/paddle/distribution/lognormal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(_bshape(self.loc._value, self.scale._value))
+
+    @property
+    def mean(self):
+        return apply("lognormal.mean",
+                     lambda l, s: jnp.exp(l + s * s / 2),
+                     self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def f(l, s):
+            s2 = s * s
+            return jnp.expm1(s2) * jnp.exp(2 * l + s2)
+
+        return apply("lognormal.var", f, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return apply("lognormal.exp", jnp.exp, base)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (jnp.log(v) - l) / s
+            return -0.5 * z * z - jnp.log(s * v) - _LOG_SQRT_2PI
+
+        return apply("lognormal.log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        def f(l, s):
+            return jnp.broadcast_to(
+                l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                _bshape(l, s))
+
+        return apply("lognormal.entropy", f, self.loc, self.scale)
+
+
+class Poisson(ExponentialFamily):
+    """Poisson(rate). Ref: python/paddle/distribution/poisson.py."""
+
+    def __init__(self, rate):
+        self.rate = _param(rate)
+        super().__init__(jnp.shape(self.rate._value))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(r):
+            return jax.random.poisson(
+                key, r, out_shape).astype(jnp.result_type(float))
+
+        return apply("poisson.sample", f, self.rate).detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, r):
+            return jsp.xlogy(v, r) - r - jsp.gammaln(v + 1)
+
+        return apply("poisson.log_prob", f, value, self.rate)
+
+    def entropy(self):
+        # truncated series over a support window sized to the rate
+        # (rate + 12*sqrt(rate) covers ~12 sigma; window must be static,
+        # so it comes from the concrete rate — under tracing fall back to
+        # a generous fixed bound)
+        def f(r):
+            try:
+                hi = float(jnp.max(r))
+                window = int(hi + 12.0 * math.sqrt(max(hi, 1.0))) + 16
+            except (jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError):
+                window = 1024
+            n = jnp.arange(0.0, float(window))
+            shape = jnp.shape(r)
+            rr = jnp.reshape(r, (-1, 1))
+            lp = jsp.xlogy(n, rr) - rr - jsp.gammaln(n + 1)
+            ent = -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+            return jnp.reshape(ent, shape)
+
+        return apply("poisson.entropy", f, self.rate)
+
+
+class Geometric(Distribution):
+    """Geometric(probs), support {0, 1, 2, ...}.
+    Ref: python/paddle/distribution/geometric.py."""
+
+    def __init__(self, probs):
+        self.probs = _param(probs)
+        super().__init__(jnp.shape(self.probs._value))
+
+    @property
+    def mean(self):
+        return apply("geom.mean", lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return apply("geom.var", lambda p: (1 - p) / (p * p), self.probs)
+
+    @property
+    def stddev(self):
+        return apply("geom.std", lambda p: jnp.sqrt(1 - p) / p, self.probs)
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, jnp.result_type(float),
+                                   minval=jnp.finfo(jnp.float32).tiny)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return apply("geom.sample", f, self.probs).detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            return jsp.xlog1py(v, -p) + jnp.log(p)
+
+        return apply("geom.log_prob", f, value, self.probs)
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(jsp.xlogy(q, q) + jsp.xlogy(p, p)) / p
+
+        return apply("geom.entropy", f, self.probs)
+
+    def cdf(self, value):
+        def f(v, p):
+            return 1 - jnp.power(1 - p, jnp.floor(v) + 1)
+
+        return apply("geom.cdf", f, value, self.probs)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs).
+    Ref: python/paddle/distribution/binomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = _param(total_count)
+        self.probs = _param(probs)
+        super().__init__(
+            _bshape(self.total_count._value, self.probs._value))
+
+    @property
+    def mean(self):
+        return apply("binom.mean", lambda n, p: n * p,
+                     self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return apply("binom.var", lambda n, p: n * p * (1 - p),
+                     self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(n, p):
+            return jax.random.binomial(
+                key, n.astype(jnp.float32), p,
+                out_shape).astype(jnp.result_type(float))
+
+        return apply("binom.sample", f, self.total_count, self.probs).detach()
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+            return logc + jsp.xlogy(v, p) + jsp.xlog1py(n - v, -p)
+
+        return apply("binom.log_prob", f, value, self.total_count, self.probs)
+
+    def entropy(self):
+        def f(n, p):
+            nmax = int(jnp.max(n)) if jnp.ndim(n) else int(n)
+            k = jnp.arange(0.0, nmax + 1.0)
+            shape = _bshape(n, p)
+            nn = jnp.reshape(jnp.broadcast_to(n, shape), (-1, 1))
+            pp = jnp.reshape(jnp.broadcast_to(p, shape), (-1, 1))
+            logc = (jsp.gammaln(nn + 1) - jsp.gammaln(k + 1)
+                    - jsp.gammaln(nn - k + 1))
+            lp = logc + jsp.xlogy(k, pp) + jsp.xlog1py(nn - k, -pp)
+            lp = jnp.where(k <= nn, lp, -jnp.inf)
+            ent = -jnp.sum(jnp.where(jnp.isfinite(lp), jnp.exp(lp) * lp, 0.0),
+                           axis=-1)
+            return jnp.reshape(ent, shape)
+
+        return apply("binom.entropy", f, self.total_count, self.probs)
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale). Ref: python/paddle/distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.df._value, self.loc._value,
+                                 self.scale._value))
+
+    @property
+    def mean(self):
+        def f(df, l, s):
+            return jnp.where(df > 1, jnp.broadcast_to(l, _bshape(df, l, s)),
+                             jnp.nan)
+
+        return apply("t.mean", f, self.df, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def f(df, l, s):
+            v = jnp.where(df > 2, s * s * df / (df - 2), jnp.inf)
+            return jnp.where(df > 1, v, jnp.nan)
+
+        return apply("t.var", f, self.df, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(df, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(df, out_shape),
+                             dtype=jnp.result_type(float))
+            return l + s * t
+
+        return apply("t.rsample", f, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, df, l, s):
+            z = (v - l) / s
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return apply("t.log_prob", f, value, self.df, self.loc, self.scale)
+
+    def entropy(self):
+        def f(df, l, s):
+            h = ((df + 1) / 2 * (jsp.digamma((df + 1) / 2)
+                                 - jsp.digamma(df / 2))
+                 + 0.5 * jnp.log(df) + jsp.betaln(df / 2, 0.5) + jnp.log(s))
+            return jnp.broadcast_to(h, _bshape(df, l, s))
+
+        return apply("t.entropy", f, self.df, self.loc, self.scale)

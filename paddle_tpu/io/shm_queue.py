@@ -26,6 +26,8 @@ class ShmQueue:
         if not self.ring:
             raise OSError(f"shm ring setup failed for {self.name}")
         self.slot_size = int(self.lib.shm_ring_slot_size(self.ring))
+        self._buf = None  # lazy reusable pop buffer (hot path: no per-pop
+                          # slot_size alloc+memset)
 
     def attach(self):
         return ShmQueue(name=self.name, create=False)
@@ -46,14 +48,15 @@ class ShmQueue:
     def get(self, timeout=60.0):
         import ctypes
 
-        buf = ctypes.create_string_buffer(self.slot_size)
-        n = self.lib.shm_ring_pop(self.ring, buf, self.slot_size,
+        if self._buf is None:
+            self._buf = ctypes.create_string_buffer(self.slot_size)
+        n = self.lib.shm_ring_pop(self.ring, self._buf, self.slot_size,
                                   float(timeout))
         if n == -1:
             raise TimeoutError("shm pop timeout")
         if n == -2:
             raise EOFError("shm ring closed and drained")
-        return pickle.loads(buf.raw[:n])
+        return pickle.loads(self._buf.raw[:n])
 
     def qsize(self):
         return int(self.lib.shm_ring_count(self.ring))
@@ -73,18 +76,26 @@ class ShmQueue:
 
 def _worker_main(dataset, batches, indices, collate_path, queue_name,
                  worker_init_fn, wid):
-    """Spawned worker entry: fetch+collate assigned batches into the ring."""
+    """Spawned worker entry: fetch+collate assigned batches into the ring.
+    Exceptions are shipped back through the ring (index -1) so the parent
+    surfaces the real dataset error instead of timing out."""
     import importlib
+    import traceback
 
-    mod_name, fn_name = collate_path
-    collate_fn = getattr(importlib.import_module(mod_name), fn_name)
     q = ShmQueue(name=queue_name, create=False)
-    if worker_init_fn is not None:
-        worker_init_fn(wid)
-    for i in indices:
-        samples = [dataset[j] for j in batches[i]]
-        payload = _to_numpy_tree(collate_fn(samples))
-        q.put((i, payload))
+    try:
+        mod_name, fn_name = collate_path
+        collate_fn = getattr(importlib.import_module(mod_name), fn_name)
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        for i in indices:
+            samples = [dataset[j] for j in batches[i]]
+            payload = _to_numpy_tree(collate_fn(samples))
+            q.put((i, payload))
+    except Exception:
+        q.put((-1, f"DataLoader worker {wid} died:\n"
+                   f"{traceback.format_exc()}"))
+        raise
 
 
 def run_process_workers(dataset, batches, collate_fn, num_workers,
@@ -96,6 +107,9 @@ def run_process_workers(dataset, batches, collate_fn, num_workers,
     keeps the multithreaded jax runtime safe."""
     import multiprocessing as mp
 
+    # validation + native load + spawn happen eagerly at call time (NOT
+    # inside the generator) so DataLoader.__iter__ can catch OSError /
+    # ValueError and fall back to thread workers
     collate_path = (collate_fn.__module__, collate_fn.__qualname__)
     if "." in collate_path[1] or "<" in collate_path[1]:
         raise ValueError(
@@ -127,12 +141,29 @@ def run_process_workers(dataset, batches, collate_fn, num_workers,
             else:
                 os.environ[k] = v
 
+    return _consume(q, procs, n)
+
+
+def _consume(q, procs, n):
     pending = {}
     next_idx = 0
     received = 0
     try:
         while received < n:
-            i, payload = q.get(timeout=300.0)
+            try:
+                # short poll so worker death is noticed promptly
+                i, payload = q.get(timeout=5.0)
+            except TimeoutError:
+                dead = [p for p in procs
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                if dead and q.qsize() == 0:
+                    raise RuntimeError(
+                        f"DataLoader worker(s) "
+                        f"{[p.pid for p in dead]} exited with "
+                        f"{[p.exitcode for p in dead]} before finishing")
+                continue
+            if i == -1:  # worker shipped its traceback
+                raise RuntimeError(payload)
             pending[i] = payload
             received += 1
             while next_idx in pending:

@@ -18,23 +18,52 @@ _lock = threading.Lock()
 _lib = None
 
 
+_SRCS = ("shm_ring.cc", "tcp_store.cc")
+_HASH_FILE = os.path.join(_BUILD, ".srchash")
+
+
+def _src_hash():
+    import hashlib
+
+    h = hashlib.sha256()
+    for f in _SRCS:
+        with open(os.path.join(_HERE, "src", f), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
 def _compile():
+    # N launcher ranks may hit a cold build dir at once: serialize across
+    # processes with an fcntl lock and publish via atomic rename so no
+    # process ever CDLLs a half-written .so
+    import fcntl
+
     os.makedirs(_BUILD, exist_ok=True)
-    srcs = [os.path.join(_HERE, "src", f)
-            for f in ("shm_ring.cc", "tcp_store.cc")]
-    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-           "-o", _SO] + srcs + ["-lrt"]
-    subprocess.run(cmd, check=True, capture_output=True)
+    with open(os.path.join(_BUILD, ".buildlock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if not _stale():  # another process built it while we waited
+                return
+            tmp = f"{_SO}.tmp.{os.getpid()}"
+            srcs = [os.path.join(_HERE, "src", f) for f in _SRCS]
+            cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                   "-pthread", "-o", tmp] + srcs + ["-lrt"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.rename(tmp, _SO)
+            with open(_HASH_FILE + ".tmp", "w") as fh:
+                fh.write(_src_hash())
+            os.rename(_HASH_FILE + ".tmp", _HASH_FILE)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 def _stale():
-    if not os.path.exists(_SO):
+    # content hash, not mtime: a fresh clone gives src/ and any cached .so
+    # near-identical mtimes, and the binary is never committed
+    if not os.path.exists(_SO) or not os.path.exists(_HASH_FILE):
         return True
-    so_m = os.path.getmtime(_SO)
-    for f in os.listdir(os.path.join(_HERE, "src")):
-        if os.path.getmtime(os.path.join(_HERE, "src", f)) > so_m:
-            return True
-    return False
+    with open(_HASH_FILE) as fh:
+        return fh.read().strip() != _src_hash()
 
 
 def load():

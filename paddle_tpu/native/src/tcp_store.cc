@@ -38,6 +38,8 @@ struct Server {
   std::condition_variable cv;
   std::map<std::string, std::vector<char>> kv;
   std::vector<std::thread> handlers;
+  std::vector<int> client_fds;  // guarded by mu; shutdown() on stop unblocks
+                                // handlers stuck in recv so they can be joined
 };
 
 bool read_all(int fd, void* buf, size_t n) {
@@ -133,6 +135,17 @@ void handle_client(Server* s, int fd) {
       break;
     }
   }
+  {
+    // deregister before close: fd numbers get reused by the process, and
+    // server_stop must never shutdown() an unrelated descriptor
+    std::lock_guard<std::mutex> g(s->mu);
+    for (auto it = s->client_fds.begin(); it != s->client_fds.end(); ++it) {
+      if (*it == fd) {
+        s->client_fds.erase(it);
+        break;
+      }
+    }
+  }
   close(fd);
 }
 
@@ -164,6 +177,10 @@ void* tcp_store_server_start(uint16_t port) {
     while (!s->stop.load()) {
       int fd = accept(s->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        s->client_fds.push_back(fd);
+      }
       s->handlers.emplace_back(handle_client, s, fd);
     }
   });
@@ -177,8 +194,13 @@ void tcp_store_server_stop(void* handle) {
   shutdown(s->listen_fd, SHUT_RDWR);
   close(s->listen_fd);
   if (s->loop.joinable()) s->loop.join();
+  {
+    // unblock handlers stuck in recv(); they close their own fds on exit
+    std::lock_guard<std::mutex> g(s->mu);
+    for (int fd : s->client_fds) shutdown(fd, SHUT_RDWR);
+  }
   for (auto& t : s->handlers) {
-    if (t.joinable()) t.detach();  // blocked GETs unblock via stop+notify
+    if (t.joinable()) t.join();
   }
   delete s;
 }
@@ -186,24 +208,26 @@ void tcp_store_server_stop(void* handle) {
 // ---- client ----
 
 int tcp_store_connect(const char* ip, uint16_t port, double timeout_s) {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return -1;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
   inet_pton(AF_INET, ip, &addr.sin_addr);
   double waited = 0;
-  while (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
-    if (waited >= timeout_s) {
-      close(fd);
-      return -1;
+  for (;;) {
+    // a stream socket is in unspecified state after a failed connect();
+    // every retry needs a fresh fd (POSIX connect(2))
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
     }
+    close(fd);
+    if (waited >= timeout_s) return -1;
     usleep(100000);
     waited += 0.1;
   }
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  return fd;
 }
 
 static bool send_req(int fd, uint8_t op, const char* key, uint32_t klen,

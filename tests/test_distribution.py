@@ -1,0 +1,360 @@
+"""Distribution package: stats vs scipy, sampling moments, KL, transforms,
+gradient flow through log_prob/rsample (reference test model:
+test/distribution/ parameterized scipy-comparison suite)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as P
+from paddle_tpu import distribution as D
+
+
+def a(t):
+    return np.asarray(t.numpy(), np.float64)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    P.seed(1234)
+
+
+class TestScipyParity:
+    def test_normal(self):
+        d = D.Normal(1.5, 2.0)
+        x = np.array([0.3, 1.5, 4.0])
+        ref = st.norm(1.5, 2.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(a(d.cdf(P.to_tensor(x))), ref.cdf(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            a(d.icdf(P.to_tensor(np.array([0.1, 0.5, 0.9], np.float32)))),
+            ref.ppf([0.1, 0.5, 0.9]), rtol=1e-4)
+
+    def test_uniform(self):
+        d = D.Uniform(-1.0, 3.0)
+        x = np.array([-0.5, 0.0, 2.9])
+        ref = st.uniform(-1.0, 4.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-5)
+
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        x = np.array([0.1, 0.5, 0.9])
+        ref = st.beta(2.0, 3.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(float(d.mean), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(d.variance), ref.var(), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-4)
+
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        x = np.array([0.5, 1.5, 4.0])
+        ref = st.gamma(3.0, scale=0.5)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-4)
+
+    def test_laplace(self):
+        d = D.Laplace(0.5, 2.0)
+        x = np.array([-1.0, 0.5, 3.0])
+        ref = st.laplace(0.5, 2.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(a(d.cdf(P.to_tensor(x))), ref.cdf(x),
+                                   rtol=1e-5)
+
+    def test_gumbel(self):
+        d = D.Gumbel(1.0, 2.0)
+        x = np.array([0.0, 1.0, 5.0])
+        ref = st.gumbel_r(1.0, 2.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(d.mean), ref.mean(), rtol=1e-5)
+
+    def test_cauchy(self):
+        d = D.Cauchy(0.0, 1.5)
+        x = np.array([-2.0, 0.0, 2.0])
+        ref = st.cauchy(0.0, 1.5)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-5)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.5, 0.8)
+        x = np.array([0.5, 1.0, 3.0])
+        ref = st.lognorm(0.8, scale=np.exp(0.5))
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(float(d.mean), ref.mean(), rtol=1e-5)
+
+    def test_exponential(self):
+        d = D.Exponential(2.0)
+        x = np.array([0.1, 1.0, 3.0])
+        ref = st.expon(scale=0.5)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+
+    def test_studentt(self):
+        d = D.StudentT(5.0, 1.0, 2.0)
+        x = np.array([-1.0, 1.0, 4.0])
+        ref = st.t(5.0, 1.0, 2.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-4)
+
+    def test_poisson(self):
+        d = D.Poisson(3.0)
+        x = np.array([0.0, 2.0, 5.0])
+        ref = st.poisson(3.0)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpmf(x.astype(int)), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-3)
+        # large rate: the series window must scale with the rate
+        np.testing.assert_allclose(float(D.Poisson(100.0).entropy()),
+                                   st.poisson(100.0).entropy(), rtol=1e-3)
+
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        np.testing.assert_allclose(float(d.log_prob(P.to_tensor(1.0))),
+                                   np.log(0.3), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   st.bernoulli(0.3).entropy(), rtol=1e-5)
+
+    def test_geometric(self):
+        d = D.Geometric(0.4)
+        x = np.array([0.0, 1.0, 4.0])
+        # scipy geom counts trials (support 1..), ours counts failures (0..)
+        ref = st.geom(0.4, loc=-1)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpmf(x), rtol=1e-5)
+
+    def test_binomial(self):
+        d = D.Binomial(10.0, 0.3)
+        x = np.array([0.0, 3.0, 10.0])
+        ref = st.binom(10, 0.3)
+        np.testing.assert_allclose(a(d.log_prob(P.to_tensor(x))),
+                                   ref.logpmf(x.astype(int)), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-3)
+
+    def test_dirichlet(self):
+        c = np.array([1.0, 2.0, 3.0])
+        d = D.Dirichlet(c)
+        x = np.array([0.2, 0.3, 0.5])
+        ref = st.dirichlet(c)
+        np.testing.assert_allclose(float(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-4)
+
+    def test_mvn(self):
+        mu = np.array([1.0, -1.0])
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]])
+        d = D.MultivariateNormal(mu, covariance_matrix=cov)
+        x = np.array([0.5, 0.0])
+        ref = st.multivariate_normal(mu, cov)
+        np.testing.assert_allclose(float(d.log_prob(P.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()), ref.entropy(),
+                                   rtol=1e-5)
+
+
+class TestSampling:
+    @pytest.mark.parametrize("dist,mean,std", [
+        (lambda: D.Normal(2.0, 1.5), 2.0, 1.5),
+        (lambda: D.Uniform(0.0, 4.0), 2.0, 4.0 / np.sqrt(12)),
+        (lambda: D.Exponential(0.5), 2.0, 2.0),
+        (lambda: D.Laplace(1.0, 1.0), 1.0, np.sqrt(2)),
+        (lambda: D.Gamma(4.0, 2.0), 2.0, 1.0),
+    ])
+    def test_moments(self, dist, mean, std):
+        s = a(dist().sample((20000,)))
+        assert abs(s.mean() - mean) < 0.1 * max(1.0, abs(mean))
+        assert abs(s.std() - std) < 0.12 * std
+
+    def test_categorical_freqs(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = D.Categorical(logits)
+        s = a(d.sample((20000,)))
+        freq = np.bincount(s.astype(int), minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_multinomial_counts(self):
+        d = D.Multinomial(100, np.array([0.2, 0.8], np.float32))
+        s = a(d.sample((500,)))
+        assert s.shape == (500, 2)
+        np.testing.assert_allclose(s.sum(-1), 100.0)
+        np.testing.assert_allclose(s.mean(0), [20, 80], rtol=0.1)
+
+    def test_dirichlet_simplex(self):
+        d = D.Dirichlet(np.array([2.0, 3.0, 4.0], np.float32))
+        s = a(d.sample((1000,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s.mean(0), np.array([2, 3, 4]) / 9.0,
+                                   atol=0.03)
+
+    def test_mvn_sample_cov(self):
+        mu = np.array([0.0, 1.0])
+        cov = np.array([[1.0, 0.6], [0.6, 2.0]])
+        d = D.MultivariateNormal(mu, covariance_matrix=cov)
+        s = a(d.sample((30000,)))
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.08)
+
+
+class TestKL:
+    def test_kl_normal_vs_mc(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(D.kl_divergence(p, q))
+        s = p.sample((100000,))
+        mc = float((p.log_prob(s) - q.log_prob(s)).mean())
+        assert abs(kl - mc) < 0.02
+
+    def test_kl_registry_pairs(self):
+        pairs = [
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+            (D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0)),
+            (D.Exponential(1.0), D.Exponential(2.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+            (D.Bernoulli(0.3), D.Bernoulli(0.6)),
+            (D.Geometric(0.3), D.Geometric(0.5)),
+            (D.Dirichlet(np.array([1.0, 2.0])),
+             D.Dirichlet(np.array([2.0, 1.0]))),
+            (D.Categorical(np.array([0.1, 0.9], np.float32)),
+             D.Categorical(np.array([0.5, 0.5], np.float32))),
+        ]
+        for p, q in pairs:
+            kl = a(D.kl_divergence(p, q))
+            assert np.all(kl >= -1e-5), type(p).__name__
+            assert np.all(np.isfinite(kl)), type(p).__name__
+        # KL(p, p) == 0
+        p = D.Normal(np.array([0.0, 1.0]), np.array([1.0, 2.0]))
+        np.testing.assert_allclose(a(D.kl_divergence(p, p)), 0.0, atol=1e-6)
+
+    def test_kl_mvn(self):
+        p = D.MultivariateNormal(np.zeros(2), covariance_matrix=np.eye(2))
+        q = D.MultivariateNormal(np.ones(2),
+                                 covariance_matrix=2 * np.eye(2))
+        # closed form for diagonal case
+        expect = 0.5 * (2 * 0.5 + 2 * 0.5 - 2 + 2 * np.log(2.0))
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), expect,
+                                   rtol=1e-5)
+
+
+class TestTransforms:
+    def test_exp_roundtrip(self):
+        t = D.ExpTransform()
+        x = P.to_tensor(np.array([0.1, 1.0, -0.5], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(a(t.inverse(y)), a(x), rtol=1e-5)
+        np.testing.assert_allclose(a(t.forward_log_det_jacobian(x)), a(x))
+
+    def test_affine_sigmoid_tanh(self):
+        x = P.to_tensor(np.array([-0.9, 0.0, 0.9], np.float32))
+        for t in [D.AffineTransform(1.0, 2.0), D.SigmoidTransform(),
+                  D.TanhTransform()]:
+            y = t.forward(x)
+            np.testing.assert_allclose(a(t.inverse(y)), a(x), rtol=1e-4,
+                                       atol=1e-5)
+            # ldj vs numeric derivative
+            eps = 1e-4
+            xp = P.to_tensor(a(x) + eps)
+            num = (a(t.forward(xp)) - a(y)) / eps
+            np.testing.assert_allclose(a(t.forward_log_det_jacobian(x)),
+                                       np.log(np.abs(num)), atol=1e-2)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = P.to_tensor(np.array([0.2, -0.3, 0.5], np.float32))
+        y = t.forward(x)
+        assert a(y).shape == (4,)
+        np.testing.assert_allclose(a(y).sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(a(t.inverse(y)), a(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_chain_mixed_event_rank_ldj(self):
+        # elementwise Affine inside an event-rank-1 chain: its per-element
+        # ldj must be summed over the event axis, giving a scalar total
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                              D.StickBreakingTransform()])
+        x = P.to_tensor(np.array([0.2, -0.3, 0.5], np.float32))
+        ldj = t.forward_log_det_jacobian(x)
+        assert ldj.shape == []
+        sb = D.StickBreakingTransform()
+        x2 = D.AffineTransform(0.0, 2.0).forward(x)
+        expect = 3 * np.log(2.0) + float(sb.forward_log_det_jacobian(x2))
+        np.testing.assert_allclose(float(ldj), expect, rtol=1e-5)
+
+    def test_reshape_transformed_event_shape(self):
+        base = D.Independent(
+            D.Normal(np.zeros(6, np.float32), np.ones(6, np.float32)), 1)
+        td = D.TransformedDistribution(
+            base, [D.ReshapeTransform((6,), (2, 3))])
+        assert td.batch_shape == ()
+        assert td.event_shape == (2, 3)
+        x = P.to_tensor(np.zeros((2, 3), np.float32))
+        np.testing.assert_allclose(
+            float(td.log_prob(x)),
+            float(base.log_prob(P.to_tensor(np.zeros(6, np.float32)))),
+            rtol=1e-6)
+
+    def test_chain_and_shapes(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = P.to_tensor(np.array([0.5], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(a(y), np.exp(2 * 0.5), rtol=1e-5)
+        np.testing.assert_allclose(a(t.inverse(y)), a(x), rtol=1e-5)
+        r = D.ReshapeTransform((2, 3), (6,))
+        z = P.to_tensor(np.zeros((4, 2, 3), np.float32))
+        assert a(r.forward(z)).shape == (4, 6)
+
+    def test_transformed_distribution(self):
+        # LogNormal == exp(Normal) via TransformedDistribution
+        base = D.Normal(0.5, 0.8)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = D.LogNormal(0.5, 0.8)
+        x = P.to_tensor(np.array([0.5, 1.5], np.float32))
+        np.testing.assert_allclose(a(td.log_prob(x)), a(ref.log_prob(x)),
+                                   rtol=1e-5)
+        s = a(td.sample((5000,)))
+        assert abs(np.log(s).mean() - 0.5) < 0.05
+
+
+class TestGradients:
+    def test_logprob_grad(self):
+        loc = P.to_tensor(0.5, stop_gradient=False)
+        scale = P.to_tensor(2.0, stop_gradient=False)
+        d = D.Normal(loc, scale)
+        lp = d.log_prob(P.to_tensor(1.5))
+        lp.backward()
+        # d/dloc logN = (x-loc)/scale^2
+        np.testing.assert_allclose(float(loc.grad), 1.0 / 4.0, rtol=1e-5)
+
+    def test_rsample_pathwise_grad(self):
+        loc = P.to_tensor(0.0, stop_gradient=False)
+        d = D.Normal(loc, 1.0)
+        s = d.rsample((256,))
+        assert not s.stop_gradient
+        s.mean().backward()
+        np.testing.assert_allclose(float(loc.grad), 1.0, rtol=1e-5)
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (4,)
+        x = P.to_tensor(np.zeros((3, 4), np.float32))
+        np.testing.assert_allclose(a(ind.log_prob(x)),
+                                   a(base.log_prob(x)).sum(-1), rtol=1e-6)

@@ -113,3 +113,46 @@ def test_tcp_store():
     t1.join(5)
     t2.join(5)
     assert not errs
+
+    # barrier is reusable: a second round on the same key must still
+    # synchronize (regression: count/go keys were single-use)
+    order = []
+
+    def b2(store, tag):
+        store.barrier("b1", world_size=2)
+        order.append(tag)
+
+    t3 = threading.Thread(target=b2, args=(master, "m"))
+    t3.start()
+    time.sleep(0.3)
+    assert not order, "barrier round 2 passed with only 1/2 arrivals"
+    b2(client, "c")
+    t3.join(5)
+    assert sorted(order) == ["c", "m"]
+
+    # get() on a missing key honors the timeout instead of hanging
+    with pytest.raises(TimeoutError):
+        client.get("never-set", timeout=0.5)
+
+
+class _BrokenDataset:
+    """Module-level so spawn workers can unpickle it."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise IndexError("poisoned sample 5")
+        return np.zeros(3, np.float32)
+
+
+def test_worker_error_surfaces():
+    from paddle_tpu.io.dataloader import default_collate_fn
+    from paddle_tpu.io.shm_queue import run_process_workers
+
+    batches = [[0, 1], [4, 5]]
+    with pytest.raises(RuntimeError, match="poisoned sample 5"):
+        list(run_process_workers(_BrokenDataset(), batches,
+                                 default_collate_fn,
+                                 num_workers=1, slot_size=1 << 20))
