@@ -26,6 +26,14 @@ from .ops import *  # noqa: F401,F403
 from .ops import is_tensor, add_n, accuracy  # noqa: F401
 from .ops.manipulation import shape_op as shape  # noqa: F401
 
+# `from .ops import *` leaks the op-submodule names (ops.linalg etc.) into
+# this namespace; drop them so `paddle_tpu.linalg` resolves to the dedicated
+# namespace module below, as `paddle.linalg` does in the reference.
+for _leak in ("creation", "math", "reduction", "manipulation", "linalg",
+              "logic"):
+    globals().pop(_leak, None)
+del _leak
+
 from . import amp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import device  # noqa: F401
@@ -44,7 +52,8 @@ def __getattr__(name):
 
     lazy = {"distributed", "hapi", "incubate", "models", "profiler",
             "distribution", "sparse", "text", "audio", "quantization",
-            "geometric"}
+            "geometric", "fft", "signal", "linalg", "regularizer",
+            "static", "inference", "onnx", "utils", "sysconfig", "hub"}
     if name in lazy:
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

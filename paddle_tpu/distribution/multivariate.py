@@ -126,7 +126,8 @@ class Multinomial(Distribution):
 
         def f(p):
             k = p.shape[-1]
-            if n * k > 4096:
+            # enumeration visits (n+1)**k tuples; bound that, not n*k
+            if (n + 1) ** k > 4096:
                 raise NotImplementedError(
                     "Multinomial.entropy: support too large to enumerate")
             import itertools

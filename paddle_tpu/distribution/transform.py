@@ -147,6 +147,13 @@ class PowerTransform(Transform):
 
         return apply("Power.fldj", f, x, self.power)
 
+    def inverse_log_det_jacobian(self, y):
+        def f(yv, p):
+            xv = jnp.power(yv, 1.0 / p)
+            return -jnp.log(jnp.abs(p * jnp.power(xv, p - 1)))
+
+        return apply("Power.ildj", f, y, self.power)
+
 
 class SigmoidTransform(Transform):
     def _forward(self, x):

@@ -56,7 +56,7 @@ class ShmQueue:
             raise TimeoutError("shm pop timeout")
         if n == -2:
             raise EOFError("shm ring closed and drained")
-        return pickle.loads(self._buf.raw[:n])
+        return pickle.loads(ctypes.string_at(self._buf, n))
 
     def qsize(self):
         return int(self.lib.shm_ring_count(self.ring))
@@ -144,23 +144,36 @@ def run_process_workers(dataset, batches, collate_fn, num_workers,
     return _consume(q, procs, n)
 
 
-def _consume(q, procs, n):
+def _consume(q, procs, n, deadline_s=300.0):
+    import time
+
     pending = {}
     next_idx = 0
     received = 0
+    deadline = time.monotonic() + deadline_s
     try:
         while received < n:
             try:
-                # short poll so worker death is noticed promptly
+                # short poll so worker death is noticed promptly; the
+                # deadline bounds total wait even if workers stay alive
                 i, payload = q.get(timeout=5.0)
+                deadline = time.monotonic() + deadline_s
             except TimeoutError:
-                dead = [p for p in procs
-                        if not p.is_alive() and p.exitcode not in (0, None)]
-                if dead and q.qsize() == 0:
+                crashed = [p for p in procs
+                           if not p.is_alive() and p.exitcode not in (0, None)]
+                if crashed and q.qsize() == 0:
                     raise RuntimeError(
                         f"DataLoader worker(s) "
-                        f"{[p.pid for p in dead]} exited with "
-                        f"{[p.exitcode for p in dead]} before finishing")
+                        f"{[p.pid for p in crashed]} exited with "
+                        f"{[p.exitcode for p in crashed]} before finishing")
+                if q.qsize() == 0 and not any(p.is_alive() for p in procs):
+                    raise RuntimeError(
+                        f"DataLoader workers all exited but only "
+                        f"{received}/{n} batches arrived")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"DataLoader stalled: {received}/{n} batches after "
+                        f"{deadline_s:.0f}s without progress")
                 continue
             if i == -1:  # worker shipped its traceback
                 raise RuntimeError(payload)

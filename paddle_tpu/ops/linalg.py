@@ -19,6 +19,7 @@ __all__ = [
     "eig", "eigh", "eigvals", "eigvalsh", "slogdet", "det", "matrix_power",
     "multi_dot", "histogram", "histogramdd", "bincount", "cov", "corrcoef",
     "cdist", "householder_product", "matrix_exp", "vander", "vecdot",
+    "cond_number", "svdvals", "vector_norm", "matrix_norm", "ormqr",
 ]
 
 
@@ -328,3 +329,55 @@ def vander(x, n=None, increasing=False, name=None):
 @op("vecdot")
 def vecdot(x, y, axis=-1, name=None):
     return jnp.sum(x * y, axis=axis)
+
+
+@op("cond")
+def cond_number(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@op("svdvals")
+def svdvals(x, name=None):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@op("vector_norm")
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return jnp.linalg.vector_norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@op("matrix_norm")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    axis = tuple(a % x.ndim for a in axis)
+    if axis != (x.ndim - 2, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, (-2, -1))
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+@op("ormqr")
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    # Q from householder reflectors (geqrf layout), then Q@other / other@Q
+    m = x.shape[-2]
+    n = tau.shape[-1]
+
+    def build_q(a, t):
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[:, i]))
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v)
+            q = q @ h
+        return q
+
+    if x.ndim == 2:
+        q = build_q(x, tau)
+    else:
+        flat_x = x.reshape((-1,) + x.shape[-2:])
+        flat_t = tau.reshape((-1,) + tau.shape[-1:])
+        q = jax.vmap(build_q)(flat_x, flat_t).reshape(
+            x.shape[:-2] + (m, m))
+    if transpose:
+        q = jnp.swapaxes(q, -1, -2)
+    return jnp.matmul(q, other) if left else jnp.matmul(other, q)

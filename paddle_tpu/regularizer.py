@@ -1,0 +1,14 @@
+"""paddle.regularizer parity (`/root/reference/python/paddle/regularizer.py`):
+L1/L2 weight-decay descriptors consumed by the optimizer layer (which folds
+them into the jit-compiled update step rather than adding graph ops)."""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+
+class WeightDecayRegularizer:
+    """Base marker class (reference `python/paddle/regularizer.py:23`)."""
+
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]

@@ -1,0 +1,131 @@
+"""Signal processing: frame / overlap_add / stft / istft
+(paddle.signal parity: `/root/reference/python/paddle/signal.py`).
+
+TPU-first: framing is a gather with a static index grid (XLA-fusable, no
+dynamic shapes); stft = frame -> window -> batched FFT on the last axis.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_raw(x, frame_length, hop_length, axis=-1):
+    axis = axis % x.ndim
+    n = x.shape[axis]
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    frames = jnp.take(x, idx.reshape(-1), axis=axis)
+    new_shape = (x.shape[:axis] + (num_frames, frame_length)
+                 + x.shape[axis + 1:])
+    frames = frames.reshape(new_shape)
+    if axis == x.ndim - 1:
+        # reference layout: [..., frame_length, num_frames]
+        frames = jnp.swapaxes(frames, -1, -2)
+    return frames
+
+
+@op("frame")
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    return _frame_raw(x, frame_length, hop_length, axis=axis)
+
+
+def _overlap_add_raw(x, hop_length, axis=-1):
+    # reference layouts: axis=-1 -> [..., frame_length, num_frames] (result
+    # seq on last axis); axis=0 -> [num_frames, frame_length, ...] (seq first)
+    axis = axis % x.ndim
+    if axis == x.ndim - 1:
+        x = jnp.swapaxes(x, -1, -2)  # -> [..., num_frames, frame_length]
+        seq_first = False
+    else:
+        x = jnp.moveaxis(x, (0, 1), (-2, -1))  # -> [..., nf, fl]
+        seq_first = True
+    num_frames, frame_length = x.shape[-2], x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [nf, fl]
+    batch_shape = x.shape[:-2]
+    flat = x.reshape((-1, num_frames * frame_length))
+    out = jnp.zeros((flat.shape[0], out_len), dtype=x.dtype)
+    out = out.at[:, idx.reshape(-1)].add(flat)
+    out = out.reshape(batch_shape + (out_len,))
+    if seq_first:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+@op("overlap_add")
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return _overlap_add_raw(x, hop_length, axis=axis)
+
+
+@op("stft")
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), dtype=jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[None, :]
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=pad_mode)
+    frames = _frame_raw(x, n_fft, hop_length, axis=-1)  # [..., n_fft, nf]
+    frames = jnp.swapaxes(frames, -1, -2) * window  # [..., nf, n_fft]
+    if onesided and not jnp.iscomplexobj(x):
+        spec = jnp.fft.rfft(frames, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    spec = jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+    return spec[0] if squeeze else spec
+
+
+@op("istft")
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = jnp.ones((win_length,), dtype=jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    spec = jnp.swapaxes(x, -1, -2)  # [..., num_frames, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * window
+    sig = _overlap_add_raw(jnp.swapaxes(frames, -1, -2), hop_length, axis=-1)
+    # normalise by summed squared window (NOLA)
+    wsq = jnp.tile(window ** 2, (frames.shape[-2], 1))
+    norm = _overlap_add_raw(jnp.swapaxes(wsq, -1, -2), hop_length, axis=-1)
+    sig = sig / jnp.maximum(norm, 1e-11)
+    if center:
+        pad = n_fft // 2
+        sig = sig[..., pad:sig.shape[-1] - pad]
+    if length is not None:
+        sig = sig[..., :length]
+    return sig[0] if squeeze else sig

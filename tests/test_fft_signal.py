@@ -1,0 +1,121 @@
+"""fft / signal / linalg-namespace parity tests (reference:
+`python/paddle/fft.py`, `python/paddle/signal.py`; SURVEY.md §2.6)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def test_fft_roundtrip(rng):
+    x = rng.randn(4, 64).astype(np.float32)
+    t = P.to_tensor(x)
+    s = P.fft.fft(t.astype("complex64"))
+    back = P.fft.ifft(s)
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-4)
+
+
+def test_rfft_irfft_roundtrip(rng):
+    x = rng.randn(4, 64).astype(np.float32)
+    s = P.fft.rfft(P.to_tensor(x))
+    assert list(s.shape) == [4, 33]
+    back = P.fft.irfft(s)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+
+def test_fft2_matches_numpy(rng):
+    x = rng.randn(3, 8, 8).astype(np.float32)
+    out = P.fft.fft2(P.to_tensor(x).astype("complex64"))
+    np.testing.assert_allclose(out.numpy(), np.fft.fft2(x), atol=1e-3)
+
+
+def test_fftfreq_fftshift():
+    f = P.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(f.numpy(), np.fft.fftfreq(8, d=0.5), atol=1e-6)
+    x = P.to_tensor(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(
+        P.fft.fftshift(x).numpy(), np.fft.fftshift(np.arange(8)), atol=0)
+
+
+def test_hfft2_matches_scipy(rng):
+    import scipy.fft as sfft
+
+    x = (rng.randn(4, 5) + 1j * rng.randn(4, 5)).astype(np.complex64)
+    out = P.fft.hfft2(P.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), sfft.hfft2(x), atol=1e-3)
+    # ihfft2(hfft2(real)) recovers a real signal
+    r = rng.randn(4, 8).astype(np.float32)
+    spec = P.fft.ihfft2(P.to_tensor(r))
+    back = P.fft.hfft2(spec, s=r.shape)
+    np.testing.assert_allclose(back.numpy(), r, atol=1e-3)
+
+
+def test_fft_grad(rng):
+    x = P.to_tensor(rng.randn(16).astype(np.float32), stop_gradient=False)
+    y = P.fft.rfft(x)
+    loss = (y.abs() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None and x.grad.shape == [16]
+
+
+def test_frame_shapes(rng):
+    x = P.to_tensor(rng.randn(2, 100).astype(np.float32))
+    f = P.signal.frame(x, frame_length=10, hop_length=5)
+    assert list(f.shape) == [2, 10, 19]
+
+
+def test_overlap_add_inverts_frame_rect(rng):
+    # hop == frame_length -> exact reconstruction
+    x = rng.randn(2, 96).astype(np.float32)
+    f = P.signal.frame(P.to_tensor(x), frame_length=16, hop_length=16)
+    rec = P.signal.overlap_add(f, hop_length=16)
+    np.testing.assert_allclose(rec.numpy(), x, atol=1e-5)
+
+
+def test_stft_istft_roundtrip(rng):
+    x = rng.randn(2, 400).astype(np.float32)
+    win = np.hanning(64).astype(np.float32)
+    spec = P.signal.stft(P.to_tensor(x), n_fft=64, hop_length=16,
+                         window=P.to_tensor(win))
+    assert list(spec.shape) == [2, 33, 26]
+    rec = P.signal.istft(spec, n_fft=64, hop_length=16,
+                         window=P.to_tensor(win), length=400)
+    # edges lose energy; compare the interior
+    np.testing.assert_allclose(rec.numpy()[:, 48:-48], x[:, 48:-48],
+                               atol=1e-3)
+
+
+def test_linalg_namespace(rng):
+    a = rng.randn(5, 5).astype(np.float32)
+    a = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+    t = P.to_tensor(a)
+    assert float(P.linalg.cond(t).numpy()) > 0
+    sv = P.linalg.svdvals(t)
+    np.testing.assert_allclose(
+        np.sort(sv.numpy()), np.sort(np.linalg.svd(a, compute_uv=False)),
+        rtol=1e-3)
+    np.testing.assert_allclose(
+        P.linalg.vector_norm(t).numpy(), np.linalg.norm(a.ravel()), rtol=1e-4)
+    np.testing.assert_allclose(
+        P.linalg.matrix_norm(t).numpy(), np.linalg.norm(a, "fro"), rtol=1e-4)
+    L = P.linalg.cholesky(t)
+    np.testing.assert_allclose((L @ L.T).numpy(), a, atol=1e-3)
+
+
+def test_ormqr(rng):
+    a = rng.randn(4, 3).astype(np.float32)
+    other = rng.randn(4, 2).astype(np.float32)
+    import scipy.linalg as sla
+
+    (h, tau), _ = sla.qr(a, mode="raw")
+    out = P.linalg.ormqr(P.to_tensor(np.ascontiguousarray(h)),
+                         P.to_tensor(tau.astype(np.float32)),
+                         P.to_tensor(other))
+    q = sla.qr(a)[0]
+    np.testing.assert_allclose(out.numpy(), q @ other, atol=1e-3)
+
+
+def test_regularizer_namespace():
+    import paddle_tpu.regularizer as reg
+
+    assert reg.L2Decay is P.optimizer.L2Decay
+    assert issubclass(reg.L1DecayRegularizer, object)
