@@ -68,6 +68,19 @@ def __getattr__(name):
 from .framework.io_utils import load, save  # noqa: F401
 
 
+def enable_static():
+    """Switch to static-graph (program-building) mode (paddle.enable_static)."""
+    _flags.set_static_mode(True)
+
+
+def disable_static(place=None):
+    _flags.set_static_mode(False)
+
+
+def in_dynamic_mode():
+    return not _flags.in_static_mode()
+
+
 class _NoGrad:
     """paddle.no_grad: usable as context manager and decorator."""
 
@@ -101,20 +114,6 @@ def is_grad_enabled():
 
 def set_grad_enabled(mode):
     return _flags.set_grad_enabled(mode)
-
-
-def in_dynamic_mode():
-    return not _flags.in_trace()
-
-
-def disable_static(place=None):
-    pass
-
-
-def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
-        "(trace-to-XLA) which subsumes it.")
 
 
 def get_device():

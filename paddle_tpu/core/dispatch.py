@@ -47,6 +47,11 @@ def apply(name, fn, *args, **kwargs):
     the grad graph extended when needed."""
     if _amp_cast_hook is not None:
         args, kwargs = _amp_cast_hook(name, args, kwargs)
+    if flags.in_static_mode():
+        from ..static import recorder
+
+        if recorder.should_record(args, kwargs):
+            return recorder.record(name, fn, args, kwargs)
     leaves, treedef = _flatten(args, kwargs)
     tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
 

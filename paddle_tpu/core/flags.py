@@ -16,6 +16,7 @@ class _State(threading.local):
     def __init__(self):
         self.grad_enabled = True
         self.tracing = 0  # nesting depth of functional tracing
+        self.static_mode = False  # paddle.enable_static() graph-build mode
 
 _state = _State()
 
@@ -50,6 +51,14 @@ def enable_grad_guard():
 
 def in_trace() -> bool:
     return _state.tracing > 0
+
+
+def in_static_mode() -> bool:
+    return _state.static_mode and not _state.tracing
+
+
+def set_static_mode(on: bool):
+    _state.static_mode = bool(on)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,135 @@
+"""paddle_tpu.inference: deployment predictor API.
+
+Role parity: `paddle.inference.Config` / `create_predictor` /
+`AnalysisPredictor` (`paddle/fluid/inference/api/analysis_predictor.h:100`,
+SURVEY §2.4). The reference runs an IR pass pipeline (fusion, memory reuse,
+TensorRT capture) before an interpreter; on TPU the saved artifact is
+already an AOT-compiled XLA program (`jax.export` serialization produced by
+`paddle_tpu.static.save_inference_model` or `jit.save`), so the predictor's
+job reduces to input/output handle marshalling around `Exported.call` —
+zero-copy in the same sense (device buffers in, device buffers out).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class Config:
+    """Predictor configuration (paths + toggles; graph-opt toggles are
+    accepted no-ops — XLA owns those decisions)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and params_file is None:
+            # single path prefix form
+            self.path_prefix = prog_file
+        else:
+            self.path_prefix = None
+            if prog_file is not None:
+                self.path_prefix = os.path.splitext(prog_file)[0]
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_device = "tpu"
+        self.mem_optim = True
+        self.ir_optim = True
+
+    def set_model(self, prog_file, params_file=None):
+        self.path_prefix = os.path.splitext(prog_file)[0]
+        self.prog_file = prog_file
+        self.params_file = params_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_device = "gpu"
+
+    def disable_gpu(self):
+        self._use_device = "cpu"
+
+    def enable_xpu(self, *a, **kw):
+        self._use_device = "xpu"
+
+    def switch_ir_optim(self, x=True):
+        self.ir_optim = x
+
+    def enable_memory_optim(self, x=True):
+        self.mem_optim = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass  # no TensorRT on TPU; XLA is the engine
+
+    def summary(self):
+        return f"Config(path={self.path_prefix}, device={self._use_device})"
+
+
+class PredictorTensor:
+    """Input/output handle (parity: paddle.inference zero-copy Tensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..static.io import load_inference_model
+
+        self.config = config
+        prog, feed_names, fetch_names = load_inference_model(
+            config.path_prefix)
+        self._prog = prog
+        self._inputs = {n: PredictorTensor(n) for n in feed_names}
+        self._outputs = {n: PredictorTensor(n) for n in fetch_names}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Run: either positional list of np arrays, or pre-filled handles."""
+        if inputs is not None:
+            for n, v in zip(self._prog.feed_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(v))
+        feed = {n: h._value for n, h in self._inputs.items()}
+        outs = self._prog._run(feed, return_numpy=True)
+        for n, v in zip(self._prog.fetch_names, outs):
+            self._outputs[n]._value = v
+        if inputs is not None:
+            return outs
+        return True
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(*a, **kw):
+    raise NotImplementedError(
+        "mixed-precision conversion happens at save time on TPU: export "
+        "under amp.auto_cast instead")

@@ -175,9 +175,8 @@ def swiglu(x, y=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...core.dispatch import apply
 
-    key = _rng.default_generator.split()
-
     def f(v):
+        key = _rng.default_generator.split()
         g = jax.random.gumbel(key, v.shape, v.dtype)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
@@ -207,9 +206,8 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     if not training:
         neg = (lower + upper) / 2.0
         return leaky_relu(x, neg)
-    key = _rng.default_generator.split()
-
     def f(v):
+        key = _rng.default_generator.split()
         a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
         return jnp.where(v >= 0, v, a * v)
 

@@ -51,9 +51,8 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return x if isinstance(x, Tensor) else Tensor(x)
         # downscale_in_infer: train uses the raw mask, infer scales by (1-p)
         return apply("dropout_infer", lambda v: v * (1.0 - p), x)
-    key = _rng.default_generator.split()
-
     def f(v):
+        key = _rng.default_generator.split()
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -79,9 +78,8 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
-    key = _rng.default_generator.split()
-
     def f(v):
+        key = _rng.default_generator.split()
         alpha = 1.6732632423543772
         scale = 1.0507009873554805
         alpha_p = -alpha * scale

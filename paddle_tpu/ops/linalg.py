@@ -351,9 +351,13 @@ def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
 @op("matrix_norm")
 def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     axis = tuple(a % x.ndim for a in axis)
-    if axis != (x.ndim - 2, x.ndim - 1):
+    moved = axis != (x.ndim - 2, x.ndim - 1)
+    if moved:
         x = jnp.moveaxis(x, axis, (-2, -1))
-    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+    out = jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+    if moved and keepdim:
+        out = jnp.moveaxis(out, (-2, -1), axis)
+    return out
 
 
 @op("ormqr")

@@ -210,6 +210,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..core import flags
+
+        if flags.in_static_mode():
+            from ..static import minimize_static
+
+            return minimize_static(self, loss, parameters=parameters,
+                                   no_grad_set=no_grad_set)
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,69 @@
+"""paddle_tpu.static: static-graph (program-building) API.
+
+Role parity: `paddle.static` (`python/paddle/static/`, SURVEY §2.6) over the
+executors of §2.4. The reference path Program→PIR→PirInterpreter collapses
+on TPU to: record pure ops on symbolic Variables (framework.py), infer
+shapes via jax.eval_shape, compile the whole program with jax.jit
+(executor.py), serialize via jax.export (io.py).
+
+Design rule: only ops with at least one symbolic Variable input record into
+the Program; ops over eager tensors alone (parameter initializers, constant
+folding) execute immediately — inline startup-program semantics. To put a
+parameter-only expression in the graph, route it through a Variable (e.g.
+multiply by a fed constant) or compute it inside a layer forward.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .framework import (  # noqa: F401
+    InputSpec, Program, Variable, data, default_main_program,
+    default_startup_program, program_guard, reset_default_programs,
+)
+from .backward import append_backward, gradients  # noqa: F401
+from .executor import Executor, global_scope, scope_guard  # noqa: F401
+from .io import (  # noqa: F401
+    load_inference_model, save_inference_model,
+)
+from . import nn  # noqa: F401
+from .optim import minimize_static  # noqa: F401
+
+
+def CompiledProgram(program, build_strategy=None):
+    """Every Program already compiles whole-graph via XLA; identity shim."""
+    return program
+
+
+class BuildStrategy:
+    """No-op strategy carrier (XLA owns fusion/memory decisions)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []

@@ -1,0 +1,79 @@
+"""Static-graph backward: append_backward / gradients.
+
+Role parity: `paddle.static.append_backward`
+(`python/paddle/base/backward.py`) which appends grad ops per forward op.
+TPU-first collapse: one recorded `backward` op marks "differentiate the
+prefix graph at this point"; the compiler realizes it as a single `jax.vjp`
+over the replayed prefix, so XLA sees exactly the fused fwd+bwd program a
+hand-appended grad-op chain would describe.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.tensor import Parameter
+from .framework import OpRecord, Variable, default_main_program
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Record grads of `loss` w.r.t. trainable captured parameters.
+
+    Returns list of (param, grad_variable) pairs, as the reference does.
+    """
+    prog = default_main_program()
+    if not isinstance(loss, Variable) or loss.program is not prog:
+        raise ValueError("append_backward needs a loss Variable of the "
+                         "default main program")
+    if prog._has_backward:
+        raise RuntimeError("append_backward already called on this Program")
+
+    if parameter_list is None:
+        params = [p for p in prog.all_parameters()
+                  if not p.stop_gradient and getattr(p, "trainable", True)]
+    else:
+        params = list(parameter_list)
+    if no_grad_set:
+        drop = set(id(p) for p in no_grad_set)
+        params = [p for p in params if id(p) not in drop]
+    if not params:
+        raise ValueError("no trainable parameters captured by the program")
+
+    wrt_caps = [prog.capture(p) for p in params]
+    pairs = []
+    grad_vids = []
+    for p, cap in zip(params, wrt_caps):
+        aval = jax.ShapeDtypeStruct(tuple(p._value.shape),
+                                    np.dtype(p._value.dtype))
+        g = Variable(aval, name=f"{p.name or 'param'}@GRAD", program=prog)
+        prog.register_var(g)
+        grad_vids.append(g.vid)
+        pairs.append((p, g))
+
+    prog.ops.append(OpRecord(
+        "backward", "append_backward",
+        out_vids=grad_vids,
+        extra={"loss_vid": loss.vid, "wrt_caps": wrt_caps}))
+    prog._has_backward = True
+    prog._bump()
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity: d(sum of targets)/d(inputs) where
+    inputs are captured eager tensors (parameters/constants)."""
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "target_gradients is not supported; pre-scale the targets")
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    from .. import ops
+
+    loss = ops.sum(targets[0])
+    for t in targets[1:]:
+        loss = ops.add(loss, ops.sum(t))
+    pairs = append_backward(loss, parameter_list=list(inputs),
+                            no_grad_set=no_grad_set)
+    return [g for _, g in pairs]

@@ -1,0 +1,220 @@
+"""Static-graph program representation.
+
+Role parity: `Program`/`Block`/`Variable` of the reference
+(`paddle/fluid/framework/program_desc.h`, `python/paddle/base/framework.py`)
+and the PIR program it translates to (`paddle/pir/`, SURVEY §2.4).
+
+TPU-first collapse: a Program is a recorded DAG of pure-op applications over
+symbolic `Variable`s. Shape/dtype inference at build time is `jax.eval_shape`
+(the InferMeta analog); there is no separate serialization IR — compilation
+lowers the recorded ops straight through `jax.jit` to StableHLO/XLA, and
+`save_inference_model` serializes via `jax.export` (the ProgramDesc analog).
+Parameters materialize eagerly at creation (the startup program is an API
+no-op), held as scope-bound captures so optimizer writebacks persist across
+`Executor.run` calls without recompiling.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..core.tensor import Tensor
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (build-time handle, no device value).
+
+    `_value` holds a `jax.ShapeDtypeStruct`, so shape/dtype properties and
+    `jnp.issubdtype` checks in the dispatch gate work unchanged; any attempt
+    to read data eagerly fails loudly.
+    """
+
+    __slots__ = ("vid", "program", "is_data", "declared_shape")
+
+    def __init__(self, aval, name=None, program=None, stop_gradient=True):
+        # bypass Tensor.__init__'s asarray path: bind the abstract value
+        self._value = aval
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._hooks = []
+        self.name = name
+        self.persistable = False
+        self.dist_attr = None
+        self.program = program
+        self.is_data = False
+        self.declared_shape = None
+        self.vid = program._next_vid() if program is not None else -1
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} is symbolic (static mode); run it "
+            "through Executor.run(fetch_list=[...]) to get a value")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self._value.dtype})")
+
+
+class OpRecord:
+    """One recorded op application.
+
+    kind: 'compute' (pure fn replay), 'backward' (vjp over the prefix graph),
+    'update' (optimizer step with scope writebacks).
+    """
+
+    __slots__ = ("kind", "name", "fn", "leafspec", "treedef", "out_vids",
+                 "out_tree", "extra")
+
+    def __init__(self, kind, name, fn=None, leafspec=(), treedef=None,
+                 out_vids=(), out_tree=None, extra=None):
+        self.kind = kind
+        self.name = name
+        self.fn = fn
+        self.leafspec = list(leafspec)
+        self.treedef = treedef
+        self.out_vids = list(out_vids)
+        self.out_tree = out_tree
+        self.extra = extra or {}
+
+
+class Program:
+    """Recorded op list + captured eager tensors + mutable scope state."""
+
+    def __init__(self):
+        self.ops = []
+        self.captures = []          # eager Tensor handles (params, consts)
+        self._capture_ids = {}      # id(tensor) -> capture index
+        self.scope = {}             # str -> jax array (optimizer slots, step)
+        self.feed_vars = {}         # name -> Variable
+        self.vars = {}              # vid -> Variable (weak by design: small)
+        self._vid = 0
+        self._version = 0
+        self._has_backward = False
+        self.lr_providers = []      # callables evaluated at run time
+        self.random_seed = None
+
+    def _next_vid(self):
+        self._vid += 1
+        return self._vid
+
+    def _bump(self):
+        self._version += 1
+
+    def capture(self, tensor):
+        idx = self._capture_ids.get(id(tensor))
+        if idx is None:
+            idx = len(self.captures)
+            self.captures.append(tensor)
+            self._capture_ids[id(tensor)] = idx
+        return idx
+
+    def register_var(self, var):
+        self.vars[var.vid] = var
+        return var
+
+    def all_parameters(self):
+        from ..core.tensor import Parameter
+
+        return [t for t in self.captures if isinstance(t, Parameter)]
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def block(self, i=0):
+        return self
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        # the recorded graph is already side-effect-free; a test clone simply
+        # shares ops (dropout keys are threaded per-run, eval determinism is
+        # the caller's Layer.eval() responsibility, as in dygraph)
+        return self
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, captures={len(self.captures)},"
+                f" feeds={list(self.feed_vars)})")
+
+
+class _Defaults(threading.local):
+    def __init__(self):
+        self.main = Program()
+        self.startup = Program()
+
+
+_defaults = _Defaults()
+
+
+def default_main_program():
+    return _defaults.main
+
+
+def default_startup_program():
+    return _defaults.startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main, old_startup = _defaults.main, _defaults.startup
+    _defaults.main = main_program
+    if startup_program is not None:
+        _defaults.startup = startup_program
+    try:
+        yield
+    finally:
+        _defaults.main = old_main
+        _defaults.startup = old_startup
+
+
+def reset_default_programs():
+    _defaults.main = Program()
+    _defaults.startup = Program()
+
+
+def data(name, shape, dtype=None, lod_level=0):
+    """Declare a feed Variable (parity: paddle.static.data)."""
+    prog = default_main_program()
+    dtype = _dtypes.convert_dtype(dtype) or _dtypes.get_default_dtype()
+    shape = [(-1 if s is None else int(s)) for s in shape]
+    aval = jax.ShapeDtypeStruct(
+        tuple(1 if s == -1 else s for s in shape), np.dtype(dtype))
+    var = Variable(aval, name=name, program=prog, stop_gradient=True)
+    var.is_data = True
+    # user-facing shape keeps -1 for the batch dim; compile re-derives real
+    # shapes from the fed arrays
+    var.declared_shape = shape
+    prog.feed_vars[name] = var
+    prog.register_var(var)
+    prog._bump()
+    return var
+
+
+class InputSpec:
+    """Shape/dtype spec for jit.save / static feeds (parity:
+    `paddle.static.InputSpec`)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(1 if (s is None or s == -1) else int(s)
+                           for s in shape)
+        self.declared_shape = [(-1 if s is None else int(s)) for s in shape]
+        self.dtype = np.dtype(_dtypes.convert_dtype(dtype) or "float32")
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.declared_shape}, dtype={self.dtype},"
+                f" name={self.name})")

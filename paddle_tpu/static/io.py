@@ -1,0 +1,140 @@
+"""Inference-model serialization for static programs.
+
+Role parity: `paddle.static.save/load_inference_model`
+(`python/paddle/static/io.py`) which freeze a pruned ProgramDesc + params.
+TPU-first: the pruned program is AOT-lowered through `jax.export` to
+serialized StableHLO (`.pdmodel`); parameters ship separately (`.pdiparams`)
+and are bound at load as executable arguments — the zero-copy deployment
+path `AnalysisPredictor` provides in the reference (SURVEY §2.4 inference).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .executor import _build
+from .framework import default_main_program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    program = program or default_main_program()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    feed_names = [v.name for v in feed_vars]
+    fetch_vids = [v.vid for v in fetch_vars]
+
+    # prune to the forward subgraph reaching the fetches (the reference
+    # prunes the ProgramDesc the same way before freezing): training-only
+    # ops (backward/update) and unrelated feeds drop out
+    import copy
+
+    needed = set(fetch_vids)
+    keep = []
+    for op in reversed(program.ops):
+        if op.kind != "compute":
+            continue
+        if set(op.out_vids) & needed:
+            keep.append(op)
+            needed.update(v for k, v in op.leafspec if k == "var")
+    pruned = copy.copy(program)
+    pruned.ops = list(reversed(keep))
+    unresolved = needed - {v.vid for v in program.feed_vars.values()} \
+        - {vid for op in pruned.ops for vid in op.out_vids}
+    if unresolved:
+        raise ValueError(
+            "fetch_vars depend on non-forward values (grads/updates?); "
+            f"unresolved vids: {sorted(unresolved)}")
+    fn, _ = _build(pruned, feed_names, fetch_vids, [])
+
+    cap_vals = [c._value for c in program.captures]
+    from ..core import rng
+
+    key_val = rng.default_generator.get_state()
+
+    def infer_fn(cap_vals_in, feed_vals_in):
+        fetches, _, _, _ = fn(feed_vals_in, cap_vals_in, [], [], key_val)
+        return fetches
+
+    # symbolic batch dims: every declared -1 becomes its own export symbol
+    scope = jax.export.SymbolicScope()
+    feed_avals = []
+    has_symbolic = False
+    for i, v in enumerate(feed_vars):
+        decl = getattr(v, "declared_shape", None) or v.shape
+        if any(d == -1 for d in decl):
+            has_symbolic = True
+            spec = ",".join(f"d{i}_{j}" if d == -1 else str(d)
+                            for j, d in enumerate(decl))
+            shape = jax.export.symbolic_shape(spec, scope=scope)
+        else:
+            shape = tuple(decl)
+        feed_avals.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
+    cap_avals = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in cap_vals]
+
+    try:
+        exp = jax.export.export(jax.jit(infer_fn))(cap_avals, feed_avals)
+    except Exception:
+        if not has_symbolic:
+            raise
+        # fall back to concrete batch=1 when the program isn't shape-poly safe
+        feed_avals = [
+            jax.ShapeDtypeStruct(
+                tuple(1 if d == -1 else d
+                      for d in (getattr(v, "declared_shape", None) or v.shape)),
+                v._value.dtype)
+            for v in feed_vars]
+        exp = jax.export.export(jax.jit(infer_fn))(cap_avals, feed_avals)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({
+            "format": "static_inference",
+            "caps": [np.asarray(c) for c in cap_vals],
+            "feed_names": feed_names,
+            "fetch_names": [v.name for v in fetch_vars],
+        }, f)
+    return path_prefix
+
+
+class _ExportedInferenceProgram:
+    """Loaded frozen program: Executor.run(self, feed=...) replays it."""
+
+    def __init__(self, exported, caps, feed_names, fetch_names):
+        self.exported = exported
+        self.caps = [jnp.asarray(c) for c in caps]
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+
+    def _run(self, feed, return_numpy=True):
+        vals = []
+        for n in self.feed_names:
+            if n not in feed:
+                raise KeyError(f"missing feed {n!r}")
+            v = feed[n]
+            if isinstance(v, Tensor):
+                v = v._value
+            vals.append(jnp.asarray(v))
+        out = self.exported.call(self.caps, vals)
+        if return_numpy:
+            return [np.asarray(o) for o in out]
+        return [Tensor(o) for o in out]
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exp = jax.export.deserialize(bytearray(f.read()))
+    prog = _ExportedInferenceProgram(
+        exp, meta["caps"], meta["feed_names"], meta["fetch_names"])
+    return [prog, prog.feed_names, prog.fetch_names]

@@ -1,0 +1,72 @@
+"""static.nn: program-building layer helpers.
+
+Role parity: `paddle.static.nn` (`python/paddle/static/nn/common.py` fc,
+conv2d, batch_norm, embedding ...). Each helper instantiates the eager layer
+(parameters materialize immediately — inline startup semantics) and calls it
+on the symbolic Variable so the forward records into the Program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    from .. import nn, ops
+
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = nn.Linear(in_dim, size)
+    flat = x
+    if len(x.shape) > num_flatten_dims + 1:
+        lead = list(x.shape[:num_flatten_dims])
+        flat = ops.reshape(x, [-1 if any(d == -1 for d in lead) else
+                               int(np.prod(lead)), in_dim])
+    out = layer(flat)
+    if activation is not None:
+        out = getattr(nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, dtype="float32",
+              param_attr=None):
+    from .. import nn
+
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW"):
+    from .. import nn
+
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = nn.Conv2D(in_ch, num_filters, filter_size, stride=stride,
+                      padding=padding, dilation=dilation, groups=groups,
+                      data_format=data_format)
+    out = layer(input)
+    if act is not None:
+        out = getattr(nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               data_layout="NCHW", **kwargs):
+    from .. import nn
+
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                           data_format=data_layout)
+    out = layer(input)
+    if act is not None:
+        out = getattr(nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, **kwargs):
+    from .. import nn
+
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = nn.LayerNorm(shape, epsilon=epsilon)
+    return layer(input)

@@ -1,0 +1,52 @@
+"""Static-mode optimizer.minimize: record backward + update ops.
+
+Role parity: `Optimizer.minimize` appending backward + optimizer ops to the
+Program (`python/paddle/optimizer/optimizer.py` static branch). The recorded
+update op reuses the optimizer's pure `update()` rule — the same single
+source of truth the eager `.step()` and the sharded functional path use — so
+the whole train step compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .backward import append_backward
+from .framework import OpRecord, default_main_program
+
+
+def minimize_static(opt, loss, parameters=None, no_grad_set=None):
+    prog = default_main_program()
+    if parameters is None:
+        parameters = opt._parameter_list
+    if parameters is None:
+        parameters = [p for p in prog.all_parameters()
+                      if not p.stop_gradient and getattr(p, "trainable", True)]
+    params_grads = append_backward(loss, parameter_list=parameters,
+                                   no_grad_set=no_grad_set)
+
+    items = []
+    slot_names = {}
+    for p, g in params_grads:
+        ci = prog.capture(p)
+        slots = opt.init_slots(p._value)
+        names = sorted(slots)
+        slot_names[ci] = names
+        for k in names:
+            prog.scope.setdefault(f"opt::{ci}::{k}", slots[k])
+        if opt._multi_precision and p._value.dtype != jnp.float32:
+            prog.scope.setdefault(f"opt::{ci}::@master",
+                                  p._value.astype(jnp.float32))
+        lrm = p.optimize_attr.get("learning_rate", 1.0) \
+            if hasattr(p, "optimize_attr") else 1.0
+        items.append((ci, g.vid, opt._wd_for(p), float(lrm)))
+
+    prog.scope.setdefault("@opt_step", jnp.zeros((), jnp.int32))
+    lr_slot = len(prog.lr_providers)
+    prog.lr_providers.append(opt.get_lr)
+
+    prog.ops.append(OpRecord(
+        "update", type(opt).__name__,
+        extra={"optimizer": opt, "items": items, "slot_names": slot_names,
+               "lr_slot": lr_slot}))
+    prog._bump()
+    return [], params_grads

@@ -1,0 +1,94 @@
+"""Static-mode op recording: the append_op analog.
+
+Role parity: `Block.append_op` + InferMeta invocation of the reference
+(`python/paddle/base/framework.py`, `paddle/phi/infermeta/`). Under
+`paddle.enable_static()`, the dispatch gate routes every op whose inputs
+contain a symbolic `Variable` here instead of executing it; ops over purely
+eager tensors (parameter initializers) still run immediately — the inline
+startup-program semantics.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from .framework import OpRecord, Variable, default_main_program
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def should_record(args, kwargs):
+    leaves, _ = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    return any(isinstance(l, Variable) for l in leaves)
+
+
+def record(name, fn, args, kwargs):
+    """Append one compute op to the default main program; return symbolic
+    output Variables with shapes from `jax.eval_shape` (InferMeta)."""
+    prog = default_main_program()
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_is_tensor)
+
+    leafspec = []
+    abstract = []
+    any_grad_input = False
+    for l in leaves:
+        if isinstance(l, Variable):
+            if l.program is not None and l.program is not prog:
+                raise ValueError(
+                    f"op {name!r} mixes Variables from different Programs")
+            leafspec.append(("var", l.vid))
+            abstract.append(l._value)
+            if not l.stop_gradient:
+                any_grad_input = True
+        elif isinstance(l, Tensor):
+            idx = prog.capture(l)
+            leafspec.append(("cap", idx))
+            abstract.append(
+                jax.ShapeDtypeStruct(tuple(l._value.shape), l._value.dtype))
+            if not l.stop_gradient:
+                any_grad_input = True
+        else:
+            leafspec.append(("py", l))
+            abstract.append(l)
+
+    dyn_idx = [i for i, spec in enumerate(leafspec) if spec[0] != "py"]
+
+    def abstract_call(*dyn_vals):
+        cur = list(abstract)
+        for i, v in zip(dyn_idx, dyn_vals):
+            cur[i] = v
+        a, kw = jax.tree_util.tree_unflatten(treedef, cur)
+        return fn(*a, **kw)
+
+    # ops that draw randomness split the global generator key inside their
+    # body; eval_shape traces that as an abstract split — restore the
+    # concrete key afterwards so no tracer leaks into the generator (the
+    # compiled replay threads the real key per run)
+    from ..core import rng
+
+    old_key = rng.default_generator.get_state()
+    try:
+        out_shapes = jax.eval_shape(
+            abstract_call, *[abstract[i] for i in dyn_idx])
+    finally:
+        rng.default_generator.set_state(old_key)
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out_shapes)
+
+    out_vars = []
+    for i, aval in enumerate(out_leaves):
+        sg = not (any_grad_input
+                  and np.issubdtype(np.dtype(aval.dtype), np.inexact))
+        v = Variable(aval, name=f"{name}_{prog._vid + 1}.out{i}",
+                     program=prog, stop_gradient=sg)
+        prog.register_var(v)
+        out_vars.append(v)
+
+    prog.ops.append(OpRecord(
+        "compute", name, fn=fn, leafspec=leafspec, treedef=treedef,
+        out_vids=[v.vid for v in out_vars], out_tree=out_tree))
+    prog._bump()
+    return jax.tree_util.tree_unflatten(out_tree, out_vars)

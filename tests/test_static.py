@@ -1,0 +1,181 @@
+"""Static-graph API: program build, Executor.run, minimize, inference save.
+
+Parity model: the reference's static tests (`test/legacy_test/` Executor
+paths, SURVEY §3.4) — build program with static.data + layers, run feeds,
+train with minimize, freeze with save_inference_model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.reset_default_programs()
+    P.enable_static()
+    yield
+    P.disable_static()
+    static.reset_default_programs()
+
+
+def test_build_and_run_forward():
+    x = static.data("x", [-1, 4], "float32")
+    y = P.matmul(x, P.ones([4, 3]))
+    z = P.add(y, P.full([3], 1.0))
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    xv = np.random.rand(2, 4).astype(np.float32)
+    (out,) = exe.run(feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, xv @ np.ones((4, 3)) + 1.0, rtol=1e-6)
+    # second run with a different batch size: separate compile, same program
+    xv8 = np.random.rand(8, 4).astype(np.float32)
+    (out8,) = exe.run(feed={"x": xv8}, fetch_list=[z])
+    assert out8.shape == (8, 3)
+
+
+def test_variable_properties():
+    x = static.data("img", [-1, 1, 28, 28], "float32")
+    assert x.shape == [1, 1, 28, 28] or x.shape[0] == 1
+    assert x.declared_shape == [-1, 1, 28, 28]
+    with pytest.raises(RuntimeError):
+        x.numpy()
+
+
+def test_layers_record_and_minimize():
+    import paddle_tpu.nn as nn
+
+    x = static.data("x", [4, 8], "float32")
+    label = static.data("label", [4, 1], "float32")
+    lin = nn.Linear(8, 1)
+    pred = lin(x)
+    loss = P.mean(P.square(P.subtract(pred, label)))
+    opt = P.optimizer.SGD(learning_rate=0.1,
+                          parameters=list(lin.parameters()))
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 8).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, losses[:3] + losses[-3:]
+
+
+def test_adam_static_matches_eager():
+    import paddle_tpu.nn as nn
+
+    # static
+    w0 = np.random.RandomState(1).rand(6, 2).astype(np.float32)
+    x = static.data("x", [5, 6], "float32")
+    lin = nn.Linear(6, 2)
+    lin.weight.set_value(w0)
+    lin.bias.set_value(np.zeros(2, np.float32))
+    loss = P.mean(P.square(lin(x)))
+    opt = P.optimizer.Adam(learning_rate=0.01,
+                           parameters=list(lin.parameters()))
+    opt.minimize(loss)
+    exe = static.Executor()
+    xv = np.random.RandomState(2).rand(5, 6).astype(np.float32)
+    static_losses = [float(exe.run(feed={"x": xv}, fetch_list=[loss])[0])
+                     for _ in range(5)]
+
+    # eager twin
+    P.disable_static()
+    lin2 = nn.Linear(6, 2)
+    lin2.weight.set_value(w0)
+    lin2.bias.set_value(np.zeros(2, np.float32))
+    opt2 = P.optimizer.Adam(learning_rate=0.01,
+                            parameters=list(lin2.parameters()))
+    eager_losses = []
+    xt = P.to_tensor(xv)
+    for _ in range(5):
+        l2 = P.mean(P.square(lin2(xt)))
+        eager_losses.append(float(l2.numpy()))
+        l2.backward()
+        opt2.step()
+        opt2.clear_grad()
+    np.testing.assert_allclose(static_losses, eager_losses, rtol=1e-4)
+
+
+def test_append_backward_grads():
+    x = static.data("x", [3, 4], "float32")
+    w = P.create_parameter([4, 2], "float32")
+    loss = P.sum(P.matmul(x, w))
+    pairs = static.append_backward(loss)
+    assert len(pairs) >= 1
+    exe = static.Executor()
+    xv = np.ones((3, 4), np.float32)
+    grads = exe.run(feed={"x": xv}, fetch_list=[g for _, g in pairs])
+    # d(sum(x@w))/dw = x^T @ ones = column sums broadcast
+    np.testing.assert_allclose(grads[0], np.full((4, 2), 3.0), rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    import paddle_tpu.nn as nn
+
+    x = static.data("x", [-1, 4], "float32")
+    lin = nn.Linear(4, 3)
+    out = nn.functional.softmax(lin(x))
+    exe = static.Executor()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [x], [out], exe)
+
+    prog, feeds, fetches = static.load_inference_model(prefix, exe)
+    xv = np.random.rand(2, 4).astype(np.float32)
+    (ref,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    (got,) = exe.run(prog, feed={"x": xv})
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_inference_predictor(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu import inference
+
+    x = static.data("x", [-1, 4], "float32")
+    lin = nn.Linear(4, 3)
+    out = lin(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "pred")
+    static.save_inference_model(prefix, [x], [out], exe)
+
+    cfg = inference.Config(prefix)
+    predictor = inference.create_predictor(cfg)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    xv = np.random.rand(2, 4).astype(np.float32)
+    h.copy_from_cpu(xv)
+    predictor.run()
+    got = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    (ref,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_static_dropout_resamples_per_run():
+    import paddle_tpu.nn as nn
+
+    x = static.data("x", [4, 8], "float32")
+    y = nn.functional.dropout(x, 0.5, training=True)
+    exe = static.Executor()
+    xv = np.ones((4, 8), np.float32)
+    a = exe.run(feed={"x": xv}, fetch_list=[y])[0]
+    b = exe.run(feed={"x": xv}, fetch_list=[y])[0]
+    assert not np.array_equal(a, b)
+
+
+def test_program_guard_isolation():
+    main1 = static.Program()
+    with static.program_guard(main1):
+        a = static.data("a", [2, 2], "float32")
+        b = P.scale(a, 2.0)
+    assert static.default_main_program() is not main1
+    exe = static.Executor()
+    (r,) = exe.run(main1, feed={"a": np.eye(2, dtype=np.float32)},
+                   fetch_list=[b])
+    np.testing.assert_allclose(r, 2 * np.eye(2))
