@@ -56,6 +56,37 @@ class Generator:
 default_generator = Generator(0)
 
 
+class _DeferredKey:
+    """Marker: resolve the key when the op body actually runs (static-mode
+    replay), not at record time."""
+
+    __slots__ = ()
+
+
+_DEFERRED = _DeferredKey()
+
+
+def split_for_op():
+    """Key for a random op body. Eager/trace: split NOW at dispatch — the
+    concrete key is captured by the op's pure fn, so vjp re-evaluation
+    (create_graph, double grad) replays the SAME randomness. Static mode:
+    defer — each Executor.run replay draws from the per-run threaded key, so
+    masks resample across runs (the reference's seed/offset op attributes
+    serve the same two purposes)."""
+    from . import flags
+
+    if flags.in_static_mode():
+        return _DEFERRED
+    return default_generator.split()
+
+
+def materialize(key):
+    """First line of a random op body: resolve a possibly-deferred key."""
+    if isinstance(key, _DeferredKey):
+        return default_generator.split()
+    return key
+
+
 def seed(s):
     default_generator.manual_seed(int(s))
     return default_generator

@@ -291,6 +291,20 @@ class Tensor:
         a = np.asarray(self._value)
         return a.astype(dtype) if dtype is not None else a
 
+    def to_sparse_coo(self, sparse_dim=None):
+        from ..sparse import to_sparse_coo_from_dense
+
+        return to_sparse_coo_from_dense(self, sparse_dim=sparse_dim)
+
+    def to_sparse_csr(self):
+        return self.to_sparse_coo(sparse_dim=2).to_sparse_csr()
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return False
+
     def element_size(self):
         return self._value.dtype.itemsize
 

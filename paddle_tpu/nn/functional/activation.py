@@ -175,9 +175,11 @@ def swiglu(x, y=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...core.dispatch import apply
 
+    key = _rng.split_for_op()
+
     def f(v):
-        key = _rng.default_generator.split()
-        g = jax.random.gumbel(key, v.shape, v.dtype)
+        k = _rng.materialize(key)
+        g = jax.random.gumbel(k, v.shape, v.dtype)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
@@ -206,9 +208,11 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     if not training:
         neg = (lower + upper) / 2.0
         return leaky_relu(x, neg)
+    key = _rng.split_for_op()
+
     def f(v):
-        key = _rng.default_generator.split()
-        a = jax.random.uniform(key, v.shape, v.dtype, lower, upper)
+        k = _rng.materialize(key)
+        a = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
         return jnp.where(v >= 0, v, a * v)
 
     return apply("rrelu", f, x)

@@ -51,13 +51,15 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return x if isinstance(x, Tensor) else Tensor(x)
         # downscale_in_infer: train uses the raw mask, infer scales by (1-p)
         return apply("dropout_infer", lambda v: v * (1.0 - p), x)
+    key = _rng.split_for_op()
+
     def f(v):
-        key = _rng.default_generator.split()
+        k = _rng.materialize(key)
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
             shape = [s if i in axes else 1 for i, s in enumerate(shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype)).astype(v.dtype)
         return jnp.where(keep, v, jnp.zeros((), v.dtype))
@@ -78,12 +80,14 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
+    key = _rng.split_for_op()
+
     def f(v):
-        key = _rng.default_generator.split()
+        k = _rng.materialize(key)
         alpha = 1.6732632423543772
         scale = 1.0507009873554805
         alpha_p = -alpha * scale
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
         a = (1.0 / (scale * ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5))
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)

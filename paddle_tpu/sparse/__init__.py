@@ -1,0 +1,529 @@
+"""paddle_tpu.sparse: COO/CSR sparse tensors + ops + nn.
+
+Role parity: `paddle.sparse` (`python/paddle/sparse/`, SURVEY §2.6; kernels
+`paddle/phi/kernels/sparse/`, tensor types `paddle/phi/core/sparse_coo_tensor.h`,
+`sparse_csr_tensor.h`).
+
+TPU-first design: a sparse tensor is (index arrays, values Tensor, dense
+shape). The values Tensor carries autograd — every sparse op routes its
+value math through the regular dispatch gate, so grads flow with no extra
+machinery. Compute patterns XLA likes: matmul/sddmm as gather +
+`segment_sum` (static-nnz, MXU-friendly per-row accumulation) rather than
+scalar loops; nnz is static per tensor, so everything jits.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO: indices [sparse_dim, nnz] int64, values [nnz, *dense_dims]."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self.indices_arr = jnp.asarray(_val(indices), jnp.int32)
+        self.values_t = values if isinstance(values, Tensor) else Tensor(values)
+        self.dense_shape = tuple(int(s) for s in shape)
+        self.coalesced = coalesced
+
+    # --- paddle Tensor-surface parity ---
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def ndim(self):
+        return len(self.dense_shape)
+
+    @property
+    def nnz(self):
+        return int(self.indices_arr.shape[1])
+
+    @property
+    def stop_gradient(self):
+        return self.values_t.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_t.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self.values_t.grad
+
+    def values(self):
+        return self.values_t
+
+    def indices(self):
+        return Tensor(self.indices_arr)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def backward(self, *a, **kw):
+        return self.values_t.backward(*a, **kw)
+
+    def to_dense(self):
+        idx = self.indices_arr
+        shape = self.dense_shape
+        sparse_dim = idx.shape[0]
+
+        def f(v):
+            out = jnp.zeros(shape, v.dtype)
+            return out.at[tuple(idx[d] for d in range(sparse_dim))].add(v)
+
+        return apply("sparse_coo_to_dense", f, self.values_t)
+
+    def to_sparse_csr(self):
+        coo = self.coalesce()
+        m = coo.dense_shape[0]
+        rows = coo.indices_arr[0]
+        crows = jnp.zeros(m + 1, jnp.int32).at[rows + 1].add(1)
+        crows = jnp.cumsum(crows)
+        return SparseCsrTensor(crows, coo.indices_arr[1], coo.values_t,
+                               coo.dense_shape)
+
+    def coalesce(self):
+        if self.coalesced:
+            return self
+        idx = np.asarray(self.indices_arr)
+        flat = np.ravel_multi_index(
+            tuple(idx), self.dense_shape[:idx.shape[0]])
+        order = np.argsort(flat, kind="stable")
+        sorted_flat = flat[order]
+        uniq, first = np.unique(sorted_flat, return_index=True)
+        seg = np.searchsorted(uniq, sorted_flat)
+        new_idx = idx[:, order][:, first]
+        n_out = len(uniq)
+        perm = jnp.asarray(order)
+        seg_j = jnp.asarray(seg)
+
+        def f(v):
+            return jax.ops.segment_sum(v[perm], seg_j, num_segments=n_out)
+
+        new_vals = apply("sparse_coalesce", f, self.values_t)
+        return SparseCooTensor(new_idx, new_vals, self.dense_shape,
+                               coalesced=True)
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def astype(self, dtype):
+        return SparseCooTensor(self.indices_arr, self.values_t.astype(dtype),
+                               self.dense_shape, self.coalesced)
+
+    cast = astype
+
+    def detach(self):
+        return SparseCooTensor(self.indices_arr, self.values_t.detach(),
+                               self.dense_shape, self.coalesced)
+
+    def transpose(self, perm):
+        nd = len(self.dense_shape)
+        sd = self.indices_arr.shape[0]
+        if any(p >= sd for p in perm[:sd]) and sd != nd:
+            raise NotImplementedError(
+                "transpose mixing sparse and dense dims")
+        new_idx = jnp.stack([self.indices_arr[p] for p in perm[:sd]])
+        new_shape = tuple(self.dense_shape[p] for p in perm)
+        return SparseCooTensor(new_idx, self.values_t, new_shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR: crows [m+1], cols [nnz], values [nnz] (2D; batched 3D via
+    leading batch handled by callers)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows_arr = jnp.asarray(_val(crows), jnp.int32)
+        self.cols_arr = jnp.asarray(_val(cols), jnp.int32)
+        self.values_t = values if isinstance(values, Tensor) else Tensor(values)
+        self.dense_shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values_t.dtype
+
+    @property
+    def ndim(self):
+        return len(self.dense_shape)
+
+    @property
+    def nnz(self):
+        return int(self.cols_arr.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self.values_t.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self.values_t.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self.values_t.grad
+
+    def values(self):
+        return self.values_t
+
+    def crows(self):
+        return Tensor(self.crows_arr)
+
+    def cols(self):
+        return Tensor(self.cols_arr)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def backward(self, *a, **kw):
+        return self.values_t.backward(*a, **kw)
+
+    def _rows(self):
+        m = self.dense_shape[0]
+        counts = jnp.diff(self.crows_arr)
+        return jnp.repeat(jnp.arange(m, dtype=jnp.int32), counts,
+                          total_repeat_length=self.nnz)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        idx = jnp.stack([self._rows(), self.cols_arr])
+        return SparseCooTensor(idx, self.values_t, self.dense_shape,
+                               coalesced=True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def detach(self):
+        return SparseCsrTensor(self.crows_arr, self.cols_arr,
+                               self.values_t.detach(), self.dense_shape)
+
+    def astype(self, dtype):
+        return SparseCsrTensor(self.crows_arr, self.cols_arr,
+                               self.values_t.astype(dtype), self.dense_shape)
+
+    cast = astype
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# --- creation ---------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(_val(indices), jnp.int32)
+    vals = values if isinstance(values, Tensor) else Tensor(values, dtype=dtype)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        sparse_shape = [int(i) + 1 for i in np.asarray(idx.max(axis=1))]
+        shape = sparse_shape + list(vals.shape[1:])
+    vals.stop_gradient = stop_gradient
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = values if isinstance(values, Tensor) else Tensor(values, dtype=dtype)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    vals.stop_gradient = stop_gradient
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+# --- unary value-wise ops ----------------------------------------------------
+
+def _unary(name, f):
+    def g(x, name_arg=None):
+        out_vals = apply(f"sparse_{name}", f, x.values())
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x.indices_arr, out_vals, x.dense_shape,
+                                   x.coalesced)
+        return SparseCsrTensor(x.crows_arr, x.cols_arr, out_vals,
+                               x.dense_shape)
+
+    g.__name__ = name
+    return g
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary("leaky_relu",
+                  lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def pow(x, factor):
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    out = x
+    if value_dtype is not None:
+        out = out.astype(value_dtype)
+    return out
+
+
+def scale(x, scale_val, bias=0.0, bias_after_scale=True):
+    return _unary("scale", lambda v: v * scale_val + bias)(x)
+
+
+# --- binary -----------------------------------------------------------------
+
+def _ewise_coo(name, f, x, y):
+    """Elementwise op over two COO tensors via union of index sets."""
+    xc, yc = x.coalesce(), y.coalesce()
+    xi = np.asarray(xc.indices_arr)
+    yi = np.asarray(yc.indices_arr)
+    sd = xi.shape[0]
+    shape = x.dense_shape[:sd]
+    xf = np.ravel_multi_index(tuple(xi), shape)
+    yf = np.ravel_multi_index(tuple(yi), shape)
+    union = np.union1d(xf, yf)
+    xpos = jnp.asarray(np.searchsorted(union, xf))
+    ypos = jnp.asarray(np.searchsorted(union, yf))
+    n = len(union)
+    new_idx = np.stack(np.unravel_index(union, shape)).astype(np.int32)
+    val_shape = (n,) + tuple(xc.values_t.shape[1:])
+
+    def g(xv, yv):
+        dx = jnp.zeros(val_shape, xv.dtype).at[xpos].set(xv)
+        dy = jnp.zeros(val_shape, yv.dtype).at[ypos].set(yv)
+        return f(dx, dy)
+
+    out_vals = apply(f"sparse_{name}", g, xc.values_t, yc.values_t)
+    return SparseCooTensor(new_idx, out_vals, x.dense_shape, coalesced=True)
+
+
+def _binary(name, f):
+    def g(x, y, name_arg=None):
+        if isinstance(x, SparseCsrTensor):
+            x = x.to_sparse_coo()
+        if isinstance(y, SparseCsrTensor):
+            y = y.to_sparse_coo()
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            if list(x.dense_shape) != list(y.dense_shape):
+                raise ValueError("sparse binary op needs same shapes")
+            return _ewise_coo(name, f, x, y)
+        # sparse op dense → dense
+        xd = x.to_dense() if isinstance(x, SparseCooTensor) else x
+        yd = y.to_dense() if isinstance(y, SparseCooTensor) else y
+        return apply(f"sparse_{name}_dense", f, xd, yd)
+
+    g.__name__ = name
+    return g
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+
+
+# --- matmul family ----------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense (spmm). COO path: gather + segment_sum."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("matmul: x must be sparse")
+    if x.ndim != 2:
+        raise NotImplementedError("sparse matmul supports 2D for now")
+    rows = x.indices_arr[0]
+    cols = x.indices_arr[1]
+    m = x.dense_shape[0]
+
+    def f(v, d):
+        contrib = v[:, None] * d[cols]         # [nnz, n]
+        return jax.ops.segment_sum(contrib, rows, num_segments=m)
+
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    return apply("sparse_matmul", f, x.values_t, yt)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at mask's sparsity pattern (SDDMM)."""
+    if isinstance(mask, SparseCsrTensor):
+        coo_mask = mask.to_sparse_coo()
+    else:
+        coo_mask = mask
+    rows, cols = coo_mask.indices_arr[0], coo_mask.indices_arr[1]
+
+    def f(xa, ya):
+        return jnp.sum(xa[rows] * ya.T[cols], axis=-1)
+
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    out_vals = apply("sparse_masked_matmul", f, xt, yt)
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(mask.crows_arr, mask.cols_arr, out_vals,
+                               mask.dense_shape)
+    return SparseCooTensor(coo_mask.indices_arr, out_vals,
+                           coo_mask.dense_shape, coalesced=True)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("sparse_addmm", lambda i, mm: beta * i + alpha * mm,
+                 input if isinstance(input, Tensor) else Tensor(input),
+                 matmul(x, y))
+
+
+def mv(x, vec, name=None):
+    out = matmul(x, (vec if isinstance(vec, Tensor)
+                     else Tensor(vec)).reshape([-1, 1]))
+    from .. import ops
+
+    return ops.reshape(out, [-1])
+
+
+# --- reductions / manipulation ----------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    if axis is None:
+        out_vals = apply("sparse_sum_all", lambda v: jnp.sum(v),
+                         x.values())
+        return out_vals
+    return apply("sparse_sum_axis",
+                 lambda d: jnp.sum(d, axis=axis, keepdims=keepdim),
+                 x.to_dense())
+
+
+def transpose(x, perm, name=None):
+    return x.transpose(perm)
+
+
+def reshape(x, shape, name=None):
+    dense = x.to_dense()
+    from .. import ops
+
+    return to_sparse_coo_from_dense(ops.reshape(dense, shape),
+                                    sparse_dim=len(shape))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense x at mask's sparsity pattern (trailing dense dims come
+    from x: mask only fixes the sparse-index pattern)."""
+    coo = mask if isinstance(mask, SparseCooTensor) else mask.to_sparse_coo()
+    idx = coo.indices_arr
+    sd = idx.shape[0]
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    out_vals = apply("sparse_mask_as",
+                     lambda d: d[tuple(idx[i] for i in range(sd))], xt)
+    out_shape = tuple(coo.dense_shape[:sd]) + tuple(xt.shape[sd:])
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(mask.crows_arr, mask.cols_arr, out_vals,
+                               out_shape)
+    return SparseCooTensor(idx, out_vals, out_shape, coo.coalesced)
+
+
+def to_sparse_coo_from_dense(dense, sparse_dim=None):
+    arr = np.asarray(dense._value if isinstance(dense, Tensor) else dense)
+    sparse_dim = sparse_dim or arr.ndim
+    reduce_axes = tuple(range(sparse_dim, arr.ndim))
+    nz_mask = (arr != 0)
+    if reduce_axes:
+        nz_mask = nz_mask.any(axis=reduce_axes)
+    idx = np.stack(np.nonzero(nz_mask)).astype(np.int32)
+    pos = tuple(idx)
+    dt = dense if isinstance(dense, Tensor) else Tensor(dense)
+
+    def f(d):
+        return d[pos]
+
+    vals = apply("dense_to_sparse_coo", f, dt)
+    return SparseCooTensor(idx, vals, arr.shape, coalesced=True)
+
+
+# softmax over CSR rows (sparse attention building block)
+def softmax(x, axis=-1, name=None):
+    if isinstance(x, SparseCooTensor):
+        return x.to_sparse_csr_softmax_fallback() \
+            if hasattr(x, "to_sparse_csr_softmax_fallback") \
+            else _coo_softmax(x)
+    rows = x._rows()
+    m = x.dense_shape[0]
+
+    def f(v):
+        row_max = jax.ops.segment_max(v, rows, num_segments=m)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=m)
+        return e / denom[rows]
+
+    out_vals = apply("sparse_softmax", f, x.values_t)
+    return SparseCsrTensor(x.crows_arr, x.cols_arr, out_vals, x.dense_shape)
+
+
+def _coo_softmax(x):
+    csr = x.to_sparse_csr()
+    return softmax(csr).to_sparse_coo()
+
+
+from . import nn  # noqa: E402,F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "sin", "tan", "asin", "atan",
+    "sinh", "tanh", "asinh", "atanh", "sqrt", "square", "log1p", "abs",
+    "expm1", "neg", "rad2deg", "deg2rad", "relu", "relu6", "sigmoid",
+    "leaky_relu", "pow", "cast", "scale", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "addmm", "mv", "sum", "transpose",
+    "reshape", "coalesce", "mask_as", "softmax", "nn",
+]

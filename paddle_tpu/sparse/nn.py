@@ -1,0 +1,201 @@
+"""paddle_tpu.sparse.nn: layers over sparse tensors.
+
+Role parity: `paddle.sparse.nn` (`python/paddle/sparse/nn/`) — activation
+layers, sparse conv3d (point-cloud workloads), batch norm, pooling. The
+reference's submanifold conv uses gather/scatter rulebooks on GPU
+(`paddle/phi/kernels/sparse/gpu/conv_kernel.cu`); here Conv3D densifies the
+local neighborhood — a correct baseline (XLA fuses the gather chain) with
+the rulebook-free layout TPUs prefer; swap in a Pallas rulebook kernel if
+point-cloud perf becomes a target.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import relu6
+
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from . import leaky_relu
+
+        return leaky_relu(x, self.negative_slope)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        from . import sigmoid
+
+        return sigmoid(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from . import softmax
+
+        return softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """Batch norm over sparse values (per-channel on the last dense dim),
+    parity: paddle.sparse.nn.BatchNorm on NDHWC sparse tensors."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC"):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        from ..nn.initializer import Constant
+
+        self.weight = self.create_parameter(
+            [num_features], default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], is_bias=True)
+        self.register_buffer("_mean", Tensor(np.zeros(num_features,
+                                                      np.float32)))
+        self.register_buffer("_variance", Tensor(np.ones(num_features,
+                                                         np.float32)))
+
+    def forward(self, x):
+        from . import SparseCooTensor
+
+        vals = x.values()
+        if self.training:
+            def stats(v):
+                mean = jnp.mean(v, axis=0)
+                var = jnp.var(v, axis=0)
+                return mean, var
+
+            mean_t, var_t = apply("sparse_bn_stats", stats, vals)
+            m, v_ = mean_t._value, var_t._value
+            self._mean._value = (self.momentum * self._mean._value
+                                 + (1 - self.momentum) * m)
+            self._variance._value = (self.momentum * self._variance._value
+                                     + (1 - self.momentum) * v_)
+        else:
+            mean_t, var_t = Tensor(self._mean._value), Tensor(
+                self._variance._value)
+
+        def norm(v, m, var, w, b):
+            return (v - m) * jax.lax.rsqrt(var + self.epsilon) * w + b
+
+        out_vals = apply("sparse_bn", norm, vals, mean_t, var_t,
+                         self.weight, self.bias)
+        return SparseCooTensor(x.indices_arr, out_vals, x.dense_shape,
+                               x.coalesced)
+
+
+SyncBatchNorm = BatchNorm
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 data_format="NDHWC"):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * 3
+        self.kernel_size = list(ks)
+        self.stride = stride if isinstance(stride, (list, tuple)) \
+            else [stride] * 3
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 3
+        self.subm = subm
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels, out_channels])
+        self.bias = self.create_parameter([out_channels], is_bias=True)
+
+    def forward(self, x):
+        """Densify → lax conv → resparsify (submanifold keeps x's indices).
+
+        Baseline implementation; see module docstring.
+        """
+        from . import SparseCooTensor, mask_as, to_sparse_coo_from_dense
+
+        dense = x.to_dense()  # [N, D, H, W, C]
+
+        def conv(d, w, b):
+            out = jax.lax.conv_general_dilated(
+                d, w,
+                window_strides=self.stride,
+                padding=[(p, p) for p in self.padding],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            return out + b
+
+        out_dense = apply("sparse_conv3d", conv, dense, self.weight,
+                          self.bias)
+        if self.subm:
+            return mask_as(out_dense, x)
+        return to_sparse_coo_from_dense(out_dense, sparse_dim=4)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, subm=False,
+                         **kw)
+
+
+class SubmConv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size, subm=True,
+                         **kw)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * 3
+        self.kernel_size = list(ks)
+        if isinstance(stride, (list, tuple)):
+            self.stride = list(stride)
+        elif stride:
+            self.stride = [stride] * 3
+        else:
+            self.stride = list(self.kernel_size)
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 3
+
+    def forward(self, x):
+        from . import to_sparse_coo_from_dense
+
+        dense = x.to_dense()
+
+        def pool(d):
+            return jax.lax.reduce_window(
+                d, -jnp.inf, jax.lax.max,
+                (1, *self.kernel_size, 1), (1, *self.stride, 1),
+                [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)])
+
+        out = apply("sparse_maxpool3d", pool, dense)
+        return to_sparse_coo_from_dense(out, sparse_dim=4)

@@ -65,9 +65,27 @@ def _apply_grad_clip(clip, grads):
     return grads
 
 
+def _prune_ops(ops, fetch_vids):
+    """Keep only compute ops reaching the fetches (non-compute ops —
+    backward/update — always run, plus their dependency chains)."""
+    needed = set(fetch_vids)
+    kept = []
+    for op in reversed(ops):
+        wanted = op.kind != "compute" or set(op.out_vids) & needed
+        if not wanted:
+            continue
+        kept.append(op)
+        needed.update(v for k, v in op.leafspec if k == "var")
+        if op.kind == "backward":
+            needed.add(op.extra["loss_vid"])
+        elif op.kind == "update":
+            needed.update(gv for _, gv, _, _ in op.extra["items"])
+    return list(reversed(kept))
+
+
 def _build(program, feed_names, fetch_vids, scope_keys):
     """Build the pure whole-program function for jax.jit."""
-    ops = program.ops
+    ops = _prune_ops(program.ops, fetch_vids)
     bwd_idx = next((i for i, o in enumerate(ops) if o.kind == "backward"),
                    None)
     # statically-known set of captures an update op writes back

@@ -46,7 +46,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             needed.update(v for k, v in op.leafspec if k == "var")
     pruned = copy.copy(program)
     pruned.ops = list(reversed(keep))
-    unresolved = needed - {v.vid for v in program.feed_vars.values()} \
+    unresolved = needed - {v.vid for v in feed_vars} \
         - {vid for op in pruned.ops for vid in op.out_vids}
     if unresolved:
         raise ValueError(
@@ -81,10 +81,17 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
     try:
         exp = jax.export.export(jax.jit(infer_fn))(cap_avals, feed_avals)
-    except Exception:
+    except Exception as e:
         if not has_symbolic:
             raise
-        # fall back to concrete batch=1 when the program isn't shape-poly safe
+        # fall back to concrete batch=1 when the program isn't shape-poly
+        # safe — loudly, since the saved signature narrows
+        import warnings
+
+        warnings.warn(
+            f"shape-polymorphic export failed ({type(e).__name__}: {e}); "
+            "saving with the -1 dims fixed to 1 — the frozen model will "
+            "only accept that exact shape", RuntimeWarning)
         feed_avals = [
             jax.ShapeDtypeStruct(
                 tuple(1 if d == -1 else d

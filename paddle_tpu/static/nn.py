@@ -14,13 +14,14 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
        weight_attr=None, bias_attr=None):
     from .. import nn, ops
 
-    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    decl = getattr(x, "declared_shape", None) or x.shape
+    if any(d == -1 for d in decl[num_flatten_dims:]):
+        raise ValueError("fc: flattened dims must be static")
+    in_dim = int(np.prod(decl[num_flatten_dims:]))
     layer = nn.Linear(in_dim, size)
     flat = x
-    if len(x.shape) > num_flatten_dims + 1:
-        lead = list(x.shape[:num_flatten_dims])
-        flat = ops.reshape(x, [-1 if any(d == -1 for d in lead) else
-                               int(np.prod(lead)), in_dim])
+    if len(decl) > num_flatten_dims + 1:
+        flat = ops.reshape(x, [-1, in_dim])
     out = layer(flat)
     if activation is not None:
         out = getattr(nn.functional, activation)(out)

@@ -1,0 +1,137 @@
+"""paddle_tpu.sparse: COO/CSR ops vs dense NumPy reference + grads.
+
+Parity model: reference sparse tests (`test/legacy_test/test_sparse_*.py`)
+— construct, convert, op, compare against the dense computation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0):
+    rng = np.random.RandomState(seed)
+    flat = rng.choice(shape[0] * shape[1], size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape)).astype(np.int64)
+    vals = rng.randn(nnz).astype(np.float32)
+    return idx, vals
+
+
+def test_coo_roundtrip():
+    idx, vals = _rand_coo()
+    s = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    d = s.to_dense().numpy()
+    ref = np.zeros((4, 5), np.float32)
+    ref[idx[0], idx[1]] = vals
+    np.testing.assert_allclose(d, ref)
+    s2 = P.to_tensor(ref).to_sparse_coo(2)
+    np.testing.assert_allclose(s2.to_dense().numpy(), ref)
+    assert s.is_sparse_coo() and not s.is_sparse_csr()
+
+
+def test_csr_roundtrip():
+    idx, vals = _rand_coo()
+    s = sparse.sparse_coo_tensor(idx, vals, [4, 5]).to_sparse_csr()
+    assert s.is_sparse_csr()
+    ref = np.zeros((4, 5), np.float32)
+    ref[idx[0], idx[1]] = vals
+    np.testing.assert_allclose(s.to_dense().numpy(), ref)
+    coo_back = s.to_sparse_coo()
+    np.testing.assert_allclose(coo_back.to_dense().numpy(), ref)
+
+
+def test_unary_ops_and_grad():
+    idx, vals = _rand_coo(seed=1)
+    s = sparse.sparse_coo_tensor(idx, np.abs(vals) + 0.5, [4, 5],
+                                 stop_gradient=False)
+    out = sparse.sqrt(s)
+    ref = np.zeros((4, 5), np.float32)
+    ref[idx[0], idx[1]] = np.sqrt(np.abs(vals) + 0.5)
+    np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-6)
+    # grad flows to values
+    loss = P.sum(out.values())
+    loss.backward()
+    g = s.grad.numpy()
+    np.testing.assert_allclose(g, 0.5 / np.sqrt(np.abs(vals) + 0.5),
+                               rtol=1e-5)
+
+
+def test_binary_add_union_pattern():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [1, 1]], [10.0, 20.0], [2, 2])
+    c = sparse.add(a, b)
+    np.testing.assert_allclose(
+        c.to_dense().numpy(), [[1.0, 10.0], [0.0, 22.0]])
+
+
+def test_spmm_vs_dense_and_grad():
+    idx, vals = _rand_coo((4, 5), 7, seed=2)
+    s = sparse.sparse_coo_tensor(idx, vals, [4, 5], stop_gradient=False)
+    dense = P.to_tensor(np.random.RandomState(3).rand(5, 3).astype(
+        np.float32), stop_gradient=False)
+    out = sparse.matmul(s, dense)
+    ref = np.zeros((4, 5), np.float32)
+    ref[idx[0], idx[1]] = vals
+    np.testing.assert_allclose(out.numpy(), ref @ dense.numpy(), rtol=1e-5)
+    P.sum(out).backward()
+    assert s.grad is not None and dense.grad is not None
+    np.testing.assert_allclose(dense.grad.numpy(),
+                               ref.T @ np.ones((4, 3), np.float32),
+                               rtol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(4)
+    x = rng.rand(4, 6).astype(np.float32)
+    y = rng.rand(6, 5).astype(np.float32)
+    idx, _ = _rand_coo((4, 5), 6, seed=5)
+    mask = sparse.sparse_coo_tensor(idx, np.ones(6, np.float32), [4, 5])
+    out = sparse.masked_matmul(P.to_tensor(x), P.to_tensor(y), mask)
+    full = x @ y
+    np.testing.assert_allclose(
+        np.asarray(out.values().numpy()), full[idx[0], idx[1]], rtol=1e-5)
+
+
+def test_csr_softmax_rows():
+    idx, vals = _rand_coo((4, 5), 8, seed=6)
+    csr = sparse.sparse_coo_tensor(idx, vals, [4, 5]).to_sparse_csr()
+    out = sparse.softmax(csr)
+    dense = csr.to_dense().numpy()
+    # reference: softmax over nonzero entries per row
+    ref = np.zeros_like(dense)
+    for i in range(4):
+        nz = dense[i] != 0
+        if nz.any():
+            e = np.exp(dense[i][nz] - dense[i][nz].max())
+            ref[i][nz] = e / e.sum()
+    np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-5)
+
+
+def test_coalesce_sums_duplicates():
+    s = sparse.sparse_coo_tensor([[0, 0, 1], [1, 1, 0]], [1.0, 2.0, 3.0],
+                                 [2, 2])
+    c = s.coalesce()
+    assert c.nnz == 2
+    np.testing.assert_allclose(c.to_dense().numpy(), [[0, 3.0], [3.0, 0]])
+
+
+def test_sparse_nn_layers():
+    idx, vals = _rand_coo((4, 5), 6, seed=7)
+    s = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    out = sparse.nn.ReLU()(s)
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               np.maximum(s.to_dense().numpy(), 0))
+
+
+def test_subm_conv3d_keeps_pattern():
+    rng = np.random.RandomState(8)
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 1, 1] = rng.rand(2)
+    dense[0, 2, 3, 0] = rng.rand(2)
+    s = P.to_tensor(dense).to_sparse_coo(4)
+    conv = sparse.nn.SubmConv3D(2, 3, 3, padding=1)
+    out = conv(s)
+    assert out.dense_shape == (1, 4, 4, 4, 3)
+    np.testing.assert_array_equal(np.asarray(out.indices_arr),
+                                  np.asarray(s.indices_arr))
