@@ -1,0 +1,223 @@
+"""paddle_tpu.audio: audio feature extraction.
+
+Role parity: `paddle.audio` (`python/paddle/audio/`) — functional window/
+mel utilities and the Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC
+feature layers built on the stft stack (which lives in
+`paddle_tpu.signal`/`paddle_tpu.fft`, the pocketfft analog).
+
+TPU-first: features are pure jnp pipelines (frame → window → rFFT → mel
+matmul) that fuse under jit; the mel filterbank is a precomputed dense
+matrix so the projection is an MXU matmul.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["functional", "features"]
+
+
+class functional:
+    """paddle.audio.functional parity."""
+
+    @staticmethod
+    def hz_to_mel(freq, htk=False):
+        if htk:
+            return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+        f = np.asarray(freq, np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(f / min_log_hz) / logstep,
+                        mels)
+
+    @staticmethod
+    def mel_to_hz(mel, htk=False):
+        if htk:
+            return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+        m = np.asarray(mel, np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                        freqs)
+
+    @staticmethod
+    def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+        lo = functional.hz_to_mel(f_min, htk)
+        hi = functional.hz_to_mel(f_max, htk)
+        return functional.mel_to_hz(np.linspace(lo, hi, n_mels), htk)
+
+    @staticmethod
+    def fft_frequencies(sr, n_fft):
+        return np.linspace(0, sr / 2, n_fft // 2 + 1)
+
+    @staticmethod
+    def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                             htk=False, norm="slaney", dtype="float32"):
+        f_max = f_max or sr / 2.0
+        fft_freqs = functional.fft_frequencies(sr, n_fft)
+        mel_f = functional.mel_frequencies(n_mels + 2, f_min, f_max, htk)
+        fdiff = np.diff(mel_f)
+        ramps = mel_f[:, None] - fft_freqs[None, :]
+        weights = np.zeros((n_mels, len(fft_freqs)))
+        for i in range(n_mels):
+            lower = -ramps[i] / fdiff[i]
+            upper = ramps[i + 2] / fdiff[i + 1]
+            weights[i] = np.maximum(0, np.minimum(lower, upper))
+        if norm == "slaney":
+            enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+            weights *= enorm[:, None]
+        return Tensor(weights.astype(dtype))
+
+    @staticmethod
+    def get_window(window, win_length, fftbins=True, dtype="float32"):
+        n = win_length
+        if isinstance(window, (tuple, list)):
+            name, *params = window
+        else:
+            name, params = window, []
+        periodic = fftbins
+        m = n + 1 if periodic else n
+        k = np.arange(m)
+        if name in ("hann", "hanning"):
+            w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+        elif name == "hamming":
+            w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (m - 1))
+        elif name == "blackman":
+            w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+                 + 0.08 * np.cos(4 * np.pi * k / (m - 1)))
+        elif name == "bartlett":
+            w = 1.0 - np.abs(2 * k / (m - 1) - 1.0)
+        elif name in ("rect", "rectangular", "boxcar", "ones"):
+            w = np.ones(m)
+        elif name == "gaussian":
+            std = params[0] if params else 0.4 * (m - 1) / 2
+            w = np.exp(-0.5 * ((k - (m - 1) / 2) / std) ** 2)
+        else:
+            raise ValueError(f"unknown window {name!r}")
+        if periodic:
+            w = w[:-1]
+        return Tensor(w.astype(dtype))
+
+    @staticmethod
+    def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+        def f(s):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+            log_spec = log_spec - 10.0 * jnp.log10(
+                jnp.maximum(amin, ref_value))
+            if top_db is not None:
+                log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+            return log_spec
+
+        return apply("power_to_db", f,
+                     spect if isinstance(spect, Tensor) else Tensor(spect))
+
+    @staticmethod
+    def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+        n = np.arange(n_mels)
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
+        if norm == "ortho":
+            dct[0] *= 1.0 / np.sqrt(2)
+            dct *= np.sqrt(2.0 / n_mels)
+        else:
+            dct *= 2.0
+        return Tensor(dct.T.astype(dtype))
+
+
+class _Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window_t = functional.get_window(window, self.win_length,
+                                              dtype=dtype)
+
+    def forward(self, x):
+        from .. import signal
+
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           self.window_t, center=self.center,
+                           pad_mode=self.pad_mode)
+
+        def mag(s):
+            return jnp.abs(s) ** self.power
+
+        return apply("spectrogram_mag", mag, spec)
+
+
+class _MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = _Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank = functional.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        from .. import ops
+
+        spec = self.spectrogram(x)  # [..., freq, time]
+        return ops.matmul(self.fbank, spec)
+
+
+class _LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__()
+        self.mel = _MelSpectrogram(*args, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return functional.power_to_db(self.mel(x), self.ref_value,
+                                      self.amin, self.top_db)
+
+
+class _MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_mels=64, **kw):
+        super().__init__()
+        self.log_mel = _LogMelSpectrogram(sr=sr, n_mels=n_mels, **kw)
+        self.dct = functional.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        from .. import ops
+
+        lm = self.log_mel(x)  # [..., n_mels, T]
+        # dct: [n_mels, n_mfcc] → project the mel axis: [..., n_mfcc, T]
+        perm = list(range(lm.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        t = ops.transpose(lm, perm)           # [..., T, n_mels]
+        proj = ops.matmul(t, self.dct)        # [..., T, n_mfcc]
+        return ops.transpose(proj, perm)      # [..., n_mfcc, T]
+
+
+class features:
+    Spectrogram = _Spectrogram
+    MelSpectrogram = _MelSpectrogram
+    LogMelSpectrogram = _LogMelSpectrogram
+    MFCC = _MFCC

@@ -1,0 +1,200 @@
+"""paddle_tpu.geometric: graph-learning message passing + sampling.
+
+Role parity: `paddle.geometric` (`python/paddle/geometric/`, SURVEY §2.8) —
+`send_u_recv`/`send_ue_recv`/`send_uv` message passing, segment reductions,
+neighbor sampling, and reindexing.
+
+TPU-first: message passing is gather + `jax.ops.segment_*` with a static
+num_segments (out_size) — the layout XLA vectorizes; no dynamic-shape
+scatter kernels as in the reference's CUDA `graph_send_recv` ops. Sampling
+and reindex are host-side (numpy) as in the reference's CPU path: they
+produce the static shapes the device graph then consumes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "sample_neighbors", "reindex_graph", "weighted_sample_neighbors",
+]
+
+
+def _ival(x):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return v.astype(jnp.int32)
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment_reduce(data, seg, n, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, seg, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  seg, num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (data.ndim - 1))
+    out = _REDUCERS[pool](data, seg, num_segments=n)
+    if pool in ("max", "min"):
+        # empty segments come back ±inf; zero them as the reference does
+        out = jnp.where(jnp.isfinite(out), out, jnp.zeros((), out.dtype))
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst (paddle.geometric.send_u_recv,
+    reference kernel `paddle/phi/kernels/gpu/graph_send_recv_kernel.cu`)."""
+    src = _ival(src_index)
+    dst = _ival(dst_index)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+    pool = reduce_op.lower()
+
+    def f(xv):
+        return _segment_reduce(xv[src], dst, n, pool)
+
+    return apply("send_u_recv", f, x)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Messages combine node features x[src] with edge features y."""
+    src = _ival(src_index)
+    dst = _ival(dst_index)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+    pool = reduce_op.lower()
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.true_divide}[message_op.lower()]
+
+    def f(xv, yv):
+        return _segment_reduce(combine(xv[src], yv), dst, n, pool)
+
+    return apply("send_ue_recv", f, x, y)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages combining x[src] with y[dst] (no reduce)."""
+    src = _ival(src_index)
+    dst = _ival(dst_index)
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.true_divide}[message_op.lower()]
+
+    def f(xv, yv):
+        return combine(xv[src], yv[dst])
+
+    return apply("send_uv", f, x, y)
+
+
+def _segment_api(pool):
+    def g(data, segment_ids, name=None):
+        seg = _ival(segment_ids)
+        n = int(np.asarray(seg).max()) + 1 if seg.shape[0] else 0
+
+        def f(d):
+            return _segment_reduce(d, seg, n, pool)
+
+        return apply(f"segment_{pool}", f, data)
+
+    g.__name__ = f"segment_{pool}"
+    return g
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_max = _segment_api("max")
+segment_min = _segment_api("min")
+
+
+# --- host-side sampling/reindex (CPU path parity) ---------------------------
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on a CSC graph (host-side numpy; parity:
+    `paddle.geometric.sample_neighbors`)."""
+    rowv = np.asarray(row._value if isinstance(row, Tensor) else row)
+    colp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes)
+    out_nb, out_cnt, out_eids = [], [], []
+    rng = np.random
+    for nd in nodes.ravel():
+        lo, hi = int(colp[nd]), int(colp[nd + 1])
+        nbrs = rowv[lo:hi]
+        ids = np.arange(lo, hi)
+        if sample_size != -1 and len(nbrs) > sample_size:
+            pick = rng.choice(len(nbrs), size=sample_size, replace=False)
+            nbrs = nbrs[pick]
+            ids = ids[pick]
+        out_nb.append(nbrs)
+        out_eids.append(ids)
+        out_cnt.append(len(nbrs))
+    nbr = Tensor(np.concatenate(out_nb) if out_nb
+                 else np.zeros(0, rowv.dtype))
+    cnt = Tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        return nbr, cnt, Tensor(np.concatenate(out_eids)
+                                if out_eids else np.zeros(0, np.int64))
+    return nbr, cnt
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    rowv = np.asarray(row._value if isinstance(row, Tensor) else row)
+    colp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    w = np.asarray(edge_weight._value if isinstance(edge_weight, Tensor)
+                   else edge_weight)
+    nodes = np.asarray(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes)
+    out_nb, out_cnt = [], []
+    for nd in nodes.ravel():
+        lo, hi = int(colp[nd]), int(colp[nd + 1])
+        nbrs = rowv[lo:hi]
+        ww = w[lo:hi]
+        if sample_size != -1 and len(nbrs) > sample_size:
+            p = ww / ww.sum()
+            pick = np.random.choice(len(nbrs), size=sample_size,
+                                    replace=False, p=p)
+            nbrs = nbrs[pick]
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    return (Tensor(np.concatenate(out_nb) if out_nb
+                   else np.zeros(0, rowv.dtype)),
+            Tensor(np.asarray(out_cnt, np.int32)))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (parity:
+    `paddle.geometric.reindex_graph`)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x).ravel()
+    nb = np.asarray(
+        neighbors._value if isinstance(neighbors, Tensor)
+        else neighbors).ravel()
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count)
+    mapping = {}
+    for nd in xv:
+        mapping.setdefault(int(nd), len(mapping))
+    for nd in nb:
+        mapping.setdefault(int(nd), len(mapping))
+    reindex_nb = np.asarray([mapping[int(v)] for v in nb], np.int64)
+    # reconstruct dst from counts: node i repeated count[i] times
+    dst = np.repeat(np.arange(len(xv)), cnt)
+    nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
+    return Tensor(reindex_nb), Tensor(dst), Tensor(nodes)

@@ -1,4 +1,5 @@
 """paddle.incubate parity surface."""
+from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from .distributed.models import moe  # noqa: F401
 from .distributed.models.moe import MoELayer  # noqa: F401

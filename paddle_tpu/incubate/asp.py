@@ -1,0 +1,115 @@
+"""ASP: automatic (n:m) structured sparsity.
+
+Role parity: `python/paddle/incubate/asp/asp.py` (SURVEY §2.8) — compute
+n:m sparse masks for weights, prune a model, and keep the masks applied
+across optimizer steps via `decorate`.
+
+TPU note: the reference targets Ampere 2:4 sparse tensor cores; TPUs have
+no structured-sparsity MXU mode, so the win here is model-size/regularizer
+parity — masks are plain elementwise multiplies that XLA fuses into the
+matmul's producer. The workflow API is kept identical.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_supported_layers_cache = {}
+_masks = {}  # id(param) -> jnp mask
+
+
+def calculate_density(mat):
+    arr = np.asarray(mat._value if isinstance(mat, Tensor) else mat)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d_block(block, n, m):
+    """Keep the n largest-|.| entries of an m-block."""
+    keep = np.argsort(-np.abs(block))[:n]
+    mask = np.zeros_like(block, dtype=bool)
+    mask[keep] = True
+    return mask
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m mask along the last axis (numpy offline computation, as the
+    reference's mask calc is)."""
+    arr = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    orig_shape = arr.shape
+    flat = arr.reshape(-1, orig_shape[-1])
+    cols = orig_shape[-1]
+    if cols % m != 0:
+        raise ValueError(f"last dim {cols} not divisible by m={m}")
+    blocks = flat.reshape(flat.shape[0], cols // m, m)
+    mask = np.zeros_like(blocks, dtype=bool)
+    for i in range(blocks.shape[0]):
+        for j in range(blocks.shape[1]):
+            mask[i, j] = _mask_1d_block(blocks[i, j], n, m)
+    return Tensor(mask.reshape(orig_shape).astype(arr.dtype))
+
+
+def check_sparsity(mat, n=2, m=4, func_name="check_1d"):
+    arr = np.asarray(mat._value if isinstance(mat, Tensor) else mat)
+    flat = arr.reshape(-1, arr.shape[-1])
+    if arr.shape[-1] % m != 0:
+        return False
+    blocks = flat.reshape(flat.shape[0], -1, m)
+    nnz = (blocks != 0).sum(axis=-1)
+    return bool((nnz <= n).all())
+
+
+def _prunable_params(model):
+    from ..nn.layers_common import Linear
+    from ..nn.layers_conv_pool import Conv2D
+
+    out = []
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (Linear, Conv2D)) and hasattr(layer, "weight"):
+            w = layer.weight
+            if w.ndim >= 2 and w.shape[-1] % 4 == 0:
+                out.append(w)
+    return out
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to the supported weights; remember masks so
+    `decorate`d optimizers re-apply them after each step."""
+    pruned = {}
+    for w in _prunable_params(model):
+        mask = create_mask(w, func_name=mask_algo, n=n, m=m)
+        mval = jnp.asarray(mask._value)
+        w._value = w._value * mval.astype(w._value.dtype)
+        if with_mask:
+            _masks[id(w)] = mval
+        pruned[id(w)] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply the recorded masks after updates
+    (parity: ASPHelper._decorate / OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameter_list or []:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask.astype(p._value.dtype)
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    _masks.clear()
+
+
+def set_excluded_layers(model, layer_names):
+    # name-based exclusion: drop masks of matching sublayers
+    for name, sub in model.named_sublayers():
+        if name in layer_names and hasattr(sub, "weight"):
+            _masks.pop(id(sub.weight), None)

@@ -137,12 +137,20 @@ class SparseCooTensor:
     def transpose(self, perm):
         nd = len(self.dense_shape)
         sd = self.indices_arr.shape[0]
-        if any(p >= sd for p in perm[:sd]) and sd != nd:
+        if any(p >= sd for p in perm[:sd]) or any(p < sd for p in perm[sd:]):
             raise NotImplementedError(
                 "transpose mixing sparse and dense dims")
         new_idx = jnp.stack([self.indices_arr[p] for p in perm[:sd]])
         new_shape = tuple(self.dense_shape[p] for p in perm)
-        return SparseCooTensor(new_idx, self.values_t, new_shape)
+        vals = self.values_t
+        if sd < nd:
+            # permute the trailing dense dims of values ([nnz, *dense_dims])
+            val_perm = [0] + [1 + (perm[i] - sd) for i in range(sd, nd)]
+            if val_perm != list(range(nd - sd + 1)):
+                from .. import ops
+
+                vals = ops.transpose(vals, val_perm)
+        return SparseCooTensor(new_idx, vals, new_shape)
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
@@ -246,6 +254,9 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
     if dtype is not None:
         vals = vals.astype(dtype)
     if shape is None:
+        if idx.shape[1] == 0:
+            raise ValueError(
+                "shape is required for an empty (nnz=0) sparse tensor")
         sparse_shape = [int(i) + 1 for i in np.asarray(idx.max(axis=1))]
         shape = sparse_shape + list(vals.shape[1:])
     vals.stop_gradient = stop_gradient
@@ -318,7 +329,9 @@ def cast(x, index_dtype=None, value_dtype=None):
 
 
 def scale(x, scale_val, bias=0.0, bias_after_scale=True):
-    return _unary("scale", lambda v: v * scale_val + bias)(x)
+    if bias_after_scale:
+        return _unary("scale", lambda v: v * scale_val + bias)(x)
+    return _unary("scale", lambda v: (v + bias) * scale_val)(x)
 
 
 # --- binary -----------------------------------------------------------------
@@ -434,12 +447,18 @@ def mv(x, vec, name=None):
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     if axis is None:
-        out_vals = apply("sparse_sum_all", lambda v: jnp.sum(v),
-                         x.values())
-        return out_vals
-    return apply("sparse_sum_axis",
-                 lambda d: jnp.sum(d, axis=axis, keepdims=keepdim),
-                 x.to_dense())
+        out = apply("sparse_sum_all", lambda v: jnp.sum(v), x.values())
+        return out.astype(dtype) if dtype is not None else out
+    dense = apply("sparse_sum_axis",
+                  lambda d: jnp.sum(d, axis=axis, keepdims=keepdim),
+                  x.to_dense())
+    if dtype is not None:
+        dense = dense.astype(dtype)
+    # paddle.sparse.sum stays sparse
+    out = to_sparse_coo_from_dense(dense)
+    if isinstance(x, SparseCsrTensor) and out.ndim == 2:
+        return out.to_sparse_csr()
+    return out
 
 
 def transpose(x, perm, name=None):
@@ -494,10 +513,11 @@ def to_sparse_coo_from_dense(dense, sparse_dim=None):
 
 # softmax over CSR rows (sparse attention building block)
 def softmax(x, axis=-1, name=None):
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError(
+            "sparse softmax supports the last axis only (per-row)")
     if isinstance(x, SparseCooTensor):
-        return x.to_sparse_csr_softmax_fallback() \
-            if hasattr(x, "to_sparse_csr_softmax_fallback") \
-            else _coo_softmax(x)
+        return _coo_softmax(x)
     rows = x._rows()
     m = x.dense_shape[0]
 

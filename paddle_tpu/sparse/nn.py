@@ -127,11 +127,14 @@ class _ConvNd(Layer):
             else [stride] * 3
         self.padding = padding if isinstance(padding, (list, tuple)) \
             else [padding] * 3
+        self.dilation = dilation if isinstance(dilation, (list, tuple)) \
+            else [dilation] * 3
+        self.groups = groups
         self.subm = subm
         self.in_channels = in_channels
         self.out_channels = out_channels
         self.weight = self.create_parameter(
-            list(ks) + [in_channels, out_channels])
+            list(ks) + [in_channels // groups, out_channels])
         self.bias = self.create_parameter([out_channels], is_bias=True)
 
     def forward(self, x):
@@ -148,6 +151,8 @@ class _ConvNd(Layer):
                 d, w,
                 window_strides=self.stride,
                 padding=[(p, p) for p in self.padding],
+                rhs_dilation=self.dilation,
+                feature_group_count=self.groups,
                 dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
             return out + b
 

@@ -65,14 +65,21 @@ def _apply_grad_clip(clip, grads):
     return grads
 
 
-def _prune_ops(ops, fetch_vids):
-    """Keep only compute ops reaching the fetches (non-compute ops —
-    backward/update — always run, plus their dependency chains)."""
-    needed = set(fetch_vids)
+def _backward_reach(ops, seed_vids, include_noncompute=True):
+    """THE reverse reachability walk (single source of truth for
+    Executor pruning, feed checking, and save_inference_model pruning).
+
+    Returns (kept_ops_in_order, needed_vids). Compute ops are kept iff they
+    produce a needed vid; backward/update ops are kept when
+    `include_noncompute` (training execution) and dropped otherwise
+    (inference freezing)."""
+    needed = set(seed_vids)
     kept = []
     for op in reversed(ops):
-        wanted = op.kind != "compute" or set(op.out_vids) & needed
-        if not wanted:
+        if op.kind == "compute":
+            if not (set(op.out_vids) & needed):
+                continue
+        elif not include_noncompute:
             continue
         kept.append(op)
         needed.update(v for k, v in op.leafspec if k == "var")
@@ -80,12 +87,12 @@ def _prune_ops(ops, fetch_vids):
             needed.add(op.extra["loss_vid"])
         elif op.kind == "update":
             needed.update(gv for _, gv, _, _ in op.extra["items"])
-    return list(reversed(kept))
+    return list(reversed(kept)), needed
 
 
 def _build(program, feed_names, fetch_vids, scope_keys):
     """Build the pure whole-program function for jax.jit."""
-    ops = _prune_ops(program.ops, fetch_vids)
+    ops, _ = _backward_reach(program.ops, fetch_vids)
     bwd_idx = next((i for i, o in enumerate(ops) if o.kind == "backward"),
                    None)
     # statically-known set of captures an update op writes back
@@ -268,15 +275,8 @@ class Executor:
 
 
 def _feeds_needed(program, fetch_vids):
-    """Conservative reachability: which feed names can influence fetches."""
-    needed_vids = set(fetch_vids)
-    for op in reversed(program.ops):
-        if set(op.out_vids) & needed_vids or op.kind != "compute":
-            for kind, v in op.leafspec:
-                if kind == "var":
-                    needed_vids.add(v)
-            if op.kind == "backward":
-                needed_vids.add(op.extra["loss_vid"])
+    """Which feed names can influence fetches or training ops."""
+    _, needed_vids = _backward_reach(program.ops, fetch_vids)
     return {n for n, v in program.feed_vars.items() if v.vid in needed_vids}
 
 

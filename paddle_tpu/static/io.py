@@ -36,16 +36,12 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     # ops (backward/update) and unrelated feeds drop out
     import copy
 
-    needed = set(fetch_vids)
-    keep = []
-    for op in reversed(program.ops):
-        if op.kind != "compute":
-            continue
-        if set(op.out_vids) & needed:
-            keep.append(op)
-            needed.update(v for k, v in op.leafspec if k == "var")
+    from .executor import _backward_reach
+
+    keep, needed = _backward_reach(program.ops, fetch_vids,
+                                   include_noncompute=False)
     pruned = copy.copy(program)
-    pruned.ops = list(reversed(keep))
+    pruned.ops = keep
     unresolved = needed - {v.vid for v in feed_vars} \
         - {vid for op in pruned.ops for vid in op.out_vids}
     if unresolved:

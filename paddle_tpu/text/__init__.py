@@ -1,0 +1,120 @@
+"""paddle_tpu.text: text-domain utilities.
+
+Role parity: `paddle.text` (`python/paddle/text/`) — dataset helpers plus
+`viterbi_decode` (the one compute op; reference kernel
+`paddle/phi/kernels/cpu/viterbi_decode_kernel.cc`).
+
+TPU-first: Viterbi is a `lax.scan` over the sequence (compiler-friendly,
+batched); datasets are host-side iterators as in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decoding (parity: paddle.text.viterbi_decode).
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N];
+    lengths: [B] int. Returns (scores [B], paths [B, T]).
+    """
+    lens = (lengths._value if isinstance(lengths, Tensor)
+            else jnp.asarray(lengths)).astype(jnp.int32)
+
+    def f(pot, trans):
+        B, T, N = pot.shape
+        if include_bos_eos_tag:
+            # reference semantics: tag N-2 is BOS, N-1 is EOS
+            start = trans[N - 2, :][None, :]
+            init = pot[:, 0] + start
+        else:
+            init = pot[:, 0]
+
+        def step(carry, t):
+            alpha, history_dummy = carry
+            # alpha: [B, N]; trans: [N, N]; emission at t: [B, N]
+            scores = alpha[:, :, None] + trans[None, :, :]  # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+            best_score = jnp.max(scores, axis=1) + pot[:, t]
+            # positions beyond length keep previous alpha
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, best_score, alpha)
+            return (new_alpha, 0), jnp.where(
+                active, best_prev, jnp.arange(N)[None, :])
+
+        (alpha, _), history = jax.lax.scan(
+            step, (init, 0), jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None, :]
+        last_tag = jnp.argmax(alpha, axis=-1)               # [B]
+        score = jnp.max(alpha, axis=-1)
+
+        # backtrace through history [T-1, B, N]
+        def back(tag, hist_t):
+            # hist_t[b, j] = best predecessor of tag j at this step; emit the
+            # predecessor so ys[t] lines up with path position t
+            prev = jnp.take_along_axis(hist_t, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        _, tags_rev = jax.lax.scan(back, last_tag, history, reverse=True)
+        paths = jnp.concatenate(
+            [tags_rev.transpose(1, 0), last_tag[:, None]], axis=1)
+        return score, paths.astype(jnp.int32)
+
+    pt = potentials if isinstance(potentials, Tensor) else Tensor(potentials)
+    tt = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(transition_params)
+    return apply("viterbi_decode", f, pt, tt)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class datasets:
+    """Dataset stubs: the reference downloads corpora (Imdb, Conll05st,
+    …); no network egress here, so constructors raise with guidance."""
+
+    class _NeedsDownload:
+        def __init__(self, *a, **kw):
+            raise RuntimeError(
+                f"{type(self).__name__} requires dataset download; provide "
+                "local files via paddle_tpu.io.Dataset instead")
+
+    class Imdb(_NeedsDownload):
+        pass
+
+    class Imikolov(_NeedsDownload):
+        pass
+
+    class Movielens(_NeedsDownload):
+        pass
+
+    class Conll05st(_NeedsDownload):
+        pass
+
+    class UCIHousing(_NeedsDownload):
+        pass
+
+    class WMT14(_NeedsDownload):
+        pass
+
+    class WMT16(_NeedsDownload):
+        pass

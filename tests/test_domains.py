@@ -1,0 +1,132 @@
+"""geometric / text / audio domain packages.
+
+Parity model: reference tests `test/legacy_test/test_graph_send_recv_op.py`,
+`test_viterbi_decode_op.py`, `test/legacy_test/test_audio_functions.py`.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import audio, geometric, text
+
+
+# --- geometric ---------------------------------------------------------------
+
+def test_send_u_recv_sum_mean():
+    x = P.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    src = P.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = P.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    ref = np.zeros((4, 3), np.float32)
+    for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+        ref[d] += x.numpy()[s]
+    np.testing.assert_allclose(out.numpy(), ref)
+    out_mean = geometric.send_u_recv(x, src, dst, reduce_op="mean")
+    ref_mean = ref.copy()
+    ref_mean[1] /= 2
+    np.testing.assert_allclose(out_mean.numpy(), ref_mean)
+
+
+def test_send_u_recv_max_empty_segment_zero():
+    x = P.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    src = P.to_tensor(np.array([0, 1], np.int32))
+    dst = P.to_tensor(np.array([0, 0], np.int32))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="max", out_size=3)
+    np.testing.assert_allclose(out.numpy(), [[2.0], [0.0], [0.0]])
+
+
+def test_send_ue_recv_and_grad():
+    x = P.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+    e = P.to_tensor(np.full((4, 2), 0.5, np.float32))
+    src = np.array([0, 1, 2, 0], np.int32)
+    dst = np.array([1, 2, 0, 2], np.int32)
+    out = geometric.send_ue_recv(x, e, P.to_tensor(src), P.to_tensor(dst),
+                                 message_op="mul", reduce_op="sum")
+    P.sum(out).backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1.0, 1.0], [0.5, 0.5], [0.5, 0.5]])
+
+
+def test_segment_ops():
+    data = P.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    seg = P.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(
+        geometric.segment_sum(data, seg).numpy(), [[3.0], [3.0]])
+    np.testing.assert_allclose(
+        geometric.segment_mean(data, seg).numpy(), [[1.5], [3.0]])
+    np.testing.assert_allclose(
+        geometric.segment_max(data, seg).numpy(), [[2.0], [3.0]])
+
+
+def test_sample_and_reindex():
+    # CSC: node j's in-neighbors are row[colptr[j]:colptr[j+1]]
+    row = np.array([1, 2, 0, 2, 0, 1], np.int64)
+    colptr = np.array([0, 2, 4, 6], np.int64)
+    nbr, cnt = geometric.sample_neighbors(
+        P.to_tensor(row), P.to_tensor(colptr),
+        P.to_tensor(np.array([0, 2], np.int64)))
+    assert cnt.numpy().tolist() == [2, 2]
+    re_nb, dst, nodes = geometric.reindex_graph(
+        P.to_tensor(np.array([0, 2], np.int64)), nbr, cnt)
+    assert nodes.numpy()[0] == 0 and nodes.numpy()[1] == 2
+    assert dst.numpy().tolist() == [0, 0, 1, 1]
+
+
+# --- text --------------------------------------------------------------------
+
+def test_viterbi_decode_simple():
+    # 2 tags + BOS/EOS = 4 states; deterministic argmax chain
+    np.random.seed(0)
+    B, T, N = 2, 5, 4
+    pot = np.random.rand(B, T, N).astype(np.float32)
+    trans = np.random.rand(N, N).astype(np.float32)
+    lens = np.array([5, 5], np.int64)
+    scores, paths = text.viterbi_decode(
+        P.to_tensor(pot), P.to_tensor(trans), P.to_tensor(lens),
+        include_bos_eos_tag=False)
+    assert list(paths.shape) == [B, T]
+    # brute-force reference for batch 0
+    best = None
+    from itertools import product
+
+    for seq in product(range(N), repeat=T):
+        s = pot[0, 0, seq[0]]
+        for t in range(1, T):
+            s += trans[seq[t - 1], seq[t]] + pot[0, t, seq[t]]
+        if best is None or s > best[0]:
+            best = (s, seq)
+    np.testing.assert_allclose(float(scores.numpy()[0]), best[0], rtol=1e-5)
+    assert paths.numpy()[0].tolist() == list(best[1])
+
+
+# --- audio -------------------------------------------------------------------
+
+def test_windows_and_mel():
+    w = audio.functional.get_window("hann", 16)
+    assert w.shape == [16]
+    np.testing.assert_allclose(w.numpy()[0], 0.0, atol=1e-7)
+    fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40)
+    assert fb.shape == [40, 257]
+    assert float(np.asarray(fb.numpy()).min()) >= 0.0
+
+
+def test_spectrogram_and_mfcc_shapes():
+    sr, n_fft, hop = 16000, 256, 128
+    x = P.to_tensor(np.random.RandomState(0).randn(2, 1600)
+                    .astype(np.float32))
+    spec = audio.features.Spectrogram(n_fft=n_fft, hop_length=hop)(x)
+    assert spec.shape[0] == 2 and spec.shape[1] == n_fft // 2 + 1
+    mel = audio.features.MelSpectrogram(sr=sr, n_fft=n_fft, hop_length=hop,
+                                        n_mels=32)(x)
+    assert mel.shape[1] == 32
+    mfcc = audio.features.MFCC(sr=sr, n_mfcc=13, n_mels=32, n_fft=n_fft,
+                               hop_length=hop)(x)
+    assert mfcc.shape[1] == 13
+    db = audio.functional.power_to_db(mel)
+    assert db.shape == mel.shape
+
+
+def test_text_dataset_stub_raises():
+    with pytest.raises(RuntimeError):
+        text.datasets.Imdb()

@@ -1,0 +1,82 @@
+"""Quantization (QAT/PTQ) + ASP 2:4 sparsity workflows.
+
+Parity model: reference `test/quantization/` (QAT swap + convert) and
+`test/asp/` (mask creation, prune_model, optimizer guarantee).
+"""
+import numpy as np
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+from paddle_tpu.incubate import asp
+
+
+def _model():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_qat_swaps_and_trains():
+    m = _model()
+    cfg = Q.QuantConfig(
+        activation=Q.quanters.FakeQuanterWithAbsMaxObserver,
+        weight=Q.quanters.FakeQuanterChannelWiseAbsMax)
+    qat = Q.QAT(cfg)
+    qm = qat.quantize(m, inplace=False)
+    kinds = [type(l).__name__ for l in qm.sublayers()]
+    assert "QuantedLinear" in kinds
+    x = P.to_tensor(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    out = qm(x)
+    assert out.shape == [4, 4]
+    loss = P.mean(P.square(out))
+    loss.backward()
+    params = [p for p in qm.parameters() if not p.stop_gradient]
+    assert any(p.grad is not None for p in params)
+    # quantized forward stays near float forward (8-bit)
+    ref = m(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=0.1)
+
+
+def test_qat_type_config_targets_only_linear():
+    m = _model()
+    cfg = Q.QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(nn.Linear,
+                        weight=Q.quanters.FakeQuanterChannelWiseAbsMax)
+    qm = Q.QAT(cfg).quantize(m)
+    assert sum(isinstance(l, Q.QuantedLinear) for l in qm.sublayers()) == 2
+
+
+def test_ptq_observe_convert():
+    m = _model()
+    cfg = Q.QuantConfig(activation=Q.observers.AbsmaxObserver, weight=None)
+    ptq = Q.PTQ(cfg)
+    qm = ptq.quantize(m)
+    rng = np.random.RandomState(1)
+    for _ in range(3):  # calibration
+        qm(P.to_tensor(rng.rand(4, 8).astype(np.float32)))
+    frozen = ptq.convert(qm)
+    x = P.to_tensor(rng.rand(4, 8).astype(np.float32))
+    out = frozen(x)
+    np.testing.assert_allclose(out.numpy(), m(x).numpy(), atol=0.2)
+
+
+def test_asp_mask_and_density():
+    w = P.to_tensor(np.random.RandomState(2).randn(8, 8).astype(np.float32))
+    mask = asp.create_mask(w, n=2, m=4)
+    masked = w.numpy() * mask.numpy()
+    assert asp.check_sparsity(P.to_tensor(masked), n=2, m=4)
+    assert abs(asp.calculate_density(P.to_tensor(masked)) - 0.5) < 1e-6
+
+
+def test_asp_prune_model_and_decorate():
+    m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    asp.prune_model(m, n=2, m=4)
+    assert asp.check_sparsity(m[0].weight, n=2, m=4)
+    opt = asp.decorate(P.optimizer.SGD(
+        0.1, parameters=list(m.parameters())))
+    x = P.to_tensor(np.random.RandomState(3).rand(4, 8).astype(np.float32))
+    loss = P.mean(P.square(m(x)))
+    loss.backward()
+    opt.step()
+    # sparsity survives the update
+    assert asp.check_sparsity(m[0].weight, n=2, m=4)
+    assert asp.check_sparsity(m[2].weight, n=2, m=4)
