@@ -116,9 +116,67 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
     return F.dropout(x, p, training=training, mode=mode) + y
 
 
-def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
-    raise NotImplementedError(
-        "decode-time masked MHA lands with the serving/KV-cache milestone")
+def masked_multihead_attention(x, cache_kv=None, src_mask=None,
+                               sequence_lengths=None, rotary_embs=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", name=None):
+    """Decode-step attention over a KV cache (parity:
+    `incubate.nn.functional.masked_multihead_attention`, reference kernel
+    `paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu`).
+
+    x: [B, 3*H*D] fused qkv of the CURRENT token. cache_kv: [2, B, H, S, D].
+    sequence_lengths: [B] number of tokens already in the cache (write
+    position). Returns (out [B, H*D], updated cache_kv).
+
+    TPU-first: the cache update is a static-shape scatter
+    (`.at[b, :, pos].set`) and attention runs over the full cache with a
+    position mask — fixed shapes every step, so the decode loop compiles
+    once; XLA fuses mask+softmax+weighted-sum into the two einsums.
+    """
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    unsupported = {"rotary_embs": rotary_embs,
+                   "beam_cache_offset": beam_cache_offset,
+                   "qkv_out_scale": qkv_out_scale, "out_shift": out_shift,
+                   "out_smooth": out_smooth}
+    bad = [k for k, v in unsupported.items() if v is not None]
+    if rotary_emb_dims:
+        bad.append("rotary_emb_dims")
+    if bad:
+        raise NotImplementedError(
+            f"masked_multihead_attention: {bad} not supported yet — apply "
+            "RoPE before the qkv fuse (models.llama does) and dequant "
+            "outside")
+    _, B, H, S, D = cache_kv.shape
+
+    if sequence_lengths is None:
+        raise ValueError("sequence_lengths ([B] int32 write positions) is "
+                         "required in this implementation")
+
+    def f(xv, cache, pos, mask):
+        qkv = xv.reshape(B, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        pos = pos.reshape(-1).astype(jnp.int32)
+        bidx = jnp.arange(B)
+        kcache = cache[0].at[bidx, :, pos, :].set(k)
+        vcache = cache[1].at[bidx, :, pos, :].set(v)
+        valid = (jnp.arange(S)[None, None, :]
+                 <= pos[:, None, None])                       # [B,1,S]
+        scores = jnp.einsum("bhd,bhsd->bhs", q, kcache) \
+            * (1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))).astype(q.dtype)
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+        scores = jnp.where(valid, scores, neg)
+        if mask is not None:
+            scores = scores + mask.reshape(B, 1, -1)[:, :, :S]
+        p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+            vcache.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", p, vcache)
+        return out.reshape(B, H * D), jnp.stack([kcache, vcache])
+
+    return apply("masked_multihead_attention", f, x, cache_kv,
+                 sequence_lengths, src_mask)
 
 
 def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,

@@ -1,4 +1,9 @@
 from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingCriterion, gpt_1p3b,
-    gpt_6p7b, gpt_tiny, llama_7b,
+    gpt_6p7b, gpt_tiny,
+)
+from .gpt import llama_7b as gpt_llama_7b  # noqa: F401 (legacy alias)
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
+    llama2_70b_shapes, llama_13b, llama_7b, llama_pipe_layers, llama_tiny,
 )

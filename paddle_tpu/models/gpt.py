@@ -82,7 +82,16 @@ class GPTAttention(nn.Layer):
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
         if self.cfg.use_rope:
-            q, k, _ = F.fused_rotary_position_embedding(q, k, None)
+            position_ids = None
+            if cache is not None:
+                # decode: rotary phases continue from the cached length
+                import numpy as _np
+
+                offset = cache[0].shape[1]
+                position_ids = _np.arange(offset, offset + s)[None, :].repeat(
+                    b, axis=0)
+            q, k, _ = F.fused_rotary_position_embedding(
+                q, k, None, position_ids=position_ids)
         if cache is not None:
             pk, pv = cache
             from .. import ops

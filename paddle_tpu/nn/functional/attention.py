@@ -94,11 +94,20 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     b, s, h, d = q.shape
     if sin is None or cos is None:
         inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-        t = jnp.arange(s, dtype=jnp.float32)
-        freqs = jnp.outer(t, inv)  # [S, D/2]
-        emb = jnp.concatenate([freqs, freqs], axis=-1)
-        cos = jnp.cos(emb)[None, :, None, :]
-        sin = jnp.sin(emb)[None, :, None, :]
+        if position_ids is not None:
+            # explicit positions (decode offsets): build phases per position
+            pos = jnp.asarray(position_ids).astype(jnp.float32)  # [B, S]
+            freqs = pos[:, :, None] * inv[None, None, :]         # [B,S,D/2]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+            cos = jnp.cos(emb)[:, :, None, :]
+            sin = jnp.sin(emb)[:, :, None, :]
+            position_ids = None  # consumed
+        else:
+            t = jnp.arange(s, dtype=jnp.float32)
+            freqs = jnp.outer(t, inv)  # [S, D/2]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+            cos = jnp.cos(emb)[None, :, None, :]
+            sin = jnp.sin(emb)[None, :, None, :]
     else:
         cos = jnp.reshape(cos, (1, -1, 1, d))
         sin = jnp.reshape(sin, (1, -1, 1, d))

@@ -4,3 +4,11 @@ from .resnet import (  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .lenet import LeNet  # noqa: F401
+from .vit import (  # noqa: F401
+    VisionTransformer, vit_b_16, vit_b_32, vit_h_14, vit_l_16, vit_l_32,
+)
+from .extras import (  # noqa: F401
+    AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
+    densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_0,
+    squeezenet1_1,
+)

@@ -1,0 +1,291 @@
+"""AlexNet / SqueezeNet / DenseNet / ShuffleNetV2 / GoogLeNet.
+
+Role parity: the rest of the reference vision zoo
+(`python/paddle/vision/models/{alexnet,squeezenet,densenet,shufflenetv2,
+googlenet}.py`). Compact TPU-friendly implementations (NCHW like the
+reference; XLA transposes to its preferred layout internally).
+"""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "DenseNet", "densenet121", "ShuffleNetV2",
+           "shufflenet_v2_x1_0", "GoogLeNet", "googlenet"]
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2))
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(dropout), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(dropout), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.avgpool(self.features(x))
+        return self.classifier(ops.flatten(x, start_axis=1))
+
+
+def alexnet(**kw):
+    return AlexNet(**kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(in_ch, squeeze, 1), nn.ReLU())
+        self.expand1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.expand3 = nn.Sequential(
+            nn.Conv2D(squeeze, e3, 3, padding=1), nn.ReLU())
+
+    def forward(self, x):
+        from ... import ops
+
+        s = self.squeeze(x)
+        return ops.concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000):
+        super().__init__()
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.flatten(self.classifier(self.features(x)), start_axis=1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth, bn_size):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.BatchNorm2D(in_ch), nn.ReLU(),
+            nn.Conv2D(in_ch, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.concat([x, self.fn(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers_per_block=(6, 12, 24, 16), growth=32,
+                 bn_size=4, num_classes=1000, init_ch=64):
+        super().__init__()
+        feats = [nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(init_ch), nn.ReLU(),
+                 nn.MaxPool2D(3, stride=2, padding=1)]
+        ch = init_ch
+        for i, n in enumerate(layers_per_block):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(layers_per_block) - 1:
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, stride=2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        from ... import ops
+
+        return self.classifier(
+            ops.flatten(self.pool(self.features(x)), start_axis=1))
+
+
+def densenet121(**kw):
+    return DenseNet((6, 12, 24, 16), **kw)
+
+
+def _channel_shuffle(x, groups):
+    from ... import ops
+
+    b, c, h, w = x.shape
+    x = ops.reshape(x, [b, groups, c // groups, h, w])
+    x = ops.transpose(x, [0, 2, 1, 3, 4])
+    return ops.reshape(x, [b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                          groups=in_ch, bias_attr=False),
+                nn.BatchNorm2D(in_ch),
+                nn.Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_ch), nn.ReLU())
+            b2_in = in_ch
+        else:
+            self.branch1 = None
+            b2_in = in_ch // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch), nn.ReLU(),
+            nn.Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
+                      groups=branch_ch, bias_attr=False),
+            nn.BatchNorm2D(branch_ch),
+            nn.Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_ch), nn.ReLU())
+
+    def forward(self, x):
+        from ... import ops
+
+        if self.stride > 1:
+            out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = ops.concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024],
+                     2.0: [244, 488, 976, 2048]}[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_ch = 24
+        for i, reps in enumerate([4, 8, 4]):
+            out_ch = stage_out[i]
+            units = [_ShuffleUnit(in_ch, out_ch, 2)]
+            units += [_ShuffleUnit(out_ch, out_ch, 1)
+                      for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            in_ch = out_ch
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_ch, stage_out[3], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[3]), nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.pool(self.conv5(x))
+        return self.fc(ops.flatten(x, start_axis=1))
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_ch, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_ch, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_ch, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                nn.Conv2D(in_ch, pool_proj, 1), nn.ReLU())
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                          axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc4 = nn.Sequential(
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc5 = nn.Sequential(
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        x = self.dropout(self.pool(x))
+        return self.fc(ops.flatten(x, start_axis=1))
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)

@@ -1,0 +1,135 @@
+"""Vision Transformer (BASELINE config 5: ViT-L flash-attn on the Pallas
+fused-attention path).
+
+Role parity: the ViT family the reference serves through its model zoo +
+`nn.functional.flash_attention` (`python/paddle/nn/functional/
+flash_attention.py:146`); attention here routes through
+`F.scaled_dot_product_attention`, which picks the Pallas flash kernel on
+TPU ([B, S, H, D] layout, MXU-tiled).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...nn import functional as F
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16",
+           "vit_l_32", "vit_h_14"]
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_ch=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_ch, embed_dim, patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.proj(x)                       # [B, E, H/P, W/P]
+        b, e = x.shape[0], x.shape[1]
+        x = ops.reshape(x, [b, e, -1])
+        return ops.transpose(x, [0, 2, 1])     # [B, N, E]
+
+
+class MHSA(nn.Layer):
+    def __init__(self, dim, num_heads, attn_drop=0.0, proj_drop=0.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, 3 * dim)
+        self.proj = nn.Linear(dim, dim)
+        self.attn_drop = attn_drop
+        self.proj_drop = proj_drop
+
+    def forward(self, x):
+        from ... import ops
+
+        b, n, d = x.shape
+        qkv = self.qkv(x).reshape([b, n, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)           # [B, N, H, hd]
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.attn_drop if self.training else 0.0,
+            training=self.training)
+        out = self.proj(out.reshape([b, n, d]))
+        if self.proj_drop:
+            out = F.dropout(out, self.proj_drop, training=self.training)
+        return out
+
+
+class Block(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, drop=0.0,
+                 attn_drop=0.0, eps=1e-6):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=eps)
+        self.attn = MHSA(dim, num_heads, attn_drop, drop)
+        self.norm2 = nn.LayerNorm(dim, epsilon=eps)
+        hidden = int(dim * mlp_ratio)
+        self.mlp = nn.Sequential(
+            nn.Linear(dim, hidden), nn.GELU(), nn.Dropout(drop),
+            nn.Linear(hidden, dim), nn.Dropout(drop))
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        return x + self.mlp(self.norm2(x))
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_ch=3, num_classes=1000,
+                 embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0,
+                 drop_rate=0.0, attn_drop_rate=0.0, eps=1e-6):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_ch, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter([1, 1, embed_dim])
+        self.pos_embed = self.create_parameter([1, n + 1, embed_dim])
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            Block(embed_dim, num_heads, mlp_ratio, drop_rate,
+                  attn_drop_rate, eps) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=eps)
+        self.head = nn.Linear(embed_dim, num_classes) \
+            if num_classes > 0 else None
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = ops.expand(self.cls_token, [b, 1, x.shape[-1]])
+        x = ops.concat([cls, x], axis=1)
+        x = self.pos_drop(ops.add(x, self.pos_embed))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        cls_out = x[:, 0]
+        return self.head(cls_out) if self.head is not None else cls_out
+
+
+def _vit(patch, dim, depth, heads, **kw):
+    d = dict(patch_size=patch, embed_dim=dim, depth=depth, num_heads=heads)
+    d.update(kw)
+    return VisionTransformer(**d)
+
+
+def vit_b_16(**kw):
+    return _vit(16, 768, 12, 12, **kw)
+
+
+def vit_b_32(**kw):
+    return _vit(32, 768, 12, 12, **kw)
+
+
+def vit_l_16(**kw):
+    return _vit(16, 1024, 24, 16, **kw)
+
+
+def vit_l_32(**kw):
+    return _vit(32, 1024, 24, 16, **kw)
+
+
+def vit_h_14(**kw):
+    return _vit(14, 1280, 32, 16, **kw)

@@ -1,0 +1,35 @@
+"""Decode-time masked MHA vs a full-attention reference."""
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def test_masked_multihead_attention_decode_loop():
+    B, H, D, S = 2, 2, 8, 6
+    rng = np.random.RandomState(0)
+    cache = P.to_tensor(np.zeros((2, B, H, S, D), np.float32))
+    toks = rng.rand(S, B, 3 * H * D).astype(np.float32)
+
+    outs = []
+    for t in range(4):
+        x = P.to_tensor(toks[t])
+        seq = P.to_tensor(np.full((B,), t, np.int32))
+        out, cache = IF.masked_multihead_attention(
+            x, cache_kv=cache, sequence_lengths=seq)
+        outs.append(out.numpy())
+
+    # reference: causal attention of token t over tokens 0..t
+    qkv = toks[:4].reshape(4, B, 3, H, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    for t in range(4):
+        ref = np.zeros((B, H, D), np.float32)
+        for b in range(B):
+            for h in range(H):
+                sc = np.array([q[t, b, h] @ k[j, b, h] for j in range(t + 1)])
+                sc = sc / np.sqrt(D)
+                p = np.exp(sc - sc.max())
+                p /= p.sum()
+                ref[b, h] = sum(p[j] * v[j, b, h] for j in range(t + 1))
+        np.testing.assert_allclose(outs[t], ref.reshape(B, H * D),
+                                   rtol=1e-4, atol=1e-5)

@@ -1,0 +1,119 @@
+"""Model zoo: LLaMA (GQA), ViT, and the extra vision families.
+
+Parity model: reference model-zoo smoke tests (`test/legacy_test/
+test_vision_models.py` style — construct, forward, shape-check) plus a
+train-step check on the flagship language models.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                               LlamaPretrainingCriterion, llama_pipe_layers,
+                               llama_tiny)
+from paddle_tpu.vision import models as V
+
+
+def test_llama_forward_and_train_step():
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    crit = LlamaPretrainingCriterion()
+    rng = np.random.RandomState(0)
+    ids = P.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)), dtype="int64")
+    labels = P.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)),
+                         dtype="int64")
+    logits = model(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    loss = crit(logits, labels)
+    loss.backward()
+    opt = P.optimizer.AdamW(1e-3, parameters=list(model.parameters()))
+    opt.step()
+    opt.clear_grad()
+    loss2 = crit(model(ids), labels)
+    assert float(loss2.numpy()) < float(loss.numpy())
+
+
+def test_llama_gqa_heads():
+    cfg = llama_tiny(num_heads=4, num_kv_heads=2)
+    model = LlamaForCausalLM(cfg)
+    hd = cfg.hidden_size // cfg.num_heads
+    qkv_w = model.model.layers[0].attn.qkv_proj.weight
+    # fused qkv: q (4 heads) + k (2) + v (2)
+    assert qkv_w.shape[-1] == (4 + 2 + 2) * hd
+    ids = P.to_tensor(np.zeros((1, 8), np.int64))
+    out = model(ids)
+    assert out.shape == [1, 8, cfg.vocab_size]
+
+
+def test_llama_pipe_layers_compose():
+    cfg = llama_tiny()
+    layers = llama_pipe_layers(cfg)
+    assert len(layers) == cfg.num_layers + 2
+    x = P.to_tensor(np.zeros((1, 8), np.int64))
+    h = layers[0](x)
+    for blk in layers[1:-1]:
+        h = blk(h)
+    out = layers[-1](h)
+    assert out.shape == [1, 8, cfg.vocab_size]
+
+
+def test_llama_jit_parity():
+    cfg = llama_tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = P.to_tensor(np.arange(16, dtype=np.int64).reshape(1, 16) % 100)
+    eager = model(ids)
+    st = P.jit.to_static(model)
+    jit_out = st(ids)
+    np.testing.assert_allclose(eager.numpy(), jit_out.numpy(), rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_llama_incremental_decode_matches_full():
+    """KV-cache decode must equal full-sequence attention (RoPE offsets)."""
+    from paddle_tpu.models.llama import LlamaAttention
+
+    cfg = llama_tiny(num_heads=4, num_kv_heads=2)
+    attn = LlamaAttention(cfg)
+    attn.eval()
+    rng = np.random.RandomState(0)
+    x_full = P.to_tensor(rng.rand(1, 6, cfg.hidden_size).astype(np.float32))
+    full_out = attn(x_full)
+    hd = cfg.hidden_size // cfg.num_heads
+    cache = (P.to_tensor(np.zeros((1, 0, cfg.num_kv_heads, hd), np.float32)),
+             P.to_tensor(np.zeros((1, 0, cfg.num_kv_heads, hd), np.float32)))
+    outs = []
+    for t in range(6):
+        xt = P.to_tensor(x_full.numpy()[:, t:t + 1])
+        out_t, cache = attn(xt, cache=cache)
+        outs.append(out_t.numpy()[:, 0])
+    np.testing.assert_allclose(np.stack(outs, axis=1), full_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_vit_forward():
+    m = V.VisionTransformer(img_size=32, patch_size=8, embed_dim=64,
+                            depth=2, num_heads=4, num_classes=10)
+    x = P.to_tensor(np.random.RandomState(0).rand(2, 3, 32, 32)
+                    .astype(np.float32))
+    out = m(x)
+    assert out.shape == [2, 10]
+    loss = P.mean(P.square(out))
+    loss.backward()
+    assert m.blocks[0].attn.qkv.weight.grad is not None
+
+
+@pytest.mark.parametrize("ctor,img", [
+    (lambda: V.AlexNet(num_classes=10), 224),
+    (lambda: V.SqueezeNet("1.1", num_classes=10), 224),
+    (lambda: V.DenseNet((2, 2), growth=8, num_classes=10, init_ch=16), 64),
+    (lambda: V.ShuffleNetV2(0.5, num_classes=10), 64),
+    (lambda: V.GoogLeNet(num_classes=10), 64),
+])
+def test_vision_zoo_smoke(ctor, img):
+    m = ctor()
+    m.eval()
+    x = P.to_tensor(np.random.RandomState(1).rand(1, 3, img, img)
+                    .astype(np.float32))
+    out = m(x)
+    assert out.shape == [1, 10]
