@@ -53,7 +53,8 @@ def __getattr__(name):
     lazy = {"distributed", "hapi", "incubate", "models", "profiler",
             "distribution", "sparse", "text", "audio", "quantization",
             "geometric", "fft", "signal", "linalg", "regularizer",
-            "static", "inference", "onnx", "utils", "sysconfig", "hub"}
+            "static", "inference", "onnx", "utils", "sysconfig", "hub",
+            "cost_model"}
     if name in lazy:
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

@@ -143,3 +143,57 @@ class debugging:
                 f"check_numerics failed for {op_type}:{var_name}: "
                 f"{n_nan} NaN, {n_inf} Inf")
         return True
+
+    _stats = None
+
+    @classmethod
+    def enable_operator_stats_collection(cls):
+        """Collect per-op output dtype counts (parity:
+        `paddle.amp.debugging.enable_operator_stats_collection` — used to
+        audit which ops ran in bf16/fp32 under autocast)."""
+        from ..core import dispatch
+
+        cls._stats = {}
+        dispatch.set_op_stats_sink(cls._stats)
+
+    @classmethod
+    def disable_operator_stats_collection(cls):
+        from ..core import dispatch
+
+        dispatch.set_op_stats_sink(None)
+        stats = cls._stats or {}
+        by_op = {}
+        for (name, dtype), cnt in sorted(stats.items()):
+            by_op.setdefault(name, {})[dtype] = cnt
+        if by_op:
+            print("<------------------- op list ------------------->")
+            for name, dts in by_op.items():
+                print(f"  {name}: " + ", ".join(
+                    f"{d}={c}" for d, c in dts.items()))
+        return by_op
+
+    @classmethod
+    def collect_operator_stats(cls):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            cls.enable_operator_stats_collection()
+            try:
+                yield
+            finally:
+                cls.disable_operator_stats_collection()
+
+        return g()
+
+    @staticmethod
+    def enable_tensor_checker():
+        from ..core import flags
+
+        flags.set_flags({"FLAGS_check_nan_inf": True})
+
+    @staticmethod
+    def disable_tensor_checker():
+        from ..core import flags
+
+        flags.set_flags({"FLAGS_check_nan_inf": False})

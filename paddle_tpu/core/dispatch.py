@@ -26,11 +26,26 @@ from .engine import GradNode
 from .tensor import Tensor
 
 _amp_cast_hook = None  # installed by paddle_tpu.amp
+_op_stats_sink = None  # installed by amp.debugging op-stats collection
 
 
 def set_amp_cast_hook(fn):
     global _amp_cast_hook
     _amp_cast_hook = fn
+
+
+def set_op_stats_sink(sink):
+    """sink: dict[(op_name, dtype_str)] -> count, or None to disable."""
+    global _op_stats_sink
+    _op_stats_sink = sink
+
+
+def _record_op_stats(sink, name, out):
+    leaves = jax.tree_util.tree_flatten(out)[0]
+    for leaf in leaves:
+        if hasattr(leaf, "dtype"):
+            key = (name, str(leaf.dtype))
+            sink[key] = sink.get(key, 0) + 1
 
 
 def _is_tensor(x):
@@ -63,6 +78,11 @@ def apply(name, fn, *args, **kwargs):
         vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
         a, kw = jax.tree_util.tree_unflatten(treedef, vals)
         out = fn(*a, **kw)
+        if _watching():
+            check_nan_inf(name, out)
+        sink = _op_stats_sink
+        if sink is not None and not flags.in_trace():
+            _record_op_stats(sink, name, out)
         if flags.in_trace():
             # grad bookkeeping belongs to jax here; just propagate the flag
             sg = not any(not leaves[i].stop_gradient for i in tensor_pos)
@@ -86,6 +106,11 @@ def apply(name, fn, *args, **kwargs):
 
     diff_vals = [base_vals[p] for p in diff_pos]
     out, vjp_fn = jax.vjp(pure, *diff_vals)
+    if _watching():
+        check_nan_inf(name, out)
+    sink = _op_stats_sink
+    if sink is not None:
+        _record_op_stats(sink, name, out)
 
     out_leaves, out_tree = jax.tree_util.tree_flatten(out)
     edges = []
@@ -124,6 +149,32 @@ class _VjpAdapter:
             cots = (cots,)
         cot_tree = jax.tree_util.tree_unflatten(self.out_tree, list(cots))
         return self.vjp_fn(cot_tree)
+
+
+def check_nan_inf(name, out):
+    """FLAGS_check_nan_inf watcher (parity: eager nan/inf hook
+    `paddle/fluid/eager/nan_inf_utils.h` checking every kernel output).
+    Debug tool: forces a device sync per op, exactly as the reference's
+    flag does."""
+    leaves = jax.tree_util.tree_flatten(out)[0]
+    for i, leaf in enumerate(leaves):
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(
+                leaf.dtype, np.inexact):
+            continue
+        bad = ~np.asarray(jnp.isfinite(leaf)).all()
+        if bad:
+            arr = np.asarray(leaf)
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            raise FloatingPointError(
+                f"op {name!r} output {i} contains {n_nan} NaN / {n_inf} Inf "
+                f"values (shape={arr.shape}, dtype={arr.dtype}) — "
+                "FLAGS_check_nan_inf watcher")
+
+
+def _watching():
+    # hot path: direct dict read, no allocation
+    return flags._flags["FLAGS_check_nan_inf"] and not flags.in_trace()
 
 
 def _wrap_outputs(out, stop_gradient):

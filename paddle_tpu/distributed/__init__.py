@@ -16,6 +16,8 @@ from .topology import HybridTopology, get_topology, set_topology  # noqa: F401
 from .train_step import DistributedTrainStep  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import mpu  # noqa: F401
+from . import rpc  # noqa: F401
+from .auto_tuner import AutoTuner  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, PipelineParallel  # noqa: F401
 
 

@@ -1,0 +1,136 @@
+"""Auto-tuner: search hybrid-parallel configs, prune by memory, rank by cost.
+
+Role parity: `python/paddle/distributed/auto_tuner/{tuner.py,search.py,
+prune.py}` (SURVEY §2.5) — enumerate dp/mp/pp/sharding/micro-batch
+combinations, prune those that exceed per-chip memory, and (reference:
+relaunch trials; here:) rank by the analytic roofline and optionally run
+user trials best-first.
+
+TPU-first: pruning uses the v5p chip model in `paddle_tpu.cost_model`; mp
+candidates prefer powers of two ≤ 8 that divide both head count and an ICI
+axis; trials run in-process against a user callback (a jit'd step) instead
+of relaunching pods — compile cache makes sequential in-process trials
+cheap on TPU.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+
+from ..cost_model import (ChipSpec, TransformerShape, V5P, memory_per_chip,
+                          train_step_cost)
+
+__all__ = ["AutoTuner", "Candidate", "default_candidates"]
+
+
+class Candidate:
+    __slots__ = ("dp", "mp", "pp", "sharding_stage", "micro_batch",
+                 "recompute", "est_time_s", "est_mem_bytes")
+
+    def __init__(self, dp, mp, pp, sharding_stage, micro_batch,
+                 recompute=False):
+        self.dp = dp
+        self.mp = mp
+        self.pp = pp
+        self.sharding_stage = sharding_stage
+        self.micro_batch = micro_batch
+        self.recompute = recompute
+        self.est_time_s = None
+        self.est_mem_bytes = None
+
+    def as_strategy(self):
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_stage": self.sharding_stage,
+                "micro_batch_size": self.micro_batch,
+                "recompute": self.recompute}
+
+    def __repr__(self):
+        t = f", est={self.est_time_s:.3f}s" if self.est_time_s else ""
+        return (f"Candidate(dp={self.dp}, mp={self.mp}, pp={self.pp}, "
+                f"zero={self.sharding_stage}, mbs={self.micro_batch}, "
+                f"rc={self.recompute}{t})")
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(n_chips, global_batch, num_heads, num_layers,
+                       sharding_stages=(0, 1, 2, 3), allow_recompute=True):
+    out = []
+    for mp in [d for d in _divisors(n_chips)
+               if d <= 8 and num_heads % d == 0]:
+        for pp in [d for d in _divisors(n_chips // mp)
+                   if num_layers % d == 0]:
+            dp = n_chips // mp // pp
+            if dp * mp * pp != n_chips or global_batch % dp != 0:
+                continue
+            per_dp = global_batch // dp
+            for mbs in _divisors(per_dp):
+                if mbs > 64:
+                    continue
+                for st in sharding_stages:
+                    if st > 0 and dp == 1:
+                        continue
+                    for rc in ((False, True) if allow_recompute
+                               else (False,)):
+                        out.append(Candidate(dp, mp, pp, st, mbs, rc))
+    return out
+
+
+class AutoTuner:
+    def __init__(self, model_shape, n_chips, global_batch, chip=V5P,
+                 n_hosts=1, mem_fraction=0.9):
+        if not isinstance(model_shape, TransformerShape):
+            raise TypeError("model_shape must be a TransformerShape")
+        self.shape = model_shape
+        self.n_chips = n_chips
+        self.global_batch = global_batch
+        self.chip = chip
+        self.n_hosts = n_hosts
+        self.mem_budget = chip.hbm_bytes * mem_fraction
+        self.history = []
+
+    def prune(self, candidates):
+        kept = []
+        for c in candidates:
+            mem = memory_per_chip(self.shape, c.micro_batch, c.dp, c.mp,
+                                  c.pp, c.sharding_stage, c.recompute)
+            c.est_mem_bytes = mem
+            if mem <= self.mem_budget:
+                kept.append(c)
+        return kept
+
+    def rank(self, candidates):
+        for c in candidates:
+            c.est_time_s = train_step_cost(
+                self.shape, self.global_batch, c.micro_batch, c.dp, c.mp,
+                c.pp, c.sharding_stage, self.chip, self.n_hosts).total_s
+        return sorted(candidates, key=lambda c: c.est_time_s)
+
+    def search(self, candidates=None):
+        """Prune + rank; returns candidates best-first."""
+        if candidates is None:
+            candidates = default_candidates(
+                self.n_chips, self.global_batch, self.shape.heads,
+                self.shape.L)
+        return self.rank(self.prune(candidates))
+
+    def tune(self, trial_fn, candidates=None, max_trials=5):
+        """Run real trials best-first: trial_fn(candidate) -> measured
+        seconds (or raise/return None to reject). Returns the best
+        (candidate, time)."""
+        ranked = self.search(candidates)
+        best = None
+        for c in ranked[:max_trials]:
+            try:
+                t = trial_fn(c)
+            except Exception as e:  # OOM / compile failure prunes the point
+                self.history.append((c, None, repr(e)))
+                continue
+            if t is None:
+                continue
+            self.history.append((c, t, None))
+            if best is None or t < best[1]:
+                best = (c, t)
+        return best

@@ -10,6 +10,7 @@ for the dygraph UX.
 """
 from __future__ import annotations
 
+from . import elastic  # noqa: F401
 from .. import topology as topo_mod
 from ..topology import HybridTopology
 from ..train_step import DistributedTrainStep

@@ -1,0 +1,161 @@
+"""Elastic training: node registry, heartbeats, membership watch, restart.
+
+Role parity: `ElasticManager`
+(`python/paddle/distributed/fleet/elastic/manager.py:126`, SURVEY §2.5/§5)
+— etcd node registry + heartbeats, fault-tolerance levels, watch+restart
+loop, `--nnodes=min:max` scale range, and the exit-code protocol the
+launcher understands.
+
+TPU-first: the registry rides the framework's own TCPStore (native tier,
+`paddle_tpu/native/src/tcp_store.cc`) instead of etcd — one fewer external
+service; membership changes trigger the same local-pod restart protocol
+(on TPU pods a membership change also invalidates the mesh, so restart is
+the correct granularity — XLA programs are compiled for a fixed topology).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+# exit-code protocol (manager.py:32-39 parity)
+ELASTIC_EXIT_CODE = 101          # relaunch me with a new world
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1   # fixed world size, restart on failure
+    ELASTIC = 2           # world may scale within [min, max]
+
+
+class ElasticManager:
+    def __init__(self, args=None, store=None, job_id=None, np_range=None,
+                 heartbeat_interval=2.0, heartbeat_ttl=8.0):
+        from ..store import TCPStore
+
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        rng = np_range or os.environ.get("PADDLE_ELASTIC_NP", "1")
+        if isinstance(rng, str) and ":" in rng:
+            lo, hi = rng.split(":")
+            self.min_np, self.max_np = int(lo), int(hi)
+        else:
+            self.min_np = self.max_np = int(rng)
+        self.elastic_level = (
+            ElasticLevel.ELASTIC if self.max_np > self.min_np
+            else ElasticLevel.FAULT_TOLERANCE)
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_ttl = heartbeat_ttl
+        if store is not None:
+            self.store = store
+        else:
+            master = os.environ.get("PADDLE_MASTER", "127.0.0.1:8476")
+            host, port = master.split(":")
+            self.store = TCPStore(host, int(port),
+                                  is_master=(self.rank == 0))
+        self._stop = threading.Event()
+        self._thread = None
+        self._membership_version = 0
+        self.enabled = os.environ.get("PADDLE_ELASTIC_ENABLE",
+                                      "1") not in ("0", "false")
+
+    # --- registry ------------------------------------------------------------
+    def _hb_key(self, rank=None):
+        r = self.rank if rank is None else rank
+        return f"elastic/{self.job_id}/hb/{r}"
+
+    def register(self):
+        """Join the registry and start heartbeating."""
+        self.store.set(self._hb_key(), str(time.time()))
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        while not self._stop.is_set():
+            try:
+                self.store.set(self._hb_key(), str(time.time()))
+            except Exception:
+                pass
+            self._stop.wait(self.heartbeat_interval)
+
+    def alive_ranks(self, scan_up_to=None):
+        """Ranks with fresh heartbeats, scanned over the FULL scale range
+        (so joins beyond the current world — scale-out — are visible)."""
+        now = time.time()
+        alive = []
+        for r in range(scan_up_to if scan_up_to is not None else self.max_np):
+            try:
+                ts = float(self.store.get(self._hb_key(r), timeout=0.5))
+            except Exception:
+                continue
+            if now - ts <= self.heartbeat_ttl:
+                alive.append(r)
+        return alive
+
+    # --- watch ---------------------------------------------------------------
+    def watch(self, world_size):
+        """One membership check. Returns an ElasticStatus.
+
+        After a RESTART the relaunched script must derive its NEW world from
+        the registry (`len(alive_ranks())`), not from the stale
+        PADDLE_TRAINERS_NUM env — the launcher restarts the local pod; the
+        world resize happens at rendezvous.
+        """
+        alive = self.alive_ranks()
+        n = len(alive)
+        if n == world_size:
+            return ElasticStatus.COMPLETED if self._job_done() \
+                else ElasticStatus.HOLD
+        if self.elastic_level == ElasticLevel.FAULT_TOLERANCE:
+            # fixed world: any membership change means restart-and-rejoin;
+            # the launcher's max_restart caps repeated failures
+            self._membership_version += 1
+            return ElasticStatus.RESTART
+        if n >= self.min_np:
+            # scale-in or scale-out within [min, max]: relaunch on the new
+            # membership
+            self._membership_version += 1
+            return ElasticStatus.RESTART
+        return ElasticStatus.ERROR
+
+    def _job_done(self):
+        try:
+            return self.store.check(f"elastic/{self.job_id}/done")
+        except Exception:
+            return False
+
+    def mark_done(self):
+        self.store.set(f"elastic/{self.job_id}/done", "1")
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if completed and self.rank == 0:
+            try:
+                self.mark_done()
+            except Exception:
+                pass
+
+    # --- restart protocol ----------------------------------------------------
+    @staticmethod
+    def request_relaunch():
+        """Child signals the launcher: bring me back with a fresh world."""
+        os._exit(ELASTIC_EXIT_CODE)
+
+    @staticmethod
+    def signal_handler(sig, frame):
+        os._exit(ELASTIC_EXIT_CODE)
+
+    def install_signal_handlers(self):
+        signal.signal(signal.SIGTERM, self.signal_handler)
+        signal.signal(signal.SIGINT, self.signal_handler)

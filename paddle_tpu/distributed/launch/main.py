@@ -33,6 +33,10 @@ def parse_args(argv=None):
                    help="processes per host (1 = single-controller default)")
     p.add_argument("--log_dir", default="log")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--max_elastic_relaunch", type=int,
+                   default=int(os.environ.get("PADDLE_MAX_ELASTIC_RELAUNCH",
+                                              10)),
+                   help="cap on membership-change relaunches (exit 101)")
     p.add_argument("--devices", default=None,
                    help="accepted for compatibility; chips are owned by the "
                         "single controller")
@@ -66,8 +70,11 @@ def launch(args=None):
         print("usage: python -m paddle_tpu.distributed.launch [opts] script.py",
               file=sys.stderr)
         return 1
+    from ..fleet.elastic import ELASTIC_EXIT_CODE
+
     os.makedirs(args.log_dir, exist_ok=True)
     restarts = 0
+    elastic_relaunches = 0
     while True:
         procs = []
         logs = []
@@ -85,6 +92,21 @@ def launch(args=None):
             lf.close()
         if all(c == 0 for c in codes):
             return 0
+        if any(c == ELASTIC_EXIT_CODE for c in codes):
+            # fleet.elastic protocol: membership change — relaunch without
+            # charging max_restart, but bounded so a permanently dead peer
+            # can't spin the pod forever
+            elastic_relaunches += 1
+            if elastic_relaunches > args.max_elastic_relaunch:
+                print(f"giving up after {elastic_relaunches - 1} elastic "
+                      "relaunches (membership never stabilized)",
+                      file=sys.stderr)
+                return ELASTIC_EXIT_CODE
+            print("elastic membership change; relaunching pod "
+                  f"({elastic_relaunches}/{args.max_elastic_relaunch})",
+                  file=sys.stderr)
+            time.sleep(1)
+            continue
         restarts += 1
         if restarts > args.max_restart:
             print(f"giving up after {restarts - 1} restarts; exit codes "

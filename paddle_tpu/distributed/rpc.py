@@ -1,0 +1,180 @@
+"""paddle_tpu.distributed.rpc: simple worker-to-worker RPC.
+
+Role parity: `paddle.distributed.rpc` (`python/paddle/distributed/rpc/
+rpc.py` over brpc, SURVEY §2.2) — init_rpc/rpc_sync/rpc_async/
+get_worker_info/shutdown.
+
+Transport: one daemon TCP server thread per worker; worker name→endpoint
+registry rides the job's TCPStore (the same rendezvous the collectives
+use). Payloads are pickled callables+args, exactly the reference's trust
+model: RPC peers are inside one training job's trust domain — do NOT
+expose the port beyond the cluster network.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+from concurrent.futures import Future
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "shutdown",
+           "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state = {"server": None, "store": None, "me": None, "world_size": 0,
+          "workers": {}}
+
+
+def _send_msg(sock, payload):
+    data = pickle.dumps(payload)
+    sock.sendall(struct.pack("!Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("!Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        try:
+            payload = ("ok", fn(*args, **kwargs))
+        except Exception as e:  # ship the exception back
+            payload = ("err", e)
+        try:
+            _send_msg(self.request, payload)
+        except ConnectionError:
+            pass
+        except Exception as e:
+            # result/exception not picklable — tell the caller WHY instead
+            # of dropping the connection
+            try:
+                _send_msg(self.request, ("err", RuntimeError(
+                    f"rpc reply not picklable: {e!r}")))
+            except Exception:
+                pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and register it."""
+    from .store import TCPStore
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                               "127.0.0.1:8476")
+    host, port = master.split(":")
+    store = TCPStore(host, int(port), is_master=(rank == 0))
+
+    server = _Server(("0.0.0.0", 0), _Handler)
+    my_port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    my_ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+    store.set(f"rpc/{rank}", f"{name},{my_ip},{my_port}")
+
+    workers = {}
+    for r in range(world_size):
+        val = store.get(f"rpc/{r}", timeout=60)
+        if isinstance(val, bytes):
+            val = val.decode()
+        wname, ip, p = val.split(",")
+        workers[wname] = WorkerInfo(wname, r, ip, int(p))
+
+    _state.update(server=server, store=store, me=workers_by_rank(workers,
+                                                                 rank),
+                  world_size=world_size, workers=workers)
+    return _state["me"]
+
+
+def workers_by_rank(workers, rank):
+    for w in workers.values():
+        if w.rank == rank:
+            return w
+    raise KeyError(rank)
+
+
+def get_worker_info(name):
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def get_current_worker_info():
+    return _state["me"]
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=60):
+    return rpc_async(to, fn, args, kwargs, timeout).result(timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=60):
+    info = _state["workers"].get(to)
+    if info is None:
+        raise KeyError(f"unknown rpc worker {to!r}; did you init_rpc?")
+    fut = Future()
+
+    def call():
+        try:
+            with socket.create_connection((info.ip, info.port),
+                                          timeout=timeout) as s:
+                _send_msg(s, (fn, args or (), kwargs or {}))
+                status, payload = _recv_msg(s)
+            if status == "ok":
+                fut.set_result(payload)
+            else:
+                fut.set_exception(payload)
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=call, daemon=True).start()
+    return fut
+
+
+def shutdown():
+    server = _state.get("server")
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    _state.update(server=None, workers={}, me=None)

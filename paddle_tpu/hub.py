@@ -1,0 +1,47 @@
+"""paddle_tpu.hub: model hub loader (parity: `python/paddle/hapi/hub.py`).
+
+Local-only in this environment (zero egress): `source='local'` loads a
+hubconf.py from a directory; github/gitee sources raise with guidance.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            "no network egress in this environment; clone the repo and use "
+            "source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model)(*args, **kwargs)

@@ -1,0 +1,29 @@
+"""paddle_tpu.onnx: model export.
+
+Role parity: `paddle.onnx.export` (`python/paddle/onnx/export.py:22`, which
+delegates to paddle2onnx). The TPU-native interchange format is serialized
+StableHLO via `jax.export` — the artifact ONNX serves for the reference
+(framework-neutral deployment). `export` therefore writes the StableHLO
+artifact; true ONNX protobuf emission would need an onnx wheel, which this
+image doesn't carry (gated with a clear error).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, format="stablehlo",
+           **configs):
+    if format == "onnx":
+        raise NotImplementedError(
+            "onnx protobuf emission needs the onnx package (not in this "
+            "image); export format='stablehlo' produces the portable "
+            "compiled artifact instead")
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+    from ..jit import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec)
+    return path + ".pdmodel"

@@ -1,0 +1,169 @@
+"""paddle_tpu.utils: interop + misc utilities.
+
+Role parity: `python/paddle/utils/` — dlpack interop
+(`paddle/fluid/framework/dlpack_tensor.cc`), unique_name, deprecated
+decorator, download stub, cpp_extension gate, try_import.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import warnings
+
+__all__ = ["dlpack", "unique_name", "deprecated", "try_import", "download",
+           "cpp_extension", "require_version", "run_check"]
+
+
+class dlpack:
+    """Zero-copy tensor interop via the DLPack protocol (jax arrays speak
+    it natively — the DLPack capsule path of the reference)."""
+
+    @staticmethod
+    def to_dlpack(x):
+        """Return the DLPack protocol object (the modern interchange form:
+        consumers call `from_dlpack(obj)` which invokes obj.__dlpack__();
+        jax arrays implement the protocol natively)."""
+        from ..core.tensor import Tensor
+
+        return x._value if isinstance(x, Tensor) else x
+
+    @staticmethod
+    def from_dlpack(obj):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        if not hasattr(obj, "__dlpack__"):
+            raise TypeError(
+                "from_dlpack needs an object implementing the DLPack "
+                "protocol (__dlpack__/__dlpack_device__); raw PyCapsules "
+                "from legacy producers are not supported — pass the source "
+                "tensor itself")
+        return Tensor(jnp.from_dlpack(obj))
+
+
+class _UniqueNames(threading.local):
+    def __init__(self):
+        self.counters = {}
+        self.prefix = ""
+
+
+_un = _UniqueNames()
+
+
+class unique_name:
+    @staticmethod
+    def generate(key="tmp"):
+        c = _un.counters.get(key, 0)
+        _un.counters[key] = c + 1
+        return f"{_un.prefix}{key}_{c}"
+
+    @staticmethod
+    def guard(prefix=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            old_prefix, old_counters = _un.prefix, _un.counters
+            _un.prefix = prefix or ""
+            _un.counters = {}
+            try:
+                yield
+            finally:
+                _un.prefix, _un.counters = old_prefix, old_counters
+
+        return g()
+
+    @staticmethod
+    def switch(new_generator=None):
+        _un.counters = {}
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            msg = f"API {fn.__name__!r} is deprecated since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed")
+
+
+class download:
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            "no network egress in this environment; place weights locally "
+            "and load with paddle_tpu.load()")
+
+    get_path_from_url = get_weights_path_from_url
+
+
+class cpp_extension:
+    """Custom-op build gate (parity: `paddle.utils.cpp_extension`). The
+    TPU-native extension path is a C library + ctypes (see
+    `paddle_tpu/native/_build.py`); pybind11-style JIT extensions are
+    gated off."""
+
+    @staticmethod
+    def load(name, sources, **kwargs):
+        from ..native import _build
+
+        raise NotImplementedError(
+            "use paddle_tpu.native._build to compile C extensions (ctypes "
+            "ABI); pybind11 JIT extensions are not available in this image")
+
+    class CppExtension:
+        def __init__(self, *a, **kw):
+            raise NotImplementedError("see cpp_extension.load")
+
+    CUDAExtension = CppExtension
+
+
+def require_version(min_version, max_version=None):
+    from .. import __version__
+
+    def tup(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    if tup(__version__) < tup(min_version):
+        raise RuntimeError(
+            f"requires paddle_tpu>={min_version}, got {__version__}")
+    if max_version and tup(__version__) > tup(max_version):
+        raise RuntimeError(
+            f"requires paddle_tpu<={max_version}, got {__version__}")
+    return True
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the install can compute."""
+    import jax
+    import numpy as np
+
+    from .. import matmul, to_tensor
+
+    a = to_tensor(np.ones((2, 2), np.float32))
+    out = matmul(a, a)
+    assert np.allclose(np.asarray(out.numpy()), 2 * np.ones((2, 2)))
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"{n} device(s): {[d.platform for d in jax.devices()]}")
+    return True

@@ -1,0 +1,100 @@
+"""utils/dlpack/onnx/hub/sysconfig + NaN-Inf watcher + amp debugging."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import amp, utils
+
+
+def test_dlpack_roundtrip():
+    x = P.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = utils.dlpack.to_dlpack(x)
+    y = utils.dlpack.from_dlpack(cap)
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+def test_unique_name():
+    a = utils.unique_name.generate("fc")
+    b = utils.unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with utils.unique_name.guard("model/"):
+        c = utils.unique_name.generate("fc")
+        assert c.startswith("model/fc_")
+
+
+def test_run_check_and_require_version():
+    assert utils.run_check()
+    assert utils.require_version("0.0.1")
+    with pytest.raises(RuntimeError):
+        utils.require_version("999.0.0")
+
+
+def test_nan_inf_watcher():
+    P.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = P.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError) as e:
+            P.divide(x, P.to_tensor(np.zeros(2, np.float32)))
+        assert "Inf" in str(e.value)
+        with pytest.raises(FloatingPointError):
+            P.log(P.to_tensor(np.array([-1.0], np.float32)))
+        # clean ops pass
+        P.add(x, x)
+    finally:
+        P.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_nan_watcher_on_grad_path():
+    P.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = P.to_tensor(np.array([0.0], np.float32), stop_gradient=False)
+        with pytest.raises(FloatingPointError):
+            P.rsqrt(x)  # 1/sqrt(0) = inf, on the autograd path
+    finally:
+        P.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_amp_operator_stats():
+    with amp.debugging.collect_operator_stats():
+        a = P.to_tensor(np.ones((2, 2), np.float32))
+        P.matmul(a, a)
+        P.add(a, a)
+    stats = amp.debugging._stats
+    assert any(k[0] == "matmul" for k in stats)
+
+
+def test_onnx_export_stablehlo(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu import onnx, static
+
+    m = nn.Linear(4, 2)
+    p = onnx.export(m, str(tmp_path / "m"),
+                    input_spec=[static.InputSpec([1, 4], "float32")])
+    import os
+
+    assert os.path.exists(p)
+    with pytest.raises(NotImplementedError):
+        onnx.export(m, str(tmp_path / "m2"), input_spec=[
+            static.InputSpec([1, 4], "float32")], format="onnx")
+
+
+def test_hub_local(tmp_path):
+    from paddle_tpu import hub
+
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(n=3):\n"
+        "    'build a tiny model'\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(n, n)\n")
+    assert "tiny_model" in hub.list(str(tmp_path))
+    assert "tiny" in hub.help(str(tmp_path), "tiny_model")
+    m = hub.load(str(tmp_path), "tiny_model", n=5)
+    assert m.weight.shape == [5, 5]
+    with pytest.raises(RuntimeError):
+        hub.load("user/repo", "x", source="github")
+
+
+def test_sysconfig_paths():
+    from paddle_tpu import sysconfig
+
+    assert sysconfig.get_include().endswith("src")
