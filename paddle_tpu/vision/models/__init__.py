@@ -7,6 +7,7 @@ from .lenet import LeNet  # noqa: F401
 from .vit import (  # noqa: F401
     VisionTransformer, vit_b_16, vit_b_32, vit_h_14, vit_l_16, vit_l_32,
 )
+from .swin import SwinTransformer, swin_b, swin_s, swin_t  # noqa: F401
 from .extras import (  # noqa: F401
     AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
     densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_0,

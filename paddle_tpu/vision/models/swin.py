@@ -1,0 +1,239 @@
+"""Swin Transformer (BASELINE config 5 companion to ViT).
+
+Role parity: the Swin family the reference ecosystem trains through its
+fused attention stack. TPU-first notes: window partition/reverse are pure
+reshape+transpose (free under XLA); the shifted-window roll is `jnp.roll`
+(a static rotate XLA lowers to two slices+concat); window attention runs
+as one batched matmul over [num_windows*B, tokens, C] — MXU-shaped.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...nn import functional as F
+
+__all__ = ["SwinTransformer", "swin_t", "swin_s", "swin_b"]
+
+
+def _window_partition(x, ws):
+    # x: [B, H, W, C] → [B*nH*nW, ws*ws, C]
+    def f(v):
+        B, H, W, C = v.shape
+        v = v.reshape(B, H // ws, ws, W // ws, ws, C)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(-1, ws * ws, C)
+
+    return apply("window_partition", f, x)
+
+
+def _window_reverse(windows, ws, H, W):
+    def f(v):
+        B = v.shape[0] // ((H // ws) * (W // ws))
+        v = v.reshape(B, H // ws, W // ws, ws, ws, -1)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(B, H, W, -1)
+
+    return apply("window_reverse", f, windows)
+
+
+class WindowAttention(nn.Layer):
+    def __init__(self, dim, window_size, num_heads, attn_drop=0.0,
+                 proj_drop=0.0):
+        super().__init__()
+        self.dim = dim
+        self.ws = window_size
+        self.num_heads = num_heads
+        self.scale = (dim // num_heads) ** -0.5
+        self.qkv = nn.Linear(dim, 3 * dim)
+        self.proj = nn.Linear(dim, dim)
+        self.attn_drop = attn_drop
+        self.proj_drop = proj_drop
+        # relative position bias table [(2w-1)^2, heads]
+        self.rel_bias = self.create_parameter(
+            [(2 * window_size - 1) ** 2, num_heads])
+        coords = np.stack(np.meshgrid(np.arange(window_size),
+                                      np.arange(window_size),
+                                      indexing="ij"))  # [2, w, w]
+        flat = coords.reshape(2, -1)
+        rel = flat[:, :, None] - flat[:, None, :]       # [2, n, n]
+        rel = rel.transpose(1, 2, 0) + window_size - 1
+        self._rel_index = (rel[..., 0] * (2 * window_size - 1)
+                           + rel[..., 1])               # [n, n]
+
+    def forward(self, x, mask=None):
+        n_tok = self.ws * self.ws
+        heads = self.num_heads
+        hd = self.dim // heads
+        rel_idx = self._rel_index
+
+        qkv = self.qkv(x)
+
+        def f(qkv_v, bias_tab, mask_v):
+            Bw = qkv_v.shape[0]
+            qkv_ = qkv_v.reshape(Bw, n_tok, 3, heads, hd)
+            q, k, v = (qkv_[:, :, i].transpose(0, 2, 1, 3)
+                       for i in range(3))               # [Bw, h, n, hd]
+            attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
+            bias = bias_tab[rel_idx.reshape(-1)].reshape(
+                n_tok, n_tok, heads).transpose(2, 0, 1)
+            attn = attn + bias[None]
+            if mask_v is not None:
+                nw = mask_v.shape[0]
+                attn = attn.reshape(Bw // nw, nw, heads, n_tok, n_tok) \
+                    + mask_v[None, :, None]
+                attn = attn.reshape(Bw, heads, n_tok, n_tok)
+            attn = jax.nn.softmax(attn, axis=-1)
+            out = (attn @ v).transpose(0, 2, 1, 3).reshape(Bw, n_tok,
+                                                           self.dim)
+            return out
+
+        out = apply("window_attention", f, qkv, self.rel_bias, mask)
+        if self.attn_drop and self.training:
+            # post-softmax dropout folded onto the attention output (the
+            # per-prob variant needs the mask inside f; output dropout is
+            # the common simplification)
+            out = F.dropout(out, self.attn_drop, training=True)
+        out = self.proj(out)
+        if self.proj_drop and self.training:
+            out = F.dropout(out, self.proj_drop, training=True)
+        return out
+
+
+class SwinBlock(nn.Layer):
+    def __init__(self, dim, input_resolution, num_heads, window_size=7,
+                 shift_size=0, mlp_ratio=4.0, drop=0.0):
+        super().__init__()
+        self.dim = dim
+        self.resolution = input_resolution
+        self.ws = min(window_size, *input_resolution)
+        # a window covering the whole feature map needs no shift
+        self.shift = 0 if min(input_resolution) <= self.ws else shift_size
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn = WindowAttention(dim, self.ws, num_heads,
+                                    attn_drop=drop, proj_drop=drop)
+        self.norm2 = nn.LayerNorm(dim)
+        hidden = int(dim * mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(dim, hidden), nn.GELU(),
+                                 nn.Dropout(drop),
+                                 nn.Linear(hidden, dim), nn.Dropout(drop))
+        if self.shift > 0:
+            H, W = input_resolution
+            img_mask = np.zeros((1, H, W, 1))
+            slices = (slice(0, -self.ws), slice(-self.ws, -self.shift),
+                      slice(-self.shift, None))
+            cnt = 0
+            for hs in slices:
+                for ws_ in slices:
+                    img_mask[:, hs, ws_, :] = cnt
+                    cnt += 1
+            m = img_mask.reshape(1, H // self.ws, self.ws, W // self.ws,
+                                 self.ws, 1).transpose(0, 1, 3, 2, 4, 5)
+            m = m.reshape(-1, self.ws * self.ws)
+            diff = m[:, None, :] - m[:, :, None]
+            self._attn_mask = Tensor(
+                np.where(diff != 0, -100.0, 0.0).astype(np.float32))
+        else:
+            self._attn_mask = None
+
+    def forward(self, x):
+        from ... import ops
+
+        H, W = self.resolution
+        b, L, c = x.shape
+        shortcut = x
+        x = self.norm1(x)
+        x = ops.reshape(x, [b, H, W, c])
+        if self.shift > 0:
+            x = apply("swin_roll",
+                      lambda v: jnp.roll(v, (-self.shift, -self.shift),
+                                         axis=(1, 2)), x)
+        windows = _window_partition(x, self.ws)
+        attn_out = self.attn(windows, self._attn_mask)
+        x = _window_reverse(attn_out, self.ws, H, W)
+        if self.shift > 0:
+            x = apply("swin_unroll",
+                      lambda v: jnp.roll(v, (self.shift, self.shift),
+                                         axis=(1, 2)), x)
+        x = ops.reshape(x, [b, L, c])
+        x = ops.add(shortcut, x)
+        return ops.add(x, self.mlp(self.norm2(x)))
+
+
+class PatchMerging(nn.Layer):
+    def __init__(self, input_resolution, dim):
+        super().__init__()
+        self.resolution = input_resolution
+        self.dim = dim
+        self.norm = nn.LayerNorm(4 * dim)
+        self.reduction = nn.Linear(4 * dim, 2 * dim, bias_attr=False)
+
+    def forward(self, x):
+        from ... import ops
+
+        H, W = self.resolution
+        b, L, c = x.shape
+        x = ops.reshape(x, [b, H, W, c])
+        x = apply("patch_merge", lambda v: jnp.concatenate(
+            [v[:, 0::2, 0::2], v[:, 1::2, 0::2],
+             v[:, 0::2, 1::2], v[:, 1::2, 1::2]], axis=-1), x)
+        x = ops.reshape(x, [b, (H // 2) * (W // 2), 4 * c])
+        return self.reduction(self.norm(x))
+
+
+class SwinTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=4, in_ch=3, num_classes=1000,
+                 embed_dim=96, depths=(2, 2, 6, 2), num_heads=(3, 6, 12, 24),
+                 window_size=7, mlp_ratio=4.0, drop_rate=0.0):
+        super().__init__()
+        self.patch_embed = nn.Conv2D(in_ch, embed_dim, patch_size,
+                                     stride=patch_size)
+        res = img_size // patch_size
+        self.num_layers = len(depths)
+        layers = []
+        dim = embed_dim
+        for i, (depth, heads) in enumerate(zip(depths, num_heads)):
+            for d in range(depth):
+                layers.append(SwinBlock(
+                    dim, (res, res), heads, window_size,
+                    shift_size=0 if d % 2 == 0 else window_size // 2,
+                    mlp_ratio=mlp_ratio, drop=drop_rate))
+            if i != self.num_layers - 1:
+                layers.append(PatchMerging((res, res), dim))
+                dim *= 2
+                res //= 2
+        self.blocks = nn.LayerList(layers)
+        self.norm = nn.LayerNorm(dim)
+        self.head = nn.Linear(dim, num_classes)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.patch_embed(x)                  # [B, E, H', W']
+        b, e = x.shape[0], x.shape[1]
+        x = ops.transpose(ops.reshape(x, [b, e, -1]), [0, 2, 1])
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        x = ops.mean(x, axis=1)
+        return self.head(x)
+
+
+def swin_t(**kw):
+    return SwinTransformer(embed_dim=96, depths=(2, 2, 6, 2),
+                           num_heads=(3, 6, 12, 24), **kw)
+
+
+def swin_s(**kw):
+    return SwinTransformer(embed_dim=96, depths=(2, 2, 18, 2),
+                           num_heads=(3, 6, 12, 24), **kw)
+
+
+def swin_b(**kw):
+    return SwinTransformer(embed_dim=128, depths=(2, 2, 18, 2),
+                           num_heads=(4, 8, 16, 32), **kw)

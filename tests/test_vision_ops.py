@@ -1,0 +1,104 @@
+"""vision.ops (nms/roi_align/deform_conv) + Swin.
+
+Parity model: reference `test/legacy_test/test_nms_op.py`,
+`test_roi_align_op.py`, `test_deform_conv2d.py` — NumPy references.
+"""
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu.vision import ops as VO
+from paddle_tpu.vision import models as V
+
+
+def test_box_iou_and_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [0, 0, 5, 5]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    iou = VO.box_iou(P.to_tensor(boxes), P.to_tensor(boxes)).numpy()
+    assert abs(iou[0, 0] - 1.0) < 1e-6 and iou[0, 2] == 0.0
+    kept = VO.nms(P.to_tensor(boxes), 0.5, P.to_tensor(scores)).numpy()
+    # box1 suppressed by box0 (IoU≈0.68); box2 and box3 survive
+    assert kept.tolist() == [0, 2, 3]
+
+
+def test_nms_class_aware():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1], np.int32)
+    kept = VO.nms(P.to_tensor(boxes), 0.5, P.to_tensor(scores),
+                  category_idxs=P.to_tensor(cats),
+                  categories=[0, 1]).numpy()
+    assert sorted(kept.tolist()) == [0, 1]  # different classes both live
+
+
+def test_roi_align_identity():
+    # a ROI covering exactly one aligned cell grid reproduces avg pooling
+    H = W = 4
+    feat = np.arange(H * W, dtype=np.float32).reshape(1, 1, H, W)
+    boxes = np.array([[0, 0, 4, 4]], np.float32)
+    out = VO.roi_align(P.to_tensor(feat), P.to_tensor(boxes),
+                       P.to_tensor(np.array([1])), output_size=2,
+                       spatial_scale=1.0, sampling_ratio=2,
+                       aligned=True).numpy()
+    assert out.shape == (1, 1, 2, 2)
+    # aligned=True samples land exactly on the pixel centers of each 2x2
+    # cell, so the result equals 2x2 average pooling
+    ref = feat.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))[0, 0]
+    np.testing.assert_allclose(out[0, 0], ref, rtol=1e-5)
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    import jax
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 4, 6, 6).astype(np.float32)
+    w = rng.rand(8, 4, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    out = VO.deform_conv2d(P.to_tensor(x), P.to_tensor(off), P.to_tensor(w),
+                           padding=1).numpy()
+    ref = jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_mask_halves_output():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    w = rng.rand(2, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    full = VO.deform_conv2d(P.to_tensor(x), P.to_tensor(off),
+                            P.to_tensor(w), padding=1).numpy()
+    half_mask = np.full((1, 9, 4, 4), 0.5, np.float32)
+    half = VO.deform_conv2d(P.to_tensor(x), P.to_tensor(off),
+                            P.to_tensor(w), padding=1,
+                            mask=P.to_tensor(half_mask)).numpy()
+    np.testing.assert_allclose(half, full * 0.5, rtol=1e-5)
+
+
+def test_swin_forward_and_grads():
+    m = V.SwinTransformer(img_size=32, patch_size=4, embed_dim=24,
+                          depths=(2, 2), num_heads=(2, 4), window_size=4,
+                          num_classes=5)
+    x = P.to_tensor(np.random.RandomState(2).rand(2, 3, 32, 32)
+                    .astype(np.float32))
+    out = m(x)
+    assert out.shape == [2, 5]
+    P.mean(P.square(out)).backward()
+    wa = [l for l in m.sublayers()
+          if type(l).__name__ == "WindowAttention"][0]
+    assert wa.rel_bias.grad is not None
+    # shifted blocks exist (every second block in each stage)
+    shifts = [b.shift for b in m.sublayers()
+              if type(b).__name__ == "SwinBlock"]
+    assert any(s > 0 for s in shifts)
+
+
+def test_swin_jit_parity():
+    m = V.swin_t(img_size=32, patch_size=4, window_size=4, num_classes=4)
+    m.eval()
+    x = P.to_tensor(np.random.RandomState(3).rand(1, 3, 32, 32)
+                    .astype(np.float32))
+    e = m(x)
+    j = P.jit.to_static(m)(x)
+    np.testing.assert_allclose(e.numpy(), j.numpy(), rtol=2e-5, atol=1e-5)
