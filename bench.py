@@ -30,11 +30,11 @@ def main():
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024)
-        batch, seq, iters = 8, 1024, 20
+        batch_candidates, seq, iters = [32, 16, 8], 1024, 20
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128)
-        batch, seq, iters = 2, 128, 3
+        batch_candidates, seq, iters = [2], 128, 3
 
     topology.reset_topology()
     strategy = fleet.DistributedStrategy()
@@ -42,29 +42,48 @@ def main():
                                "sep_degree": 1, "sharding_degree": 1}
     fleet.init(is_collective=True, strategy=strategy)
 
-    P.seed(0)
-    model = fleet.distributed_model(GPTForCausalLM(cfg))
-    opt = fleet.distributed_optimizer(
-        P.optimizer.AdamW(parameters=model.parameters(), learning_rate=1e-4))
-    crit = GPTPretrainingCriterion()
-    step = model.build_train_step(opt, crit, amp_dtype="bfloat16")
-
     rs = np.random.RandomState(0)
-    ids = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
-    labels = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+    tps = None
+    model = opt = crit = step = ids = labels = loss = None
+    last_exc = None
+    for batch in batch_candidates:  # biggest batch that fits wins (MXU util)
+        # release the previous attempt's device buffers BEFORE reallocating
+        model = opt = crit = step = ids = labels = loss = None
+        import gc
 
-    # warmup/compile
-    loss = step(ids, labels)
-    loss.block_until_ready()
+        gc.collect()
+        try:
+            # fresh model/opt/step per attempt: a failed donated step leaves
+            # state unusable
+            P.seed(0)
+            model = fleet.distributed_model(GPTForCausalLM(cfg))
+            opt = fleet.distributed_optimizer(
+                P.optimizer.AdamW(parameters=model.parameters(),
+                                  learning_rate=1e-4))
+            crit = GPTPretrainingCriterion()
+            step = model.build_train_step(opt, crit, amp_dtype="bfloat16")
+            ids = P.to_tensor(
+                rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+            labels = P.to_tensor(
+                rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+            # warmup/compile
+            loss = step(ids, labels)
+            loss.block_until_ready()
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, labels)
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    tokens = batch * seq * iters
-    tps = tokens / dt
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = step(ids, labels)
+            loss.block_until_ready()
+            dt = time.perf_counter() - t0
+            tokens = batch * seq * iters
+            tps = tokens / dt
+            break
+        except Exception as e:
+            last_exc = e
+            print(f"batch={batch} failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+    if tps is None:
+        raise RuntimeError("all batch sizes failed") from last_exc
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params  # fwd+bwd matmul flops

@@ -64,6 +64,11 @@ def __getattr__(name):
 
         globals()["Model"] = Model
         return Model
+    if name in ("summary", "flops"):
+        from .hapi.summary import flops, summary
+
+        globals().update(summary=summary, flops=flops)
+        return globals()[name]
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 from .framework.io_utils import load, save  # noqa: F401
@@ -140,6 +145,79 @@ def is_compiled_with_cuda():
 
 def is_compiled_with_xpu():
     return False
+
+
+def get_cudnn_version():
+    return None
+
+
+def _metadata_dtype(dtype):
+    # metadata queries report the dtype ASKED about — no x64 demotion
+    # (that demotion is intentional only for tensor creation)
+    if isinstance(dtype, str):
+        return dtype
+    name = getattr(dtype, "name", None) or str(dtype)
+    return name.replace("paddle.", "").replace("jax.numpy.", "")
+
+
+def iinfo(dtype):
+    import numpy as _np
+
+    return _np.iinfo(_np.dtype(_metadata_dtype(dtype)))
+
+
+def finfo(dtype):
+    import jax.numpy as _jnp
+    import numpy as _np
+
+    name = _metadata_dtype(dtype)
+    if name == "bfloat16":
+        return _jnp.finfo(_jnp.bfloat16)
+    return _np.finfo(_np.dtype(name))
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(tpu:{self.device_id})"
+
+
+class CUDAPlace(TPUPlace):
+    """Accepted for ported code; maps to the accelerator (TPU) place."""
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class version:
+    """paddle.version parity surface."""
+
+    full_version = __version__
+    major, minor, patch = (__version__.split(".") + ["0", "0"])[:3]
+    rc = "0"
+    cuda_version = "False"
+    cudnn_version = "False"
+    tpu = True
+
+    @staticmethod
+    def show():
+        print(f"paddle_tpu {__version__} (XLA/StableHLO/Pallas backend)")
+
+    @staticmethod
+    def cuda():
+        return "False"
+
+    @staticmethod
+    def cudnn():
+        return "False"
 
 
 def is_compiled_with_rocm():

@@ -1,0 +1,115 @@
+"""paddle.summary / paddle.flops parity (`python/paddle/hapi/model_summary.py`,
+`python/paddle/hapi/dynamic_flops.py`): layer table + param/FLOP counts via
+forward hooks."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+def _make_input(input_size, dtypes):
+    import paddle_tpu as P
+
+    if isinstance(input_size, (list, tuple)) and input_size and \
+            isinstance(input_size[0], (list, tuple)):
+        return [_make_input(s, dtypes) for s in input_size]
+    shape = [1 if (s is None or s == -1) else int(s) for s in input_size]
+    dt = dtypes or "float32"
+    if "int" in str(dt):
+        return P.to_tensor(np.zeros(shape, np.int64))
+    return P.to_tensor(np.zeros(shape, np.float32))
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; return dict with total/trainable params."""
+    records = []
+    hooks = []
+
+    def register(layer):
+        def hook(l, inputs, output):
+            out_shape = None
+            out = output
+            if isinstance(out, (list, tuple)) and out:
+                out = out[0]
+            if isinstance(out, Tensor):
+                out_shape = list(out.shape)
+            n_params = sum(int(np.prod(p.shape))
+                           for p in l.parameters(include_sublayers=False))
+            records.append((type(l).__name__, out_shape, n_params))
+
+        if not layer.sublayers():
+            hooks.append(layer.register_forward_post_hook(hook))
+
+    for l in net.sublayers(include_self=True):
+        register(l)
+
+    try:
+        x = input if input is not None else _make_input(input_size, dtypes)
+        if isinstance(x, (list, tuple)):
+            net(*x)
+        else:
+            net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient or getattr(p, "trainable", True))
+    line = "-" * 64
+    print(line)
+    print(f"{'Layer (type)':<24}{'Output Shape':<24}{'Param #':<12}")
+    print(line)
+    for name, shape, n in records:
+        print(f"{name:<24}{str(shape):<24}{n:<12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+_FLOP_RULES = {}
+
+
+def _conv_flops(l, inp, out):
+    k = int(np.prod(l.kernel_size))
+    cin = l.in_channels // getattr(l, "groups", 1)
+    out_numel = int(np.prod(out.shape))
+    return out_numel * cin * k
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total multiply-accumulate count of one forward (paddle.flops)."""
+    from ..nn.layers_common import Linear
+    from ..nn.layers_conv_pool import _ConvNd
+
+    total = [0]
+    hooks = []
+
+    def register(layer):
+        def hook(l, inputs, output):
+            out = output[0] if isinstance(output, (list, tuple)) else output
+            if custom_ops and type(l) in custom_ops:
+                total[0] += int(custom_ops[type(l)](l, inputs, out))
+            elif isinstance(l, _ConvNd):
+                total[0] += _conv_flops(l, inputs, out)
+            elif isinstance(l, Linear):
+                total[0] += int(np.prod(l.weight.shape)) * (
+                    int(np.prod(out.shape)) // out.shape[-1])
+
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for l in net.sublayers(include_self=True):
+        register(l)
+    try:
+        x = _make_input(input_size, None)
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs (MACs): {total[0]:,}")
+    return total[0]

@@ -1,0 +1,52 @@
+"""Top-level API surface: summary/flops, version, places, iinfo/finfo,
+static AMP."""
+import numpy as np
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+
+
+def test_summary_counts_params(capsys):
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = P.summary(m, (1, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    out = capsys.readouterr().out
+    assert "Linear" in out and "Total params" in out
+
+
+def test_flops_linear():
+    m = nn.Linear(8, 16)
+    n = P.flops(m, (4, 8))
+    assert n == 8 * 16 * 4  # MACs per sample * batch
+
+
+def test_version_and_places():
+    assert P.version.full_version == P.__version__
+    assert "cpu" in repr(P.CPUPlace())
+    assert "tpu" in repr(P.CUDAPlace(0))
+    assert P.get_cudnn_version() is None
+
+
+def test_iinfo_finfo():
+    assert P.iinfo("int32").max == 2**31 - 1
+    assert P.finfo("float32").dtype == np.float32
+    assert P.finfo("bfloat16").bits == 16
+
+
+def test_static_amp_autocast_records_casts():
+    from paddle_tpu import amp, static
+
+    static.reset_default_programs()
+    P.enable_static()
+    try:
+        x = static.data("x", [4, 8], "float32")
+        lin = nn.Linear(8, 8)
+        with amp.auto_cast():
+            y = P.matmul(x, lin.weight)
+        exe = static.Executor()
+        (out,) = exe.run(feed={"x": np.ones((4, 8), np.float32)},
+                         fetch_list=[y], return_numpy=False)
+        assert "bfloat16" in str(out.dtype)
+    finally:
+        P.disable_static()
+        static.reset_default_programs()
