@@ -1,20 +1,32 @@
 """Single-chip GPT pretrain throughput benchmark.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line (last line of stdout):
+    {"metric", "value", "unit", "vs_baseline", ...}
 Metric: tokens/sec/chip on a GPT-125M-shape training step (fwd+bwd+AdamW),
 bf16 compute. vs_baseline = achieved MFU / 0.45 (the BASELINE.md north-star
 MFU target; the reference publishes no absolute numbers — BASELINE.md).
+
+Backend hardening (VERDICT.md round-1 task 1): the environment's TPU PJRT
+plugin can fail or hang at init when its tunnel is down. The default
+backend is therefore probed in a watchdog subprocess first; on probe
+failure — or on any TPU-side crash mid-run — the benchmark re-runs as a
+CPU proxy (fresh subprocess, `--force-cpu`) and still emits the JSON line
+with ``"degraded": true``. This script always produces a parseable result.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+_ACCEL_PLATFORMS = ("tpu", "axon")
 
-def main():
+
+def run_bench(degraded: bool = False, note: str = "") -> dict:
     import jax
 
     import paddle_tpu as P
@@ -24,7 +36,7 @@ def main():
     )
 
     platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
+    on_tpu = platform in _ACCEL_PLATFORMS
 
     # GPT-125M shape on TPU; tiny proxy on CPU so the script always completes
     if on_tpu:
@@ -41,6 +53,8 @@ def main():
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
                                "sep_degree": 1, "sharding_degree": 1}
     fleet.init(is_collective=True, strategy=strategy)
+
+    trace_dir = os.environ.get("BENCH_XPROF_DIR")
 
     rs = np.random.RandomState(0)
     tps = None
@@ -70,11 +84,17 @@ def main():
             loss = step(ids, labels)
             loss.block_until_ready()
 
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                loss = step(ids, labels)
-            loss.block_until_ready()
-            dt = time.perf_counter() - t0
+            if trace_dir:
+                jax.profiler.start_trace(trace_dir)
+            try:
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    loss = step(ids, labels)
+                loss.block_until_ready()
+                dt = time.perf_counter() - t0
+            finally:
+                if trace_dir:
+                    jax.profiler.stop_trace()
             tokens = batch * seq * iters
             tps = tokens / dt
             break
@@ -87,14 +107,86 @@ def main():
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params  # fwd+bwd matmul flops
-    peak = {"tpu": 197e12}.get(platform, 1e12)  # v5e bf16 peak
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
     mfu = tps * flops_per_token / peak
-    print(json.dumps({
+    result = {
         "metric": "gpt125m_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    }
+    if degraded or not on_tpu:
+        result["degraded"] = True
+    if note:
+        result["note"] = note
+    return result
+
+
+def _emit(result: dict) -> None:
+    sys.stdout.flush()
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
+def main() -> None:
+    if "--force-cpu" in sys.argv[1:]:
+        from paddle_tpu.backend_guard import force_cpu_mesh
+
+        force_cpu_mesh(1)
+        _emit(run_bench(degraded=True, note="forced-cpu"))
+        return
+
+    from paddle_tpu.backend_guard import (
+        force_cpu_mesh, probe_default_backend,
+    )
+
+    note = ""
+    probe = probe_default_backend(timeout=75.0, retries=2)
+    if probe is not None and probe[0] in _ACCEL_PLATFORMS:
+        try:
+            _emit(run_bench())
+            return
+        except Exception as e:  # TPU ran but the bench crashed mid-run
+            note = f"tpu-run-failed: {type(e).__name__}: {e}"
+            print(note, file=sys.stderr)
+            # CPU fallback needs a fresh process: this one holds a live
+            # TPU backend and possibly poisoned device state.
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--force-cpu"],
+                    capture_output=True, text=True, timeout=600)
+                for line in reversed(r.stdout.splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        out = json.loads(line)
+                        out["note"] = note
+                        _emit(out)
+                        return
+            except Exception as e2:
+                print(f"cpu-subprocess-failed: {e2}", file=sys.stderr)
+            # this process holds a (possibly poisoned) TPU backend and the
+            # fresh-process fallback also failed — emit a parseable line
+            # rather than risk an in-process re-init hang
+            _emit({"metric": "gpt125m_train_tokens_per_sec_per_chip",
+                   "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                   "degraded": True, "note": note + "; cpu-fallback-failed"})
+            return
+    else:
+        note = "tpu-probe-failed" if probe is None else f"platform={probe[0]}"
+        print(f"backend probe: {note}; falling back to CPU proxy",
+              file=sys.stderr)
+
+    # Probe failed or reported a non-accelerator platform: no backend has
+    # been initialized in this process yet (the probe ran in a subprocess),
+    # so an in-process forced-CPU run is safe.
+    force_cpu_mesh(1)
+    try:
+        _emit(run_bench(degraded=True, note=note))
+    except Exception as e:
+        _emit({"metric": "gpt125m_train_tokens_per_sec_per_chip",
+               "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+               "degraded": True, "note": f"{note}; cpu-run-failed: {e}"})
 
 
 if __name__ == "__main__":

@@ -2,15 +2,18 @@
 
 Role parity: `python/paddle/distributed/checkpoint/save_state_dict.py:104` /
 `load_state_dict.py:65` — every rank writes its local shards + merged
-metadata; load reshards arbitrary source↔target placements.
+metadata; load reshards arbitrary source↔target placements, reading only
+the saved shards that intersect each target shard (the reference's
+point-to-point load model, `load_state_dict.py:65 get_rank_to_files`).
 
 TPU-first: on the single-controller runtime each *host process* writes the
-shards it owns (addressable shards of the global jax.Array); metadata records
-(global shape, per-shard offsets). Load assembles requested shards from any
-saved partitioning and `device_put`s them under the target sharding — the
-reshard engine role falls out of global-view arrays. Multi-host: each process
-writes only its addressable shards, so the directory aggregates the full
-state exactly like the reference's per-rank files.
+shards it owns (addressable shards of the global jax.Array) as raw bytes at
+recorded offsets in one `.distcp` file; metadata records (global shape,
+per-shard offsets, byte ranges). Load never materializes a full global
+tensor for sharded targets: `jax.make_array_from_callback` asks for each
+target device's block and the loader assembles just that block from the
+intersecting saved byte ranges. dtypes round-trip bit-exactly (bfloat16 is
+read back via ml_dtypes, never via a float32 detour).
 """
 from __future__ import annotations
 
@@ -24,6 +27,10 @@ import jax
 from ...core.tensor import Tensor
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
+# introspection for tests: peak block size (elements) assembled by the last
+# load, and which keys fell back to full-tensor materialization
+last_load_stats = {"max_block_elems": 0, "full_materialized": []}
+
 
 def _proc_id():
     try:
@@ -32,94 +39,199 @@ def _proc_id():
         return 0
 
 
+def _np_dtype(name):
+    """Resolve a dtype string to numpy, via ml_dtypes for bf16/fp8 names."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     os.makedirs(path, exist_ok=True)
     pid = _proc_id()
     meta = Metadata()
-    shards = {}
-    for key, t in state_dict.items():
-        v = t._value if isinstance(t, Tensor) else t
-        if not hasattr(v, "addressable_shards"):
-            import jax.numpy as jnp
+    fname = f"{pid}.distcp"
+    pos = 0
+    with open(os.path.join(path, fname), "wb") as f:
+        for key, t in state_dict.items():
+            v = t._value if isinstance(t, Tensor) else t
+            if not hasattr(v, "addressable_shards"):
+                import jax.numpy as jnp
 
-            v = jnp.asarray(v)
-        entries = []
-        seen_offsets = set()
-        for sh in v.addressable_shards:
-            # dedup replicated shards (reference dedups replicated tensors)
-            offset = tuple(
-                int(idx.start) if idx.start is not None else 0
-                for idx in sh.index) if sh.index else (0,) * v.ndim
-            if offset in seen_offsets:
-                continue
-            seen_offsets.add(offset)
-            arr = np.asarray(sh.data)
-            storage_key = f"{key}@{'_'.join(map(str, offset))}"
-            shards[storage_key] = arr
-            entries.append(LocalTensorMetadata(
-                offset, tuple(arr.shape), str(v.dtype)))
-            meta.storage_metadata[LocalTensorIndex(key, offset)] = \
-                f"{pid}.distcp"
-        meta.state_dict_metadata[key] = {
-            "global_shape": tuple(v.shape),
-            "dtype": str(v.dtype),
-            "shards": entries,
-        }
-    with open(os.path.join(path, f"{pid}.distcp"), "wb") as f:
-        pickle.dump(shards, f, protocol=4)
+                v = jnp.asarray(v)
+            entries = []
+            seen_offsets = set()
+            for sh in v.addressable_shards:
+                # dedup replicated shards (reference dedups replicated
+                # tensors across dp, save_state_dict.py:76)
+                offset = tuple(
+                    int(idx.start) if idx.start is not None else 0
+                    for idx in sh.index) if sh.index else (0,) * v.ndim
+                if offset in seen_offsets:
+                    continue
+                seen_offsets.add(offset)
+                arr = np.asarray(sh.data)
+                raw = arr.tobytes()
+                f.write(raw)
+                entries.append(LocalTensorMetadata(
+                    offset, tuple(arr.shape), str(v.dtype)))
+                meta.storage_metadata[LocalTensorIndex(key, offset)] = {
+                    "file": fname, "byte_offset": pos, "nbytes": len(raw),
+                }
+                pos += len(raw)
+            meta.state_dict_metadata[key] = {
+                "global_shape": tuple(v.shape),
+                "dtype": str(v.dtype),
+                "shards": entries,
+            }
     if pid == coordinator_rank:
         with open(os.path.join(path, f"{pid}.metadata"), "wb") as f:
             pickle.dump(meta, f, protocol=4)
 
 
-def _load_all_shards(path):
-    shards = {}
-    meta = None
+def _load_metadata(path):
+    metas = []
     for name in sorted(os.listdir(path)):
-        full = os.path.join(path, name)
-        if name.endswith(".distcp"):
-            with open(full, "rb") as f:
-                shards.update(pickle.load(f))
-        elif name.endswith(".metadata"):
-            with open(full, "rb") as f:
-                meta = pickle.load(f)
-    return meta, shards
+        if name.endswith(".metadata"):
+            with open(os.path.join(path, name), "rb") as f:
+                metas.append(pickle.load(f))
+    if not metas:
+        return None
+    # multi-host: coordinator wrote one file; merge defensively if several
+    meta = metas[0]
+    for extra in metas[1:]:
+        meta.state_dict_metadata.update(extra.state_dict_metadata)
+        meta.storage_metadata.update(extra.storage_metadata)
+    return meta
+
+
+class _ShardReader:
+    """Reads saved shard byte-ranges on demand; caches open file handles,
+    never whole files."""
+
+    def __init__(self, path, meta):
+        self.path = path
+        self.meta = meta
+        self._files = {}
+
+    def read(self, key, entry):
+        loc = self.meta.storage_metadata.get(
+            LocalTensorIndex(key, tuple(entry.global_offset)))
+        if loc is None:
+            return None
+        if isinstance(loc, str):  # legacy layout: whole-file pickle
+            cached = self._files.get(("pickle", loc))
+            if cached is None:
+                with open(os.path.join(self.path, loc), "rb") as f:
+                    cached = pickle.load(f)
+                self._files[("pickle", loc)] = cached
+            return cached[
+                f"{key}@{'_'.join(map(str, entry.global_offset))}"]
+        f = self._files.get(loc["file"])
+        if f is None:
+            f = open(os.path.join(self.path, loc["file"]), "rb")
+            self._files[loc["file"]] = f
+        f.seek(loc["byte_offset"])
+        raw = f.read(loc["nbytes"])
+        dt = _np_dtype(entry.dtype)
+        return np.frombuffer(raw, dtype=dt).reshape(entry.local_shape)
+
+    def close(self):
+        for f in self._files.values():
+            if hasattr(f, "close"):
+                f.close()
+        self._files.clear()
+
+
+def _assemble_block(key, info, reader, block_index):
+    """Assemble one target block (tuple of slices into the global tensor)
+    from the saved shards that intersect it."""
+    gshape = info["global_shape"]
+    dt = _np_dtype(info["dtype"])
+    starts = [s.start or 0 for s in block_index]
+    stops = [s.stop if s.stop is not None else dim
+             for s, dim in zip(block_index, gshape)]
+    bshape = tuple(b - a for a, b in zip(starts, stops))
+    if not bshape:  # scalar
+        entry = info["shards"][0]
+        return reader.read(key, entry).reshape(())
+    # zeros, not empty: a region no readable shard covers (missing file,
+    # stale metadata) must not surface uninitialized memory as weights
+    block = np.zeros(bshape, dtype=dt)
+    last_load_stats["max_block_elems"] = max(
+        last_load_stats["max_block_elems"], int(np.prod(bshape) or 1))
+    for entry in info["shards"]:
+        e_lo = list(entry.global_offset)
+        e_hi = [o + s for o, s in zip(entry.global_offset, entry.local_shape)]
+        lo = [max(a, b) for a, b in zip(starts, e_lo)]
+        hi = [min(a, b) for a, b in zip(stops, e_hi)]
+        if any(a >= b for a, b in zip(lo, hi)):
+            continue
+        src = reader.read(key, entry)
+        if src is None:
+            continue
+        src_sl = tuple(slice(a - o, b - o)
+                       for a, b, o in zip(lo, hi, e_lo))
+        dst_sl = tuple(slice(a - s, b - s)
+                       for a, b, s in zip(lo, hi, starts))
+        block[dst_sl] = src[src_sl]
+    return block
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None,
                     offload=False):
     """Fill `state_dict`'s tensors in-place from the checkpoint, resharding
-    from the saved partitioning to each target tensor's current sharding."""
-    meta, shards = _load_all_shards(path)
+    from the saved partitioning to each target tensor's current sharding.
+
+    Sharded targets are assembled block-by-block via
+    `jax.make_array_from_callback` — no full global tensor is ever
+    materialized on the host for them (scales to multi-B-param states).
+    """
+    meta = _load_metadata(path)
     assert meta is not None, f"no metadata found under {path}"
-    for key, t in state_dict.items():
-        if key not in meta.state_dict_metadata:
-            continue
-        info = meta.state_dict_metadata[key]
-        gshape = info["global_shape"]
-        full = np.zeros(gshape, dtype=np.dtype(
-            info["dtype"].replace("bfloat16", "float32")))
-        for entry in info["shards"]:
-            skey = f"{key}@{'_'.join(map(str, entry.global_offset))}"
-            if skey not in shards:
+    last_load_stats["max_block_elems"] = 0
+    last_load_stats["full_materialized"] = []
+    reader = _ShardReader(path, meta)
+    try:
+        for key, t in state_dict.items():
+            if key not in meta.state_dict_metadata:
                 continue
-            sl = tuple(slice(o, o + s) for o, s in
-                       zip(entry.global_offset, entry.local_shape))
-            arr = shards[skey]
-            if info["dtype"] == "bfloat16":
-                arr = arr.astype(np.float32)
-            full[sl] = arr
-        if isinstance(t, Tensor):
+            info = meta.state_dict_metadata[key]
+            gshape = tuple(info["global_shape"])
+            dt = _np_dtype(info["dtype"])
+            if not isinstance(t, Tensor):
+                continue
             tgt_sharding = getattr(t._value, "sharding", None)
+            is_sharded = (
+                tgt_sharding is not None
+                and hasattr(tgt_sharding, "is_fully_replicated")
+                and not tgt_sharding.is_fully_replicated
+                and gshape != ())
+            if is_sharded:
+                t._value = jax.make_array_from_callback(
+                    gshape, tgt_sharding,
+                    lambda idx, _k=key, _i=info: np.ascontiguousarray(
+                        _assemble_block(_k, _i, reader, idx)).astype(
+                            dt, copy=False))
+                continue
+            # replicated / unsharded target: the full array IS the target
+            full = _assemble_block(
+                key, info, reader, tuple(slice(0, d) for d in gshape))
+            last_load_stats["full_materialized"].append(key)
             import jax.numpy as jnp
 
-            val = jnp.asarray(full, dtype=info["dtype"])
+            val = jnp.asarray(full, dtype=dt)
             if tgt_sharding is not None:
                 try:
                     val = jax.device_put(val, tgt_sharding)
                 except Exception:
                     pass
             t._value = val
+    finally:
+        reader.close()
     return state_dict

@@ -36,7 +36,8 @@ from . import topology as topo_mod
 from .train_step import param_placements
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-           "segment_layers"]
+           "segment_layers", "interleaved_order", "simulate_makespan",
+           "bubble_fraction"]
 
 
 class LayerDesc:
@@ -81,8 +82,126 @@ def segment_layers(layers, num_stages, method="uniform"):
     return [layers[bounds[i]:bounds[i + 1]] for i in range(num_stages)]
 
 
+def _vpp_microstep(k, pp, v, forward):
+    """Map a rank-local micro-step index to (chunk_round, microbatch).
+
+    Megatron interleave pattern: micro-batches advance in groups of ``pp``;
+    within a group the rank cycles through its ``v`` chunks (forward in
+    ascending chunk-round order, backward descending).
+    """
+    group, within = divmod(k, pp * v)
+    round_ = within // pp
+    if not forward:
+        round_ = v - 1 - round_
+    mb = group * pp + within % pp
+    return round_, mb
+
+
+def interleaved_order(pp, v, m):
+    """Global dependency-valid enqueue order for the interleaved (VPP)
+    schedule: list of (chunk, 'F'|'B', mb) with chunk ∈ [0, pp*v).
+
+    Per-rank local op sequences follow Megatron's interleaved 1F1B
+    (warmup = 2*(pp-1-rank) + (v-1)*pp micro-steps, then steady 1F1B,
+    then cooldown); the global order is a greedy linearization that
+    respects both the local sequences and cross-chunk data dependencies.
+    """
+    if v > 1:  # plain 1F1B (v=1) has no divisibility requirement
+        assert m % pp == 0, (
+            f"interleaved schedule needs micro-batches ({m}) divisible by "
+            f"pipeline stages ({pp})")
+    n_chunks = pp * v
+    total = m * v  # forward micro-steps per rank
+    local = []
+    for i in range(pp):
+        # v=1 degenerates to classic 1F1B warmup; the 2x factor + (v-1)*pp
+        # extra in-flight micro-steps are what lets later chunks start
+        # before earlier ones drain (Megatron interleave)
+        warm = (min(pp - 1 - i, total) if v == 1 else
+                min((pp - 1 - i) * 2 + (v - 1) * pp, total))
+        seq = [("F", k) for k in range(warm)]
+        for j in range(total - warm):
+            seq.append(("F", warm + j))
+            seq.append(("B", j))
+        seq += [("B", j) for j in range(total - warm, total)]
+        local.append(seq)
+
+    ptr = [0] * pp
+    fdone, bdone = set(), set()  # (chunk, mb)
+    order = []
+    remaining = pp * total * 2
+    while remaining:
+        progressed = False
+        for i in range(pp):
+            if ptr[i] >= len(local[i]):
+                continue
+            op, k = local[i][ptr[i]]
+            fwd = op == "F"
+            round_, mb = _vpp_microstep(k, pp, v, fwd)
+            c = round_ * pp + i
+            if fwd:
+                ready = c == 0 or (c - 1, mb) in fdone
+            else:
+                ready = (c, mb) in fdone and (
+                    c == n_chunks - 1 or (c + 1, mb) in bdone)
+            if ready:
+                order.append((c, op, mb))
+                (fdone if fwd else bdone).add((c, mb))
+                ptr[i] += 1
+                remaining -= 1
+                progressed = True
+        assert progressed, "interleaved schedule deadlock"
+    return order
+
+
+def simulate_makespan(order, pp, n_chunks, op_cost=1.0):
+    """Event-driven makespan of a schedule order (unit-cost chunk ops).
+
+    Each op occupies its physical rank (chunk % pp) for ``op_cost`` and
+    may start once its data dependencies finished. Returns the makespan.
+    """
+    rank_free = [0.0] * pp
+    done = {}
+    for (c, op, mb) in order:
+        i = c % pp
+        t = rank_free[i]
+        if op == "F":
+            if c > 0:
+                t = max(t, done[(c - 1, "F", mb)])
+        else:
+            t = max(t, done[(c, "F", mb)])
+            if c < n_chunks - 1:
+                t = max(t, done[(c + 1, "B", mb)])
+        t += op_cost
+        done[(c, op, mb)] = t
+        rank_free[i] = t
+    return max(rank_free)
+
+
+def bubble_fraction(pp, m, v=1):
+    """Idle fraction of the schedule, with chunk-op cost 1/v so total work
+    per rank is constant across v (same model, finer chunks)."""
+    if v == 1:
+        # plain 1F1B local orders via the same machinery
+        order = interleaved_order(pp, 1, m) if m % pp == 0 else None
+        assert order is not None
+    else:
+        order = interleaved_order(pp, v, m)
+    cost = 1.0 / v
+    span = simulate_makespan(order, pp, pp * v, cost)
+    work = 2.0 * m  # per-rank busy time, in full-stage units
+    return (span - work) / span
+
+
 class PipelineLayer(Layer):
-    """Holds the full LayerDesc list + stage partition (pp_layers parity)."""
+    """Holds the full LayerDesc list + stage partition (pp_layers parity).
+
+    With ``num_virtual_pipeline_stages = v > 1`` the model is cut into
+    ``num_stages * v`` chunks; physical stage ``i`` owns chunks
+    ``{i, i+pp, i+2pp, …}`` (Megatron-style interleaving, reference
+    `fleet/meta_parallel/pipeline_parallel.py:906`
+    PipelineParallelWithInterleave).
+    """
 
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seg_method="uniform", recompute_interval=0,
@@ -90,11 +209,17 @@ class PipelineLayer(Layer):
         super().__init__()
         topo = topology or topo_mod.get_topology()
         self.num_stages = num_stages or topo.pp_degree
+        self.num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         built = [d.build_layer() if isinstance(d, LayerDesc) else d
                  for d in layers]
         self._full_layers = built
         self.loss_fn = loss_fn
-        stages = segment_layers(built, self.num_stages, seg_method)
+        n_chunks = self.num_stages * self.num_virtual_stages
+        if n_chunks > len(built):
+            raise ValueError(
+                f"cannot split {len(built)} layers into {n_chunks} chunks "
+                f"(pp={self.num_stages} × vpp={self.num_virtual_stages})")
+        stages = segment_layers(built, n_chunks, seg_method)
         self.stages = [Sequential(*s) for s in stages]
         for i, s in enumerate(self.stages):
             self.add_sublayer(f"stage_{i}", s)
@@ -184,11 +309,21 @@ class PipelineParallel:
         self.num_micro_batches = num_micro_batches or self.pp
         assert isinstance(pipeline_layer, PipelineLayer)
         self.pipe = pipeline_layer
+        self.vpp = getattr(pipeline_layer, "num_virtual_stages", 1)
+        self.n_chunks = self.pp * self.vpp
+        assert len(pipeline_layer.stages) == self.n_chunks, (
+            f"PipelineLayer has {len(pipeline_layer.stages)} chunks, "
+            f"topology needs pp×vpp = {self.n_chunks}")
+        if self.vpp > 1:
+            assert self.num_micro_batches % self.pp == 0, (
+                "interleaved (VPP) schedule needs num_micro_batches "
+                f"({self.num_micro_batches}) divisible by pp ({self.pp})")
         self.loss_fn = pipeline_layer.loss_fn
+        # chunk c lives on physical stage c % pp (interleaved assignment)
         self.stages = [
-            _Stage(pipeline_layer.stages[i], self.topo.stage_mesh(i),
-                   i == self.pp - 1, self.loss_fn)
-            for i in range(self.pp)
+            _Stage(pipeline_layer.stages[c], self.topo.stage_mesh(c % self.pp),
+                   c == self.n_chunks - 1, self.loss_fn)
+            for c in range(self.n_chunks)
         ]
         self._opt_states = None
         self._opt_update = None
@@ -280,8 +415,12 @@ class PipelineParallel:
         for st in self.stages:
             st.grads = None
 
-        order = self._schedule_1f1b(m) if self.schedule == "1F1B" else \
-            self._schedule_fthenb(m)
+        if self.vpp > 1:
+            order = interleaved_order(self.pp, self.vpp, m)
+        elif self.schedule == "1F1B":
+            order = self._schedule_1f1b(m)
+        else:
+            order = self._schedule_fthenb(m)
         for (i, op, mb) in order:
             st = self.stages[i]
             if op == "F":

@@ -105,11 +105,12 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     host, port = master.split(":")
     store = TCPStore(host, int(port), is_master=(rank == 0))
 
-    server = _Server(("0.0.0.0", 0), _Handler)
+    # bind only the advertised interface — the handler executes unpickled
+    # callables, so don't widen the trust domain beyond the job's network
+    my_ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+    server = _Server((my_ip, 0), _Handler)
     my_port = server.server_address[1]
     threading.Thread(target=server.serve_forever, daemon=True).start()
-
-    my_ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
     store.set(f"rpc/{rank}", f"{name},{my_ip},{my_port}")
 
     workers = {}

@@ -147,8 +147,14 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                  else np.zeros(0, rowv.dtype))
     cnt = Tensor(np.asarray(out_cnt, np.int32))
     if return_eids:
-        return nbr, cnt, Tensor(np.concatenate(out_eids)
-                                if out_eids else np.zeros(0, np.int64))
+        ids = (np.concatenate(out_eids) if out_eids
+               else np.zeros(0, np.int64))
+        # caller-provided eids map CSR slots to real edge ids (reference
+        # gathers returned ids from it); without it, slots ARE the ids
+        if eids is not None:
+            ev = np.asarray(eids._value if isinstance(eids, Tensor) else eids)
+            ids = ev[ids]
+        return nbr, cnt, Tensor(ids)
     return nbr, cnt
 
 
@@ -162,21 +168,32 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     nodes = np.asarray(
         input_nodes._value if isinstance(input_nodes, Tensor)
         else input_nodes)
-    out_nb, out_cnt = [], []
+    out_nb, out_cnt, out_eids = [], [], []
     for nd in nodes.ravel():
         lo, hi = int(colp[nd]), int(colp[nd + 1])
         nbrs = rowv[lo:hi]
         ww = w[lo:hi]
+        ids = np.arange(lo, hi)
         if sample_size != -1 and len(nbrs) > sample_size:
             p = ww / ww.sum()
             pick = np.random.choice(len(nbrs), size=sample_size,
                                     replace=False, p=p)
             nbrs = nbrs[pick]
+            ids = ids[pick]
         out_nb.append(nbrs)
+        out_eids.append(ids)
         out_cnt.append(len(nbrs))
-    return (Tensor(np.concatenate(out_nb) if out_nb
-                   else np.zeros(0, rowv.dtype)),
-            Tensor(np.asarray(out_cnt, np.int32)))
+    nbr = Tensor(np.concatenate(out_nb) if out_nb
+                 else np.zeros(0, rowv.dtype))
+    cnt = Tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        ids = (np.concatenate(out_eids) if out_eids
+               else np.zeros(0, np.int64))
+        if eids is not None:
+            ev = np.asarray(eids._value if isinstance(eids, Tensor) else eids)
+            ids = ev[ids]
+        return nbr, cnt, Tensor(ids)
+    return nbr, cnt
 
 
 def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,

@@ -57,7 +57,7 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
     total = sum(int(np.prod(p.shape)) for p in net.parameters())
     trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
-                    if not p.stop_gradient or getattr(p, "trainable", True))
+                    if not p.stop_gradient and getattr(p, "trainable", True))
     line = "-" * 64
     print(line)
     print(f"{'Layer (type)':<24}{'Output Shape':<24}{'Param #':<12}")

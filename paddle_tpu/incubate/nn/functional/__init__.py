@@ -27,6 +27,14 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    quant_scale=-1, **kw):
     """fused_rms_norm parity (residual-add + bias + rmsnorm in one op)."""
     def f(xv, w, b, bias_v, res):
+        from ....ops.pallas.fused_norm import (
+            fused_norm_available, fused_norm_pallas,
+        )
+
+        if begin_norm_axis in (-1, xv.ndim - 1) and \
+                fused_norm_available(xv, w, b):
+            return fused_norm_pallas(xv, w, b, bias_v, res,
+                                     eps=epsilon, kind="rms")
         if bias_v is not None:
             xv = xv + bias_v
         if res is not None:
@@ -48,6 +56,14 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
                      begin_norm_axis=-1, bias=None, residual=None, **kw):
     def f(xv, w, b, bias_v, res):
+        from ....ops.pallas.fused_norm import (
+            fused_norm_available, fused_norm_pallas,
+        )
+
+        if begin_norm_axis in (-1, xv.ndim - 1) and \
+                fused_norm_available(xv, w, b):
+            return fused_norm_pallas(xv, w, b, bias_v, res,
+                                     eps=epsilon, kind="ln")
         if bias_v is not None:
             xv = xv + bias_v
         if res is not None:
@@ -162,6 +178,14 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
         bidx = jnp.arange(B)
         kcache = cache[0].at[bidx, :, pos, :].set(k)
         vcache = cache[1].at[bidx, :, pos, :].set(v)
+        if mask is None:
+            from ....ops.pallas.decode_attention import (
+                decode_attention, decode_attention_available,
+            )
+
+            if decode_attention_available(cache.shape):
+                out = decode_attention(q, kcache, vcache, pos)
+                return out.reshape(B, H * D), jnp.stack([kcache, vcache])
         valid = (jnp.arange(S)[None, None, :]
                  <= pos[:, None, None])                       # [B,1,S]
         scores = jnp.einsum("bhd,bhsd->bhs", q, kcache) \

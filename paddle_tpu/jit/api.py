@@ -68,7 +68,12 @@ class StaticFunction:
         return self._layer.functional_state()
 
     def _build(self, treedef, static_leaves, n_dyn, training):
-        fn = self._fn
+        from . import dy2static
+
+        # AST tier: rewrite tensor-dependent if/while to lax.cond/while_loop
+        # before tracing (reference dy2static transformers role); functions
+        # without retrievable source trace as-is
+        fn = dy2static.convert(self._fn)
         layer = self._layer
 
         def pure(params, buffers, key, *dyn_vals):
@@ -139,8 +144,13 @@ class StaticFunction:
             f"jit::{getattr(self._fn, '__name__', 'fn')}",
             mega, param_tensors, buffers, gen_key, *dyn_args)
 
-        rng.default_generator.set_state(
-            new_key._value if isinstance(new_key, Tensor) else new_key)
+        new_key_val = new_key._value if isinstance(new_key, Tensor) \
+            else new_key
+        # under an outer trace (e.g. jit.save exporting a Layer whose
+        # forward is already a StaticFunction) the threaded key is a
+        # tracer — writing it into the global generator would leak it
+        if not isinstance(new_key_val, jax.core.Tracer):
+            rng.default_generator.set_state(new_key_val)
         if self._layer is not None and new_buffers:
             named_b = dict(self._layer.named_buffers())
             items = new_buffers.items() if isinstance(new_buffers, dict) else []
@@ -202,11 +212,17 @@ def save(layer, path, input_spec=None, **configs):
         params, buffers = target.functional_state()
         key = rng.default_generator.get_state()
 
+        # if the Layer's forward was to_static-wrapped, export the original
+        # forward — re-entering StaticFunction during export tracing would
+        # thread the traced RNG key through the global generator
+        fwd = target.forward
+        call = fwd._fn if isinstance(fwd, StaticFunction) else target
+
         def pure(params, buffers, key, *dyn):
             with flags.trace_guard():
                 with target.bind_state(params, buffers):
                     wrapped = [Tensor(v) for v in dyn]
-                    out = target(*wrapped)
+                    out = call(*wrapped)
             return jax.tree_util.tree_map(
                 lambda o: o._value if isinstance(o, Tensor) else o, out,
                 is_leaf=lambda x: isinstance(x, Tensor))

@@ -1,0 +1,494 @@
+"""Dynamic-to-static control-flow conversion (AST tier).
+
+Role parity: the reference's dy2static AST transformers
+(`python/paddle/jit/dy2static/transformers/convert_operators.py`,
+`ifelse_transformer.py`, `loop_transformer.py`) and the SOT fallback's
+graph-break contract. TPU-first: instead of emitting `conditional_block` /
+`while` ops into a ProgramDesc, tensor-dependent `if`/`while` become
+`jax.lax.cond` / `jax.lax.while_loop` in the traced program — XLA-native
+control flow, no second IR.
+
+How it works:
+  * `convert(fn)` rewrites the function's AST: every `if` whose outcome may
+    depend on a traced Tensor becomes `_jst_if(pred, true_fn, false_fn,
+    (threaded vars…))`; every `while` becomes `_jst_while(cond_fn, body_fn,
+    (threaded vars…))`; `and`/`or`/`not` inside tests become
+    `_jst_and/or/not` (tensor-aware, both operands evaluated).
+  * At runtime the `_jst_*` helpers check the predicate: a concrete bool
+    takes the plain Python path (eager mode — zero overhead beyond one
+    isinstance); a traced Tensor routes through `lax.cond`/`while_loop`
+    with the *Tensor-valued* threaded variables as carried state.
+  * Variables assigned under a traced branch/loop must hold Tensors (or
+    stay untouched): rebinding a Python scalar divergently is a
+    graph-break and raises `Dy2StaticError` with guidance — the loud-error
+    contract (VERDICT.md round-1 item 5) instead of silent specialization.
+
+Scope: `if`/`while`/boolean ops at any nesting depth inside the converted
+function; user-defined callees are converted transitively via `_jst_call`
+(reference convert_call role). `for` over Python iterables stays Python
+(it unrolls under trace, matching the reference's static-range behavior).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert", "Dy2StaticError"]
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+_HELPERS = "__jst__"
+_conversion_cache: dict = {}
+
+
+# =========================== runtime helpers ===========================
+
+def _is_traced(x):
+    return isinstance(x, Tensor) and isinstance(x._value, jax.core.Tracer)
+
+
+def _tensor_bool(pred):
+    """Concrete truthiness for non-traced predicates."""
+    if isinstance(pred, Tensor):
+        return bool(jax.device_get(pred._value))
+    return bool(pred)
+
+
+def _thread_split(vals):
+    """Split threaded vars into (tensor positions, tensor values, template)."""
+    tpos, tvals = [], []
+    for i, v in enumerate(vals):
+        if isinstance(v, Tensor):
+            tpos.append(i)
+            tvals.append(v._value)
+    return tpos, tvals
+
+
+def _thread_merge(vals, tpos, new_tvals):
+    out = list(vals)
+    for i, v in zip(tpos, new_tvals):
+        out[i] = Tensor(v)
+        out[i].stop_gradient = vals[i].stop_gradient \
+            if isinstance(vals[i], Tensor) else True
+    return tuple(out)
+
+
+class _Undef:
+    """Sentinel for threaded variables that were unbound before the
+    control-flow statement (reference UndefinedVar role)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undef()
+
+
+def _jst_if(pred, true_fn, false_fn, names, vals):
+    if not _is_traced(pred):
+        return true_fn(*vals) if _tensor_bool(pred) else false_fn(*vals)
+
+    tpos, tvals = _thread_split(vals)
+
+    def run(branch_fn):
+        def g(carried):
+            merged = _thread_merge(vals, tpos, carried)
+            outs = branch_fn(*merged)
+            mask = tuple(isinstance(o, Tensor) for o in outs)
+            out_tvals = tuple(o._value for o in outs if isinstance(o, Tensor))
+            rest = tuple(o for o in outs if not isinstance(o, Tensor))
+            return out_tvals, rest, mask
+        return g
+
+    # trace both branches once to validate cross-branch structure and
+    # collect the (branch-invariant) non-Tensor outputs
+    t_tvals, t_rest, t_mask = run(true_fn)(tuple(tvals))
+    f_tvals, f_rest, f_mask = run(false_fn)(tuple(tvals))
+    if t_mask != f_mask:
+        diverging = [n for n, a, b in zip(names, t_mask, f_mask) if a != b]
+        raise Dy2StaticError(
+            f"dy2static: variables {diverging} are Tensors on one path of "
+            "a traced `if` but not the other; assign every threaded "
+            "variable a Tensor on both paths (e.g. initialize with "
+            "paddle_tpu.to_tensor)")
+    rest_names = [n for n, m in zip(names, t_mask) if not m]
+    for n, tr_, fr_ in zip(rest_names, t_rest, f_rest):
+        if tr_ is not fr_ and tr_ != fr_:
+            raise Dy2StaticError(
+                f"dy2static: Python variable '{n}' takes different values "
+                "in the two branches of a traced `if`; only Tensors can be "
+                "selected by lax.cond — make it a Tensor or hoist the "
+                "assignment out of the data-dependent branch")
+
+    out_tvals = jax.lax.cond(
+        pred._value,
+        lambda c: run(true_fn)(c)[0],
+        lambda c: run(false_fn)(c)[0],
+        tuple(tvals))
+    outs = []
+    ti = ri = 0
+    for is_t in t_mask:
+        if is_t:
+            outs.append(Tensor(out_tvals[ti]))
+            ti += 1
+        else:
+            outs.append(t_rest[ri])
+            ri += 1
+    return tuple(outs)
+
+
+def _jst_while(cond_fn, body_fn, names, vals):
+    probe = cond_fn(*vals)
+    if not _is_traced(probe):
+        while _tensor_bool(probe):
+            vals = body_fn(*vals)
+            probe = cond_fn(*vals)
+        return vals
+
+    tpos, tvals = _thread_split(vals)
+    if len(tpos) != len(vals):
+        non = [n for n, v in zip(names, vals) if not isinstance(v, Tensor)]
+        raise Dy2StaticError(
+            f"dy2static: traced `while` carries non-Tensor variables {non}; "
+            "XLA while_loop state must be Tensors — convert them with "
+            "paddle_tpu.to_tensor or hoist them out of the loop")
+
+    def cond(carried):
+        merged = _thread_merge(vals, tpos, carried)
+        p = cond_fn(*merged)
+        return p._value if isinstance(p, Tensor) else p
+
+    def body(carried):
+        merged = _thread_merge(vals, tpos, carried)
+        outs = body_fn(*merged)
+        for n, b, a in zip(names, merged, outs):
+            if isinstance(b, Tensor) != isinstance(a, Tensor):
+                raise Dy2StaticError(
+                    f"dy2static: variable '{n}' switches between Tensor "
+                    "and non-Tensor inside a traced `while` body; the "
+                    "loop state must keep a fixed structure")
+        _, out_tvals = _thread_split(outs)
+        if len(out_tvals) != len(carried):
+            raise Dy2StaticError(
+                "dy2static: traced `while` body changed which variables "
+                "hold Tensors; the loop state must keep a fixed structure")
+        return tuple(out_tvals)
+
+    out_tvals = jax.lax.while_loop(cond, body, tuple(tvals))
+    return _thread_merge(vals, tpos, out_tvals)
+
+
+def _jst_and(x, y):
+    xv = x() if callable(x) else x
+    if isinstance(xv, Tensor) and _is_traced(xv):
+        yv = y() if callable(y) else y
+        yvv = yv._value if isinstance(yv, Tensor) else yv
+        return Tensor(jnp.logical_and(xv._value.astype(bool),
+                                      jnp.asarray(yvv).astype(bool)))
+    if not _tensor_bool(xv):
+        return xv if not isinstance(xv, Tensor) else False
+    return y() if callable(y) else y
+
+
+def _jst_or(x, y):
+    xv = x() if callable(x) else x
+    if isinstance(xv, Tensor) and _is_traced(xv):
+        yv = y() if callable(y) else y
+        yvv = yv._value if isinstance(yv, Tensor) else yv
+        return Tensor(jnp.logical_or(xv._value.astype(bool),
+                                     jnp.asarray(yvv).astype(bool)))
+    if _tensor_bool(xv):
+        return xv if not isinstance(xv, Tensor) else True
+    return y() if callable(y) else y
+
+
+def _jst_not(x):
+    if isinstance(x, Tensor) and _is_traced(x):
+        return Tensor(jnp.logical_not(x._value.astype(bool)))
+    return not _tensor_bool(x)
+
+
+def _jst_call(fn):
+    """Transitive conversion of user callees (reference convert_call)."""
+    from ..nn.layer_base import Layer
+
+    if isinstance(fn, Layer) or not callable(fn):
+        return fn  # Layer.forward goes through __call__; convert on demand
+    mod = getattr(fn, "__module__", None) or ""
+    if mod.split(".")[0] in ("paddle_tpu", "jax", "jaxlib", "numpy",
+                             "builtins", "math", "functools"):
+        return fn
+    if isinstance(fn, (types.FunctionType, types.MethodType)):
+        try:
+            return convert(fn)
+        except Exception:
+            return fn
+    return fn
+
+
+class _Helpers:
+    if_ = staticmethod(_jst_if)
+    while_ = staticmethod(_jst_while)
+    and_ = staticmethod(_jst_and)
+    or_ = staticmethod(_jst_or)
+    not_ = staticmethod(_jst_not)
+    call = staticmethod(_jst_call)
+    UNDEF = UNDEF
+
+
+# =========================== AST transform ===========================
+
+def _assigned_names(nodes):
+    out = set()
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)):
+                out.add(n.id)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name):
+                out.add(n.target.id)
+    return out
+
+
+def _read_names(node):
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _has_return(nodes):
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Return):
+                return True
+    return False
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def _name(self, base):
+        self._uid += 1
+        return f"__jst_{base}_{self._uid}"
+
+    @staticmethod
+    def _undef_guards(names):
+        """`try: name \nexcept (NameError, UnboundLocalError): name = UNDEF`
+        per threaded name — branches may bind vars that don't exist yet."""
+        guards = []
+        for m in names:
+            guards.append(ast.Try(
+                body=[ast.Expr(value=ast.Name(id=m, ctx=ast.Load()))],
+                handlers=[ast.ExceptHandler(
+                    type=ast.Tuple(
+                        elts=[ast.Name(id="NameError", ctx=ast.Load()),
+                              ast.Name(id="UnboundLocalError",
+                                       ctx=ast.Load())],
+                        ctx=ast.Load()),
+                    name=None,
+                    body=[ast.Assign(
+                        targets=[ast.Name(id=m, ctx=ast.Store())],
+                        value=ast.Attribute(
+                            value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                            attr="UNDEF", ctx=ast.Load()))])],
+                orelse=[], finalbody=[]))
+        return guards
+
+    # ---- boolean ops in any expression ----
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "and_" if isinstance(node.op, ast.And) else "or_"
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                    attr=op, ctx=ast.Load()),
+                args=[expr, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                       kw_defaults=[], defaults=[]),
+                    body=rhs)],
+                keywords=[])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                    attr="not_", ctx=ast.Load()),
+                args=[node.operand], keywords=[]), node)
+        return node
+
+    # ---- calls: transitive conversion ----
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        node.func = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                attr="call", ctx=ast.Load()),
+            args=[node.func], keywords=[])
+        return node
+
+    # ---- if/while ----
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_return(node.body) or _has_return(node.orelse):
+            # branch with `return` can't become lax.cond — leave as Python
+            # (fails loudly at trace time if the predicate is traced)
+            return node
+        mod = sorted((_assigned_names(node.body)
+                      | _assigned_names(node.orelse))
+                     - {"_", _HELPERS})
+        if not mod:
+            return node
+        tname, fname = self._name("true"), self._name("false")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=m) for m in mod],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=m, ctx=ast.Load()) for m in mod],
+            ctx=ast.Load()))
+        t_def = ast.FunctionDef(
+            name=tname, args=args, body=list(node.body) + [ret],
+            decorator_list=[], returns=None, type_params=[])
+        f_def = ast.FunctionDef(
+            name=fname, args=args, body=list(node.orelse) + [ret],
+            decorator_list=[], returns=None, type_params=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=m, ctx=ast.Store()) for m in mod],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                    attr="if_", ctx=ast.Load()),
+                args=[
+                    node.test,
+                    ast.Name(id=tname, ctx=ast.Load()),
+                    ast.Name(id=fname, ctx=ast.Load()),
+                    ast.Tuple(elts=[ast.Constant(value=m) for m in mod],
+                              ctx=ast.Load()),
+                    ast.Tuple(elts=[ast.Name(id=m, ctx=ast.Load())
+                                    for m in mod], ctx=ast.Load()),
+                ],
+                keywords=[]))
+        out = self._undef_guards(mod) + [t_def, f_def, assign]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_return(node.body) or node.orelse:
+            return node
+        mod = sorted((_assigned_names(node.body) | _read_names(node.test))
+                     - {"_", _HELPERS})
+        if not mod:
+            return node
+        cname, bname = self._name("cond"), self._name("body")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=m) for m in mod],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        c_def = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=m, ctx=ast.Load()) for m in mod],
+            ctx=ast.Load()))
+        b_def = ast.FunctionDef(
+            name=bname, args=args, body=list(node.body) + [ret],
+            decorator_list=[], returns=None, type_params=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=m, ctx=ast.Store()) for m in mod],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                    attr="while_", ctx=ast.Load()),
+                args=[
+                    ast.Name(id=cname, ctx=ast.Load()),
+                    ast.Name(id=bname, ctx=ast.Load()),
+                    ast.Tuple(elts=[ast.Constant(value=m) for m in mod],
+                              ctx=ast.Load()),
+                    ast.Tuple(elts=[ast.Name(id=m, ctx=ast.Load())
+                                    for m in mod], ctx=ast.Load()),
+                ],
+                keywords=[]))
+        out = self._undef_guards(mod) + [c_def, b_def, assign]
+        for n in out:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+        return out
+
+
+def convert(fn):
+    """Return `fn` with tensor-dependent control flow rewritten to XLA
+    control-flow primitives. Functions without source (builtins, C
+    extensions) are returned unchanged."""
+    cached = _conversion_cache.get(fn)
+    if cached is not None:
+        return cached
+
+    bound_self = None
+    raw = fn
+    if isinstance(fn, types.MethodType):
+        bound_self = fn.__self__
+        raw = fn.__func__
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+    except (OSError, TypeError):
+        _conversion_cache[fn] = fn
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        _conversion_cache[fn] = fn
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _conversion_cache[fn] = fn
+        return fn
+    fdef.decorator_list = []  # run the body, not the decorators, again
+
+    transformer = _ControlFlowTransformer()
+    tree = transformer.visit(tree)
+    ast.fix_missing_locations(tree)
+
+    glb = dict(raw.__globals__)
+    glb[_HELPERS] = _Helpers
+    code = compile(tree, filename=f"<dy2static {raw.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)
+    new_fn = ns[fdef.name]
+    new_fn = functools.wraps(raw)(new_fn)
+    if raw.__closure__:
+        # free variables can't be re-created by exec; fall back for
+        # closures rather than miscompile
+        _conversion_cache[fn] = fn
+        return fn
+    if bound_self is not None:
+        new_fn = types.MethodType(new_fn, bound_self)
+    _conversion_cache[fn] = new_fn
+    return new_fn

@@ -123,6 +123,11 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     def emb_one(x):
         if x is None:
             return None
+        if use_neox_rotary_style:
+            from ...ops.pallas.rope import rope_available, rope_pallas
+
+            if rope_available(x):
+                return rope_pallas(x, cos, sin)
         return x * cos + rot(x) * sin
 
     return tuple(emb_one(x) for x in (q, k, v))

@@ -191,3 +191,30 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
     return x / jnp.maximum(norm, epsilon)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers for partial-FC training (parity:
+    `paddle.nn.functional.class_center_sample`). Positive classes are always
+    kept; negatives fill up to num_samples. Host-side (data-dependent
+    unique), like the reference's CPU path."""
+    import numpy as np
+
+    from ...core.tensor import Tensor
+
+    lv = np.asarray(label._value if isinstance(label, Tensor)
+                    else label).reshape(-1)
+    pos = np.unique(lv)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        picked = np.random.RandomState(0).choice(
+            neg_pool, size=num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, picked]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(remap[lv]), Tensor(sampled.astype(np.int64)))
+
+
+__all__ += ["class_center_sample"]

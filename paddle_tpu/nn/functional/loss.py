@@ -5,7 +5,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import op
+from ...core.dispatch import apply, op
 
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
@@ -268,3 +268,189 @@ def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
 @op("soft_margin_loss")
 def soft_margin_loss(input, label, reduction="mean", name=None):
     return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (parity: `paddle.nn.functional.ctc_loss`, reference kernel
+    third_party warpctc via `warpctc` op).
+
+    TPU-first: the forward algorithm runs as a `lax.scan` over time with the
+    [B, 2L+1] extended-label lattice vectorized per batch — log-space
+    recursion, no host loop; grads come from jax autodiff through the scan
+    (the reference ships a hand-written backward).
+
+    log_probs: [T, B, C] log-softmax scores; labels: [B, L] padded.
+    """
+    def f(lp, lab, in_len, lab_len):
+        t_max, b, c = lp.shape
+        l_max = lab.shape[1]
+        s_max = 2 * l_max + 1
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        lp = lp.astype(jnp.float32)
+
+        # extended label sequence: blank interleaved
+        ext = jnp.full((b, s_max), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+        allow_skip = (ext != blank) & (ext != prev2)
+
+        in_len = in_len.astype(jnp.int32).reshape(b)
+        lab_len = lab_len.astype(jnp.int32).reshape(b)
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=1)  # [B, S]
+        alpha0 = jnp.where(
+            jnp.arange(s_max)[None, :] < 2, emit0, neg_inf)
+        # s=1 only valid if label_len > 0
+        alpha0 = jnp.where(
+            (jnp.arange(s_max)[None, :] == 1) & (lab_len[:, None] == 0),
+            neg_inf, alpha0)
+
+        def step(alpha, inp):
+            lp_t, t = inp
+            a1 = alpha
+            a2 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                         constant_values=-1e30)
+            a3 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                         constant_values=-1e30)
+            a3 = jnp.where(allow_skip, a3, neg_inf)
+            m = jnp.maximum(jnp.maximum(a1, a2), a3)
+            summed = m + jnp.log(
+                jnp.exp(a1 - m) + jnp.exp(a2 - m) + jnp.exp(a3 - m))
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new = summed + emit
+            new = jnp.where(t < in_len[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(
+            step, alpha0, (lp[1:], jnp.arange(1, t_max)))
+
+        last = 2 * lab_len  # index of final blank
+        a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        a_prev = jnp.where(lab_len > 0, a_prev, neg_inf)
+        m = jnp.maximum(a_last, a_prev)
+        ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1).astype(loss.dtype))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("ctc_loss", f, log_probs, labels, input_lengths,
+                 label_lengths)
+
+
+__all__.append("ctc_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax CE (parity: `paddle.nn.functional.
+    margin_cross_entropy`, phi `margin_cross_entropy` kernel).
+
+    logits are cosine similarities; the target class gets
+    cos(m1·θ + m2) − m3 before scaling. Model-parallel class sharding is
+    expressed with sharded logits under jit (mesh 'mp' axis) instead of the
+    reference's per-rank comm kernel."""
+    def f(lg, lb):
+        lb = lb.reshape(-1).astype(jnp.int32)
+        n, c = lg.shape
+        onehot = jax.nn.one_hot(lb, c, dtype=lg.dtype)
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        mod = jnp.where(onehot > 0, target, lg) * scale
+        logp = jax.nn.log_softmax(mod, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            out = jnp.mean(loss)
+        elif reduction == "sum":
+            out = jnp.sum(loss)
+        else:
+            out = loss
+        return (out, sm) if return_softmax else out
+
+    return apply("margin_cross_entropy", f, logits, label)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (parity: `paddle.nn.functional.rnnt_loss`,
+    reference kernel third_party warprnnt via `warprnnt` op).
+
+    TPU-first: the (T, U) lattice forward recursion runs as an outer
+    `lax.scan` over time with an inner scan over the label axis (the u
+    recurrence is sequential); log-space throughout, grads via autodiff.
+
+    input: [B, T, U+1, V] joint-network logits; label: [B, U] padded.
+    """
+    def f(logits, lab, in_len, lab_len):
+        b, t_max, u1, v = logits.shape
+        u_max = u1 - 1
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        blank_lp = logp[..., blank]                       # [B, T, U+1]
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :u_max, :],
+            lab.astype(jnp.int32)[:, None, :, None], axis=-1)[..., 0]
+        # mask emits beyond each row's label length
+        upos = jnp.arange(u_max)[None, None, :]
+        emit_lp = jnp.where(upos < lab_len.reshape(b, 1, 1), emit_lp,
+                            neg_inf)
+
+        in_len = in_len.astype(jnp.int32).reshape(b)
+        lab_len = lab_len.astype(jnp.int32).reshape(b)
+
+        # row at t=0: pure emission prefix sums
+        row0 = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.float32),
+             jnp.cumsum(emit_lp[:, 0, :], axis=-1)], axis=-1)
+
+        def time_step(row_prev, inp):
+            blank_prev, emit_t, t = inp
+            top = row_prev + blank_prev        # [B, U+1]
+
+            def u_step(c, xu):
+                top_u, emit_u = xu
+                m = jnp.maximum(top_u, c + emit_u)
+                c_new = m + jnp.log(jnp.exp(top_u - m)
+                                    + jnp.exp(c + emit_u - m))
+                return c_new, c_new
+
+            c0 = top[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, c0,
+                (jnp.swapaxes(top[:, 1:], 0, 1),
+                 jnp.swapaxes(emit_t, 0, 1)))
+            row = jnp.concatenate([c0[:, None],
+                                   jnp.swapaxes(rest, 0, 1)], axis=-1)
+            row = jnp.where(t < in_len[:, None], row, row_prev)
+            return row, None
+
+        row, _ = jax.lax.scan(
+            time_step, row0,
+            (jnp.swapaxes(blank_lp[:, :-1], 0, 1)[: t_max - 1]
+             if t_max > 1 else jnp.zeros((0, b, u1)),
+             jnp.swapaxes(emit_lp[:, 1:], 0, 1) if t_max > 1
+             else jnp.zeros((0, b, u_max)),
+             jnp.arange(1, t_max)))
+
+        final_alpha = jnp.take_along_axis(row, lab_len[:, None],
+                                          axis=1)[:, 0]
+        tb = jnp.clip(in_len - 1, 0)
+        final_blank = blank_lp[jnp.arange(b), tb, lab_len]
+        loss = -(final_alpha + final_blank)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)
+
+
+__all__ += ["margin_cross_entropy", "rnnt_loss"]

@@ -84,12 +84,57 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 @op("max_pool1d")
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool1d(return_mask=True): use max_pool2d on a [N,C,1,L] "
+            "view — 2d carries the argmax path")
     return _pool(x, kernel_size, stride, padding, 1, "max", ceil_mode)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _max_pool2d_with_index(x, kernel_size, stride, padding):
+    """Pooled values + flat h*w argmax indices (phi `max_pool2d_with_index`
+    role). Static small kernel → stacked shifted views + one argmax; XLA
+    fuses the stack away."""
+    kh, kw = _pair(kernel_size)
+    sh, sw = _pair(stride or kernel_size)
+    ph, pw = _pair(padding)
+    n, c, h, w = x.shape
+    neg = jnp.finfo(jnp.float32).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    views = []
+    for ki in range(kh):
+        for kj in range(kw):
+            views.append(jax.lax.slice(
+                xp, (0, 0, ki, kj),
+                (n, c, ki + (oh - 1) * sh + 1, kj + (ow - 1) * sw + 1),
+                (1, 1, sh, sw)))
+    patches = jnp.stack(views)                      # [kh*kw, N, C, OH, OW]
+    local = jnp.argmax(patches, axis=0)             # [N, C, OH, OW]
+    vals = jnp.max(patches, axis=0)
+    ki = local // kw
+    kj = local % kw
+    gy = jnp.arange(oh)[None, None, :, None] * sh + ki - ph
+    gx = jnp.arange(ow)[None, None, None, :] * sw + kj - pw
+    mask = (gy.clip(0, h - 1) * w + gx.clip(0, w - 1)).astype(jnp.int32)
+    return vals, mask
 
 
 @op("max_pool2d")
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise NotImplementedError("return_mask needs NCHW")
+        if ceil_mode:
+            raise NotImplementedError("return_mask with ceil_mode")
+        return _max_pool2d_with_index(x, kernel_size, stride, padding)
     return _pool(x, kernel_size, stride, padding, 2, "max", ceil_mode,
                  channel_last=data_format == "NHWC")
 
@@ -161,3 +206,60 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 @op("adaptive_max_pool3d")
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive(x, output_size, 3, "max")
+
+
+def _unpool(x, indices, spatial_shape):
+    """Scatter pooled values back to flat spatial positions (phi `unpool` /
+    `unpool3d` role; indices layout = flat index over the spatial dims)."""
+    n, c = x.shape[0], x.shape[1]
+    flat_len = 1
+    for s in spatial_shape:
+        flat_len *= s
+    xv = x.reshape(n, c, -1)
+    iv = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, flat_len), x.dtype)
+    bidx = jnp.arange(n)[:, None, None]
+    cidx = jnp.arange(c)[None, :, None]
+    out = out.at[bidx, cidx, iv].set(xv)
+    return out.reshape((n, c) + tuple(spatial_shape))
+
+
+def _unpool_out_size(in_sp, kernel_size, stride, padding, ndim,
+                     output_size):
+    if output_size is not None:
+        sp = tuple(output_size[-ndim:])
+        return sp
+    ks = (kernel_size,) * ndim if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * ndim if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * ndim if isinstance(padding, int) else tuple(padding)
+    return tuple((i - 1) * s - 2 * p + k
+                 for i, k, s, p in zip(in_sp, ks, st, pd))
+
+
+@op("max_unpool1d")
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    sp = _unpool_out_size(x.shape[2:], kernel_size, stride, padding, 1,
+                          output_size)
+    return _unpool(x, indices, sp)
+
+
+@op("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    sp = _unpool_out_size(x.shape[2:], kernel_size, stride, padding, 2,
+                          output_size)
+    return _unpool(x, indices, sp)
+
+
+@op("max_unpool3d")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    sp = _unpool_out_size(x.shape[2:], kernel_size, stride, padding, 3,
+                          output_size)
+    return _unpool(x, indices, sp)
+
+
+__all__ += ["max_unpool1d", "max_unpool2d", "max_unpool3d"]

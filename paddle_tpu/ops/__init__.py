@@ -11,6 +11,7 @@ from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
+from .extra import *  # noqa: F401,F403
 from .logic import is_tensor  # noqa: F401
 
 from ..core.dispatch import apply, op  # noqa: F401

@@ -6,7 +6,24 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, reduction
+from . import creation, extra, linalg, logic, manipulation, math, reduction
+
+# Ops with a reference-parity in-place variant (`<name>_`): the PHI yaml
+# `inplace:` entries that map to public tensor API. Generated as
+# compute-then-rebind — on TPU "in-place" is a handle rebind; XLA's buffer
+# donation provides the actual memory reuse under jit.
+INPLACE_BASES = (
+    "abs acos acosh add addmm asin asinh atan atanh bitwise_and bitwise_not "
+    "bitwise_or bitwise_xor cast ceil clip cos cosh cumprod cumsum digamma "
+    "divide equal erfinv exp fill fill_diagonal floor floor_divide floor_mod "
+    "frac gammaln gcd greater_equal greater_than hypot i0 index_add "
+    "index_fill index_put lcm ldexp lerp less_equal less_than lgamma log "
+    "log10 log1p log2 logical_and logical_not logical_or logical_xor logit "
+    "masked_fill masked_scatter mod multigammaln multiply nan_to_num neg "
+    "not_equal polygamma pow put_along_axis reciprocal remainder renorm "
+    "round rsqrt scale sigmoid sin sinh sqrt squeeze subtract t tan tanh "
+    "transpose tril triu trunc unsqueeze where"
+).split()
 
 
 def _swap(f):
@@ -17,7 +34,7 @@ def _swap(f):
 
 
 def patch_tensor():
-    modules = (math, reduction, manipulation, linalg, logic, creation)
+    modules = (math, reduction, manipulation, linalg, logic, creation, extra)
     # Plain method names: tensor.method(...) == ops.method(tensor, ...)
     skip = {
         "to_tensor", "as_tensor", "zeros", "ones", "full", "empty", "arange",
@@ -79,3 +96,27 @@ def patch_tensor():
     Tensor.__isub__ = _iop(math.subtract)
     Tensor.__imul__ = _iop(math.multiply)
     Tensor.__itruediv__ = _iop(math.divide)
+
+    # Generated `<name>_` in-place variants: Tensor methods AND module-level
+    # functions on paddle_tpu.ops (picked up by the package star-import)
+    import sys
+
+    ops_pkg = sys.modules.get("paddle_tpu.ops")
+
+    def _inplace(f, nm):
+        def g(self, *a, **kw):
+            return self._rebind(f(self, *a, **kw))
+
+        g.__name__ = nm
+        g.__qualname__ = f"Tensor.{nm}"
+        g.__doc__ = f"In-place variant of `{nm[:-1]}` (compute + rebind)."
+        return g
+
+    for base in INPLACE_BASES:
+        f = getattr(Tensor, base, None)
+        if f is None or hasattr(Tensor, base + "_"):
+            continue
+        g = _inplace(f, base + "_")
+        setattr(Tensor, base + "_", g)
+        if ops_pkg is not None and not hasattr(ops_pkg, base + "_"):
+            setattr(ops_pkg, base + "_", g)

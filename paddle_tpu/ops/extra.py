@@ -1,0 +1,584 @@
+"""Long-tail tensor ops (manifest-closure batch).
+
+Role parity: assorted `python/paddle/tensor/` ops (manipulation.py,
+math.py, random.py) that round out the OPS_MANIFEST coverage — each op
+maps to one jnp/lax expression; grads come from `jax.vjp` through the
+dispatch gate like every other op.
+"""
+from __future__ import annotations
+
+import itertools
+import math as _math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "mm", "floor_mod", "reverse", "frexp", "gammaln", "multigammaln",
+    "i0e", "i1", "i1e", "polar", "signbit", "nanquantile",
+    "cumulative_trapezoid", "combinations", "broadcast_shape",
+    "create_tensor", "is_complex", "is_floating_point", "is_integer",
+    "diag_embed", "diagonal_scatter", "dsplit", "hsplit", "vsplit",
+    "split_with_num", "index_fill", "fill", "fill_diagonal", "multiplex",
+    "select_scatter", "slice_scatter", "unstack", "as_strided",
+    "top_p_sampling", "uniform_", "normal_", "exponential_", "cauchy_",
+    "geometric_",
+]
+
+
+# ---------------------------- aliases ----------------------------
+
+def mm(input, mat2, name=None):
+    """Alias of matmul (paddle.mm)."""
+    from .linalg import matmul
+
+    return matmul(input, mat2)
+
+
+def floor_mod(x, y, name=None):
+    """Alias of mod (paddle.floor_mod)."""
+    from .math import mod
+
+    return mod(x, y)
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (paddle.reverse)."""
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+# ---------------------------- math ----------------------------
+
+@op("frexp")
+def frexp(x, name=None):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+@op("gammaln")
+def gammaln(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@op("multigammaln")
+def multigammaln(x, p, name=None):
+    const = 0.25 * p * (p - 1) * _math.log(_math.pi)
+    terms = [jax.scipy.special.gammaln(x - 0.5 * i) for i in range(p)]
+    return const + sum(terms[1:], terms[0])
+
+
+@op("i0e")
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+@op("i1")
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+@op("i1e")
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+@op("polar")
+def polar(abs, angle, name=None):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+@op("signbit")
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+@op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+@op("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    n = y.shape[axis]
+    lo = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    hi = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    if x is not None:
+        xlo = jax.lax.slice_in_dim(x, 0, n - 1, axis=axis)
+        xhi = jax.lax.slice_in_dim(x, 1, n, axis=axis)
+        widths = xhi - xlo
+    else:
+        widths = dx if dx is not None else 1.0
+    return jnp.cumsum((lo + hi) * 0.5 * widths, axis=axis)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (paddle.combinations).
+    The index set is static (depends only on length), so this traces to one
+    gather."""
+    def f(v):
+        n = v.shape[0]
+        picker = (itertools.combinations_with_replacement if with_replacement
+                  else itertools.combinations)
+        idx = np.asarray(list(picker(range(n), r)), np.int32).reshape(-1, r)
+        return v[idx]
+
+    return apply("combinations", f, x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static shape algebra (paddle.broadcast_shape) — pure host-side."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    from ..core import dtypes
+
+    return Tensor(jnp.zeros((0,), dtypes.convert_dtype(dtype)))
+
+
+def _dtype_of(x):
+    return x._value.dtype if isinstance(x, Tensor) else jnp.asarray(x).dtype
+
+
+def is_complex(x):
+    return jnp.issubdtype(_dtype_of(x), jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_dtype_of(x), jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_dtype_of(x), jnp.integer)
+
+
+# ---------------------------- manipulation ----------------------------
+
+@op("diag_embed")
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    n = input.shape[-1]
+    m = n + abs(offset)
+    rows = jnp.arange(n) + max(0, -offset)
+    cols = jnp.arange(n) + max(0, offset)
+    out = jnp.zeros(input.shape[:-1] + (m, m), input.dtype)
+    out = out.at[..., rows, cols].set(input)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+@op("diagonal_scatter")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    nd = x.ndim
+    a1, a2 = axis1 % nd, axis2 % nd
+    moved = jnp.moveaxis(x, (a1, a2), (nd - 2, nd - 1))
+    h, w = moved.shape[-2], moved.shape[-1]
+    k = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+    rows = jnp.arange(k) + max(0, -offset)
+    cols = jnp.arange(k) + max(0, offset)
+    moved = moved.at[..., rows, cols].set(y)
+    return jnp.moveaxis(moved, (nd - 2, nd - 1), (a1, a2))
+
+
+def _nsplit(x, num_or_sections, axis, min_ndim, api):
+    def f(v):
+        if v.ndim < min_ndim:
+            raise ValueError(f"{api} expects at least {min_ndim}-D input, "
+                             f"got {v.ndim}-D")
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        sections = np.cumsum(num_or_sections[:-1]).tolist()
+        return tuple(jnp.split(v, sections, axis=axis))
+
+    return list(apply(api, f, x))
+
+
+def vsplit(x, num_or_sections, name=None):
+    return _nsplit(x, num_or_sections, 0, 2, "vsplit")
+
+
+def hsplit(x, num_or_sections, name=None):
+    return _nsplit(x, num_or_sections, 1, 2, "hsplit")
+
+
+def dsplit(x, num_or_sections, name=None):
+    return _nsplit(x, num_or_sections, 2, 3, "dsplit")
+
+
+def split_with_num(x, num, axis=0, name=None):
+    return _nsplit(x, num, axis, 1, "split_with_num")
+
+
+@op("index_fill")
+def index_fill(x, index, axis, value, name=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(value)
+
+
+@op("fill")
+def fill(x, value, name=None):
+    return jnp.full_like(x, value)
+
+
+@op("fill_diagonal")
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    h, w = x.shape[-2], x.shape[-1]
+    k = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+    rows = jnp.arange(k) + max(0, -offset)
+    cols = jnp.arange(k) + max(0, offset)
+    return x.at[..., rows, cols].set(value)
+
+
+def multiplex(inputs, index, name=None):
+    """out[i] = inputs[index[i]][i] (paddle.multiplex)."""
+    def f(idx, *vs):
+        stacked = jnp.stack(vs)
+        sel = idx.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(stacked.shape[1])]
+
+    return apply("multiplex", f, index, *inputs)
+
+
+@op("select_scatter")
+def select_scatter(x, values, axis, index, name=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@op("slice_scatter")
+def slice_scatter(x, value, axes=None, starts=None, ends=None, strides=None,
+                  name=None):
+    axes = axes or []
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts or [], ends or [],
+                           strides or [1] * len(axes)):
+        idx[a % x.ndim] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def f(v):
+        n = num or v.shape[axis]
+        parts = jnp.split(v, n, axis=axis)
+        return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+    return list(apply("unstack", f, x))
+
+
+@op("as_strided")
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view as a gather over the flattened buffer (paddle.as_strided;
+    TPU has no aliasing views — XLA fuses the gather)."""
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset, jnp.int32)
+    for dim, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(dim, dtype=jnp.int32) * st
+    return flat[idx.reshape(shape)]
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus sampling over the last axis (paddle.top_p_sampling):
+    keep the smallest prefix of descending-prob tokens whose mass ≥ p,
+    renormalize, sample one id per row. Returns (probs, ids)."""
+    from ..core import rng
+
+    key = rng.default_generator.split()
+
+    def f(probs, p):
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p < p.reshape(-1, 1)
+        keep = keep.at[..., 0].set(True)  # always keep the top token
+        filtered = jnp.where(keep, sorted_p, 0.0)
+        filtered = filtered / jnp.sum(filtered, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(filtered + 1e-30),
+                                        axis=-1)
+        ids = jnp.take_along_axis(order, choice[..., None], axis=-1)
+        val = jnp.take_along_axis(probs, ids, axis=-1)
+        return val, ids.astype(jnp.int64)
+
+    return apply("top_p_sampling", f, x, ps)
+
+
+# ------------------------ in-place random fills ------------------------
+
+def _rand01(shape, dtype):
+    from ..core import rng
+
+    key = rng.default_generator.split()
+    return jax.random.uniform(key, shape, dtype)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    u = _rand01(tuple(x.shape), x._value.dtype)
+    return x._rebind(Tensor(min + (max - min) * u))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    from ..core import rng
+
+    key = rng.default_generator.split()
+    v = mean + std * jax.random.normal(key, tuple(x.shape), x._value.dtype)
+    return x._rebind(Tensor(v))
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = _rand01(tuple(x.shape), x._value.dtype)
+    return x._rebind(Tensor(-jnp.log1p(-u) / lam))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    u = _rand01(tuple(x.shape), x._value.dtype)
+    return x._rebind(Tensor(loc + scale * jnp.tan(jnp.pi * (u - 0.5))))
+
+
+def geometric_(x, probs, name=None):
+    u = _rand01(tuple(x.shape), x._value.dtype)
+    return x._rebind(Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-probs)) + 1))
+
+
+# ------------------- manifest batch 2: math/indexing -------------------
+
+@op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * (x @ y)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype))
+
+
+@op("clip_by_norm")
+def clip_by_norm(x, max_norm, name=None):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+
+
+@op("fill_diagonal_tensor")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    nd = x.ndim
+    a1, a2 = dim1 % nd, dim2 % nd
+    moved = jnp.moveaxis(x, (a1, a2), (nd - 2, nd - 1))
+    h, w = moved.shape[-2], moved.shape[-1]
+    k = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+    rows = jnp.arange(k) + max(0, -offset)
+    cols = jnp.arange(k) + max(0, offset)
+    # y carries the diagonal values (diag axis last, reference layout)
+    moved = moved.at[..., rows, cols].set(y)
+    return jnp.moveaxis(moved, (nd - 2, nd - 1), (a1, a2))
+
+
+@op("identity_loss")
+def identity_loss(x, reduction="none", name=None):
+    red = {"none": lambda v: v, 0: lambda v: v,
+           "sum": jnp.sum, 1: jnp.sum,
+           "mean": jnp.mean, 2: jnp.mean}
+    return red[reduction](x)
+
+
+@op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pad = jnp.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+    back = pad[:, :seg_num, :c1]          # shift left (from t-1 view)
+    fwd = pad[:, 2:, c1:c2]               # shift right
+    keep = v[:, :, c2:]
+    out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+# ------------------- random sampling creation ops -------------------
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    from ..core import dtypes, rng
+
+    key = rng.default_generator.split()
+    dt = dtypes.convert_dtype(dtype)
+    return Tensor(mean + std * jax.random.normal(key, tuple(shape), dt))
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1) elementwise (paddle.standard_gamma)."""
+    from ..core import rng
+
+    key = rng.default_generator.split()
+
+    def f(a):
+        return jax.random.gamma(key, a)
+
+    return apply("standard_gamma", f, x)
+
+
+def binomial(count, prob, name=None):
+    from ..core import rng
+
+    key = rng.default_generator.split()
+
+    def f(n, p):
+        return jax.random.binomial(key, n.astype(jnp.float32),
+                                   p).astype(jnp.int64)
+
+    return apply("binomial", f, count, prob)
+
+
+def dirichlet(alpha, name=None):
+    from ..core import rng
+
+    key = rng.default_generator.split()
+
+    def f(a):
+        return jax.random.dirichlet(key, a)
+
+    return apply("dirichlet", f, alpha)
+
+
+# ------------------- host-side sequence/metric ops -------------------
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row (paddle.edit_distance; host-side
+    DP like the reference CPU kernel)."""
+    a = np.asarray(input._value if isinstance(input, Tensor) else input)
+    b = np.asarray(label._value if isinstance(label, Tensor) else label)
+    la = (np.asarray(input_length._value if isinstance(input_length, Tensor)
+                     else input_length) if input_length is not None
+          else np.full(a.shape[0], a.shape[1]))
+    lb = (np.asarray(label_length._value if isinstance(label_length, Tensor)
+                     else label_length) if label_length is not None
+          else np.full(b.shape[0], b.shape[1]))
+    ignored = set(ignored_tokens or ())
+    dists = np.zeros((a.shape[0], 1), np.float32)
+    counts = np.zeros((a.shape[0], 1), np.int64)
+    for i in range(a.shape[0]):
+        s1 = [t for t in a[i, :int(la[i])] if t not in ignored]
+        s2 = [t for t in b[i, :int(lb[i])] if t not in ignored]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        d = dp[n]
+        dists[i, 0] = d / max(1, n) if normalized else d
+        counts[i, 0] = max(1, n)
+    return Tensor(dists), Tensor(counts)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding (paddle.text.viterbi_decode role) via lax.scan —
+    compiled DP, TPU-friendly."""
+    def f(emis, trans, lens):
+        b, t, n = emis.shape
+        if include_bos_eos_tag:
+            # tag n-2 = BOS, n-1 = EOS (reference convention)
+            start = trans[n - 2][None, :]
+            alpha0 = emis[:, 0] + start
+        else:
+            alpha0 = emis[:, 0]
+
+        def step(carry, xt):
+            alpha, idx = carry
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best = jnp.max(scores, axis=1) + xt
+            bp = jnp.argmax(scores, axis=1)
+            return (best, idx + 1), bp
+
+        (alpha, _), bps = jax.lax.scan(
+            step, (alpha0, jnp.zeros((), jnp.int32)),
+            jnp.swapaxes(emis[:, 1:], 0, 1))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, n - 1][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)
+
+        def back(carry, bp):
+            # carry = tag at time i+1; emit it at slot i, carry tag at i
+            cur = carry
+            prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+            return prev, cur
+
+        first, ys = jax.lax.scan(back, last, bps, reverse=True)
+        path = (jnp.concatenate([first[:, None], jnp.swapaxes(ys, 0, 1)],
+                                axis=1) if t > 1 else last[:, None])
+        return scores, path.astype(jnp.int64)
+
+    return apply("viterbi_decode", f, potentials, transition_params, lengths)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (paddle.nn.functional.gather_tree): walk
+    parent pointers from the last step back to the root."""
+    iv = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+    pv = np.asarray(parents._value if isinstance(parents, Tensor)
+                    else parents)
+    t, b, w = iv.shape
+    out = np.zeros_like(iv)
+    for bi in range(b):
+        for wi in range(w):
+            beam = wi
+            for ti in range(t - 1, -1, -1):
+                out[ti, bi, wi] = iv[ti, bi, beam]
+                beam = int(pv[ti, bi, beam])
+    return Tensor(out)
+
+
+def auc(x, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    """Batch AUC (paddle.static.auc role, eager form)."""
+    pred = np.asarray(x._value if isinstance(x, Tensor) else x)
+    lab = np.asarray(label._value if isinstance(label, Tensor)
+                     else label).reshape(-1)
+    score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+        else pred.reshape(-1)
+    order = np.argsort(-score)
+    lab = lab[order]
+    tps = np.cumsum(lab)
+    fps = np.cumsum(1 - lab)
+    tot_p = max(1, int(tps[-1]))
+    tot_f = max(1, int(fps[-1]))
+    tpr = np.concatenate([[0.0], tps / tot_p])
+    fpr = np.concatenate([[0.0], fps / tot_f])
+    return Tensor(np.asarray(np.trapezoid(tpr, fpr), np.float32))
+
+
+__all__ += [
+    "addmm", "tril_indices", "triu_indices", "clip_by_norm",
+    "fill_diagonal_tensor", "identity_loss", "temporal_shift", "gaussian",
+    "standard_gamma", "binomial", "dirichlet", "edit_distance",
+    "viterbi_decode", "gather_tree", "auc",
+]

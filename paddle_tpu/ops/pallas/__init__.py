@@ -11,3 +11,12 @@ from .flash_attention import (  # noqa: F401
     flash_attention_available,
     flash_attention_fwd,
 )
+from .fused_norm import (  # noqa: F401
+    fused_norm_available,
+    fused_norm_pallas,
+)
+from .rope import rope_available, rope_pallas  # noqa: F401
+from .decode_attention import (  # noqa: F401
+    decode_attention,
+    decode_attention_available,
+)

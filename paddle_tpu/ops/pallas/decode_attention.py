@@ -1,0 +1,100 @@
+"""Blocked KV-cache decode attention — Pallas TPU kernel.
+
+Role parity: `paddle/phi/kernels/fusion/gpu/
+masked_multihead_attention_kernel.cu` and
+`block_multi_head_attention_kernel.cu` (exposed as
+`incubate.nn.functional.masked_multihead_attention`).
+
+Design (TPU-first):
+  * One query token per (batch, head) grid cell attends over its KV cache
+    with an online-softmax fori_loop over KV blocks — the loop bound is
+    `ceil((pos+1)/block_k)` from a scalar-prefetched position vector, so
+    a decode step costs O(tokens-in-cache), not O(cache-capacity). The
+    jnp fallback attends the full fixed-size cache every step; this is
+    the algorithmic win (plus: logits never hit HBM).
+  * Shapes are static (cache capacity S), so the decode loop compiles
+    once; only the scalar positions change step to step.
+  * Inference-only (no VJP) — decode never backprops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _interpret, _pick_block, NEG_INF
+
+
+def decode_attention_available(cache_shape) -> bool:
+    _, b, h, s, d = cache_shape
+    if d % 8 != 0 or d > 256 or s % 8 != 0:
+        return False
+    return not _interpret()
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
+                   scale):
+    bi = pl.program_id(0)
+    pos = pos_ref[bi]                       # tokens 0..pos are valid
+    q = q_ref[:].astype(jnp.float32) * scale        # [1, D]
+
+    d = q.shape[-1]
+    m0 = jnp.full((1,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1,), jnp.float32)
+    acc0 = jnp.zeros((1, d), jnp.float32)
+
+    num_iters = (pos + block_k) // block_k  # == cdiv(pos+1, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [1,bk]
+        k_ids = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s = jnp.where(k_ids <= pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None):
+    """q: [B, H, D] current-token queries; kcache/vcache: [B, H, S, D]
+    (already containing the current token at index pos[b]); pos: [B] int32.
+    Returns [B, H, D]."""
+    b, h, d = q.shape
+    s = kcache.shape[2]
+    scale = 1.0 / (d ** 0.5)
+    block_k = _pick_block(s, block_k)
+    q4 = q.reshape(b, h, 1, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, s, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, d),
+                               lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=block_k, seq=s,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(pos.astype(jnp.int32), q4, kcache, vcache)
+    return out.reshape(b, h, d)

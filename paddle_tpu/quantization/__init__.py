@@ -115,15 +115,26 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         self.moving_rate = moving_rate
         self.quant_bits = quant_bits
         self.register_buffer("_scale", Tensor(np.ones((), np.float32)))
-        self._initialized = False
+        # calibration flag is a buffer so it survives state_dict round-trips
+        # (a trained quanter loaded from a checkpoint must keep quantizing)
+        self.register_buffer("_calibrated", Tensor(np.zeros((), np.float32)))
+
+    @property
+    def _initialized(self):
+        return bool(float(self._calibrated._value) > 0)
 
     def forward(self, x):
         qmax = 2 ** (self.quant_bits - 1) - 1
+        if not self.training and not self._initialized:
+            # uncalibrated: the default scale 1.0 would round activations
+            # to integers; pass through instead (cf. AbsmaxObserver, which
+            # raises when asked for scales it never observed)
+            return x
         if self.training:
             cur = float(np.max(np.abs(np.asarray(x._value)))) / qmax
             if not self._initialized:
                 self._scale._value = jnp.asarray(cur, jnp.float32)
-                self._initialized = True
+                self._calibrated._value = jnp.asarray(1.0, jnp.float32)
             else:
                 r = self.moving_rate
                 self._scale._value = (r * self._scale._value

@@ -52,6 +52,11 @@ class Variable(Tensor):
 
     @property
     def shape(self):
+        # surface -1 for symbolic (batch) dims like the reference: the aval
+        # binds a placeholder 1 so tracing works, but letting user code read
+        # that 1 as a concrete batch size would bake it into the program
+        if self.declared_shape is not None:
+            return list(self.declared_shape)
         return list(self._value.shape)
 
     def numpy(self):

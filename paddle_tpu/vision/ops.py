@@ -310,3 +310,15 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
                              self.padding, self.dilation,
                              self.deformable_groups, self.groups, mask)
+
+
+from .ops_detection import (  # noqa: F401,E402
+    box_coder, distribute_fpn_proposals, generate_proposals, matrix_nms,
+    multiclass_nms, prior_box, psroi_pool, roi_pool, yolo_box, yolo_loss,
+)
+
+__all__ += [
+    "box_coder", "distribute_fpn_proposals", "generate_proposals",
+    "matrix_nms", "multiclass_nms", "prior_box", "psroi_pool", "roi_pool",
+    "yolo_box", "yolo_loss",
+]

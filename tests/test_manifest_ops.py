@@ -1,0 +1,313 @@
+"""OPS_MANIFEST drift check + correctness tests for the manifest-closure op
+batch (inplace variants, losses, pooling masks, detection ops)."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn.functional as F
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_manifest_no_drift_and_coverage():
+    from gen_op_manifest import generate
+
+    with open(os.path.join(REPO, "OPS_MANIFEST.json")) as f:
+        recorded = json.load(f)
+    current = generate()
+    assert current["present"] >= recorded["present"], (
+        "op coverage regressed — fix or regenerate OPS_MANIFEST.json")
+    assert current["coverage_pct"] >= 95.0
+    cur_names = {e["name"]: (e["present"], e["internal"])
+                 for e in current["ops"]}
+    rec_names = {e["name"]: (e["present"], e["internal"])
+                 for e in recorded["ops"]}
+    assert cur_names == rec_names, "manifest drift — regenerate"
+
+
+# --------------------------- inplace variants ---------------------------
+
+def test_inplace_variants_exist_and_rebind():
+    x = P.to_tensor(np.array([0.5, 1.0], np.float32))
+    y = x.sin_()
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), np.sin([0.5, 1.0]), rtol=1e-6)
+    # module-level form too
+    z = P.to_tensor(np.array([4.0], np.float32))
+    P.sqrt_(z)
+    np.testing.assert_allclose(z.numpy(), [2.0], rtol=1e-6)
+
+
+def test_inplace_grad_flows():
+    x = P.to_tensor(np.array([0.3, 0.7], np.float32), stop_gradient=False)
+    y = (x * 2.0)
+    y.exp_()
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.exp(2 * np.array(
+        [0.3, 0.7], np.float32)), rtol=1e-5)
+
+
+# ------------------------------ new math ------------------------------
+
+def test_addmm_tril_triu_indices():
+    a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    x = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+    y = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+    out = P.addmm(P.to_tensor(a), P.to_tensor(x), P.to_tensor(y),
+                  beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(out.numpy(), 0.5 * a + 2.0 * (x @ y),
+                               rtol=1e-5)
+    np.testing.assert_array_equal(
+        P.tril_indices(4, 4, 0).numpy(), np.stack(np.tril_indices(4, 0, 4)))
+    np.testing.assert_array_equal(
+        P.triu_indices(3, 5, 1).numpy(), np.stack(np.triu_indices(3, 1, 5)))
+
+
+def test_diag_embed_and_scatter():
+    v = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = P.diag_embed(P.to_tensor(v)).numpy()
+    for b in range(2):
+        np.testing.assert_array_equal(out[b], np.diag(v[b]))
+    m = np.zeros((3, 3), np.float32)
+    y = np.array([1.0, 2.0, 3.0], np.float32)
+    ds = P.diagonal_scatter(P.to_tensor(m), P.to_tensor(y)).numpy()
+    np.testing.assert_array_equal(np.diag(ds), y)
+
+
+def test_gammaln_multigammaln_i_bessel():
+    from scipy import special as sp  # available via jax.scipy parity check
+
+    x = np.array([0.5, 1.5, 3.0], np.float32)
+    np.testing.assert_allclose(P.gammaln(P.to_tensor(x)).numpy(),
+                               sp.gammaln(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        P.multigammaln(P.to_tensor(x + 2), 2).numpy(),
+        sp.multigammaln(x + 2, 2), rtol=1e-5)
+    np.testing.assert_allclose(P.i0e(P.to_tensor(x)).numpy(), sp.i0e(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(P.i1(P.to_tensor(x)).numpy(), sp.i1(x),
+                               rtol=1e-5)
+
+
+def test_vsplit_hsplit_unstack():
+    m = np.arange(24, dtype=np.float32).reshape(4, 6)
+    parts = P.vsplit(P.to_tensor(m), 2)
+    assert len(parts) == 2 and parts[0].shape == [2, 6]
+    parts = P.hsplit(P.to_tensor(m), 3)
+    assert len(parts) == 3 and parts[0].shape == [4, 2]
+    us = P.unstack(P.to_tensor(m), axis=0)
+    assert len(us) == 4 and us[0].shape == [6]
+    np.testing.assert_array_equal(us[1].numpy(), m[1])
+
+
+def test_as_strided_and_slice_scatter():
+    x = np.arange(12, dtype=np.float32)
+    out = P.as_strided(P.to_tensor(x), [3, 4], [4, 1]).numpy()
+    np.testing.assert_array_equal(out, x.reshape(3, 4))
+    base = np.zeros((4, 4), np.float32)
+    val = np.ones((2, 4), np.float32)
+    ss = P.slice_scatter(P.to_tensor(base), P.to_tensor(val),
+                         axes=[0], starts=[1], ends=[3], strides=[1]).numpy()
+    assert ss[1:3].sum() == 8 and ss[0].sum() == 0
+
+
+# ------------------------------ losses ------------------------------
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    T, B, C, L = 12, 3, 6, 4
+    logits = rs.randn(T, B, C).astype(np.float32)
+    log_probs = torch.log_softmax(torch.tensor(logits), dim=-1)
+    labels = rs.randint(1, C, (B, L)).astype(np.int32)
+    in_len = np.array([12, 10, 8], np.int32)
+    lab_len = np.array([4, 3, 2], np.int32)
+    ref = torch.nn.functional.ctc_loss(
+        log_probs, torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_len.astype(np.int64)),
+        torch.tensor(lab_len.astype(np.int64)),
+        blank=0, reduction="none", zero_infinity=False).numpy()
+    import jax
+
+    lp = jax.nn.log_softmax(np.asarray(logits), axis=-1)
+    out = F.ctc_loss(P.to_tensor(np.asarray(lp)), P.to_tensor(labels),
+                     P.to_tensor(in_len), P.to_tensor(lab_len),
+                     blank=0, reduction="none")
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rnnt_loss_brute_force():
+    rs = np.random.RandomState(1)
+    B, T, U, V = 2, 4, 3, 5
+    logits = rs.randn(B, T, U + 1, V).astype(np.float32)
+    labels = rs.randint(1, V, (B, U)).astype(np.int32)
+    in_len = np.array([4, 3], np.int32)
+    lab_len = np.array([3, 2], np.int32)
+
+    def brute(b):
+        from scipy.special import log_softmax, logsumexp
+
+        lp = log_softmax(logits[b], axis=-1)
+        tt, uu = int(in_len[b]), int(lab_len[b])
+        alpha = np.full((tt, uu + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(tt):
+            for u in range(uu + 1):
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + lp[t - 1, u, 0])
+                if u > 0:
+                    cands.append(alpha[t, u - 1]
+                                 + lp[t, u - 1, labels[b, u - 1]])
+                if cands:
+                    alpha[t, u] = logsumexp(cands) if (t, u) != (0, 0) \
+                        else alpha[0, 0]
+        return -(alpha[tt - 1, uu] + lp[tt - 1, uu, 0])
+
+    ref = np.array([brute(0), brute(1)], np.float32)
+    out = F.rnnt_loss(P.to_tensor(logits), P.to_tensor(labels),
+                      P.to_tensor(in_len), P.to_tensor(lab_len),
+                      blank=0, reduction="none")
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_margin_cross_entropy_reduces_to_ce_without_margin():
+    rs = np.random.RandomState(2)
+    logits = np.clip(rs.randn(4, 10).astype(np.float32) * 0.3, -0.99, 0.99)
+    label = rs.randint(0, 10, (4,)).astype(np.int64)
+    out = F.margin_cross_entropy(P.to_tensor(logits), P.to_tensor(label),
+                                 margin1=1.0, margin2=0.0, margin3=0.0,
+                                 scale=1.0, reduction="none")
+    import jax
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ref = -logp[np.arange(4), label].reshape(-1, 1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------- pooling + unpool ---------------------------
+
+def test_max_pool2d_mask_and_unpool_roundtrip():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(P.to_tensor(x), 2, 2, 0, return_mask=True)
+    assert out.shape == [2, 3, 4, 4] and mask.shape == [2, 3, 4, 4]
+    # indices point at the max elements
+    flat = x.reshape(2, 3, -1)
+    gathered = np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1),
+                                  axis=2).reshape(2, 3, 4, 4)
+    np.testing.assert_allclose(gathered, out.numpy())
+    up = F.max_unpool2d(out, mask, 2, 2, 0)
+    assert up.shape == [2, 3, 8, 8]
+    # unpooled tensor contains exactly the pooled maxima
+    np.testing.assert_allclose(up.numpy().sum(), out.numpy().sum(),
+                               rtol=1e-6)
+
+
+# ----------------------------- detection -----------------------------
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 20.]], np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    targets = np.array([[1., 1., 9., 9.], [6., 4., 14., 21.]], np.float32)
+    from paddle_tpu.vision.ops import box_coder
+
+    enc = box_coder(P.to_tensor(priors), P.to_tensor(var),
+                    P.to_tensor(targets), code_type="encode_center_size")
+    dec = box_coder(P.to_tensor(priors), P.to_tensor(var),
+                    enc, code_type="decode_center_size", axis=0)
+    d = dec.numpy()
+    np.testing.assert_allclose(np.diagonal(d[:, :, :], axis1=0, axis2=1).T,
+                               targets, rtol=1e-4, atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    from paddle_tpu.vision.ops import prior_box
+
+    feat = P.zeros([1, 32, 4, 4])
+    img = P.zeros([1, 3, 64, 64])
+    boxes, var = prior_box(feat, img, min_sizes=[16.0], clip=True)
+    assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+    b = boxes.numpy()
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    assert var.shape == boxes.shape
+
+
+def test_multiclass_nms_basic():
+    from paddle_tpu.vision.ops import multiclass_nms
+
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10, 10],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.8]  # class 1 (0 = background)
+    out, idx, num = multiclass_nms(
+        P.to_tensor(boxes), P.to_tensor(scores), score_threshold=0.1,
+        nms_threshold=0.5, background_label=0, return_index=True)
+    assert int(num.numpy()[0]) == 2  # overlapping pair suppressed to one
+    assert out.numpy().shape[1] == 6
+
+
+def test_roi_pool_simple():
+    from paddle_tpu.vision.ops import roi_pool
+
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0., 0., 3., 3.]], np.float32)
+    out = roi_pool(P.to_tensor(x), P.to_tensor(rois),
+                   P.to_tensor(np.array([1], np.int32)), 2)
+    np.testing.assert_array_equal(out.numpy().reshape(2, 2),
+                                  [[5, 7], [13, 15]])
+
+
+def test_viterbi_decode_brute_force():
+    rs = np.random.RandomState(4)
+    B, T, N = 2, 5, 4
+    emis = rs.randn(B, T, N).astype(np.float32)
+    trans = rs.randn(N, N).astype(np.float32)
+    lengths = np.array([5, 5], np.int64)
+    scores, path = P.viterbi_decode(
+        P.to_tensor(emis), P.to_tensor(trans), P.to_tensor(lengths),
+        include_bos_eos_tag=False)
+    # brute force over all tag sequences
+    import itertools
+
+    for b in range(B):
+        best, best_seq = -np.inf, None
+        for seq in itertools.product(range(N), repeat=T):
+            s = emis[b, 0, seq[0]]
+            for t in range(1, T):
+                s += trans[seq[t - 1], seq[t]] + emis[b, t, seq[t]]
+            if s > best:
+                best, best_seq = s, seq
+        np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-4)
+        np.testing.assert_array_equal(path.numpy()[b], best_seq)
+
+
+def test_edit_distance_known():
+    a = np.array([[1, 2, 3, 4]], np.int64)
+    b = np.array([[1, 3, 3, 5]], np.int64)
+    d, n = P.edit_distance(P.to_tensor(a), P.to_tensor(b), normalized=False)
+    assert float(d.numpy()[0, 0]) == 2.0
+
+
+def test_gather_tree():
+    ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]],
+                    [[0, 1], [9, 0]]], np.int64)
+    parents = np.array([[[0, 0], [1, 1]], [[1, 0], [0, 0]],
+                        [[0, 0], [0, 1]]], np.int64)
+    out = P.gather_tree(P.to_tensor(ids), P.to_tensor(parents)).numpy()
+    assert out.shape == ids.shape
+
+
+def test_dy2static_ctc_and_extra_under_jit():
+    """New ops must also run under trace (jit.to_static path)."""
+    def f(x):
+        return P.addmm(x, x, x, beta=1.0, alpha=1.0)
+
+    x = P.to_tensor(np.eye(3, dtype=np.float32))
+    static_f = P.jit.to_static(f)
+    np.testing.assert_allclose(static_f(x).numpy(), f(x).numpy())

@@ -1,0 +1,210 @@
+"""Op conformance sweep (OpTest role at breadth): for every op in the
+tables below assert
+  * eager value matches the numpy reference (when numpy has one),
+  * autodiff grad matches central finite differences (differentiable ops),
+  * the op traces under jax.jit with identical output (dygraph/static leg),
+  * 0-d and empty-tensor inputs keep elementwise shape semantics,
+  * binary dtype promotion follows the jnp lattice.
+
+Reference model: `test/legacy_test/` OpTest sweep + white_list policy
+(SURVEY.md §4.1)."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as P
+from op_test import numeric_grad
+
+rs = np.random.RandomState(11)
+
+
+def _pos(shape):
+    return np.asarray(rs.rand(*shape) + 0.5, np.float32)
+
+
+def _std(shape):
+    return np.asarray(rs.randn(*shape), np.float32)
+
+
+def _unit(shape):
+    return np.asarray(rs.rand(*shape) * 1.6 - 0.8, np.float32)
+
+
+# name -> (input factory, numpy ref or None, grad-checkable)
+UNARY_OPS = {
+    "abs": (_std, np.abs, True),
+    "acos": (_unit, np.arccos, True),
+    "acosh": (lambda s: _pos(s) + 1.0, np.arccosh, True),
+    "asin": (_unit, np.arcsin, True),
+    "asinh": (_std, np.arcsinh, True),
+    "atan": (_std, np.arctan, True),
+    "atanh": (_unit, np.arctanh, True),
+    "ceil": (_std, np.ceil, False),
+    "cos": (_std, np.cos, True),
+    "cosh": (_std, np.cosh, True),
+    "digamma": (_pos, None, True),
+    "erf": (_std, None, True),
+    "erfinv": (_unit, None, True),
+    "exp": (_std, np.exp, True),
+    "expm1": (_std, np.expm1, True),
+    "floor": (_std, np.floor, False),
+    "frac": (_std, lambda x: x - np.trunc(x), False),
+    "i0": (_pos, None, True),
+    "i0e": (_pos, None, True),
+    "i1": (_pos, None, True),
+    "i1e": (_pos, None, True),
+    "gammaln": (_pos, None, True),
+    "lgamma": (_pos, None, True),
+    "log": (_pos, np.log, True),
+    "log10": (_pos, np.log10, True),
+    "log1p": (_pos, np.log1p, True),
+    "log2": (_pos, np.log2, True),
+    "logit": (lambda s: (rs.rand(*s) * 0.8 + 0.1).astype(np.float32),
+              None, True),
+    "neg": (_std, np.negative, True),
+    "reciprocal": (_pos, np.reciprocal, True),
+    "round": (_std, np.round, False),
+    "rsqrt": (_pos, lambda x: 1 / np.sqrt(x), True),
+    "sigmoid": (_std, lambda x: 1 / (1 + np.exp(-x)), True),
+    "sign": (_std, np.sign, False),
+    "signbit": (_std, np.signbit, False),
+    "sin": (_std, np.sin, True),
+    "sinh": (_std, np.sinh, True),
+    "sqrt": (_pos, np.sqrt, True),
+    "square": (_std, np.square, True),
+    "tan": (_unit, np.tan, True),
+    "tanh": (_std, np.tanh, True),
+    "trunc": (_std, np.trunc, False),
+}
+
+BINARY_OPS = {
+    "add": (np.add, True),
+    "subtract": (np.subtract, True),
+    "multiply": (np.multiply, True),
+    "divide": (np.true_divide, True),
+    "maximum": (np.maximum, True),
+    "minimum": (np.minimum, True),
+    "pow": (None, True),
+    "atan2": (np.arctan2, True),
+    "fmax": (np.fmax, True),
+    "fmin": (np.fmin, True),
+    "hypot": (np.hypot, True),
+    "ldexp": (None, False),
+    "logaddexp": (np.logaddexp, True),
+    "nextafter": (np.nextafter, False),
+    "remainder": (None, False),
+    "floor_divide": (None, False),
+    "lerp": (None, True),
+}
+
+REDUCTIONS = {
+    "sum": np.sum, "mean": np.mean, "max": np.max, "min": np.min,
+    "prod": np.prod, "std": None, "var": None, "median": None,
+    "logsumexp": None, "all": None, "any": None,
+    "amax": np.max, "amin": np.min, "nansum": np.nansum,
+    "nanmean": np.nanmean,
+}
+
+
+@pytest.mark.parametrize("name", sorted(UNARY_OPS))
+def test_unary_conformance(name):
+    make, ref, gradable = UNARY_OPS[name]
+    fn = getattr(P, name)
+    x = make((3, 4))
+    out = fn(P.to_tensor(x))
+    if ref is not None:
+        np.testing.assert_allclose(out.numpy(), ref(x), rtol=2e-5,
+                                   atol=2e-5)
+    # jit parity (static leg)
+    static = P.jit.to_static(lambda t: fn(t))
+    np.testing.assert_allclose(static(P.to_tensor(x)).numpy(), out.numpy(),
+                               rtol=1e-6, atol=1e-6)
+    # 0-d and empty-tensor semantics
+    z = fn(P.to_tensor(make(())))
+    assert z.shape == []
+    e = fn(P.to_tensor(make((0,))))
+    assert e.shape == [0]
+    if gradable:
+        t = P.to_tensor(x, stop_gradient=False)
+        fn(t).sum().backward()
+        num = numeric_grad(lambda v: fn(P.to_tensor(v)), [x], 0)
+        np.testing.assert_allclose(t.grad.numpy(), num, rtol=2e-2,
+                                   atol=2e-2)
+
+
+@pytest.mark.parametrize("name", sorted(BINARY_OPS))
+def test_binary_conformance(name):
+    ref, gradable = BINARY_OPS[name]
+    fn = getattr(P, name)
+    x = (rs.rand(3, 4) + 0.5).astype(np.float32)
+    y = (rs.rand(3, 4) + 0.5).astype(np.float32)
+    if name == "lerp":
+        out = fn(P.to_tensor(x), P.to_tensor(y), 0.3)
+        call = lambda a, b: fn(P.to_tensor(a), P.to_tensor(b), 0.3)  # noqa
+    else:
+        out = fn(P.to_tensor(x), P.to_tensor(y))
+        call = lambda a, b: fn(P.to_tensor(a), P.to_tensor(b))  # noqa
+    if ref is not None:
+        np.testing.assert_allclose(out.numpy(), ref(x, y), rtol=2e-5,
+                                   atol=2e-5)
+    # broadcasting leg
+    yb = (rs.rand(4) + 0.5).astype(np.float32)
+    if name != "lerp":
+        outb = fn(P.to_tensor(x), P.to_tensor(yb))
+        assert outb.shape == [3, 4]
+    if gradable:
+        tx = P.to_tensor(x, stop_gradient=False)
+        ty = P.to_tensor(y, stop_gradient=False)
+        if name == "lerp":
+            fn(tx, ty, 0.3).sum().backward()
+        else:
+            fn(tx, ty).sum().backward()
+        num_x = numeric_grad(lambda a, b: call(a, b), [x, y], 0)
+        num_y = numeric_grad(lambda a, b: call(a, b), [x, y], 1)
+        np.testing.assert_allclose(tx.grad.numpy(), num_x, rtol=2e-2,
+                                   atol=2e-2)
+        np.testing.assert_allclose(ty.grad.numpy(), num_y, rtol=2e-2,
+                                   atol=2e-2)
+
+
+@pytest.mark.parametrize("name", sorted(REDUCTIONS))
+def test_reduction_conformance(name):
+    fn = getattr(P, name)
+    x = rs.rand(3, 4).astype(np.float32) + 0.1
+    out = fn(P.to_tensor(x))
+    ref = REDUCTIONS[name]
+    if ref is not None:
+        np.testing.assert_allclose(np.asarray(out.numpy(), np.float32),
+                                   np.asarray(ref(x), np.float32),
+                                   rtol=1e-5, atol=1e-5)
+    # axis + keepdim semantics
+    out_ax = fn(P.to_tensor(x), axis=1)
+    assert out_ax.shape == [3]
+    out_kd = fn(P.to_tensor(x), axis=1, keepdim=True)
+    assert out_kd.shape == [3, 1]
+    # 0-d input reduces to 0-d
+    assert fn(P.to_tensor(np.float32(0.5))).shape == []
+
+
+def test_dtype_promotion_matrix():
+    cases = [
+        ("float32", "float32", "float32"),
+        ("float32", "int32", "float32"),
+        ("int32", "int64", "int64"),
+        ("bool", "int32", "int32"),
+        ("bfloat16", "float32", "float32"),
+    ]
+    for da, db, expect in cases:
+        a = P.ones([2], dtype=da)
+        b = P.ones([2], dtype=db)
+        out = P.add(a, b)
+        assert expect in str(out.dtype), (da, db, out.dtype)
+
+
+def test_empty_tensor_reductions_and_concat():
+    e = P.to_tensor(np.zeros((0, 4), np.float32))
+    assert float(P.sum(e).numpy()) == 0.0
+    c = P.concat([e, P.ones([2, 4])], axis=0)
+    assert c.shape == [2, 4]
+    assert P.abs(e).shape == [0, 4]

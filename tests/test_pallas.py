@@ -61,3 +61,181 @@ def test_flash_bf16_io():
     ref = fa._ref_attention(q, k, v, None, True)
     np.testing.assert_allclose(out.astype(jnp.float32),
                                ref.astype(jnp.float32), atol=3e-2, rtol=3e-2)
+
+
+# ===================== fused norm (rms / layernorm) =====================
+
+from paddle_tpu.ops.pallas import fused_norm as fn_mod
+
+
+def _rms_ref(z, w, b, eps):
+    z32 = z.astype(jnp.float32)
+    ms = jnp.mean(z32 * z32, axis=-1, keepdims=True)
+    y = z32 * jax.lax.rsqrt(ms + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(z.dtype)
+
+
+def _ln_ref(z, w, b, eps):
+    z32 = z.astype(jnp.float32)
+    mu = jnp.mean(z32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(z32 - mu), axis=-1, keepdims=True)
+    y = (z32 - mu) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(z.dtype)
+
+
+@pytest.mark.parametrize("kind", ["rms", "ln"])
+def test_fused_norm_forward_matches_reference(kind):
+    R, D = 24, 256
+    x = _rand((R, D))
+    w = _rand((D,))
+    b = _rand((D,))
+    out = fn_mod.fused_norm_pallas(x, w, b, eps=1e-6, kind=kind)
+    ref = (_rms_ref if kind == "rms" else _ln_ref)(x, w, b, 1e-6)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kind", ["rms", "ln"])
+def test_fused_norm_residual_bias_forward(kind):
+    B, S, D = 2, 8, 128
+    x = _rand((B, S, D))
+    w = _rand((D,))
+    bias = _rand((D,))
+    res = _rand((B, S, D))
+    out, z = fn_mod.fused_norm_pallas(x, w, None, bias, res,
+                                      eps=1e-6, kind=kind)
+    z_ref = x + bias + res
+    ref = (_rms_ref if kind == "rms" else _ln_ref)(z_ref, w, None, 1e-6)
+    np.testing.assert_allclose(z, z_ref, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kind", ["rms", "ln"])
+def test_fused_norm_grads_match_reference(kind):
+    R, D = 16, 128
+    x = _rand((R, D))
+    w = _rand((D,))
+    b = _rand((D,))
+
+    def loss_pallas(x, w, b):
+        return jnp.sum(fn_mod.fused_norm_pallas(x, w, b, eps=1e-6,
+                                                kind=kind) ** 2)
+
+    def loss_ref(x, w, b):
+        return jnp.sum(
+            (_rms_ref if kind == "rms" else _ln_ref)(x, w, b, 1e-6) ** 2)
+
+    g1 = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(g1, g2):
+        np.testing.assert_allclose(a, c, atol=5e-4, rtol=5e-4)
+
+
+def test_fused_norm_residual_grads():
+    R, D = 16, 128
+    x = _rand((R, D))
+    w = _rand((D,))
+    bias = _rand((D,))
+    res = _rand((R, D))
+
+    def loss_pallas(x, w, bias, res):
+        y, z = fn_mod.fused_norm_pallas(x, w, None, bias, res, eps=1e-6,
+                                        kind="rms")
+        return jnp.sum(y ** 2) + jnp.sum(z ** 3)
+
+    def loss_ref(x, w, bias, res):
+        z = x + bias + res
+        y = _rms_ref(z, w, None, 1e-6)
+        return jnp.sum(y ** 2) + jnp.sum(z ** 3)
+
+    g1 = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(x, w, bias, res)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, w, bias, res)
+    for a, c in zip(g1, g2):
+        np.testing.assert_allclose(a, c, atol=5e-4, rtol=5e-4)
+
+
+# ============================== fused rope ==============================
+
+from paddle_tpu.ops.pallas import rope as rope_mod
+
+
+def _rope_phases(s, d, base=10000.0):
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    t = jnp.arange(s, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return (jnp.cos(emb)[None, :, None, :], jnp.sin(emb)[None, :, None, :])
+
+
+def _rope_ref(x, cos, sin):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rot * sin
+
+
+def test_rope_forward_matches_reference():
+    B, S, H, D = 2, 16, 4, 64
+    x = _rand((B, S, H, D))
+    cos, sin = _rope_phases(S, D)
+    out = rope_mod.rope_pallas(x, cos, sin)
+    ref = _rope_ref(x, cos, sin)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_rope_grad_matches_reference():
+    B, S, H, D = 1, 8, 2, 64
+    x = _rand((B, S, H, D))
+    cos, sin = _rope_phases(S, D)
+    g1 = jax.grad(lambda x: jnp.sum(rope_mod.rope_pallas(x, cos, sin) ** 2))(x)
+    g2 = jax.grad(lambda x: jnp.sum(_rope_ref(x, cos, sin) ** 2))(x)
+    np.testing.assert_allclose(g1, g2, atol=5e-5, rtol=5e-5)
+
+
+# ====================== blocked KV-cache decode ======================
+
+# the package re-exports the function under the module's name — import the
+# function straight from the submodule via sys.modules
+import importlib
+da_mod = importlib.import_module("paddle_tpu.ops.pallas.decode_attention")
+
+
+def test_decode_attention_matches_full_softmax():
+    B, H, S, D = 2, 4, 64, 64
+    q = _rand((B, H, D))
+    kc = _rand((B, H, S, D))
+    vc = _rand((B, H, S, D))
+    pos = jnp.asarray([5, 33], jnp.int32)
+    out = da_mod.decode_attention(q, kc, vc, pos, block_k=16)
+    # reference: full-cache softmax with position mask
+    scale = 1.0 / np.sqrt(D)
+    scores = jnp.einsum("bhd,bhsd->bhs", q, kc) * scale
+    valid = jnp.arange(S)[None, None, :] <= pos[:, None, None]
+    scores = jnp.where(valid, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    ref = jnp.einsum("bhs,bhsd->bhd", p, vc)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_decode_attention_pos_zero_and_full():
+    B, H, S, D = 1, 2, 32, 64
+    q = _rand((B, H, D))
+    kc = _rand((B, H, S, D))
+    vc = _rand((B, H, S, D))
+    for p0 in (0, S - 1):
+        pos = jnp.asarray([p0], jnp.int32)
+        out = da_mod.decode_attention(q, kc, vc, pos, block_k=8)
+        scale = 1.0 / np.sqrt(D)
+        scores = jnp.einsum("bhd,bhsd->bhs", q, kc) * scale
+        valid = jnp.arange(S)[None, None, :] <= pos[:, None, None]
+        scores = jnp.where(valid, scores, -1e30)
+        pr = jax.nn.softmax(scores, axis=-1)
+        ref = jnp.einsum("bhs,bhsd->bhd", pr, vc)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
