@@ -80,17 +80,27 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
                 rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
             labels = P.to_tensor(
                 rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
-            # warmup/compile
+            # warmup/compile — two calls: the first call's inputs are fresh
+            # device_puts; the second proves the steady-state executable is
+            # reused (train_step pins state shardings so there is no
+            # second-call retrace)
+            loss = step(ids, labels)
+            loss.block_until_ready()
             loss = step(ids, labels)
             loss.block_until_ready()
 
             if trace_dir:
                 jax.profiler.start_trace(trace_dir)
             try:
+                # block every step: on this TPU tunnel, block_until_ready on
+                # the tail of an async chain returns before the chain's
+                # device work has actually run, so async loop timing reads
+                # 10-50x too fast (physically impossible MFU). Synchronous
+                # per-step timing is the honest number.
                 t0 = time.perf_counter()
                 for _ in range(iters):
                     loss = step(ids, labels)
-                loss.block_until_ready()
+                    loss.block_until_ready()
                 dt = time.perf_counter() - t0
             finally:
                 if trace_dir:

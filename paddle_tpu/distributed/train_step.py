@@ -114,13 +114,22 @@ class DistributedTrainStep:
         buffers = {n: jax.device_put(v, NamedSharding(mesh, P()))
                    for n, v in buffers.items()}
         self._p_spec, self._s_spec = p_spec, s_spec
+        # every leaf — including the scalar step counter and the PRNG key —
+        # must carry the mesh sharding the compiled step emits, or the
+        # second call's input avals differ from the first's and jit
+        # retraces+recompiles the whole program (a full second XLA compile)
+        rep = NamedSharding(mesh, P())
         self._state = {
             "params": params,
-            "opt": {"slots": slots, "step": opt_state["step"]},
+            "opt": {"slots": slots,
+                    "step": jax.device_put(jnp.asarray(opt_state["step"]),
+                                           rep)},
             "buffers": buffers,
             # fresh buffer: the step donates its state, so it must NOT alias
             # the global generator's key array
-            "key": jax.random.fold_in(rng.default_generator.get_state(), 7),
+            "key": jax.device_put(
+                jax.random.fold_in(rng.default_generator.get_state(), 7),
+                rep),
         }
         return self._state
 
