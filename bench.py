@@ -92,19 +92,25 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
             if trace_dir:
                 jax.profiler.start_trace(trace_dir)
             try:
-                # block every step: on this TPU tunnel, block_until_ready on
-                # the tail of an async chain returns before the chain's
-                # device work has actually run, so async loop timing reads
-                # 10-50x too fast (physically impossible MFU). Synchronous
-                # per-step timing is the honest number.
+                # Timing: chain all steps (donated state serializes them),
+                # then FETCH the final loss value. A D2H value read is the
+                # only true synchronization through this PJRT tunnel —
+                # block_until_ready returns before chained device work has
+                # run (reads 10-50x too fast, physically impossible MFU).
+                # The single fetch amortizes the tunnel's ~70ms round-trip
+                # over all iters; the final loss transitively depends on
+                # every prior step's param update, so the fetch waits for
+                # the whole chain.
                 t0 = time.perf_counter()
                 for _ in range(iters):
                     loss = step(ids, labels)
-                    loss.block_until_ready()
+                final_loss = float(np.asarray(loss._value))
                 dt = time.perf_counter() - t0
             finally:
                 if trace_dir:
                     jax.profiler.stop_trace()
+            if not np.isfinite(final_loss):
+                raise RuntimeError(f"non-finite loss {final_loss}")
             tokens = batch * seq * iters
             tps = tokens / dt
             break
