@@ -72,10 +72,31 @@ def trace_guard():
 
 
 # --- FLAGS_* style runtime flags (paddle.set_flags parity) -------------------
+def _env_bool(name, default="0"):
+    return os.environ.get(name, default) in ("1", "true", "True")
+
+
 _flags = {
-    "FLAGS_check_nan_inf": os.environ.get("FLAGS_check_nan_inf", "0") in ("1", "true", "True"),
-    "FLAGS_eager_jit_ops": os.environ.get("FLAGS_eager_jit_ops", "0") in ("1", "true", "True"),
+    "FLAGS_check_nan_inf": _env_bool("FLAGS_check_nan_inf"),
+    "FLAGS_eager_jit_ops": _env_bool("FLAGS_eager_jit_ops"),
+    # kernel-granular degradation (VERDICT r2 task 3): a broken Pallas
+    # kernel must cost speed, not the whole datapoint. The master flag
+    # disables the entire tier; per-kernel flags disable one dispatch site.
+    "FLAGS_disable_pallas": _env_bool("FLAGS_disable_pallas"),
+    "FLAGS_disable_pallas_flash": _env_bool("FLAGS_disable_pallas_flash"),
+    "FLAGS_disable_pallas_fused_norm": _env_bool("FLAGS_disable_pallas_fused_norm"),
+    # (ring attention is jnp/lax collectives, not pallas_call — no flag)
+    "FLAGS_disable_pallas_rope": _env_bool("FLAGS_disable_pallas_rope"),
+    "FLAGS_disable_pallas_decode": _env_bool("FLAGS_disable_pallas_decode"),
+    "FLAGS_use_autotune": _env_bool("FLAGS_use_autotune", "1"),
 }
+
+
+def pallas_enabled(kernel: str) -> bool:
+    """Dispatch-site gate for one Pallas kernel ('flash', 'fused_norm',
+    'rope', 'ring', 'decode')."""
+    return not (_flags.get("FLAGS_disable_pallas")
+                or _flags.get(f"FLAGS_disable_pallas_{kernel}"))
 
 
 def set_flags(d: dict):

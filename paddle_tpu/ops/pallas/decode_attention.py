@@ -29,6 +29,10 @@ from .flash_attention import _interpret, _pick_block, NEG_INF
 
 
 def decode_attention_available(cache_shape) -> bool:
+    from ...core import flags
+
+    if not flags.pallas_enabled("decode"):
+        return False
     _, b, h, s, d = cache_shape
     if d % 8 != 0 or d > 256 or s % 8 != 0:
         return False
@@ -42,8 +46,10 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
     q = q_ref[:].astype(jnp.float32) * scale        # [1, D]
 
     d = q.shape[-1]
-    m0 = jnp.full((1,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((1,), jnp.float32)
+    # stats kept rank-2 (1, 1): rank-1 loop state does not lower through
+    # Mosaic (same failure class as the round-2 flash LSE BlockSpec)
+    m0 = jnp.full((1, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1, 1), jnp.float32)
     acc0 = jnp.zeros((1, d), jnp.float32)
 
     num_iters = (pos + block_k) // block_k  # == cdiv(pos+1, block_k)
@@ -57,17 +63,17 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
         k_ids = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)
         s = jnp.where(k_ids <= pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None):

@@ -22,6 +22,7 @@ Supports is_causal and (optionally) an additive float mask broadcastable to
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 
@@ -35,17 +36,44 @@ DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
 
+_FORCE_COMPILED = False  # see force_tpu_lowering()
+
+
 def _interpret():
+    if _FORCE_COMPILED:
+        return False
     try:
         return jax.devices()[0].platform != "tpu"
     except Exception:
         return True
 
 
+@contextlib.contextmanager
+def force_tpu_lowering():
+    """Trace Pallas kernels for real Mosaic lowering even on a CPU host.
+
+    Used by the TPU-lowering CI gate (tests/test_tpu_lowering.py): under
+    `jax.export(..., platforms=['tpu'])` the kernels must go through
+    `pallas_call(interpret=False)` so BlockSpec/Mosaic layout errors — the
+    class of failure that broke the round-2 bench on hardware — surface
+    without a chip."""
+    global _FORCE_COMPILED
+    old = _FORCE_COMPILED
+    _FORCE_COMPILED = True
+    try:
+        yield
+    finally:
+        _FORCE_COMPILED = old
+
+
 def flash_attention_available(q) -> bool:
     """Pallas path policy: TPU with MXU-friendly shapes. (CPU exercises the
     same kernels through the interpreter in tests/test_pallas.py; the eager
     CPU fallback is the jnp reference.)"""
+    from ...core import flags
+
+    if not flags.pallas_enabled("flash"):
+        return False
     if q.ndim != 4:
         return False
     b, s, h, d = q.shape

@@ -31,6 +31,10 @@ from .flash_attention import _interpret, _pick_block
 
 
 def fused_norm_available(x, weight, bias) -> bool:
+    from ...core import flags
+
+    if not flags.pallas_enabled("fused_norm"):
+        return False
     d = x.shape[-1]
     if d % 128 != 0 or d > 16384:
         return False

@@ -24,6 +24,10 @@ from .flash_attention import _interpret, _pick_block
 
 
 def rope_available(x) -> bool:
+    from ...core import flags
+
+    if not flags.pallas_enabled("rope"):
+        return False
     if x.ndim != 4:
         return False
     d = x.shape[-1]

@@ -1,0 +1,141 @@
+"""TPU-lowering CI gate for the Pallas kernel tier (VERDICT r2 task 2).
+
+Every Pallas kernel is lowered FOR THE TPU PLATFORM on the CPU host via
+`jax.export(..., platforms=['tpu'])`. Mosaic runs its BlockSpec/layout
+checks at lowering time, so the exact class of failure that crashed the
+round-2 bench on hardware (rank-1 LSE block) is caught here without a chip.
+Interpreter mode is disabled through `force_tpu_lowering()`; each test
+asserts the lowered module really contains the Mosaic custom call so a
+silent interpreter fallback can't make the gate vacuous.
+
+Reference parity: kernels are compiled and run on-device in CI
+(test/cpp/phi/, SURVEY §4) — this is the no-hardware TPU equivalent.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas.decode_attention import decode_attention as da_fn
+from paddle_tpu.ops.pallas import fused_norm as fn
+from paddle_tpu.ops.pallas import rope as rp
+
+
+def _lower_for_tpu(f, *args):
+    """Export f for TPU from the CPU host; return StableHLO text."""
+    specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
+    with fa.force_tpu_lowering():
+        exported = jax.export.export(jax.jit(f), platforms=["tpu"])(*specs)
+    return exported.mlir_module()
+
+
+def _assert_mosaic(mlir: str):
+    # a silently-interpreted kernel would produce no custom call at all
+    assert "tpu_custom_call" in mlir or "mosaic" in mlir.lower(), (
+        "Pallas kernel did not lower through Mosaic — interpreter fallback?")
+
+
+# bench shapes (B, H=12, S=1024, D=64) + model-zoo shapes:
+# GPT-125M (12h, 64d), GPT-1.3B proxy (32h, 64d), LLaMA-ish (32h, 128d)
+FLASH_SHAPES = [
+    (8, 1024, 12, 64),
+    (16, 1024, 12, 64),
+    (32, 1024, 12, 64),
+    (4, 2048, 32, 64),
+    (2, 2048, 32, 128),
+]
+
+
+@pytest.mark.parametrize("shape", FLASH_SHAPES)
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_fwd_lowers(shape, causal):
+    b, s, h, d = shape
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    f = lambda q, k, v: fa._flash_core(q, k, v, causal, 128, 128)
+    mlir = _lower_for_tpu(f, q, q, q)
+    _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("shape", [(8, 1024, 12, 64), (2, 2048, 32, 128)])
+def test_flash_attention_bwd_lowers(shape):
+    b, s, h, d = shape
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_core(q, k, v, True, 128, 128).astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("kind", ["ln", "rms"])
+@pytest.mark.parametrize("rows,d", [(32 * 1024, 768), (4096, 1024)])
+def test_fused_norm_lowers(kind, rows, d):
+    x = jnp.zeros((rows, d), jnp.bfloat16)
+    w = jnp.ones((d,), jnp.bfloat16)
+    b = jnp.zeros((d,), jnp.bfloat16)
+
+    def f(x, w, b):
+        return fn.fused_norm_pallas(x, w, b, None, None, eps=1e-5, kind=kind)
+
+    mlir = _lower_for_tpu(f, x, w, b)
+    _assert_mosaic(mlir)
+
+
+def test_fused_norm_bwd_lowers():
+    x = jnp.zeros((8192, 768), jnp.bfloat16)
+    w = jnp.ones((768,), jnp.bfloat16)
+
+    def loss(x, w):
+        out = fn.fused_norm_pallas(x, w, None, None, None,
+                                   eps=1e-5, kind="rms")
+        return jnp.sum(out.astype(jnp.float32))
+
+    # value_and_grad: with grad alone XLA DCEs the pallas forward (the
+    # saved residuals are (x, w), not y) and the gate would test nothing
+    mlir = _lower_for_tpu(jax.value_and_grad(loss, argnums=(0, 1)), x, w)
+    _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("b,s,h,d", [(8, 1024, 12, 64), (2, 2048, 32, 128)])
+def test_rope_lowers(b, s, h, d):
+    x = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    cos = jnp.zeros((1, s, 1, d), jnp.float32)  # rope phase layout
+    sin = jnp.zeros((1, s, 1, d), jnp.float32)
+    mlir = _lower_for_tpu(rp.rope_pallas, x, cos, sin)
+    _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("b,h,s,d", [(8, 12, 1024, 64), (4, 32, 2048, 128)])
+def test_decode_attention_lowers(b, h, s, d):
+    q = jnp.zeros((b, h, d), jnp.bfloat16)
+    cache = jnp.zeros((b, h, s, d), jnp.bfloat16)
+    pos = jnp.zeros((b,), jnp.int32)
+    f = functools.partial(da_fn, block_k=256)
+    mlir = _lower_for_tpu(f, q, cache, cache, pos)
+    _assert_mosaic(mlir)
+
+
+def test_gate_catches_bad_blockspec():
+    """Meta-test: the gate actually fails on a Mosaic-illegal kernel (the
+    round-2 bug shape — rank-1 stats output block)."""
+    from jax.experimental import pallas as pl
+
+    def bad_kernel(x_ref, o_ref):
+        o_ref[:] = jnp.sum(x_ref[:], axis=1)
+
+    def bad(x):
+        return pl.pallas_call(
+            bad_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((None, 128, 128), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((None, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((4, 128), jnp.float32),
+        )(x)
+
+    x = jnp.zeros((4, 128, 128), jnp.float32)
+    with pytest.raises(Exception):
+        _lower_for_tpu(bad, x)
