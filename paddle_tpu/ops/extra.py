@@ -18,6 +18,14 @@ import jax.numpy as jnp
 from ..core.dispatch import apply, op
 from ..core.tensor import Tensor
 
+
+def _index_dtype():
+    """Canonical `int64`-request dtype: int32 with x64 disabled (the
+    documented TPU-first demotion, core/dtypes.py) — warning-free."""
+    from ..core import dtypes
+
+    return dtypes.convert_dtype("int64")
+
 __all__ = [
     "mm", "floor_mod", "reverse", "frexp", "gammaln", "multigammaln",
     "i0e", "i1", "i1e", "polar", "signbit", "nanquantile",
@@ -311,7 +319,7 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
                                         axis=-1)
         ids = jnp.take_along_axis(order, choice[..., None], axis=-1)
         val = jnp.take_along_axis(probs, ids, axis=-1)
-        return val, ids.astype(jnp.int64)
+        return val, ids.astype(_index_dtype())
 
     return apply("top_p_sampling", f, x, ps)
 
@@ -361,15 +369,19 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 
 
 def tril_indices(row, col=None, offset=0, dtype="int64"):
+    from ..core import dtypes
+
     col = row if col is None else col
     r, c = np.tril_indices(row, offset, col)
-    return Tensor(jnp.asarray(np.stack([r, c]), dtype))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtypes.convert_dtype(dtype)))
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64"):
+    from ..core import dtypes
+
     col = row if col is None else col
     r, c = np.triu_indices(row, offset, col)
-    return Tensor(jnp.asarray(np.stack([r, c]), dtype))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtypes.convert_dtype(dtype)))
 
 
 @op("clip_by_norm")
@@ -449,7 +461,7 @@ def binomial(count, prob, name=None):
 
     def f(n, p):
         return jax.random.binomial(key, n.astype(jnp.float32),
-                                   p).astype(jnp.int64)
+                                   p).astype(_index_dtype())
 
     return apply("binomial", f, count, prob)
 
@@ -536,7 +548,7 @@ def viterbi_decode(potentials, transition_params, lengths,
         first, ys = jax.lax.scan(back, last, bps, reverse=True)
         path = (jnp.concatenate([first[:, None], jnp.swapaxes(ys, 0, 1)],
                                 axis=1) if t > 1 else last[:, None])
-        return scores, path.astype(jnp.int64)
+        return scores, path.astype(_index_dtype())
 
     return apply("viterbi_decode", f, potentials, transition_params, lengths)
 

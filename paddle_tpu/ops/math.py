@@ -65,7 +65,16 @@ nextafter = _bin("nextafter", jnp.nextafter)
 heaviside = _bin("heaviside", jnp.heaviside)
 gcd = _bin("gcd", jnp.gcd)
 lcm = _bin("lcm", jnp.lcm)
-ldexp = _bin("ldexp", jnp.ldexp)
+def _ldexp_impl(x, y):
+    # reference ldexp (python/paddle/tensor/math.py) computes x * 2**y and
+    # documents y as "typically integers"; jnp.ldexp rejects float
+    # exponents outright, so truncate-cast them (matching 2**int(y))
+    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.floating):
+        y = jnp.trunc(y).astype(jnp.int32)
+    return jnp.ldexp(x, y)
+
+
+ldexp = _bin("ldexp", _ldexp_impl)
 
 exp = _un("exp", jnp.exp)
 expm1 = _un("expm1", jnp.expm1)

