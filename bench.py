@@ -131,6 +131,9 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
     }
+    peak_mem = P.device.max_memory_allocated()
+    if peak_mem:
+        result["peak_memory_bytes"] = int(peak_mem)
     if degraded or not on_tpu:
         result["degraded"] = True
     if note:

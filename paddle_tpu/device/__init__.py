@@ -46,6 +46,64 @@ def is_compiled_with_cinn():
     return False
 
 
+# --- memory stats (paddle/fluid/memory/stats.h role) -------------------------
+
+def _resolve_device(device=None):
+    devs = jax.local_devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str) and ":" in device:
+        idx = int(device.rsplit(":", 1)[1])
+        for d in devs:
+            if d.id == idx:
+                return d
+        return devs[idx % len(devs)]
+    return devs[0]
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT allocator stats for one device (reference:
+    `paddle/fluid/memory/stats.h` DEVICE_MEMORY_STAT_* counters). Keys
+    include `bytes_in_use`, `peak_bytes_in_use`, `largest_alloc_size`,
+    and (TPU) `bytes_limit`. Empty dict when the backend doesn't report
+    (e.g. CPU)."""
+    d = _resolve_device(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (memory_allocated parity)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-watermark of allocated bytes (max_memory_allocated parity).
+    PJRT tracks the peak since process start; there is no reset API."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator (pool size; falls back to
+    bytes_in_use on backends without a reservation pool)."""
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def total_memory(device=None) -> int:
+    """Device memory capacity in bytes (0 when unreported)."""
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
 class Stream:
     """No-op stream (XLA schedules async execution itself)."""
 
@@ -103,3 +161,14 @@ class cuda:  # namespace shim: reference exposes paddle.device.cuda
     @staticmethod
     def is_available():
         return False
+
+    # memory-stat API parity (paddle.device.cuda.max_memory_allocated):
+    # reports the accelerator this process actually runs on
+    memory_allocated = staticmethod(
+        lambda device=None: memory_allocated(device))
+    max_memory_allocated = staticmethod(
+        lambda device=None: max_memory_allocated(device))
+    memory_reserved = staticmethod(
+        lambda device=None: memory_reserved(device))
+    max_memory_reserved = staticmethod(
+        lambda device=None: max_memory_reserved(device))

@@ -1,2 +1,2 @@
-from .api import load_state_dict, save_state_dict  # noqa: F401
+from .api import load_state_dict, save_state_dict, wait_async_save  # noqa: F401
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401

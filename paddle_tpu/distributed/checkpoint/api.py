@@ -49,48 +49,95 @@ def _np_dtype(name):
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, async_save=False):
-    os.makedirs(path, exist_ok=True)
-    pid = _proc_id()
+_async_save_thread = None
+
+
+def _snapshot_host(state_dict):
+    """Device→host snapshot: list of (key, global_shape, dtype_str,
+    [(offset, np_array), ...]) with replicated shards deduped (reference
+    dedups replicated tensors across dp, save_state_dict.py:76)."""
+    snap = []
+    for key, t in state_dict.items():
+        v = t._value if isinstance(t, Tensor) else t
+        if not hasattr(v, "addressable_shards"):
+            import jax.numpy as jnp
+
+            v = jnp.asarray(v)
+        shards = []
+        seen_offsets = set()
+        for sh in v.addressable_shards:
+            offset = tuple(
+                int(idx.start) if idx.start is not None else 0
+                for idx in sh.index) if sh.index else (0,) * v.ndim
+            if offset in seen_offsets:
+                continue
+            seen_offsets.add(offset)
+            shards.append((offset, np.asarray(sh.data)))
+        snap.append((key, tuple(v.shape), str(v.dtype), shards))
+    return snap
+
+
+def _write_snapshot(snap, path, pid, coordinator_rank):
     meta = Metadata()
     fname = f"{pid}.distcp"
     pos = 0
     with open(os.path.join(path, fname), "wb") as f:
-        for key, t in state_dict.items():
-            v = t._value if isinstance(t, Tensor) else t
-            if not hasattr(v, "addressable_shards"):
-                import jax.numpy as jnp
-
-                v = jnp.asarray(v)
+        for key, gshape, dtype_str, shards in snap:
             entries = []
-            seen_offsets = set()
-            for sh in v.addressable_shards:
-                # dedup replicated shards (reference dedups replicated
-                # tensors across dp, save_state_dict.py:76)
-                offset = tuple(
-                    int(idx.start) if idx.start is not None else 0
-                    for idx in sh.index) if sh.index else (0,) * v.ndim
-                if offset in seen_offsets:
-                    continue
-                seen_offsets.add(offset)
-                arr = np.asarray(sh.data)
+            for offset, arr in shards:
                 raw = arr.tobytes()
                 f.write(raw)
                 entries.append(LocalTensorMetadata(
-                    offset, tuple(arr.shape), str(v.dtype)))
+                    offset, tuple(arr.shape), dtype_str))
                 meta.storage_metadata[LocalTensorIndex(key, offset)] = {
                     "file": fname, "byte_offset": pos, "nbytes": len(raw),
                 }
                 pos += len(raw)
             meta.state_dict_metadata[key] = {
-                "global_shape": tuple(v.shape),
-                "dtype": str(v.dtype),
+                "global_shape": gshape,
+                "dtype": dtype_str,
                 "shards": entries,
             }
     if pid == coordinator_rank:
         with open(os.path.join(path, f"{pid}.metadata"), "wb") as f:
             pickle.dump(meta, f, protocol=4)
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    """Write each process's addressable shards + metadata.
+
+    `async_save=True` (reference async-save semantics, SURVEY §5
+    checkpoint row): the device→host copy happens synchronously — the
+    snapshot is consistent even if training immediately mutates/donates
+    the state — then file IO runs on a background thread. Overlapping
+    saves are serialized; `wait_async_save()` is the completion barrier
+    (also called automatically by the next save/load).
+    """
+    os.makedirs(path, exist_ok=True)
+    pid = _proc_id()
+    wait_async_save()  # serialize with any in-flight save
+    snap = _snapshot_host(state_dict)
+    if async_save:
+        global _async_save_thread
+        import threading
+
+        _async_save_thread = threading.Thread(
+            target=_write_snapshot, args=(snap, path, pid, coordinator_rank),
+            daemon=False, name="distcp-async-save")
+        _async_save_thread.start()
+        return
+    _write_snapshot(snap, path, pid, coordinator_rank)
+
+
+def wait_async_save():
+    """Block until the last `save_state_dict(..., async_save=True)` has
+    fully hit disk (completion barrier; no-op when nothing is in flight)."""
+    global _async_save_thread
+    t = _async_save_thread
+    if t is not None:
+        t.join()
+        _async_save_thread = None
 
 
 def _load_metadata(path):
@@ -192,6 +239,7 @@ def load_state_dict(state_dict, path, process_group=None,
     `jax.make_array_from_callback` — no full global tensor is ever
     materialized on the host for them (scales to multi-B-param states).
     """
+    wait_async_save()  # a just-issued async save of `path` must land first
     meta = _load_metadata(path)
     assert meta is not None, f"no metadata found under {path}"
     last_load_stats["max_block_elems"] = 0

@@ -114,6 +114,39 @@ def test_dist_checkpoint_bf16_bit_exact(tmp_path):
         out.view(np.uint16), vals.view(np.uint16))
 
 
+def test_dist_checkpoint_async_save(tmp_path):
+    """async_save: snapshot is taken synchronously (mutating the state
+    dict right after save must not corrupt the checkpoint), IO runs on a
+    background thread, wait_async_save() is the completion barrier."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict, wait_async_save,
+    )
+    from paddle_tpu.distributed.checkpoint import api as ck_api
+
+    data = np.arange(64, dtype=np.float32).reshape(8, 8)
+    src = P.Tensor(jnp.asarray(data))
+    sd = {"w": src}
+    save_state_dict(sd, str(tmp_path / "cka"), async_save=True)
+    assert ck_api._async_save_thread is not None  # really backgrounded
+    # clobber the live tensor immediately — the snapshot must be immune
+    sd["w"]._value = jnp.zeros((8, 8), jnp.float32)
+    wait_async_save()
+    assert ck_api._async_save_thread is None
+    tgt = P.Tensor(jnp.zeros((8, 8), jnp.float32))
+    load_state_dict({"w": tgt}, str(tmp_path / "cka"))
+    np.testing.assert_allclose(np.asarray(tgt._value), data)
+
+    # load right after an async save (no explicit wait): load's own
+    # barrier must see the finished file
+    save_state_dict({"w": P.Tensor(jnp.asarray(data * 2))},
+                    str(tmp_path / "ckb"), async_save=True)
+    tgt2 = P.Tensor(jnp.zeros((8, 8), jnp.float32))
+    load_state_dict({"w": tgt2}, str(tmp_path / "ckb"))
+    np.testing.assert_allclose(np.asarray(tgt2._value), data * 2)
+
+
 def test_hapi_model_fit(tmp_path):
     from paddle_tpu.hapi import Model
     from paddle_tpu.metric import Accuracy
