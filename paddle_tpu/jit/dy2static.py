@@ -234,9 +234,34 @@ def _jst_call(fn):
     if isinstance(fn, (types.FunctionType, types.MethodType)):
         try:
             return convert(fn)
+        except Dy2StaticError:
+            raise  # loud-error contract: never silently unconvert a callee
         except Exception:
             return fn
     return fn
+
+
+def _jst_for_iter(thunk):
+    """Evaluate a `for` loop's iterable; tensor-dependent trip counts
+    (e.g. `range(t)` with traced `t`) fail LOUDLY instead of surfacing a
+    deep tracer error or silently specializing (reference: SOT converts
+    these; the AST tier's contract is convert-or-raise)."""
+    try:
+        it = thunk()
+    except (jax.errors.TracerIntegerConversionError,
+            jax.errors.TracerBoolConversionError,
+            jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError) as e:
+        raise Dy2StaticError(
+            "dy2static: `for` over a tensor-dependent range cannot be "
+            "converted to XLA control flow. Use a Python-int bound, "
+            "vectorize with paddle_tpu.arange + masked ops, or express "
+            "the loop as `while` (converted to lax.while_loop).") from e
+    if _is_traced(it) and getattr(it, "ndim", 1) == 0:
+        raise Dy2StaticError(
+            "dy2static: `for` over a 0-d traced tensor is not iterable; "
+            "use a Python int or a convertible `while` loop.")
+    return it
 
 
 class _Helpers:
@@ -246,6 +271,7 @@ class _Helpers:
     or_ = staticmethod(_jst_or)
     not_ = staticmethod(_jst_not)
     call = staticmethod(_jst_call)
+    for_iter = staticmethod(_jst_for_iter)
     UNDEF = UNDEF
 
 
@@ -343,6 +369,22 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 value=ast.Name(id=_HELPERS, ctx=ast.Load()),
                 attr="call", ctx=ast.Load()),
             args=[node.func], keywords=[])
+        return node
+
+    # ---- for: stays a Python loop (static unroll), but the iterable is
+    # routed through for_iter so tensor-dependent ranges raise loudly ----
+    def visit_For(self, node):
+        self.generic_visit(node)
+        node.iter = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id=_HELPERS, ctx=ast.Load()),
+                attr="for_iter", ctx=ast.Load()),
+            args=[ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=node.iter)],
+            keywords=[])
+        ast.fix_missing_locations(node)
         return node
 
     # ---- if/while ----
@@ -477,17 +519,43 @@ def convert(fn):
 
     glb = dict(raw.__globals__)
     glb[_HELPERS] = _Helpers
-    code = compile(tree, filename=f"<dy2static {raw.__qualname__}>",
-                   mode="exec")
+    fname = f"<dy2static {raw.__qualname__}>"
     ns: dict = {}
-    exec(code, glb, ns)
-    new_fn = ns[fdef.name]
+    free = raw.__code__.co_freevars
+    if free and raw.__closure__:
+        # Closure conversion (VERDICT r2 task 6): compile the converted
+        # body nested in a wrapper whose params shadow the free names, so
+        # the inner code object gets real co_freevars again; then rebind
+        # it to the ORIGINAL cells with types.FunctionType — `nonlocal`
+        # mutation stays visible both ways, exactly like the source fn.
+        outer_name = "__dy2s_outer__"
+        outer = ast.FunctionDef(
+            name=outer_name,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in free],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[fdef,
+                  ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+            decorator_list=[], returns=None, type_params=[])
+        mod_ast = ast.Module(body=[outer], type_ignores=[])
+        ast.fix_missing_locations(mod_ast)
+        exec(compile(mod_ast, filename=fname, mode="exec"), glb, ns)
+        template = ns[outer_name](*[None] * len(free))
+        cellmap = dict(zip(free, raw.__closure__))
+        missing = [n for n in template.__code__.co_freevars
+                   if n not in cellmap]
+        if missing:
+            raise Dy2StaticError(
+                f"dy2static: converted {raw.__qualname__} references free "
+                f"variables {missing} absent from the original closure")
+        new_fn = types.FunctionType(
+            template.__code__, glb, raw.__name__, raw.__defaults__,
+            tuple(cellmap[n] for n in template.__code__.co_freevars))
+        new_fn.__kwdefaults__ = raw.__kwdefaults__
+    else:
+        exec(compile(tree, filename=fname, mode="exec"), glb, ns)
+        new_fn = ns[fdef.name]
     new_fn = functools.wraps(raw)(new_fn)
-    if raw.__closure__:
-        # free variables can't be re-created by exec; fall back for
-        # closures rather than miscompile
-        _conversion_cache[fn] = fn
-        return fn
     if bound_self is not None:
         new_fn = types.MethodType(new_fn, bound_self)
     _conversion_cache[fn] = new_fn

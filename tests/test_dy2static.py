@@ -138,3 +138,67 @@ def test_convert_preserves_plain_functions():
         return a + b
 
     assert convert(g)(1, 2) == 3
+
+
+def test_closure_with_branch_matches_eager():
+    """Closures are converted, not silently skipped (VERDICT r2 task 6):
+    a closure-using fn with a tensor-dependent branch must run under jit
+    and match eager."""
+    scale = P.to_tensor(np.float32(3.0))
+    offset = 2.0
+
+    def f(x):
+        if x.sum() > 0:
+            out = x * scale
+        else:
+            out = x - offset
+        return out
+
+    xs = P.to_tensor(np.ones((2,), np.float32))
+    neg = P.to_tensor(-np.ones((2,), np.float32))
+    static_f = P.jit.to_static(f)
+    np.testing.assert_allclose(static_f(xs).numpy(), f(xs).numpy())
+    np.testing.assert_allclose(static_f(neg).numpy(), f(neg).numpy())
+
+
+def test_closure_cells_stay_live():
+    """The converted function shares the ORIGINAL cells: rebinding the
+    free variable through the maker is visible to the converted fn."""
+    from paddle_tpu.jit.dy2static import convert
+
+    def make():
+        k = 10.0
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * k
+            else:
+                y = x
+            return y
+
+        def bump():
+            nonlocal k
+            k = k + 1.0
+
+        return f, bump
+
+    f, bump = make()
+    cf = convert(f)
+    xs = P.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(cf(xs).numpy(), 10.0 * np.ones(2))
+    bump()
+    np.testing.assert_allclose(cf(xs).numpy(), 11.0 * np.ones(2))
+
+
+def test_loud_error_on_tensor_dependent_for_range():
+    def f(x, n):
+        acc = x
+        for _ in range(n):  # n is traced -> must raise loudly
+            acc = acc + 1
+        return acc
+
+    xs = P.to_tensor(np.ones((2,), np.float32))
+    n = P.to_tensor(np.int32(3))
+    static_f = P.jit.to_static(f)
+    with pytest.raises(Dy2StaticError):
+        static_f(xs, n)
