@@ -198,8 +198,11 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 def maxout(x, groups, axis=1, name=None):
     axis = axis % x.ndim
     c = x.shape[axis]
-    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
-    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+    # reference layout (nn/functional/activation.py maxout docstring):
+    # out[..., j, ...] = max_k x[..., j + (c//groups)*k, ...] — the groups
+    # dim is the OUTER factor of the channel axis
+    new_shape = x.shape[:axis] + (groups, c // groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis)
 
 
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):

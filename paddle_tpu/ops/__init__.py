@@ -20,11 +20,12 @@ from ..core.tensor import Tensor
 
 def add_n(inputs, name=None):
     """Sum a list of tensors (paddle.add_n)."""
-    import jax.numpy as jnp
+    import builtins
 
     if isinstance(inputs, Tensor):
         return inputs
-    return apply("add_n", lambda *vs: sum(vs[1:], vs[0]), *inputs)
+    # NB: builtins.sum — this namespace shadows `sum` with the paddle op
+    return apply("add_n", lambda *vs: builtins.sum(vs[1:], vs[0]), *inputs)
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):

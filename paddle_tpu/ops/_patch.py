@@ -22,7 +22,7 @@ INPLACE_BASES = (
     "masked_fill masked_scatter mod multigammaln multiply nan_to_num neg "
     "not_equal polygamma pow put_along_axis reciprocal remainder renorm "
     "round rsqrt scale sigmoid sin sinh sqrt squeeze subtract t tan tanh "
-    "transpose tril triu trunc unsqueeze where"
+    "transpose tril triu trunc unsqueeze"
 ).split()
 
 
@@ -111,6 +111,18 @@ def patch_tensor():
         g.__qualname__ = f"Tensor.{nm}"
         g.__doc__ = f"In-place variant of `{nm[:-1]}` (compute + rebind)."
         return g
+
+    # where_ is special: the reference's inplace target is `x` (arg 2 of
+    # where(condition, x, y)), not the receiver/condition
+    def _where_(condition, x, y, name=None):
+        out = Tensor.where(condition, x, y)
+        return x._rebind(out) if isinstance(x, Tensor) else out
+
+    _where_.__name__ = "where_"
+    if not hasattr(Tensor, "where_"):
+        Tensor.where_ = _where_
+        if ops_pkg is not None and not hasattr(ops_pkg, "where_"):
+            setattr(ops_pkg, "where_", _where_)
 
     for base in INPLACE_BASES:
         f = getattr(Tensor, base, None)

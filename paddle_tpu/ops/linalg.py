@@ -260,6 +260,12 @@ def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
 @op("histogramdd")
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                 name=None):
+    if ranges is not None:
+        # reference API: flat [lo0, hi0, lo1, hi1, ...]
+        import numpy as _np
+
+        flat = _np.asarray(ranges, float).reshape(-1, 2)
+        ranges = [tuple(p) for p in flat]
     return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
                            weights=weights)
 
@@ -309,11 +315,11 @@ def householder_product(x, tau, name=None):
         return q
 
     if x.ndim == 2:
-        return single(x, tau)
+        return single(x, tau)[:, :n]
     flat_x = x.reshape((-1,) + x.shape[-2:])
     flat_t = tau.reshape((-1,) + tau.shape[-1:])
-    out = jax.vmap(single)(flat_x, flat_t)
-    return out.reshape(x.shape[:-2] + (m, m))
+    out = jax.vmap(single)(flat_x, flat_t)[..., :, :n]
+    return out.reshape(x.shape[:-2] + (m, n))
 
 
 @op("matrix_exp")

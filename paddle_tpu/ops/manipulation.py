@@ -478,12 +478,15 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
     if axis is None:
         arr = arr.reshape(-1)
         axis = 0
+    import builtins
+
+    # NB: this module defines a paddle `slice` op that shadows the builtin
     changed = np.ones(arr.shape[axis], dtype=bool)
     if arr.shape[axis] > 1:
-        sl = [slice(None)] * arr.ndim
+        sl = [builtins.slice(None)] * arr.ndim
         sl_prev = list(sl)
-        sl[axis] = slice(1, None)
-        sl_prev[axis] = slice(None, -1)
+        sl[axis] = builtins.slice(1, None)
+        sl_prev[axis] = builtins.slice(None, -1)
         diffs = arr[tuple(sl)] != arr[tuple(sl_prev)]
         other_axes = tuple(i for i in range(arr.ndim) if i != axis)
         changed[1:] = diffs.any(axis=other_axes) if other_axes else diffs

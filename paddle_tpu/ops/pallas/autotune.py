@@ -1,0 +1,145 @@
+"""Runtime block-size autotune for the Pallas kernel tier.
+
+Role parity: `paddle/phi/kernels/autotune/` (`cache.h`,
+`switch_autotune.cc`) — the reference times candidate kernel algorithms at
+runtime and caches the winner per input signature. Here the "algorithm"
+axis is Pallas block shape: on the first call for a given (op, shape,
+dtype) signature on TPU, each candidate config is compiled and
+slope-timed on the device with real data, and the winner is cached
+in-process and on disk (so one process pays the search once per
+signature, ever).
+
+Gating: `FLAGS_use_autotune` (default on; `paddle.set_flags` or env).
+Never runs in interpreter mode / off-TPU — the static default config is
+used there.
+
+Timing: value-fetch slope method (PERF.md "Measurement methodology") —
+`block_until_ready` is unreliable through tunneled PJRT, so each
+candidate is timed by chaining N iterations between two device-to-host
+fetches and dividing the difference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+import jax
+
+_CACHE_PATH = os.environ.get(
+    "PADDLE_TPU_AUTOTUNE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "autotune.json"))
+_cache = None
+_lock = threading.Lock()
+
+
+def _enabled() -> bool:
+    from ...core import flags
+
+    return bool(flags.get_flags("FLAGS_use_autotune")["FLAGS_use_autotune"])
+
+
+def _load() -> dict:
+    global _cache
+    if _cache is None:
+        try:
+            with open(_CACHE_PATH) as f:
+                _cache = json.load(f)
+        except Exception:
+            _cache = {}
+    return _cache
+
+
+def _save() -> None:
+    try:
+        os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+        tmp = _CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_cache, f, indent=0, sort_keys=True)
+        os.replace(tmp, _CACHE_PATH)
+    except Exception:
+        pass  # cache is an optimization; never fail the op over it
+
+
+def _sync_fetch(r):
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    return float(np.asarray(jax.device_get(leaf.ravel()[0:1]),
+                            np.float32)[0])
+
+
+def _slope_time(f, n1=2, n2=6) -> float:
+    """Per-iteration seconds of `f` (a nullary fn returning a jax array),
+    amortizing the tunnel's fixed dispatch+fetch overhead."""
+    def chain(n):
+        r = None
+        for _ in range(n):
+            r = f()
+        _sync_fetch(r)
+
+    chain(1)  # compile + warm
+    t0 = time.perf_counter()
+    chain(n1)
+    d1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    chain(n2)
+    d2 = time.perf_counter() - t0
+    return max((d2 - d1) / (n2 - n1), 1e-9)
+
+
+def pick(op: str, signature, candidates, run, default):
+    """Return the fastest of `candidates` for this signature.
+
+    run(config) must execute the kernel with that config on REAL device
+    data and return a jax array. Results are cached under
+    (device_kind, op, signature). Falls back to `default` when autotune
+    is disabled or every candidate fails.
+    """
+    if not _enabled() or len(candidates) <= 1:
+        return default
+    try:
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return default
+        devkind = getattr(dev, "device_kind", dev.platform)
+    except Exception:
+        return default
+    key = f"{devkind}|{op}|{signature}"
+    with _lock:
+        cache = _load()
+        hit = cache.get(key)
+        if hit is not None:
+            cfg = hit["config"]
+            return tuple(cfg) if isinstance(cfg, list) else cfg
+    # search outside the lock: candidate compiles can take seconds each
+    best, best_t, timings = None, float("inf"), {}
+    for cfg in candidates:
+        try:
+            t = _slope_time(lambda: run(cfg))
+        except Exception:
+            continue  # a config that fails to compile just loses
+        timings[str(cfg)] = round(t * 1e3, 4)
+        if t < best_t:
+            best, best_t = cfg, t
+    if best is None:
+        return default
+    with _lock:
+        cache = _load()
+        cache[key] = {"config": list(best) if isinstance(best, tuple)
+                      else best, "ms": timings}
+        _save()
+    return best
+
+
+def clear_cache():
+    """Drop the in-process and on-disk cache (tests / re-tuning)."""
+    global _cache
+    with _lock:
+        _cache = {}
+        try:
+            os.remove(_CACHE_PATH)
+        except OSError:
+            pass

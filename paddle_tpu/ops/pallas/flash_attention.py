@@ -26,6 +26,8 @@ import contextlib
 import functools
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -371,10 +373,42 @@ def _ref_attention(q, k, v, mask, is_causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
+    """Autotuned (block_q, block_k) for this attention signature
+    (paddle/phi/kernels/autotune role; cached per signature on disk)."""
+    from . import autotune
+
+    cands = [(bq, bk)
+             for bq in (128, 256, 512) for bk in (128, 256, 512)
+             if sq % bq == 0 and sk % bk == 0 and bq <= sq and bk <= sk]
+    default = (_pick_block(sq, DEFAULT_BLOCK_Q),
+               _pick_block(sk, DEFAULT_BLOCK_K))
+    if len(cands) <= 1:
+        return default
+
+    def run(cfg):
+        # concrete dummy data, same signature; compiled eagerly per config
+        rs = np.random.RandomState(0)
+        qv = jnp.asarray(rs.randn(b, sq, h, d), dtype)
+        kv = jnp.asarray(rs.randn(b, sk, h, d), dtype)
+        vv = jnp.asarray(rs.randn(b, sk, h, d), dtype)
+        return _flash_core(qv, kv, vv, causal, cfg[0], cfg[1])
+
+    sig = f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
+    return autotune.pick("flash_fwd", sig, cands, run, default)
+
+
 def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                        block_q=None, block_k=None):
     """[B, S, H, D] in/out. Pallas kernel for causal/full; additive or
-    boolean masks use the fused-softmax reference path."""
+    boolean masks use the fused-softmax reference path. Block sizes are
+    autotuned per signature unless passed explicitly."""
     if mask is not None or not flash_attention_available(q):
         return _ref_attention(q, k, v, mask, is_causal)
+    if block_q is None or block_k is None:
+        bq, bk = _tuned_blocks(q.shape[0], q.shape[1], k.shape[1],
+                               q.shape[2], q.shape[3], q.dtype,
+                               bool(is_causal))
+        block_q = block_q or bq
+        block_k = block_k or bk
     return _flash_core(q, k, v, bool(is_causal), block_q, block_k)

@@ -13,9 +13,10 @@ Design (TPU-first):
     lanes (d multiple of 128 for the Pallas path; anything else falls
     back to the jnp body, which XLA fuses well for small d anyway).
   * Backward is recompute-style jnp (bandwidth-bound elementwise +
-    row reductions — XLA emits a single fused pass; measured on TPU, see
-    PERF.md). The Pallas win is the forward, which sits on the decode /
-    inference hot path and inside every transformer layer.
+    row reductions that XLA emits as a single fused pass — see PERF.md
+    for what has and hasn't been measured on hardware). The Pallas win
+    is the forward, which sits on the decode / inference hot path and
+    inside every transformer layer.
   * Non-TPU backends run the same kernel through the Pallas interpreter
     in tests (tests/test_pallas.py) to validate kernel code on CPU.
 """

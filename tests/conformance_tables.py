@@ -1,0 +1,148 @@
+"""Conformance-sweep op tables — the single source shared by
+tests/test_op_conformance.py (which parametrizes FROM OPS_MANIFEST.json and
+resolves specs here) and tools/gen_op_manifest.py (which stamps each op's
+manifest `conformance` entry from these tables).
+
+Reference role: the per-op metadata rows of `paddle/phi/api/yaml/ops.yaml`
+(backward link, inplace map) — here the `grad` bit is machine-true: it is
+exactly the set of ops whose numeric-grad check the sweep executes.
+"""
+import numpy as np
+
+rs = np.random.RandomState(11)
+
+
+def _pos(shape):
+    return np.asarray(rs.rand(*shape) + 0.5, np.float32)
+
+
+def _std(shape):
+    return np.asarray(rs.randn(*shape), np.float32)
+
+
+def _unit(shape):
+    return np.asarray(rs.rand(*shape) * 1.6 - 0.8, np.float32)
+
+
+# name -> (input factory, numpy ref or None, grad-checkable)
+UNARY_OPS = {
+    "abs": (_std, np.abs, True),
+    "acos": (_unit, np.arccos, True),
+    "acosh": (lambda s: _pos(s) + 1.0, np.arccosh, True),
+    "asin": (_unit, np.arcsin, True),
+    "asinh": (_std, np.arcsinh, True),
+    "atan": (_std, np.arctan, True),
+    "atanh": (_unit, np.arctanh, True),
+    "ceil": (_std, np.ceil, False),
+    "cos": (_std, np.cos, True),
+    "cosh": (_std, np.cosh, True),
+    "digamma": (_pos, None, True),
+    "erf": (_std, None, True),
+    "erfinv": (_unit, None, True),
+    "exp": (_std, np.exp, True),
+    "expm1": (_std, np.expm1, True),
+    "floor": (_std, np.floor, False),
+    "frac": (_std, lambda x: x - np.trunc(x), False),
+    "i0": (_pos, None, True),
+    "i0e": (_pos, None, True),
+    "i1": (_pos, None, True),
+    "i1e": (_pos, None, True),
+    "gammaln": (_pos, None, True),
+    "lgamma": (_pos, None, True),
+    "log": (_pos, np.log, True),
+    "log10": (_pos, np.log10, True),
+    "log1p": (_pos, np.log1p, True),
+    "log2": (_pos, np.log2, True),
+    "logit": (lambda s: np.asarray(rs.rand(*s) * 0.8 + 0.1, np.float32),
+              None, True),
+    "neg": (_std, np.negative, True),
+    "reciprocal": (_pos, np.reciprocal, True),
+    "round": (_std, np.round, False),
+    "rsqrt": (_pos, lambda x: 1 / np.sqrt(x), True),
+    "sigmoid": (_std, lambda x: 1 / (1 + np.exp(-x)), True),
+    "sign": (_std, np.sign, False),
+    "signbit": (_std, np.signbit, False),
+    "sin": (_std, np.sin, True),
+    "sinh": (_std, np.sinh, True),
+    "sqrt": (_pos, np.sqrt, True),
+    "square": (_std, np.square, True),
+    "tan": (_unit, np.tan, True),
+    "tanh": (_std, np.tanh, True),
+    "trunc": (_std, np.trunc, False),
+}
+
+BINARY_OPS = {
+    "add": (np.add, True),
+    "subtract": (np.subtract, True),
+    "multiply": (np.multiply, True),
+    "divide": (np.true_divide, True),
+    "maximum": (np.maximum, True),
+    "minimum": (np.minimum, True),
+    "pow": (None, True),
+    "atan2": (np.arctan2, True),
+    "fmax": (np.fmax, True),
+    "fmin": (np.fmin, True),
+    "hypot": (np.hypot, True),
+    "ldexp": (None, False),
+    "logaddexp": (np.logaddexp, True),
+    "nextafter": (np.nextafter, False),
+    "remainder": (None, False),
+    "floor_divide": (None, False),
+    "lerp": (None, True),
+}
+
+REDUCTIONS = {
+    "sum": np.sum, "mean": np.mean, "max": np.max, "min": np.min,
+    "prod": np.prod, "std": None, "var": None, "median": None,
+    "logsumexp": None, "all": None, "any": None,
+    "amax": np.max, "amin": np.min, "nansum": np.nansum,
+    "nanmean": np.nanmean,
+}
+
+
+
+
+def specs():
+    """{name: {kind, grad}} for the manifest generator."""
+    out = {}
+    for n, (_, _, g) in UNARY_OPS.items():
+        out[n] = {"kind": "unary", "grad": bool(g)}
+    for n, (_, g) in BINARY_OPS.items():
+        out[n] = {"kind": "binary", "grad": bool(g)}
+    for n in REDUCTIONS:
+        out[n] = {"kind": "reduction", "grad": False}
+    for n in COMPARISON_OPS:
+        out[n] = {"kind": "comparison", "grad": False}
+    for n in INT_BINARY_OPS:
+        out[n] = {"kind": "int_binary", "grad": False}
+    for n in INT_UNARY_OPS:
+        out[n] = {"kind": "int_unary", "grad": False}
+    return out
+
+
+# comparison / logical binaries: float inputs, bool outputs, no grads
+COMPARISON_OPS = {
+    "equal": np.equal,
+    "not_equal": np.not_equal,
+    "greater_than": np.greater,
+    "greater_equal": np.greater_equal,
+    "less_than": np.less,
+    "less_equal": np.less_equal,
+    "logical_and": np.logical_and,
+    "logical_or": np.logical_or,
+    "logical_xor": np.logical_xor,
+}
+
+# integer binaries (bitwise + number theory)
+INT_BINARY_OPS = {
+    "bitwise_and": np.bitwise_and,
+    "bitwise_or": np.bitwise_or,
+    "bitwise_xor": np.bitwise_xor,
+    "gcd": np.gcd,
+    "lcm": np.lcm,
+}
+
+# unary over ints
+INT_UNARY_OPS = {
+    "bitwise_not": np.bitwise_not,
+}

@@ -146,13 +146,42 @@ def _resolve(name):
     return None
 
 
-def _tested_names():
-    src = []
+def _test_sources():
+    """{filename: source} for every test file."""
+    out = {}
     tests_dir = os.path.join(REPO, "tests")
-    for f in os.listdir(tests_dir):
+    for f in sorted(os.listdir(tests_dir)):
         if f.endswith(".py"):
-            src.append(open(os.path.join(tests_dir, f)).read())
-    return "\n".join(src)
+            out[f] = open(os.path.join(tests_dir, f)).read()
+    return out
+
+
+def _conformance_specs():
+    """Per-op sweep specs from tests/conformance_tables.py +
+    tests/op_smoke_table.py — machine-true: tests/test_op_conformance.py
+    and tests/test_op_smoke.py parametrize FROM this manifest and resolve
+    every listed op in those same tables, so a manifest `conformance`
+    entry implies the op is executed by the suite."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    try:
+        import conformance_tables
+        import op_smoke_table
+
+        out = conformance_tables.specs()
+        for n in op_smoke_table.SMOKE_OPS:
+            out.setdefault(n, {"kind": "smoke", "grad": False})
+        return out
+    finally:
+        sys.path.pop(0)
+
+
+def _tested_by(name, sources):
+    """Test files that invoke the op (call syntax `name(` or exact quoted
+    name — tighter than the old bare-substring heuristic)."""
+    pat = re.compile(
+        rf"(?:\b{re.escape(name)}\s*\(|\.{re.escape(name)}\b"
+        rf"|[\"']{re.escape(name)}[\"'])")
+    return [f for f, src in sources.items() if pat.search(src)]
 
 
 def generate():
@@ -161,30 +190,59 @@ def generate():
     tensor_api = reference_tensor_api()
     yaml_ops = reference_yaml_ops()
     all_names = sorted(set(tensor_api) | set(yaml_ops))
-    tests_blob = _tested_names()
+    sources = _test_sources()
+    conf_specs = _conformance_specs()
 
     entries = []
     for name in all_names:
         where = _resolve(name)
         internal = name in INTERNAL_OPS and name not in tensor_api
+        conf = conf_specs.get(name)
+        if conf is None and name.endswith("_") \
+                and (conf_specs.get(name[:-1]) or {}).get("kind") in (
+                    "unary", "binary", "comparison", "int_binary",
+                    "int_unary") \
+                and where is not None:
+            # inplace twin of a sweep-covered base op: executed by
+            # test_op_conformance.py::test_inplace_variant_matches_outofplace
+            conf = {"kind": "inplace", "grad": False,
+                    "base": name[:-1]}
+        tested_by = _tested_by(name, sources)
         entries.append({
             "name": name,
             "present": where is not None,
             "where": where,
             "internal": internal,
             "tensor_method": hasattr(P.Tensor, name),
-            "tested": bool(re.search(rf"\b{re.escape(name)}\b", tests_blob)),
+            # ops.yaml-parity metadata (VERDICT r2 task 7):
+            # conformance: sweep kind + whether its numeric-grad check runs
+            "conformance": conf,
+            # grad: "checked" only when the sweep actually grad-checks it
+            "grad": "checked" if conf and conf.get("grad") else None,
+            # inplace: the reference's inplace-map bit — `<name>_` resolves
+            "inplace": _resolve(name + "_") is not None,
+            # spmd: jnp-backed ops shard via XLA/GSPMD propagation (the
+            # build's spmd rule registry IS the compiler)
+            "spmd": "xla-propagation" if where is not None else None,
+            "tested_by": tested_by,
             "sources": [s for s, names in (("tensor_api", tensor_api),
                                            ("phi_yaml", yaml_ops))
                         if name in names],
         })
     counted = [e for e in entries if not e["internal"]]
     present = sum(e["present"] for e in counted)
+    # enforcement: a present op with neither a conformance entry nor any
+    # test invoking it is UNPROVEN — regeneration fails on it (task 7
+    # "present => conformance-tested is machine-true")
+    unproven = sorted(
+        e["name"] for e in counted
+        if e["present"] and not e["conformance"] and not e["tested_by"])
     manifest = {
         "total": len(counted),
         "internal": len(entries) - len(counted),
         "present": present,
         "coverage_pct": round(100.0 * present / max(1, len(counted)), 1),
+        "unproven": unproven,
         "ops": entries,
     }
     return manifest
@@ -193,6 +251,12 @@ def generate():
 def main():
     out_path = os.path.join(REPO, "OPS_MANIFEST.json")
     manifest = generate()
+    if manifest["unproven"]:
+        print(f"UNPROVEN present ops (no conformance entry, no test "
+              f"invokes them): {manifest['unproven']}")
+        print("add a conformance_tables.py spec or a test before "
+              "regenerating the manifest")
+        return 1
     if "--check" in sys.argv:
         with open(out_path) as f:
             old = json.load(f)
