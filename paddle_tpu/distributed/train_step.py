@@ -152,10 +152,19 @@ class DistributedTrainStep:
                         n: (v.astype(amp_dtype)
                             if jnp.issubdtype(v.dtype, jnp.floating) else v)
                         for n, v in params.items()}
+                def _amp_in(b):
+                    # O2 semantics: floating model inputs enter in the
+                    # compute dtype (conv/matmul operands must agree)
+                    if amp_dtype is not None and \
+                            jnp.issubdtype(b.dtype, jnp.floating):
+                        return b.astype(amp_dtype)
+                    return b
+
                 with flags.trace_guard():
                     with model.bind_state(run_params, buffers) as (np_, nb_):
                         args = jax.tree_util.tree_unflatten(
-                            batch_treedef, [Tensor(b) for b in batch_leaves])
+                            batch_treedef,
+                            [Tensor(_amp_in(b)) for b in batch_leaves])
                         if loss_fn is not None:
                             inputs, labels = args
                             out = model(inputs)

@@ -210,3 +210,40 @@ def test_jit_save_load_roundtrip(tmp_path):
     P.jit.save(m, str(tmp_path / "net"), input_spec=[x._value])
     loaded = P.jit.load(str(tmp_path / "net"))
     np.testing.assert_allclose(loaded(x).numpy(), m(x).numpy(), rtol=1e-6)
+
+
+def test_amp_train_step_casts_float_inputs():
+    """bf16 AMP train step with float32 image inputs: the step must cast
+    floating batch leaves to the compute dtype (conv operands must agree
+    — regression for the f32-input/bf16-weight conv mismatch)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import Conv2D, CrossEntropyLoss, Flatten, Linear
+    from paddle_tpu import nn as pnn
+
+    class Tiny(pnn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = Conv2D(3, 4, 3)
+            self.flat = Flatten()
+            self.fc = Linear(4 * 6 * 6, 5)
+
+        def forward(self, x):
+            return self.fc(self.flat(self.conv(x)))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(Tiny())
+    opt = fleet.distributed_optimizer(
+        P.optimizer.SGD(parameters=model.parameters(), learning_rate=1e-2))
+    step = model.build_train_step(opt, CrossEntropyLoss(),
+                                  amp_dtype="bfloat16")
+    imgs = P.to_tensor(np.random.RandomState(0)
+                       .randn(2, 3, 8, 8).astype(np.float32))
+    lbl = P.to_tensor(np.array([1, 3]), "int32")
+    l1 = float(np.asarray(step(imgs, lbl)._value))
+    l2 = float(np.asarray(step(imgs, lbl)._value))
+    assert np.isfinite(l1) and np.isfinite(l2)
