@@ -118,22 +118,22 @@ class download:
 
 
 class cpp_extension:
-    """Custom-op build gate (parity: `paddle.utils.cpp_extension`). The
-    TPU-native extension path is a C library + ctypes (see
-    `paddle_tpu/native/_build.py`); pybind11-style JIT extensions are
-    gated off."""
+    """Runtime custom-op registration (parity: `paddle.utils.cpp_extension`
+    + `custom_operator.cc`). `load` compiles user C++ with g++ (ctypes C
+    ABI — pybind11 is not in this image) and registers each exported
+    kernel as a paddle op that runs eagerly AND under jit (host callback
+    via `jax.pure_callback`), with autodiff when a gradient symbol is
+    provided. See `paddle_tpu.native.custom_op` for the ABI contract."""
 
     @staticmethod
     def load(name, sources, **kwargs):
-        from ..native import _build
+        from ..native import custom_op
 
-        raise NotImplementedError(
-            "use paddle_tpu.native._build to compile C extensions (ctypes "
-            "ABI); pybind11 JIT extensions are not available in this image")
+        return custom_op.load(name, sources, **kwargs)
 
     class CppExtension:
-        def __init__(self, *a, **kw):
-            raise NotImplementedError("see cpp_extension.load")
+        def __init__(self, sources=None, *a, **kw):
+            self.sources = sources or []
 
     CUDAExtension = CppExtension
 
