@@ -1,0 +1,159 @@
+"""HTTP inference server over the AOT predictor.
+
+Role parity: the reference's deployment tier around `AnalysisPredictor`
+(`paddle/fluid/inference/api/` + the C/Go serving surfaces and Paddle
+Serving). TPU-first: the model is a saved `jit.save` export (compiled
+once at load); the server is a thin host loop — request decode, one
+compiled call, response encode — because XLA owns all scheduling.
+
+Protocol (stdlib-only, zero heavy deps):
+  POST /predict   body = .npz archive (numpy savez) with one array per
+                  model input, keyed by feed name (or arr_0.. in feed
+                  order); response = .npz with one array per fetch name.
+  GET  /health    -> {"status": "ok", "inputs": [...], "outputs": [...]}
+
+Client helper: `InferenceClient` wraps the same protocol.
+"""
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from . import Config, create_predictor
+
+__all__ = ["InferenceServer", "InferenceClient", "serve"]
+
+
+class InferenceServer:
+    """Serve one predictor. `start()` returns immediately (daemon thread);
+    `serve_forever()` blocks. Concurrent requests serialize around the
+    predictor (one device queue) via a lock."""
+
+    def __init__(self, model_path: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        cfg = Config(model_path)
+        self._predictor = create_predictor(cfg)
+        self._plock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/health":
+                    return self._json(404, {"error": "unknown path"})
+                p = server._predictor
+                self._json(200, {
+                    "status": "ok",
+                    "inputs": p.get_input_names(),
+                    "outputs": p.get_output_names(),
+                })
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    return self._json(404, {"error": "unknown path"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n)
+                    with np.load(io.BytesIO(raw)) as z:
+                        arrays = {k: z[k] for k in z.files}
+                    outs = server.predict(arrays)
+                    buf = io.BytesIO()
+                    np.savez(buf, **outs)
+                    body = buf.getvalue()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:
+                    self._json(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = None
+
+    @property
+    def address(self):
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def predict(self, arrays: dict) -> dict:
+        p = self._predictor
+        feed_order = p.get_input_names()
+        if set(arrays) >= set(feed_order):
+            inputs = [arrays[n] for n in feed_order]
+        else:  # positional arr_0, arr_1, ... (np.savez default keys)
+            inputs = [arrays[k] for k in sorted(arrays)]
+        with self._plock:
+            outs = p.run(inputs)
+        return {n: np.asarray(v)
+                for n, v in zip(p.get_output_names(), outs)}
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="paddle-tpu-serving")
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._httpd.serve_forever()
+
+    def shutdown(self):
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class InferenceClient:
+    def __init__(self, address: str):
+        self.address = address.rstrip("/")
+
+    def health(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self.address + "/health",
+                                    timeout=30) as r:
+            return json.loads(r.read())
+
+    def predict(self, *arrays, **named) -> dict:
+        import urllib.request
+
+        buf = io.BytesIO()
+        if named:
+            np.savez(buf, **named)
+        else:
+            np.savez(buf, *arrays)
+        req = urllib.request.Request(
+            self.address + "/predict", data=buf.getvalue(),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            with np.load(io.BytesIO(r.read())) as z:
+                return {k: z[k] for k in z.files}
+
+
+def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866):
+    """Blocking entry point: `python -m paddle_tpu.inference.serving`."""
+    srv = InferenceServer(model_path, host, port)
+    print(f"serving {model_path} at {srv.address}")
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    import sys
+
+    serve(sys.argv[1], *(sys.argv[2:] or []))

@@ -247,3 +247,34 @@ def test_amp_train_step_casts_float_inputs():
     l1 = float(np.asarray(step(imgs, lbl)._value))
     l2 = float(np.asarray(step(imgs, lbl)._value))
     assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_inference_http_serving(tmp_path):
+    """Inference serving tier (reference deployment surface role): save
+    an inference model, serve it over HTTP, predict via the client."""
+    from paddle_tpu import static
+    from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+
+    P.enable_static()
+    try:
+        x = static.data("x", [-1, 4], "float32")
+        lin = nn.Linear(4, 3)
+        out = nn.functional.softmax(lin(x))
+        exe = static.Executor()
+        prefix = str(tmp_path / "served")
+        static.save_inference_model(prefix, [x], [out], exe)
+        xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        (ref,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    finally:
+        P.disable_static()
+
+    srv = InferenceServer(prefix, port=0).start()
+    try:
+        client = InferenceClient(srv.address)
+        h = client.health()
+        assert h["status"] == "ok" and h["inputs"] == ["x"]
+        outs = client.predict(x=xv)
+        (got,) = outs.values()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        srv.shutdown()
