@@ -1,0 +1,222 @@
+"""Minimal numpy evaluator for the ONNX subset this framework emits.
+
+Purpose: (a) CI verifies exported models numerically without an
+onnxruntime wheel (this image has none); (b) users get
+`paddle_tpu.onnx.run_reference(path, inputs)` to sanity-check an export
+before shipping it to a real ONNX runtime. This is NOT a general ONNX
+runtime — it implements exactly the ops `_jaxpr_export.py` can produce
+and raises loudly on anything else.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import _schema
+
+_NP_DTYPE = {
+    _schema.FLOAT: np.float32,
+    _schema.DOUBLE: np.float64,
+    _schema.FLOAT16: np.float16,
+    _schema.INT32: np.int32,
+    _schema.INT64: np.int64,
+    _schema.INT8: np.int8,
+    _schema.UINT8: np.uint8,
+    _schema.BOOL: np.bool_,
+}
+
+
+def _tensor_to_np(t):
+    dt = _NP_DTYPE[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dt).reshape(tuple(t.dims)).copy()
+    if t.data_type == _schema.FLOAT:
+        return np.asarray(t.float_data, dt).reshape(tuple(t.dims))
+    if t.data_type in (_schema.INT64,):
+        return np.asarray(t.int64_data, dt).reshape(tuple(t.dims))
+    return np.asarray(t.int32_data, dt).reshape(tuple(t.dims))
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:
+            out[a.name] = a.f
+        elif a.type == 2:
+            out[a.name] = a.i
+        elif a.type == 3:
+            out[a.name] = a.s.decode()
+        elif a.type == 6:
+            out[a.name] = list(a.floats)
+        elif a.type == 7:
+            out[a.name] = list(a.ints)
+        else:
+            raise NotImplementedError(f"attr type {a.type}")
+    return out
+
+
+def _conv2d(x, w, b=None, *, strides, pads, group=1, dilations=None):
+    n, cin, h, wdt = x.shape
+    cout, cink, kh, kw = w.shape
+    dh, dw = (dilations or [1, 1])
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    eh = (kh - 1) * dh + 1
+    ew = (kw - 1) * dw + 1
+    oh = (xp.shape[2] - eh) // strides[0] + 1
+    ow = (xp.shape[3] - ew) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    cpg_in = cin // group
+    cpg_out = cout // group
+    for g in range(group):
+        xs = xp[:, g * cpg_in:(g + 1) * cpg_in]
+        ws = w[g * cpg_out:(g + 1) * cpg_out]
+        for i in range(oh):
+            for j in range(ow):
+                patch = xs[:, :, i * strides[0]:i * strides[0] + eh:dh,
+                           j * strides[1]:j * strides[1] + ew:dw]
+                out[:, g * cpg_out:(g + 1) * cpg_out, i, j] = np.einsum(
+                    "nchw,ochw->no", patch, ws)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def _maxpool(x, *, kernel_shape, strides, pads):
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+                constant_values=-np.inf)
+    kh, kw = kernel_shape
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    out = np.full((x.shape[0], x.shape[1], oh, ow), -np.inf, x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out[:, :, i, j] = xp[:, :, i * strides[0]:i * strides[0] + kh,
+                                 j * strides[1]:j * strides[1] + kw
+                                 ].max((2, 3))
+    return out
+
+
+_ERF = np.vectorize(math.erf)
+
+
+def run_model(model, inputs: dict) -> dict:
+    """Evaluate a ModelProto emitted by `_jaxpr_export` on numpy inputs."""
+    g = model.graph
+    env = dict(inputs)
+    for init in g.initializer:
+        env[init.name] = _tensor_to_np(init)
+    for vi in g.input:
+        if vi.name not in env:
+            raise ValueError(f"missing input {vi.name}")
+
+    def A(i):
+        return env[node.input[i]]
+
+    for node in g.node:
+        a = _attrs(node)
+        op = node.op_type
+        if op == "Identity":
+            r = A(0)
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow", "Mod"):
+            f = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                 "Div": np.divide, "Pow": np.power, "Mod": np.mod}[op]
+            r = f(A(0), A(1))
+        elif op in ("Max", "Min"):
+            r = (np.maximum if op == "Max" else np.minimum)(A(0), A(1))
+        elif op in ("Equal", "Less", "LessOrEqual", "Greater",
+                    "GreaterOrEqual"):
+            f = {"Equal": np.equal, "Less": np.less,
+                 "LessOrEqual": np.less_equal, "Greater": np.greater,
+                 "GreaterOrEqual": np.greater_equal}[op]
+            r = f(A(0), A(1))
+        elif op in ("And", "Or", "Xor"):
+            f = {"And": np.logical_and, "Or": np.logical_or,
+                 "Xor": np.logical_xor}[op]
+            r = f(A(0), A(1))
+        elif op == "Not":
+            r = np.logical_not(A(0))
+        elif op in ("Exp", "Log", "Tanh", "Abs", "Neg", "Sign", "Floor",
+                    "Ceil", "Round", "Sqrt", "Sin", "Cos", "Tan", "Asin",
+                    "Acos", "Atan", "Sinh", "Cosh", "Reciprocal"):
+            f = {"Exp": np.exp, "Log": np.log, "Tanh": np.tanh,
+                 "Abs": np.abs, "Neg": np.negative, "Sign": np.sign,
+                 "Floor": np.floor, "Ceil": np.ceil, "Round": np.round,
+                 "Sqrt": np.sqrt, "Sin": np.sin, "Cos": np.cos,
+                 "Tan": np.tan, "Asin": np.arcsin, "Acos": np.arccos,
+                 "Atan": np.arctan, "Sinh": np.sinh, "Cosh": np.cosh,
+                 "Reciprocal": np.reciprocal}[op]
+            r = f(A(0))
+        elif op == "Sigmoid":
+            r = 1.0 / (1.0 + np.exp(-A(0)))
+        elif op == "Erf":
+            r = _ERF(A(0)).astype(A(0).dtype)
+        elif op == "Einsum":
+            r = np.einsum(a["equation"], *[A(i)
+                                           for i in range(len(node.input))])
+        elif op == "Reshape":
+            r = A(0).reshape(tuple(int(x) for x in A(1)))
+        elif op == "Transpose":
+            r = np.transpose(A(0), a["perm"])
+        elif op == "Expand":
+            r = np.broadcast_to(A(0), tuple(int(x) for x in A(1))).copy()
+        elif op == "ReduceSum":
+            axes = tuple(int(x) for x in A(1))
+            r = A(0).sum(axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin", "ReduceProd"):
+            f = {"ReduceMax": np.max, "ReduceMin": np.min,
+                 "ReduceProd": np.prod}[op]
+            r = f(A(0), axis=tuple(a["axes"]),
+                  keepdims=bool(a.get("keepdims", 1)))
+        elif op == "Conv":
+            bias = A(2) if len(node.input) > 2 else None
+            r = _conv2d(A(0), A(1), bias, strides=a["strides"],
+                        pads=a["pads"], group=a.get("group", 1),
+                        dilations=a.get("dilations"))
+        elif op == "MaxPool":
+            r = _maxpool(A(0), kernel_shape=a["kernel_shape"],
+                         strides=a["strides"], pads=a["pads"])
+        elif op == "Where":
+            r = np.where(A(0), A(1), A(2))
+        elif op == "Cast":
+            r = A(0).astype(_NP_DTYPE[a["to"]])
+        elif op == "Concat":
+            r = np.concatenate([A(i) for i in range(len(node.input))],
+                               axis=a["axis"])
+        elif op == "Slice":
+            starts = [int(x) for x in A(1)]
+            ends = [int(x) for x in A(2)]
+            axes = [int(x) for x in A(3)]
+            steps = ([int(x) for x in A(4)]
+                     if len(node.input) > 4 else [1] * len(axes))
+            sl = [slice(None)] * A(0).ndim
+            for ax, st, en, sp in zip(axes, starts, ends, steps):
+                sl[ax] = slice(st, en, sp)
+            r = A(0)[tuple(sl)]
+        elif op == "Squeeze":
+            r = np.squeeze(A(0), axis=tuple(int(x) for x in A(1)))
+        elif op == "Pad":
+            pads = [int(x) for x in A(1)]
+            nd = A(0).ndim
+            val = float(A(2)) if len(node.input) > 2 else 0.0
+            width = [(pads[i], pads[nd + i]) for i in range(nd)]
+            r = np.pad(A(0), width, constant_values=val)
+        else:
+            raise NotImplementedError(f"reference runtime: op {op}")
+        env[node.output[0]] = r
+    return {vo.name: env[vo.name] for vo in g.output}
+
+
+def load_model(path):
+    C = _schema.classes()
+    m = C["ModelProto"]()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    return m
+
+
+def run_reference(path, inputs: dict) -> dict:
+    """Load a saved .onnx file and evaluate it with the numpy evaluator."""
+    return run_model(load_model(path), inputs)

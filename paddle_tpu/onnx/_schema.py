@@ -1,0 +1,161 @@
+"""Official ONNX protobuf schema (FileDescriptorProto), vendored.
+
+Provenance: extracted from the protobuf descriptor embedded in this
+image's `torch/lib/libtorch_cpu.so` (PyTorch's bundled copy of the
+official `onnx/onnx-ml.proto`, package-renamed `onnx_torch` by PyTorch's
+build; field numbers and wire format are IDENTICAL to upstream ONNX, so
+files serialized with these classes are standard .onnx files). Verified
+against the official field numbering (ModelProto.graph=7,
+GraphProto.node=1/initializer=5/input=11/output=12, NodeProto.op_type=4,
+TensorProto.raw_data=9, AttributeProto.type=20, DataType FLOAT=1
+INT64=7 BFLOAT16=16) at extraction time.
+
+Why vendored: this image has google.protobuf but no `onnx` wheel, and no
+network egress to fetch one — the descriptor IS the schema, so runtime
+message classes are built from it directly.
+"""
+import base64 as _b64
+
+_SCHEMA_B64 = (
+    "Ch1vbm54L29ubnhfb25ueF90b3JjaC1tbC5wcm90bxIKb25ueF90b3JjaCKRBgoOQXR0cmlidXRl"
+    "UHJvdG8SDAoEbmFtZRgBIAEoCRIVCg1yZWZfYXR0cl9uYW1lGBUgASgJEhIKCmRvY19zdHJpbmcY"
+    "DSABKAkSNgoEdHlwZRgUIAEoDjIoLm9ubnhfdG9yY2guQXR0cmlidXRlUHJvdG8uQXR0cmlidXRl"
+    "VHlwZRIJCgFmGAIgASgCEgkKAWkYAyABKAMSCQoBcxgEIAEoDBIiCgF0GAUgASgLMhcub25ueF90"
+    "b3JjaC5UZW5zb3JQcm90bxIhCgFnGAYgASgLMhYub25ueF90b3JjaC5HcmFwaFByb3RvEjQKDXNw"
+    "YXJzZV90ZW5zb3IYFiABKAsyHS5vbm54X3RvcmNoLlNwYXJzZVRlbnNvclByb3RvEiEKAnRwGA4g"
+    "ASgLMhUub25ueF90b3JjaC5UeXBlUHJvdG8SDgoGZmxvYXRzGAcgAygCEgwKBGludHMYCCADKAMS"
+    "DwoHc3RyaW5ncxgJIAMoDBIoCgd0ZW5zb3JzGAogAygLMhcub25ueF90b3JjaC5UZW5zb3JQcm90"
+    "bxImCgZncmFwaHMYCyADKAsyFi5vbm54X3RvcmNoLkdyYXBoUHJvdG8SNQoOc3BhcnNlX3RlbnNv"
+    "cnMYFyADKAsyHS5vbm54X3RvcmNoLlNwYXJzZVRlbnNvclByb3RvEioKC3R5cGVfcHJvdG9zGA8g"
+    "AygLMhUub25ueF90b3JjaC5UeXBlUHJvdG8i2QEKDUF0dHJpYnV0ZVR5cGUSDQoJVU5ERUZJTkVE"
+    "EAASCQoFRkxPQVQQARIHCgNJTlQQAhIKCgZTVFJJTkcQAxIKCgZURU5TT1IQBBIJCgVHUkFQSBAF"
+    "EhEKDVNQQVJTRV9URU5TT1IQCxIOCgpUWVBFX1BST1RPEA0SCgoGRkxPQVRTEAYSCAoESU5UUxAH"
+    "EgsKB1NUUklOR1MQCBILCgdURU5TT1JTEAkSCgoGR1JBUEhTEAoSEgoOU1BBUlNFX1RFTlNPUlMQ"
+    "DBIPCgtUWVBFX1BST1RPUxAOSgQIDBANSgQIEBAUUgF2IpMBCg5WYWx1ZUluZm9Qcm90bxIMCgRu"
+    "YW1lGAEgASgJEiMKBHR5cGUYAiABKAsyFS5vbm54X3RvcmNoLlR5cGVQcm90bxISCgpkb2Nfc3Ry"
+    "aW5nGAMgASgJEjoKDm1ldGFkYXRhX3Byb3BzGAQgAygLMiIub25ueF90b3JjaC5TdHJpbmdTdHJp"
+    "bmdFbnRyeVByb3RvIrMCCglOb2RlUHJvdG8SDQoFaW5wdXQYASADKAkSDgoGb3V0cHV0GAIgAygJ"
+    "EgwKBG5hbWUYAyABKAkSDwoHb3BfdHlwZRgEIAEoCRIOCgZkb21haW4YByABKAkSEAoIb3Zlcmxv"
+    "YWQYCCABKAkSLQoJYXR0cmlidXRlGAUgAygLMhoub25ueF90b3JjaC5BdHRyaWJ1dGVQcm90bxIS"
+    "Cgpkb2Nfc3RyaW5nGAYgASgJEjoKDm1ldGFkYXRhX3Byb3BzGAkgAygLMiIub25ueF90b3JjaC5T"
+    "dHJpbmdTdHJpbmdFbnRyeVByb3RvEkcKFWRldmljZV9jb25maWd1cmF0aW9ucxgKIAMoCzIoLm9u"
+    "bnhfdG9yY2guTm9kZURldmljZUNvbmZpZ3VyYXRpb25Qcm90byIyChRJbnRJbnRMaXN0RW50cnlQ"
+    "cm90bxILCgNrZXkYASABKAMSDQoFdmFsdWUYAiADKAMihgEKHE5vZGVEZXZpY2VDb25maWd1cmF0"
+    "aW9uUHJvdG8SGAoQY29uZmlndXJhdGlvbl9pZBgBIAEoCRI0Cg1zaGFyZGluZ19zcGVjGAIgAygL"
+    "Mh0ub25ueF90b3JjaC5TaGFyZGluZ1NwZWNQcm90bxIWCg5waXBlbGluZV9zdGFnZRgDIAEoBSKv"
+    "AQoRU2hhcmRpbmdTcGVjUHJvdG8SEwoLdGVuc29yX25hbWUYASABKAkSDgoGZGV2aWNlGAIgAygD"
+    "EkMKGWluZGV4X3RvX2RldmljZV9ncm91cF9tYXAYAyADKAsyIC5vbm54X3RvcmNoLkludEludExp"
+    "c3RFbnRyeVByb3RvEjAKC3NoYXJkZWRfZGltGAQgAygLMhsub25ueF90b3JjaC5TaGFyZGVkRGlt"
+    "UHJvdG8iWwoPU2hhcmRlZERpbVByb3RvEgwKBGF4aXMYASABKAMSOgoPc2ltcGxlX3NoYXJkaW5n"
+    "GAIgAygLMiEub25ueF90b3JjaC5TaW1wbGVTaGFyZGVkRGltUHJvdG8iXAoVU2ltcGxlU2hhcmRl"
+    "ZERpbVByb3RvEhMKCWRpbV92YWx1ZRgBIAEoA0gAEhMKCWRpbV9wYXJhbRgCIAEoCUgAEhIKCm51"
+    "bV9zaGFyZHMYAyABKANCBQoDZGltIu4BChFUcmFpbmluZ0luZm9Qcm90bxIuCg5pbml0aWFsaXph"
+    "dGlvbhgBIAEoCzIWLm9ubnhfdG9yY2guR3JhcGhQcm90bxIpCglhbGdvcml0aG0YAiABKAsyFi5v"
+    "bm54X3RvcmNoLkdyYXBoUHJvdG8SQgoWaW5pdGlhbGl6YXRpb25fYmluZGluZxgDIAMoCzIiLm9u"
+    "bnhfdG9yY2guU3RyaW5nU3RyaW5nRW50cnlQcm90bxI6Cg51cGRhdGVfYmluZGluZxgEIAMoCzIi"
+    "Lm9ubnhfdG9yY2guU3RyaW5nU3RyaW5nRW50cnlQcm90byLGAwoKTW9kZWxQcm90bxISCgppcl92"
+    "ZXJzaW9uGAEgASgDEjQKDG9wc2V0X2ltcG9ydBgIIAMoCzIeLm9ubnhfdG9yY2guT3BlcmF0b3JT"
+    "ZXRJZFByb3RvEhUKDXByb2R1Y2VyX25hbWUYAiABKAkSGAoQcHJvZHVjZXJfdmVyc2lvbhgDIAEo"
+    "CRIOCgZkb21haW4YBCABKAkSFQoNbW9kZWxfdmVyc2lvbhgFIAEoAxISCgpkb2Nfc3RyaW5nGAYg"
+    "ASgJEiUKBWdyYXBoGAcgASgLMhYub25ueF90b3JjaC5HcmFwaFByb3RvEjoKDm1ldGFkYXRhX3By"
+    "b3BzGA4gAygLMiIub25ueF90b3JjaC5TdHJpbmdTdHJpbmdFbnRyeVByb3RvEjQKDXRyYWluaW5n"
+    "X2luZm8YFCADKAsyHS5vbm54X3RvcmNoLlRyYWluaW5nSW5mb1Byb3RvEiwKCWZ1bmN0aW9ucxgZ"
+    "IAMoCzIZLm9ubnhfdG9yY2guRnVuY3Rpb25Qcm90bxI7Cg1jb25maWd1cmF0aW9uGBogAygLMiQu"
+    "b25ueF90b3JjaC5EZXZpY2VDb25maWd1cmF0aW9uUHJvdG8iTQoYRGV2aWNlQ29uZmlndXJhdGlv"
+    "blByb3RvEgwKBG5hbWUYASABKAkSEwoLbnVtX2RldmljZXMYAiABKAUSDgoGZGV2aWNlGAMgAygJ"
+    "IjQKFlN0cmluZ1N0cmluZ0VudHJ5UHJvdG8SCwoDa2V5GAEgASgJEg0KBXZhbHVlGAIgASgJInEK"
+    "EFRlbnNvckFubm90YXRpb24SEwoLdGVuc29yX25hbWUYASABKAkSSAoccXVhbnRfcGFyYW1ldGVy"
+    "X3RlbnNvcl9uYW1lcxgCIAMoCzIiLm9ubnhfdG9yY2guU3RyaW5nU3RyaW5nRW50cnlQcm90byKE"
+    "BAoKR3JhcGhQcm90bxIjCgRub2RlGAEgAygLMhUub25ueF90b3JjaC5Ob2RlUHJvdG8SDAoEbmFt"
+    "ZRgCIAEoCRIsCgtpbml0aWFsaXplchgFIAMoCzIXLm9ubnhfdG9yY2guVGVuc29yUHJvdG8SOQoS"
+    "c3BhcnNlX2luaXRpYWxpemVyGA8gAygLMh0ub25ueF90b3JjaC5TcGFyc2VUZW5zb3JQcm90bxIS"
+    "Cgpkb2Nfc3RyaW5nGAogASgJEikKBWlucHV0GAsgAygLMhoub25ueF90b3JjaC5WYWx1ZUluZm9Q"
+    "cm90bxIqCgZvdXRwdXQYDCADKAsyGi5vbm54X3RvcmNoLlZhbHVlSW5mb1Byb3RvEi4KCnZhbHVl"
+    "X2luZm8YDSADKAsyGi5vbm54X3RvcmNoLlZhbHVlSW5mb1Byb3RvEj0KF3F1YW50aXphdGlvbl9h"
+    "bm5vdGF0aW9uGA4gAygLMhwub25ueF90b3JjaC5UZW5zb3JBbm5vdGF0aW9uEjoKDm1ldGFkYXRh"
+    "X3Byb3BzGBAgAygLMiIub25ueF90b3JjaC5TdHJpbmdTdHJpbmdFbnRyeVByb3RvSgQIAxAESgQI"
+    "BBAFSgQIBhAKUgppcl92ZXJzaW9uUhBwcm9kdWNlcl92ZXJzaW9uUgxwcm9kdWNlcl90YWdSBmRv"
+    "bWFpbiL1BgoLVGVuc29yUHJvdG8SDAoEZGltcxgBIAMoAxIRCglkYXRhX3R5cGUYAiABKAUSMAoH"
+    "c2VnbWVudBgDIAEoCzIfLm9ubnhfdG9yY2guVGVuc29yUHJvdG8uU2VnbWVudBIWCgpmbG9hdF9k"
+    "YXRhGAQgAygCQgIQARIWCgppbnQzMl9kYXRhGAUgAygFQgIQARITCgtzdHJpbmdfZGF0YRgGIAMo"
+    "DBIWCgppbnQ2NF9kYXRhGAcgAygDQgIQARIMCgRuYW1lGAggASgJEhIKCmRvY19zdHJpbmcYDCAB"
+    "KAkSEAoIcmF3X2RhdGEYCSABKAwSOQoNZXh0ZXJuYWxfZGF0YRgNIAMoCzIiLm9ubnhfdG9yY2gu"
+    "U3RyaW5nU3RyaW5nRW50cnlQcm90bxI7Cg1kYXRhX2xvY2F0aW9uGA4gASgOMiQub25ueF90b3Jj"
+    "aC5UZW5zb3JQcm90by5EYXRhTG9jYXRpb24SFwoLZG91YmxlX2RhdGEYCiADKAFCAhABEhcKC3Vp"
+    "bnQ2NF9kYXRhGAsgAygEQgIQARI6Cg5tZXRhZGF0YV9wcm9wcxgQIAMoCzIiLm9ubnhfdG9yY2gu"
+    "U3RyaW5nU3RyaW5nRW50cnlQcm90bxolCgdTZWdtZW50Eg0KBWJlZ2luGAEgASgDEgsKA2VuZBgC"
+    "IAEoAyLJAgoIRGF0YVR5cGUSDQoJVU5ERUZJTkVEEAASCQoFRkxPQVQQARIJCgVVSU5UOBACEggK"
+    "BElOVDgQAxIKCgZVSU5UMTYQBBIJCgVJTlQxNhAFEgkKBUlOVDMyEAYSCQoFSU5UNjQQBxIKCgZT"
+    "VFJJTkcQCBIICgRCT09MEAkSCwoHRkxPQVQxNhAKEgoKBkRPVUJMRRALEgoKBlVJTlQzMhAMEgoK"
+    "BlVJTlQ2NBANEg0KCUNPTVBMRVg2NBAOEg4KCkNPTVBMRVgxMjgQDxIMCghCRkxPQVQxNhAQEhAK"
+    "DEZMT0FUOEU0TTNGThAREhIKDkZMT0FUOEU0TTNGTlVaEBISDgoKRkxPQVQ4RTVNMhATEhIKDkZM"
+    "T0FUOEU1TTJGTlVaEBQSCQoFVUlOVDQQFRIICgRJTlQ0EBYSDgoKRkxPQVQ0RTJNMRAXIikKDERh"
+    "dGFMb2NhdGlvbhILCgdERUZBVUxUEAASDAoIRVhURVJOQUwQASJ0ChFTcGFyc2VUZW5zb3JQcm90"
+    "bxInCgZ2YWx1ZXMYASABKAsyFy5vbm54X3RvcmNoLlRlbnNvclByb3RvEigKB2luZGljZXMYAiAB"
+    "KAsyFy5vbm54X3RvcmNoLlRlbnNvclByb3RvEgwKBGRpbXMYAyADKAMimwEKEFRlbnNvclNoYXBl"
+    "UHJvdG8SMwoDZGltGAEgAygLMiYub25ueF90b3JjaC5UZW5zb3JTaGFwZVByb3RvLkRpbWVuc2lv"
+    "bhpSCglEaW1lbnNpb24SEwoJZGltX3ZhbHVlGAEgASgDSAASEwoJZGltX3BhcmFtGAIgASgJSAAS"
+    "EgoKZGVub3RhdGlvbhgDIAEoCUIHCgV2YWx1ZSLnBQoJVHlwZVByb3RvEjMKC3RlbnNvcl90eXBl"
+    "GAEgASgLMhwub25ueF90b3JjaC5UeXBlUHJvdG8uVGVuc29ySAASNwoNc2VxdWVuY2VfdHlwZRgE"
+    "IAEoCzIeLm9ubnhfdG9yY2guVHlwZVByb3RvLlNlcXVlbmNlSAASLQoIbWFwX3R5cGUYBSABKAsy"
+    "GS5vbm54X3RvcmNoLlR5cGVQcm90by5NYXBIABI3Cg1vcHRpb25hbF90eXBlGAkgASgLMh4ub25u"
+    "eF90b3JjaC5UeXBlUHJvdG8uT3B0aW9uYWxIABJAChJzcGFyc2VfdGVuc29yX3R5cGUYCCABKAsy"
+    "Ii5vbm54X3RvcmNoLlR5cGVQcm90by5TcGFyc2VUZW5zb3JIABIzCgtvcGFxdWVfdHlwZRgHIAEo"
+    "CzIcLm9ubnhfdG9yY2guVHlwZVByb3RvLk9wYXF1ZUgAEhIKCmRlbm90YXRpb24YBiABKAkaSAoG"
+    "VGVuc29yEhEKCWVsZW1fdHlwZRgBIAEoBRIrCgVzaGFwZRgCIAEoCzIcLm9ubnhfdG9yY2guVGVu"
+    "c29yU2hhcGVQcm90bxo0CghTZXF1ZW5jZRIoCgllbGVtX3R5cGUYASABKAsyFS5vbm54X3RvcmNo"
+    "LlR5cGVQcm90bxpCCgNNYXASEAoIa2V5X3R5cGUYASABKAUSKQoKdmFsdWVfdHlwZRgCIAEoCzIV"
+    "Lm9ubnhfdG9yY2guVHlwZVByb3RvGjQKCE9wdGlvbmFsEigKCWVsZW1fdHlwZRgBIAEoCzIVLm9u"
+    "bnhfdG9yY2guVHlwZVByb3RvGk4KDFNwYXJzZVRlbnNvchIRCgllbGVtX3R5cGUYASABKAUSKwoF"
+    "c2hhcGUYAiABKAsyHC5vbm54X3RvcmNoLlRlbnNvclNoYXBlUHJvdG8aJgoGT3BhcXVlEg4KBmRv"
+    "bWFpbhgBIAEoCRIMCgRuYW1lGAIgASgJQgcKBXZhbHVlIjUKEk9wZXJhdG9yU2V0SWRQcm90bxIO"
+    "CgZkb21haW4YASABKAkSDwoHdmVyc2lvbhgCIAEoAyKkAwoNRnVuY3Rpb25Qcm90bxIMCgRuYW1l"
+    "GAEgASgJEg0KBWlucHV0GAQgAygJEg4KBm91dHB1dBgFIAMoCRIRCglhdHRyaWJ1dGUYBiADKAkS"
+    "MwoPYXR0cmlidXRlX3Byb3RvGAsgAygLMhoub25ueF90b3JjaC5BdHRyaWJ1dGVQcm90bxIjCgRu"
+    "b2RlGAcgAygLMhUub25ueF90b3JjaC5Ob2RlUHJvdG8SEgoKZG9jX3N0cmluZxgIIAEoCRI0Cgxv"
+    "cHNldF9pbXBvcnQYCSADKAsyHi5vbm54X3RvcmNoLk9wZXJhdG9yU2V0SWRQcm90bxIOCgZkb21h"
+    "aW4YCiABKAkSEAoIb3ZlcmxvYWQYDSABKAkSLgoKdmFsdWVfaW5mbxgMIAMoCzIaLm9ubnhfdG9y"
+    "Y2guVmFsdWVJbmZvUHJvdG8SOgoObWV0YWRhdGFfcHJvcHMYDiADKAsyIi5vbm54X3RvcmNoLlN0"
+    "cmluZ1N0cmluZ0VudHJ5UHJvdG9KBAgCEANKBAgDEARSDXNpbmNlX3ZlcnNpb25SBnN0YXR1cyqx"
+    "AgoHVmVyc2lvbhISCg5fU1RBUlRfVkVSU0lPThAAEhkKFUlSX1ZFUlNJT05fMjAxN18xMF8xMBAB"
+    "EhkKFUlSX1ZFUlNJT05fMjAxN18xMF8zMBACEhgKFElSX1ZFUlNJT05fMjAxN18xMV8zEAMSGAoU"
+    "SVJfVkVSU0lPTl8yMDE5XzFfMjIQBBIYChRJUl9WRVJTSU9OXzIwMTlfM18xOBAFEhgKFElSX1ZF"
+    "UlNJT05fMjAxOV85XzE5EAYSFwoTSVJfVkVSU0lPTl8yMDIwXzVfOBAHEhgKFElSX1ZFUlNJT05f"
+    "MjAyMV83XzMwEAgSFwoTSVJfVkVSU0lPTl8yMDIzXzVfNRAJEhgKFElSX1ZFUlNJT05fMjAyNF8z"
+    "XzI1EAoSDgoKSVJfVkVSU0lPThALKi4KDk9wZXJhdG9yU3RhdHVzEhAKDEVYUEVSSU1FTlRBTBAA"
+    "EgoKBlNUQUJMRRAB"
+)
+
+_classes = None
+
+
+def classes():
+    """{message_name: class} for the ONNX schema (built once)."""
+    global _classes
+    if _classes is None:
+        from google.protobuf import (
+            descriptor_pb2, descriptor_pool, message_factory,
+        )
+
+        fd = descriptor_pb2.FileDescriptorProto()
+        fd.ParseFromString(_b64.b64decode(_SCHEMA_B64))
+        pool = descriptor_pool.DescriptorPool()
+        pool.Add(fd)
+        _classes = {}
+        for m in fd.message_type:
+            desc = pool.FindMessageTypeByName(f"{fd.package}.{m.name}")
+            _classes[m.name] = message_factory.GetMessageClass(desc)
+    return _classes
+
+
+# TensorProto.DataType values (verified against the descriptor)
+FLOAT = 1
+UINT8 = 2
+INT8 = 3
+INT32 = 6
+INT64 = 7
+STRING = 8
+BOOL = 9
+FLOAT16 = 10
+DOUBLE = 11
+BFLOAT16 = 16
