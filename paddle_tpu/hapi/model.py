@@ -28,15 +28,54 @@ class Model:
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
+        # AMP (reference Model.prepare amp_configs): "O1"/"O2" or a dict
+        # {"level": ..., "dtype": ...}; O2 decorates params to the compute
+        # dtype, O1 autocasts per-op inside train/eval_batch
+        self._amp_level = "O0"
+        self._amp_dtype = "bfloat16"
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                self._amp_level = amp_configs.get("level", "O1")
+                self._amp_dtype = amp_configs.get("dtype", "bfloat16")
+            if self._amp_level == "O2":
+                import paddle_tpu as P
+
+                self.network, self._optimizer = P.amp.decorate(
+                    self.network, self._optimizer, level="O2",
+                    dtype=self._amp_dtype)
+        # distributed fit: with an initialized dp>1 hybrid topology the
+        # network is wrapped so backward syncs grads across dp ranks
+        # (reference: hapi Model under paddle.DataParallel)
+        try:
+            from ..distributed import topology as _topo
+
+            topo = _topo._topology  # only an ALREADY-initialized topology
+            if topo is not None and getattr(topo, 'dp_degree', 1) > 1:
+                from ..distributed.parallel import DataParallel
+
+                if not isinstance(self.network, DataParallel):
+                    self.network = DataParallel(self.network)
+        except Exception:
+            pass
         return self
 
     # --- single steps --------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        import paddle_tpu as P
+
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        outs = self.network(*inputs)
-        losses = self._compute_loss(outs, labels)
+        if getattr(self, "_amp_level", "O0") in ("O1", "O2"):
+            with P.amp.auto_cast(level=self._amp_level,
+                                 dtype=self._amp_dtype):
+                outs = self.network(*inputs)
+                losses = self._compute_loss(outs, labels)
+        else:
+            outs = self.network(*inputs)
+            losses = self._compute_loss(outs, labels)
         total = losses[0]
         for l in losses[1:]:
             total = total + l
@@ -110,26 +149,38 @@ class Model:
         })
         cbks.on_begin("train")
         it = 0
+        done = False
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
             cbks.on_epoch_begin(epoch)
+            pending = 0
             for step, batch in enumerate(train_loader):
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                losses, metrics = self.train_batch(inputs, labels)
+                # gradient accumulation (reference accumulate_grad_batches):
+                # grads add up across micro-batches; step every k-th
+                pending += 1
+                update = pending % max(1, accumulate_grad_batches) == 0
+                losses, metrics = self.train_batch(inputs, labels,
+                                                   update=update)
                 logs = {"loss": losses, **metrics, "step": step}
                 cbks.on_train_batch_end(step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:
+                    done = True
                     break
+            if pending % max(1, accumulate_grad_batches) != 0:
+                # flush the tail micro-batches
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             cbks.on_epoch_end(epoch, logs if "logs" in dir() else {})
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_loader, callbacks=callbacks,
                               verbose=verbose)
             if save_dir is not None and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/{epoch}")
-            if self.stop_training:
+            if self.stop_training or done:
                 break
         cbks.on_end("train")
 
@@ -140,18 +191,26 @@ class Model:
         loader = DataLoader(eval_data, batch_size=batch_size,
                             num_workers=num_workers) \
             if isinstance(eval_data, Dataset) else eval_data
+        cbks = CallbackList(_to_list(callbacks) or [])
+        cbks.set_model(self)
+        cbks.on_begin("eval")
         for m in self._metrics:
             m.reset()
         total_loss = 0.0
         n = 0
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
             inputs, labels = self._split_batch(batch)
             losses, _ = self.eval_batch(inputs, labels)
             total_loss += sum(losses)
             n += 1
+            cbks.on_eval_batch_end(step, {"loss": losses})
+            if num_samples is not None and n * batch_size >= num_samples:
+                break
         res = {"loss": total_loss / max(1, n)}
         for m in self._metrics:
             res[m.name()] = m.accumulate()
+        cbks.on_end("eval", res)
         return res
 
     def predict(self, test_data, batch_size=1, num_workers=0,

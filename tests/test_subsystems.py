@@ -278,3 +278,49 @@ def test_inference_http_serving(tmp_path):
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
     finally:
         srv.shutdown()
+
+
+def test_hapi_fit_amp_and_accumulation(tmp_path):
+    """prepare(amp_configs=...) and accumulate_grad_batches are honored
+    (previously silent no-op args)."""
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.vision.datasets import FakeData
+
+    P.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(48, 10))
+    model = Model(net)
+    model.prepare(
+        optimizer=P.optimizer.SGD(parameters=net.parameters(),
+                                  learning_rate=1e-2),
+        loss=nn.CrossEntropyLoss(),
+        amp_configs={"level": "O1", "dtype": "bfloat16"})
+    assert model._amp_level == "O1"
+    data = FakeData(size=32, image_shape=(3, 4, 4), num_classes=10)
+    model.fit(data, batch_size=8, epochs=1, verbose=0,
+              accumulate_grad_batches=2)
+    res = model.evaluate(data, batch_size=8)
+    assert np.isfinite(res["loss"])
+
+
+def test_hapi_fit_data_parallel():
+    """With a dp>1 topology initialized, prepare() wraps the network in
+    DataParallel so fit syncs grads across dp ranks."""
+    from paddle_tpu.distributed.parallel import DataParallel
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.vision.datasets import FakeData
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(48, 10))
+    model = Model(net)
+    model.prepare(
+        optimizer=P.optimizer.SGD(parameters=net.parameters(),
+                                  learning_rate=1e-2),
+        loss=nn.CrossEntropyLoss())
+    assert isinstance(model.network, DataParallel)
+    data = FakeData(size=16, image_shape=(3, 4, 4), num_classes=10)
+    model.fit(data, batch_size=8, epochs=1, verbose=0)
