@@ -1441,21 +1441,41 @@ def _yolo_box():
 
 @_op("yolo_loss")
 def _yolo_loss():
-    # documented gate: the fused CUDA loss kernel has no TPU counterpart;
-    # the composed-op path is the supported way. The gate must stay LOUD.
-    x = rs.rand(1, 18, 4, 4).astype(F32)
-    gt = np.array([[[0.5, 0.5, 0.3, 0.3]]], F32)
-    lbl = np.array([[0]], np.int32)
-    try:
-        P.vision.ops.yolo_loss(
-            T(x), T(gt), T(lbl), anchors=[10, 13, 16, 30, 33, 23],
-            anchor_mask=[0, 1, 2], class_num=1, ignore_thresh=0.7,
-            downsample_ratio=8)
-    except NotImplementedError as e:
-        assert "compose" in str(e) or "TPU" in str(e)
-    else:
-        raise AssertionError("yolo_loss gate silently disappeared — "
-                             "add a real conformance check")
+    # real composed implementation: finite per-image loss, grads flow,
+    # and a matching prediction scores lower than a mismatched one
+    rs2 = np.random.RandomState(5)
+    anchors = [10, 14, 23, 27, 37, 58]
+    gt = np.array([[[0.5, 0.5, 0.2, 0.2]]], F32)
+    lbl = np.array([[1]], np.int32)
+
+    def head(obj_logit, correct_cls):
+        x = np.zeros((1, 3 * 7, 4, 4), F32)
+        v = x.reshape(1, 3, 7, 4, 4)
+        v[:, :, 4] = -8.0                   # everything background...
+        a_best = 0  # 0.2*32=6.4px -> anchor (10,14) has best wh-IoU
+        v[0, a_best, 4, 2, 2] = obj_logit   # ...except the gt cell
+        v[0, a_best, 5 + (1 if correct_cls else 0), 2, 2] = 6.0
+        return v.reshape(1, 21, 4, 4)
+
+    def loss_of(arr):
+        out = P.vision.ops.yolo_loss(
+            T(arr), T(gt), T(lbl), anchors=anchors, anchor_mask=[0, 1, 2],
+            class_num=2, ignore_thresh=0.7, downsample_ratio=8)
+        return np.asarray(out.numpy())
+
+    good = loss_of(head(6.0, True))
+    bad = loss_of(head(-8.0, False))
+    assert good.shape == (1,)
+    assert np.isfinite(good).all() and np.isfinite(bad).all()
+    assert good[0] < bad[0]
+
+    # grads flow through the head
+    t = P.to_tensor(head(0.0, True), stop_gradient=False)
+    P.vision.ops.yolo_loss(
+        t, T(gt), T(lbl), anchors=anchors, anchor_mask=[0, 1, 2],
+        class_num=2, ignore_thresh=0.7, downsample_ratio=8).sum().backward()
+    g = np.asarray(t.grad.numpy())
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
 
 
 @_op("psroi_pool")
