@@ -1,0 +1,108 @@
+"""One-shot TPU perf experiment sweep (run on the real chip).
+
+Usage: python tools/tpu_experiments.py [--quick]
+Prints a markdown table of step times for the GPT-125M bench config
+under different knobs (flash blocks, pallas on/off, batch size), using
+the chained-fetch slope timing from PERF.md. Paste results into PERF.md.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def step_time(batch=32, seq=1024, iters=8, flags_overrides=None,
+              blocks=None):
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.core import flags as F
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    if flags_overrides:
+        F.set_flags(flags_overrides)
+    if blocks is not None:
+        from paddle_tpu.ops.pallas import flash_attention as fa
+
+        fa.DEFAULT_BLOCK_Q, fa.DEFAULT_BLOCK_K = blocks
+        F.set_flags({"FLAGS_use_autotune": False})
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=seq)
+    P.seed(0)
+    model = fleet.distributed_model(GPTForCausalLM(cfg))
+    opt = fleet.distributed_optimizer(
+        P.optimizer.AdamW(parameters=model.parameters(),
+                          learning_rate=1e-4))
+    crit = GPTPretrainingCriterion()
+    step = model.build_train_step(opt, crit, amp_dtype="bfloat16")
+    rs = np.random.RandomState(0)
+    ids = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+    labels = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                         "int32")
+    loss = step(ids, labels)
+    float(np.asarray(loss._value))
+    loss = step(ids, labels)
+    float(np.asarray(loss._value))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    final = float(np.asarray(loss._value))
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(final), final
+    tps = batch * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    mfu = tps * 6 * n_params / 197e12
+    return dt * 1e3, tps, mfu
+
+
+def run_in_subprocess(desc, **kw):
+    """Each config in a fresh process: flags/caches/donated state clean."""
+    import json
+    import subprocess
+
+    code = (
+        "import sys; sys.path.insert(0, '.');"
+        "from tools.tpu_experiments import step_time; import json;"
+        f"r = step_time(**{kw!r}); print('RESULT ' + json.dumps(r))"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=1500)
+    for line in reversed(r.stdout.splitlines()):
+        if line.startswith("RESULT "):
+            ms, tps, mfu = json.loads(line[len("RESULT "):])
+            print(f"| {desc} | {ms:.0f} | {tps:,.0f} | {mfu*100:.1f}% |")
+            return mfu
+    print(f"| {desc} | FAILED: {r.stderr.strip().splitlines()[-1][:90] if r.stderr else '?'} | | |")
+    return None
+
+
+def main():
+    quick = "--quick" in sys.argv
+    print("| config | ms/step | tokens/s | MFU |")
+    print("|---|---|---|---|")
+    run_in_subprocess("baseline b32 (autotuned blocks)")
+    if not quick:
+        for bq, bk in [(128, 128), (256, 256), (512, 512), (256, 512),
+                       (512, 1024), (1024, 1024)]:
+            run_in_subprocess(f"blocks {bq}x{bk}", blocks=(bq, bk))
+    run_in_subprocess("jnp attention (flash off)",
+                      flags_overrides={"FLAGS_disable_pallas_flash": True})
+    run_in_subprocess("batch 16", batch=16)
+    run_in_subprocess("batch 64", batch=64)
+
+
+if __name__ == "__main__":
+    main()
