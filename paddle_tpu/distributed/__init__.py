@@ -1,5 +1,6 @@
 """paddle.distributed parity surface, TPU-native (SURVEY §2.2, §2.5)."""
 from . import fleet  # noqa: F401
+from .engine import Engine  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn, get_mesh,
     reshard, set_mesh, shard_layer, shard_optimizer, shard_tensor,

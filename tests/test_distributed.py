@@ -180,3 +180,47 @@ def test_graft_entry():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 2
     mod.dryrun_multichip(8)
+
+
+def test_auto_parallel_engine_plans_and_fits():
+    """Static auto-parallel Engine (engine.py role): the cost-model
+    planner picks a feasible (dp, mp, pp) factorization of the mesh and
+    the compiled step trains under it."""
+    import jax
+
+    from paddle_tpu.distributed.engine import Engine, plan
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    topology.reset_topology()
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32)
+    model = GPTForCausalLM(cfg)
+
+    cands = plan(model, n_devices=8, global_batch=8, seq_len=32)
+    assert cands, "planner returned nothing"
+    best = cands[0]
+    assert best.dp * best.mp * best.pp == 8
+    assert best.est_time_s > 0 and best.est_mem_bytes > 0
+    # ranked best-first by the cost model
+    times = [c.est_time_s for c in cands]
+    assert times == sorted(times)
+
+    eng = Engine(model=model, loss=GPTPretrainingCriterion(),
+                 optimizer=P.optimizer.AdamW(
+                     parameters=model.parameters(), learning_rate=1e-3))
+    # pp>1 engines need the pipeline runner; force a pp=1 plan for the
+    # compiled-step smoke leg
+    forced = next(c for c in cands if c.pp == 1)
+    eng.strategy = forced.as_strategy()
+    eng.prepare(global_batch=8, seq_len=32)
+    rs = np.random.RandomState(0)
+    ids = P.to_tensor(rs.randint(0, 256, (8, 32)), "int32")
+    labels = P.to_tensor(rs.randint(0, 256, (8, 32)), "int32")
+    losses = []
+    for _ in range(3):
+        loss = eng._step(ids, labels)
+        losses.append(float(np.asarray(loss._value)))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
