@@ -117,3 +117,41 @@ def test_vision_zoo_smoke(ctor, img):
                     .astype(np.float32))
     out = m(x)
     assert out.shape == [1, 10]
+
+
+def test_fused_chunked_ce_matches_plain():
+    """The chunked online-logsumexp CE must match F.cross_entropy in value
+    AND gradient (it is the default GPT loss for large vocabs)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import _chunked_softmax_ce
+    import paddle_tpu.nn.functional as F
+
+    rs = np.random.RandomState(4)
+    n, v = 64, 9001  # odd vocab: exercises padding
+    logits = rs.randn(n, v).astype(np.float32)
+    labels = rs.randint(0, v, (n,)).astype(np.int32)
+    labels[:5] = -100  # ignore_index tokens
+
+    def fused(lg):
+        total, count = _chunked_softmax_ce(lg, jnp.asarray(labels), -100)
+        return total / count
+
+    def plain(lg):
+        return F.cross_entropy(
+            P.Tensor(lg), P.Tensor(jnp.asarray(labels)),
+            reduction="mean", ignore_index=-100)._value
+
+    import jax
+
+    f_val, f_grad = jax.value_and_grad(fused)(jnp.asarray(logits))
+    p_val, p_grad = jax.value_and_grad(plain)(jnp.asarray(logits))
+    np.testing.assert_allclose(float(f_val), float(p_val), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(f_grad), np.asarray(p_grad),
+                               rtol=1e-4, atol=1e-6)
+
+    # bf16 logits leg (the dtype the GPT head actually produces)
+    lb = jnp.asarray(logits, jnp.bfloat16)
+    fb = jax.value_and_grad(fused)(lb)
+    assert np.isfinite(float(fb[0]))
+    assert fb[1].dtype == jnp.bfloat16
