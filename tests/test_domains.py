@@ -130,3 +130,56 @@ def test_spectrogram_and_mfcc_shapes():
 def test_text_dataset_stub_raises():
     with pytest.raises(RuntimeError):
         text.datasets.Imdb()
+
+
+def test_incubate_fused_layer_zoo():
+    """incubate.nn fused Layers (fused_transformer.py role): construct,
+    forward, backward; pre-LN and post-LN variants."""
+    from paddle_tpu.incubate.nn import (
+        FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedEcMoe,
+        FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
+        FusedMultiTransformer, FusedTransformerEncoderLayer,
+    )
+
+    P.seed(0)
+    rs = np.random.RandomState(0)
+    x = P.to_tensor(rs.randn(2, 8, 16).astype(np.float32))
+
+    lin = FusedLinear(16, 24)
+    assert lin(x).shape == [2, 8, 24]
+
+    da = FusedDropoutAdd(p=0.0)
+    np.testing.assert_allclose(np.asarray(da(x, x).numpy()),
+                               2 * np.asarray(x.numpy()), rtol=1e-6)
+
+    bdr = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+    out = bdr(x, x)
+    assert out.shape == [2, 8, 16]
+    # layer-normalized output: ~zero mean, ~unit variance per row
+    v = np.asarray(out.numpy())
+    np.testing.assert_allclose(v.mean(-1), 0.0, atol=1e-4)
+
+    for pre in (True, False):
+        mha = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0,
+                                      normalize_before=pre)
+        assert mha(x).shape == [2, 8, 16]
+
+        ffn = FusedFeedForward(16, 32, dropout_rate=0.0,
+                               normalize_before=pre)
+        assert ffn(x).shape == [2, 8, 16]
+
+    enc = FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    t = P.to_tensor(rs.randn(2, 8, 16).astype(np.float32),
+                    stop_gradient=False)
+    out = enc(t)
+    out.sum().backward()
+    assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
+
+    mt = FusedMultiTransformer(16, 4, 32, num_layers=2)
+    assert mt(x).shape == [2, 8, 16]
+
+    moe = FusedEcMoe(16, 32, num_experts=4)
+    out = moe(x)
+    assert out.shape == [2, 8, 16]
+    assert np.isfinite(out.numpy()).all()
