@@ -73,9 +73,10 @@ def test_onnx_export_stablehlo(tmp_path):
     import os
 
     assert os.path.exists(p)
-    with pytest.raises(NotImplementedError):
-        onnx.export(m, str(tmp_path / "m2"), input_spec=[
-            static.InputSpec([1, 4], "float32")], format="onnx")
+    # format="onnx" is now REAL emission (tests/test_onnx_export.py)
+    p2 = onnx.export(m, str(tmp_path / "m2"), input_spec=[
+        static.InputSpec([1, 4], "float32")], format="onnx")
+    assert os.path.exists(p2) and p2.endswith(".onnx")
 
 
 def test_hub_local(tmp_path):
