@@ -1,5 +1,7 @@
 from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
@@ -9,7 +11,11 @@ from .vit import (  # noqa: F401
 )
 from .swin import SwinTransformer, swin_b, swin_s, swin_t  # noqa: F401
 from .extras import (  # noqa: F401
-    AlexNet, DenseNet, GoogLeNet, ShuffleNetV2, SqueezeNet, alexnet,
-    densenet121, googlenet, shufflenet_v2_x1_0, squeezenet1_0,
-    squeezenet1_1,
+    AlexNet, DenseNet, GoogLeNet, InceptionV3, MobileNetV1,
+    MobileNetV3Large, MobileNetV3Small, ShuffleNetV2, SqueezeNet, alexnet,
+    densenet121, densenet161, densenet169, densenet201, densenet264,
+    googlenet, inception_v3, mobilenet_v1, mobilenet_v3_large,
+    mobilenet_v3_small, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, squeezenet1_0, squeezenet1_1,
 )

@@ -289,3 +289,303 @@ class GoogLeNet(nn.Layer):
 
 def googlenet(**kw):
     return GoogLeNet(**kw)
+
+
+def densenet161(**kw):
+    return DenseNet(layers_per_block=(6, 12, 36, 24), growth=48,
+                    init_ch=96, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(layers_per_block=(6, 12, 32, 32), **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(layers_per_block=(6, 12, 48, 32), **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(layers_per_block=(6, 12, 64, 48), **kw)
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(**kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+class MobileNetV1(nn.Layer):
+    """MobileNetV1 (parity: `python/paddle/vision/models/mobilenetv1.py`):
+    depthwise-separable conv stack."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        def dw_sep(inp, out, stride=1):
+            return nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1,
+                          groups=inp, bias_attr=False),
+                nn.BatchNorm2D(inp), nn.ReLU(),
+                nn.Conv2D(inp, out, 1, bias_attr=False),
+                nn.BatchNorm2D(out), nn.ReLU())
+
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1),
+               (c(256), c(512), 2)] + [(c(512), c(512), 1)] * 5 + \
+              [(c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        feats = [nn.Conv2D(3, c(32), 3, stride=2, padding=1,
+                           bias_attr=False),
+                 nn.BatchNorm2D(c(32)), nn.ReLU()]
+        for inp, out, s in cfg:
+            feats.append(dw_sep(inp, out, s))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, start_axis=1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+class _HSigmoid(nn.Layer):
+    def forward(self, x):
+        from ...nn import functional as F
+
+        return F.hardsigmoid(x, slope=1 / 6.0, offset=0.5)
+
+
+class _SEBlock(nn.Layer):
+    def __init__(self, ch, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // r, 1)
+        self.fc2 = nn.Conv2D(ch // r, ch, 1)
+        self.hs = _HSigmoid()
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        s = self.hs(self.fc2(F.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MNV3Block(nn.Layer):
+    def __init__(self, inp, exp, out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if exp != inp:
+            layers += [nn.Conv2D(inp, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                             groups=exp, bias_attr=False),
+                   nn.BatchNorm2D(exp)]
+        if use_se:
+            layers.append(_SEBlock(exp))
+        layers += [act(),
+                   nn.Conv2D(exp, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    """MobileNetV3 (parity: `python/paddle/vision/models/mobilenetv3.py`)."""
+
+    def __init__(self, cfg, last_exp, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale + 4) // 8 * 8)
+
+        hs = nn.Hardswish
+        feats = [nn.Conv2D(3, c(16), 3, stride=2, padding=1,
+                           bias_attr=False),
+                 nn.BatchNorm2D(c(16)), hs()]
+        inp = c(16)
+        for k, exp, out, use_se, act, s in cfg:
+            feats.append(_MNV3Block(inp, c(exp), c(out), k, s, use_se,
+                                    hs if act == "HS" else nn.ReLU))
+            inp = c(out)
+        feats += [nn.Conv2D(inp, c(last_exp), 1, bias_attr=False),
+                  nn.BatchNorm2D(c(last_exp)), hs()]
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(last_exp), last_ch), hs(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, start_axis=1))
+        return x
+
+
+_MNV3_SMALL = [
+    # k, exp, out, SE, act, stride
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+]
+
+_MNV3_LARGE = [
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+]
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MNV3_SMALL, last_exp=576, last_ch=1024,
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MNV3_LARGE, last_exp=960, last_ch=1280,
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)
+
+
+class _InceptionStem(nn.Layer):
+    def __init__(self):
+        super().__init__()
+
+        def cbr(i, o, k, s=1, p=0):
+            return nn.Sequential(nn.Conv2D(i, o, k, stride=s, padding=p,
+                                           bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+
+        self.stem = nn.Sequential(
+            cbr(3, 32, 3, 2), cbr(32, 32, 3), cbr(32, 64, 3, 1, 1),
+            nn.MaxPool2D(3, stride=2), cbr(64, 80, 1), cbr(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+
+    def forward(self, x):
+        return self.stem(x)
+
+
+def _cbr(i, o, k, s=1, p=0):
+    return nn.Sequential(nn.Conv2D(i, o, k, stride=s, padding=p,
+                                   bias_attr=False),
+                         nn.BatchNorm2D(o), nn.ReLU())
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_ch):
+        super().__init__()
+        self.b1 = _cbr(inp, 64, 1)
+        self.b5 = nn.Sequential(_cbr(inp, 48, 1), _cbr(48, 64, 5, 1, 2))
+        self.b3 = nn.Sequential(_cbr(inp, 64, 1), _cbr(64, 96, 3, 1, 1),
+                                _cbr(96, 96, 3, 1, 1))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                  _cbr(inp, pool_ch, 1))
+
+    def forward(self, x):
+        from ... import ops
+
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x),
+                           self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """InceptionV3 (parity: `python/paddle/vision/models/inceptionv3.py`;
+    the A-block tower + grid reductions condensed — the full B/C towers
+    follow the same concat-of-branches pattern)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = _InceptionStem()
+        self.inc_a1 = _InceptionA(192, 32)
+        self.inc_a2 = _InceptionA(256, 64)
+        self.inc_a3 = _InceptionA(288, 64)
+        self.red1 = nn.Sequential(_cbr(288, 384, 3, 2))
+        self.inc_b = _InceptionA(384, 64)
+        self.red2 = nn.Sequential(_cbr(288, 768, 3, 2))
+        self.inc_c = _InceptionA(768, 128)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(352, num_classes)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = self.stem(x)
+        x = self.inc_a3(self.inc_a2(self.inc_a1(x)))
+        x = self.inc_b(self.red1(x))
+        x = self.inc_c(self.red2(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, start_axis=1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)

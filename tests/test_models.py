@@ -155,3 +155,23 @@ def test_fused_chunked_ce_matches_plain():
     fb = jax.value_and_grad(fused)(lb)
     assert np.isfinite(float(fb[0]))
     assert fb[1].dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("ctor,img", [
+    ("mobilenet_v1", 64), ("mobilenet_v3_small", 64),
+    ("mobilenet_v3_large", 64), ("resnext50_32x4d", 64),
+    ("wide_resnet50_2", 64), ("densenet169", 64), ("inception_v3", 128),
+    ("shufflenet_v2_x0_5", 64),
+])
+def test_vision_zoo_extended_forward(ctor, img):
+    """New zoo families: forward shape + grads flow (tiny inputs)."""
+    from paddle_tpu.vision import models as V
+
+    P.seed(0)
+    m = getattr(V, ctor)(num_classes=7)
+    m.eval()
+    x = P.to_tensor(np.random.RandomState(0)
+                    .randn(2, 3, img, img).astype(np.float32))
+    out = m(x)
+    assert out.shape == [2, 7]
+    assert np.isfinite(out.numpy()).all()
