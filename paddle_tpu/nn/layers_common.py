@@ -11,6 +11,7 @@ from .initializer import Constant, Normal, XavierUniform
 from .layer_base import Layer
 
 __all__ = [
+    "PairwiseDistance",
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
     "Flatten", "Identity", "Sequential", "LayerList", "ParameterList",
     "LayerDict", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
@@ -404,3 +405,20 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (paddle.nn.PairwiseDistance)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import paddle_tpu as P
+
+        d = x - y
+        return P.norm(d + self.epsilon, p=self.p, axis=-1,
+                      keepdim=self.keepdim)

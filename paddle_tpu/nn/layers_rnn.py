@@ -13,7 +13,7 @@ from ..core.tensor import Tensor
 from .initializer import Uniform
 from .layer_base import Layer
 
-__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN", "SimpleRNN",
            "LSTM", "GRU"]
 
 
@@ -248,3 +248,23 @@ class LSTM(_MultiLayerRNN):
 
 class GRU(_MultiLayerRNN):
     MODE = "GRU"
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (paddle.nn.BiRNN): runs
+    `cell_fw` forward and `cell_bw` reversed over time, concatenating
+    outputs on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as P
+
+        fw_init, bw_init = (initial_states
+                            if initial_states is not None else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, fw_init, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_init, sequence_length)
+        return P.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,186 @@
+"""paddle.nn.utils parity (`python/paddle/nn/utils/`): gradient clipping
+helpers, parameter flattening, and weight/spectral-norm reparametrization
+hooks.
+
+TPU-first notes: clip helpers operate on eager `.grad` tensors (inside a
+compiled train step, clipping belongs to the step's own global-norm code,
+train_step.py); weight_norm/spectral_norm recompute the effective weight
+in a forward-pre-hook, so they trace straight into jit programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+    "vector_to_parameters", "weight_norm", "remove_weight_norm",
+    "spectral_norm",
+]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Clip eager grads in place by global norm; returns the total norm
+    (reference clip_grad_norm_.py)."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.asarray(0.0, jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack([
+            jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32))
+                    ** norm_type) for p in params])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"non-finite total norm {float(total)} in clip_grad_norm_")
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    for p in params:
+        p.grad._value = (p.grad._value.astype(jnp.float32)
+                         * scale).astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp eager grads elementwise to [-clip_value, clip_value]."""
+    for p in (parameters if isinstance(parameters, (list, tuple))
+              else [parameters]):
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one 1-D tensor (transform_parameters.py)."""
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Scatter a flat vector back into the parameters (in-place rebind)."""
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._rebind(Tensor(v[off:off + n].reshape(tuple(p.shape))
+                         .astype(p._value.dtype)))
+        off += n
+    if off != v.shape[0]:
+        raise ValueError(f"vector has {v.shape[0]} elements; parameters "
+                         f"need {off}")
+
+
+def _norm_except_dim(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize `layer.name` as g * v/||v|| (weight_norm_hook.py).
+    The effective weight is recomputed in a forward-pre-hook, so the
+    reparametrization traces into compiled programs."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # norm over everything
+    wv = w._value
+    if dim == -1:
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv.astype(jnp.float32))))
+        g0 = g0.reshape((1,) * wv.ndim)
+    else:
+        g0 = _norm_except_dim(wv, dim)
+    g = layer.create_parameter(list(g0.shape), dtype=str(wv.dtype))
+    g._rebind(Tensor(g0.astype(wv.dtype)))
+    v = layer.create_parameter(list(w.shape), dtype=str(wv.dtype))
+    v._rebind(Tensor(wv))
+    setattr(layer, name + "_g", g)
+    setattr(layer, name + "_v", v)
+    # the original becomes a derived (non-parameter) attribute
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        vv = getattr(lyr, name + "_v")._value
+        gg = getattr(lyr, name + "_g")._value.astype(jnp.float32)
+        if dim == -1:
+            nrm = jnp.sqrt(jnp.sum(jnp.square(vv.astype(jnp.float32))))
+        else:
+            nrm = _norm_except_dim(vv, dim)
+        eff = (vv.astype(jnp.float32) / jnp.maximum(nrm, 1e-12) * gg)
+        setattr(lyr, name, Tensor(eff.astype(vv.dtype)))
+        return None
+
+    hook(layer, None)  # materialize once immediately
+    helper = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (helper, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"no weight_norm hook on {name!r}")
+    helper, dim = hooks.pop(name)
+    helper.remove()
+    eff = getattr(layer, name)  # last materialized effective weight
+    w = layer.create_parameter(list(eff.shape), dtype=str(eff._value.dtype))
+    w._rebind(Tensor(eff._value))
+    setattr(layer, name, w)
+    for suffix in ("_g", "_v"):
+        pname = name + suffix
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+        if hasattr(layer, pname):
+            delattr(layer, pname)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization hook (spectral_norm_hook.py): divides the
+    weight by its largest singular value, estimated by power iteration
+    on host-held u/v buffers updated each forward."""
+    w = getattr(layer, name)
+    wv = w._value
+    if dim is None:
+        dim = 0
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = jnp.asarray(rng.randn(mat.shape[0]).astype(np.float32))
+    u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    layer._sn_u = u
+
+    v_param = layer.create_parameter(list(w.shape), dtype=str(wv.dtype))
+    v_param._rebind(Tensor(wv))
+    setattr(layer, name + "_orig", v_param)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        wv2 = getattr(lyr, name + "_orig")._value
+        m = jnp.moveaxis(wv2, dim, 0).reshape(wv2.shape[dim], -1) \
+            .astype(jnp.float32)
+        u_ = lyr._sn_u
+        for _ in range(n_power_iterations):
+            v_ = m.T @ u_
+            v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+            u_ = m @ v_
+            u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        from ...core import flags
+
+        if not flags.in_trace():
+            lyr._sn_u = u_  # persist the iterate only outside tracing
+        sigma = u_ @ (m @ v_)
+        eff = wv2.astype(jnp.float32) / jnp.maximum(sigma, eps)
+        setattr(lyr, name, Tensor(eff.astype(wv2.dtype)))
+        return None
+
+    hook(layer, None)
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -99,3 +99,91 @@ def test_sysconfig_paths():
     from paddle_tpu import sysconfig
 
     assert sysconfig.get_include().endswith("src")
+
+
+def test_nn_utils_clip_and_vector():
+    from paddle_tpu.nn.utils import (
+        clip_grad_norm_, clip_grad_value_, parameters_to_vector,
+        vector_to_parameters,
+    )
+    import paddle_tpu.nn as nn
+
+    P.seed(0)
+    lin = nn.Linear(4, 3)
+    x = P.to_tensor(np.ones((2, 4), np.float32))
+    (lin(x) * 100).sum().backward()
+    total = clip_grad_norm_(lin.parameters(), max_norm=1.0)
+    assert float(total.numpy()) > 1.0  # pre-clip norm was large
+    gnorm = np.sqrt(sum(float((p.grad.numpy() ** 2).sum())
+                        for p in lin.parameters()))
+    np.testing.assert_allclose(gnorm, 1.0, rtol=1e-4)
+
+    (lin(x) * 100).sum().backward()
+    clip_grad_value_(lin.parameters(), 0.5)
+    for p in lin.parameters():
+        assert np.abs(p.grad.numpy()).max() <= 0.5 + 1e-6
+
+    vec = parameters_to_vector(lin.parameters())
+    assert vec.shape == [4 * 3 + 3]
+    vector_to_parameters(vec * 0 + 1.0, lin.parameters())
+    for p in lin.parameters():
+        np.testing.assert_allclose(p.numpy(), 1.0)
+
+
+def test_nn_utils_weight_norm_roundtrip():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    import paddle_tpu.nn as nn
+
+    P.seed(3)
+    lin = nn.Linear(5, 4)
+    w0 = lin.weight.numpy().copy()
+    x = P.to_tensor(np.random.RandomState(1).randn(2, 5).astype(np.float32))
+    y0 = lin(x).numpy()
+    weight_norm(lin, "weight", dim=0)
+    assert hasattr(lin, "weight_g") and hasattr(lin, "weight_v")
+    # reparametrized forward must reproduce the original function
+    np.testing.assert_allclose(lin(x).numpy(), y0, rtol=1e-5, atol=1e-6)
+    # g/v are the trainable parameters now
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight_g" in names and "weight_v" in names
+    remove_weight_norm(lin, "weight")
+    np.testing.assert_allclose(lin(x).numpy(), y0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_nn_utils_spectral_norm():
+    from paddle_tpu.nn.utils import spectral_norm
+    import paddle_tpu.nn as nn
+
+    P.seed(4)
+    lin = nn.Linear(6, 6)
+    # give the weight a large known top singular value
+    w = np.random.RandomState(2).randn(6, 6).astype(np.float32) * 5
+    lin.weight.set_value(w)
+    spectral_norm(lin, "weight", n_power_iterations=5)
+    x = P.to_tensor(np.eye(6, dtype=np.float32))
+    lin(x)  # triggers the hook
+    eff = lin.weight.numpy()
+    s = np.linalg.svd(eff, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-2)
+
+
+def test_birnn_and_pairwise_distance():
+    import paddle_tpu.nn as nn
+
+    P.seed(0)
+    cell_fw = nn.GRUCell(4, 6)
+    cell_bw = nn.GRUCell(4, 6)
+    rnn = nn.BiRNN(cell_fw, cell_bw)
+    x = P.to_tensor(np.random.RandomState(0)
+                    .randn(2, 5, 4).astype(np.float32))
+    out, (st_f, st_b) = rnn(x)
+    assert out.shape == [2, 5, 12]
+
+    pd = nn.PairwiseDistance(p=2.0, epsilon=0.0)
+    a = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        pd(P.to_tensor(a), P.to_tensor(b)).numpy(),
+        np.linalg.norm(a - b, axis=-1), rtol=1e-5)
