@@ -1,5 +1,7 @@
 """paddle.incubate parity surface."""
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .distributed.models import moe  # noqa: F401
 from .distributed.models.moe import MoELayer  # noqa: F401

@@ -1,0 +1,143 @@
+"""incubate.optimizer parity (`python/paddle/incubate/optimizer/`):
+LookAhead, ModelAverage, DistributedFusedLamb.
+
+TPU-first: these are host-side weight post-processors around any inner
+optimizer — slow/averaged copies live as jax arrays and the blend math
+is a handful of fused elementwise programs, so there is nothing to port
+from the reference's fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Lamb, Optimizer
+
+__all__ = ["LookAhead", "ModelAverage", "DistributedFusedLamb"]
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper (incubate/optimizer/lookahead.py): the inner
+    optimizer updates fast weights every step; every `k` steps the slow
+    weights move alpha of the way toward the fast ones and the fast
+    weights reset to the slow copy."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        if self._slow is None:
+            self._slow = [jnp.asarray(p._value) for p in params]
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p, s in zip(params, self._slow):
+                new_slow = s + self.alpha * (p._value - s)
+                p._value = new_slow.astype(p._value.dtype)
+            self._slow = [jnp.asarray(p._value) for p in params]
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = {"inner": self.inner_optimizer.state_dict(),
+              "steps": self._steps}
+        if self._slow is not None:
+            sd["slow"] = [np.asarray(s) for s in self._slow]
+        return sd
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd.get("inner", {}))
+        self._steps = sd.get("steps", 0)
+        if "slow" in sd:
+            self._slow = [jnp.asarray(s) for s in sd["slow"]]
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage(Optimizer):
+    """Running parameter average (incubate/optimizer/modelaverage.py):
+    accumulates weights each step; `apply()` swaps the averaged weights
+    in for evaluation, `restore()` puts the live ones back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters or [])
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sum = [jnp.zeros_like(p._value) for p in self._parameter_list]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._count += 1
+        for i, p in enumerate(self._parameter_list):
+            self._sum[i] = self._sum[i] + p._value.astype(self._sum[i].dtype)
+        # bound the window (reference max_average_window behavior)
+        if self._count > self.max_w:
+            for i, p in enumerate(self._parameter_list):
+                self._sum[i] = self._sum[i] * (self.max_w /
+                                               float(self._count))
+            self._count = self.max_w
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = [jnp.asarray(p._value)
+                        for p in self._parameter_list]
+        for p, s in zip(self._parameter_list, self._sum):
+            p._value = (s / self._count).astype(p._value.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._parameter_list, self._backup):
+            p._value = b
+        self._backup = None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad() if hasattr(p, "clear_grad") else None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+
+class DistributedFusedLamb(Lamb):
+    """LAMB whose state sharding comes from the compiled train step
+    (reference `distributed_fused_lamb` fuses + shards in CUDA; here
+    ZeRO staging in `DistributedTrainStep` shards the moments over dp,
+    and XLA fuses the update — same capability, compiler-owned)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 alignment=128, use_master_param_norm=True, **kw):
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon,
+                         parameters=parameters, grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=
+                         exclude_from_weight_decay_fn)

@@ -183,3 +183,45 @@ def test_incubate_fused_layer_zoo():
     out = moe(x)
     assert out.shape == [2, 8, 16]
     assert np.isfinite(out.numpy()).all()
+
+
+def test_incubate_lookahead_and_model_average():
+    from paddle_tpu.incubate.optimizer import (
+        DistributedFusedLamb, LookAhead, ModelAverage,
+    )
+    import paddle_tpu.nn as nn
+
+    P.seed(0)
+    lin = nn.Linear(4, 1)
+    inner = P.optimizer.SGD(parameters=lin.parameters(), learning_rate=0.1)
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    rs = np.random.RandomState(0)
+    x = P.to_tensor(rs.randn(8, 4).astype(np.float32))
+    y = P.to_tensor(rs.randn(8, 1).astype(np.float32))
+    losses = []
+    for _ in range(6):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    sd = opt.state_dict()
+    assert "slow" in sd and sd["steps"] == 6
+
+    ma = ModelAverage(0.15, parameters=lin.parameters(),
+                      max_average_window=4)
+    w_live = lin.weight.numpy().copy()
+    for _ in range(3):
+        ma.step()
+    ma.apply()
+    np.testing.assert_allclose(lin.weight.numpy(), w_live, rtol=1e-5)
+    lin.weight.set_value(w_live * 0)  # averaged copy is active; mutate
+    ma.restore()
+    np.testing.assert_allclose(lin.weight.numpy(), w_live, rtol=1e-6)
+
+    fl = DistributedFusedLamb(parameters=lin.parameters())
+    loss = ((lin(x) - y) ** 2).mean()
+    loss.backward()
+    fl.step()
+    fl.clear_grad()
