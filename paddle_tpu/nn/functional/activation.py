@@ -224,3 +224,33 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
 @op("thresholded_relu")
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     return jnp.where(x > threshold, x, value)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    return x._rebind(hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._rebind(leaky_relu(x, negative_slope))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def tanh_(x, name=None):
+    from ... import ops
+
+    return x._rebind(ops.tanh(x))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._rebind(thresholded_relu(x, threshold, value))
+
+
+__all__ += ["elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+            "thresholded_relu_"]

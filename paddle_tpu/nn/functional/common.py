@@ -218,3 +218,97 @@ def class_center_sample(label, num_classes, num_samples, group=None):
 
 
 __all__ += ["class_center_sample"]
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm distance along the last axis (reference distance.py)."""
+    from ... import ops
+
+    return ops.norm(x - y + epsilon, p=p, axis=-1, keepdim=keepdim)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W of a 4-D tensor; padding = [left, right, top, bottom]
+    (reference zeropad2d)."""
+    from ... import ops
+
+    return ops.pad(x, padding, mode="constant", value=0.0,
+                   data_format=data_format)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    from ... import ops
+
+    return ops.temporal_shift(x, seg_num, shift_ratio, data_format)
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestor back-tracing (reference gather_tree):
+    ids/parents [T, B, W] -> full sequences following parent pointers
+    from the last step backward (lax.scan in reverse)."""
+    def f(iv, pv):
+        import jax
+
+        t, b, w = iv.shape
+        last_parent = jnp.broadcast_to(jnp.arange(w, dtype=pv.dtype),
+                                       (b, w))
+
+        def body(carry, xs):
+            step_ids, step_parents = xs
+            beam = carry  # [B, W] which beam to read at this step
+            out = jnp.take_along_axis(step_ids, beam, axis=1)
+            prev = jnp.take_along_axis(step_parents, beam, axis=1)
+            return prev, out
+
+        _, outs = jax.lax.scan(body, last_parent, (iv, pv), reverse=True)
+        return outs
+
+    return apply("gather_tree", f, ids, parents)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR sparsity pattern (reference
+    sparse_attention, CUDA-only there). Each query row attends only to
+    its CSR column set: columns are gathered per row, so compute is
+    O(nnz·d) — static shapes (the CSR layout is fixed per call).
+
+    query/key/value: [B, H, S, D]; offset: [B, H, S+1]; columns:
+    [B, H, nnz]. Rows' column counts may vary; positions beyond a row's
+    count are masked via the offset difference."""
+    def f(q, k, v, off, cols):
+        b, h, s, d = q.shape
+        nnz = cols.shape[-1]
+        counts = off[..., 1:] - off[..., :-1]           # [B, H, S]
+        # per row r: its columns live at cols[off[r]:off[r+1]] — build a
+        # [S, nnz] gather index with validity mask
+        row_start = off[..., :-1]                        # [B, H, S]
+        pos = jnp.arange(nnz)
+        idx = row_start[..., None] + pos                 # [B, H, S, nnz]
+        valid = pos < counts[..., None]
+        idx = jnp.clip(idx, 0, nnz - 1)
+        gathered_cols = jnp.take_along_axis(
+            cols[..., None, :].repeat(s, axis=-2), idx, axis=-1)
+        # gather k/v rows by advanced indexing per (b, h)
+        bi = jnp.arange(b)[:, None, None, None]
+        hi = jnp.arange(h)[None, :, None, None]
+        kg = k[bi, hi, gathered_cols]                    # [B,H,S,nnz,D]
+        vg = v[bi, hi, gathered_cols]
+        scale = 1.0 / (d ** 0.5)
+        logits = jnp.einsum("bhsd,bhsnd->bhsn",
+                            q.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+        logits = jnp.where(valid, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhsn,bhsnd->bhsd", probs,
+                         vg.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    return apply("sparse_attention", f, query, key, value,
+                 sparse_csr_offset, sparse_csr_columns)
+
+
+__all__ += ["pairwise_distance", "zeropad2d", "temporal_shift",
+            "gather_tree", "sparse_attention"]

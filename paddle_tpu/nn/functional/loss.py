@@ -454,3 +454,148 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
 
 
 __all__ += ["margin_cross_entropy", "rnnt_loss"]
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss (reference nn/functional/loss.py dice_loss): input
+    [N, ..., C] probabilities, label [N, ..., 1] class ids."""
+    def f(x, y):
+        import jax
+
+        c = x.shape[-1]
+        y1 = jax.nn.one_hot(y.reshape(y.shape[:-1]), c, dtype=x.dtype)
+        flat_x = x.reshape(x.shape[0], -1)
+        flat_y = y1.reshape(y1.shape[0], -1)
+        inter = jnp.sum(flat_x * flat_y, axis=1)
+        union = jnp.sum(flat_x, axis=1) + jnp.sum(flat_y, axis=1)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", f, input, label)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over the default complete binary tree
+    (reference hsigmoid_loss): num_classes leaves, num_classes-1 internal
+    nodes; each class's root-to-leaf path comes from its binary coding."""
+    import numpy as _np
+
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not wired; "
+            "the default complete-binary-tree path is supported")
+    depth = max(1, int(_np.ceil(_np.log2(max(num_classes, 2)))))
+    # per class: sequence of (node_index, code) top-down, padded
+    tables, codes, masks = [], [], []
+    for cls in range(num_classes):
+        node = cls + num_classes  # leaf id in heap order
+        path = []
+        while node > 1:
+            path.append((node // 2 - 1, node % 2))  # internal idx, code
+            node //= 2
+        path = path[::-1]
+        pad = depth - len(path)
+        tables.append([p[0] for p in path] + [0] * pad)
+        codes.append([p[1] for p in path] + [0] * pad)
+        masks.append([1.0] * len(path) + [0.0] * pad)
+    t = jnp.asarray(_np.asarray(tables, _np.int32))
+    c = jnp.asarray(_np.asarray(codes, _np.float32))
+    m = jnp.asarray(_np.asarray(masks, _np.float32))
+
+    def f(x, y, w, b):
+        yy = y.reshape(-1).astype(jnp.int32)
+        nodes = t[yy]                      # [N, depth]
+        code = c[yy]
+        mask = m[yy]
+        wn = w[nodes]                      # [N, depth, D]
+        logit = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
+                           wn.astype(jnp.float32))
+        if b is not None:
+            logit = logit + b.reshape(-1)[nodes]
+        # BCE with target = code
+        per = jnp.maximum(logit, 0) - logit * code + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        return jnp.mean(jnp.sum(per * mask, axis=1))
+
+    if bias is None:
+        return apply("hsigmoid_loss", lambda x, y, w: f(x, y, w, None),
+                     input, label, weight)
+    return apply("hsigmoid_loss", f, input, label, weight, bias)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Reference triplet_margin_with_distance_loss: arbitrary distance fn
+    (default p2 pairwise distance)."""
+    if distance_function is None:
+        def distance_function(a, b):
+            from ... import ops
+
+            return ops.norm(a - b, p=2, axis=-1)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ... import ops as _ops
+
+        d_neg = _ops.minimum(d_neg, d_pn)
+    loss = (d_pos - d_neg + margin).clip(min=0.0)
+    from ...core.dispatch import apply as _apply
+
+    return _apply("triplet_margin_with_distance_loss",
+                  lambda lv: _reduce(lv, reduction), loss)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Reference multi_margin_loss: hinge loss vs the true-class score."""
+    def f(x, y, w):
+        n, c = x.shape
+        yy = y.reshape(-1).astype(jnp.int32)
+        true = jnp.take_along_axis(x, yy[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - true + x) ** p
+        if w is not None:
+            m = m * w.reshape(-1)[yy][:, None]
+        m = m * (1 - jax_nn_one_hot(yy, c, x.dtype))
+        return jnp.sum(m, axis=1) / c
+
+    def jax_nn_one_hot(i, c, dt):
+        import jax
+
+        return jax.nn.one_hot(i, c, dtype=dt)
+
+    if weight is None:
+        return apply("multi_margin_loss",
+                     lambda x, y: _reduce(f(x, y, None), reduction),
+                     input, label)
+    return apply("multi_margin_loss",
+                 lambda x, y, w: _reduce(f(x, y, w), reduction),
+                 input, label, weight)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Reference gaussian_nll_loss: negative log likelihood of label
+    under N(input, variance)."""
+    def f(mu, y, var):
+        import math as _math
+
+        v = jnp.maximum(var.astype(jnp.float32), epsilon)
+        out = 0.5 * (jnp.log(v) +
+                     (y.astype(jnp.float32) - mu.astype(jnp.float32)) ** 2
+                     / v)
+        if full:
+            out = out + 0.5 * _math.log(2 * _math.pi)
+        return out
+
+    return apply("gaussian_nll_loss",
+                 lambda mu, y, var: _reduce(f(mu, y, var), reduction),
+                 input, label, variance)
+
+
+__all__ += ["dice_loss", "hsigmoid_loss",
+            "triplet_margin_with_distance_loss", "multi_margin_loss",
+            "gaussian_nll_loss"]
