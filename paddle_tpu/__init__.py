@@ -240,3 +240,153 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
 
 def tensor_method_grad_fix():  # pragma: no cover
     pass
+
+
+# ---- top-level surface completion (reference python/paddle/__init__.py) ----
+import jax.numpy as _jnp  # noqa: E402
+from .core import dtypes as _dtypes  # noqa: E402
+from .nn import ParamAttr  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+
+dtype = _jnp.dtype
+bool = _dtypes.convert_dtype("bool")  # paddle.bool dtype alias  # noqa: A001
+
+
+def get_cuda_rng_state():
+    """CUDA-namespace RNG parity: returns the framework generator state."""
+    from .core import rng as _rng
+
+    return [_rng.default_generator.get_state()]
+
+
+def set_cuda_rng_state(state):
+    from .core import rng as _rng
+
+    _rng.default_generator.set_state(state[0] if isinstance(state, (list,
+                                     tuple)) else state)
+
+
+class LazyGuard:
+    """Reference LazyGuard delays parameter materialization; jax arrays
+    are cheap eagerly, so the guard is a no-op context (documented)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    shape = list(x.shape)
+    return randint(low, high, shape=shape,
+                   dtype=dtype or str(x.dtype))
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (paddle.batch)."""
+    def _gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return _gen
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (upper triangle, flat)."""
+    from .core.dispatch import apply as _apply
+
+    def f(v):
+        n = v.shape[0]
+        d = v[:, None, :] - v[None, :, :]
+        if p == 2.0:
+            m = _jnp.sqrt(_jnp.sum(d * d, axis=-1) + 1e-30)
+        else:
+            m = _jnp.sum(_jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        iu = _jnp.triu_indices(n, k=1)
+        return m[iu]
+
+    return _apply("pdist", f, x)
+
+
+def column_stack(x, name=None):
+    from . import ops as _ops
+
+    cols = [t.reshape([-1, 1]) if len(t.shape) == 1 else t for t in x]
+    return _ops.concat(cols, axis=1)
+
+
+def row_stack(x, name=None):
+    from . import ops as _ops
+
+    return _ops.vstack(x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows over `axis` (paddle.unfold tensor op — distinct
+    from nn.functional.unfold's im2col)."""
+    from .core.dispatch import apply as _apply
+
+    def f(v):
+        length = v.shape[axis]
+        n_win = (length - size) // step + 1
+        idx = _jnp.arange(n_win)[:, None] * step + _jnp.arange(size)
+        taken = _jnp.take(v, idx.reshape(-1), axis=axis)
+        shp = list(v.shape)
+        new = shp[:axis] + [n_win, size] + shp[axis + 1:]
+        out = taken.reshape(new)
+        # paddle puts the window dim LAST
+        return _jnp.moveaxis(out, axis + 1, -1)
+
+    return _apply("unfold", f, x)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: the jax runtime installs no signal handlers to disable."""
+
+
+def check_shape(x):
+    return list(x.shape)
+
+
+# inplace twins missing from the generated set
+def expm1_(x, name=None):
+    from . import ops as _ops
+
+    return x._rebind(_ops.expm1(x))
+
+
+def square_(x, name=None):
+    from . import ops as _ops
+
+    return x._rebind(_ops.square(x))
+
+
+def erf_(x, name=None):
+    from . import ops as _ops
+
+    return x._rebind(_ops.erf(x))
