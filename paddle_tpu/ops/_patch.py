@@ -48,6 +48,34 @@ def patch_tensor():
                 continue
             setattr(Tensor, name, getattr(mod, name))
 
+    # remaining reference tensor_method_func names backed by other
+    # namespaces (signal/linalg) or free functions
+    from .. import signal as _signal
+    from . import linalg as _linalg_mod
+
+    if not hasattr(Tensor, "stft"):
+        Tensor.stft = _signal.stft
+    if not hasattr(Tensor, "istft"):
+        Tensor.istft = _signal.istft
+    if not hasattr(Tensor, "cond") and hasattr(_linalg_mod, "cond_number"):
+        Tensor.cond = _linalg_mod.cond_number
+    if not hasattr(Tensor, "unfold"):
+        def _t_unfold(self, axis, size, step, name=None):
+            import paddle_tpu as _P
+
+            return _P.unfold(self, axis, size, step)
+
+        Tensor.unfold = _t_unfold
+    if not hasattr(Tensor, "is_tensor"):
+        Tensor.is_tensor = lambda self: True
+    if not hasattr(Tensor, "add_n"):
+        def _t_add_n(self, inputs=None, name=None):
+            from . import add_n as _add_n
+
+            return _add_n([self] + list(inputs or []))
+
+        Tensor.add_n = _t_add_n
+
     # Paddle-style aliases
     Tensor.mm = linalg.matmul
     Tensor.pow = math.pow
