@@ -5,5 +5,32 @@ from ..core.flags import enable_grad_guard as enable_grad  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
 
-__all__ = ["backward", "grad", "no_grad", "enable_grad", "PyLayer",
+__all__ = ["saved_tensors_hooks", "backward", "grad", "no_grad", "enable_grad", "PyLayer",
            "PyLayerContext", "jacobian", "hessian", "vjp", "jvp"]
+
+
+
+class saved_tensors_hooks:
+    """Reference parity: `paddle.autograd.saved_tensors_hooks` lets users
+    pack/unpack activations saved for backward (CPU offload etc.).
+
+    TPU-first gate, documented and LOUD: on this runtime saved residuals
+    live inside XLA (jit) or jax-managed vjp closures (eager) — there is
+    no host-visible save point to intercept, and the memory lever the
+    reference hook serves is `recompute`/`jax.checkpoint` here. Entering
+    the context raises with that guidance rather than silently doing
+    nothing.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        raise NotImplementedError(
+            "saved_tensors_hooks cannot intercept XLA-managed residuals; "
+            "use paddle_tpu.distributed.recompute / jax.checkpoint for "
+            "activation-memory control")
+
+    def __exit__(self, *a):
+        return False

@@ -21,6 +21,7 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
 __all__ = [
+    "reindex_heter_graph",
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_max", "segment_min",
     "sample_neighbors", "reindex_graph", "weighted_sample_neighbors",
@@ -215,3 +216,37 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     dst = np.repeat(np.arange(len(xv)), cnt)
     nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
     return Tensor(reindex_nb), Tensor(dst), Tensor(nodes)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant of reindex_graph (paddle.geometric.
+    reindex_heter_graph): per-relation neighbor/count lists share one
+    node-id remapping."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x).ravel()
+    nbs = [np.asarray(n._value if isinstance(n, Tensor) else n).ravel()
+           for n in neighbors]
+    cnts = [np.asarray(c._value if isinstance(c, Tensor) else c)
+            for c in count]
+    mapping = {}
+    for nd in xv:
+        mapping.setdefault(int(nd), len(mapping))
+    outs = []
+    for nb in nbs:
+        loc = np.empty_like(nb)
+        for i, nd in enumerate(nb):
+            loc[i] = mapping.setdefault(int(nd), len(mapping))
+        outs.append(loc)
+    nodes = np.empty(len(mapping), dtype=xv.dtype)
+    for nd, i in mapping.items():
+        nodes[i] = nd
+    reindex_src = Tensor(jnp.asarray(np.concatenate(outs)
+                                     if outs else np.empty(0, xv.dtype)))
+    total = int(sum(int(c.sum()) for c in cnts))
+    dst = np.empty(total, dtype=xv.dtype)
+    off = 0
+    for cnt in cnts:
+        for i, c in enumerate(np.ravel(cnt)):
+            dst[off:off + int(c)] = i
+            off += int(c)
+    return reindex_src, Tensor(jnp.asarray(dst)), Tensor(jnp.asarray(nodes))

@@ -4,6 +4,8 @@ from .dataset import (  # noqa: F401
 )
 from .sampler import (  # noqa: F401
     BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
-    SequenceSampler, WeightedRandomSampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, get_worker_info,
+)

@@ -161,3 +161,19 @@ class DataLoader:
         if self.num_workers > 0:
             return self._iter_workers()
         return self._iter_single()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: its (id, num_workers, dataset);
+    None in the main process (paddle.io.get_worker_info)."""
+    return _worker_info

@@ -135,3 +135,19 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (paddle.io)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as np
+
+        for i in np.random.permutation(len(self.indices)):
+            yield self.indices[int(i)]
+
+    def __len__(self):
+        return len(self.indices)

@@ -139,3 +139,10 @@ class Auc(Metric):
             tot_pos, tot_neg = new_pos, new_neg
         denom = tot_pos * tot_neg
         return float(auc / denom) if denom else 0.0
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional accuracy (paddle.metric.accuracy) — top-k hit rate."""
+    import paddle_tpu as P
+
+    return P.accuracy(input, label, k=k, correct=correct, total=total)

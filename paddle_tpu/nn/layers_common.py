@@ -11,7 +11,7 @@ from .initializer import Constant, Normal, XavierUniform
 from .layer_base import Layer
 
 __all__ = [
-    "PairwiseDistance",
+    "PairwiseDistance", "Softmax2D", "Unflatten",
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
     "Flatten", "Identity", "Sequential", "LayerList", "ParameterList",
     "LayerDict", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
@@ -422,3 +422,22 @@ class PairwiseDistance(Layer):
         d = x - y
         return P.norm(d + self.epsilon, p=self.p, axis=-1,
                       keepdim=self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (paddle.nn.Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        import paddle_tpu as P
+
+        return P.unflatten(x, self.axis, self.shape)

@@ -8,6 +8,7 @@ from .initializer import KaimingUniform
 from .layer_base import Layer
 
 __all__ = [
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
     "Conv1D", "Conv2D", "Conv3D",
     "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
     "AvgPool1D", "AvgPool2D", "AvgPool3D",
@@ -237,3 +238,39 @@ class AdaptiveMaxPool2D(_AdaptivePoolNd):
 class AdaptiveMaxPool3D(_AdaptivePoolNd):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(F.adaptive_max_pool3d, output_size)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kw = dict(kernel_size=kernel_size, stride=stride,
+                       padding=padding, data_format=data_format,
+                       output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, **self.kw)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kw = dict(kernel_size=kernel_size, stride=stride,
+                       padding=padding, data_format=data_format,
+                       output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self.kw)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kw = dict(kernel_size=kernel_size, stride=stride,
+                       padding=padding, data_format=data_format,
+                       output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, **self.kw)

@@ -169,3 +169,96 @@ class PoissonNLLLoss(_LossBase):
     def forward(self, input, label):
         return F.poisson_nll_loss(input, label, self.log_input, self.full,
                                   self.epsilon, self.reduction)
+
+
+class CTCLoss(_LossBase):
+    """Layer over F.ctc_loss (reference nn/layer/loss.py CTCLoss)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__(reduction)
+        self.blank = blank
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class RNNTLoss(_LossBase):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean"):
+        super().__init__(reduction)
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+
+    def forward(self, logits, labels, input_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, input_lengths, label_lengths,
+                           blank=self.blank, reduction=self.reduction,
+                           fastemit_lambda=self.fastemit_lambda)
+
+
+class HSigmoidLoss(Layer):
+    """Layer over F.hsigmoid_loss: owns the internal-node weight table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree hsigmoid is not wired (default "
+                "complete-binary-tree paths only)")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size])
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([num_classes - 1], is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class MultiMarginLoss(_LossBase):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__(reduction)
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self.p,
+                                   margin=self.margin, weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(_LossBase):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(reduction)
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
+
+
+class GaussianNLLLoss(_LossBase):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__(reduction)
+        self.full = full
+        self.epsilon = epsilon
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, full=self.full,
+                                   epsilon=self.epsilon,
+                                   reduction=self.reduction)
+
+
+__all__ += ["CTCLoss", "RNNTLoss", "HSigmoidLoss", "MultiMarginLoss",
+            "TripletMarginWithDistanceLoss", "GaussianNLLLoss"]

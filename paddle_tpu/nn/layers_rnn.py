@@ -268,3 +268,99 @@ class BiRNN(Layer):
         out_fw, st_fw = self.rnn_fw(inputs, fw_init, sequence_length)
         out_bw, st_bw = self.rnn_bw(inputs, bw_init, sequence_length)
         return P.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+RNNCellBase = _RNNCellBase  # public alias (paddle.nn.RNNCellBase)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoder over an RNN cell (paddle.nn.BeamSearchDecoder).
+
+    TPU-first: the decode loop is a host loop over static-shape steps
+    (each step is jit-friendly); beams are a leading batch*beam fold."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run a BeamSearchDecoder to completion (paddle.nn.dynamic_decode).
+    Returns (predicted_ids [B, T, W], final_scores [B, W]) (+ lengths)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np_
+
+    import paddle_tpu as P
+
+    cell = decoder.cell
+    W = decoder.beam_size
+    # infer batch from provided initial states
+    assert inits is not None, "dynamic_decode needs initial states"
+    flat = inits[0] if isinstance(inits, (tuple, list)) else inits
+    b = flat.shape[0]
+
+    def tile(t):
+        v = t._value if isinstance(t, Tensor) else t
+        return Tensor(jnp.repeat(v, W, axis=0))
+
+    states = jax.tree_util.tree_map(
+        tile, inits, is_leaf=lambda x: isinstance(x, Tensor))
+    ids = P.full([b * W], decoder.start_token, dtype="int32")
+    # beam 0 active, others -inf so step 1 expands from one beam
+    scores = jnp.tile(jnp.asarray([0.0] + [-1e9] * (W - 1), jnp.float32), b)
+    finished = jnp.zeros((b * W,), bool)
+    out_ids = []
+
+    for _ in range(max_step_num):
+        inp = decoder.embedding_fn(ids) if decoder.embedding_fn else \
+            P.cast(ids, "float32").unsqueeze(-1)
+        out, states_new = cell(inp, states)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        logp = jax.nn.log_softmax(
+            logits._value.astype(jnp.float32), axis=-1)     # [B*W, V]
+        v = logp.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        end_only = jnp.full((v,), -1e9).at[decoder.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], end_only[None, :], logp)
+        total = scores[:, None] + logp                      # [B*W, V]
+        total = total.reshape(b, W * v)
+        top_scores, top_idx = jax.lax.top_k(total, W)       # [B, W]
+        beam_src = top_idx // v                             # which beam
+        tok = (top_idx % v).astype(jnp.int32)
+        gather = (jnp.arange(b)[:, None] * W + beam_src).reshape(-1)
+
+        def regather(t):
+            return Tensor(t._value[gather])
+
+        states = jax.tree_util.tree_map(
+            regather, states_new, is_leaf=lambda x: isinstance(x, Tensor))
+        scores = top_scores.reshape(-1)
+        finished = finished[gather] | (tok.reshape(-1) == decoder.end_token)
+        ids = Tensor(tok.reshape(-1))
+        # re-gather previously emitted ids so beams stay consistent
+        out_ids = [o[gather] for o in out_ids]
+        out_ids.append(tok.reshape(-1))
+        if bool(finished.all()):
+            break
+
+    pred = jnp.stack(out_ids, axis=0).reshape(-1, b, W)     # [T, B, W]
+    if not output_time_major:
+        pred = jnp.moveaxis(pred, 0, 1)                     # [B, T, W]
+    result = (Tensor(pred), Tensor(scores.reshape(b, W)))
+    if return_length:
+        steps = pred.shape[1 if not output_time_major else 0]
+        lens = jnp.full((b, W), steps, jnp.int32)
+        return result + (Tensor(lens),)
+    return result
+
+
+__all__ += ["RNNCellBase", "BeamSearchDecoder", "dynamic_decode"]

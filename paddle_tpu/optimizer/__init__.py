@@ -1,6 +1,6 @@
 """paddle.optimizer parity (`python/paddle/optimizer/`)."""
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    Adadelta, Adagrad, Adam, Adamax, AdamW, L1Decay, L2Decay, Lamb, Momentum,
-    Optimizer, RMSProp, SGD,
+    Adadelta, Adagrad, Adam, Adamax, AdamW, L1Decay, L2Decay, Lamb, LBFGS,
+    Momentum, Optimizer, RMSProp, Rprop, SGD,
 )

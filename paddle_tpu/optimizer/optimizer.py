@@ -505,3 +505,135 @@ class Adadelta(Optimizer):
             (1 - self._rho) * upd * upd
         return p - lr * upd, {"avg_squared_grad": asg,
                               "avg_squared_update": asu}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference optimizer/rprop.py): per-element
+    step sizes grown/shrunk by gradient-sign agreement; sign-based
+    update (batch-mode only, as the reference documents)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def init_slots(self, pv):
+        return {"delta": jnp.full(pv.shape, self.get_lr(), jnp.float32),
+                "prev_grad": jnp.zeros(pv.shape, jnp.float32)}
+
+    def update(self, p, g, slots, lr, t, wd):
+        g = g.astype(jnp.float32)
+        sign = jnp.sign(g * slots["prev_grad"])
+        delta = jnp.clip(
+            jnp.where(sign > 0, slots["delta"] * self._eta_pos,
+                      jnp.where(sign < 0, slots["delta"] * self._eta_neg,
+                                slots["delta"])),
+            self._lr_min, self._lr_max)
+        # on sign flip: no step, zero the remembered grad
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * delta
+        return new_p, {"delta": delta, "prev_grad": g_eff}
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with two-loop recursion + Armijo backtracking line search
+    (reference optimizer/lbfgs.py). Requires `step(closure)` — the
+    closure re-evaluates the loss (and grads) like the reference API."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=10, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self.max_iter = max_iter
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist = []
+        self._y_hist = []
+        self._prev_flat_g = None
+
+    def _flat_params(self):
+        return jnp.concatenate([p._value.astype(jnp.float32).reshape(-1)
+                                for p in self._parameter_list])
+
+    def _flat_grads(self):
+        return jnp.concatenate([
+            (p.grad._value if p.grad is not None
+             else jnp.zeros(p._value.shape)).astype(jnp.float32).reshape(-1)
+            for p in self._parameter_list])
+
+    def _assign_flat(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._value = flat[off:off + n].reshape(tuple(p.shape)) \
+                .astype(p._value.dtype)
+            off += n
+
+    def step(self, closure=None):
+        assert closure is not None, \
+            "LBFGS.step(closure) needs a loss closure (reference API)"
+
+        def eval_loss_grads():
+            self.clear_grad()
+            loss = closure()
+            return float(loss.numpy() if hasattr(loss, "numpy") else loss)
+
+        loss = eval_loss_grads()
+        for _ in range(self.max_iter):
+            g = self._flat_grads()
+            if float(jnp.max(jnp.abs(g))) < self.tol_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in reversed(list(zip(self._s_hist, self._y_hist))):
+                rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+                a = rho * jnp.vdot(s, q)
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y_hist:
+                y_l, s_l = self._y_hist[-1], self._s_hist[-1]
+                gamma = jnp.vdot(s_l, y_l) / jnp.maximum(
+                    jnp.vdot(y_l, y_l), 1e-10)
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.vdot(y, q)
+                q = q + s * (a - b)
+            d = -q
+            # Armijo backtracking
+            x0 = self._flat_params()
+            g0_d = float(jnp.vdot(g, d))
+            t = self.get_lr()
+            ok = False
+            for _ls in range(20):
+                self._assign_flat(x0 + t * d)
+                new_loss = eval_loss_grads()
+                if new_loss <= loss + 1e-4 * t * g0_d:
+                    ok = True
+                    break
+                t *= 0.5
+            if not ok:
+                self._assign_flat(x0)
+                eval_loss_grads()
+                break
+            s_vec = t * d
+            new_g = self._flat_grads()
+            y_vec = new_g - g
+            if float(jnp.vdot(s_vec, y_vec)) > 1e-10:
+                self._s_hist.append(s_vec)
+                self._y_hist.append(y_vec)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if abs(new_loss - loss) < self.tol_change:
+                loss = new_loss
+                break
+            loss = new_loss
+        return loss

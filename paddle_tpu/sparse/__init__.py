@@ -547,3 +547,44 @@ __all__ = [
     "divide", "matmul", "masked_matmul", "addmm", "mv", "sum", "transpose",
     "reshape", "coalesce", "mask_as", "softmax", "nn",
 ]
+
+
+def isnan(x, name=None):
+    """Elementwise NaN test on the stored values (paddle.sparse.isnan)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor as _T
+
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        vals = x.values()
+        out = jnp.isnan(vals._value if isinstance(vals, _T) else vals)
+        if isinstance(x, SparseCooTensor):
+            return sparse_coo_tensor(x.indices(), _T(out), x.shape)
+        return sparse_csr_tensor(x.crows(), x.cols(), _T(out), x.shape)
+    return _T(jnp.isnan(_val(x)))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Dense-region slice of a sparse tensor (paddle.sparse.slice):
+    computed on the dense form, returned sparse-COO."""
+    import numpy as np_
+
+    from .. import ops as _ops
+    from ..core.tensor import Tensor as _T
+
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    out = _ops.slice(dense, axes, starts, ends)
+    arr = np_.asarray(out._value)
+    nz = np_.nonzero(arr)
+    idx = np_.stack(nz)
+    return sparse_coo_tensor(_T(idx.astype(np_.int64)),
+                             _T(arr[nz]), list(arr.shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA of a sparse matrix (paddle.sparse.pca_lowrank):
+    densify (the factors are dense anyway) and reuse the dense routine."""
+    import paddle_tpu as P
+
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    return P.pca_lowrank(dense, q=q, center=center, niter=niter)

@@ -1,6 +1,7 @@
 """Top-level API surface: summary/flops, version, places, iinfo/finfo,
 static AMP."""
 import numpy as np
+import pytest
 
 import paddle_tpu as P
 import paddle_tpu.nn as nn
@@ -207,3 +208,100 @@ def test_top_level_additions_behave():
     # batch combinator
     batches = list(P.batch(lambda: iter(range(7)), 3)())
     assert [len(b) for b in batches] == [3, 3, 1]
+
+
+def test_lbfgs_and_rprop_converge():
+    import paddle_tpu.nn as nn
+
+    P.seed(0)
+    rs = np.random.RandomState(0)
+    xs = rs.randn(32, 3).astype(np.float32)
+    w_true = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    ys = xs @ w_true
+
+    lin = nn.Linear(3, 1)
+    opt = P.optimizer.LBFGS(parameters=lin.parameters(), max_iter=10)
+
+    def closure():
+        loss = ((lin(P.to_tensor(xs)) - P.to_tensor(ys)) ** 2).mean()
+        loss.backward()
+        return loss
+
+    final = opt.step(closure)
+    assert final < 1e-3, final
+
+    lin2 = nn.Linear(3, 1)
+    opt2 = P.optimizer.Rprop(learning_rate=0.01,
+                             parameters=lin2.parameters())
+    losses = []
+    for _ in range(30):
+        loss = ((lin2(P.to_tensor(xs)) - P.to_tensor(ys)) ** 2).mean()
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_beam_search_decoder():
+    import paddle_tpu.nn as nn
+
+    P.seed(0)
+
+    class ToyCell(nn.Layer):
+        """Deterministic 'cell': logits favor (prev_id + 1) mod V."""
+
+        def __init__(self, v):
+            super().__init__()
+            self.v = v
+            self.lin = nn.Linear(1, v)
+
+        def forward(self, inp, states):
+            ids = P.cast(inp.squeeze(-1), "int32")
+            import jax.numpy as jnp
+
+            nxt = (ids._value + 1) % self.v
+            import jax
+
+            logits = jax.nn.one_hot(nxt, self.v) * 10.0
+            return P.Tensor(logits), states
+
+    cell = ToyCell(6)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=5,
+                               beam_size=2)
+    init = P.zeros([3, 4])  # batch of 3, dummy state
+    ids, scores = nn.dynamic_decode(dec, inits=init, max_step_num=8)
+    out = np.asarray(ids.numpy())
+    # best beam should walk 1,2,3,4,5 then hold at end token
+    np.testing.assert_array_equal(out[0, :5, 0], [1, 2, 3, 4, 5])
+    assert scores.shape == [3, 2]
+
+
+def test_new_layer_wrappers_smoke():
+    import paddle_tpu.nn as nn
+
+    rs = np.random.RandomState(0)
+    x = P.to_tensor(rs.randn(2, 3, 4, 4).astype(np.float32))
+    assert nn.Softmax2D()(x).shape == [2, 3, 4, 4]
+    np.testing.assert_allclose(
+        np.asarray(nn.Softmax2D()(x).numpy()).sum(1), 1.0, rtol=1e-5)
+    u = nn.Unflatten(1, [1, 3])(x)
+    assert u.shape == [2, 1, 3, 4, 4]
+    # losses
+    mm = nn.MultiMarginLoss()(
+        P.to_tensor(rs.randn(4, 5).astype(np.float32)),
+        P.to_tensor(rs.randint(0, 5, (4, 1)).astype(np.int64)))
+    assert np.isfinite(float(mm.numpy()))
+    gnll = nn.GaussianNLLLoss()(
+        P.to_tensor(rs.randn(4).astype(np.float32)),
+        P.to_tensor(rs.randn(4).astype(np.float32)),
+        P.to_tensor((rs.rand(4) + 0.5).astype(np.float32)))
+    assert np.isfinite(float(gnll.numpy()))
+    hs = nn.HSigmoidLoss(6, 10)(
+        P.to_tensor(rs.randn(4, 6).astype(np.float32)),
+        P.to_tensor(rs.randint(0, 10, (4, 1)).astype(np.int64)))
+    assert np.isfinite(float(hs.numpy()))
+    # saved_tensors_hooks is a LOUD gate
+    with pytest.raises(NotImplementedError):
+        with P.autograd.saved_tensors_hooks(lambda t: t, lambda t: t):
+            pass
