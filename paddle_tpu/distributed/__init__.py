@@ -35,3 +35,237 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     owns all local devices in-process, so spawn degenerates to a direct call
     with rank 0 semantics (multi-host uses the launcher)."""
     return func(*args)
+
+
+# ---- reference __all__ completion (python/paddle/distributed/__init__.py)
+
+from .auto_parallel import Placement  # noqa: F401,E402
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401,E402
+from . import launch  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+
+
+class ParallelMode:
+    """Reference parallel-mode constants (base/topology.py roles)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+class DistAttr:
+    """Per-tensor distributed attribute (DistTensor's TensorDistAttr
+    role): process mesh + per-dim sharding names."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+def is_available():
+    return True
+
+
+def destroy_process_group(group=None):
+    """Tear down collective state (reference destroy_process_group);
+    XLA backends hold no persistent communicators — reset the topology."""
+    from . import topology as _topo
+
+    _topo.reset_topology()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference wait blocks on a collective's stream; jax arrays expose
+    completion directly."""
+    v = tensor._value if hasattr(tensor, "_value") else tensor
+    try:
+        v.block_until_ready()
+    except Exception:
+        pass
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to dst (reference communication/gather.py). On the
+    single-controller runtime every rank's shard is addressable, so
+    gather == all_gather with the result delivered on dst."""
+    out = []
+    all_gather(out, tensor, group=group, sync_op=sync_op)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(out)
+    return out
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Python-object broadcast (pickle transport over the collective
+    layer; single-controller: objects are already shared)."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    rank = get_rank()
+    world = max(get_world_size(), 1)
+    if in_object_list is None:
+        in_object_list = []
+    per = max(len(in_object_list) // world, 1) if in_object_list else 0
+    out_object_list.clear()
+    out_object_list.extend(in_object_list[rank * per:(rank + 1) * per])
+    return out_object_list
+
+
+def gloo_init_parallel_env(rank_id=0, rank_num=1, server_endpoint=None):
+    """CPU-rendezvous parity (gloo role): the TCPStore path."""
+    from .parallel import init_parallel_env
+
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    return barrier()
+
+
+def gloo_release():
+    return None
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a sharded DistTensor to a fully-replicated dense tensor
+    (reference unshard_dtensor)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..core.tensor import Tensor
+    from . import topology as _topo
+
+    v = dist_tensor._value if hasattr(dist_tensor, "_value") else dist_tensor
+    mesh = _topo.get_topology().spmd_mesh
+    out = jax.device_put(v, NamedSharding(mesh, PartitionSpec()))
+    return Tensor(out)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, weight_attr=None,
+          bias_attr=None, gather_out=True, name=None):
+    """Model-parallel split op (reference distributed/parallel layers
+    `paddle.distributed.split`): builds a row/column-parallel linear or
+    a vocab-parallel embedding whose weight shard lives on the mp axis.
+    Returns the layer's output for input x (mirrors the reference's
+    functional use)."""
+    from . import mpu
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:  # row parallel (input dim split)
+            layer = mpu.RowParallelLinear(in_f, out_f,
+                                          input_is_parallel=False)
+        else:
+            layer = mpu.ColumnParallelLinear(in_f, out_f,
+                                             gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        vocab, hidden = size
+        layer = mpu.VocabParallelEmbedding(vocab, hidden)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")
+
+
+# PS-era dataset/entry configs: excluded with the parameter-server stack
+# (see README "Scope notes") — loud, documented gates.
+def _ps_gate(name):
+    def ctor(*a, **kw):
+        raise NotImplementedError(
+            f"{name} belongs to the parameter-server stack, which this "
+            "TPU build deliberately excludes (see README Scope notes); "
+            "use paddle_tpu.io.Dataset/DataLoader for data input")
+
+    ctor.__name__ = name
+    return ctor
+
+
+QueueDataset = _ps_gate("QueueDataset")
+InMemoryDataset = _ps_gate("InMemoryDataset")
+CountFilterEntry = _ps_gate("CountFilterEntry")
+ShowClickEntry = _ps_gate("ShowClickEntry")
+ProbabilityEntry = _ps_gate("ProbabilityEntry")
+
+
+# auto-parallel static facade (reference auto_parallel/api.py to_static /
+# Strategy / DistModel) over the Engine
+class Strategy:
+    """Auto-parallel strategy (auto_parallel/strategy.py role): bags of
+    config for sharding/amp/recompute consumed by to_static/Engine."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = config.get("sharding", {})
+        self.amp = config.get("amp", {})
+        self.recompute = config.get("recompute", {})
+        self.pipeline = config.get("pipeline", {})
+        self.hybrid_configs = config.get("hybrid_configs", None)
+
+
+class DistModel:
+    """Compiled distributed model handle (auto_parallel/api.py DistModel):
+    call it to run one train/eval step under the planned strategy."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def __call__(self, *batch):
+        if self._mode == "train":
+            return self._engine._step(*batch)
+        import paddle_tpu as P
+
+        with P.no_grad():
+            out = self._engine.model(batch[0])
+            if self._engine.loss is not None and len(batch) > 1:
+                return self._engine.loss(out, batch[1])
+            return out
+
+    def state_dict(self):
+        return self._engine.model.state_dict()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """Auto-parallel to_static (auto_parallel/api.py:1358): plan +
+    compile the distributed training step; returns (DistModel, loader)."""
+    from .engine import Engine
+
+    eng = Engine(model=layer, loss=loss, optimizer=optimizer,
+                 strategy=getattr(strategy, "hybrid_configs", None))
+    # infer global batch from the loader's first element when available
+    gb = 32
+    if loader is not None:
+        try:
+            first = next(iter(loader))
+            import numpy as _np
+
+            gb = int(_np.shape(first[0])[0])
+        except Exception:
+            pass
+    eng.prepare(global_batch=gb)
+    dm = DistModel(eng)
+    return (dm, loader) if loader is not None else dm

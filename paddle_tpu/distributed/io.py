@@ -1,0 +1,60 @@
+"""paddle.distributed.io parity (`python/paddle/distributed/io.py`):
+persistables save/load helpers for distributed programs. On this runtime
+persistables are the state dicts the checkpoint package already shards;
+these helpers cover the reference's single-file program-level entry
+points."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable", "save_inference_model", "load_inference_model"]
+
+
+def is_persistable(var):
+    from ..core.tensor import Parameter
+
+    return isinstance(var, Parameter) or getattr(var, "persistable", False)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    from ..framework.io_utils import save as _save
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    state = {}
+    for p in prog.all_parameters():
+        state[getattr(p, "name", f"param_{id(p)}")] = p
+    os.makedirs(dirname, exist_ok=True)
+    _save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    from ..framework.io_utils import load as _load
+    from ..static import default_main_program
+
+    state = _load(os.path.join(dirname,
+                               filename or "persistables.pdparams"))
+    prog = main_program or default_main_program()
+    for p in prog.all_parameters():
+        name = getattr(p, "name", None)
+        if name in state:
+            p.set_value(state[name]._value
+                        if hasattr(state[name], "_value") else state[name])
+    return state
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         **kw):
+    from ..static.io import save_inference_model as _sim
+
+    return _sim(os.path.join(dirname, "model"), feeded_var_names,
+                target_vars, executor)
+
+
+def load_inference_model(dirname, executor, **kw):
+    from ..static.io import load_inference_model as _lim
+
+    return _lim(os.path.join(dirname, "model"), executor)
