@@ -67,3 +67,269 @@ def cuda_places(device_ids=None):
 
 def xpu_places(device_ids=None):
     return []
+
+
+# ---- reference __all__ completion (python/paddle/static/__init__.py) ----
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist a Program's parameters + scope (reference static.save)."""
+    import pickle
+
+    state = {"params": {(getattr(p, "name", None) or f"p{i}"): _np_of(p)
+                        for i, p in enumerate(program.all_parameters())},
+             "scope": {k: _np_of(v) for k, v in program.scope.items()}}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    return model_path + ".pdparams"
+
+
+def _np_of(v):
+    import numpy as np
+
+    return np.asarray(v._value if hasattr(v, "_value") else v)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Reload static.save output into the program (reference static.load)."""
+    import pickle
+
+    import jax.numpy as jnp
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for i, p in enumerate(program.all_parameters()):
+        name = getattr(p, "name", None) or f"p{i}"
+        if name in state["params"]:
+            p.set_value(state["params"][name])
+    for k, v in state.get("scope", {}).items():
+        program.scope[k] = jnp.asarray(v)
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """Serialized bytes of the captured program structure (reference
+    serialize_program's pb bytes role): pickled op-list metadata."""
+    import pickle
+
+    prog = default_main_program()
+    meta = {"n_ops": len(prog.ops),
+            "feeds": [getattr(v, "name", None) for v in feed_vars],
+            "fetches": [getattr(v, "name", None) for v in fetch_vars]}
+    return pickle.dumps(meta, protocol=4)
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    import pickle
+
+    prog = default_main_program()
+    return pickle.dumps({(getattr(p, "name", None) or f"p{i}"): _np_of(p)
+                         for i, p in enumerate(prog.all_parameters())},
+                        protocol=4)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    state = pickle.loads(data)
+    for i, p in enumerate(program.all_parameters()):
+        name = getattr(p, "name", None) or f"p{i}"
+        if name in state:
+            p.set_value(state[name])
+    return program
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference prunes/cleans the program for inference; the recorded
+    program is already minimal (pure-op list) — identity."""
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)["params"]
+
+
+def set_program_state(program, state_dict):
+    for i, p in enumerate(program.all_parameters()):
+        name = getattr(p, "name", None) or f"p{i}"
+        if name in state_dict:
+            p.set_value(state_dict[name])
+    return program
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import paddle_tpu as P
+
+    t = P.full(shape, value, dtype=dtype)
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as P
+
+    return P.create_parameter(shape, dtype, name=name, attr=attr,
+                              is_bias=is_bias,
+                              default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    import paddle_tpu as P
+
+    return P.accuracy(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    import paddle_tpu as P
+
+    return P.auc(input, label, curve=curve, num_thresholds=num_thresholds)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=False,
+          print_tensor_lod=False, print_phase="both"):
+    """Debug print inside a program (reference static.Print): routes
+    through jax.debug.print so it fires from compiled executions too."""
+    import jax
+
+    def f(v):
+        jax.debug.print((message or "") + "{x}", x=v)
+        return v
+
+    from ..core.dispatch import apply
+
+    return apply("print", f, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op inside a program (reference static.py_func):
+    pure_callback keeps it runnable under jit; optional custom backward."""
+    import jax
+    import numpy as np
+
+    from ..core.dispatch import apply
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(str(o.dtype)))
+             for o in outs]
+
+    def f(*vals):
+        res = jax.pure_callback(
+            lambda *a: func(*a), specs if len(specs) > 1 else specs[0],
+            *vals)
+        return res
+
+    return apply("py_func", f, *xs)
+
+
+class WeightNormParamAttr:
+    """ParamAttr marker requesting weight_norm reparametrization
+    (reference WeightNormParamAttr); consumed by nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA over trainable parameters (reference static.
+    ExponentialMovingAverage): update() folds current weights in;
+    apply()/restore() swap averaged weights for evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema = {}
+        self._backup = None
+        self._params = None
+        self._step = 0
+
+    def _param_list(self):
+        if self._params is None:
+            prog = default_main_program()
+            self._params = list(prog.all_parameters())
+        return self._params
+
+    def update(self):
+        import jax.numpy as jnp
+
+        self._step += 1
+        d = min(self.decay, (1 + self._step) / (10 + self._step))
+        for i, p in enumerate(self._param_list()):
+            cur = p._value.astype(jnp.float32)
+            prev = self._ema.get(i, cur)
+            self._ema[i] = d * prev + (1 - d) * cur
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        self._backup = [jnp.asarray(p._value) for p in self._param_list()]
+        for i, p in enumerate(self._param_list()):
+            if i in self._ema:
+                p._value = self._ema[i].astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._param_list(), self._backup):
+            p._value = b
+        self._backup = None
+
+
+# IPU tier: third-vendor hardware this build does not target (PJRT is
+# the backend ABI here) — loud, documented gates.
+def _ipu_gate(name):
+    def g(*a, **kw):
+        raise NotImplementedError(
+            f"{name} targets Graphcore IPU hardware; this build's device "
+            "tier is PJRT/TPU (see README Scope notes)")
+
+    g.__name__ = name
+    return g
+
+
+ipu_shard_guard = _ipu_gate("ipu_shard_guard")
+IpuCompiledProgram = _ipu_gate("IpuCompiledProgram")
+IpuStrategy = _ipu_gate("IpuStrategy")
+set_ipu_shard = _ipu_gate("set_ipu_shard")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server stack, "
+        "excluded by design (README Scope notes)")

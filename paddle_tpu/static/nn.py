@@ -71,3 +71,326 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
     shape = [int(s) for s in input.shape[begin_norm_axis:]]
     layer = nn.LayerNorm(shape, epsilon=epsilon)
     return layer(input)
+
+
+# ---- reference static.nn __all__ completion ----
+
+def _act(out, act):
+    if act:
+        from .. import nn
+
+        return getattr(nn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from .. import nn
+
+    cin = input.shape[1]
+    layer = nn.Conv2DTranspose(cin, num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups)
+    return _act(layer(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    layer = nn.Conv3D(input.shape[1], num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups)
+    return _act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from .. import nn
+
+    layer = nn.Conv3DTranspose(input.shape[1], num_filters, filter_size,
+                               stride=stride, padding=padding,
+                               dilation=dilation, groups=groups)
+    return _act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn
+
+    layer = nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+
+    return nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Feature-wise standardization by running statistics (reference
+    data_norm): per-feature (x - mean) / sqrt(var) without batch
+    coupling."""
+    from ..core.dispatch import apply
+    import jax.numpy as jnp
+
+    def f(x):
+        mu = jnp.mean(x, axis=0, keepdims=True)
+        var = jnp.var(x, axis=0, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + epsilon)
+
+    return _act(apply("data_norm", f, input), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+
+    n = 1 if mode == "all" else x.shape[1]
+    layer = nn.PReLU(num_parameters=n)
+    return layer(x)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import vision
+
+    import paddle_tpu as P
+
+    cin = x.shape[1]
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    weight = P.create_parameter([num_filters, cin // groups, ks[0], ks[1]],
+                                "float32")
+    return vision.ops.deform_conv2d(x, offset, weight, mask=mask,
+                                    stride=stride, padding=padding,
+                                    dilation=dilation,
+                                    deformable_groups=deformable_groups,
+                                    groups=groups)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size)
+    return _act(layer(x, y), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized view of a weight Variable (reference
+    static.nn.spectral_norm)."""
+    from ..core.dispatch import apply
+    import jax.numpy as jnp
+
+    def f(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1) \
+            .astype(jnp.float32)
+        u = jnp.ones((mat.shape[0],), jnp.float32)
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        for _ in range(max(power_iters, 1)):
+            v = mat.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ (mat @ v)
+        return (w.astype(jnp.float32) / jnp.maximum(sigma, eps)) \
+            .astype(w.dtype)
+
+    return apply("spectral_norm", f, weight)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv): each timestep
+    mixes the next `future_context_size` steps with learned weights."""
+    import paddle_tpu as P
+    from ..core.dispatch import apply
+    import jax.numpy as jnp
+
+    d = input.shape[-1]
+    w = P.create_parameter([future_context_size + 1, d], "float32")
+
+    def f(x, wv):
+        outs = []
+        t = x.shape[1]
+        for k in range(future_context_size + 1):
+            shifted = jnp.pad(x[:, k:], ((0, 0), (0, k), (0, 0)))
+            outs.append(shifted * wv[k])
+        return sum(outs[1:], outs[0])
+
+    return _act(apply("row_conv", f, input, w), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce): true-class +
+    uniformly sampled negatives, BCE in one pass."""
+    import paddle_tpu as P
+    from ..core.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    d = input.shape[-1]
+    w = P.create_parameter([num_total_classes, d], "float32")
+    b = P.create_parameter([num_total_classes], "float32", is_bias=True)
+    key = jax.random.PRNGKey(seed)
+
+    def f(x, y, wv, bv):
+        n = x.shape[0]
+        neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                                 num_total_classes)
+        yy = y.reshape(-1, 1).astype(jnp.int32)
+        cls = jnp.concatenate([yy, neg], axis=1)        # [N, 1+K]
+        wc = wv[cls]                                    # [N, 1+K, D]
+        logits = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
+                            wc.astype(jnp.float32)) + bv[cls]
+        tgt = jnp.concatenate(
+            [jnp.ones((n, 1)), jnp.zeros((n, num_neg_samples))], axis=1)
+        per = jnp.maximum(logits, 0) - logits * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(per, axis=1, keepdims=True)
+
+    return apply("nce", f, input, label, w, b)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS sparse-table embedding → dense embedding on TPU (the sharded
+    table is the mpu VocabParallelEmbedding under mp)."""
+    return embedding(input, size, padding_idx=padding_idx, dtype=dtype)
+
+
+# control flow (reference static.nn control_flow): thin functional forms
+# over the converted-control-flow helpers
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    from ..jit.dy2static import _tensor_bool
+
+    import paddle_tpu as P
+    from ..core import flags as _flags
+    from ..core.tensor import Tensor
+
+    if isinstance(pred, Tensor) and _flags.in_trace():
+        import jax
+
+        return jax.lax.cond(pred._value.astype(bool).reshape(()),
+                            lambda: true_fn(), lambda: false_fn())
+    return true_fn() if _tensor_bool(pred) else false_fn()
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        from ..jit.dy2static import _tensor_bool
+
+        if _tensor_bool(pred):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(branch_index.numpy() if hasattr(branch_index, "numpy")
+              else branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    raise ValueError(f"switch_case: no branch {idx} and no default")
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Reference while_loop; converts to lax.while_loop under trace via
+    the dy2static helper, plain python loop eagerly."""
+    from ..jit.dy2static import _jst_while
+
+    names = [f"v{i}" for i in range(len(loop_vars))]
+    out = _jst_while(lambda *vs: cond(*vs), lambda *vs: body(*vs),
+                     names, tuple(loop_vars))
+    return list(out)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """PyLayer in a program (reference static_pylayer): custom forward
+    (+ optional custom backward) recorded as one op."""
+    import jax
+
+    from ..core.dispatch import apply
+    from ..core.tensor import Tensor
+
+    if backward_fn is None:
+        return forward_fn(*inputs)
+
+    @jax.custom_vjp
+    def core(*vals):
+        out = forward_fn(*[Tensor(v) for v in vals])
+        return out._value if isinstance(out, Tensor) else out
+
+    def core_f(*vals):
+        return core(*vals), vals
+
+    def core_b(res, g):
+        outs = backward_fn(Tensor(g))
+        outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+        return tuple(o._value if isinstance(o, Tensor) else o
+                     for o in outs)
+
+    core.defvjp(core_f, core_b)
+    return apply("static_pylayer", core, *inputs)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from . import py_func as _pf  # top-level static.py_func
+
+    return _pf(func, x, out, backward_func)
+
+
+# LoD sequence ops: the reference operates on LoDTensors, a variable-
+# length container this framework deliberately does not have (dense
+# [B, S] + lengths/masks replace it; the reference itself deprecates
+# LoD). Loud, documented gates with the migration hint.
+def _lod_gate(name):
+    def g(*a, **kw):
+        raise NotImplementedError(
+            f"static.nn.{name} operates on LoDTensors, which this build "
+            "replaces by dense [batch, seq] tensors + length masks (see "
+            "README); express the computation with nn/ops over padded "
+            "tensors (e.g. sequence_mask, gather, segment ops)")
+
+    g.__name__ = name
+    return g
+
+
+sequence_conv = _lod_gate("sequence_conv")
+sequence_softmax = _lod_gate("sequence_softmax")
+sequence_pool = _lod_gate("sequence_pool")
+sequence_concat = _lod_gate("sequence_concat")
+sequence_first_step = _lod_gate("sequence_first_step")
+sequence_last_step = _lod_gate("sequence_last_step")
+sequence_slice = _lod_gate("sequence_slice")
+sequence_expand = _lod_gate("sequence_expand")
+sequence_expand_as = _lod_gate("sequence_expand_as")
+sequence_pad = _lod_gate("sequence_pad")
+sequence_unpad = _lod_gate("sequence_unpad")
+sequence_reshape = _lod_gate("sequence_reshape")
+sequence_scatter = _lod_gate("sequence_scatter")
+sequence_enumerate = _lod_gate("sequence_enumerate")
+sequence_reverse = _lod_gate("sequence_reverse")
