@@ -322,3 +322,70 @@ __all__ += [
     "matrix_nms", "multiclass_nms", "prior_box", "psroi_pool", "roi_pool",
     "yolo_box", "yolo_loss",
 ]
+
+
+class RoIPool(Layer):
+    """Layer over roi_pool (paddle.vision.ops.RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        from .ops_detection import roi_pool
+
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    """Layer over psroi_pool (paddle.vision.ops.PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        from .ops_detection import psroi_pool
+
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (paddle.vision.ops.read_file)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes to an HWC uint8 tensor via PIL (host op —
+    image IO has no TPU role; reference uses nvjpeg on GPU)."""
+    import io as _io
+
+    import numpy as np
+    from PIL import Image
+
+    from ..core.tensor import Tensor
+
+    raw = bytes(np.asarray(x._value if hasattr(x, "_value") else x)
+                .astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return Tensor(np.transpose(arr, (2, 0, 1)))  # CHW like the reference
+
+
+__all__ += ["RoIPool", "PSRoIPool", "read_file", "decode_jpeg"]

@@ -191,3 +191,427 @@ class Pad:
         if self.mode == "constant":
             return np.pad(arr, cfg, constant_values=self.fill)
         return np.pad(arr, cfg, mode=self.mode)
+
+
+# ---- reference __all__ completion (vision/transforms/__init__.py) ----
+
+def crop(img, top, left, height, width):
+    arr = _np_img(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np_img(img)
+    th, tw = ((output_size, output_size)
+              if isinstance(output_size, numbers.Number) else output_size)
+    h, w = arr.shape[:2]
+    return crop(arr, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _np_img(img).astype(np.float32)
+    if arr.ndim == 2:
+        g = arr
+    else:
+        g = 0.299 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2]
+    out = np.stack([g] * num_output_channels, axis=-1) \
+        if num_output_channels > 1 else g[..., None]
+    return out.astype(_np_img(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _np_img(img)
+    hi = 255 if arr.dtype == np.uint8 else 1.0
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0, hi)
+    return out.astype(arr.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _np_img(img)
+    hi = 255 if arr.dtype == np.uint8 else 1.0
+    mean = to_grayscale(arr).astype(np.float32).mean()
+    out = np.clip((arr.astype(np.float32) - mean) * contrast_factor + mean,
+                  0, hi)
+    return out.astype(arr.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _np_img(img)
+    hi = 255 if arr.dtype == np.uint8 else 1.0
+    gray = to_grayscale(arr).astype(np.float32)
+    out = np.clip(arr.astype(np.float32) * saturation_factor +
+                  gray * (1 - saturation_factor), 0, hi)
+    return out.astype(arr.dtype)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, axis=-1)
+    minc = np.min(rgb, axis=-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        rc = (maxc - r) / np.maximum(delta, 1e-12)
+        gc = (maxc - g) / np.maximum(delta, 1e-12)
+        bc = (maxc - b) / np.maximum(delta, 1e-12)
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta == 0, 0.0, h / 6.0 % 1.0)
+    return np.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    assert -0.5 <= hue_factor <= 0.5, "hue_factor in [-0.5, 0.5]"
+    arr = _np_img(img)
+    scale = 255.0 if arr.dtype == np.uint8 else 1.0
+    hsv = _rgb_to_hsv(arr.astype(np.float32) / scale)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = _hsv_to_rgb(hsv) * scale
+    return np.clip(out, 0, scale if arr.dtype == np.uint8 else 1.0) \
+        .astype(arr.dtype)
+
+
+def _affine_sample(arr, matrix, out_hw=None, interpolation="nearest",
+                   fill=0):
+    """Inverse-warp sampling: out(y, x) = in(M @ (x, y, 1))."""
+    h, w = arr.shape[:2]
+    oh, ow = out_hw or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], axis=0).reshape(3, -1)
+    m = np.asarray(matrix, np.float64).reshape(3, 3)
+    src = m @ coords
+    sx = src[0] / np.maximum(src[2], 1e-12)
+    sy = src[1] / np.maximum(src[2], 1e-12)
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(int)
+        y0 = np.floor(sy).astype(int)
+        dx = (sx - x0).reshape(oh, ow, *([1] * (arr.ndim - 2)))
+        dy = (sy - y0).reshape(oh, ow, *([1] * (arr.ndim - 2)))
+
+        def at(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            vals = arr[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+            vshape = valid.reshape(oh, ow, *([1] * (arr.ndim - 2)))
+            return np.where(vshape, vals.reshape(oh, ow, *arr.shape[2:]),
+                            fill).astype(np.float32)
+
+        out = (at(y0, x0) * (1 - dx) * (1 - dy)
+               + at(y0, x0 + 1) * dx * (1 - dy)
+               + at(y0 + 1, x0) * (1 - dx) * dy
+               + at(y0 + 1, x0 + 1) * dx * dy)
+    else:
+        xi = np.round(sx).astype(int)
+        yi = np.round(sy).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        vals = arr[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+        out = np.where(valid.reshape(oh, ow, *([1] * (arr.ndim - 2))),
+                       vals.reshape(oh, ow, *arr.shape[2:]), fill)
+    return out.astype(arr.dtype)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    a = np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward matrix: T(center) R S Sh T(-center) + translate
+    rot = np.array([
+        [np.cos(a + sy) / np.cos(sy),
+         -np.cos(a + sy) * np.tan(sx) / np.cos(sy) - np.sin(a), 0],
+        [np.sin(a + sy) / np.cos(sy),
+         -np.sin(a + sy) * np.tan(sx) / np.cos(sy) + np.cos(a), 0],
+        [0, 0, 1]], np.float64)
+    rot[:2, :2] *= scale
+    t1 = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]], np.float64)
+    t2 = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64)
+    fwd = t1 @ rot @ t2
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    arr = _np_img(img)
+    h, w = arr.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    return _affine_sample(arr, m, interpolation=interpolation, fill=fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    return affine(img, angle=angle, interpolation=interpolation,
+                  fill=fill, center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp mapping startpoints -> endpoints (4 corners)."""
+    arr = _np_img(img)
+    a = []
+    bvec = []
+    for (x, y), (u, v) in zip(endpoints, startpoints):
+        a.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        bvec.append(u)
+        a.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        bvec.append(v)
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(bvec, np.float64))
+    m = np.append(coeffs, 1.0).reshape(3, 3)
+    return _affine_sample(arr, m, interpolation=interpolation, fill=fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _np_img(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+class BaseTransform:
+    """Keyed-transform base (reference BaseTransform): subclasses
+    implement _apply_image (and friends); __call__ dispatches on keys."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        return img
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outs.append(fn(data) if fn else data)
+        return tuple(outs)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _np_img(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _np_img(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _np_img(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = min(value, 0.5)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _np_img(img)
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for t in np.random.permutation(self.ts):
+            img = t._apply_image(img)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return resize(arr[i:i + ch, j:j + cw], self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, (min(h, w), min(h, w))), self.size,
+                      self.interpolation)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) \
+            if isinstance(degrees, numbers.Number) else degrees
+        self.interpolation = interpolation
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, center=self.center,
+                      fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) \
+            if isinstance(degrees, numbers.Number) else degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (np.random.uniform(-self.shear, self.shear), 0.0) \
+            if isinstance(self.shear, numbers.Number) else (0.0, 0.0)
+        return affine(arr, angle, (tx, ty), sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        if np.random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        dx = int(self.scale * w / 2)
+        dy = int(self.scale * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(arr, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _np_img(img)
+        if np.random.random() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return erase(arr, i, j, eh, ew, self.value)
+        return arr
+
+
+__all__ += [
+    "BaseTransform", "RandomResizedCrop", "BrightnessTransform",
+    "SaturationTransform", "ContrastTransform", "HueTransform",
+    "ColorJitter", "RandomAffine", "RandomRotation", "RandomPerspective",
+    "Grayscale", "RandomErasing", "pad", "affine", "rotate", "perspective",
+    "to_grayscale", "crop", "center_crop", "adjust_brightness",
+    "adjust_contrast", "adjust_saturation", "adjust_hue", "erase",
+]

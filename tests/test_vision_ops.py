@@ -102,3 +102,72 @@ def test_swin_jit_parity():
     e = m(x)
     j = P.jit.to_static(m)(x)
     np.testing.assert_allclose(e.numpy(), j.numpy(), rtol=2e-5, atol=1e-5)
+
+
+def test_vision_surface_and_new_transforms(tmp_path):
+    import ast
+    import os
+
+    import paddle_tpu.vision.transforms as T
+    from paddle_tpu.vision import ops as V
+
+    ref = "/root/reference/python/paddle/vision/transforms/__init__.py"
+    if os.path.exists(ref):
+        names = []
+        for node in ast.walk(ast.parse(open(ref).read())):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__":
+                        names = [e.value for e in node.value.elts
+                                 if isinstance(e, ast.Constant)]
+        missing = [n for n in names if not hasattr(T, n)]
+        assert not missing, f"transforms missing: {missing}"
+
+    rs = np.random.RandomState(0)
+    img = (rs.rand(8, 10, 3) * 255).astype(np.uint8)
+    # crop/center_crop/erase round-trip basics
+    np.testing.assert_array_equal(T.crop(img, 1, 2, 4, 5),
+                                  img[1:5, 2:7])
+    assert T.center_crop(img, 6).shape == (6, 6, 3)
+    er = T.erase(img, 2, 3, 2, 2, 7)
+    assert (er[2:4, 3:5] == 7).all()
+    # color ops stay in range and keep dtype
+    for f in (lambda i: T.adjust_brightness(i, 1.5),
+              lambda i: T.adjust_contrast(i, 0.5),
+              lambda i: T.adjust_saturation(i, 2.0),
+              lambda i: T.adjust_hue(i, 0.2)):
+        out = f(img)
+        assert out.dtype == np.uint8 and out.shape == img.shape
+    # identity affine == original; rotate 360 ~ original interior
+    same = T.affine(img, angle=0.0)
+    np.testing.assert_array_equal(same, img)
+    rot = T.rotate(img.astype(np.float32), 360.0,
+                   interpolation="bilinear")
+    np.testing.assert_allclose(rot[2:-2, 2:-2], img[2:-2, 2:-2], atol=2.0)
+    # perspective identity corners
+    corners = [(0, 0), (9, 0), (9, 7), (0, 7)]
+    same = T.perspective(img, corners, corners)
+    np.testing.assert_array_equal(same, img)
+    # transform classes execute
+    for t in (T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.Grayscale(3),
+              T.RandomResizedCrop(6), T.RandomRotation(10),
+              T.RandomAffine(10, translate=(0.1, 0.1)),
+              T.RandomPerspective(prob=1.0), T.RandomErasing(prob=1.0)):
+        out = t(img)
+        assert out is not None
+
+    # read_file + decode_jpeg round-trip via PIL
+    from PIL import Image
+
+    p = str(tmp_path / "t.jpg")
+    Image.fromarray(img).save(p, quality=95)
+    raw = V.read_file(p)
+    dec = np.asarray(V.decode_jpeg(raw, mode="rgb").numpy())
+    assert dec.shape == (3, 8, 10)
+
+    # RoIPool layer forward
+    x = P.to_tensor(rs.rand(1, 2, 8, 8).astype(np.float32))
+    boxes = P.to_tensor(np.array([[0, 0, 6, 6]], np.float32))
+    num = P.to_tensor(np.array([1], np.int32))
+    out = V.RoIPool(2)(x, boxes, num)
+    assert list(out.shape) == [1, 2, 2, 2]
