@@ -158,6 +158,12 @@ def _jst_while(cond_fn, body_fn, names, vals):
             probe = cond_fn(*vals)
         return vals
 
+    # numeric Python scalars in the carried state lift to 0-d Tensors
+    # (e.g. the start/step constants of a converted range-for); anything
+    # else non-Tensor still fails loudly
+    vals = tuple(
+        Tensor(jnp.asarray(v)) if isinstance(v, (int, float, bool))
+        else v for v in vals)
     tpos, tvals = _thread_split(vals)
     if len(tpos) != len(vals):
         non = [n for n, v in zip(names, vals) if not isinstance(v, Tensor)]
@@ -374,6 +380,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     # ---- for: stays a Python loop (static unroll), but the iterable is
     # routed through for_iter so tensor-dependent ranges raise loudly ----
     def visit_For(self, node):
+        # `for i in range(...)` with a simple Name target and no
+        # break/continue/else rewrites to a while loop BEFORE visiting —
+        # the while converter then handles tensor-dependent bounds via
+        # lax.while_loop (reference dy2static/transformers loop
+        # conversion). Everything else stays a Python loop (static
+        # unroll) with a loud for_iter guard on the iterable.
+        if self._is_rangefor(node):
+            return self._rangefor_to_while(node)
         self.generic_visit(node)
         node.iter = ast.Call(
             func=ast.Attribute(
@@ -386,6 +400,103 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             keywords=[])
         ast.fix_missing_locations(node)
         return node
+
+    @staticmethod
+    def _is_rangefor(node):
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return False
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return False
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Break, ast.Continue)):
+                return False
+            if sub is not node and isinstance(
+                    sub, (ast.For, ast.While, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+                # nested loops/functions may own the break — keep simple,
+                # only flat range-for bodies convert
+                if any(isinstance(s, (ast.Break, ast.Continue))
+                       for s in ast.walk(sub)):
+                    return False
+        return True
+
+    def _rangefor_to_while(self, node):
+        if node.target.id == "_":
+            # `_` is excluded from while-state threading (scratch-var
+            # convention); rename the loop counter so it threads
+            fresh = self._name("i")
+
+            class _Ren(ast.NodeTransformer):
+                def visit_Name(self, n):
+                    if n.id == "_":
+                        n.id = fresh
+                    return n
+
+            node.target = ast.Name(id=fresh, ctx=ast.Store())
+            node.body = [_Ren().visit(b) for b in node.body]
+        args = node.iter.args
+        if len(args) == 1:
+            start, stop, step = ast.Constant(value=0), args[0], \
+                ast.Constant(value=1)
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], ast.Constant(value=1)
+        else:
+            start, stop, step = args
+        i = node.target.id
+        stop_n, step_n = self._name("stop"), self._name("step")
+        pre = [
+            ast.Assign(targets=[ast.Name(id=stop_n, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=step_n, ctx=ast.Store())],
+                       value=step),
+            ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                       value=start),
+        ]
+        # condition: step > 0 ? i < stop : i > stop — as arithmetic the
+        # while converter can trace: (step>0 and i<stop) or (step<0 and
+        # i>stop); BoolOps get converted by visit_BoolOp downstream
+        cond = ast.BoolOp(op=ast.Or(), values=[
+            ast.BoolOp(op=ast.And(), values=[
+                ast.Compare(left=ast.Name(id=step_n, ctx=ast.Load()),
+                            ops=[ast.Gt()],
+                            comparators=[ast.Constant(value=0)]),
+                ast.Compare(left=ast.Name(id=i, ctx=ast.Load()),
+                            ops=[ast.Lt()],
+                            comparators=[ast.Name(id=stop_n,
+                                                  ctx=ast.Load())]),
+            ]),
+            ast.BoolOp(op=ast.And(), values=[
+                ast.Compare(left=ast.Name(id=step_n, ctx=ast.Load()),
+                            ops=[ast.Lt()],
+                            comparators=[ast.Constant(value=0)]),
+                ast.Compare(left=ast.Name(id=i, ctx=ast.Load()),
+                            ops=[ast.Gt()],
+                            comparators=[ast.Name(id=stop_n,
+                                                  ctx=ast.Load())]),
+            ]),
+        ])
+        incr = ast.Assign(
+            targets=[ast.Name(id=i, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=i, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step_n, ctx=ast.Load())))
+        wl = ast.While(test=cond, body=list(node.body) + [incr], orelse=[])
+        out = []
+        for n in pre:
+            ast.copy_location(n, node)
+            ast.fix_missing_locations(n)
+            out.append(self.visit(n) or n)
+        ast.copy_location(wl, node)
+        ast.fix_missing_locations(wl)
+        converted = self.visit(wl)
+        if isinstance(converted, list):
+            out.extend(converted)
+        else:
+            out.append(converted)
+        return out
 
     # ---- if/while ----
     def visit_If(self, node):

@@ -190,15 +190,43 @@ def test_closure_cells_stay_live():
     np.testing.assert_allclose(cf(xs).numpy(), 11.0 * np.ones(2))
 
 
-def test_loud_error_on_tensor_dependent_for_range():
+def test_tensor_dependent_for_range_converts():
+    """`for i in range(n)` with traced n converts to lax.while_loop and
+    matches eager (upgraded from the round-2 loud-error contract)."""
     def f(x, n):
         acc = x
-        for _ in range(n):  # n is traced -> must raise loudly
+        for _ in range(n):
             acc = acc + 1
         return acc
 
     xs = P.to_tensor(np.ones((2,), np.float32))
-    n = P.to_tensor(np.int32(3))
     static_f = P.jit.to_static(f)
-    with pytest.raises(Dy2StaticError):
+    for k in (0, 3, 5):
+        n = P.to_tensor(np.int32(k))
+        np.testing.assert_allclose(static_f(xs, n).numpy(), 1.0 + k)
+
+    # loop variable used in the body, explicit start/step
+    def g(x, n):
+        s = x * 0
+        for i in range(1, n, 2):
+            s = s + i
+        return s
+
+    static_g = P.jit.to_static(g)
+    n = P.to_tensor(np.int32(7))
+    np.testing.assert_allclose(static_g(xs, n).numpy(),
+                               float(1 + 3 + 5))
+
+
+def test_loud_error_on_tensor_iterable_for():
+    def f(x, idxs):
+        acc = x
+        for i in zip(idxs):  # non-range tensor iterable: loud
+            acc = acc + 1
+        return acc
+
+    xs = P.to_tensor(np.ones((2,), np.float32))
+    n = P.to_tensor(np.int32(0))
+    static_f = P.jit.to_static(f)
+    with pytest.raises((Dy2StaticError, Exception)):
         static_f(xs, n)
