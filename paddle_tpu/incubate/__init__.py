@@ -5,3 +5,73 @@ from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .distributed.models import moe  # noqa: F401
 from .distributed.models.moe import MoELayer  # noqa: F401
+
+
+# legacy incubate graph/segment API: aliases of paddle_tpu.geometric
+# (the reference moved these to paddle.geometric and keeps incubate
+# names for compatibility)
+from ..geometric import (  # noqa: F401,E402
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401,E402
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+from ..geometric import (  # noqa: F401,E402
+    sample_neighbors as graph_sample_neighbors,
+)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (incubate.graph_khop_sampler):
+    composed from per-hop sample_neighbors + reindex."""
+    from .. import geometric as G
+
+    nodes = input_nodes
+    all_src, all_dst = [], []
+    for k in sample_sizes:
+        out = G.sample_neighbors(row, colptr, nodes, sample_size=k)
+        neigh, counts = out[0], out[1]
+        all_src.append(neigh)
+        all_dst.append(nodes)
+        nodes = neigh
+    reindexed = G.reindex_graph(input_nodes, all_src[0],
+                                G.sample_neighbors(
+                                    row, colptr, input_nodes,
+                                    sample_size=sample_sizes[0])[1])
+    return reindexed
+
+
+def identity_loss(x, reduction="none"):
+    import paddle_tpu as P
+
+    return P.identity_loss(x, reduction=reduction)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (incubate.softmax_mask_fuse role —
+    fused_softmax_mask CUDA kernel): one XLA fusion here."""
+    from ..core.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    def f(xv, mv):
+        return jax.nn.softmax(xv.astype(jnp.float32)
+                              + mv.astype(jnp.float32),
+                              axis=-1).astype(xv.dtype)
+
+    return apply("softmax_mask_fuse", f, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (fused_softmax_mask_upper_triangle role)."""
+    from ..core.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    def f(xv):
+        s = xv.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask, xv.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(xv.dtype)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, x)

@@ -215,3 +215,169 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
     out = scaled_dot_product_attention(q, k, v, attn_mask=mask,
                                        is_causal=causal)
     return out.transpose([0, 2, 1, 3])
+
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    if transpose_x:
+        x = x.T
+    return fused_linear(x, y, bias, transpose_weight=transpose_y)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, mode='upscale_in_train',
+                               ring_id=-1, add_residual=True, name=None):
+    """Functional fused MHA (fused_attention_op role): qkv proj (packed
+    [3,H,D,hidden] weight) -> flash/sdpa -> out proj -> residual(+LN)."""
+    from ....nn.functional import (
+        dropout as _dropout, scaled_dot_product_attention as _sdpa,
+    )
+
+    residual = x
+    if pre_layer_norm and ln_scale is not None or pre_ln_scale is not None:
+        out = fused_layer_norm(x, pre_ln_scale, pre_ln_bias,
+                               epsilon=pre_ln_epsilon)
+        x = out[0] if isinstance(out, (tuple, list)) else out
+    b, s, h = x.shape
+    nh = qkv_weight.shape[1]
+    hd = qkv_weight.shape[2]
+    w = qkv_weight.reshape([3 * h, h])
+    qkv = x.matmul(w, transpose_y=True)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape([3 * h])
+    qkv = qkv.reshape([b, s, 3, nh, hd])
+    q, k, v = qkv.unbind(axis=2)
+    out = _sdpa(q, k, v, attn_mask=attn_mask,
+                dropout_p=attn_dropout_rate if training else 0.0,
+                is_causal=False, training=training)
+    out = out.reshape([b, s, h]).matmul(linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate and training:
+        out = _dropout(out, p=dropout_rate, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm and ln_scale is not None:
+        o2 = fused_layer_norm(out, ln_scale, ln_bias, epsilon=ln_epsilon)
+        out = o2[0] if isinstance(o2, (tuple, list)) else o2
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode='upscale_in_train', ring_id=-1, name=None):
+    from ....nn.functional import dropout as _dropout
+
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        out = fused_layer_norm(x, ln1_scale, ln1_bias, epsilon=ln1_epsilon)
+        x = out[0] if isinstance(out, (tuple, list)) else out
+    x = fused_linear_activation(x, linear1_weight, linear1_bias,
+                                activation=activation)
+    if dropout1_rate and training:
+        x = _dropout(x, p=dropout1_rate, mode=mode)
+    x = x.matmul(linear2_weight)
+    if linear2_bias is not None:
+        x = x + linear2_bias
+    if dropout2_rate and training:
+        x = _dropout(x, p=dropout2_rate, mode=mode)
+    x = x + residual
+    if not pre_layer_norm and ln2_scale is not None:
+        out = fused_layer_norm(x, ln2_scale, ln2_bias, epsilon=ln2_epsilon)
+        x = out[0] if isinstance(out, (tuple, list)) else out
+    return x
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False, mode=None,
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """N pre-LN decoder layers over packed per-layer weight lists
+    (fused_multi_transformer_op role)."""
+    out = x
+    for i in range(len(qkv_weights)):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i], pre_layer_norm=True,
+            pre_ln_scale=ln_scales[i],
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, training=training)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i],
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=True, training=training)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode='upscale_in_train',
+                                           name=None):
+    from ....nn.functional import dropout as _dropout
+
+    if bias is not None:
+        x = x + bias
+    if dropout_rate and training:
+        x = _dropout(x, p=dropout_rate, mode=mode)
+    out = fused_layer_norm(x, ln_scale, ln_bias, epsilon=ln_epsilon,
+                           residual=residual)
+    return out[0] if isinstance(out, (tuple, list)) else out
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu"):
+    from ....core.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    def f(xv, gl, w1, b1, w2, b2):
+        probs = jax.nn.softmax(gl, axis=-1)
+        h = jnp.einsum("bsh,ehi->ebsi", xv, w1) + b1
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        out = jnp.einsum("ebsi,eih->ebsh", h, w2) + b2
+        return jnp.einsum("ebsh,bse->bsh", out, probs.astype(out.dtype))
+
+    return apply("fused_ec_moe", f, x, gate, bmm0_weight, bmm0_bias,
+                 bmm1_weight, bmm1_bias)
+
+
+def block_multihead_attention(*args, **kwargs):
+    """Paged/block KV-cache attention (block_multi_head_attention_kernel
+    role). The decode path here is `masked_multihead_attention` over a
+    dense [B, H, S, D] cache (Pallas decode kernel); a paged-block cache
+    is an inference-serving memory layout this build has not adopted —
+    LOUD gate with the supported alternative."""
+    raise NotImplementedError(
+        "block_multihead_attention's paged KV-cache layout is not "
+        "implemented; use incubate.nn.functional."
+        "masked_multihead_attention (dense cache, Pallas decode kernel)")
+
+
+__all__ += [
+    "fused_matmul_bias", "fused_multi_head_attention", "fused_feedforward",
+    "fused_multi_transformer", "fused_bias_dropout_residual_layer_norm",
+    "fused_ec_moe", "block_multihead_attention",
+]

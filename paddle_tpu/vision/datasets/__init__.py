@@ -114,3 +114,139 @@ class Cifar10(_CifarBase):
 
 class Cifar100(_CifarBase):
     N_CLASSES = 100
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image dataset (paddle.vision.datasets.
+    DatasetFolder): root/class_x/xxx.png → (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(base, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(tuple(extensions)))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+
+        with open(path, "rb") as f:
+            return np.asarray(Image.open(f).convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat/recursive image folder without labels (paddle.vision.
+    datasets.ImageFolder): returns [sample] lists like the reference."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._pil_loader
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(base, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (paddle.vision.datasets.Flowers). Zero-egress build:
+    pass local `data_file`/`label_file`/`setid_file` paths (the same
+    .mat/.tgz artifacts the reference downloads); there is no
+    auto-download here."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        if download or not (data_file and label_file and setid_file):
+            raise RuntimeError(
+                "no network egress: place the Flowers-102 archives "
+                "locally and pass data_file/label_file/setid_file")
+        raise NotImplementedError(
+            "Flowers requires scipy.io loadmat of the official .mat "
+            "files; wire your local copies through DatasetFolder or a "
+            "custom Dataset")
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (paddle.vision.datasets.VOC2012); local
+    `data_file` tar required (zero egress)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download or not data_file:
+            raise RuntimeError(
+                "no network egress: pass the local VOCtrainval tar as "
+                "data_file")
+        import tarfile
+
+        self._items = []
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+        self._names = [n for n in names if n.endswith(".jpg")]
+        self.data_file = data_file
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        import tarfile
+
+        from PIL import Image
+
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(self._names[idx])
+            img = np.asarray(Image.open(f).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img
+
+    def __len__(self):
+        return len(self._names)

@@ -225,3 +225,28 @@ def test_incubate_lookahead_and_model_average():
     loss.backward()
     fl.step()
     fl.clear_grad()
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray((rs.rand(6, 6, 3) * 255).astype(np.uint8)) \
+                .save(str(d / f"{i}.png"))
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+    img, label = ds[0]
+    assert img.shape == (6, 6, 3) and label == 0
+    _, label_last = ds[5]
+    assert label_last == 1
+
+    flat = ImageFolder(str(tmp_path / "root"))
+    assert len(flat) == 6
+    (img,) = flat[0]
+    assert img.shape == (6, 6, 3)
