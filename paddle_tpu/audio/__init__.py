@@ -221,3 +221,5 @@ class features:
     MelSpectrogram = _MelSpectrogram
     LogMelSpectrogram = _LogMelSpectrogram
     MFCC = _MFCC
+
+from . import datasets  # noqa: F401,E402

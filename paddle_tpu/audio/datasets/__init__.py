@@ -1,0 +1,105 @@
+"""paddle.audio.datasets parity (`python/paddle/audio/datasets/`):
+TESS and ESC-50. Zero-egress build: both read LOCAL copies of the
+official archives/folders (the reference downloads them); `download=True`
+raises with instructions."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _load_wav(path, sample_rate=None):
+    import wave
+
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+    dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype).astype(np.float32)
+    data /= float(np.iinfo(dtype).max)
+    if ch > 1:
+        data = data.reshape(-1, ch).mean(axis=1)
+    return data, sr
+
+
+class TESS(Dataset):
+    """Toronto Emotional Speech Set: seven emotions from folder names
+    (reference audio/datasets/tess.py). Point `data_dir` at the local
+    extracted dataset."""
+
+    EMOTIONS = ("angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad")
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, download=False, **kwargs):
+        if download or not data_dir:
+            raise RuntimeError(
+                "no network egress: extract TESS locally and pass "
+                "data_dir=")
+        self.files = []
+        self.labels = []
+        for base, _, files in sorted(os.walk(data_dir)):
+            for f in sorted(files):
+                if not f.lower().endswith(".wav"):
+                    continue
+                for i, emo in enumerate(self.EMOTIONS):
+                    if emo in f.lower() or emo in base.lower():
+                        self.files.append(os.path.join(base, f))
+                        self.labels.append(i)
+                        break
+        if not self.files:
+            raise RuntimeError(f"no TESS wav files under {data_dir}")
+        fold = np.arange(len(self.files)) % n_folds + 1
+        keep = (fold != split) if mode == "train" else (fold == split)
+        self.files = [f for f, k in zip(self.files, keep) if k]
+        self.labels = [l for l, k in zip(self.labels, keep) if k]
+
+    def __getitem__(self, idx):
+        data, sr = _load_wav(self.files[idx])
+        return data, self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(Dataset):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    labels parsed from the official `{fold}-{src}-{take}-{target}.wav`
+    naming. Point `data_dir` at the local audio folder."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, download=False, **kwargs):
+        if download or not data_dir:
+            raise RuntimeError(
+                "no network egress: extract ESC-50 locally and pass "
+                "data_dir=")
+        self.files = []
+        self.labels = []
+        for base, _, files in sorted(os.walk(data_dir)):
+            for f in sorted(files):
+                if not f.lower().endswith(".wav"):
+                    continue
+                parts = os.path.splitext(f)[0].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, target = int(parts[0]), int(parts[3])
+                if (mode == "train") == (fold != split):
+                    self.files.append(os.path.join(base, f))
+                    self.labels.append(target)
+        if not self.files:
+            raise RuntimeError(f"no ESC-50 wav files under {data_dir}")
+
+    def __getitem__(self, idx):
+        data, sr = _load_wav(self.files[idx])
+        return data, self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)

@@ -250,3 +250,38 @@ def test_dataset_folder_and_image_folder(tmp_path):
     assert len(flat) == 6
     (img,) = flat[0]
     assert img.shape == (6, 6, 3)
+
+
+def test_audio_datasets_local(tmp_path):
+    import wave
+
+    from paddle_tpu.audio.datasets import ESC50, TESS
+
+    # synthesize tiny wavs in both naming schemes
+    def write_wav(path, n=160):
+        with wave.open(str(path), "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(16000)
+            w.writeframes((np.sin(np.arange(n)) * 3000)
+                          .astype(np.int16).tobytes())
+
+    tess_dir = tmp_path / "tess" / "OAF_angry"
+    tess_dir.mkdir(parents=True)
+    for i in range(4):
+        write_wav(tess_dir / f"OAF_word_angry_{i}.wav")
+    ds = TESS(mode="train", data_dir=str(tmp_path / "tess"))
+    x, y = ds[0]
+    assert y == 0 and x.dtype == np.float32 and len(ds) >= 2
+
+    esc_dir = tmp_path / "esc50"
+    esc_dir.mkdir()
+    for fold in (1, 2):
+        write_wav(esc_dir / f"{fold}-11111-A-{7 + fold}.wav")
+    tr = ESC50(mode="train", split=1, data_dir=str(esc_dir))
+    dv = ESC50(mode="dev", split=1, data_dir=str(esc_dir))
+    assert len(tr) == 1 and len(dv) == 1
+    _, y = dv[0]
+    assert y == 8
+    with pytest.raises(RuntimeError):
+        TESS(download=True)
