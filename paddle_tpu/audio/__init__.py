@@ -223,3 +223,7 @@ class features:
     MFCC = _MFCC
 
 from . import datasets  # noqa: F401,E402
+
+
+from . import backends  # noqa: F401,E402
+from .backends import info, load, save  # noqa: F401,E402

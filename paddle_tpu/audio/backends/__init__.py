@@ -1,0 +1,75 @@
+"""paddle.audio.backends parity: wave-format IO via the stdlib (the
+reference's default 'wave_backend'); soundfile is optional-absent here."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+__all__ = ["list_available_backends", "get_current_backend",
+           "set_backend", "info", "load", "save", "AudioInfo"]
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise ValueError(
+            "only the stdlib wave_backend is available in this image")
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(),
+                         w.getnchannels(), w.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    from ...core.tensor import Tensor
+
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+    dtype = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype).reshape(-1, ch)
+    if normalize:
+        data = data.astype(np.float32) / float(np.iinfo(dtype).max)
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    arr = np.asarray(src._value if hasattr(src, "_value") else src)
+    if channels_first:
+        arr = arr.T
+    if arr.dtype.kind == "f":
+        arr = (np.clip(arr, -1, 1) * 32767).astype(np.int16)
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(arr.astype(np.int16).tobytes())

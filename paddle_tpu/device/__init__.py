@@ -172,3 +172,66 @@ class cuda:  # namespace shim: reference exposes paddle.device.cuda
         lambda device=None: memory_reserved(device))
     max_memory_reserved = staticmethod(
         lambda device=None: max_memory_reserved(device))
+
+
+
+def get_cudnn_version():
+    return None  # no cuDNN tier on TPU
+
+
+class XPUPlace:
+    def __init__(self, id=0):
+        raise NotImplementedError(
+            "XPU is a second-vendor backend subsumed by PJRT here "
+            "(README Scope notes)")
+
+
+class IPUPlace:
+    def __init__(self, id=0):
+        raise NotImplementedError(
+            "IPU is not a target of this build (README Scope notes)")
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True  # XLA collectives are always in
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_custom_device():
+    return []
+
+
+def set_stream(stream=None):
+    """XLA owns stream scheduling; returns the current (no-op) stream."""
+    return current_stream()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def stream_guard(stream=None):
+    yield current_stream()

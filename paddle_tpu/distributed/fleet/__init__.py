@@ -193,3 +193,80 @@ from .. import recompute as _recompute_mod  # noqa: E402
 class utils:
     recompute = staticmethod(_recompute_mod.recompute)
     recompute_sequential = staticmethod(_recompute_mod.recompute_sequential)
+
+
+
+# reference fleet __all__ completion
+from ..topology import HybridTopology as HybridCommunicateGroup  # noqa: F401,E402
+from ..topology import HybridTopology as CommunicateTopology  # noqa: F401,E402
+
+
+class Fleet:
+    """The fleet facade class (fleet/fleet.py Fleet); module-level
+    init/distributed_model/... are the bound methods of the default
+    instance, mirroring the reference's `fleet = Fleet()` singleton."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+
+    @staticmethod
+    def is_first_worker():
+        from .. import get_rank
+
+        return get_rank() == 0
+
+    @staticmethod
+    def worker_index():
+        from .. import get_rank
+
+        return get_rank()
+
+    @staticmethod
+    def worker_num():
+        from .. import get_world_size
+
+        return get_world_size()
+
+
+class UtilBase:
+    """fleet.util role: tiny collective helpers over the topology."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from .. import all_reduce as _ar
+
+        return _ar(input)
+
+    def barrier(self, comm_world="worker"):
+        from .. import barrier as _b
+
+        return _b()
+
+    def get_file_shard(self, files):
+        from .. import get_rank, get_world_size
+
+        n = get_world_size()
+        return files[get_rank()::max(n, 1)]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+def _ps_role_gate(name):
+    class _Gate:
+        def __init__(self, *a, **kw):
+            raise NotImplementedError(
+                f"{name} configures parameter-server roles, excluded by "
+                "design (README Scope notes); collective mode needs no "
+                "role maker")
+
+    _Gate.__name__ = name
+    return _Gate
+
+
+UserDefinedRoleMaker = _ps_role_gate("UserDefinedRoleMaker")
+PaddleCloudRoleMaker = _ps_role_gate("PaddleCloudRoleMaker")
+MultiSlotDataGenerator = _ps_role_gate("MultiSlotDataGenerator")
+MultiSlotStringDataGenerator = _ps_role_gate("MultiSlotStringDataGenerator")

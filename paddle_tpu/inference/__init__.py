@@ -133,3 +133,80 @@ def convert_to_mixed_precision(*a, **kw):
     raise NotImplementedError(
         "mixed-precision conversion happens at save time on TPU: export "
         "under amp.auto_cast instead")
+
+
+
+class DataType:
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+
+
+class PlaceType:
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+Tensor = PredictorTensor  # reference paddle.inference.Tensor
+
+
+def get_version():
+    from .. import __version__
+
+    return f"paddle_tpu {__version__} (PJRT/XLA inference)"
+
+
+def _get_phi_kernel_name(op_name):
+    return op_name  # one op layer here; names are already kernel names
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as np
+
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.BOOL: 1}
+    return sizes.get(dtype, 4)
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT tier (README Scope notes)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+class XpuConfig:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "XPU is a second-vendor backend subsumed by PJRT "
+            "(README Scope notes)")
+
+
+class PredictorPool:
+    """Pool of predictors over one config (reference PredictorPool):
+    size-many independently steppable predictors."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrive(self, idx):
+        return self._predictors[idx]
+
+    retrieve = retrive  # reference spells it 'retrive'

@@ -1,1 +1,38 @@
 from .api import TranslatedLayer, load, not_to_static, save, to_static  # noqa: F401
+
+
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable=True):
+    """Globally toggle to_static conversion (reference
+    jit.enable_to_static): when off, decorated functions run eagerly."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def ignore_module(modules):
+    """Reference: exclude modules from dy2static conversion. The AST
+    tier already skips non-convertible callees by module allowlist
+    (dy2static._jst_call); recorded here for API parity."""
+    from . import dy2static
+
+    skip = getattr(dy2static, "_IGNORED_MODULES", set())
+    for m in (modules if isinstance(modules, (list, tuple)) else [modules]):
+        skip.add(getattr(m, "__name__", str(m)))
+    dy2static._IGNORED_MODULES = skip
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Debug knob (SOT code-dump level in the reference): here controls
+    whether converted AST source is printed."""
+    from . import dy2static
+
+    dy2static._DEBUG_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    from . import dy2static
+
+    dy2static._VERBOSITY = level

@@ -220,3 +220,40 @@ class Profiler:
         text = "\n".join(lines)
         print(text)
         return agg
+
+
+
+class SortedKeys:
+    """Summary-table sort keys (reference profiler.SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Summary view selector (reference profiler.SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(profiler_result, file_name):
+    """Persist a profiler result (reference export_protobuf writes the
+    paddle profiler pb; this runtime's on-disk trace format is
+    chrome-trace JSON — same information, readable by chrome://tracing
+    and perfetto). The file extension is honored as given."""
+    return profiler_result.export(file_name) \
+        if hasattr(profiler_result, "export") else None

@@ -391,3 +391,18 @@ class PTQ:
         fix(model)
         model.eval()
         return model
+
+
+
+def quanter(name):
+    """Class decorator registering a quanter under `name` (reference
+    quantization/factory.py quanter): makes the class discoverable via
+    the config factory."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+_QUANTER_REGISTRY = {}

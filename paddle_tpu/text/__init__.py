@@ -118,3 +118,14 @@ class datasets:
 
     class WMT16(_NeedsDownload):
         pass
+
+
+# top-level re-exports (reference paddle.text exposes the dataset
+# classes directly)
+Conll05st = datasets.Conll05st
+Imdb = datasets.Imdb
+Imikolov = datasets.Imikolov
+Movielens = datasets.Movielens
+UCIHousing = datasets.UCIHousing
+WMT14 = datasets.WMT14
+WMT16 = datasets.WMT16

@@ -2,3 +2,34 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend!r}")
+    if backend == "cv2":
+        raise ValueError("cv2 is not available in this image; use 'pil'")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (paddle.vision.image_load): PIL-backed."""
+    import numpy as np
+    from PIL import Image
+
+    b = backend or _image_backend
+    img = Image.open(path)
+    if b == "tensor":
+        from ..core.tensor import Tensor
+
+        return Tensor(np.asarray(img))
+    return img
