@@ -364,16 +364,82 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
                  bmm1_weight, bmm1_bias)
 
 
-def block_multihead_attention(*args, **kwargs):
-    """Paged/block KV-cache attention (block_multi_head_attention_kernel
-    role). The decode path here is `masked_multihead_attention` over a
-    dense [B, H, S, D] cache (Pallas decode kernel); a paged-block cache
-    is an inference-serving memory layout this build has not adopted —
-    LOUD gate with the supported alternative."""
-    raise NotImplementedError(
-        "block_multihead_attention's paged KV-cache layout is not "
-        "implemented; use incubate.nn.functional."
-        "masked_multihead_attention (dense cache, Pallas decode kernel)")
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder, seq_lens_decoder,
+                              seq_lens_this_time, padding_offsets=None,
+                              cum_offsets=None, cu_seqlens_q=None,
+                              cu_seqlens_k=None, block_tables=None,
+                              pre_key_cache=None, pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False,
+                              name=None, **kwargs):
+    """Paged (block) KV-cache decode attention
+    (`block_multi_head_attention_kernel.cu` role): each sequence's cache
+    lives in `block_size`-token blocks scattered through a shared block
+    pool, addressed by `block_tables` [B, max_blocks_per_seq].
+
+    Decode-step subset (one new token per sequence — the serving hot
+    path): the new token's K/V are written into the current block slot,
+    and attention runs over the gathered per-sequence blocks with a
+    validity mask from `seq_lens_decoder`. Quant/smooth scale inputs are
+    not supported (no int8 cache tier) and raise loudly.
+
+    qkv: [B, 3*H*D]; key_cache/value_cache: [num_blocks, H, block_size,
+    D]; returns (out [B, H*D], key_cache, value_cache) with the caches
+    functionally updated.
+    """
+    if any(s is not None for s in (cache_k_quant_scales,
+                                   cache_v_quant_scales,
+                                   cache_k_dequant_scales,
+                                   cache_v_dequant_scales, qkv_out_scale,
+                                   out_shift, out_smooth)):
+        raise NotImplementedError(
+            "block_multihead_attention: int8/smooth-quant cache scales "
+            "are not supported (no int8 cache tier in this build)")
+
+    from ....core.dispatch import apply
+    import jax
+    import jax.numpy as jnp
+
+    def f(qkv_v, kc, vc, dec_lens, bt):
+        b = qkv_v.shape[0]
+        nb, h, bs, d = kc.shape
+        qkv3 = qkv_v.reshape(b, 3, h, d)
+        q, k_new, v_new = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
+        lens = dec_lens.reshape(-1).astype(jnp.int32)   # tokens already cached
+        # write the new token at position lens[b] in its sequence:
+        blk_idx = lens // bs
+        slot = lens % bs
+        phys = jnp.take_along_axis(bt, blk_idx[:, None], axis=1)[:, 0]
+        kc = kc.at[phys, :, slot].set(k_new)
+        vc = vc.at[phys, :, slot].set(v_new)
+        # gather each sequence's blocks: [B, max_blocks, H, bs, D]
+        kb = kc[bt]
+        vb = vc[bt]
+        max_blocks = bt.shape[1]
+        s_max = max_blocks * bs
+        kseq = jnp.moveaxis(kb, 2, 1).reshape(b, h, s_max, d)
+        vseq = jnp.moveaxis(vb, 2, 1).reshape(b, h, s_max, d)
+        scale = 1.0 / (d ** 0.5)
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            kseq.astype(jnp.float32)) * scale
+        valid = jnp.arange(s_max)[None, :] <= lens[:, None]
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", probs,
+                         vseq.astype(jnp.float32))
+        return (out.reshape(b, h * d).astype(qkv_v.dtype), kc, vc)
+
+    return apply("block_multihead_attention", f, qkv, key_cache,
+                 value_cache, seq_lens_decoder, block_tables)
 
 
 __all__ += [

@@ -33,3 +33,50 @@ def test_masked_multihead_attention_decode_loop():
                 ref[b, h] = sum(p[j] * v[j, b, h] for j in range(t + 1))
         np.testing.assert_allclose(outs[t], ref.reshape(B, H * D),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_block_multihead_attention_decode_matches_dense():
+    """Paged-cache decode == dense-cache attention on the same tokens."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    rs = np.random.RandomState(0)
+    B, H, D, BS, NBLK = 2, 2, 8, 4, 6  # block_size 4, 6-block pool
+    max_blocks_per_seq = 3
+    # two sequences with 5 and 2 cached tokens
+    lens = np.array([5, 2], np.int32)
+    kc = np.zeros((NBLK, H, BS, D), np.float32)
+    vc = np.zeros((NBLK, H, BS, D), np.float32)
+    bt = np.array([[0, 2, 4], [1, 3, 5]], np.int32)
+    dense_k = np.zeros((B, H, 12, D), np.float32)
+    dense_v = np.zeros((B, H, 12, D), np.float32)
+    for b in range(B):
+        for t in range(lens[b]):
+            kv = rs.randn(H, D).astype(np.float32)
+            vv = rs.randn(H, D).astype(np.float32)
+            phys = bt[b, t // BS]
+            kc[phys, :, t % BS] = kv
+            vc[phys, :, t % BS] = vv
+            dense_k[b, :, t] = kv
+            dense_v[b, :, t] = vv
+    qkv = rs.randn(B, 3 * H * D).astype(np.float32)
+    out, kc2, vc2 = IF.block_multihead_attention(
+        P.to_tensor(qkv), P.to_tensor(kc), P.to_tensor(vc),
+        P.to_tensor(lens * 0), P.to_tensor(lens), P.to_tensor(lens * 0 + 1),
+        block_tables=P.to_tensor(bt), block_size=BS)
+    # dense reference: append new token, causal-decode attention
+    q3 = qkv.reshape(B, 3, H, D)
+    q, kn, vn = q3[:, 0], q3[:, 1], q3[:, 2]
+    for b in range(B):
+        dense_k[b, :, lens[b]] = kn[b]
+        dense_v[b, :, lens[b]] = vn[b]
+    logits = np.einsum("bhd,bhsd->bhs", q, dense_k) / np.sqrt(D)
+    valid = np.arange(12)[None, :] <= lens[:, None]
+    logits = np.where(valid[:, None, :], logits, -1e30)
+    pr = np.exp(logits - logits.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+    ref = np.einsum("bhs,bhsd->bhd", pr, dense_v).reshape(B, H * D)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+    # the new token landed in the right physical block slot
+    kc2 = np.asarray(kc2.numpy())
+    assert np.allclose(kc2[bt[0, 1], :, 1], kn[0])  # seq0: pos5 -> blk1 slot1
