@@ -8,11 +8,23 @@ Design (TPU-first, not a CUDA translation):
   * forward: grid (batch, heads, q_blocks); K/V live in VMEM per (b,h); an
     online-softmax fori_loop walks KV blocks with f32 running max/sum/acc —
     logits never materialize in HBM. Causal blocks that are fully masked are
-    skipped by bounding the loop.
+    skipped by bounding the loop, and fully-unmasked blocks (strictly below
+    the diagonal) take a mask-free body: the iota/compare/select chain only
+    runs on diagonal blocks, which matters because the kernel is VPU-bound
+    at head_dim 64 (PERF.md round-3 microbenchmarks).
+  * dots run in the input dtype (bf16 on TPU) with f32 accumulation via
+    preferred_element_type — casting operands to f32 first (round-2 design)
+    forces the MXU off its bf16 path and measured 4x slower. The softmax
+    scale is applied to the f32 logits, not the bf16 operands.
   * backward: recomputation-style — one kernel produces dQ (grid over
     q_blocks), one produces dK/dV (grid over kv_blocks), both replaying
-    blocked logits from saved (out, logsumexp) rather than storing P.
-  * dtype: IO in input dtype (bf16 on TPU), accumulation in f32.
+    blocked logits from saved (out, logsumexp) rather than storing P; same
+    bf16-dot + diagonal-only-masking treatment as forward.
+  * block sizes are autotuned per signature on a fwd+bwd run (cached on
+    disk; paddle/phi/kernels/autotune role). At B32 H12 S1024 D64 bf16 the
+    tuned kernel measures ~4x over the 128x128 static default.
+  * dtype: IO in input dtype, accumulation in f32; softmax stats rank-2
+    `(block_q, 1)` f32 (rank-1 stats blocks do not lower to Mosaic).
   * non-TPU backends run the same kernels through the Pallas interpreter so
     CPU tests validate the exact kernel code (fake-backend strategy,
     SURVEY §4.5).
@@ -33,9 +45,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+
+_DIMSEM = (pltpu.GridDimensionSemantics.PARALLEL,
+           pltpu.GridDimensionSemantics.PARALLEL,
+           pltpu.GridDimensionSemantics.ARBITRARY)
 
 
 _FORCE_COMPILED = False  # see force_tpu_lowering()
@@ -48,6 +64,15 @@ def _interpret():
         return jax.devices()[0].platform != "tpu"
     except Exception:
         return True
+
+
+def _compiler_params():
+    # dimension_semantics lets Mosaic reorder/parallelize the (b, h) grid
+    # axes; the trailing q/kv-block axis stays sequential (online softmax /
+    # accumulation carries). Interpreter mode rejects TPU compiler params.
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(dimension_semantics=_DIMSEM)
 
 
 @contextlib.contextmanager
@@ -87,55 +112,67 @@ def flash_attention_available(q) -> bool:
 # =========================== forward kernel ===========================
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                causal, seq_k):
+                causal, seq_q, seq_k):
     # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d]; o_ref: [block_q, d];
     # lse_ref: [block_q, 1].  Softmax stats are carried rank-2 (q positions
     # along sublanes, a single lane) — Mosaic requires >=2-D blocks whose
     # trailing dims tile to (8, 128) or equal the array dims; a rank-1
     # (block_q,) stats block does not lower (VERDICT r2 missing #2).
+    # Causal is bottom-right aligned like the reference (_ref_attention
+    # tril k=sk-sq): q row i attends k cols <= i + (seq_k - seq_q).
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
     iq = pl.program_id(2)
+    off = seq_k - seq_q  # causal diagonal offset (0 for self-attention)
 
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[:]  # input dtype; dots accumulate in f32
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-
     num_k_blocks = pl.cdiv(seq_k, block_k)
+
+    def make_body(masked):
+        def body(j, carry):
+            m, l, acc = carry
+            k = k_ref[pl.ds(j * block_k, block_k), :]
+            v = v_ref[pl.ds(j * block_k, block_k), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            if masked:
+                q_ids = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_ids = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                valid = k_ids < seq_k
+                if causal:
+                    valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                s = jnp.where(valid, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
+
     if causal:
-        # kv blocks strictly above the diagonal never contribute
-        q_end = (iq + 1) * block_q
-        num_iters = pl.cdiv(q_end, block_k)
+        # blocks with max k_id <= min q_id + off are fully unmasked:
+        # mask-free body; the diagonal remainder runs the masked body.
+        num_full = jnp.clip((iq * block_q + off + 1) // block_k,
+                            0, num_k_blocks)
+        num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
+                             num_full, num_k_blocks)
+        carry = jax.lax.fori_loop(0, num_full, make_body(False),
+                                  (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(num_full, num_iters, make_body(True),
+                                      carry)
     else:
-        num_iters = num_k_blocks
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or seq_k % block_k != 0:
-            q_ids = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_ids = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = k_ids < seq_k
-            if causal:
-                valid = jnp.logical_and(valid, q_ids >= k_ids)
-            s = jnp.where(valid, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(
+            0, num_k_blocks, make_body(seq_k % block_k != 0), (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[:] = (m + jnp.log(l_safe)).astype(jnp.float32)
@@ -143,7 +180,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
 
 def _pick_block(seq, pref):
     """Largest multiple of 8 ≤ pref that divides seq (avoids OOB dynamic
-    slices on the trailing block: refs are full-array, not pallas-padded)."""
+    slices on the trailing block: refs are full-array, not pallas-padded).
+    Loud on indivisible seq — a block that doesn't divide the sequence
+    would read/write out of bounds and silently corrupt the tail rows
+    (the dispatch gates route such shapes to the reference path; reaching
+    here means _flash_core was called directly)."""
+    if seq % 8 != 0:
+        raise ValueError(
+            f"flash attention Pallas kernel requires seq % 8 == 0, got "
+            f"{seq}; use nn.functional attention entry points, which fall "
+            "back to the fused-softmax reference path for such shapes")
     b = min(pref, seq)
     b -= b % 8
     while b > 8 and seq % b:
@@ -164,7 +210,7 @@ def _fwd(q, k, v, causal, block_q, block_k):
     grid = (b, h, pl.cdiv(sq, block_q))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                          causal=causal, seq_k=sk),
+                          causal=causal, seq_q=sq, seq_k=sk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
@@ -183,6 +229,7 @@ def _fwd(q, k, v, causal, block_q, block_k):
             jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2), lse
 
@@ -190,98 +237,122 @@ def _fwd(q, k, v, causal, block_q, block_k):
 # =========================== backward kernels ===========================
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
-                   scale, block_k, causal, seq_k):
+                   scale, block_k, causal, seq_q, seq_k):
     block_q = q_ref.shape[0]
     d = q_ref.shape[1]
     iq = pl.program_id(2)
+    off = seq_k - seq_q
 
-    q = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
-    o = o_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]  # [bq, 1]
-    delta = jnp.sum(do * o, axis=1, keepdims=True)  # [bq, 1]
+    q = q_ref[:]
+    do = do_ref[:]
+    lse = lse_ref[:]  # [bq, 1] f32
+    delta = jnp.sum(do_ref[:].astype(jnp.float32) *
+                    o_ref[:].astype(jnp.float32), axis=1, keepdims=True)
+    num_k_blocks = pl.cdiv(seq_k, block_k)
 
+    def make_body(masked):
+        def body(j, dq):
+            k = k_ref[pl.ds(j * block_k, block_k), :]
+            v = v_ref[pl.ds(j * block_k, block_k), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            if masked:
+                q_ids = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_ids = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                valid = k_ids < seq_k
+                if causal:
+                    valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                s = jnp.where(valid, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta) * scale).astype(q.dtype)
+            return dq + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return body
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
     if causal:
-        num_iters = pl.cdiv((iq + 1) * block_q, block_k)
+        num_full = jnp.clip((iq * block_q + off + 1) // block_k,
+                            0, num_k_blocks)
+        num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
+                             num_full, num_k_blocks)
+        dq = jax.lax.fori_loop(0, num_full, make_body(False), dq0)
+        dq = jax.lax.fori_loop(num_full, num_iters, make_body(True), dq)
     else:
-        num_iters = pl.cdiv(seq_k, block_k)
-
-    def body(j, dq):
-        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or seq_k % block_k != 0:
-            q_ids = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_ids = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = k_ids < seq_k
-            if causal:
-                valid = jnp.logical_and(valid, q_ids >= k_ids)
-            s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, num_iters, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+        dq = jax.lax.fori_loop(0, num_k_blocks,
+                               make_body(seq_k % block_k != 0), dq0)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
-                    dv_ref, *, scale, block_q, causal, seq_q):
+                    dv_ref, *, scale, block_q, causal, seq_q, seq_k):
     block_k = k_ref.shape[0]
     d = k_ref.shape[1]
     jk = pl.program_id(2)
+    off = seq_k - seq_q
 
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    k = k_ref[:]
+    v = v_ref[:]
 
-    if causal:
-        start_block = (jk * block_k) // block_q
-    else:
-        start_block = 0
+    def make_body(masked):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[pl.ds(i * block_q, block_q), :]
+            do = do_ref[pl.ds(i * block_q, block_q), :]
+            o = o_ref[pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[pl.ds(i * block_q, block_q), :]  # [bq, 1]
+            delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                            axis=1, keepdims=True)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            if masked:
+                q_ids = i * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_ids = jk * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                valid = q_ids < seq_q
+                if causal:
+                    valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                s = jnp.where(valid, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            pc = p.astype(do.dtype)
+            dv_new = dv + jax.lax.dot_general(
+                pc, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta) * scale).astype(q.dtype)
+            dk_new = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+        return body
+
     num_iters = pl.cdiv(seq_q, block_q)
-
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q), :]  # [bq, 1]
-        delta = jnp.sum(do * o, axis=1, keepdims=True)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal or seq_q % block_q != 0:
-            q_ids = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_ids = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = q_ids < seq_q
-            if causal:
-                valid = jnp.logical_and(valid, q_ids >= k_ids)
-            s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk_new, dv_new
-
-    dk, dv = jax.lax.fori_loop(
-        start_block, num_iters, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)))
+    carry = (jnp.zeros((block_k, d), jnp.float32),
+             jnp.zeros((block_k, d), jnp.float32))
+    tail_masked = seq_q % block_q != 0
+    if causal:
+        # bottom-right alignment: kv block jk is seen by q rows
+        # >= jk*block_k - off. q blocks with min q_id + off >= max k_id
+        # are fully unmasked; between the diagonal and there runs masked.
+        start_block = jnp.clip((jk * block_k - off) // block_q,
+                               0, num_iters)
+        first_full = -(-((jk + 1) * block_k - 1 - off) // block_q)  # ceil
+        first_full = jnp.clip(first_full, start_block, num_iters)
+        carry = jax.lax.fori_loop(start_block, first_full, make_body(True),
+                                  carry)
+        dk, dv = jax.lax.fori_loop(first_full, num_iters,
+                                   make_body(tail_masked), carry)
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_iters, make_body(tail_masked),
+                                   carry)
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
@@ -306,24 +377,26 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                          causal=causal, seq_k=sk),
+                          causal=causal, seq_q=sq, seq_k=sk),
         grid=(b, h, pl.cdiv(sq, block_q)),
         in_specs=[q_spec, k_spec_full, k_spec_full, q_spec, lse_spec, q_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(qt, kt, vt, ot, lse, dot)
 
     kv_spec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          causal=causal, seq_q=sq),
+                          causal=causal, seq_q=sq, seq_k=sk),
         grid=(b, h, pl.cdiv(sk, block_k)),
         in_specs=[full_q, kv_spec, kv_spec, full_q, full_lse, full_q],
         out_specs=[kv_spec, kv_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(qt, kt, vt, ot, lse, dot)
 
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
@@ -375,12 +448,27 @@ def _ref_attention(q, k, v, mask, is_causal):
 
 def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
     """Autotuned (block_q, block_k) for this attention signature
-    (paddle/phi/kernels/autotune role; cached per signature on disk)."""
+    (paddle/phi/kernels/autotune role; cached per signature on disk).
+
+    Tuned on a fwd+bwd run — training is the dominant workload and the
+    same (block_q, block_k) pair parameterizes both directions through
+    the custom VJP. Measured at B32 H12 S1024 D64 bf16: tuned (1024,1024)
+    fwd ≈ 1.3 ms vs 128x128 ≈ 6.0 ms (PERF.md)."""
     from . import autotune
 
+    sizes = (128, 256, 512, 1024)
+
+    def vmem_est(bq, bk):
+        # f32 logits block (s and p live together) + full K/V + q/o/acc;
+        # must leave headroom in the ~16 MB/core VMEM budget
+        itemsize = jnp.dtype(dtype).itemsize
+        return (2 * bq * bk * 4 + 2 * sk * d * itemsize
+                + 2 * bq * d * itemsize + bq * d * 4)
+
     cands = [(bq, bk)
-             for bq in (128, 256, 512) for bk in (128, 256, 512)
-             if sq % bq == 0 and sk % bk == 0 and bq <= sq and bk <= sk]
+             for bq in sizes for bk in sizes
+             if sq % bq == 0 and sk % bk == 0 and bq <= sq and bk <= sk
+             and vmem_est(bq, bk) <= 12 * 1024 * 1024]
     default = (_pick_block(sq, DEFAULT_BLOCK_Q),
                _pick_block(sk, DEFAULT_BLOCK_K))
     if len(cands) <= 1:
@@ -392,10 +480,15 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
         qv = jnp.asarray(rs.randn(b, sq, h, d), dtype)
         kv = jnp.asarray(rs.randn(b, sk, h, d), dtype)
         vv = jnp.asarray(rs.randn(b, sk, h, d), dtype)
-        return _flash_core(qv, kv, vv, causal, cfg[0], cfg[1])
+
+        def loss(qv):
+            return _flash_core(qv, kv, vv, causal, cfg[0],
+                               cfg[1]).astype(jnp.float32).sum()
+
+        return jax.grad(loss)(qv)
 
     sig = f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
-    return autotune.pick("flash_fwd", sig, cands, run, default)
+    return autotune.pick("flash_fwdbwd", sig, cands, run, default)
 
 
 def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
@@ -403,7 +496,8 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
     """[B, S, H, D] in/out. Pallas kernel for causal/full; additive or
     boolean masks use the fused-softmax reference path. Block sizes are
     autotuned per signature unless passed explicitly."""
-    if mask is not None or not flash_attention_available(q):
+    if mask is not None or not flash_attention_available(q) \
+            or k.shape[1] % 8 != 0:
         return _ref_attention(q, k, v, mask, is_causal)
     if block_q is None or block_k is None:
         bq, bk = _tuned_blocks(q.shape[0], q.shape[1], k.shape[1],

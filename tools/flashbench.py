@@ -1,0 +1,98 @@
+"""Flash-attention kernel micro-benchmark on the real chip.
+
+Slope timing (PERF.md methodology): chain N iterations with a data
+dependency, fetch one scalar, subtract two chain lengths to cancel the
+tunnel's fixed dispatch+fetch cost.
+
+Usage:
+    python tools/flashbench.py [--fwd-only] [--blocks 128x128,256x256,...]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.ops.pallas import flash_attention as FA  # noqa: E402
+
+B, H, S, D = 32, 12, 1024, 64
+CAUSAL = True
+DTYPE = jnp.bfloat16
+
+
+def sync(x):
+    return float(np.asarray(jax.device_get(x.ravel()[0:1]), np.float32)[0])
+
+
+def slope(f, q, n1=3, n2=9):
+    def chain(n):
+        x = q
+        for _ in range(n):
+            x = f(x)
+        return sync(x)
+
+    chain(1)
+    chain(1)
+    t0 = time.perf_counter(); chain(n1); d1 = time.perf_counter() - t0
+    t0 = time.perf_counter(); chain(n2); d2 = time.perf_counter() - t0
+    return (d2 - d1) / (n2 - n1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fwd-only", action="store_true")
+    ap.add_argument("--blocks", default="128x128,256x256,256x512,512x512,512x1024,1024x1024")
+    ap.add_argument("--shape", default=f"{B}x{H}x{S}x{D}")
+    args = ap.parse_args()
+    b, h, s, d = map(int, args.shape.split("x"))
+
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, s, h, d), DTYPE)
+    k = jnp.asarray(rs.randn(b, s, h, d), DTYPE)
+    v = jnp.asarray(rs.randn(b, s, h, d), DTYPE)
+
+    # causal attention FLOPs (fwd): 2 matmuls * 2*S^2*D * 0.5 causal
+    flops_fwd = b * h * (4 * s * s * d) * (0.5 if CAUSAL else 1.0)
+
+    def report(name, t, mult):
+        fl = flops_fwd * mult
+        print(f"{name:40s} {t*1e3:8.2f} ms  {fl/t/1e12:7.2f} TFLOP/s")
+
+    # jnp reference
+    if args.fwd_only:
+        ref = jax.jit(lambda q: FA._ref_attention(q, k, v, None, CAUSAL))
+        report("jnp ref fwd", slope(lambda x: ref(x), q), 1)
+    else:
+        refg = jax.jit(jax.grad(lambda q: FA._ref_attention(
+            q, k, v, None, CAUSAL).astype(jnp.float32).sum()))
+        report("jnp ref fwd+bwd(dq,..)", slope(lambda x: refg(x), q), 3.5)
+
+    for blk in args.blocks.split(","):
+        bq, bk = map(int, blk.split("x"))
+        if s % bq or s % bk:
+            continue
+        try:
+            if args.fwd_only:
+                f = jax.jit(lambda q, bq=bq, bk=bk: FA._flash_core(
+                    q, k, v, CAUSAL, bq, bk))
+                t = slope(lambda x: f(x), q)
+                report(f"pallas fwd {bq}x{bk}", t, 1)
+            else:
+                f = jax.jit(jax.grad(
+                    lambda q, bq=bq, bk=bk: FA._flash_core(
+                        q, k, v, CAUSAL, bq, bk).astype(jnp.float32).sum()))
+                t = slope(lambda x: f(x), q)
+                report(f"pallas fwd+bwd {bq}x{bk}", t, 3.5)
+        except Exception as e:
+            print(f"pallas {bq}x{bk} FAILED: {type(e).__name__}: {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()
