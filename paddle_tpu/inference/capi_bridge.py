@@ -1,0 +1,95 @@
+"""Python side of the C inference ABI (paddle_tpu/native/src/capi.cc).
+
+Role parity: `paddle/fluid/inference/capi_exp/` (C API) — the reference
+exposes its predictor to C/Go through a C ABI; ours exposes the AOT XLA
+predictor the same way. The C library talks to this module exclusively
+through (bytes, shape, dtype-code) triples so it never needs the NumPy C
+API: `capi.cc` packs raw buffers into PyBytes and unpacks the returned
+triples back into malloc'd C buffers.
+
+Handles are process-local integer ids (the C side is free-threaded; the
+registry is guarded by the GIL which the C side holds on every call).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# codes shared with paddle_tpu.inference.DataType and capi.cc
+_DTYPES = {
+    0: np.float32,
+    1: np.int64,
+    2: np.int32,
+    3: np.uint8,
+    4: np.int8,
+    5: np.float16,
+    7: np.bool_,
+}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+_BF16_CODE = 6
+
+_registry: dict = {}
+_next_id = 1
+
+
+def create(path_prefix: str) -> int:
+    """Load an exported inference model; returns a handle (>0)."""
+    global _next_id
+    from . import Config, Predictor
+
+    pred = Predictor(Config(path_prefix))
+    h = _next_id
+    _next_id += 1
+    _registry[h] = pred
+    return h
+
+
+def input_num(h: int) -> int:
+    return len(_registry[h].get_input_names())
+
+
+def output_num(h: int) -> int:
+    return len(_registry[h].get_output_names())
+
+
+def io_name(h: int, is_input: int, idx: int) -> str:
+    pred = _registry[h]
+    names = pred.get_input_names() if is_input else pred.get_output_names()
+    return names[idx]
+
+
+def _decode(triple):
+    data, shape, code = triple
+    if code == _BF16_CODE:
+        import jax.numpy as jnp
+
+        arr = np.frombuffer(data, dtype=jnp.bfloat16)
+    elif code in _DTYPES:
+        arr = np.frombuffer(data, dtype=_DTYPES[code])
+    else:
+        raise ValueError(f"capi: unknown dtype code {code}")
+    return arr.reshape(shape)
+
+
+def _encode(arr):
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.name == "bfloat16":
+        code = _BF16_CODE
+    else:
+        code = _CODES.get(arr.dtype)
+        if code is None:  # e.g. float64 from a CPU-run program: narrow
+            arr = arr.astype(np.float32)
+            code = 0
+    return arr.tobytes(), tuple(int(s) for s in arr.shape), code
+
+
+def run(h: int, inputs):
+    """inputs: list of (bytes, shape-tuple, dtype-code). Returns the same
+    triple format for every fetch output."""
+    pred = _registry[h]
+    arrs = [_decode(t) for t in inputs]
+    outs = pred.run(arrs)
+    return [_encode(np.asarray(o)) for o in outs]
+
+
+def destroy(h: int) -> int:
+    return 1 if _registry.pop(h, None) is not None else 0

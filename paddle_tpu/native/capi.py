@@ -1,0 +1,164 @@
+"""Build + ctypes harness for the C inference API (src/capi.cc).
+
+Role parity: `paddle/fluid/inference/capi_exp/` — the C ABI a C/Go
+deployment links against. The library is built lazily with g++ (like the
+rest of the native tier) and embeds CPython; inside an existing Python
+process (tests) the embedded-interpreter path short-circuits and the calls
+ride the host interpreter's GIL.
+
+C consumers: include `src/paddle_tpu_capi.h`, link `libpaddle_tpu_capi.so`
+and libpython, set PYTHONPATH to reach `paddle_tpu`.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD, "libpaddle_tpu_capi.so")
+_HASH_FILE = os.path.join(_BUILD, ".capi.srchash")
+_SRCS = ("capi.cc", "paddle_tpu_capi.h")
+_lock = threading.Lock()
+_lib = None
+
+PD_MAX_NDIM = 8
+
+
+class PD_TensorData(ctypes.Structure):
+    _fields_ = [
+        ("dtype", ctypes.c_int32),
+        ("ndim", ctypes.c_int32),
+        ("shape", ctypes.c_int64 * PD_MAX_NDIM),
+        ("data", ctypes.c_void_p),
+        ("nbytes", ctypes.c_int64),
+    ]
+
+
+def _src_hash():
+    h = hashlib.sha256()
+    for f in _SRCS:
+        with open(os.path.join(_HERE, "src", f), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()
+
+
+def _stale():
+    if not os.path.exists(_SO) or not os.path.exists(_HASH_FILE):
+        return True
+    with open(_HASH_FILE) as fh:
+        return fh.read().strip() != _src_hash()
+
+
+def _python_link_flags():
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    flags = [f"-I{inc}"]
+    if libdir:
+        flags += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    flags.append(f"-lpython{ver}")
+    return flags
+
+
+def _compile():
+    import fcntl
+
+    os.makedirs(_BUILD, exist_ok=True)
+    with open(os.path.join(_BUILD, ".capi.buildlock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        try:
+            if not _stale():
+                return
+            tmp = f"{_SO}.tmp.{os.getpid()}"
+            cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                    "-pthread", "-o", tmp,
+                    os.path.join(_HERE, "src", "capi.cc"),
+                    f"-I{os.path.join(_HERE, 'src')}"]
+                   + _python_link_flags())
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.rename(tmp, _SO)
+            with open(_HASH_FILE + ".tmp", "w") as fh:
+                fh.write(_src_hash())
+            os.rename(_HASH_FILE + ".tmp", _HASH_FILE)
+        finally:
+            fcntl.flock(lockf, fcntl.LOCK_UN)
+
+
+def load():
+    """Build (if stale) and load the C API with typed ctypes signatures."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _stale():
+            _compile()
+        lib = ctypes.CDLL(_SO)
+        lib.PD_PredictorCreate.restype = ctypes.c_int
+        lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p]
+        for f in (lib.PD_PredictorInputNum, lib.PD_PredictorOutputNum,
+                  lib.PD_PredictorDestroy):
+            f.restype = ctypes.c_int
+            f.argtypes = [ctypes.c_int]
+        for f in (lib.PD_PredictorInputName, lib.PD_PredictorOutputName):
+            f.restype = ctypes.c_int
+            f.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+                          ctypes.c_size_t]
+        lib.PD_PredictorRun.restype = ctypes.c_int
+        lib.PD_PredictorRun.argtypes = [
+            ctypes.c_int, ctypes.POINTER(PD_TensorData), ctypes.c_int,
+            ctypes.POINTER(PD_TensorData), ctypes.c_int]
+        lib.PD_ReleaseOutputs.restype = None
+        lib.PD_ReleaseOutputs.argtypes = [ctypes.POINTER(PD_TensorData),
+                                          ctypes.c_int]
+        lib.PD_LastError.restype = ctypes.c_char_p
+        lib.PD_LastError.argtypes = []
+        _lib = lib
+        return _lib
+
+
+_NP_CODES = {"float32": 0, "int64": 1, "int32": 2, "uint8": 3, "int8": 4,
+             "float16": 5, "bfloat16": 6, "bool": 7}
+
+
+def np_to_td(arr):
+    """Pack a numpy array into a PD_TensorData (keeps a ref to the bytes —
+    hold the return value alive for the duration of the call)."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr)
+    code = _NP_CODES.get(arr.dtype.name)
+    if code is None:
+        raise TypeError(f"unsupported dtype {arr.dtype}")
+    td = PD_TensorData()
+    td.dtype = code
+    td.ndim = arr.ndim
+    for i, s in enumerate(arr.shape):
+        td.shape[i] = s
+    buf = arr.tobytes()
+    td.data = ctypes.cast(ctypes.create_string_buffer(buf, len(buf)),
+                          ctypes.c_void_p)
+    td.nbytes = len(buf)
+    return td
+
+
+def td_to_np(td):
+    """Copy a PD_TensorData (filled by PD_PredictorRun) into numpy."""
+    import numpy as np
+
+    inv = {v: k for k, v in _NP_CODES.items()}
+    name = inv[int(td.dtype)]
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        dt = np.dtype(jnp.bfloat16)
+    else:
+        dt = np.dtype(name)
+    raw = ctypes.string_at(td.data, td.nbytes)
+    shape = tuple(td.shape[i] for i in range(td.ndim))
+    return np.frombuffer(raw, dtype=dt).reshape(shape).copy()

@@ -228,3 +228,51 @@ def test_custom_op_runtime_registration():
     y = rs.randn(4, 5).astype(np.float32)
     out2 = lib.my_scaled_add(P.to_tensor(x), P.to_tensor(y))
     np.testing.assert_allclose(out2.numpy(), 2 * x + 3 * y, rtol=1e-5)
+
+
+def test_c_inference_api(tmp_path):
+    """C inference ABI (reference capi_exp role): build libpaddle_tpu_capi,
+    load it with ctypes, and run a saved model end-to-end through the raw
+    C structs — the same path a C/Go deployment uses."""
+    import ctypes
+
+    import paddle_tpu.nn as nn
+    from paddle_tpu import static
+    from paddle_tpu.native import capi
+
+    static.reset_default_programs()
+    P.enable_static()
+    x = static.data("x", [-1, 4], "float32")
+    lin = nn.Linear(4, 3)
+    out = lin(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "cmodel")
+    static.save_inference_model(prefix, [x], [out], exe)
+
+    lib = capi.load()
+    h = lib.PD_PredictorCreate(prefix.encode())
+    assert h > 0, lib.PD_LastError().decode()
+    assert lib.PD_PredictorInputNum(h) == 1
+    assert lib.PD_PredictorOutputNum(h) == 1
+    buf = ctypes.create_string_buffer(64)
+    n = lib.PD_PredictorInputName(h, 0, buf, 64)
+    assert n > 0 and buf.value == b"x"
+
+    xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    td_in = capi.np_to_td(xv)
+    outs = (capi.PD_TensorData * 4)()
+    n_out = lib.PD_PredictorRun(h, ctypes.byref(td_in), 1, outs, 4)
+    assert n_out == 1, lib.PD_LastError().decode()
+    got = capi.td_to_np(outs[0])
+    lib.PD_ReleaseOutputs(outs, n_out)
+
+    (ref,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    # error surface: bad handle
+    assert lib.PD_PredictorRun(9999, ctypes.byref(td_in), 1, outs, 4) < 0
+    assert b"9999" in lib.PD_LastError() or lib.PD_LastError()
+    assert lib.PD_PredictorDestroy(h) == 1
+    assert lib.PD_PredictorDestroy(h) == 0
+    P.disable_static()
+    static.reset_default_programs()
