@@ -27,11 +27,19 @@ from .tensor import Tensor
 
 _amp_cast_hook = None  # installed by paddle_tpu.amp
 _op_stats_sink = None  # installed by amp.debugging op-stats collection
+_sot_recorder = None   # installed by jit.sot during eager capture
 
 
 def set_amp_cast_hook(fn):
     global _amp_cast_hook
     _amp_cast_hook = fn
+
+
+def set_sot_recorder(fn):
+    """fn(name, raw_fn, args, kwargs, out) called after each dispatched op
+    (jit.sot eager-capture tier), or None to disable."""
+    global _sot_recorder
+    _sot_recorder = fn
 
 
 def set_op_stats_sink(sink):
@@ -88,7 +96,10 @@ def apply(name, fn, *args, **kwargs):
             sg = not any(not leaves[i].stop_gradient for i in tensor_pos)
         else:
             sg = True
-        return _wrap_outputs(out, stop_gradient=sg)
+        wrapped = _wrap_outputs(out, stop_gradient=sg)
+        if _sot_recorder is not None:
+            _sot_recorder(name, fn, args, kwargs, wrapped)
+        return wrapped
 
     # --- autograd path ---
     diff_pos = [
@@ -132,7 +143,10 @@ def apply(name, fn, *args, **kwargs):
         if not t.stop_gradient:
             t._grad_node = (node, i)
         wrapped.append(t)
-    return jax.tree_util.tree_unflatten(out_tree, wrapped)
+    result = jax.tree_util.tree_unflatten(out_tree, wrapped)
+    if _sot_recorder is not None:
+        _sot_recorder(name, fn, args, kwargs, result)
+    return result
 
 
 class _VjpAdapter:

@@ -66,6 +66,19 @@ class _DeferredKey:
 _DEFERRED = _DeferredKey()
 
 
+class OpKey:
+    """A PRNG key passed as an op argument, tagged so capture tiers can
+    recognize it structurally (legacy uint32[2] keys are indistinguishable
+    from data by dtype): the SOT tier substitutes a per-call fold_in of a
+    threaded key here, which is what makes dropout resample across compiled
+    replays instead of baking the capture-time mask."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+
 def split_for_op():
     """Key for a random op body. Eager/trace: split NOW at dispatch — the
     concrete key is captured by the op's pure fn, so vjp re-evaluation
@@ -77,11 +90,13 @@ def split_for_op():
 
     if flags.in_static_mode():
         return _DEFERRED
-    return default_generator.split()
+    return OpKey(default_generator.split())
 
 
 def materialize(key):
     """First line of a random op body: resolve a possibly-deferred key."""
+    if isinstance(key, OpKey):
+        return key.key
     if isinstance(key, _DeferredKey):
         return default_generator.split()
     return key

@@ -173,16 +173,31 @@ class Tensor:
         return ops.assign(self)
 
     # --- host interop -------------------------------------------------------
+    # _force_hook (jit.sot capture): observes every point where a concrete
+    # value leaves tensor-land — each is a graph break + branch guard in
+    # the SOT tier (reference sot/opcode_translator BreakGraphError sites)
+    _force_hook = None
+
+    @classmethod
+    def _set_force_hook(cls, fn):
+        cls._force_hook = fn
+
+    def _forced(self, kind, value):
+        hook = Tensor._force_hook
+        if hook is not None:
+            hook(self, kind, value)
+        return value
+
     def numpy(self):
-        return np.asarray(self._value)
+        return self._forced("value", np.asarray(self._value))
 
     def item(self, *args):
         if args:
-            return self.numpy().item(*args)
-        return self.numpy().item()
+            return self._forced("value", np.asarray(self._value)).item(*args)
+        return self._forced("value", np.asarray(self._value)).item()
 
     def tolist(self):
-        return self.numpy().tolist()
+        return self._forced("value", np.asarray(self._value)).tolist()
 
     def __dlpack__(self, *a, **kw):
         return self._value.__dlpack__(*a, **kw)
@@ -263,16 +278,16 @@ class Tensor:
         if self.size != 1:
             raise ValueError(
                 "The truth value of a multi-element Tensor is ambiguous")
-        return bool(self.numpy())
+        return self._forced("bool", bool(np.asarray(self._value)))
 
     def __int__(self):
-        return int(self.numpy())
+        return self._forced("int", int(np.asarray(self._value)))
 
     def __float__(self):
-        return float(self.numpy())
+        return self._forced("float", float(np.asarray(self._value)))
 
     def __index__(self):
-        return int(self.numpy())
+        return self._forced("int", int(np.asarray(self._value)))
 
     def __iter__(self):
         for i in range(len(self)):

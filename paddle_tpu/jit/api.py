@@ -175,7 +175,18 @@ _DYN = _Dyn()
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
+    """backend=None (default): trace + AST dy2static into one XLA program.
+    backend='sot': the SOT-role eager-capture tier (jit/sot/) — arbitrary
+    Python incl. source-less functions, graph breaks at value forces,
+    guarded branch cache (reference's default `to_static` tier)."""
     def decorate(fn):
+        if backend in ("sot", "SOT"):
+            from .sot import symbolic_translate
+
+            if isinstance(fn, Layer):
+                fn.forward = symbolic_translate(fn.forward)
+                return fn
+            return symbolic_translate(fn)
         if isinstance(fn, Layer):
             fn.forward = StaticFunction(fn.forward, input_spec)
             return fn

@@ -1,0 +1,38 @@
+"""SOT-role graph capture: arbitrary-Python capture with graph breaks.
+
+Role parity: `python/paddle/jit/sot/` — the reference's default `to_static`
+tier captures arbitrary Python through a CPython eval-frame hook +
+symbolic bytecode interpreter (`opcode_translator/executor/
+opcode_executor.py`), emitting StatementIR subgraphs separated by graph
+breaks, guarded and cached per input signature.
+
+TPU-first redesign (not a bytecode port): the eval-frame/bytecode
+machinery exists because the reference must intercept ops *without
+executing them*. Here every op already flows through one dispatch gate
+(`core/dispatch.py`), so capture runs the function EAGERLY once per
+(signature, branch-path) and records each dispatched op into an SSA list
+— arbitrary Python (closures, comprehensions, dict flow, functions with
+no retrievable source — the AST tier's blind spot) just executes, no
+interpreter needed. What the bytecode tier calls a graph break surfaces
+here as a *force point*: `bool()/int()/float()/item()/numpy()` on a
+Tensor. Each force ends the current segment, the forced value becomes a
+segment output, and the concrete outcome keys a branch table to the next
+segment — exactly the reference's subgraph + guard + cache structure
+(`sot/opcode_translator/executor/guard.py` role), with re-capture on an
+unseen outcome instead of re-translation.
+
+Execution: each segment replays as one jitted pure function dispatched as
+ONE framework op, so eager autograd composes across segments and graph
+breaks (the reference runs its subgraphs through partial_program the same
+way). Randomness: PRNG keys recorded in op args are re-derived from a
+per-call key threaded into every segment, so dropout resamples across
+replays instead of baking the capture-time mask.
+
+Entry points: `symbolic_translate(fn)` (reference `sot/translate.py`
+name) / `sot_capture(fn)`.
+"""
+from .capture import (  # noqa: F401
+    SOTError, SOTFunction, sot_capture, symbolic_translate,
+)
+
+__all__ = ["symbolic_translate", "sot_capture", "SOTFunction", "SOTError"]

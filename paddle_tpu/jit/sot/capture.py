@@ -1,0 +1,465 @@
+"""Eager-capture engine behind paddle_tpu.jit.sot (see package docstring).
+
+Data model
+----------
+Capture interprets one call eagerly, producing a flat trace:
+  * op records   — (name, raw_fn, leafspec, treedef, n_out, out_refs):
+                   one dispatched op; leafspec tags each flattened arg
+                   leaf as a prior SSA value ("ref"), an implicit input
+                   ("imp": a live Tensor outside the trace, e.g. a layer
+                   parameter — re-read at every replay so optimizer steps
+                   stay visible), a PRNG key ("rng": re-derived per call),
+                   or a Python literal ("py").
+  * force events — a Tensor left tensor-land via bool/int/float/item/
+                   numpy/tolist; ends the current segment, keys a branch.
+The trace then splits into segments at force events; each segment becomes
+one jitted replay function whose outputs are the SSA values still live
+downstream (+ the forced value). Chains are cached in a trie keyed by
+(input signature) then (force outcomes), reference guard+cache role.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dispatch, rng
+from ...core.tensor import Tensor
+
+MAX_PATHS_PER_SIG = 64
+
+_RECAPTURE = object()  # _replay sentinel: guard miss / unseen branch
+
+
+class SOTError(RuntimeError):
+    pass
+
+
+_dummy = None
+
+
+def _dummy_key():
+    """Shared placeholder key Tensor for RNG-free segments."""
+    global _dummy
+    if _dummy is None:
+        _dummy = Tensor(jnp.zeros((), jnp.uint32), stop_gradient=True)
+    return _dummy
+
+
+def _is_prng_key(x):
+    try:
+        return jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _sig_of(x):
+    if isinstance(x, Tensor):
+        return ("T", tuple(x._value.shape), str(x._value.dtype))
+    if isinstance(x, jax.Array):
+        return ("A", tuple(x.shape), str(x.dtype))
+    if isinstance(x, (list, tuple)):
+        return (type(x).__name__,) + tuple(_sig_of(v) for v in x)
+    if isinstance(x, dict):
+        return tuple(sorted((k, _sig_of(v)) for k, v in x.items()))
+    if isinstance(x, np.ndarray):
+        return ("N", x.shape, str(x.dtype), x.tobytes())
+    return ("S", repr(x))
+
+
+def _outcome_key(kind, value):
+    """Hashable branch-table key for a forced value."""
+    if isinstance(value, np.ndarray):
+        return (kind, value.shape, str(value.dtype), value.tobytes())
+    if isinstance(value, (list, tuple)):
+        return (kind, repr(value))
+    return (kind, value)
+
+
+# =========================== trace recording ===========================
+
+class _Trace:
+    """Flat eager trace of one call: op records and force events."""
+
+    def __init__(self):
+        self.events = []          # ("op", rec) | ("force", kind, ref, out)
+        self.env = {}             # id(Tensor) -> ssa ref
+        self.keepalive = []       # Tensors backing env ids (id-reuse guard)
+        self.implicit = {}        # ssa ref -> Tensor (live external reads)
+        self.n_refs = 0
+        self.n_rng = 0
+
+    def new_ref(self):
+        r = self.n_refs
+        self.n_refs += 1
+        return r
+
+    def bind(self, t: Tensor):
+        r = self.new_ref()
+        self.env[id(t)] = r
+        self.keepalive.append(t)
+        return r
+
+    def ref_of(self, t: Tensor, implicit_ok=True):
+        r = self.env.get(id(t))
+        if r is None:
+            if not implicit_ok:
+                raise SOTError("sot: unknown tensor in trace")
+            r = self.bind(t)
+            self.implicit[r] = t
+        return r
+
+    # ---- dispatch hook ----
+    def on_op(self, name, fn, args, kwargs, out):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        spec = []
+        for l in leaves:
+            if isinstance(l, Tensor):
+                spec.append(("ref", self.ref_of(l)))
+            elif isinstance(l, rng.OpKey) or (
+                    isinstance(l, jax.Array) and _is_prng_key(l)):
+                spec.append(("rng", self.n_rng))
+                self.n_rng += 1
+            else:
+                spec.append(("py", l))
+        # dispatch wraps every output leaf into a Tensor (_wrap_outputs),
+        # so the flattened output is all-Tensor, in replay order
+        out_leaves = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+        out_refs = [self.bind(o) for o in out_leaves
+                    if isinstance(o, Tensor)]
+        self.events.append(
+            ("op", (name, fn, tuple(spec), treedef, out_refs)))
+
+    def on_force(self, t: Tensor, kind, value):
+        # only tensors that belong to the trace key a branch; forcing an
+        # unrelated eager tensor (e.g. a global counter) is not a break
+        r = self.env.get(id(t))
+        if r is None:
+            return
+        self.events.append(("force", kind, r, value))
+
+
+_active = threading.local()
+
+
+def _thread_local_on_op(name, fn, args, kwargs, out):
+    """Routes the global dispatch hook to THIS thread's trace only: the
+    hook slots are process-global, but capture is a per-thread activity —
+    ops dispatched concurrently by other threads (prefetch workers,
+    metrics) must not leak into the capturing thread's trace."""
+    trace = getattr(_active, "trace", None)
+    if trace is not None:
+        trace.on_op(name, fn, args, kwargs, out)
+
+
+def _thread_local_on_force(t, kind, value):
+    trace = getattr(_active, "trace", None)
+    if trace is not None:
+        trace.on_force(t, kind, value)
+
+
+_scope_lock = threading.Lock()
+_n_scopes = 0
+
+
+class _CaptureScope:
+    def __init__(self, trace):
+        self.trace = trace
+
+    def __enter__(self):
+        global _n_scopes
+        if getattr(_active, "trace", None) is not None:
+            raise SOTError("sot: nested capture is not supported")
+        _active.trace = self.trace
+        with _scope_lock:
+            _n_scopes += 1
+            dispatch.set_sot_recorder(_thread_local_on_op)
+            Tensor._set_force_hook(_thread_local_on_force)
+        return self.trace
+
+    def __exit__(self, *exc):
+        global _n_scopes
+        _active.trace = None
+        with _scope_lock:
+            _n_scopes -= 1
+            if _n_scopes == 0:
+                dispatch.set_sot_recorder(None)
+                Tensor._set_force_hook(None)
+
+
+# =========================== segment build ===========================
+
+class _Segment:
+    """One jitted replay unit between graph breaks."""
+
+    __slots__ = ("ops", "in_refs", "out_refs", "n_rng", "compiled")
+
+    def __init__(self, ops, in_refs, out_refs, n_rng):
+        self.ops = ops
+        self.in_refs = tuple(in_refs)
+        self.out_refs = tuple(out_refs)
+        self.n_rng = n_rng
+
+        def replay(key, *vals):
+            env = dict(zip(self.in_refs, vals))
+            for name, fn, spec, treedef, orefs in self.ops:
+                leaves = []
+                for tag, payload in spec:
+                    if tag == "ref":
+                        leaves.append(env[payload])
+                    elif tag == "rng":
+                        leaves.append(
+                            rng.OpKey(jax.random.fold_in(key, payload)))
+                    else:
+                        leaves.append(payload)
+                a, kw = jax.tree_util.tree_unflatten(treedef, leaves)
+                out = fn(*a, **kw)
+                # dispatch wrapped every output leaf at capture time, so
+                # orefs covers ALL flattened leaves, in order
+                outs = jax.tree_util.tree_flatten(out)[0]
+                for r, v in zip(orefs, outs):
+                    env[r] = v
+            return tuple(env[r] for r in self.out_refs)
+
+        self.compiled = jax.jit(replay)
+
+
+class _Node:
+    """Chain node: a segment plus either a terminal output template or a
+    branch table keyed by the forced outcome."""
+
+    __slots__ = ("segment", "break_kind", "break_ref", "branches",
+                 "out_template")
+
+    def __init__(self, segment):
+        self.segment = segment
+        self.break_kind = None
+        self.break_ref = None
+        self.branches = {}
+        self.out_template = None  # (treedef, leafspec) for terminal nodes
+
+
+def _live_after(events, idx, final_refs):
+    """Refs read by any event at/after position idx, plus final outputs."""
+    live = set(final_refs)
+    for ev in events[idx:]:
+        if ev[0] == "op":
+            for tag, payload in ev[1][2]:
+                if tag == "ref":
+                    live.add(payload)
+        else:
+            live.add(ev[2])
+    return live
+
+
+def _build_chain(trace, out_treedef, out_leafspec, final_refs):
+    """Split the flat trace into a linked chain of nodes; returns the head."""
+    events = trace.events
+    seg_ops = []
+
+    def close_segment(end_idx, break_ref=None):
+        # inputs: refs used by this segment's ops that it didn't produce
+        used = set()
+        internal = set()
+        for name, fn, spec, treedef, orefs in seg_ops:
+            for tag, payload in spec:
+                if tag == "ref" and payload not in internal:
+                    used.add(payload)
+            internal.update(orefs)
+        live = _live_after(events, end_idx, final_refs)
+        outs = sorted((internal & live) | ({break_ref} if break_ref is not
+                                          None and break_ref in internal
+                                          else set()))
+        n_rng = sum(1 for (_, _, spec, _, _) in seg_ops
+                    for tag, _ in spec if tag == "rng")
+        return _Segment(list(seg_ops), sorted(used), outs, n_rng)
+
+    head = None
+    prev = None
+    prev_outcome = None
+    for i, ev in enumerate(events):
+        if ev[0] == "op":
+            seg_ops.append(ev[1])
+        else:
+            _, kind, ref, value = ev
+            node = _Node(close_segment(i + 1, break_ref=ref))
+            node.break_kind = kind
+            node.break_ref = ref
+            seg_ops = []
+            if prev is None:
+                head = node
+            else:
+                prev.branches[prev_outcome] = node
+            prev = node
+            prev_outcome = _outcome_key(kind, value)
+    # terminal node
+    node = _Node(close_segment(len(events)))
+    node.out_template = (out_treedef, out_leafspec)
+    if prev is None:
+        head = node
+    else:
+        prev.branches[prev_outcome] = node
+    return head
+
+
+# =========================== the callable ===========================
+
+class SOTFunction:
+    """Captured function: guarded chain cache + eager re-capture."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._entries = {}   # sig -> {"head": _Node, "paths": int,
+                             #         "implicit": {ref: Tensor}}
+        functools.update_wrapper(self, fn)
+
+    # ---- capture ----
+    def _capture(self, args, kwargs, sig):
+        trace = _Trace()
+        # bind explicit tensor inputs before running
+        in_leaves = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
+        for l in in_leaves:
+            if isinstance(l, Tensor):
+                trace.bind(l)
+        with _CaptureScope(trace):
+            out = self._fn(*args, **kwargs)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        out_spec = []
+        final_refs = []
+        for l in out_leaves:
+            if isinstance(l, Tensor):
+                r = trace.env.get(id(l))
+                if r is None:  # output tensor created outside dispatch
+                    r = trace.ref_of(l)
+                out_spec.append(("ref", r))
+                final_refs.append(r)
+            else:
+                out_spec.append(("py", l))
+        head = _build_chain(trace, out_treedef, out_spec, final_refs)
+
+        imp_sigs = {r: (tuple(t._value.shape), str(t._value.dtype))
+                    for r, t in trace.implicit.items()}
+        entry = self._entries.get(sig)
+        if entry is None:
+            self._entries[sig] = {
+                "head": head, "paths": 1, "implicit": dict(trace.implicit),
+                "imp_sigs": imp_sigs,
+                "in_refs": [trace.env[id(l)] for l in in_leaves
+                            if isinstance(l, Tensor)],
+            }
+        else:
+            entry["implicit"].update(trace.implicit)
+            entry["imp_sigs"].update(imp_sigs)
+            self._merge(entry, head)
+        return out
+
+    @staticmethod
+    def _merge(entry, new_head):
+        """Graft the new path into the existing trie at the first unseen
+        branch outcome (segments before it are identical by construction:
+        same ops ran, same forces occurred)."""
+        cur, new = entry["head"], new_head
+        while True:
+            if new.out_template is not None or cur.out_template is not None:
+                return  # identical terminal path — nothing to graft
+            (outcome, nxt), = ((o, n) for o, n in new.branches.items())
+            if outcome in cur.branches:
+                cur, new = cur.branches[outcome], nxt
+            else:
+                cur.branches[outcome] = nxt
+                entry["paths"] += 1
+                return
+
+    # ---- replay ----
+    def _replay(self, entry, args, kwargs):
+        in_leaves = [l for l in jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
+            if isinstance(l, Tensor)]
+        values = dict(zip(entry["in_refs"], in_leaves))
+        for r, t in entry["implicit"].items():
+            # live read: the same external Tensor (e.g. a parameter) with
+            # its CURRENT value; shape/dtype guard against silent drift
+            if (tuple(t._value.shape), str(t._value.dtype)) != \
+                    entry["imp_sigs"][r]:
+                self._entries.pop(
+                    next(k for k, v in self._entries.items()
+                         if v is entry), None)
+                return _RECAPTURE
+            values[r] = t
+        node = entry["head"]
+        while True:
+            seg = node.segment
+            ins = [values[r] for r in seg.in_refs]
+            key = Tensor(rng.default_generator.split(), stop_gradient=True) \
+                if seg.n_rng else _dummy_key()
+            outs = dispatch.apply(
+                f"sot_segment[{self._fn.__name__}]", seg.compiled,
+                key, *ins)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for r, t in zip(seg.out_refs, outs):
+                values[r] = t
+            if node.out_template is not None:
+                treedef, spec = node.out_template
+                leaves = [values[p] if tag == "ref" else p
+                          for tag, p in spec]
+                return jax.tree_util.tree_unflatten(treedef, leaves)
+            forced = values[node.break_ref]
+            raw = np.asarray(forced._value)
+            if node.break_kind == "bool":
+                outcome = _outcome_key("bool", bool(raw))
+            elif node.break_kind == "int":
+                outcome = _outcome_key("int", int(raw))
+            elif node.break_kind == "float":
+                outcome = _outcome_key("float", float(raw))
+            else:
+                outcome = _outcome_key(node.break_kind, raw)
+            nxt = node.branches.get(outcome)
+            if nxt is None:
+                return _RECAPTURE  # unseen branch — caller recaptures
+            node = nxt
+
+    def __call__(self, *args, **kwargs):
+        if getattr(_active, "trace", None) is not None:
+            # nested SOT call inside a capture: inline it (record its ops
+            # into the outer trace)
+            return self._fn(*args, **kwargs)
+        from ...core import flags
+
+        if flags.in_static_mode() or flags.in_trace():
+            # static recording / an enclosing functional trace owns the
+            # program — SOT's eager capture machinery would record nothing
+            return self._fn(*args, **kwargs)
+
+        sig = (_sig_of(args), _sig_of(kwargs))
+        entry = self._entries.get(sig)
+        if entry is not None:
+            if entry["paths"] >= MAX_PATHS_PER_SIG:
+                warnings.warn(
+                    f"sot: {self._fn.__name__} exceeded "
+                    f"{MAX_PATHS_PER_SIG} traced branch paths for one "
+                    "signature; falling back to eager execution",
+                    stacklevel=2)
+                return self._fn(*args, **kwargs)
+            out = self._replay(entry, args, kwargs)
+            if out is not _RECAPTURE:
+                return out
+        return self._capture(args, kwargs, sig)
+
+
+def symbolic_translate(fn):
+    """Reference `paddle.jit.sot.translate.symbolic_translate` name."""
+    if isinstance(fn, SOTFunction):
+        return fn
+    return SOTFunction(fn)
+
+
+sot_capture = symbolic_translate

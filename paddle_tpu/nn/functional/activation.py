@@ -177,7 +177,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
     key = _rng.split_for_op()
 
-    def f(v):
+    def f(v, key):
         k = _rng.materialize(key)
         g = jax.random.gumbel(k, v.shape, v.dtype)
         y = jax.nn.softmax((v + g) / temperature, axis=axis)
@@ -191,7 +191,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = y_hard - jax.lax.stop_gradient(y) + y
         return y
 
-    return apply("gumbel_softmax", f, x)
+    return apply("gumbel_softmax", f, x, key)
 
 
 @op("maxout")
@@ -213,12 +213,12 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
         return leaky_relu(x, neg)
     key = _rng.split_for_op()
 
-    def f(v):
+    def f(v, key):
         k = _rng.materialize(key)
         a = jax.random.uniform(k, v.shape, v.dtype, lower, upper)
         return jnp.where(v >= 0, v, a * v)
 
-    return apply("rrelu", f, x)
+    return apply("rrelu", f, x, key)
 
 
 @op("thresholded_relu")

@@ -53,7 +53,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return apply("dropout_infer", lambda v: v * (1.0 - p), x)
     key = _rng.split_for_op()
 
-    def f(v):
+    def f(v, key):
         k = _rng.materialize(key)
         shape = list(v.shape)
         if axis is not None:
@@ -64,7 +64,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return jnp.where(keep, v / (1.0 - p), jnp.zeros((), v.dtype)).astype(v.dtype)
         return jnp.where(keep, v, jnp.zeros((), v.dtype))
 
-    return apply("dropout", f, x)
+    return apply("dropout", f, x, key)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -82,7 +82,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         return x if isinstance(x, Tensor) else Tensor(x)
     key = _rng.split_for_op()
 
-    def f(v):
+    def f(v, key):
         k = _rng.materialize(key)
         alpha = 1.6732632423543772
         scale = 1.0507009873554805
@@ -92,7 +92,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         b = -a * alpha_p * p
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
 
-    return apply("alpha_dropout", f, x)
+    return apply("alpha_dropout", f, x, key)
 
 
 @op("unfold")
