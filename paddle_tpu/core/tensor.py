@@ -192,12 +192,10 @@ class Tensor:
         return self._forced("value", np.asarray(self._value))
 
     def item(self, *args):
-        if args:
-            return self._forced("value", np.asarray(self._value)).item(*args)
-        return self._forced("value", np.asarray(self._value)).item()
+        return self.numpy().item(*args)
 
     def tolist(self):
-        return self._forced("value", np.asarray(self._value)).tolist()
+        return self.numpy().tolist()
 
     def __dlpack__(self, *a, **kw):
         return self._value.__dlpack__(*a, **kw)
@@ -303,7 +301,7 @@ class Tensor:
 
     # numpy interop (lets np.asarray(tensor) work)
     def __array__(self, dtype=None):
-        a = np.asarray(self._value)
+        a = self._forced("value", np.asarray(self._value))
         return a.astype(dtype) if dtype is not None else a
 
     def to_sparse_coo(self, sparse_dim=None):

@@ -87,9 +87,9 @@ class _Trace:
 
     def __init__(self):
         self.events = []          # ("op", rec) | ("force", kind, ref, out)
-        self.env = {}             # id(Tensor) -> ssa ref
-        self.keepalive = []       # Tensors backing env ids (id-reuse guard)
-        self.implicit = {}        # ssa ref -> Tensor (live external reads)
+        self.env = {}             # id(Tensor | jax.Array) -> ssa ref
+        self.keepalive = []       # objects backing env ids (id-reuse guard)
+        self.implicit = {}        # ssa ref -> Tensor/array read from outside
         self.n_refs = 0
         self.n_rng = 0
 
@@ -98,17 +98,17 @@ class _Trace:
         self.n_refs += 1
         return r
 
-    def bind(self, t: Tensor):
+    def bind(self, t):
         r = self.new_ref()
         self.env[id(t)] = r
         self.keepalive.append(t)
         return r
 
-    def ref_of(self, t: Tensor, implicit_ok=True):
+    def ref_of(self, t):
         r = self.env.get(id(t))
         if r is None:
-            if not implicit_ok:
-                raise SOTError("sot: unknown tensor in trace")
+            # first sight of an external value (parameter, module-level
+            # constant): becomes a live-read input of the segment using it
             r = self.bind(t)
             self.implicit[r] = t
         return r
@@ -119,12 +119,16 @@ class _Trace:
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
         spec = []
         for l in leaves:
-            if isinstance(l, Tensor):
-                spec.append(("ref", self.ref_of(l)))
-            elif isinstance(l, rng.OpKey) or (
+            if isinstance(l, rng.OpKey) or (
                     isinstance(l, jax.Array) and _is_prng_key(l)):
                 spec.append(("rng", self.n_rng))
                 self.n_rng += 1
+            elif isinstance(l, (Tensor, jax.Array)):
+                # raw jax.Array args ride as refs, not baked literals —
+                # a literal would silently replay a stale value for a
+                # same-shaped array (the entry signature guards arrays by
+                # shape/dtype only)
+                spec.append(("ref", self.ref_of(l)))
             else:
                 spec.append(("py", l))
         # dispatch wraps every output leaf into a Tensor (_wrap_outputs),
@@ -196,15 +200,21 @@ class _CaptureScope:
 # =========================== segment build ===========================
 
 class _Segment:
-    """One jitted replay unit between graph breaks."""
+    """One jitted replay unit between graph breaks. `implicit` maps the
+    refs this segment is responsible for binding at replay time to their
+    live external objects — PER SEGMENT, because divergent branch suffixes
+    allocate overlapping ref numbers for different external tensors (an
+    entry-level map would let one branch clobber another's bindings)."""
 
-    __slots__ = ("ops", "in_refs", "out_refs", "n_rng", "compiled")
+    __slots__ = ("ops", "in_refs", "out_refs", "n_rng", "implicit",
+                 "compiled")
 
-    def __init__(self, ops, in_refs, out_refs, n_rng):
+    def __init__(self, ops, in_refs, out_refs, n_rng, implicit):
         self.ops = ops
         self.in_refs = tuple(in_refs)
         self.out_refs = tuple(out_refs)
         self.n_rng = n_rng
+        self.implicit = implicit  # ref -> (obj, (shape, dtype))
 
         def replay(key, *vals):
             env = dict(zip(self.in_refs, vals))
@@ -262,6 +272,11 @@ def _build_chain(trace, out_treedef, out_leafspec, final_refs):
     """Split the flat trace into a linked chain of nodes; returns the head."""
     events = trace.events
     seg_ops = []
+    claimed = set()  # implicit refs already bound by an earlier segment
+
+    def _sig_of_obj(t):
+        v = t._value if isinstance(t, Tensor) else t
+        return (tuple(v.shape), str(v.dtype))
 
     def close_segment(end_idx, break_ref=None):
         # inputs: refs used by this segment's ops that it didn't produce
@@ -278,7 +293,13 @@ def _build_chain(trace, out_treedef, out_leafspec, final_refs):
                                           else set()))
         n_rng = sum(1 for (_, _, spec, _, _) in seg_ops
                     for tag, _ in spec if tag == "rng")
-        return _Segment(list(seg_ops), sorted(used), outs, n_rng)
+        implicit = {}
+        for r in used:
+            if r in trace.implicit and r not in claimed:
+                implicit[r] = (trace.implicit[r],
+                               _sig_of_obj(trace.implicit[r]))
+                claimed.add(r)
+        return _Segment(list(seg_ops), sorted(used), outs, n_rng, implicit)
 
     head = None
     prev = None
@@ -322,12 +343,16 @@ class SOTFunction:
     # ---- capture ----
     def _capture(self, args, kwargs, sig):
         trace = _Trace()
-        # bind explicit tensor inputs before running
-        in_leaves = jax.tree_util.tree_flatten(
-            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
+        # bind explicit tensor/array inputs before running (raw jax.Arrays
+        # are dynamic inputs too — see on_op)
+        in_leaves = [
+            l for l in jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
+            if isinstance(l, (Tensor, jax.Array))
+            and not isinstance(l, rng.OpKey) and not (
+                isinstance(l, jax.Array) and _is_prng_key(l))]
         for l in in_leaves:
-            if isinstance(l, Tensor):
-                trace.bind(l)
+            trace.bind(l)
         with _CaptureScope(trace):
             out = self._fn(*args, **kwargs)
         out_leaves, out_treedef = jax.tree_util.tree_flatten(
@@ -335,29 +360,21 @@ class SOTFunction:
         out_spec = []
         final_refs = []
         for l in out_leaves:
-            if isinstance(l, Tensor):
-                r = trace.env.get(id(l))
-                if r is None:  # output tensor created outside dispatch
-                    r = trace.ref_of(l)
+            if isinstance(l, (Tensor, jax.Array)):
+                r = trace.ref_of(l)  # binds if created outside dispatch
                 out_spec.append(("ref", r))
                 final_refs.append(r)
             else:
                 out_spec.append(("py", l))
         head = _build_chain(trace, out_treedef, out_spec, final_refs)
 
-        imp_sigs = {r: (tuple(t._value.shape), str(t._value.dtype))
-                    for r, t in trace.implicit.items()}
         entry = self._entries.get(sig)
         if entry is None:
             self._entries[sig] = {
-                "head": head, "paths": 1, "implicit": dict(trace.implicit),
-                "imp_sigs": imp_sigs,
-                "in_refs": [trace.env[id(l)] for l in in_leaves
-                            if isinstance(l, Tensor)],
+                "head": head, "paths": 1,
+                "in_refs": [trace.env[id(l)] for l in in_leaves],
             }
         else:
-            entry["implicit"].update(trace.implicit)
-            entry["imp_sigs"].update(imp_sigs)
             self._merge(entry, head)
         return out
 
@@ -379,24 +396,25 @@ class SOTFunction:
                 return
 
     # ---- replay ----
-    def _replay(self, entry, args, kwargs):
-        in_leaves = [l for l in jax.tree_util.tree_flatten(
-            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
-            if isinstance(l, Tensor)]
+    def _replay(self, sig, entry, args, kwargs):
+        in_leaves = [
+            l for l in jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))[0]
+            if isinstance(l, (Tensor, jax.Array))
+            and not isinstance(l, rng.OpKey) and not (
+                isinstance(l, jax.Array) and _is_prng_key(l))]
         values = dict(zip(entry["in_refs"], in_leaves))
-        for r, t in entry["implicit"].items():
-            # live read: the same external Tensor (e.g. a parameter) with
-            # its CURRENT value; shape/dtype guard against silent drift
-            if (tuple(t._value.shape), str(t._value.dtype)) != \
-                    entry["imp_sigs"][r]:
-                self._entries.pop(
-                    next(k for k, v in self._entries.items()
-                         if v is entry), None)
-                return _RECAPTURE
-            values[r] = t
         node = entry["head"]
         while True:
             seg = node.segment
+            for r, (t, expect) in seg.implicit.items():
+                # live read: the same external Tensor (e.g. a parameter)
+                # with its CURRENT value; shape/dtype guard against drift
+                v = t._value if isinstance(t, Tensor) else t
+                if (tuple(v.shape), str(v.dtype)) != expect:
+                    self._entries.pop(sig, None)
+                    return _RECAPTURE
+                values[r] = t
             ins = [values[r] for r in seg.in_refs]
             key = Tensor(rng.default_generator.split(), stop_gradient=True) \
                 if seg.n_rng else _dummy_key()
@@ -449,7 +467,7 @@ class SOTFunction:
                     "signature; falling back to eager execution",
                     stacklevel=2)
                 return self._fn(*args, **kwargs)
-            out = self._replay(entry, args, kwargs)
+            out = self._replay(sig, entry, args, kwargs)
             if out is not _RECAPTURE:
                 return out
         return self._capture(args, kwargs, sig)

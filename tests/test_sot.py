@@ -201,3 +201,57 @@ def test_nested_sot_inlines():
     x = P.to_tensor(np.zeros(3, np.float32))
     np.testing.assert_allclose(sf(x).numpy(), [2, 2, 2])
     np.testing.assert_allclose(sf(x).numpy(), [2, 2, 2])
+
+
+def test_divergent_branches_bind_distinct_params():
+    """Branch suffixes allocate overlapping SSA refs for different external
+    layers; bindings are per-segment so paths must not clobber each other
+    (r3 review finding)."""
+    lin_pos = P.nn.Linear(3, 3)
+    lin_neg = P.nn.Linear(3, 3)
+
+    def f(x):
+        if float(x.sum()) > 0:
+            return lin_pos(x)
+        return lin_neg(x)
+
+    sf = symbolic_translate(f)
+    xp = P.to_tensor(np.ones((1, 3), np.float32))
+    xn = P.to_tensor(-np.ones((1, 3), np.float32))
+    ref_p, ref_n = lin_pos(xp).numpy(), lin_neg(xn).numpy()
+    np.testing.assert_allclose(sf(xp).numpy(), ref_p, rtol=1e-6)  # capture +
+    np.testing.assert_allclose(sf(xn).numpy(), ref_n, rtol=1e-6)  # recapture
+    # replays of BOTH paths must use their own layer's weights
+    np.testing.assert_allclose(sf(xp).numpy(), ref_p, rtol=1e-6)
+    np.testing.assert_allclose(sf(xn).numpy(), ref_n, rtol=1e-6)
+
+
+def test_raw_jax_array_arg_not_baked():
+    """A raw jnp array argument must flow as a dynamic input, not a baked
+    literal (same-shape different-value call returned stale results)."""
+    import jax.numpy as jnp
+
+    def f(x, mask):
+        return x * mask  # mask is a raw jax array
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.ones((4,), np.float32))
+    m1 = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    m2 = jnp.asarray([0.0, 1.0, 0.0, 1.0])
+    np.testing.assert_allclose(sf(x, m1).numpy(), [1, 0, 1, 0])
+    np.testing.assert_allclose(sf(x, m2).numpy(), [0, 1, 0, 1])  # replay
+
+
+def test_np_asarray_force_breaks_graph():
+    """np.asarray(tensor) escapes tensor-land -> must key a branch guard
+    like item()/float() (r3 review finding: __array__ bypassed the hook)."""
+    def f(x):
+        s = float(np.asarray(x).mean())
+        return x * s
+
+    sf = symbolic_translate(f)
+    x1 = P.to_tensor(np.full((2,), 2.0, np.float32))
+    x2 = P.to_tensor(np.full((2,), 5.0, np.float32))
+    np.testing.assert_allclose(sf(x1).numpy(), [4, 4])
+    np.testing.assert_allclose(sf(x2).numpy(), [25, 25])
+    np.testing.assert_allclose(sf(x1).numpy(), [4, 4])
