@@ -89,22 +89,32 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
             loss = step(ids, labels)
             loss.block_until_ready()
 
+            # multi-step program: all timed steps run inside ONE compiled
+            # lax.scan, so per-dispatch host/tunnel gaps (measured ~44 ms
+            # IDLE per step, PERF.md) are out of the loop entirely
+            ids_st = P.to_tensor(
+                np.broadcast_to(np.asarray(ids._value),
+                                (iters,) + tuple(ids.shape)).copy(), "int32")
+            labels_st = P.to_tensor(
+                np.broadcast_to(np.asarray(labels._value),
+                                (iters,) + tuple(labels.shape)).copy(),
+                "int32")
+            losses = step.run_steps(ids_st, labels_st)  # compile warmup
+            float(np.asarray(losses._value[-1]))
+
             if trace_dir:
                 jax.profiler.start_trace(trace_dir)
             try:
-                # Timing: chain all steps (donated state serializes them),
-                # then FETCH the final loss value. A D2H value read is the
-                # only true synchronization through this PJRT tunnel —
+                # Timing: dispatch the N-step program once, then FETCH the
+                # final loss. A D2H value read is the only true
+                # synchronization through this PJRT tunnel —
                 # block_until_ready returns before chained device work has
                 # run (reads 10-50x too fast, physically impossible MFU).
-                # The single fetch amortizes the tunnel's ~70ms round-trip
-                # over all iters; the final loss transitively depends on
-                # every prior step's param update, so the fetch waits for
-                # the whole chain.
+                # The last loss depends on every prior step's param update,
+                # so the fetch waits for the whole scan.
                 t0 = time.perf_counter()
-                for _ in range(iters):
-                    loss = step(ids, labels)
-                final_loss = float(np.asarray(loss._value))
+                losses = step.run_steps(ids_st, labels_st)
+                final_loss = float(np.asarray(losses._value[-1]))
                 dt = time.perf_counter() - t0
             finally:
                 if trace_dir:

@@ -235,3 +235,38 @@ import contextlib as _contextlib
 @_contextlib.contextmanager
 def stream_guard(stream=None):
     yield current_stream()
+
+
+# ---- custom device plugins (PJRT) ----------------------------------------
+
+def register_pjrt_plugin(name, library_path, options=None, priority=400):
+    """Register an out-of-tree device backend from a PJRT plugin shared
+    library.
+
+    Role parity: the reference's pluggable-device ABI
+    (`paddle/phi/backends/device_ext.h:94` C_DeviceInterface +
+    `paddle/phi/backends/custom/custom_device.cc`) — a vendor ships one
+    shared library and the framework discovers a new device type at
+    runtime. TPU-first collapse: PJRT *is* that ABI here; this registers
+    the plugin with the runtime so `jax.devices(name)` /
+    `set_device(name)` can target it. Must be called before the first
+    device computation (backends are frozen at first use).
+    """
+    import os
+
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(
+            f"PJRT plugin library not found: {library_path}")
+    from jax._src import xla_bridge as _xb
+
+    return _xb.register_plugin(name, library_path=library_path,
+                               options=options, priority=priority)
+
+
+def get_registered_backends():
+    """Names of every registered PJRT backend factory (built-in + custom
+    plugins) — the custom-device discovery surface
+    (`paddle.device.get_all_custom_device_type` over real plugins)."""
+    from jax._src import xla_bridge as _xb
+
+    return sorted(_xb._backend_factories)

@@ -207,11 +207,75 @@ class DistributedTrainStep:
             new_opt = {"slots": new_opt_slots, "step": new_opt["step"]}
             return loss, new_params, new_opt, new_buffers, new_key
 
+        self._step_fn = step
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
-    def __call__(self, *batch):
-        """batch: (inputs, labels) Tensors (loss_fn mode) or raw model args.
-        Returns the loss as a Tensor; model/optimizer state advances."""
+    def _build_multi(self, batch_treedef):
+        """N steps in ONE compiled program: lax.scan over the leading batch
+        axis. Host dispatches once per N steps — on a tunneled/remote chip
+        the per-dispatch gap (~tens of ms) otherwise shows up as device
+        IDLE between steps (PERF.md profile). XLA keeps state resident
+        across scan iterations, so this is also the idiomatic TPU shape
+        for a training loop (host loop minimization)."""
+        self._build(batch_treedef, None)  # ensure _step_fn exists
+        step = self._step_fn
+
+        def multi(params, opt_state, buffers, key, lrs, *batch_leaves):
+            def body(carry, sl):
+                params, opt_state, buffers, key = carry
+                lr_i, batch_sl = sl[0], sl[1:]
+                loss, p2, o2, b2, k2 = step(params, opt_state, buffers, key,
+                                            lr_i, *batch_sl)
+                return (p2, o2, b2, k2), loss
+
+            (p, o, b, k), losses = jax.lax.scan(
+                body, (params, opt_state, buffers, key),
+                (lrs,) + tuple(batch_leaves))
+            return losses, p, o, b, k
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
+
+    def run_steps(self, *batch, lrs=None):
+        """Run one optimizer step per leading-axis slice of `batch` (every
+        leaf shaped [n_steps, ...]) inside a single compiled program;
+        returns the per-step losses as one [n_steps] Tensor.
+
+        lrs: optional per-step learning rates, shape [n_steps]. Required
+        when the optimizer uses an LRScheduler — the host cannot step the
+        scheduler mid-scan, so the schedule must be supplied up front
+        (sequential `__call__` semantics read the scheduler each step)."""
+        from ..optimizer.lr import LRScheduler
+
+        placed, treedef = self._place_batch(batch, batch_axis=1)
+        n_steps = int(placed[0].shape[0]) if placed else 0
+        if lrs is None:
+            if isinstance(self.optimizer._learning_rate, LRScheduler):
+                raise ValueError(
+                    "run_steps with an LRScheduler needs explicit per-step "
+                    "rates: pass lrs=[...] (the scheduler cannot be stepped "
+                    "from inside the compiled scan)")
+            lrs = jnp.full((n_steps,), self.optimizer.get_lr(), jnp.float32)
+        else:
+            lrs = jnp.asarray(
+                lrs._value if isinstance(lrs, Tensor) else lrs,
+                jnp.float32)
+            if lrs.shape != (n_steps,):
+                raise ValueError(
+                    f"lrs must have shape ({n_steps},), got {lrs.shape}")
+        if getattr(self, "_compiled_multi", None) is None or \
+                getattr(self, "_multi_treedef", None) != treedef:
+            self._multi_treedef = treedef
+            self._compiled_multi = self._build_multi(treedef)
+        s = self._state
+        losses, params, opt, buffers, key = self._compiled_multi(
+            s["params"], s["opt"], s["buffers"], s["key"], lrs, *placed)
+        self._swap_state(params, opt, buffers, key)
+        return Tensor(losses)
+
+    def _place_batch(self, batch, batch_axis):
+        """Unwrap/flatten a batch and device_put each leaf with the dp
+        axis on `batch_axis` (0 for single steps, 1 under a leading step
+        axis). Returns (placed_leaves, treedef)."""
         if self._state is None:
             self.init_state()
         vals = jax.tree_util.tree_map(
@@ -222,11 +286,23 @@ class DistributedTrainStep:
         dp = mesh.shape.get("dp", 1)
         placed = []
         for b in leaves:
-            spec = ["dp"] + [None] * (np.ndim(b) - 1) \
-                if np.ndim(b) >= 1 and b.shape[0] % max(dp, 1) == 0 else \
-                [None] * np.ndim(b)
-            placed.append(jax.device_put(
-                b, NamedSharding(mesh, P(*spec))))
+            if np.ndim(b) > batch_axis and \
+                    b.shape[batch_axis] % max(dp, 1) == 0:
+                spec = [None] * batch_axis + ["dp"] + \
+                    [None] * (np.ndim(b) - batch_axis - 1)
+            else:
+                spec = [None] * np.ndim(b)
+            placed.append(jax.device_put(b, NamedSharding(mesh, P(*spec))))
+        return placed, treedef
+
+    def _swap_state(self, params, opt, buffers, key):
+        self._state = {"params": params, "opt": opt, "buffers": buffers,
+                       "key": key}
+
+    def __call__(self, *batch):
+        """batch: (inputs, labels) Tensors (loss_fn mode) or raw model args.
+        Returns the loss as a Tensor; model/optimizer state advances."""
+        placed, treedef = self._place_batch(batch, batch_axis=0)
         if self._compiled is None or self._batch_treedef != treedef:
             self._batch_treedef = treedef
             self._compiled = self._build(treedef, None)
@@ -234,8 +310,7 @@ class DistributedTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         loss, params, opt, buffers, key = self._compiled(
             s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
-        self._state = {"params": params, "opt": opt, "buffers": buffers,
-                       "key": key}
+        self._swap_state(params, opt, buffers, key)
         return Tensor(loss)
 
     # --- state sync back to the eager model ---------------------------------

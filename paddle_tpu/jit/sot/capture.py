@@ -278,7 +278,7 @@ def _build_chain(trace, out_treedef, out_leafspec, final_refs):
         v = t._value if isinstance(t, Tensor) else t
         return (tuple(v.shape), str(v.dtype))
 
-    def close_segment(end_idx, break_ref=None):
+    def close_segment(end_idx, break_ref=None, extra_needs=()):
         # inputs: refs used by this segment's ops that it didn't produce
         used = set()
         internal = set()
@@ -294,7 +294,11 @@ def _build_chain(trace, out_treedef, out_leafspec, final_refs):
         n_rng = sum(1 for (_, _, spec, _, _) in seg_ops
                     for tag, _ in spec if tag == "rng")
         implicit = {}
-        for r in used:
+        # claim implicit refs this segment's ops read, PLUS any the replay
+        # walker needs right after this segment (its break predicate; for
+        # the terminal segment, output-template refs): an external tensor
+        # returned untouched is in no op's arg list but must still bind
+        for r in list(used) + list(extra_needs):
             if r in trace.implicit and r not in claimed:
                 implicit[r] = (trace.implicit[r],
                                _sig_of_obj(trace.implicit[r]))
@@ -309,7 +313,8 @@ def _build_chain(trace, out_treedef, out_leafspec, final_refs):
             seg_ops.append(ev[1])
         else:
             _, kind, ref, value = ev
-            node = _Node(close_segment(i + 1, break_ref=ref))
+            node = _Node(close_segment(i + 1, break_ref=ref,
+                                       extra_needs=(ref,)))
             node.break_kind = kind
             node.break_ref = ref
             seg_ops = []
@@ -320,7 +325,7 @@ def _build_chain(trace, out_treedef, out_leafspec, final_refs):
             prev = node
             prev_outcome = _outcome_key(kind, value)
     # terminal node
-    node = _Node(close_segment(len(events)))
+    node = _Node(close_segment(len(events), extra_needs=final_refs))
     node.out_template = (out_treedef, out_leafspec)
     if prev is None:
         head = node

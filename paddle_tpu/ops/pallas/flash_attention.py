@@ -456,7 +456,12 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
     fwd ≈ 1.3 ms vs 128x128 ≈ 6.0 ms (PERF.md)."""
     from . import autotune
 
-    sizes = (128, 256, 512, 1024)
+    # curated candidate pairs: the full {128..1024}^2 grid costs ~16 TPU
+    # compiles of fwd+bwd on the first call for a new signature (~10 min
+    # through a tunnel); these six cover the measured-good region
+    # (PERF.md round-3 sweep: big blocks win until VMEM pressure)
+    pairs = ((1024, 1024), (512, 1024), (256, 512), (512, 512),
+             (256, 256), (128, 128))
 
     def vmem_est(bq, bk):
         # f32 logits block (s and p live together) + full K/V + q/o/acc;
@@ -466,7 +471,7 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
                 + 2 * bq * d * itemsize + bq * d * 4)
 
     cands = [(bq, bk)
-             for bq in sizes for bk in sizes
+             for bq, bk in pairs
              if sq % bq == 0 and sk % bk == 0 and bq <= sq and bk <= sk
              and vmem_est(bq, bk) <= 12 * 1024 * 1024]
     default = (_pick_block(sq, DEFAULT_BLOCK_Q),

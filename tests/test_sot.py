@@ -255,3 +255,19 @@ def test_np_asarray_force_breaks_graph():
     np.testing.assert_allclose(sf(x1).numpy(), [4, 4])
     np.testing.assert_allclose(sf(x2).numpy(), [25, 25])
     np.testing.assert_allclose(sf(x1).numpy(), [4, 4])
+
+
+def test_output_only_external_tensor_binds_on_replay():
+    """An external tensor returned untouched (never an op input) must bind
+    at replay (r3 review finding: unclaimed implicit ref -> KeyError)."""
+    ext = P.to_tensor(np.full((2,), 7.0, np.float32))
+
+    def f(x):
+        return x * 2.0, ext
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.ones((2,), np.float32))
+    a1, e1 = sf(x)
+    a2, e2 = sf(x)  # replay
+    np.testing.assert_allclose(a2.numpy(), [2, 2])
+    np.testing.assert_allclose(e2.numpy(), [7, 7])

@@ -187,3 +187,16 @@ def test_birnn_and_pairwise_distance():
     np.testing.assert_allclose(
         pd(P.to_tensor(a), P.to_tensor(b)).numpy(),
         np.linalg.norm(a - b, axis=-1), rtol=1e-5)
+
+
+def test_register_pjrt_plugin_surface():
+    """Custom-device plugin registration (device_ext.h role): loud on a
+    missing library; discovery lists registered backends."""
+    import pytest
+
+    from paddle_tpu import device as D
+
+    with pytest.raises(FileNotFoundError):
+        D.register_pjrt_plugin("npu", "/nonexistent/libnpu_pjrt.so")
+    backends = D.get_registered_backends()
+    assert isinstance(backends, list) and "cpu" in backends

@@ -1,0 +1,146 @@
+"""One-shot TPU measurement session: run everything perf-related while the
+chip is reachable, append results to tools/chip_session_log.json as each
+phase lands (the tunnel can drop at any time — nothing waits on anything
+it doesn't need).
+
+Phases:
+  1. sanity matmul (chip + timing-method check)
+  2. flash fwd and fwd+bwd block sweep at the bench shape
+  3. autotune-seed: run _tuned_blocks for the bench + ViT signatures so
+     the on-disk cache is hot for bench.py
+  4. bench.py subprocess (headline + secondary JSON lines)
+
+Usage: python tools/chip_session.py [phase...]   (default: all)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "chip_session_log.json")
+
+
+def log(phase, payload):
+    entry = {"t": time.strftime("%H:%M:%S"), "phase": phase, **payload}
+    try:
+        data = json.load(open(LOG))
+    except Exception:
+        data = []
+    data.append(entry)
+    json.dump(data, open(LOG, "w"), indent=1)
+    print(f"[{entry['t']}] {phase}: {payload}", flush=True)
+
+
+def sync(x):
+    import numpy as np
+
+    import jax
+
+    return float(np.asarray(jax.device_get(x.ravel()[0:1]), np.float32)[0])
+
+
+def slope(f, x, n1=4, n2=16, reps=2):
+    def chain(n):
+        y = x
+        for _ in range(n):
+            y = f(y)
+        sync(y)
+
+    chain(2)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter(); chain(n1); d1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); chain(n2); d2 = time.perf_counter() - t0
+        best = min(best, (d2 - d1) / (n2 - n1))
+    return best
+
+
+def phase_sanity():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((8192, 8192), jnp.bfloat16)
+    f = jax.jit(lambda x: x @ x)
+    t = slope(f, x)
+    tflops = 2 * 8192**3 / t / 1e12
+    log("sanity", {"matmul8192_ms": round(t * 1e3, 2),
+                   "tflops": round(tflops, 1)})
+
+
+def phase_sweep():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as FA
+
+    B, H, S, D = 32, 12, 1024, 64
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+    flops = B * H * 4 * S * S * D * 0.5
+    for bq, bk in [(1024, 1024), (512, 1024), (256, 512), (512, 512),
+                   (256, 256), (128, 128)]:
+        try:
+            f = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core(
+                x, k, v, True, bq, bk))
+            t = slope(f, q)
+            g = jax.jit(jax.grad(lambda x, bq=bq, bk=bk: FA._flash_core(
+                x, k, v, True, bq, bk).astype(jnp.float32).sum()))
+            tg = slope(g, q)
+            log("sweep", {"blocks": f"{bq}x{bk}",
+                          "fwd_ms": round(t * 1e3, 2),
+                          "fwd_tflops": round(flops / t / 1e12, 1),
+                          "fwdbwd_ms": round(tg * 1e3, 2),
+                          "fwdbwd_tflops": round(3.5 * flops / tg / 1e12, 1)})
+        except Exception as e:
+            log("sweep", {"blocks": f"{bq}x{bk}",
+                          "error": f"{type(e).__name__}: {str(e)[:100]}"})
+
+
+def phase_autotune_seed():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as FA
+
+    for (b, s, h, d) in [(32, 1024, 12, 64), (16, 1024, 12, 64),
+                         (8, 1024, 12, 64)]:
+        t0 = time.perf_counter()
+        blocks = FA._tuned_blocks(b, s, s, h, d, jnp.bfloat16, True)
+        log("autotune", {"sig": f"{b}x{s}x{h}x{d}", "picked": list(blocks),
+                         "seconds": round(time.perf_counter() - t0, 1)})
+
+
+def phase_bench():
+    t0 = time.perf_counter()
+    r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                       text=True, timeout=3600)
+    lines = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
+    log("bench", {"seconds": round(time.perf_counter() - t0, 1),
+                  "json_lines": lines,
+                  "stderr_tail": r.stderr[-500:]})
+
+
+PHASES = {"sanity": phase_sanity, "sweep": phase_sweep,
+          "autotune": phase_autotune_seed, "bench": phase_bench}
+
+
+def main():
+    names = sys.argv[1:] or ["sanity", "sweep", "autotune", "bench"]
+    for n in names:
+        try:
+            PHASES[n]()
+        except Exception as e:
+            log(n, {"error": f"{type(e).__name__}: {str(e)[:300]}"})
+
+
+if __name__ == "__main__":
+    main()
