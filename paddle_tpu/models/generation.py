@@ -1,0 +1,202 @@
+"""Auto-regressive generation over static KV caches.
+
+Role parity: the reference's decode serving path — `AnalysisPredictor` +
+`masked_multihead_attention`/`block_multi_head_attention` decode kernels
+(`paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu`) and
+the generation loops its ecosystem builds on them.
+
+TPU-first design: the naive concat KV cache grows the sequence axis every
+token — a new shape per step, so XLA recompiles per token. Here the cache
+is a FIXED-shape buffer `[B, H, max_len, D]` per layer written with
+`lax.dynamic_update_slice` at a traced position, so generation compiles
+exactly twice (one prefill program, one decode-step program) regardless
+of length. The decode step attends with the Pallas `decode_attention`
+kernel on TPU (position-masked paged read, logits never materialized) and
+tokens stay on device between steps — the host loop dispatches
+asynchronously and fetches once at the end (or per step only when
+`eos_token_id` needs checking).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags, rng
+from ..core.tensor import Tensor
+
+
+def _static_cache_attention(q, k, v, kv_cache, cache_pos):
+    """Shared attention-over-static-cache body for the model families.
+
+    q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] (GQA: Hkv may divide Hq — the
+    cache stores KV heads, NOT expanded query heads, so GQA's decode
+    bandwidth advantage survives); kv_cache: (k_buf, v_buf) Tensors
+    [B, Hkv, max_len, D]; cache_pos: scalar int Tensor — write offset of
+    this call's tokens. Prefill (S > 1) assumes cache_pos == 0 and runs
+    causal attention over the fresh K/V; decode (S == 1) reads the cache
+    through the Pallas `decode_attention` kernel (grouped queries per KV
+    head), masked to positions <= cache_pos.
+    Returns (out [B, S, Hq, D], (k_buf, v_buf))."""
+    import importlib
+
+    from .. import ops
+    from ..core.dispatch import apply
+    from ..nn import functional as F
+
+    DA = importlib.import_module("paddle_tpu.ops.pallas.decode_attention")
+
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    kt = ops.transpose(k, [0, 2, 1, 3])
+    vt = ops.transpose(v, [0, 2, 1, 3])
+    kb, vb = kv_cache
+
+    def upd(buf, new, p):
+        return jax.lax.dynamic_update_slice(
+            buf, new.astype(buf.dtype), (0, 0, p, 0))
+
+    kb = apply("kv_cache_update", upd, kb, kt, cache_pos)
+    vb = apply("kv_cache_update", upd, vb, vt, cache_pos)
+    if s == 1:
+        def dec(q1, kb_, vb_, p):
+            pos = jnp.broadcast_to(p, (q1.shape[0],))
+            return DA.decode_attention(q1, kb_, vb_, pos)
+
+        q1 = q.reshape([b, hq, d])
+        out = apply("decode_attention", dec, q1, kb, vb, cache_pos)
+        out = out.reshape([b, 1, hq, d])
+    else:
+        if hkv != hq:
+            rep = hq // hkv
+            k = ops.repeat_interleave(k, rep, axis=2)
+            v = ops.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             dropout_p=0.0, training=False)
+    return out, (kb, vb)
+
+
+def init_kv_caches(num_layers, batch, num_heads, head_dim, max_len,
+                   dtype="float32"):
+    """Fixed-shape per-layer KV buffers; capacity rounds up to a multiple
+    of 128 so the decode kernel's block sizes always divide it (the tail
+    is masked by position)."""
+    cap = -(-int(max_len) // 128) * 128
+    return [(jnp.zeros((batch, num_heads, cap, head_dim), dtype),
+             jnp.zeros((batch, num_heads, cap, head_dim), dtype))
+            for _ in range(num_layers)]
+
+
+def _sample(logits, key, do_sample, temperature, top_k):
+    """logits: [B, V] f32. Returns [B] int32 next tokens."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature != 1.0:
+        logits = logits / max(float(temperature), 1e-6)
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class GenerationMixin:
+    """Mixed into *ForCausalLM models that implement
+    `init_kv_caches(batch, max_len)` and
+    `forward(ids, kv_caches=, cache_pos=) -> (logits, new_caches)`."""
+
+    def _gen_programs(self, b, s0, cap, do_sample, temperature, top_k):
+        """Compiled prefill/decode programs, cached per signature — a
+        serving loop calling generate() repeatedly must not pay the XLA
+        compile per call."""
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        sig = (b, s0, cap, bool(do_sample), float(temperature), int(top_k))
+        hit = cache.get(sig)
+        if hit is not None:
+            return hit
+
+        def run(params, buffers, step_ids, caches, pos):
+            with flags.no_grad_guard(), flags.trace_guard():
+                with self.bind_state(params, buffers):
+                    logits, new_caches = self(
+                        Tensor(step_ids),
+                        kv_caches=[(Tensor(k), Tensor(v))
+                                   for k, v in caches],
+                        cache_pos=Tensor(pos))
+            return (logits._value,
+                    [(k._value, v._value) for k, v in new_caches])
+
+        @jax.jit
+        def prefill(params, buffers, ids, caches):
+            logits, caches = run(params, buffers, ids, caches,
+                                 jnp.zeros((), jnp.int32))
+            return logits[:, -1, :], caches
+
+        # caches are donated: the step overwrites one position in each
+        # buffer, and donation lets XLA update in place instead of
+        # copying ~2*L*B*H*max*D bytes every token
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def decode(params, buffers, tok, caches, pos, key):
+            logits, caches = run(params, buffers, tok[:, None], caches,
+                                 pos)
+            nxt = _sample(logits[:, -1, :], key, do_sample,
+                          temperature, top_k)
+            return nxt, caches
+
+        cache[sig] = (prefill, decode)
+        return cache[sig]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, eos_token_id=None, seed=None):
+        """input_ids: [B, S0] int Tensor/array. Returns an int32 Tensor
+        [B, S0 + n_generated]. With eos_token_id set, rows that emit eos
+        are frozen (their remaining positions fill with eos) and the loop
+        stops once every row has finished."""
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, s0 = ids.shape
+        if max_new_tokens <= 0:
+            return Tensor(ids)
+        max_len = s0 + max_new_tokens
+        was_training = self.training
+        self.eval()
+        try:
+            params, buffers = self.functional_state()
+            caches = self.init_kv_caches(b, max_len)
+            cap = caches[0][0].shape[2]
+            prefill, decode = self._gen_programs(
+                b, s0, cap, do_sample, temperature, top_k)
+            key = (jax.random.PRNGKey(seed) if seed is not None
+                   else rng.default_generator.split())
+
+            last_logits, caches = prefill(params, buffers, ids, caches)
+            key, sub = jax.random.split(key)
+            tok = _sample(last_logits, sub, do_sample, temperature, top_k)
+            finished = jnp.zeros((b,), bool)
+            if eos_token_id is not None:
+                finished = tok == eos_token_id
+            out_toks = [tok]
+            for i in range(1, max_new_tokens):
+                if eos_token_id is not None and bool(
+                        np.asarray(jax.device_get(finished.all()))):
+                    break
+                key, sub = jax.random.split(key)
+                tok, caches = decode(params, buffers, tok, caches,
+                                     jnp.asarray(s0 + i - 1, jnp.int32),
+                                     sub)
+                if eos_token_id is not None:
+                    # frozen rows keep emitting eos, not live continuations
+                    tok = jnp.where(finished, eos_token_id, tok)
+                    finished = finished | (tok == eos_token_id)
+                out_toks.append(tok)
+            gen = jnp.stack(out_toks, axis=1)
+            return Tensor(jnp.concatenate([ids, gen], axis=1))
+        finally:
+            if was_training:
+                self.train()

@@ -21,6 +21,7 @@ from ..core.tensor import Tensor
 from ..distributed import mpu
 from ..distributed.recompute import recompute as _recompute
 from ..nn import functional as F
+from .generation import GenerationMixin, _static_cache_attention
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
            "GPTPretrainingCriterion", "gpt_tiny", "gpt_1p3b", "gpt_6p7b",
@@ -78,15 +79,21 @@ class GPTAttention(nn.Layer):
         self.out_proj = mpu.RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, kv_cache=None, cache_pos=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
         if self.cfg.use_rope:
             position_ids = None
-            if cache is not None:
-                # decode: rotary phases continue from the cached length
+            if kv_cache is not None:
+                # static-cache path: phases continue from the traced offset
+                from .. import ops
+
+                row = ops.arange(0, s, dtype="int32") + cache_pos
+                position_ids = ops.broadcast_to(row.unsqueeze(0), [b, s])
+            elif cache is not None:
+                # legacy concat cache: offset is a host int
                 import numpy as _np
 
                 offset = cache[0].shape[1]
@@ -94,6 +101,12 @@ class GPTAttention(nn.Layer):
                     b, axis=0)
             q, k, _ = F.fused_rotary_position_embedding(
                 q, k, None, position_ids=position_ids)
+        if kv_cache is not None:
+            out, new_cache = _static_cache_attention(
+                q, k, v, kv_cache, cache_pos)
+            out = out.reshape([b, s, h])
+            out = self.out_proj(out)
+            return out, new_cache
         if cache is not None:
             pk, pv = cache
             from .. import ops
@@ -160,7 +173,13 @@ class GPTBlock(nn.Layer):
         x = x + self.drop(self.mlp(self.ln_2(x)))
         return x
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_pos=None):
+        if kv_cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), kv_cache=kv_cache,
+                                     cache_pos=cache_pos)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
         if self.cfg.recompute and self.training:
             return _recompute(self._body, x)
         return self._body(x)
@@ -177,20 +196,28 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = _norm(cfg)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None):
         from .. import ops
 
         x = self.wte(input_ids)
         if not self.cfg.use_rope:
             pos = ops.arange(0, input_ids.shape[1], dtype="int32")
+            if kv_caches is not None:
+                pos = pos + cache_pos
             x = x + self.wpe(pos)
         x = self.drop(x)
+        if kv_caches is not None:
+            new_caches = []
+            for block, kc in zip(self.h, kv_caches):
+                x, nc = block(x, kv_cache=kc, cache_pos=cache_pos)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         for block in self.h:
             x = block(x)
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg):
         super().__init__()
         self.cfg = cfg
@@ -199,13 +226,28 @@ class GPTForCausalLM(nn.Layer):
             self.lm_head = mpu.ColumnParallelLinear(
                 cfg.hidden_size, cfg.vocab_size, has_bias=False)
 
-    def forward(self, input_ids):
-        x = self.gpt(input_ids)
+    def forward(self, input_ids, kv_caches=None, cache_pos=None):
+        if kv_caches is not None:
+            x, new_caches = self.gpt(input_ids, kv_caches=kv_caches,
+                                     cache_pos=cache_pos)
+        else:
+            x = self.gpt(input_ids)
         if self.cfg.tie_embeddings:
             logits = x.matmul(self.gpt.wte.weight, transpose_y=True)
         else:
             logits = self.lm_head(x)
+        if kv_caches is not None:
+            return logits, new_caches
         return logits
+
+    def init_kv_caches(self, batch, max_len):
+        from .generation import init_kv_caches
+
+        cfg = self.cfg
+        dtype = self.gpt.wte.weight.dtype
+        return init_kv_caches(cfg.num_layers, batch, cfg.num_heads,
+                              cfg.hidden_size // cfg.num_heads, max_len,
+                              dtype)
 
 
 def _chunked_softmax_ce(logits, labels, ignore_index, n_chunks=8):

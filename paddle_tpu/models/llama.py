@@ -17,6 +17,7 @@ from .. import nn
 from ..distributed import mpu
 from ..distributed.recompute import recompute as _recompute
 from ..nn import functional as F
+from .generation import GenerationMixin, _static_cache_attention
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "LlamaPretrainingCriterion", "llama_pipe_layers",
@@ -61,7 +62,7 @@ class LlamaAttention(nn.Layer):
         self.out_proj = mpu.RowParallelLinear(
             q_size, cfg.hidden_size, input_is_parallel=True, has_bias=False)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, kv_cache=None, cache_pos=None):
         from .. import ops
 
         b, s, _ = x.shape
@@ -74,8 +75,12 @@ class LlamaAttention(nn.Layer):
         k = k.reshape([b, s, self.num_kv_heads, hd])
         v = v.reshape([b, s, self.num_kv_heads, hd])
         position_ids = None
-        if cache is not None:
-            # decode: rotary phases continue from the cached length
+        if kv_cache is not None:
+            # static-cache decode: phases continue from the traced offset
+            row = ops.arange(0, s, dtype="int32") + cache_pos
+            position_ids = ops.broadcast_to(row.unsqueeze(0), [b, s])
+        elif cache is not None:
+            # legacy concat cache: offset is a host int
             import numpy as _np
 
             offset = cache[0].shape[1]
@@ -89,6 +94,14 @@ class LlamaAttention(nn.Layer):
             k = ops.concat([pk, k], axis=1)
             v = ops.concat([pv, v], axis=1)
             cache = (k, v)
+        if kv_cache is not None:
+            # GQA-native static cache: k/v stay at num_kv_heads; the decode
+            # kernel groups Hq/Hkv queries per KV head so the cache is read
+            # once per KV head (GQA's decode-bandwidth advantage)
+            out, new_cache = _static_cache_attention(
+                q, k, v, kv_cache, cache_pos)
+            out = self.out_proj(out.reshape([b, s, q_size]))
+            return out, new_cache
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             k = ops.repeat_interleave(k, rep, axis=2)
@@ -141,7 +154,12 @@ class LlamaBlock(nn.Layer):
         x = x + self.attn(self.input_norm(x))
         return x + self.mlp(self.post_norm(x))
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_pos=None):
+        if kv_cache is not None:
+            a, new_cache = self.attn(self.input_norm(x), kv_cache=kv_cache,
+                                     cache_pos=cache_pos)
+            x = x + a
+            return x + self.mlp(self.post_norm(x)), new_cache
         if self.cfg.recompute and self.training:
             return _recompute(self._body, x)
         return self._body(x)
@@ -157,14 +175,20 @@ class LlamaModel(nn.Layer):
                                     for _ in range(cfg.num_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None):
         x = self.embed_tokens(input_ids)
+        if kv_caches is not None:
+            new_caches = []
+            for blk, kc in zip(self.layers, kv_caches):
+                x, nc = blk(x, kv_cache=kc, cache_pos=cache_pos)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for blk in self.layers:
             x = blk(x)
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg):
         super().__init__()
         self.cfg = cfg
@@ -176,14 +200,31 @@ class LlamaForCausalLM(nn.Layer):
                 cfg.hidden_size, cfg.vocab_size, gather_output=True,
                 has_bias=False)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None):
         from .. import ops
 
-        h = self.model(input_ids)
+        if kv_caches is not None:
+            h, new_caches = self.model(input_ids, kv_caches=kv_caches,
+                                       cache_pos=cache_pos)
+        else:
+            h = self.model(input_ids)
         if self.lm_head is None:
             w = self.model.embed_tokens.weight
-            return ops.matmul(h, w, transpose_y=True)
-        return self.lm_head(h)
+            logits = ops.matmul(h, w, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if kv_caches is not None:
+            return logits, new_caches
+        return logits
+
+    def init_kv_caches(self, batch, max_len):
+        from .generation import init_kv_caches
+
+        cfg = self.cfg
+        # KV heads only (GQA-native cache; see LlamaAttention.forward)
+        return init_kv_caches(cfg.num_layers, batch, cfg.num_kv_heads,
+                              cfg.hidden_size // cfg.num_heads, max_len,
+                              self.model.embed_tokens.weight.dtype)
 
 
 class LlamaPretrainingCriterion(nn.Layer):

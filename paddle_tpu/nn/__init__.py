@@ -13,6 +13,7 @@ from .layers_loss import *  # noqa: F401,F403
 from .layers_norm import *  # noqa: F401,F403
 from .layers_rnn import *  # noqa: F401,F403
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from .layers_transformer import *  # noqa: F401,F403
 from ..core.tensor import Parameter  # noqa: F401
 

@@ -43,14 +43,15 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
                    scale):
     bi = pl.program_id(0)
     pos = pos_ref[bi]                       # tokens 0..pos are valid
-    q = q_ref[:].astype(jnp.float32) * scale        # [1, D]
+    q = q_ref[:].astype(jnp.float32) * scale        # [G, D]
 
+    g = q.shape[0]                          # grouped queries per KV head
     d = q.shape[-1]
-    # stats kept rank-2 (1, 1): rank-1 loop state does not lower through
+    # stats kept rank-2 (G, 1): rank-1 loop state does not lower through
     # Mosaic (same failure class as the round-2 flash LSE BlockSpec)
-    m0 = jnp.full((1, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((1, 1), jnp.float32)
-    acc0 = jnp.zeros((1, d), jnp.float32)
+    m0 = jnp.full((g, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, d), jnp.float32)
 
     num_iters = (pos + block_k) // block_k  # == cdiv(pos+1, block_k)
 
@@ -59,9 +60,9 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
         k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [1,bk]
+                                preferred_element_type=jnp.float32)  # [G,bk]
         k_ids = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1)
+            jnp.int32, (g, block_k), 1)
         s = jnp.where(k_ids <= pos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -77,30 +78,37 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
 
 
 def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None):
-    """q: [B, H, D] current-token queries; kcache/vcache: [B, H, S, D]
+    """q: [B, Hq, D] current-token queries; kcache/vcache: [B, Hkv, S, D]
     (already containing the current token at index pos[b]); pos: [B] int32.
-    Returns [B, H, D]."""
-    b, h, d = q.shape
+    Hq may be a multiple of Hkv (GQA): each KV head serves the
+    Hq/Hkv-query group in one grid cell, so the cache is read ONCE per KV
+    head — the bandwidth shape GQA exists for. Returns [B, Hq, D]."""
+    b, hq, d = q.shape
+    hkv = kcache.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"query heads {hq} not a multiple of KV heads "
+                         f"{hkv}")
+    g = hq // hkv
     s = kcache.shape[2]
     scale = 1.0 / (d ** 0.5)
     block_k = _pick_block(s, block_k)
-    q4 = q.reshape(b, h, 1, d)
+    q4 = q.reshape(b, hkv, g, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, h),
+        grid=(b, hkv),
         in_specs=[
-            pl.BlockSpec((None, None, 1, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, g, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
             pl.BlockSpec((None, None, s, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
             pl.BlockSpec((None, None, s, d), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, 1, d),
+        out_specs=pl.BlockSpec((None, None, g, d),
                                lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, block_k=block_k, seq=s,
                           scale=scale),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, 1, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
     )(pos.astype(jnp.int32), q4, kcache, vcache)
-    return out.reshape(b, h, d)
+    return out.reshape(b, hq, d)

@@ -175,3 +175,119 @@ def test_vision_zoo_extended_forward(ctor, img):
     out = m(x)
     assert out.shape == [2, 7]
     assert np.isfinite(out.numpy()).all()
+
+
+def test_gpt_generate_matches_full_forward_loop():
+    """generate() (static KV cache + decode kernel path) must produce the
+    same greedy tokens as re-running the full forward every step."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(7)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, cfg.vocab_size, (2, 5))
+
+    # naive: full forward each step, greedy
+    ids = prompt.copy()
+    for _ in range(6):
+        logits = model(P.to_tensor(ids, "int32")).numpy()
+        ids = np.concatenate([ids, logits[:, -1].argmax(-1)[:, None]
+                              .astype(ids.dtype)], axis=1)
+
+    out = model.generate(P.to_tensor(prompt, "int32"), max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out._value), ids)
+
+
+def test_llama_generate_gqa_matches_full_forward_loop():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    P.seed(11)
+    cfg = LlamaConfig(vocab_size=89, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64,
+                      ffn_hidden=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(1)
+    prompt = rs.randint(0, cfg.vocab_size, (2, 4))
+
+    ids = prompt.copy()
+    for _ in range(5):
+        logits = model(P.to_tensor(ids, "int32")).numpy()
+        ids = np.concatenate([ids, logits[:, -1].argmax(-1)[:, None]
+                              .astype(ids.dtype)], axis=1)
+
+    out = model.generate(P.to_tensor(prompt, "int32"), max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(out._value), ids)
+
+
+def test_generate_eos_stops_early_and_sampling_runs():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(3)
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = P.to_tensor(np.zeros((1, 3), np.int64), "int32")
+    out = model.generate(prompt, max_new_tokens=8, do_sample=True,
+                         temperature=0.9, top_k=5, seed=0)
+    arr = np.asarray(out._value)
+    assert arr.shape[0] == 1 and 4 <= arr.shape[1] <= 11
+    # eos: greedy emits SOME token t at step1; using it as eos stops at 1
+    g = model.generate(prompt, max_new_tokens=8)
+    first = int(np.asarray(g._value)[0, 3])
+    g2 = model.generate(prompt, max_new_tokens=8, eos_token_id=first)
+    assert np.asarray(g2._value).shape[1] == 4
+
+
+def test_generate_per_row_eos_freezes_rows():
+    """Rows that emit eos are frozen to eos while other rows continue
+    (r3 review finding: all() only stopped on simultaneous finish)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(5)
+    cfg = GPTConfig(vocab_size=23, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt_np = np.array([[1, 2, 3], [4, 5, 6]])
+    base = model.generate(P.to_tensor(prompt_np, "int32"), max_new_tokens=6)
+    arr = np.asarray(base._value)
+    # pick row 0's first generated token as eos: row 0 freezes immediately
+    eos = int(arr[0, 3])
+    out = np.asarray(model.generate(P.to_tensor(prompt_np, "int32"),
+                                    max_new_tokens=6,
+                                    eos_token_id=eos)._value)
+    assert (out[0, 3:] == eos).all()  # frozen row: eos-padded
+
+
+def test_generate_program_cache_reused():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(6)
+    cfg = GPTConfig(vocab_size=19, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = P.to_tensor(np.ones((1, 3), np.int64), "int32")
+    model.generate(prompt, max_new_tokens=2)
+    assert len(model._gen_cache) == 1
+    model.generate(prompt, max_new_tokens=2)   # same sig -> cache hit
+    assert len(model._gen_cache) == 1
+    model.generate(prompt, max_new_tokens=2, do_sample=True, seed=0)
+    assert len(model._gen_cache) == 2
+
+
+def test_llama_gqa_cache_stores_kv_heads_only():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=31, hidden_size=32, num_layers=1,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64,
+                      ffn_hidden=64)
+    model = LlamaForCausalLM(cfg)
+    caches = model.init_kv_caches(2, 10)
+    k, v = caches[0]
+    assert k.shape[1] == 2  # kv heads, not 4 query heads

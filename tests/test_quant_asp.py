@@ -80,3 +80,94 @@ def test_asp_prune_model_and_decorate():
     # sparsity survives the update
     assert asp.check_sparsity(m[0].weight, n=2, m=4)
     assert asp.check_sparsity(m[2].weight, n=2, m=4)
+
+
+# ---------------- nn.quant weight-only / LLM.int8 serving path -----------
+
+def test_weight_quantize_roundtrip_int8_int4():
+    from paddle_tpu.nn import quant as Q
+
+    rs = np.random.RandomState(0)
+    w = P.to_tensor(rs.randn(64, 32).astype(np.float32))
+    for algo, tol in [("weight_only_int8", 0.02), ("weight_only_int4", 0.2)]:
+        qv, scale = Q.weight_quantize(w, algo=algo)
+        packed_in = 32 if algo.endswith("int8") else 32
+        assert list(qv.shape) == ([32, 64] if algo.endswith("int8")
+                                  else [32, 32])
+        assert str(qv.dtype) == "int8" and list(scale.shape) == [32]
+        back = Q.weight_dequantize(qv, scale, algo=algo)
+        err = np.max(np.abs(back.numpy() - w.numpy()))
+        assert err < tol * np.max(np.abs(w.numpy())), (algo, err)
+
+
+def test_weight_quantize_grouped():
+    from paddle_tpu.nn import quant as Q
+
+    rs = np.random.RandomState(1)
+    w = P.to_tensor(rs.randn(128, 16).astype(np.float32))
+    qv, scale = Q.weight_quantize(w, group_size=64)
+    assert list(scale.shape) == [2, 16]
+    back = Q.weight_dequantize(qv, scale, group_size=64)
+    err = np.max(np.abs(back.numpy() - w.numpy()))
+    assert err < 0.02 * np.max(np.abs(w.numpy()))
+
+
+def test_weight_only_linear_matches_float():
+    from paddle_tpu.nn import quant as Q
+
+    rs = np.random.RandomState(2)
+    x = P.to_tensor(rs.randn(4, 64).astype(np.float32))
+    w = P.to_tensor(rs.randn(64, 16).astype(np.float32))
+    b = P.to_tensor(rs.randn(16).astype(np.float32))
+    ref = (x.numpy() @ w.numpy()) + b.numpy()
+    qv, scale = Q.weight_quantize(w)
+    y = Q.weight_only_linear(x, qv, bias=b, weight_scale=scale)
+    rel = np.max(np.abs(y.numpy() - ref)) / np.max(np.abs(ref))
+    assert rel < 0.03, rel
+
+
+def test_weight_only_linear_layer_from_linear():
+    from paddle_tpu.nn import quant as Q
+
+    P.seed(0)
+    lin = P.nn.Linear(32, 8)
+    wol = Q.WeightOnlyLinear.from_linear(lin)
+    x = P.to_tensor(np.random.RandomState(3).randn(5, 32).astype(np.float32))
+    ref = lin(x).numpy()
+    got = wol(x).numpy()
+    rel = np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-6)
+    assert rel < 0.05, rel
+    # int8 storage halves+ the weight bytes
+    assert str(wol.quant_weight.dtype) == "int8"
+
+
+def test_llm_int8_linear_outlier_decomposition():
+    from paddle_tpu.nn import quant as Q
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(8, 64).astype(np.float32)
+    x[:, 7] *= 40.0   # one outlier channel far past threshold
+    w = rs.randn(64, 16).astype(np.float32)
+    ref = x @ w
+    qv, scale = Q.weight_quantize(P.to_tensor(w))
+    y = Q.llm_int8_linear(P.to_tensor(x), qv, weight_scale=scale,
+                          threshold=6.0)
+    rel = np.max(np.abs(y.numpy() - ref)) / np.max(np.abs(ref))
+    assert rel < 0.03, rel
+    # naive full-int8 (threshold huge -> no outlier split) must be worse
+    y_naive = Q.llm_int8_linear(P.to_tensor(x), qv, weight_scale=scale,
+                                threshold=1e9)
+    rel_naive = np.max(np.abs(y_naive.numpy() - ref)) / np.max(np.abs(ref))
+    assert rel_naive > rel
+
+
+def test_int4_odd_in_features_raises():
+    from paddle_tpu.nn import quant as Q
+
+    w = P.to_tensor(np.random.RandomState(0).randn(33, 8).astype(np.float32))
+    import pytest
+
+    with pytest.raises(ValueError, match="even in_features"):
+        Q.weight_quantize(w, algo="weight_only_int4")
+    with pytest.raises(ValueError, match="even in_features"):
+        Q.WeightOnlyLinear(33, 8, weight_dtype="int4")

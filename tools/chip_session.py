@@ -23,17 +23,15 @@ import time
 sys.path.insert(0, ".")
 
 LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "chip_session_log.json")
+                   "chip_session_log.jsonl")
 
 
 def log(phase, payload):
+    # JSONL append: crash-safe — a tunnel drop mid-write can at worst
+    # truncate the LAST line, never clobber earlier measurements
     entry = {"t": time.strftime("%H:%M:%S"), "phase": phase, **payload}
-    try:
-        data = json.load(open(LOG))
-    except Exception:
-        data = []
-    data.append(entry)
-    json.dump(data, open(LOG, "w"), indent=1)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
     print(f"[{entry['t']}] {phase}: {payload}", flush=True)
 
 
@@ -106,6 +104,77 @@ def phase_sweep():
                           "error": f"{type(e).__name__}: {str(e)[:100]}"})
 
 
+def phase_kernels():
+    """fused_norm / rope / decode-attention micro-benchmarks (PERF.md's
+    'not yet measured on hardware' list)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+
+    import importlib
+
+    # fused RMS norm vs XLA-fused jnp at GPT-125M shapes
+    FN = importlib.import_module("paddle_tpu.ops.pallas.fused_norm")
+    x = jnp.asarray(rs.randn(32 * 1024, 768), jnp.bfloat16)
+    w = jnp.asarray(rs.randn(768), jnp.bfloat16)
+    try:
+        f_pal = jax.jit(
+            lambda x: FN.fused_norm_pallas(x, w=w, eps=1e-5, kind="rms"))
+
+        def jnp_rms(x):
+            x32 = x.astype(jnp.float32)
+            y = x32 * jax.lax.rsqrt(
+                jnp.mean(x32 * x32, -1, keepdims=True) + 1e-5)
+            return (y * w.astype(jnp.float32)).astype(x.dtype)
+
+        f_jnp = jax.jit(jnp_rms)
+        log("kernels", {"op": "rms_norm 32kx768",
+                        "pallas_ms": round(slope(f_pal, x) * 1e3, 3),
+                        "jnp_ms": round(slope(f_jnp, x) * 1e3, 3)})
+    except Exception as e:
+        log("kernels", {"op": "rms_norm", "error": str(e)[:150]})
+
+    # rope at bench shape (neox phases)
+    try:
+        RP = importlib.import_module("paddle_tpu.ops.pallas.rope")
+        B, S, H, D = 32, 1024, 12, 64
+        qr = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+        inv = 1.0 / (10000.0 ** (np.arange(0, D, 2) / D))
+        ph = np.arange(S)[:, None] * inv[None, :]
+        cos = jnp.asarray(np.cos(np.concatenate([ph, ph], -1))[None, :,
+                                                               None, :],
+                          jnp.float32)
+        sin = jnp.asarray(np.sin(np.concatenate([ph, ph], -1))[None, :,
+                                                               None, :],
+                          jnp.float32)
+        f_rope = jax.jit(lambda q: RP.rope_pallas(q, cos, sin))
+        log("kernels", {"op": f"rope {B}x{S}x{H}x{D}",
+                        "pallas_ms": round(slope(f_rope, qr) * 1e3, 3)})
+    except Exception as e:
+        log("kernels", {"op": "rope", "error": str(e)[:150]})
+
+    # decode attention (paged KV single-token) at serving shape
+    try:
+        DA = importlib.import_module(
+            "paddle_tpu.ops.pallas.decode_attention")
+        B, H, S, D = 8, 12, 2048, 64
+        qd = jnp.asarray(rs.randn(B, H, D), jnp.bfloat16)
+        kc = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+        vc = jnp.asarray(rs.randn(B, H, S, D), jnp.bfloat16)
+        pos = jnp.full((B,), S - 1, jnp.int32)
+        f_dec = jax.jit(lambda q: DA.decode_attention(q, kc, vc, pos))
+        t = slope(f_dec, qd)
+        bytes_rw = 2 * B * H * S * D * 2  # K+V bf16 reads dominate
+        log("kernels", {"op": f"decode B{B} S{S}",
+                        "pallas_ms": round(t * 1e3, 3),
+                        "gbps": round(bytes_rw / t / 1e9, 1)})
+    except Exception as e:
+        log("kernels", {"op": "decode", "error": str(e)[:150]})
+
+
 def phase_autotune_seed():
     import jax.numpy as jnp
 
@@ -130,11 +199,13 @@ def phase_bench():
 
 
 PHASES = {"sanity": phase_sanity, "sweep": phase_sweep,
-          "autotune": phase_autotune_seed, "bench": phase_bench}
+          "kernels": phase_kernels, "autotune": phase_autotune_seed,
+          "bench": phase_bench}
 
 
 def main():
-    names = sys.argv[1:] or ["sanity", "sweep", "autotune", "bench"]
+    names = sys.argv[1:] or ["sanity", "sweep", "kernels", "autotune",
+                             "bench"]
     for n in names:
         try:
             PHASES[n]()
