@@ -1,0 +1,51 @@
+"""paddle_tpu.incubate.autograd — functional/prim autograd surface.
+
+Role parity: `python/paddle/incubate/autograd/__init__.py` (vjp, jvp,
+Jacobian, Hessian, enable_prim, disable_prim, forward_grad, grad). The
+reference's prim system decomposes composite ops into primitive vjp/jvp
+rules so its static compiler can differentiate and fuse
+(`primapi.py:25,108`); on this stack jax IS the primitive system — every
+op body already lowers to differentiable lax primitives — so
+enable/disable_prim only flips the compatibility flag the reference
+exposes, and forward-mode AD comes straight from `jax.jvp`.
+"""
+from __future__ import annotations
+
+from ...autograd.functional import hessian as Hessian
+from ...autograd.functional import jacobian as Jacobian
+from ...autograd.functional import jvp, vjp
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+_prim_state = {"enabled": False}
+
+
+def enable_prim():
+    """Compatibility flag (reference switches static AD to primitive-op
+    decomposition; XLA always differentiates primitives here)."""
+    _prim_state["enabled"] = True
+
+
+def disable_prim():
+    _prim_state["enabled"] = False
+
+
+def prim_enabled():
+    return _prim_state["enabled"]
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode derivative of `func` at `xs` along tangents `v`
+    (reference primapi.forward_grad role, functional form: the reference
+    operates on static-graph output/input Variables; here forward-mode AD
+    is `jax.jvp` over the same op bodies). Returns (outputs, tangents)."""
+    return jvp(func, xs, v)
+
+
+def grad(func, xs, v=None):
+    """Reverse-mode gradients of `func` at `xs` (reference primapi.grad
+    role, functional form). v: optional output cotangents; defaults to
+    ones. Returns the gradient(s) with the structure of `xs`."""
+    _, grads = vjp(func, xs, v)
+    return grads

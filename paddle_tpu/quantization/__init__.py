@@ -25,7 +25,7 @@ from ..nn.layer_base import Layer
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "quanters", "observers",
-    "BaseQuanter", "BaseObserver",
+    "BaseQuanter", "BaseObserver", "weight_only_quantize",
 ]
 
 
@@ -285,19 +285,19 @@ class QuantedConv2D(Layer):
                         groups=src.groups, data_format=src.data_format)
 
 
-def _swap_layers(model, make_twin):
-    """Replace quantizable sublayers in-place via make_twin(layer)->new."""
-    from ..nn.layers_common import Linear
-    from ..nn.layers_conv_pool import Conv2D
-
+def _swap_layers(model, make_twin, dry_run=False):
+    """Replace sublayers in-place: make_twin(layer) returns the
+    replacement or None (no match -> recurse into the layer). With
+    dry_run=True, twins are built but NOT installed — used to validate a
+    whole model before mutating it (an in-place swap must never leave the
+    caller's model half-converted when one layer fails)."""
     for name, sub in list(model.named_children()):
-        twin = None
-        if isinstance(sub, (Linear, Conv2D)):
-            twin = make_twin(sub)
+        twin = make_twin(sub)
         if twin is not None:
-            setattr(model, name, twin)
+            if not dry_run:
+                setattr(model, name, twin)
         else:
-            _swap_layers(sub, make_twin)
+            _swap_layers(sub, make_twin, dry_run=dry_run)
     return model
 
 
@@ -406,3 +406,34 @@ def quanter(name):
 
 
 _QUANTER_REGISTRY = {}
+
+
+def weight_only_quantize(model, weight_dtype="int8", group_size=-1,
+                         inplace=False):
+    """Swap every Linear-family sublayer (nn.Linear and the mpu
+    Column/RowParallelLinear, which store the same [in, out] weight) for a
+    `nn.quant.WeightOnlyLinear` holding int8/int4 weights + scales — the
+    serving-side weight-only pipeline (reference:
+    paddle.nn.quant.weight_quantize + PaddleNLP's predictor swap).
+    Single-chip serving path: parallel linears are swapped as plain
+    linears (quantized sharded serving would re-shard the int8 weights).
+    """
+    from ..distributed.mpu import ColumnParallelLinear, RowParallelLinear
+    from ..nn.layers_common import Linear
+    from ..nn.quant import WeightOnlyLinear
+
+    def make(sub):
+        if isinstance(sub, (Linear, ColumnParallelLinear,
+                            RowParallelLinear)):
+            return WeightOnlyLinear.from_linear(
+                sub, weight_dtype=weight_dtype, group_size=group_size)
+        return None
+
+    if inplace:
+        # validate the whole model BEFORE mutating: a mid-traversal
+        # failure (e.g. int4 on odd in_features) must not leave the
+        # caller's model half-swapped
+        _swap_layers(model, make, dry_run=True)
+    else:
+        model = copy.deepcopy(model)
+    return _swap_layers(model, make)

@@ -187,3 +187,24 @@ def test_autocast_bf16():
     assert str(z.dtype) == "bfloat16"
     z2 = P.matmul(x, y)
     assert str(z2.dtype) == "float32"
+
+
+def test_incubate_autograd_surface():
+    """incubate.autograd parity (reference incubate/autograd/__init__.py
+    __all__): functional vjp/jvp/Jacobian/Hessian + prim toggles +
+    forward_grad/grad."""
+    from paddle_tpu.incubate import autograd as IA
+
+    for n in ("vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+              "disable_prim", "forward_grad", "grad"):
+        assert hasattr(IA, n), n
+    x = P.to_tensor(np.array([3.0], np.float32))
+    out, tang = IA.forward_grad(lambda t: t * t, x)
+    np.testing.assert_allclose(np.asarray(tang._value), [6.0], rtol=1e-6)
+    g = IA.grad(lambda t: t * t, x)
+    gv = g.numpy() if hasattr(g, "numpy") else np.asarray(g)
+    np.testing.assert_allclose(gv, [6.0], rtol=1e-6)
+    IA.enable_prim()
+    assert IA.prim_enabled()
+    IA.disable_prim()
+    assert not IA.prim_enabled()

@@ -171,3 +171,31 @@ def test_int4_odd_in_features_raises():
         Q.weight_quantize(w, algo="weight_only_int4")
     with pytest.raises(ValueError, match="even in_features"):
         Q.WeightOnlyLinear(33, 8, weight_dtype="int4")
+
+
+def test_weight_only_quantize_model_generates():
+    """End-to-end serving quantization: swap a GPT's linears for int8
+    weight-only layers and generate; outputs stay close to float greedy."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.quantization import weight_only_quantize
+
+    P.seed(9)
+    cfg = GPTConfig(vocab_size=61, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    qmodel = weight_only_quantize(model, weight_dtype="int8")
+    assert qmodel is not model  # deepcopy by default
+    from paddle_tpu.nn.quant import WeightOnlyLinear
+
+    n_swapped = sum(1 for _, m in qmodel.named_sublayers()
+                    if isinstance(m, WeightOnlyLinear))
+    assert n_swapped >= 2 * cfg.num_layers  # qkv + out per block at least
+
+    prompt = P.to_tensor(np.array([[1, 2, 3, 4]]), "int32")
+    ref_logits = model(prompt).numpy()
+    q_logits = qmodel(prompt).numpy()
+    rel = np.max(np.abs(q_logits - ref_logits)) / np.max(np.abs(ref_logits))
+    assert rel < 0.1, rel
+    out = qmodel.generate(prompt, max_new_tokens=4)
+    assert np.asarray(out._value).shape == (1, 8)
