@@ -285,19 +285,15 @@ class QuantedConv2D(Layer):
                         groups=src.groups, data_format=src.data_format)
 
 
-def _swap_layers(model, make_twin, dry_run=False):
+def _swap_layers(model, make_twin):
     """Replace sublayers in-place: make_twin(layer) returns the
-    replacement or None (no match -> recurse into the layer). With
-    dry_run=True, twins are built but NOT installed — used to validate a
-    whole model before mutating it (an in-place swap must never leave the
-    caller's model half-converted when one layer fails)."""
+    replacement or None (no match -> recurse into the layer)."""
     for name, sub in list(model.named_children()):
         twin = make_twin(sub)
         if twin is not None:
-            if not dry_run:
-                setattr(model, name, twin)
+            setattr(model, name, twin)
         else:
-            _swap_layers(sub, make_twin, dry_run=dry_run)
+            _swap_layers(sub, make_twin)
     return model
 
 
@@ -315,6 +311,8 @@ class QAT:
             model = copy.deepcopy(model)
 
         def make(layer):
+            if not isinstance(layer, (Conv2D, Linear)):
+                return None
             act_f, w_f = self.config._config_for(layer)
             if act_f is None and w_f is None:
                 return None
@@ -322,9 +320,7 @@ class QAT:
             w = w_f.instance() if w_f else None
             if isinstance(layer, Conv2D):
                 return QuantedConv2D(layer, act, w)
-            if isinstance(layer, Linear):
-                return QuantedLinear(layer, act, w)
-            return None
+            return QuantedLinear(layer, act, w)
 
         return _swap_layers(model, make)
 
@@ -351,6 +347,8 @@ class PTQ:
             model = copy.deepcopy(model)
 
         def make(layer):
+            if not isinstance(layer, (Conv2D, Linear)):
+                return None
             act_f, w_f = self.config._config_for(layer)
             if act_f is None and w_f is None:
                 return None
@@ -360,9 +358,7 @@ class PTQ:
             w = w_f.instance() if w_f else None
             if isinstance(layer, Conv2D):
                 return QuantedConv2D(layer, act, w)
-            if isinstance(layer, Linear):
-                return QuantedLinear(layer, act, w)
-            return None
+            return QuantedLinear(layer, act, w)
 
         return _swap_layers(model, make)
 
@@ -422,18 +418,24 @@ def weight_only_quantize(model, weight_dtype="int8", group_size=-1,
     from ..nn.layers_common import Linear
     from ..nn.quant import WeightOnlyLinear
 
-    def make(sub):
-        if isinstance(sub, (Linear, ColumnParallelLinear,
-                            RowParallelLinear)):
-            return WeightOnlyLinear.from_linear(
-                sub, weight_dtype=weight_dtype, group_size=group_size)
-        return None
+    targets = (Linear, ColumnParallelLinear, RowParallelLinear)
 
-    if inplace:
-        # validate the whole model BEFORE mutating: a mid-traversal
-        # failure (e.g. int4 on odd in_features) must not leave the
-        # caller's model half-swapped
-        _swap_layers(model, make, dry_run=True)
-    else:
+    if not inplace:
         model = copy.deepcopy(model)
-    return _swap_layers(model, make)
+    # two-phase swap: BUILD every twin first (a failure — e.g. int4 on odd
+    # in_features — must not leave the caller's model half-swapped), then
+    # install. One quantization pass per weight.
+    swaps = []
+
+    def collect(m):
+        for name, sub in list(m.named_children()):
+            if isinstance(sub, targets):
+                swaps.append((m, name, WeightOnlyLinear.from_linear(
+                    sub, weight_dtype=weight_dtype, group_size=group_size)))
+            else:
+                collect(sub)
+
+    collect(model)
+    for parent, name, twin in swaps:
+        setattr(parent, name, twin)
+    return model

@@ -271,3 +271,27 @@ def test_output_only_external_tensor_binds_on_replay():
     a2, e2 = sf(x)  # replay
     np.testing.assert_allclose(a2.numpy(), [2, 2])
     np.testing.assert_allclose(e2.numpy(), [7, 7])
+
+
+def test_while_loop_with_tensor_predicate_captures():
+    """A data-dependent Python while loop: each iteration's bool force is a
+    sequential graph break; repeated trip counts replay from the trie."""
+    body_runs = []
+
+    def f(x):
+        while float(x.sum()) < 10.0:
+            x = x * 2.0
+        body_runs.append(1)
+        return x
+
+    sf = symbolic_translate(f)
+    x1 = P.to_tensor(np.ones((2,), np.float32))  # 1+1=2 -> 4 -> 8 -> 16
+    np.testing.assert_allclose(sf(x1).numpy(), [8, 8])
+    n = len(body_runs)
+    np.testing.assert_allclose(sf(x1).numpy(), [8, 8])  # replay
+    assert len(body_runs) == n
+    # different trip count (zero iterations): new path, still correct
+    x2 = P.to_tensor(np.full((2,), 6.0, np.float32))  # sum 12 >= 10: no-op
+    np.testing.assert_allclose(sf(x2).numpy(), [6, 6])
+    x3 = P.to_tensor(np.full((2,), 3.0, np.float32))  # 6 -> 12: one iter
+    np.testing.assert_allclose(sf(x3).numpy(), [6, 6])

@@ -188,6 +188,35 @@ def phase_autotune_seed():
                          "seconds": round(time.perf_counter() - t0, 1)})
 
 
+def phase_generate():
+    """GPT-125M single-chip decode throughput over the static KV cache
+    (serving metric: tokens/s at batch 8, prompt 128, 128 new tokens)."""
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=2048)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(0)
+    B, S0, NEW = 8, 128, 128
+    prompt = P.to_tensor(rs.randint(0, cfg.vocab_size, (B, S0)), "int32")
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=NEW)
+    _ = np.asarray(out._value)  # sync
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=NEW)
+    _ = np.asarray(out._value)
+    dt = time.perf_counter() - t0
+    log("generate", {"warm_s": round(warm, 1), "steady_s": round(dt, 2),
+                     "tokens_per_s": round(B * NEW / dt, 1),
+                     "ms_per_token_step": round(dt / NEW * 1e3, 2)})
+
+
 def phase_bench():
     t0 = time.perf_counter()
     r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
@@ -200,12 +229,12 @@ def phase_bench():
 
 PHASES = {"sanity": phase_sanity, "sweep": phase_sweep,
           "kernels": phase_kernels, "autotune": phase_autotune_seed,
-          "bench": phase_bench}
+          "generate": phase_generate, "bench": phase_bench}
 
 
 def main():
     names = sys.argv[1:] or ["sanity", "sweep", "kernels", "autotune",
-                             "bench"]
+                             "generate", "bench"]
     for n in names:
         try:
             PHASES[n]()
