@@ -29,17 +29,19 @@ from ..core import flags, rng
 from ..core.tensor import Tensor
 
 
-def _static_cache_attention(q, k, v, kv_cache, cache_pos):
+def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
     """Shared attention-over-static-cache body for the model families.
 
     q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] (GQA: Hkv may divide Hq — the
     cache stores KV heads, NOT expanded query heads, so GQA's decode
     bandwidth advantage survives); kv_cache: (k_buf, v_buf) Tensors
     [B, Hkv, max_len, D]; cache_pos: scalar int Tensor — write offset of
-    this call's tokens. Prefill (S > 1) assumes cache_pos == 0 and runs
-    causal attention over the fresh K/V; decode (S == 1) reads the cache
+    this call's tokens; attn_start: optional [B] int Tensor — first
+    NON-PAD position per row (left-padded ragged prompts). Prefill
+    (S > 1) assumes cache_pos == 0 and runs causal attention over the
+    fresh K/V (with pad columns masked); decode (S == 1) reads the cache
     through the Pallas `decode_attention` kernel (grouped queries per KV
-    head), masked to positions <= cache_pos.
+    head), masked to attn_start <= j <= cache_pos.
     Returns (out [B, S, Hq, D], (k_buf, v_buf))."""
     import importlib
 
@@ -62,21 +64,45 @@ def _static_cache_attention(q, k, v, kv_cache, cache_pos):
     kb = apply("kv_cache_update", upd, kb, kt, cache_pos)
     vb = apply("kv_cache_update", upd, vb, vt, cache_pos)
     if s == 1:
-        def dec(q1, kb_, vb_, p):
+        def dec(q1, kb_, vb_, p, st):
             pos = jnp.broadcast_to(p, (q1.shape[0],))
-            return DA.decode_attention(q1, kb_, vb_, pos)
+            return DA.decode_attention(q1, kb_, vb_, pos, start=st)
 
         q1 = q.reshape([b, hq, d])
-        out = apply("decode_attention", dec, q1, kb, vb, cache_pos)
+        out = apply("decode_attention", dec, q1, kb, vb, cache_pos,
+                    attn_start)
         out = out.reshape([b, 1, hq, d])
     else:
         if hkv != hq:
             rep = hq // hkv
             k = ops.repeat_interleave(k, rep, axis=2)
             v = ops.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                             dropout_p=0.0, training=False)
+        mask = None
+        if attn_start is not None:
+            def build_mask(st):
+                j = jnp.arange(s)[None, :]                    # key pos
+                i = jnp.arange(s)[:, None]                    # query pos
+                valid = (j <= i)[None] & (j[None] >= st[:, None, None])
+                return jnp.where(valid[:, None], 0.0, -1e30)  # [B,1,S,S]
+
+            mask = apply("prefill_pad_mask", build_mask, attn_start)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=0.0, training=False)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=0.0, training=False)
     return out, (kb, vb)
+
+
+def shift_positions(position_ids, attn_start):
+    """Per-row position shift for left-padded prompts: each row's first
+    real token sits at position 0 (pad rows clip to 0). Shared by the
+    model families' rope/learned-position branches."""
+    from .. import ops
+
+    if attn_start is None:
+        return position_ids
+    return ops.clip(position_ids - attn_start.unsqueeze(1), min=0)
 
 
 def init_kv_caches(num_layers, batch, num_heads, head_dim, max_len,
@@ -108,42 +134,46 @@ class GenerationMixin:
     `init_kv_caches(batch, max_len)` and
     `forward(ids, kv_caches=, cache_pos=) -> (logits, new_caches)`."""
 
-    def _gen_programs(self, b, s0, cap, do_sample, temperature, top_k):
+    def _gen_programs(self, b, s0, cap, do_sample, temperature, top_k,
+                      has_mask):
         """Compiled prefill/decode programs, cached per signature — a
         serving loop calling generate() repeatedly must not pay the XLA
         compile per call."""
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
-        sig = (b, s0, cap, bool(do_sample), float(temperature), int(top_k))
+        sig = (b, s0, cap, bool(do_sample), float(temperature), int(top_k),
+               bool(has_mask))
         hit = cache.get(sig)
         if hit is not None:
             return hit
 
-        def run(params, buffers, step_ids, caches, pos):
+        def run(params, buffers, step_ids, caches, pos, start):
             with flags.no_grad_guard(), flags.trace_guard():
                 with self.bind_state(params, buffers):
                     logits, new_caches = self(
                         Tensor(step_ids),
                         kv_caches=[(Tensor(k), Tensor(v))
                                    for k, v in caches],
-                        cache_pos=Tensor(pos))
+                        cache_pos=Tensor(pos),
+                        attn_start=(None if start is None
+                                    else Tensor(start)))
             return (logits._value,
                     [(k._value, v._value) for k, v in new_caches])
 
         @jax.jit
-        def prefill(params, buffers, ids, caches):
+        def prefill(params, buffers, ids, caches, start):
             logits, caches = run(params, buffers, ids, caches,
-                                 jnp.zeros((), jnp.int32))
+                                 jnp.zeros((), jnp.int32), start)
             return logits[:, -1, :], caches
 
         # caches are donated: the step overwrites one position in each
         # buffer, and donation lets XLA update in place instead of
         # copying ~2*L*B*H*max*D bytes every token
         @functools.partial(jax.jit, donate_argnums=(3,))
-        def decode(params, buffers, tok, caches, pos, key):
+        def decode(params, buffers, tok, caches, pos, key, start):
             logits, caches = run(params, buffers, tok[:, None], caches,
-                                 pos)
+                                 pos, start)
             nxt = _sample(logits[:, -1, :], key, do_sample,
                           temperature, top_k)
             return nxt, caches
@@ -152,17 +182,45 @@ class GenerationMixin:
         return cache[sig]
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
-                 temperature=1.0, top_k=0, eos_token_id=None, seed=None):
+                 temperature=1.0, top_k=0, eos_token_id=None, seed=None,
+                 attention_mask=None):
         """input_ids: [B, S0] int Tensor/array. Returns an int32 Tensor
         [B, S0 + n_generated]. With eos_token_id set, rows that emit eos
         are frozen (their remaining positions fill with eos) and the loop
-        stops once every row has finished."""
+        stops once every row has finished. attention_mask: optional
+        [B, S0] 0/1 mask for LEFT-padded ragged prompts — pad positions
+        never contribute to attention and rotary/learned positions start
+        at each row's first real token."""
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
         b, s0 = ids.shape
         if max_new_tokens <= 0:
             return Tensor(ids)
+        start = None
+        if attention_mask is not None:
+            m = attention_mask._value if isinstance(attention_mask, Tensor) \
+                else jnp.asarray(attention_mask)
+            m = m.astype(jnp.int32)
+            if m.shape != (b, s0):
+                raise ValueError(
+                    f"attention_mask must be [B, S0]={b, s0}, "
+                    f"got {tuple(m.shape)}")
+            mh = np.asarray(jax.device_get(m))
+            if not (mh[:, -1] == 1).all():
+                raise ValueError(
+                    "attention_mask must be LEFT-padded (last column all "
+                    "ones): right padding would put a pad token at the "
+                    "next-token prediction position")
+            starts_h = mh.argmax(axis=1)
+            rows = np.arange(b)[:, None]
+            if not ((np.arange(s0)[None, :] >= starts_h[:, None])
+                    == mh[rows, np.arange(s0)[None, :]].astype(bool)).all():
+                raise ValueError(
+                    "attention_mask must be contiguous left padding "
+                    "(zeros then ones per row)")
+            # left-padding: first real token = number of leading zeros
+            start = jnp.asarray(starts_h, jnp.int32)
         max_len = s0 + max_new_tokens
         was_training = self.training
         self.eval()
@@ -171,11 +229,13 @@ class GenerationMixin:
             caches = self.init_kv_caches(b, max_len)
             cap = caches[0][0].shape[2]
             prefill, decode = self._gen_programs(
-                b, s0, cap, do_sample, temperature, top_k)
+                b, s0, cap, do_sample, temperature, top_k,
+                start is not None)
             key = (jax.random.PRNGKey(seed) if seed is not None
                    else rng.default_generator.split())
 
-            last_logits, caches = prefill(params, buffers, ids, caches)
+            last_logits, caches = prefill(params, buffers, ids, caches,
+                                          start)
             key, sub = jax.random.split(key)
             tok = _sample(last_logits, sub, do_sample, temperature, top_k)
             finished = jnp.zeros((b,), bool)
@@ -189,7 +249,7 @@ class GenerationMixin:
                 key, sub = jax.random.split(key)
                 tok, caches = decode(params, buffers, tok, caches,
                                      jnp.asarray(s0 + i - 1, jnp.int32),
-                                     sub)
+                                     sub, start)
                 if eos_token_id is not None:
                     # frozen rows keep emitting eos, not live continuations
                     tok = jnp.where(finished, eos_token_id, tok)

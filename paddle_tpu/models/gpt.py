@@ -79,7 +79,8 @@ class GPTAttention(nn.Layer):
         self.out_proj = mpu.RowParallelLinear(
             cfg.hidden_size, cfg.hidden_size, input_is_parallel=True)
 
-    def forward(self, x, cache=None, kv_cache=None, cache_pos=None):
+    def forward(self, x, cache=None, kv_cache=None, cache_pos=None,
+                attn_start=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
@@ -87,11 +88,16 @@ class GPTAttention(nn.Layer):
         if self.cfg.use_rope:
             position_ids = None
             if kv_cache is not None:
-                # static-cache path: phases continue from the traced offset
+                # static-cache path: phases continue from the traced
+                # offset; left-padded rows shift so their first REAL
+                # token sits at rotary position 0
                 from .. import ops
 
+                from .generation import shift_positions
+
                 row = ops.arange(0, s, dtype="int32") + cache_pos
-                position_ids = ops.broadcast_to(row.unsqueeze(0), [b, s])
+                position_ids = shift_positions(
+                    ops.broadcast_to(row.unsqueeze(0), [b, s]), attn_start)
             elif cache is not None:
                 # legacy concat cache: offset is a host int
                 import numpy as _np
@@ -103,7 +109,7 @@ class GPTAttention(nn.Layer):
                 q, k, None, position_ids=position_ids)
         if kv_cache is not None:
             out, new_cache = _static_cache_attention(
-                q, k, v, kv_cache, cache_pos)
+                q, k, v, kv_cache, cache_pos, attn_start)
             out = out.reshape([b, s, h])
             out = self.out_proj(out)
             return out, new_cache
@@ -173,10 +179,11 @@ class GPTBlock(nn.Layer):
         x = x + self.drop(self.mlp(self.ln_2(x)))
         return x
 
-    def forward(self, x, kv_cache=None, cache_pos=None):
+    def forward(self, x, kv_cache=None, cache_pos=None, attn_start=None):
         if kv_cache is not None:
             a, new_cache = self.attn(self.ln_1(x), kv_cache=kv_cache,
-                                     cache_pos=cache_pos)
+                                     cache_pos=cache_pos,
+                                     attn_start=attn_start)
             x = x + a
             x = x + self.mlp(self.ln_2(x))
             return x, new_cache
@@ -196,20 +203,30 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = _norm(cfg)
 
-    def forward(self, input_ids, kv_caches=None, cache_pos=None):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None,
+                attn_start=None):
         from .. import ops
 
         x = self.wte(input_ids)
         if not self.cfg.use_rope:
             pos = ops.arange(0, input_ids.shape[1], dtype="int32")
             if kv_caches is not None:
+                from .generation import shift_positions
+
                 pos = pos + cache_pos
+                if attn_start is not None:
+                    pos = shift_positions(
+                        ops.broadcast_to(
+                            pos.unsqueeze(0),
+                            [input_ids.shape[0], input_ids.shape[1]]),
+                        attn_start)
             x = x + self.wpe(pos)
         x = self.drop(x)
         if kv_caches is not None:
             new_caches = []
             for block, kc in zip(self.h, kv_caches):
-                x, nc = block(x, kv_cache=kc, cache_pos=cache_pos)
+                x, nc = block(x, kv_cache=kc, cache_pos=cache_pos,
+                              attn_start=attn_start)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         for block in self.h:
@@ -226,10 +243,12 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
             self.lm_head = mpu.ColumnParallelLinear(
                 cfg.hidden_size, cfg.vocab_size, has_bias=False)
 
-    def forward(self, input_ids, kv_caches=None, cache_pos=None):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None,
+                attn_start=None):
         if kv_caches is not None:
             x, new_caches = self.gpt(input_ids, kv_caches=kv_caches,
-                                     cache_pos=cache_pos)
+                                     cache_pos=cache_pos,
+                                     attn_start=attn_start)
         else:
             x = self.gpt(input_ids)
         if self.cfg.tie_embeddings:

@@ -62,7 +62,8 @@ class LlamaAttention(nn.Layer):
         self.out_proj = mpu.RowParallelLinear(
             q_size, cfg.hidden_size, input_is_parallel=True, has_bias=False)
 
-    def forward(self, x, cache=None, kv_cache=None, cache_pos=None):
+    def forward(self, x, cache=None, kv_cache=None, cache_pos=None,
+                attn_start=None):
         from .. import ops
 
         b, s, _ = x.shape
@@ -76,9 +77,14 @@ class LlamaAttention(nn.Layer):
         v = v.reshape([b, s, self.num_kv_heads, hd])
         position_ids = None
         if kv_cache is not None:
-            # static-cache decode: phases continue from the traced offset
+            # static-cache decode: phases continue from the traced offset;
+            # left-padded rows start rotary position 0 at their first
+            # real token
+            from .generation import shift_positions
+
             row = ops.arange(0, s, dtype="int32") + cache_pos
-            position_ids = ops.broadcast_to(row.unsqueeze(0), [b, s])
+            position_ids = shift_positions(
+                ops.broadcast_to(row.unsqueeze(0), [b, s]), attn_start)
         elif cache is not None:
             # legacy concat cache: offset is a host int
             import numpy as _np
@@ -99,7 +105,7 @@ class LlamaAttention(nn.Layer):
             # kernel groups Hq/Hkv queries per KV head so the cache is read
             # once per KV head (GQA's decode-bandwidth advantage)
             out, new_cache = _static_cache_attention(
-                q, k, v, kv_cache, cache_pos)
+                q, k, v, kv_cache, cache_pos, attn_start)
             out = self.out_proj(out.reshape([b, s, q_size]))
             return out, new_cache
         if self.num_kv_heads != self.num_heads:
@@ -154,10 +160,11 @@ class LlamaBlock(nn.Layer):
         x = x + self.attn(self.input_norm(x))
         return x + self.mlp(self.post_norm(x))
 
-    def forward(self, x, kv_cache=None, cache_pos=None):
+    def forward(self, x, kv_cache=None, cache_pos=None, attn_start=None):
         if kv_cache is not None:
             a, new_cache = self.attn(self.input_norm(x), kv_cache=kv_cache,
-                                     cache_pos=cache_pos)
+                                     cache_pos=cache_pos,
+                                     attn_start=attn_start)
             x = x + a
             return x + self.mlp(self.post_norm(x)), new_cache
         if self.cfg.recompute and self.training:
@@ -175,12 +182,14 @@ class LlamaModel(nn.Layer):
                                     for _ in range(cfg.num_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
 
-    def forward(self, input_ids, kv_caches=None, cache_pos=None):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None,
+                attn_start=None):
         x = self.embed_tokens(input_ids)
         if kv_caches is not None:
             new_caches = []
             for blk, kc in zip(self.layers, kv_caches):
-                x, nc = blk(x, kv_cache=kc, cache_pos=cache_pos)
+                x, nc = blk(x, kv_cache=kc, cache_pos=cache_pos,
+                            attn_start=attn_start)
                 new_caches.append(nc)
             return self.norm(x), new_caches
         for blk in self.layers:
@@ -200,12 +209,14 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
                 cfg.hidden_size, cfg.vocab_size, gather_output=True,
                 has_bias=False)
 
-    def forward(self, input_ids, kv_caches=None, cache_pos=None):
+    def forward(self, input_ids, kv_caches=None, cache_pos=None,
+                attn_start=None):
         from .. import ops
 
         if kv_caches is not None:
             h, new_caches = self.model(input_ids, kv_caches=kv_caches,
-                                       cache_pos=cache_pos)
+                                       cache_pos=cache_pos,
+                                       attn_start=attn_start)
         else:
             h = self.model(input_ids)
         if self.lm_head is None:

@@ -42,7 +42,8 @@ def decode_attention_available(cache_shape) -> bool:
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
                    scale):
     bi = pl.program_id(0)
-    pos = pos_ref[bi]                       # tokens 0..pos are valid
+    pos = pos_ref[0, bi]                    # tokens start..pos are valid
+    start = pos_ref[1, bi]                  # left-padded rows: start > 0
     q = q_ref[:].astype(jnp.float32) * scale        # [G, D]
 
     g = q.shape[0]                          # grouped queries per KV head
@@ -53,6 +54,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
     l0 = jnp.zeros((g, 1), jnp.float32)
     acc0 = jnp.zeros((g, d), jnp.float32)
 
+    first = start // block_k                # skip fully-padded blocks
     num_iters = (pos + block_k) // block_k  # == cdiv(pos+1, block_k)
 
     def body(j, carry):
@@ -63,7 +65,7 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
                                 preferred_element_type=jnp.float32)  # [G,bk]
         k_ids = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (g, block_k), 1)
-        s = jnp.where(k_ids <= pos, s, NEG_INF)
+        s = jnp.where((k_ids >= start) & (k_ids <= pos), s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -73,16 +75,19 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, seq,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(first, num_iters, body, (m0, l0, acc0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None):
+def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None,
+                     start=None):
     """q: [B, Hq, D] current-token queries; kcache/vcache: [B, Hkv, S, D]
     (already containing the current token at index pos[b]); pos: [B] int32.
-    Hq may be a multiple of Hkv (GQA): each KV head serves the
-    Hq/Hkv-query group in one grid cell, so the cache is read ONCE per KV
-    head — the bandwidth shape GQA exists for. Returns [B, Hq, D]."""
+    start: optional [B] int32 — first valid cache index per row (> 0 for
+    left-padded prompts; padding slots never contribute). Hq may be a
+    multiple of Hkv (GQA): each KV head serves the Hq/Hkv-query group in
+    one grid cell, so the cache is read ONCE per KV head — the bandwidth
+    shape GQA exists for. Returns [B, Hq, D]."""
     b, hq, d = q.shape
     hkv = kcache.shape[1]
     if hq % hkv != 0:
@@ -93,6 +98,10 @@ def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None):
     scale = 1.0 / (d ** 0.5)
     block_k = _pick_block(s, block_k)
     q4 = q.reshape(b, hkv, g, d)
+    if start is None:
+        start = jnp.zeros((b,), jnp.int32)
+    pos2 = jnp.stack([pos.astype(jnp.int32),
+                      start.astype(jnp.int32)])      # [2, B] scalar prefetch
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv),
@@ -110,5 +119,5 @@ def decode_attention(q, kcache, vcache, pos, block_k=256, interpret=None):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(pos.astype(jnp.int32), q4, kcache, vcache)
+    )(pos2, q4, kcache, vcache)
     return out.reshape(b, hq, d)

@@ -285,6 +285,28 @@ class QuantedConv2D(Layer):
                         groups=src.groups, data_format=src.data_format)
 
 
+def _make_quanted(config, layer, force_observer=False):
+    """Build the quantized twin for a swappable layer, or None. Shared by
+    the QAT and PTQ drivers (PTQ coerces activation quanters to
+    observers)."""
+    from ..nn.layers_common import Linear
+    from ..nn.layers_conv_pool import Conv2D
+
+    if not isinstance(layer, (Conv2D, Linear)):
+        return None
+    act_f, w_f = config._config_for(layer)
+    if act_f is None and w_f is None:
+        return None
+    act = act_f.instance() if act_f else None
+    if force_observer and act is not None and \
+            not isinstance(act, BaseObserver):
+        act = AbsmaxObserver()
+    w = w_f.instance() if w_f else None
+    if isinstance(layer, Conv2D):
+        return QuantedConv2D(layer, act, w)
+    return QuantedLinear(layer, act, w)
+
+
 def _swap_layers(model, make_twin):
     """Replace sublayers in-place: make_twin(layer) returns the
     replacement or None (no match -> recurse into the layer)."""
@@ -304,25 +326,10 @@ class QAT:
         self.config = config
 
     def quantize(self, model, inplace=False):
-        from ..nn.layers_common import Linear
-        from ..nn.layers_conv_pool import Conv2D
-
         if not inplace:
             model = copy.deepcopy(model)
-
-        def make(layer):
-            if not isinstance(layer, (Conv2D, Linear)):
-                return None
-            act_f, w_f = self.config._config_for(layer)
-            if act_f is None and w_f is None:
-                return None
-            act = act_f.instance() if act_f else None
-            w = w_f.instance() if w_f else None
-            if isinstance(layer, Conv2D):
-                return QuantedConv2D(layer, act, w)
-            return QuantedLinear(layer, act, w)
-
-        return _swap_layers(model, make)
+        return _swap_layers(
+            model, lambda l: _make_quanted(self.config, l))
 
     def convert(self, model, inplace=False):
         """Freeze: drop the moving-stat updates (eval mode is enough in the
@@ -340,27 +347,11 @@ class PTQ:
         self.config = config
 
     def quantize(self, model, inplace=False):
-        from ..nn.layers_common import Linear
-        from ..nn.layers_conv_pool import Conv2D
-
         if not inplace:
             model = copy.deepcopy(model)
-
-        def make(layer):
-            if not isinstance(layer, (Conv2D, Linear)):
-                return None
-            act_f, w_f = self.config._config_for(layer)
-            if act_f is None and w_f is None:
-                return None
-            act = act_f.instance() if act_f else None
-            if act is not None and not isinstance(act, BaseObserver):
-                act = AbsmaxObserver()
-            w = w_f.instance() if w_f else None
-            if isinstance(layer, Conv2D):
-                return QuantedConv2D(layer, act, w)
-            return QuantedLinear(layer, act, w)
-
-        return _swap_layers(model, make)
+        return _swap_layers(
+            model, lambda l: _make_quanted(self.config, l,
+                                           force_observer=True))
 
     def convert(self, model, inplace=False):
         """Replace observers with fixed fake-quant using observed scales."""

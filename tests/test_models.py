@@ -291,3 +291,100 @@ def test_llama_gqa_cache_stores_kv_heads_only():
     caches = model.init_kv_caches(2, 10)
     k, v = caches[0]
     assert k.shape[1] == 2  # kv heads, not 4 query heads
+
+
+def test_generate_left_padded_ragged_batch():
+    """Ragged prompts via attention_mask: every row must generate the SAME
+    tokens as running it alone unpadded (pad slots masked out of
+    attention, rotary positions shifted per row)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(21)
+    cfg = GPTConfig(vocab_size=83, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(2)
+    row_a = rs.randint(1, cfg.vocab_size, (6,))   # length 6
+    row_b = rs.randint(1, cfg.vocab_size, (3,))   # length 3
+
+    # solo references (no padding)
+    ref_a = np.asarray(model.generate(
+        P.to_tensor(row_a[None], "int32"), max_new_tokens=4)._value)[0, 6:]
+    ref_b = np.asarray(model.generate(
+        P.to_tensor(row_b[None], "int32"), max_new_tokens=4)._value)[0, 3:]
+
+    # left-padded ragged batch
+    ids = np.zeros((2, 6), np.int64)
+    mask = np.zeros((2, 6), np.int64)
+    ids[0] = row_a; mask[0] = 1
+    ids[1, 3:] = row_b; mask[1, 3:] = 1
+    out = np.asarray(model.generate(
+        P.to_tensor(ids, "int32"), max_new_tokens=4,
+        attention_mask=P.to_tensor(mask, "int32"))._value)
+    np.testing.assert_array_equal(out[0, 6:], ref_a)
+    np.testing.assert_array_equal(out[1, 6:], ref_b)
+
+
+def test_generate_left_padded_gqa_llama():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    P.seed(23)
+    cfg = LlamaConfig(vocab_size=71, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64,
+                      ffn_hidden=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(3)
+    row = rs.randint(1, cfg.vocab_size, (4,))
+    ref = np.asarray(model.generate(
+        P.to_tensor(row[None], "int32"), max_new_tokens=3)._value)[0, 4:]
+    ids = np.zeros((2, 7), np.int64)
+    mask = np.zeros((2, 7), np.int64)
+    ids[0, 3:] = row; mask[0, 3:] = 1
+    ids[1] = rs.randint(1, cfg.vocab_size, (7,)); mask[1] = 1
+    out = np.asarray(model.generate(
+        P.to_tensor(ids, "int32"), max_new_tokens=3,
+        attention_mask=P.to_tensor(mask, "int32"))._value)
+    np.testing.assert_array_equal(out[0, 7:], ref)
+
+
+def test_generate_left_padded_learned_positions():
+    """Non-rope GPT (learned wpe positions): the per-row position shift in
+    GPTModel.forward must make padded rows match solo generation."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(27)
+    cfg = GPTConfig(vocab_size=67, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, use_rope=False)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(6)
+    row = rs.randint(1, cfg.vocab_size, (3,))
+    ref = np.asarray(model.generate(
+        P.to_tensor(row[None], "int32"), max_new_tokens=4)._value)[0, 3:]
+    ids = np.zeros((2, 6), np.int64); mask = np.zeros((2, 6), np.int64)
+    ids[0, 3:] = row; mask[0, 3:] = 1
+    ids[1] = rs.randint(1, cfg.vocab_size, (6,)); mask[1] = 1
+    out = np.asarray(model.generate(
+        P.to_tensor(ids, "int32"), max_new_tokens=4,
+        attention_mask=P.to_tensor(mask, "int32"))._value)
+    np.testing.assert_array_equal(out[0, 6:], ref)
+
+
+def test_generate_rejects_bad_masks():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=31, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    ids = P.to_tensor(np.ones((1, 4), np.int64), "int32")
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        model.generate(ids, max_new_tokens=2,
+                       attention_mask=P.to_tensor(
+                           np.array([[1, 1, 1, 0]]), "int32"))
+    with pytest.raises(ValueError, match="contiguous"):
+        model.generate(ids, max_new_tokens=2,
+                       attention_mask=P.to_tensor(
+                           np.array([[1, 0, 1, 1]]), "int32"))
