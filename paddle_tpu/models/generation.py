@@ -134,6 +134,21 @@ class GenerationMixin:
     `init_kv_caches(batch, max_len)` and
     `forward(ids, kv_caches=, cache_pos=) -> (logits, new_caches)`."""
 
+    def _model_run(self, params, buffers, step_ids, caches, pos,
+                   start):
+        """One cached-forward model invocation on raw jax values (shared
+        by the greedy/sampling and beam program builders — the model-call
+        contract lives in exactly one place)."""
+        with flags.no_grad_guard(), flags.trace_guard():
+            with self.bind_state(params, buffers):
+                logits, new_caches = self(
+                    Tensor(step_ids),
+                    kv_caches=[(Tensor(k), Tensor(v)) for k, v in caches],
+                    cache_pos=Tensor(pos),
+                    attn_start=(None if start is None else Tensor(start)))
+        return (logits._value,
+                [(k._value, v._value) for k, v in new_caches])
+
     def _gen_programs(self, b, s0, cap, do_sample, temperature, top_k,
                       has_mask):
         """Compiled prefill/decode programs, cached per signature — a
@@ -148,18 +163,7 @@ class GenerationMixin:
         if hit is not None:
             return hit
 
-        def run(params, buffers, step_ids, caches, pos, start):
-            with flags.no_grad_guard(), flags.trace_guard():
-                with self.bind_state(params, buffers):
-                    logits, new_caches = self(
-                        Tensor(step_ids),
-                        kv_caches=[(Tensor(k), Tensor(v))
-                                   for k, v in caches],
-                        cache_pos=Tensor(pos),
-                        attn_start=(None if start is None
-                                    else Tensor(start)))
-            return (logits._value,
-                    [(k._value, v._value) for k, v in new_caches])
+        run = self._model_run
 
         @jax.jit
         def prefill(params, buffers, ids, caches, start):
@@ -181,9 +185,110 @@ class GenerationMixin:
         cache[sig] = (prefill, decode)
         return cache[sig]
 
+    # ---- beam search ----
+    def _beam_programs(self, b, n, s0, cap, vocab_pad_id):
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        sig = ("beam", b, n, s0, cap, vocab_pad_id)
+        hit = cache.get(sig)
+        if hit is not None:
+            return hit
+
+        run = self._model_run
+
+        @jax.jit
+        def beam_prefill(params, buffers, ids, caches):
+            logits, caches = run(params, buffers, ids, caches,
+                                 jnp.zeros((), jnp.int32), None)
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32), axis=-1)
+            scores, toks = jax.lax.top_k(logp, n)        # [B, N]
+            # tile each row's cache N times: beam i of row b at b*N+i
+            caches = [(jnp.repeat(k, n, axis=0), jnp.repeat(v, n, axis=0))
+                      for k, v in caches]
+            return toks.astype(jnp.int32), scores, caches
+
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def beam_step(params, buffers, tok, caches, pos, scores, frozen,
+                      lengths):
+            # tok/frozen: [B, N]; scores: [B, N] running log-probs;
+            # lengths: [B, N] generated tokens before each beam froze
+            logits, caches = run(params, buffers,
+                                 tok.reshape(b * n)[:, None], caches, pos,
+                                 None)
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32), axis=-1)
+            v = logp.shape[-1]
+            logp = logp.reshape(b, n, v)
+            # frozen beams only extend with the pad/eos token at no cost
+            freeze_row = jnp.full((v,), -1e30).at[vocab_pad_id].set(0.0)
+            logp = jnp.where(frozen[:, :, None], freeze_row[None, None],
+                             logp)
+            total = scores[:, :, None] + logp                 # [B, N, V]
+            new_scores, flat = jax.lax.top_k(total.reshape(b, n * v), n)
+            parent = (flat // v).astype(jnp.int32)            # [B, N]
+            new_tok = (flat % v).astype(jnp.int32)
+            # reorder caches to the chosen parents
+            gather = (jnp.arange(b)[:, None] * n + parent).reshape(-1)
+            caches = [(k[gather], v_[gather]) for k, v_ in caches]
+            new_frozen = jnp.take_along_axis(frozen, parent, axis=1)
+            new_lengths = jnp.take_along_axis(lengths, parent, axis=1) \
+                + (~new_frozen).astype(jnp.float32)
+            return (new_tok, new_scores, parent, new_frozen, new_lengths,
+                    caches)
+
+        cache[sig] = (beam_prefill, beam_step)
+        return cache[sig]
+
+    def _beam_search(self, ids, max_new_tokens, num_beams, eos_token_id,
+                     length_penalty):
+        b, s0 = ids.shape
+        n = num_beams
+        params, buffers = self.functional_state()
+        caches = self.init_kv_caches(b, s0 + max_new_tokens)
+        # prefill at batch B (tiling N identical prefills would waste N-1x)
+        cap = caches[0][0].shape[2]
+        pad = eos_token_id if eos_token_id is not None else 0
+        beam_prefill, beam_step = self._beam_programs(b, n, s0, cap, pad)
+
+        tok, scores, caches = beam_prefill(params, buffers, ids, caches)
+        frozen = jnp.zeros((b, n), bool)
+        if eos_token_id is not None:
+            frozen = tok == eos_token_id
+        lengths = jnp.ones((b, n), jnp.float32)  # 1 generated token so far
+        history = [(tok, jnp.tile(jnp.arange(n), (b, 1)))]
+        for i in range(1, max_new_tokens):
+            if eos_token_id is not None and bool(
+                    np.asarray(jax.device_get(frozen.all()))):
+                break
+            tok, scores, parent, frozen, lengths, caches = beam_step(
+                params, buffers, tok, caches,
+                jnp.asarray(s0 + i - 1, jnp.int32), scores, frozen,
+                lengths)
+            if eos_token_id is not None:
+                tok = jnp.where(frozen, pad, tok)
+                frozen = frozen | (tok == eos_token_id)
+            history.append((tok, parent))
+        # backtrack the best beam per row (length-normalized by each
+        # beam's REAL pre-freeze length)
+        steps = len(history)
+        norm = scores / (jnp.maximum(lengths, 1.0) ** length_penalty)
+        best = jnp.argmax(norm, axis=1)                       # [B]
+        toks_h = [np.asarray(jax.device_get(t)) for t, _ in history]
+        parents_h = [np.asarray(jax.device_get(p)) for _, p in history]
+        best_h = np.asarray(jax.device_get(best))
+        out = np.zeros((b, steps), np.int32)
+        beam = best_h.copy()
+        for t in range(steps - 1, -1, -1):
+            out[:, t] = toks_h[t][np.arange(b), beam]
+            beam = parents_h[t][np.arange(b), beam]
+        return Tensor(jnp.concatenate(
+            [ids, jnp.asarray(out)], axis=1))
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, eos_token_id=None, seed=None,
-                 attention_mask=None):
+                 attention_mask=None, num_beams=1, length_penalty=1.0):
         """input_ids: [B, S0] int Tensor/array. Returns an int32 Tensor
         [B, S0 + n_generated]. With eos_token_id set, rows that emit eos
         are frozen (their remaining positions fill with eos) and the loop
@@ -197,6 +302,21 @@ class GenerationMixin:
         b, s0 = ids.shape
         if max_new_tokens <= 0:
             return Tensor(ids)
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError("beam search with do_sample is not "
+                                 "supported; use num_beams=1 for sampling")
+            if attention_mask is not None:
+                raise ValueError("beam search over left-padded ragged "
+                                 "batches is not supported yet")
+            was_training = self.training
+            self.eval()
+            try:
+                return self._beam_search(ids, max_new_tokens, num_beams,
+                                         eos_token_id, length_penalty)
+            finally:
+                if was_training:
+                    self.train()
         start = None
         if attention_mask is not None:
             m = attention_mask._value if isinstance(attention_mask, Tensor) \

@@ -388,3 +388,57 @@ def test_generate_rejects_bad_masks():
         model.generate(ids, max_new_tokens=2,
                        attention_mask=P.to_tensor(
                            np.array([[1, 0, 1, 1]]), "int32"))
+
+
+def test_beam_search_beats_or_equals_greedy():
+    """num_beams=1 == greedy exactly; wider beams find a sequence whose
+    total log-prob is >= greedy's (the point of beam search)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(31)
+    cfg = GPTConfig(vocab_size=43, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt_np = np.array([[7, 9, 11]])
+    prompt = P.to_tensor(prompt_np, "int32")
+
+    greedy = np.asarray(model.generate(prompt, max_new_tokens=5)._value)
+    beam1 = np.asarray(model.generate(prompt, max_new_tokens=5,
+                                      num_beams=1)._value)
+    np.testing.assert_array_equal(greedy, beam1)
+
+    beam4 = np.asarray(model.generate(prompt, max_new_tokens=5,
+                                      num_beams=4)._value)
+    assert beam4.shape == greedy.shape
+
+    def seq_logprob(full):
+        ids = P.to_tensor(full[:, :-1], "int32")
+        logits = np.asarray(model(ids)._value, np.float32)
+        lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+        tot = 0.0
+        for t in range(prompt_np.shape[1] - 1, full.shape[1] - 1):
+            tot += lp[0, t, full[0, t + 1]]
+        return tot
+
+    assert seq_logprob(beam4) >= seq_logprob(greedy) - 1e-4
+
+
+def test_beam_search_eos_and_errors():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(33)
+    cfg = GPTConfig(vocab_size=29, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = P.to_tensor(np.array([[1, 2]]), "int32")
+    out = np.asarray(model.generate(prompt, max_new_tokens=6, num_beams=3,
+                                    eos_token_id=5)._value)
+    assert out.shape[1] <= 8
+    with pytest.raises(ValueError, match="do_sample"):
+        model.generate(prompt, max_new_tokens=2, num_beams=2,
+                       do_sample=True)
