@@ -140,6 +140,21 @@ class LRScheduler(Callback):
             s.step()
 
 
+def _metric_comparator(mode, monitor, min_delta):
+    """'min'/'max'/'auto' improvement test shared by the monitor-driven
+    callbacks ('auto' infers max for accuracy-like monitors)."""
+    if mode == "max" or (mode == "auto" and "acc" in monitor):
+        return lambda a, b: a > b + min_delta
+    return lambda a, b: a < b - min_delta
+
+
+def _unwrap_metric(logs, monitor):
+    cur = (logs or {}).get(monitor)
+    if isinstance(cur, (list, tuple)):
+        cur = cur[0] if cur else None
+    return cur
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -150,18 +165,12 @@ class EarlyStopping(Callback):
         self.baseline = baseline
         self.wait = 0
         self.best = None
-        if mode == "max" or (mode == "auto" and "acc" in monitor):
-            self.better = lambda a, b: a > b + self.min_delta
-        else:
-            self.better = lambda a, b: a < b - self.min_delta
+        self.better = _metric_comparator(mode, monitor, self.min_delta)
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        cur = logs.get(self.monitor)
+        cur = _unwrap_metric(logs, self.monitor)
         if cur is None:
             return
-        if isinstance(cur, (list, tuple)):
-            cur = cur[0]
         if self.best is None or self.better(cur, self.best):
             self.best = cur
             self.wait = 0
@@ -199,3 +208,86 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR by `factor` when `monitor` stops improving
+    (reference hapi/callbacks.py ReduceLROnPlateau). Works on plain-float
+    learning rates (set_lr); scheduler-driven optimizers keep their
+    schedule — the callback warns once and does nothing."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError(
+                "ReduceLROnPlateau does not support a factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+        self._warned = False
+        self.better = _metric_comparator(mode, monitor, self.min_delta)
+
+    def on_eval_end(self, logs=None):
+        cur = _unwrap_metric(logs, self.monitor)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait < self.patience:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        try:
+            old = opt.get_lr()
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+        except RuntimeError:
+            if not self._warned:
+                import warnings
+
+                warnings.warn(
+                    "ReduceLROnPlateau: optimizer uses an LRScheduler; "
+                    "the callback cannot override it and will do nothing")
+                self._warned = True
+            return
+        self.cooldown_counter = self.cooldown
+        self.wait = 0
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference hapi/callbacks.py WandbCallback).
+    This environment has no network egress and no wandb package; the
+    callback raises at construction with that reason (documented gate,
+    not a silent no-op)."""
+
+    def __init__(self, *a, **kw):
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package, which is not "
+                "available in this environment (no network egress); use "
+                "the VisualDL jsonl logger callback instead") from e
+        raise NotImplementedError(
+            "wandb import unexpectedly succeeded; hook up run logging "
+            "before using WandbCallback")

@@ -186,11 +186,11 @@ class GenerationMixin:
         return cache[sig]
 
     # ---- beam search ----
-    def _beam_programs(self, b, n, s0, cap, vocab_pad_id):
+    def _beam_programs(self, b, n, s0, cap, eos_id, length_penalty):
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
-        sig = ("beam", b, n, s0, cap, vocab_pad_id)
+        sig = ("beam", b, n, s0, cap, eos_id, float(length_penalty))
         hit = cache.get(sig)
         if hit is not None:
             return hit
@@ -209,36 +209,49 @@ class GenerationMixin:
                       for k, v in caches]
             return toks.astype(jnp.int32), scores, caches
 
+        def pool_update(step_idx, tok, scores, lengths, pool):
+            """Move hypotheses that just emitted eos into the per-row
+            finished pool (best-so-far by length-normalized score), and
+            knock their beam slots out of the live search."""
+            fin_norm, fin_step, fin_beam = pool
+            done = tok == eos_id                              # [B, N]
+            norm = scores / (jnp.maximum(lengths, 1.0) ** length_penalty)
+            cand = jnp.where(done, norm, -jnp.inf)
+            best_c = jnp.argmax(cand, axis=1)                 # [B]
+            best_v = jnp.take_along_axis(cand, best_c[:, None], 1)[:, 0]
+            better = best_v > fin_norm
+            fin_norm = jnp.where(better, best_v, fin_norm)
+            fin_step = jnp.where(better, step_idx, fin_step)
+            fin_beam = jnp.where(better, best_c.astype(jnp.int32),
+                                 fin_beam)
+            scores = jnp.where(done, -1e30, scores)   # slot leaves the beam
+            return scores, (fin_norm, fin_step, fin_beam)
+
         @functools.partial(jax.jit, donate_argnums=(3,))
-        def beam_step(params, buffers, tok, caches, pos, scores, frozen,
-                      lengths):
-            # tok/frozen: [B, N]; scores: [B, N] running log-probs;
-            # lengths: [B, N] generated tokens before each beam froze
+        def beam_step(params, buffers, tok, caches, pos, scores, lengths,
+                      pool, step_idx):
+            # tok: [B, N]; scores: [B, N] running log-probs (finished
+            # slots already at -1e30); lengths: [B, N] tokens generated
             logits, caches = run(params, buffers,
                                  tok.reshape(b * n)[:, None], caches, pos,
                                  None)
             logp = jax.nn.log_softmax(
                 logits[:, -1, :].astype(jnp.float32), axis=-1)
             v = logp.shape[-1]
-            logp = logp.reshape(b, n, v)
-            # frozen beams only extend with the pad/eos token at no cost
-            freeze_row = jnp.full((v,), -1e30).at[vocab_pad_id].set(0.0)
-            logp = jnp.where(frozen[:, :, None], freeze_row[None, None],
-                             logp)
-            total = scores[:, :, None] + logp                 # [B, N, V]
+            total = scores[:, :, None] + logp.reshape(b, n, v)
             new_scores, flat = jax.lax.top_k(total.reshape(b, n * v), n)
             parent = (flat // v).astype(jnp.int32)            # [B, N]
             new_tok = (flat % v).astype(jnp.int32)
             # reorder caches to the chosen parents
             gather = (jnp.arange(b)[:, None] * n + parent).reshape(-1)
             caches = [(k[gather], v_[gather]) for k, v_ in caches]
-            new_frozen = jnp.take_along_axis(frozen, parent, axis=1)
-            new_lengths = jnp.take_along_axis(lengths, parent, axis=1) \
-                + (~new_frozen).astype(jnp.float32)
-            return (new_tok, new_scores, parent, new_frozen, new_lengths,
-                    caches)
+            new_lengths = jnp.take_along_axis(lengths, parent, axis=1) + 1.0
+            if eos_id is not None:
+                new_scores, pool = pool_update(
+                    step_idx, new_tok, new_scores, new_lengths, pool)
+            return new_tok, new_scores, parent, new_lengths, pool, caches
 
-        cache[sig] = (beam_prefill, beam_step)
+        cache[sig] = (beam_prefill, beam_step, pool_update)
         return cache[sig]
 
     def _beam_search(self, ids, max_new_tokens, num_beams, eos_token_id,
@@ -249,42 +262,50 @@ class GenerationMixin:
         caches = self.init_kv_caches(b, s0 + max_new_tokens)
         # prefill at batch B (tiling N identical prefills would waste N-1x)
         cap = caches[0][0].shape[2]
-        pad = eos_token_id if eos_token_id is not None else 0
-        beam_prefill, beam_step = self._beam_programs(b, n, s0, cap, pad)
+        beam_prefill, beam_step, pool_update = self._beam_programs(
+            b, n, s0, cap, eos_token_id, length_penalty)
 
         tok, scores, caches = beam_prefill(params, buffers, ids, caches)
-        frozen = jnp.zeros((b, n), bool)
-        if eos_token_id is not None:
-            frozen = tok == eos_token_id
         lengths = jnp.ones((b, n), jnp.float32)  # 1 generated token so far
+        # finished-hypothesis pool: best length-normalized score per row
+        # plus the (step, beam) to backtrack from — a completed sequence
+        # is never evicted by live continuations (review r3 finding)
+        pool = (jnp.full((b,), -jnp.inf),
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32))
+        if eos_token_id is not None:
+            scores, pool = pool_update(0, tok, scores, lengths, pool)
         history = [(tok, jnp.tile(jnp.arange(n), (b, 1)))]
         for i in range(1, max_new_tokens):
-            if eos_token_id is not None and bool(
-                    np.asarray(jax.device_get(frozen.all()))):
-                break
-            tok, scores, parent, frozen, lengths, caches = beam_step(
+            tok, scores, parent, lengths, pool, caches = beam_step(
                 params, buffers, tok, caches,
-                jnp.asarray(s0 + i - 1, jnp.int32), scores, frozen,
-                lengths)
-            if eos_token_id is not None:
-                tok = jnp.where(frozen, pad, tok)
-                frozen = frozen | (tok == eos_token_id)
+                jnp.asarray(s0 + i - 1, jnp.int32), scores, lengths, pool,
+                jnp.asarray(i, jnp.int32))
             history.append((tok, parent))
-        # backtrack the best beam per row (length-normalized by each
-        # beam's REAL pre-freeze length)
+        # pick per row: best finished hypothesis vs best live beam
         steps = len(history)
-        norm = scores / (jnp.maximum(lengths, 1.0) ** length_penalty)
-        best = jnp.argmax(norm, axis=1)                       # [B]
+        live_norm = scores / (jnp.maximum(lengths, 1.0) ** length_penalty)
+        live_best = jnp.argmax(live_norm, axis=1)
+        live_val = jnp.take_along_axis(live_norm, live_best[:, None],
+                                       1)[:, 0]
+        fin_norm, fin_step, fin_beam = pool
+        use_fin = fin_norm >= live_val
+        sel_step = np.asarray(jax.device_get(
+            jnp.where(use_fin, fin_step, steps - 1)))
+        sel_beam = np.asarray(jax.device_get(
+            jnp.where(use_fin, fin_beam, live_best.astype(jnp.int32))))
         toks_h = [np.asarray(jax.device_get(t)) for t, _ in history]
         parents_h = [np.asarray(jax.device_get(p)) for _, p in history]
-        best_h = np.asarray(jax.device_get(best))
-        out = np.zeros((b, steps), np.int32)
-        beam = best_h.copy()
+        # rows whose winner finished at sel_step keep an eos-filled tail
+        # (rectangular output)
+        eos_fill = eos_token_id if eos_token_id is not None else 0
+        out = np.full((b, steps), eos_fill, np.int32)
+        beam = sel_beam.copy()
+        rows = np.arange(b)
         for t in range(steps - 1, -1, -1):
-            out[:, t] = toks_h[t][np.arange(b), beam]
-            beam = parents_h[t][np.arange(b), beam]
-        return Tensor(jnp.concatenate(
-            [ids, jnp.asarray(out)], axis=1))
+            take = t <= sel_step
+            out[take, t] = toks_h[t][rows[take], beam[take]]
+            beam[take] = parents_h[t][rows[take], beam[take]]
+        return Tensor(jnp.concatenate([ids, jnp.asarray(out)], axis=1))
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, eos_token_id=None, seed=None,

@@ -439,6 +439,36 @@ def test_beam_search_eos_and_errors():
     out = np.asarray(model.generate(prompt, max_new_tokens=6, num_beams=3,
                                     eos_token_id=5)._value)
     assert out.shape[1] <= 8
+    gen = out[0, 2:]
+    if (gen == 5).any():  # once eos appears, only eos follows (pool tail)
+        first = int(np.argmax(gen == 5))
+        assert (gen[first:] == 5).all()
     with pytest.raises(ValueError, match="do_sample"):
         model.generate(prompt, max_new_tokens=2, num_beams=2,
                        do_sample=True)
+
+
+def test_beam_search_keeps_finished_hypothesis():
+    """A hypothesis that ends with eos must stay selectable even when live
+    continuations out-score it in the raw beam (finished pool, r3 review
+    finding): with a length_penalty strongly favoring short outputs, a
+    finished short hypothesis must win over full-length live beams when
+    its normalized score is higher."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(37)
+    cfg = GPTConfig(vocab_size=23, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=32, use_rope=True)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompt = P.to_tensor(np.array([[1, 2, 3]]), "int32")
+    # pick the greedy second token as eos so SOME beam finishes early
+    base = np.asarray(model.generate(prompt, max_new_tokens=2)._value)
+    eos = int(base[0, 4])
+    out = np.asarray(model.generate(
+        prompt, max_new_tokens=8, num_beams=4, eos_token_id=eos,
+        length_penalty=0.0)._value)
+    gen = out[0, 3:]
+    if (gen == eos).any():
+        first = int(np.argmax(gen == eos))
+        assert (gen[first:] == eos).all()

@@ -324,3 +324,39 @@ def test_hapi_fit_data_parallel():
     assert isinstance(model.network, DataParallel)
     data = FakeData(size=16, image_shape=(3, 4, 4), num_classes=10)
     model.fit(data, batch_size=8, epochs=1, verbose=0)
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    class _Opt:
+        def __init__(self):
+            self._lr = 0.1
+
+        def get_lr(self):
+            return self._lr
+
+        def set_lr(self, v):
+            self._lr = v
+
+    class _Model:
+        pass
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0, min_lr=0.01)
+    m = _Model(); m._optimizer = _Opt()
+    cb.model = m
+    cb.on_eval_end({"loss": 1.0})
+    for _ in range(2):  # no improvement x2 -> reduce
+        cb.on_eval_end({"loss": 1.0})
+    assert abs(m._optimizer.get_lr() - 0.05) < 1e-9
+    cb.on_eval_end({"loss": 0.5})   # improvement resets
+    assert abs(m._optimizer.get_lr() - 0.05) < 1e-9
+    import pytest
+
+    with pytest.raises(ValueError):
+        ReduceLROnPlateau(factor=1.5)
+    from paddle_tpu.hapi.callbacks import WandbCallback
+
+    with pytest.raises(ImportError, match="wandb"):
+        WandbCallback()
