@@ -92,14 +92,7 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
             # multi-step program: all timed steps run inside ONE compiled
             # lax.scan, so per-dispatch host/tunnel gaps (measured ~44 ms
             # IDLE per step, PERF.md) are out of the loop entirely
-            ids_st = P.to_tensor(
-                np.broadcast_to(np.asarray(ids._value),
-                                (iters,) + tuple(ids.shape)).copy(), "int32")
-            labels_st = P.to_tensor(
-                np.broadcast_to(np.asarray(labels._value),
-                                (iters,) + tuple(labels.shape)).copy(),
-                "int32")
-            losses = step.run_steps(ids_st, labels_st)  # compile warmup
+            losses = step.run_steps(ids, labels, repeat=iters)  # warmup
             float(np.asarray(losses._value[-1]))
 
             if trace_dir:
@@ -113,7 +106,7 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
                 # The last loss depends on every prior step's param update,
                 # so the fetch waits for the whole scan.
                 t0 = time.perf_counter()
-                losses = step.run_steps(ids_st, labels_st)
+                losses = step.run_steps(ids, labels, repeat=iters)
                 final_loss = float(np.asarray(losses._value[-1]))
                 dt = time.perf_counter() - t0
             finally:
@@ -186,12 +179,13 @@ def _bench_vision_model(build_model, metric, flops_per_image,
             labels = P.to_tensor(rs.randint(0, 1000, (batch,)), "int32")
             loss = step(imgs, labels)
             final = float(np.asarray(loss._value))  # warm + compile
-            loss = step(imgs, labels)
-            final = float(np.asarray(loss._value))  # steady-state check
+            # scanned multi-step program (one dispatch, repeat= avoids
+            # stacking iters copies of the image batch)
+            losses = step.run_steps(imgs, labels, repeat=iters)  # warmup
+            final = float(np.asarray(losses._value[-1]))
             t0 = time.perf_counter()
-            for _ in range(iters):
-                loss = step(imgs, labels)
-            final = float(np.asarray(loss._value))
+            losses = step.run_steps(imgs, labels, repeat=iters)
+            final = float(np.asarray(losses._value[-1]))
             dt = time.perf_counter() - t0
             if not np.isfinite(final):
                 raise RuntimeError(f"non-finite loss {final}")

@@ -210,35 +210,44 @@ class DistributedTrainStep:
         self._step_fn = step
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
-    def _build_multi(self, batch_treedef):
+    def _build_multi(self, batch_treedef, is_repeat):
         """N steps in ONE compiled program: lax.scan over the leading batch
-        axis. Host dispatches once per N steps — on a tunneled/remote chip
-        the per-dispatch gap (~tens of ms) otherwise shows up as device
-        IDLE between steps (PERF.md profile). XLA keeps state resident
-        across scan iterations, so this is also the idiomatic TPU shape
-        for a training loop (host loop minimization)."""
+        axis (or `repeat` times over one batch). Host dispatches once per
+        N steps — on a tunneled/remote chip the per-dispatch gap (~tens of
+        ms) otherwise shows up as device IDLE between steps (PERF.md
+        profile). XLA keeps state resident across scan iterations, so this
+        is also the idiomatic TPU shape for a training loop (host loop
+        minimization)."""
         self._build(batch_treedef, None)  # ensure _step_fn exists
         step = self._step_fn
 
         def multi(params, opt_state, buffers, key, lrs, *batch_leaves):
             def body(carry, sl):
                 params, opt_state, buffers, key = carry
-                lr_i, batch_sl = sl[0], sl[1:]
+                lr_i = sl[0]
+                batch_sl = batch_leaves if is_repeat else sl[1:]
                 loss, p2, o2, b2, k2 = step(params, opt_state, buffers, key,
                                             lr_i, *batch_sl)
                 return (p2, o2, b2, k2), loss
 
+            # scan length comes from lrs' leading dim, so one jit object
+            # serves every step count in this mode (no recompile per N)
+            xs = (lrs,) if is_repeat else (lrs,) + tuple(batch_leaves)
             (p, o, b, k), losses = jax.lax.scan(
-                body, (params, opt_state, buffers, key),
-                (lrs,) + tuple(batch_leaves))
+                body, (params, opt_state, buffers, key), xs)
             return losses, p, o, b, k
 
         return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
 
-    def run_steps(self, *batch, lrs=None):
+    def run_steps(self, *batch, lrs=None, repeat=None):
         """Run one optimizer step per leading-axis slice of `batch` (every
         leaf shaped [n_steps, ...]) inside a single compiled program;
         returns the per-step losses as one [n_steps] Tensor.
+
+        repeat: alternatively, pass ONE batch (no leading step axis) and
+        scan it `repeat` times — same dispatch amortization without
+        materializing n_steps copies of the data (benchmarks, gradient
+        sanity loops).
 
         lrs: optional per-step learning rates, shape [n_steps]. Required
         when the optimizer uses an LRScheduler — the host cannot step the
@@ -246,8 +255,16 @@ class DistributedTrainStep:
         (sequential `__call__` semantics read the scheduler each step)."""
         from ..optimizer.lr import LRScheduler
 
-        placed, treedef = self._place_batch(batch, batch_axis=1)
-        n_steps = int(placed[0].shape[0]) if placed else 0
+        if repeat is not None:
+            repeat = int(repeat)
+            if repeat < 1:
+                raise ValueError(f"repeat must be >= 1, got {repeat}")
+        placed, treedef = self._place_batch(
+            batch, batch_axis=0 if repeat else 1)
+        if repeat:
+            n_steps = repeat
+        else:
+            n_steps = int(placed[0].shape[0]) if placed else 0
         if lrs is None:
             if isinstance(self.optimizer._learning_rate, LRScheduler):
                 raise ValueError(
@@ -262,10 +279,12 @@ class DistributedTrainStep:
             if lrs.shape != (n_steps,):
                 raise ValueError(
                     f"lrs must have shape ({n_steps},), got {lrs.shape}")
+        multi_sig = (treedef, repeat is not None)
         if getattr(self, "_compiled_multi", None) is None or \
-                getattr(self, "_multi_treedef", None) != treedef:
-            self._multi_treedef = treedef
-            self._compiled_multi = self._build_multi(treedef)
+                getattr(self, "_multi_sig", None) != multi_sig:
+            self._multi_sig = multi_sig
+            self._compiled_multi = self._build_multi(
+                treedef, repeat is not None)
         s = self._state
         losses, params, opt, buffers, key = self._compiled_multi(
             s["params"], s["opt"], s["buffers"], s["key"], lrs, *placed)

@@ -343,3 +343,33 @@ def test_run_steps_scheduler_requires_explicit_lrs():
         step.run_steps(ids, lab)
     losses = step.run_steps(ids, lab, lrs=[1e-3, 5e-4])
     assert np.isfinite(np.asarray(losses._value)).all()
+
+
+def test_run_steps_repeat_matches_stacked():
+    """repeat=N over one batch == N stacked copies of that batch."""
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    cfg = gpt_tiny()
+    _init(dp=2, mp=1)
+    rs = np.random.RandomState(7)
+    ids1 = rs.randint(0, cfg.vocab_size, (4, 16))
+    lab1 = rs.randint(0, cfg.vocab_size, (4, 16))
+
+    def build():
+        P.seed(0)
+        m = fleet.distributed_model(GPTForCausalLM(cfg))
+        o = fleet.distributed_optimizer(
+            P.optimizer.AdamW(parameters=m.parameters(), learning_rate=1e-3))
+        return m.build_train_step(o, GPTPretrainingCriterion())
+
+    sa = build()
+    stacked = sa.run_steps(
+        P.to_tensor(np.broadcast_to(ids1, (3, 4, 16)).copy(), "int32"),
+        P.to_tensor(np.broadcast_to(lab1, (3, 4, 16)).copy(), "int32"))
+    sb = build()
+    repeated = sb.run_steps(P.to_tensor(ids1, "int32"),
+                            P.to_tensor(lab1, "int32"), repeat=3)
+    np.testing.assert_allclose(np.asarray(repeated._value),
+                               np.asarray(stacked._value), rtol=2e-4)
