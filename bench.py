@@ -177,10 +177,10 @@ def _bench_vision_model(build_model, metric, flops_per_image,
             imgs = P.to_tensor(
                 rs.randn(batch, 3, img_size, img_size).astype(np.float32))
             labels = P.to_tensor(rs.randint(0, 1000, (batch,)), "int32")
-            loss = step(imgs, labels)
-            final = float(np.asarray(loss._value))  # warm + compile
             # scanned multi-step program (one dispatch, repeat= avoids
-            # stacking iters copies of the image batch)
+            # stacking iters copies of the image batch); no single-step
+            # warmup — only the scanned program is ever timed, so its
+            # compile would be pure waste
             losses = step.run_steps(imgs, labels, repeat=iters)  # warmup
             final = float(np.asarray(losses._value[-1]))
             t0 = time.perf_counter()

@@ -21,7 +21,6 @@ from collections import deque
 import jax
 import jax.numpy as jnp
 
-from . import flags
 
 
 class GradNode:

@@ -10,7 +10,6 @@ covered by deriving per-mesh-axis keys via fold_in.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 
 class Generator:

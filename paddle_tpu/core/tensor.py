@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from . import dtypes as _dtypes
-from . import flags
 
 
 class Tensor:

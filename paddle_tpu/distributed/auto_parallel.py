@@ -16,11 +16,9 @@ from __future__ import annotations
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import flags
-from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
 __all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",

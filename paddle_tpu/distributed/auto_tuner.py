@@ -14,10 +14,8 @@ cheap on TPU.
 """
 from __future__ import annotations
 
-import itertools
-import math
 
-from ..cost_model import (ChipSpec, TransformerShape, V5P, memory_per_chip,
+from ..cost_model import (TransformerShape, V5P, memory_per_chip,
                           train_step_cost)
 
 __all__ = ["AutoTuner", "Candidate", "default_candidates"]

@@ -3,7 +3,7 @@ metadata.py` — global shape/placement records enabling reshard-on-load)."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,7 +18,6 @@ collectives over ICI/DCN. A "group" is a mesh axis. Two operating modes:
 """
 from __future__ import annotations
 
-import numpy as np
 
 import jax
 import jax.numpy as jnp

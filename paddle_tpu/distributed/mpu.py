@@ -19,11 +19,9 @@ of ScatterOp/AllGatherOp PyLayers.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 from ..core import flags
 from ..core.dispatch import apply
-from ..core.tensor import Tensor
 from ..nn import functional as F
 from ..nn.initializer import Normal, XavierUniform
 from ..nn.layer_base import Layer

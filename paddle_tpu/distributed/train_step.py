@@ -230,8 +230,10 @@ class DistributedTrainStep:
                                             lr_i, *batch_sl)
                 return (p2, o2, b2, k2), loss
 
-            # scan length comes from lrs' leading dim, so one jit object
-            # serves every step count in this mode (no recompile per N)
+            # scan length comes from lrs' leading dim: one jit WRAPPER
+            # serves every step count in this mode (a new N still
+            # retraces inside it, since lrs' shape changes — but the
+            # previous N's executable stays cached alongside)
             xs = (lrs,) if is_repeat else (lrs,) + tuple(batch_leaves)
             (p, o, b, k), losses = jax.lax.scan(
                 body, (params, opt_state, buffers, key), xs)

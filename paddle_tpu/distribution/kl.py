@@ -5,7 +5,6 @@ dispatching on distribution types, `kl_divergence` entry).
 """
 from __future__ import annotations
 
-import math
 
 import jax.numpy as jnp
 from jax.scipy import special as jsp

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from jax.scipy import special as jsp
 
 from ..core.dispatch import apply
-from .distribution import Distribution, _asval, _param, _sample_shape
+from .distribution import Distribution, _param
 from .exponential_family import ExponentialFamily
 
 _EULER = 0.5772156649015329

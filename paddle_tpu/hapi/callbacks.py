@@ -4,7 +4,6 @@ from __future__ import annotations
 import numbers
 import time
 
-import numpy as np
 
 
 class Callback:

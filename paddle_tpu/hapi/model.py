@@ -4,7 +4,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.tensor import Tensor
 from ..framework.io_utils import load as fload, save as fsave
 from .callbacks import CallbackList, ProgBarLogger
 

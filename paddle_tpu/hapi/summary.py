@@ -6,7 +6,6 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..nn.layer_base import Layer
 
 
 def _make_input(input_size, dtypes):

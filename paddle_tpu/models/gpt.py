@@ -12,12 +12,10 @@ one XLA program), optional per-block recompute (jax rematerialization).
 """
 from __future__ import annotations
 
-import math
 
 import jax.numpy as jnp
 
 from .. import nn
-from ..core.tensor import Tensor
 from ..distributed import mpu
 from ..distributed.recompute import recompute as _recompute
 from ..nn import functional as F

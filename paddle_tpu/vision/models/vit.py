@@ -9,10 +9,8 @@ TPU ([B, S, H, D] layout, MXU-tiled).
 """
 from __future__ import annotations
 
-import numpy as np
 
 from ... import nn
-from ...core.tensor import Tensor
 from ...nn import functional as F
 
 __all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16",
