@@ -36,15 +36,21 @@ _PROBE_CODE = (
 )
 
 
-def probe_default_backend(timeout: float = 120.0, retries: int = 2):
+def probe_default_backend(timeout: float = 120.0, retries: int = 2,
+                          backoff: float = 0.0):
     """Probe the default jax backend in a subprocess.
 
     Returns ``(platform: str, n_devices: int)`` on success, ``None`` if
     every attempt fails or times out. A subprocess is the only reliable
     watchdog: a PJRT plugin stuck in native code ignores Python-level
-    signals/threads.
-    """
-    for _ in range(max(1, retries)):
+    signals/threads. ``backoff`` seconds of sleep are added between
+    attempts (a flapping remote tunnel often recovers within minutes —
+    retrying with backoff beats falling to a degraded CPU proxy)."""
+    import time as _time
+
+    for attempt in range(max(1, retries)):
+        if attempt and backoff:
+            _time.sleep(backoff)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_CODE],
