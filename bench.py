@@ -241,8 +241,9 @@ def main() -> None:
     )
 
     note = ""
-    # a down tunnel often comes back within minutes: retry for ~6 min
-    # before surrendering the round's datapoint to the CPU proxy
+    # a down tunnel often comes back within minutes: retry for up to
+    # ~7.5 min worst case (5 x 75 s timeouts + 4 x 20 s sleeps) before
+    # surrendering the round's datapoint to the CPU proxy
     probe = probe_default_backend(timeout=75.0, retries=5, backoff=20.0)
     if probe is not None and probe[0] in _ACCEL_PLATFORMS:
         try:

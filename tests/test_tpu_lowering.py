@@ -119,6 +119,32 @@ def test_decode_attention_lowers(b, h, s, d):
     _assert_mosaic(mlir)
 
 
+@pytest.mark.parametrize("hq,hkv", [(32, 8), (12, 12), (16, 2)])
+def test_decode_attention_gqa_lowers(hq, hkv):
+    """Grouped-query decode: q block [G, D] per KV head + [2,B] scalar
+    prefetch (pos+start) must lower through Mosaic."""
+    b, s, d = 4, 1024, 64
+    q = jnp.zeros((b, hq, d), jnp.bfloat16)
+    cache = jnp.zeros((b, hkv, s, d), jnp.bfloat16)
+    pos = jnp.zeros((b,), jnp.int32)
+    start = jnp.zeros((b,), jnp.int32)
+    f = functools.partial(da_fn, block_k=256)
+    mlir = _lower_for_tpu(lambda q, kc, vc, p, st: f(q, kc, vc, p, start=st),
+                          q, cache, cache, pos, start)
+    _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("sq,sk", [(128, 1024), (1024, 128)])
+def test_flash_cross_length_causal_lowers(sq, sk):
+    """Bottom-right-aligned causal with seq_q != seq_k (decode/chunked
+    shapes): traced offset loop bounds must lower."""
+    q = jnp.zeros((2, sq, 8, 64), jnp.bfloat16)
+    k = jnp.zeros((2, sk, 8, 64), jnp.bfloat16)
+    mlir = _lower_for_tpu(
+        lambda q, k, v: fa._flash_core(q, k, v, True, 128, 128), q, k, k)
+    _assert_mosaic(mlir)
+
+
 def test_gate_catches_bad_blockspec():
     """Meta-test: the gate actually fails on a Mosaic-illegal kernel (the
     round-2 bug shape — rank-1 stats output block)."""
