@@ -316,7 +316,10 @@ class GenerationMixin:
         stops once every row has finished. attention_mask: optional
         [B, S0] 0/1 mask for LEFT-padded ragged prompts — pad positions
         never contribute to attention and rotary/learned positions start
-        at each row's first real token."""
+        at each row's first real token. num_beams > 1 switches to beam
+        search (greedy scoring only; finished hypotheses live in a pool
+        and the best length_penalty-normalized sequence wins; incompatible
+        with do_sample and attention_mask)."""
         ids = input_ids._value if isinstance(input_ids, Tensor) \
             else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
