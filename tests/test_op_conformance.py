@@ -244,3 +244,54 @@ def test_empty_tensor_reductions_and_concat():
     c = P.concat([e, P.ones([2, 4])], axis=0)
     assert c.shape == [2, 4]
     assert P.abs(e).shape == [0, 4]
+
+
+# ---------------- seeded random-shape fuzz (robustness layer) ------------
+
+_FUZZ_SHAPES = [
+    (1,), (7,), (2, 3), (5, 1), (1, 1, 4), (3, 2, 5), (2, 1, 3, 2), (8, 8),
+]
+
+
+@pytest.mark.parametrize("trial", range(2))
+def test_unary_fuzz_random_shapes(trial):
+    """Shape fuzz: every manifest unary op at irregular shapes (odd
+    sizes, leading 1s, 4-D) — eager values vs the numpy reference.
+    Catches shape assumptions the fixed (3, 4) sweep can't. Inputs are
+    reseeded per test so failures reproduce standalone."""
+    rs.seed(1000 + trial)
+    for i, name in enumerate(_from_manifest("unary")):
+        make, ref, _ = UNARY_OPS[name]
+        fn = getattr(P, name)
+        # every op walks the whole shape list across (op index, trial)
+        shape = _FUZZ_SHAPES[(i + trial * 3) % len(_FUZZ_SHAPES)]
+        x = make(shape)
+        out = fn(P.to_tensor(x))
+        assert tuple(out.shape) == x.shape, (name, shape, out.shape)
+        if ref is not None:
+            np.testing.assert_allclose(out.numpy(), ref(x), rtol=3e-5,
+                                       atol=3e-5, err_msg=f"{name}@{shape}")
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_binary_fuzz_broadcast_shapes(trial):
+    """Broadcast fuzz: elementwise binary ops under broadcasting pairs
+    (the fixed sweep uses equal shapes only). Reseeded per test so
+    failures reproduce standalone."""
+    rs.seed(2000 + trial)
+    pairs = [((2, 3), (3,)), ((4, 1), (1, 5)), ((1,), (3, 2)),
+             ((2, 1, 3), (1, 4, 1))]
+    a_shape, b_shape = pairs[trial]
+    for name in ("add", "subtract", "multiply", "maximum", "minimum",
+                 "atan2", "fmax", "fmin", "hypot", "logaddexp", "divide"):
+        ref, _ = BINARY_OPS[name]
+        fn = getattr(P, name)
+        x = _std(a_shape)
+        y = _pos(b_shape) if name == "divide" else _std(b_shape)
+        out = fn(P.to_tensor(x), P.to_tensor(y))
+        expect_shape = np.broadcast_shapes(a_shape, b_shape)
+        assert tuple(out.shape) == expect_shape, (name, out.shape)
+        if ref is not None:
+            np.testing.assert_allclose(out.numpy(), ref(x, y), rtol=3e-5,
+                                       atol=3e-5,
+                                       err_msg=f"{name}@{a_shape}x{b_shape}")
