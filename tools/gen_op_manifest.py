@@ -138,6 +138,7 @@ def _resolve(name):
         ("paddle_tpu.geometric", P.geometric),
         ("paddle_tpu.incubate.nn.functional", P.incubate.nn.functional),
         ("paddle_tpu.vision.ops", P.vision.ops),
+        ("paddle_tpu.nn.quant", P.nn.quant),
     ]
     for mod_name, mod in namespaces:
         obj = getattr(mod, name, None)
