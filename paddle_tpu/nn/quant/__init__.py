@@ -22,8 +22,8 @@ import numpy as np
 from ...core.dispatch import apply, op
 from ..layer_base import Layer
 
-__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear", "WeightOnlyLinear"]
+__all__ = ["Stub", "weight_quantize", "weight_dequantize",
+           "weight_only_linear", "llm_int8_linear", "WeightOnlyLinear"]
 
 _ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
 
@@ -213,3 +213,26 @@ class WeightOnlyLinear(Layer):
         return weight_only_linear(
             x, self.quant_weight, self.bias, self.weight_scale,
             weight_dtype=self.weight_dtype, group_size=self.group_size)
+
+
+class Stub(Layer):
+    """Quantization insertion point for functional calls (reference
+    `paddle/nn/quant/stub.py`): a layer's forward can't attach a quant
+    config to a bare functional API, so a Stub sublayer is called on the
+    functional's inputs; QAT/PTQ swap the stub for the configured quanter
+    or observer. Until swapped (or with no quanter) it is identity."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        # instantiate factories NOW: a lazy instantiation in forward would
+        # rebuild the quanter every call (Layer.__setattr__ stores sublayers
+        # in _sub_layers while the factory would keep shadowing from
+        # __dict__), resetting EMA scale/calibration state each step
+        if observer is not None and hasattr(observer, "instance"):
+            observer = observer.instance()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is None:
+            return x
+        return self._observer(x)

@@ -288,10 +288,29 @@ class QuantedConv2D(Layer):
 def _make_quanted(config, layer, force_observer=False):
     """Build the quantized twin for a swappable layer, or None. Shared by
     the QAT and PTQ drivers (PTQ coerces activation quanters to
-    observers)."""
+    observers). Bare `nn.quant.Stub`s swap for the configured activation
+    quanter/observer (reference stub.py contract)."""
     from ..nn.layers_common import Linear
     from ..nn.layers_conv_pool import Conv2D
+    from ..nn.quant import Stub
 
+    if isinstance(layer, Stub):
+        if layer._observer is not None:
+            # self-configured stub: QAT keeps its quanter; PTQ coerces it
+            # to an observer like every other activation quanter (an
+            # uncalibrated quanter in eval calibration would silently
+            # no-op forever after convert)
+            if force_observer and not isinstance(layer._observer,
+                                                 BaseObserver):
+                return Stub(AbsmaxObserver())
+            return None
+        act_f, _ = config._config_for(layer)
+        if act_f is None:
+            return None
+        act = act_f.instance()
+        if force_observer and not isinstance(act, BaseObserver):
+            act = AbsmaxObserver()
+        return Stub(act)
     if not isinstance(layer, (Conv2D, Linear)):
         return None
     act_f, w_f = config._config_for(layer)
