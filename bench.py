@@ -228,6 +228,57 @@ def _emit(result: dict) -> None:
     sys.stdout.flush()
 
 
+_GOOD_BENCH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "last_good_bench.jsonl")
+_HEADLINE = "gpt125m_train_tokens_per_sec_per_chip"
+_MAX_REUSE_AGE_S = 12 * 3600  # ~one round; older records are not "this
+# session" and must not masquerade as a current measurement
+
+
+def _emit_from_chip_session(reason: str) -> bool:
+    """Probe-failure fallback (VERDICT r3 Next #1): reuse the freshest
+    non-degraded on-chip result captured by tools/chip_session.py at ANY
+    point in this session, instead of surrendering the datapoint to a CPU
+    proxy just because the tunnel is down at capture time. Emits secondary
+    metrics first and the headline last (driver reads the last line).
+    Returns True when a headline result was emitted."""
+    try:
+        best: dict[str, dict] = {}
+        with open(_GOOD_BENCH) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                m = obj.get("metric")
+                if not m or obj.get("degraded") or obj.get("value", 0) <= 0:
+                    continue
+                if time.time() - obj.get("captured_at", 0) > _MAX_REUSE_AGE_S:
+                    continue
+                if m not in best or obj.get("captured_at", 0) >= \
+                        best[m].get("captured_at", 0):
+                    best[m] = obj
+    except OSError:
+        return False
+    head = best.pop(_HEADLINE, None)
+    if head is None:
+        return False
+    for obj in best.values():
+        age_min = (time.time() - obj.pop("captured_at")) / 60.0
+        obj["source"] = "chip_session"
+        obj["note"] = f"measured on-chip {age_min:.0f} min earlier"
+        _emit(obj)
+    age_min = (time.time() - head.pop("captured_at")) / 60.0
+    head["source"] = "chip_session"
+    head["note"] = (f"{reason}; reusing on-chip result measured "
+                    f"{age_min:.0f} min earlier this session")
+    _emit(head)
+    return True
+
+
 def main() -> None:
     if "--force-cpu" in sys.argv[1:]:
         from paddle_tpu.backend_guard import force_cpu_mesh
@@ -255,6 +306,8 @@ def main() -> None:
             except Exception as e2:
                 print(f"secondary-benches-failed: {e2}", file=sys.stderr)
             _emit(result)
+            # (persistence of good lines is chip_session's job — a single
+            # writer keeps the record's filter logic in one place)
             return
         except Exception as e:  # TPU ran but the bench crashed mid-run
             note = f"tpu-run-failed: {type(e).__name__}: {e}"
@@ -283,6 +336,9 @@ def main() -> None:
                         break
             except Exception as e2:
                 print(f"pallas-disabled-retry-failed: {e2}", file=sys.stderr)
+            # a previously captured on-chip result beats any CPU proxy
+            if _emit_from_chip_session(note):
+                return
             # CPU fallback needs a fresh process: this one holds a live
             # TPU backend and possibly poisoned device state.
             try:
@@ -308,7 +364,11 @@ def main() -> None:
             return
     else:
         note = "tpu-probe-failed" if probe is None else f"platform={probe[0]}"
-        print(f"backend probe: {note}; falling back to CPU proxy",
+        print(f"backend probe: {note}", file=sys.stderr)
+        # a previously captured on-chip result beats any CPU proxy
+        if _emit_from_chip_session(note):
+            return
+        print("no chip_session result available; falling back to CPU proxy",
               file=sys.stderr)
 
     # Probe failed or reported a non-accelerator platform: no backend has

@@ -301,11 +301,17 @@ class PipelineParallel:
     """1F1B runner (PipelineParallel.forward_backward_pipeline parity)."""
 
     def __init__(self, pipeline_layer, optimizer, topo=None,
-                 num_micro_batches=None, schedule="1F1B"):
+                 num_micro_batches=None, schedule="1F1B",
+                 sharding_stage=0):
         self.topo = topo or topo_mod.get_topology()
         self.pp = self.topo.pp_degree
         self.optimizer = optimizer
         self.schedule = schedule
+        # ZeRO-over-dp composed with PP: optimizer slots (stage>=1) are
+        # sharded over each stage submesh's dp axis — the PP analog of
+        # DygraphShardingOptimizer under PipelineParallel (reference
+        # hybrid_parallel_optimizer.py composing with pipeline_parallel.py)
+        self.sharding_stage = int(sharding_stage)
         self.num_micro_batches = num_micro_batches or self.pp
         assert isinstance(pipeline_layer, PipelineLayer)
         self.pipe = pipeline_layer
@@ -330,13 +336,46 @@ class PipelineParallel:
 
     # --- optimizer state per stage ------------------------------------------
     def _ensure_opt(self):
-        if self._opt_states is None:
-            self._opt_states = [
-                self.optimizer.init_state(st.params) for st in self.stages]
-            self._opt_update = [
-                jax.jit(lambda p, g, s, lr, _o=self.optimizer:
-                        _o.apply_gradients(p, g, s, lr))
-                for _ in self.stages]
+        if self._opt_states is not None:
+            return
+        from .train_step import _zero_shard_spec
+
+        self._opt_states = []
+        self._opt_update = []
+        for st in self.stages:
+            state = self.optimizer.init_state(st.params)
+            slot_shardings = None
+            if self.sharding_stage >= 1:
+                dp = st.mesh.shape.get("dp", 1)
+                slot_shardings = {}
+                for n, sd in state["slots"].items():
+                    base = tuple(st.param_specs[n])
+                    specs = {}
+                    for k, v in sd.items():
+                        spec = (_zero_shard_spec(base, np.shape(v), dp, None)
+                                if np.ndim(v) else ())
+                        specs[k] = NamedSharding(st.mesh, P(*spec))
+                    slot_shardings[n] = specs
+                state["slots"] = {
+                    n: {k: jax.device_put(v, slot_shardings[n][k])
+                        for k, v in sd.items()}
+                    for n, sd in state["slots"].items()}
+            self._opt_states.append(state)
+
+            def upd(p, g, s, lr, _o=self.optimizer, _sh=slot_shardings,
+                    _ps={n: NamedSharding(st.mesh, sp)
+                         for n, sp in st.param_specs.items()}):
+                new_p, new_s = _o.apply_gradients(p, g, s, lr)
+                if _sh is not None:  # pin ZeRO partitioning across steps
+                    new_p = {n: jax.lax.with_sharding_constraint(v, _ps[n])
+                             for n, v in new_p.items()}
+                    new_s = dict(new_s, slots={
+                        n: {k: jax.lax.with_sharding_constraint(v, _sh[n][k])
+                            for k, v in sd.items()}
+                        for n, sd in new_s["slots"].items()})
+                return new_p, new_s
+
+            self._opt_update.append(jax.jit(upd))
 
     def _schedule_1f1b(self, m):
         """Yield (stage, 'F'|'B', mb) in a dependency-valid 1F1B enqueue

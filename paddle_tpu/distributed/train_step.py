@@ -251,10 +251,12 @@ class DistributedTrainStep:
         materializing n_steps copies of the data (benchmarks, gradient
         sanity loops).
 
-        lrs: optional per-step learning rates, shape [n_steps]. Required
-        when the optimizer uses an LRScheduler — the host cannot step the
-        scheduler mid-scan, so the schedule must be supplied up front
-        (sequential `__call__` semantics read the scheduler each step)."""
+        lrs: optional per-step learning rates, shape [n_steps]. With an
+        LRScheduler-driven optimizer and lrs=None, the schedule's next
+        n_steps values are read (and the scheduler advanced n_steps) here
+        — matching the sequential `__call__`+`scheduler.step()` loop. An
+        explicit lrs leaves the scheduler untouched: the caller owns the
+        schedule position in that mode."""
         from ..optimizer.lr import LRScheduler
 
         if repeat is not None:
@@ -268,12 +270,19 @@ class DistributedTrainStep:
         else:
             n_steps = int(placed[0].shape[0]) if placed else 0
         if lrs is None:
-            if isinstance(self.optimizer._learning_rate, LRScheduler):
-                raise ValueError(
-                    "run_steps with an LRScheduler needs explicit per-step "
-                    "rates: pass lrs=[...] (the scheduler cannot be stepped "
-                    "from inside the compiled scan)")
-            lrs = jnp.full((n_steps,), self.optimizer.get_lr(), jnp.float32)
+            sched = self.optimizer._learning_rate
+            if isinstance(sched, LRScheduler):
+                # consume the next n_steps of the schedule host-side (the
+                # scan cannot step the scheduler), leaving it positioned
+                # exactly as n_steps sequential __call__+step()s would
+                vals = []
+                for _ in range(n_steps):
+                    vals.append(float(self.optimizer.get_lr()))
+                    sched.step()
+                lrs = jnp.asarray(vals, jnp.float32)
+            else:
+                lrs = jnp.full((n_steps,), self.optimizer.get_lr(),
+                               jnp.float32)
         else:
             lrs = jnp.asarray(
                 lrs._value if isinstance(lrs, Tensor) else lrs,

@@ -124,7 +124,11 @@ def _sample(logits, key, do_sample, temperature, top_k):
     if temperature != 1.0:
         logits = logits / max(float(temperature), 1e-6)
     if top_k:
-        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        # clamp: top_k >= vocab would index past the sorted axis (jnp wraps
+        # negative OOB to 0, silently disabling the filter) — k == vocab
+        # keeps every logit, which is the correct no-op
+        k = min(int(top_k), logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 

@@ -229,7 +229,16 @@ int PD_PredictorRun(int handle, const PD_TensorData *inputs, int n_in,
     std::memset(&o, 0, sizeof(o));
     o.dtype = static_cast<int32_t>(PyLong_AsLong(code));
     o.ndim = static_cast<int32_t>(PyTuple_Size(shape));
-    for (int d = 0; d < o.ndim && d < PD_MAX_NDIM; ++d)
+    if (o.ndim > PD_MAX_NDIM) {
+      // fail like the input-side check: a truncated shape array with a
+      // larger ndim would let the caller read past the fixed array
+      for (int j = 0; j < i; ++j) std::free(outputs[j].data);
+      Py_DECREF(r);
+      set_error("output " + std::to_string(i) + " rank " +
+                std::to_string(o.ndim) + " exceeds PD_MAX_NDIM");
+      return -1;
+    }
+    for (int d = 0; d < o.ndim; ++d)
       o.shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
     char *src = nullptr;
     Py_ssize_t nbytes = 0;

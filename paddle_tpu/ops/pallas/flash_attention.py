@@ -197,16 +197,14 @@ def _pick_block(seq, pref):
     return max(b, 8)
 
 
-def _fwd(q, k, v, causal, block_q, block_k):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+def _fwd_t(qt, kt, vt, causal, block_q, block_k):
+    """Forward on head-major [B,H,S,D] operands (the kernels' native
+    layout). Returns (out_t [B,H,Sq,D], lse [B,H,Sq,1])."""
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
     scale = 1.0 / math.sqrt(d)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
-    # [B,S,H,D] -> [B,H,S,D]
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
     grid = (b, h, pl.cdiv(sq, block_q))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
@@ -225,12 +223,18 @@ def _fwd(q, k, v, causal, block_q, block_k):
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
             jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(qt, kt, vt)
+    return out, lse
+
+
+def _fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _fwd_t(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                      jnp.swapaxes(v, 1, 2), causal, block_q, block_k)
     return jnp.swapaxes(out, 1, 2), lse
 
 
@@ -357,23 +361,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
+def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k):
+    """Backward on head-major [B,H,S,D] operands; returns dq/dk/dv in the
+    same head-major layout. The custom VJP saves residuals head-major
+    (the forward already computed them), so backward only transposes the
+    incoming cotangent and the outgoing grads — half the transpose HBM
+    traffic of re-deriving all five operands from [B,S,H,D]
+    (PERF.md: ~25 ms/step of transposes at the bench shape)."""
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
     scale = 1.0 / math.sqrt(d)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
-    qt = jnp.swapaxes(q, 1, 2).reshape(b, h, sq, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b, h, sk, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b, h, sk, d)
-    ot = jnp.swapaxes(out, 1, 2).reshape(b, h, sq, d)
-    dot = jnp.swapaxes(do, 1, 2).reshape(b, h, sq, d)
 
-    q_spec = pl.BlockSpec((None, None, block_q, d), lambda bi, hi, i: (bi, hi, i, 0))
-    full_q = pl.BlockSpec((None, None, sq, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    full_lse = pl.BlockSpec((None, None, sq, 1), lambda bi, hi, i: (bi, hi, 0, 0))
-    k_spec_full = pl.BlockSpec((None, None, sk, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    lse_spec = pl.BlockSpec((None, None, block_q, 1), lambda bi, hi, i: (bi, hi, i, 0))
+    q_spec = pl.BlockSpec((None, None, block_q, d),
+                          lambda bi, hi, i: (bi, hi, i, 0))
+    full_q = pl.BlockSpec((None, None, sq, d),
+                          lambda bi, hi, i: (bi, hi, 0, 0))
+    full_lse = pl.BlockSpec((None, None, sq, 1),
+                            lambda bi, hi, i: (bi, hi, 0, 0))
+    k_spec_full = pl.BlockSpec((None, None, sk, d),
+                               lambda bi, hi, i: (bi, hi, 0, 0))
+    lse_spec = pl.BlockSpec((None, None, block_q, 1),
+                            lambda bi, hi, i: (bi, hi, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
@@ -381,24 +391,33 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
         grid=(b, h, pl.cdiv(sq, block_q)),
         in_specs=[q_spec, k_spec_full, k_spec_full, q_spec, lse_spec, q_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(qt, kt, vt, ot, lse, dot)
 
-    kv_spec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
+    kv_spec = pl.BlockSpec((None, None, block_k, d),
+                           lambda bi, hi, j: (bi, hi, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
                           causal=causal, seq_q=sq, seq_k=sk),
         grid=(b, h, pl.cdiv(sk, block_k)),
         in_specs=[full_q, kv_spec, kv_spec, full_q, full_lse, full_q],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, h, sk, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), kt.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), vt.dtype)],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(qt, kt, vt, ot, lse, dot)
 
+    return dq, dk, dv
+
+
+def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
+    dq, dk, dv = _bwd_t(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                        jnp.swapaxes(v, 1, 2), jnp.swapaxes(out, 1, 2),
+                        lse, jnp.swapaxes(do, 1, 2), causal,
+                        block_q, block_k)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))
 
@@ -412,14 +431,23 @@ def _flash_core(q, k, v, causal, block_q, block_k):
 
 
 def _flash_core_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+    # residuals saved HEAD-MAJOR: forward already computed the [B,H,S,D]
+    # transposes, so backward reuses them instead of re-transposing all
+    # five operands from [B,S,H,D] — only the cotangent (in) and the three
+    # grads (out) cross layouts in the backward pass
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t, lse = _fwd_t(qt, kt, vt, causal, block_q, block_k)
+    return jnp.swapaxes(out_t, 1, 2), (qt, kt, vt, out_t, lse)
 
 
 def _flash_core_bwd(causal, block_q, block_k, res, g):
-    q, k, v, out, lse = res
-    dq, dk, dv = _bwd(q, k, v, out, lse, g, causal, block_q, block_k)
-    return dq, dk, dv
+    qt, kt, vt, ot, lse = res
+    dq, dk, dv = _bwd_t(qt, kt, vt, ot, lse, jnp.swapaxes(g, 1, 2),
+                        causal, block_q, block_k)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)

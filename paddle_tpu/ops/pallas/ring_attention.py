@@ -8,11 +8,14 @@ local Q/K/V sequence shard; K/V blocks rotate around the ring via
 `lax.ppermute` while blockwise-softmax partial results fold in each visiting
 block. The per-block attention is the Pallas flash kernel (flash_attention
 ._fwd/._bwd), so logits live in VMEM — local memory stays O(s_local·d), not
-O(s_local²), which is what makes >HBM sequence lengths reachable. K/V (and
-in backward dK/dV, which travel the ring with their blocks) rotate in the
-input dtype (bf16 on TPU), halving ICI bytes vs an f32 ring. Communication
-overlaps compute: each ppermute is issued with the block math of the
-previous step still in flight (XLA schedules the async collective-permute).
+O(s_local²), which is what makes >HBM sequence lengths reachable. Forward
+K/V rotate in the input dtype (bf16 on TPU), halving ICI bytes vs an f32
+ring. Backward deliberately rotates the dK/dV running sums in f32 (2x the
+forward ring's bytes): each hop would otherwise round the accumulator to
+bf16, compounding error with ring size — the K/V blocks traveling alongside
+still ride in bf16. Communication overlaps compute: each ppermute is issued
+with the block math of the previous step still in flight (XLA schedules the
+async collective-permute).
 
 Differentiation is a custom VJP: forward saves (out, lse); backward runs a
 second ring pass where each step computes the flash dQ/dK/dV for the block

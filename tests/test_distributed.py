@@ -319,11 +319,11 @@ def test_run_steps_matches_sequential_calls():
     np.testing.assert_allclose(np.asarray(losses._value), seq, rtol=2e-4)
 
 
-def test_run_steps_scheduler_requires_explicit_lrs():
-    """run_steps must refuse a scheduler without per-step lrs, and honor an
-    explicit lrs vector (r3 review finding: single baked lr)."""
-    import pytest
-
+def test_run_steps_scheduler_semantics():
+    """Scheduler mode (lrs=None) consumes the next n_steps of the schedule
+    and advances the scheduler, matching sequential __call__+step();
+    explicit lrs leaves the scheduler position untouched (caller-owned)
+    (r3 review + r3 ADVICE: stale schedule position after run_steps)."""
     from paddle_tpu.models.gpt import (
         GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
     )
@@ -339,10 +339,16 @@ def test_run_steps_scheduler_requires_explicit_lrs():
     step = m.build_train_step(o, GPTPretrainingCriterion())
     ids = P.to_tensor(np.zeros((2, 2, 16), np.int64), "int32")
     lab = P.to_tensor(np.zeros((2, 2, 16), np.int64), "int32")
-    with pytest.raises(ValueError, match="LRScheduler"):
-        step.run_steps(ids, lab)
+    lr0 = float(o.get_lr())
+    losses = step.run_steps(ids, lab)  # 2 steps off the schedule
+    assert np.isfinite(np.asarray(losses._value)).all()
+    # StepDecay gamma=0.5 per step: after 2 consumed steps lr = lr0/4
+    np.testing.assert_allclose(float(o.get_lr()), lr0 * 0.25, rtol=1e-6)
+    # explicit lrs: scheduler untouched
+    before = float(o.get_lr())
     losses = step.run_steps(ids, lab, lrs=[1e-3, 5e-4])
     assert np.isfinite(np.asarray(losses._value)).all()
+    assert float(o.get_lr()) == before
 
 
 def test_run_steps_repeat_matches_stacked():

@@ -75,7 +75,7 @@ def test_multiprocess_dataloader():
 def test_tcp_store():
     from paddle_tpu.distributed.store import TCPStore
 
-    port = 18571
+    port = 18571 + os.getpid() % 4096  # parallel-safe: unique per worker
     master = TCPStore(is_master=True, port=port, world_size=2)
     client = TCPStore(is_master=False, port=port, world_size=2)
 

@@ -173,3 +173,32 @@ def test_pp_state_dict_roundtrip():
     runner.train_batch((ids, labels))
     sd = runner.state_dict()
     assert len(sd) == len(pipe.state_dict())
+
+
+def test_pp_zero_sharding_composition():
+    """PP composed with ZeRO slot sharding (sharding_stage=2): optimizer
+    slots live dp-sharded on each stage submesh, training still converges,
+    and the post-step states keep the dp partitioning (VERDICT r3 Next #3)."""
+    _init(pp=2, dp=2, mp=2)
+    P.seed(0)
+    cfg = gpt_tiny(tie_embeddings=False, dropout=0.0, num_layers=2)
+    pipe = PipelineLayer(gpt_pipe_layers(cfg),
+                         loss_fn=GPTPretrainingCriterion())
+    opt = P.optimizer.AdamW(parameters=pipe.parameters(), learning_rate=1e-3)
+    runner = PipelineParallel(pipe, opt, num_micro_batches=2,
+                              sharding_stage=2)
+    ids = P.randint(0, cfg.vocab_size, [4, 16])
+    labels = P.randint(0, cfg.vocab_size, [4, 16])
+    losses = [float(runner.train_batch((ids, labels))) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+    # slots must actually be dp-sharded AFTER an update (the constraint
+    # pins the partitioning across steps, not just at init)
+    dp_sharded = 0
+    for state in runner._opt_states:
+        for sd in state["slots"].values():
+            for v in sd.values():
+                spec = getattr(getattr(v, "sharding", None), "spec", ())
+                if "dp" in tuple(spec):
+                    dp_sharded += 1
+    assert dp_sharded > 0, "no optimizer slot carries a dp sharding"
