@@ -88,44 +88,10 @@ class ViterbiDecoder(Layer):
                               self.include_bos_eos_tag)
 
 
-class datasets:
-    """Dataset stubs: the reference downloads corpora (Imdb, Conll05st,
-    …); no network egress here, so constructors raise with guidance."""
-
-    class _NeedsDownload:
-        def __init__(self, *a, **kw):
-            raise RuntimeError(
-                f"{type(self).__name__} requires dataset download; provide "
-                "local files via paddle_tpu.io.Dataset instead")
-
-    class Imdb(_NeedsDownload):
-        pass
-
-    class Imikolov(_NeedsDownload):
-        pass
-
-    class Movielens(_NeedsDownload):
-        pass
-
-    class Conll05st(_NeedsDownload):
-        pass
-
-    class UCIHousing(_NeedsDownload):
-        pass
-
-    class WMT14(_NeedsDownload):
-        pass
-
-    class WMT16(_NeedsDownload):
-        pass
-
-
 # top-level re-exports (reference paddle.text exposes the dataset
-# classes directly)
-Conll05st = datasets.Conll05st
-Imdb = datasets.Imdb
-Imikolov = datasets.Imikolov
-Movielens = datasets.Movielens
-UCIHousing = datasets.UCIHousing
-WMT14 = datasets.WMT14
-WMT16 = datasets.WMT16
+# classes directly); the loaders live in .datasets (local-archive
+# pattern, see that module's docstring)
+from . import datasets
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

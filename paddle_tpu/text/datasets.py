@@ -1,0 +1,573 @@
+"""paddle.text.datasets parity (`python/paddle/text/datasets/`): the seven
+corpus loaders, reading LOCAL copies of the official archives.
+
+Zero-egress build: the reference downloads each corpus on first use
+(`_check_exists_and_download`); this environment has no network, so every
+class requires its archive path(s) and raises loudly on ``download=True``
+with nothing local — the same contract as `paddle_tpu.audio.datasets`.
+Parsing, example shapes, and auxiliary APIs (`get_dict`, `get_embedding`,
+`get_word_dict`) mirror the reference loaders:
+
+- Imdb       — reference `text/datasets/imdb.py:31` (aclImdb tar)
+- Imikolov   — `imikolov.py` (PTB simple-examples tar, NGRAM/SEQ)
+- Movielens  — `movielens.py` (ml-1m zip, user+movie features)
+- Conll05st  — `conll05.py` (SRL props bracket labels -> BIO)
+- UCIHousing — `uci_housing.py` (whitespace floats, normalized)
+- WMT14      — `wmt14.py` (src/trg dicts inside the tar)
+- WMT16      — `wmt16.py` (dict built from the training split)
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "Conll05st", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+def _need_file(data_file, download, name, what="archive"):
+    if data_file:
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(f"{name}: {what} not found: {data_file}")
+        return data_file
+    raise RuntimeError(
+        f"{name} requires a local {what} (no network egress in this build"
+        f"{'; download=True unsupported' if download else ''}): obtain the "
+        f"official archive and pass data_file=")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (aclImdb_v1.tar.gz). Examples: (doc_ids [T] int64,
+    label [1]) with label 0=pos 1=neg; vocabulary built from the whole
+    corpus with frequency > cutoff (reference imdb.py:31)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, download, "Imdb")
+        self.word_idx = self._build_word_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        docs = []
+        strip = string.punctuation.encode("latin-1")
+        with tarfile.open(self.data_file) as tf:
+            member = tf.next()
+            while member is not None:
+                if pattern.match(member.name):
+                    raw = tf.extractfile(member).read()
+                    docs.append(
+                        raw.rstrip(b"\n\r").translate(None, strip)
+                        .lower().split())
+                member = tf.next()
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        freq = collections.defaultdict(int)
+        for doc in self._tokenize(pattern):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(
+                rf"aclImdb/{self.mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus (simple-examples tar). NGRAM mode yields
+    window_size-grams; SEQ mode yields (src, trg) shifted sequences
+    (reference imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        assert mode.lower() in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = _need_file(data_file, download, "Imikolov")
+        self.word_idx = self._build_word_dict()
+        self._load_anno()
+
+    def _member(self, tf, suffix):
+        for name in tf.getnames():
+            if name.endswith(suffix):
+                return tf.extractfile(name)
+        raise RuntimeError(f"Imikolov: no member *{suffix} in archive")
+
+    def _count(self, f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w] += 1
+            freq[b"<s>"] += 1
+            freq[b"<e>"] += 1
+        return freq
+
+    def _build_word_dict(self):
+        with tarfile.open(self.data_file) as tf:
+            freq = self._count(
+                self._member(tf, "data/ptb.valid.txt"),
+                self._count(self._member(tf, "data/ptb.train.txt"),
+                            collections.defaultdict(int)))
+        freq.pop(b"<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items()
+                       if c > self.min_word_freq),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w.decode(): i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load_anno(self):
+        name = {"train": "data/ptb.train.txt",
+                "test": "data/ptb.valid.txt"}[self.mode]
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            for line in self._member(tf, name):
+                words = [w.decode() for w in line.strip().split()]
+                if self.data_type == "NGRAM":
+                    assert self.window_size > 0, "Invalid gram length"
+                    toks = ["<s>"] + words + ["<e>"]
+                    if len(toks) < self.window_size:
+                        continue
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(
+                            tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    src = [self.word_idx["<s>"]] + ids
+                    trg = ids + [self.word_idx["<e>"]]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_AGE_TABLE = (1, 18, 25, 35, 45, 50, 56)
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """MovieLens ml-1m ratings (zip). Each example: user features + movie
+    features + [rating*2-5] (reference movielens.py)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, download, "Movielens")
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        rs = np.random.RandomState(rand_seed)
+        self._load_meta_info()
+        self._load_data(rs)
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        self.movie_title_dict, self.categories_dict = {}, {}
+        title_words, category_set = set(), set()
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin1").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    category_set.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    for w in title.split():
+                        title_words.add(w.lower())
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode("latin1") \
+                        .strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+        # deterministic ids (the reference iterates a set — order varies
+        # per process; sorting keeps examples reproducible)
+        self.movie_title_dict = {w: i for i, w in
+                                 enumerate(sorted(title_words))}
+        self.categories_dict = {c: i for i, c in
+                                enumerate(sorted(category_set))}
+
+    def _load_data(self, rs):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    take = (rs.random_sample() < self.test_ratio) == is_test
+                    if not take:
+                        continue
+                    uid, mid, rating, _ = line.decode("latin1").strip() \
+                        .split("::")
+                    rating = float(rating) * 2 - 5.0
+                    self.data.append(
+                        self.user_info[int(uid)].value()
+                        + self.movie_info[int(mid)].value(
+                            self.categories_dict, self.movie_title_dict)
+                        + [[rating]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_CONLL_UNK_IDX = 0
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split. Examples are the reference's 9-tuple:
+    (word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+    label_idx), each [T] (reference conll05.py: bracketed props ->
+    B-/I-/O tags, predicate context windows)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self.data_file = _need_file(data_file, download, "Conll05st")
+        self.word_dict_file = _need_file(word_dict_file, download,
+                                         "Conll05st", "word dict file")
+        self.verb_dict_file = _need_file(verb_dict_file, download,
+                                         "Conll05st", "verb dict file")
+        self.target_dict_file = _need_file(target_dict_file, download,
+                                           "Conll05st", "target dict file")
+        self.emb_file = emb_file  # optional; only handed back
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d, idx = {}, 0
+        for tag in sorted(tags):
+            d["B-" + tag] = idx
+            d["I-" + tag] = idx + 1
+            idx += 2
+        d["O"] = idx
+        return d
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sentence, columns = [], []
+                for word, prop in zip(words, props):
+                    word = word.strip().decode()
+                    prop = prop.strip().decode().split()
+                    if prop:
+                        sentence.append(word)
+                        columns.append(prop)
+                        continue
+                    self._emit_sentence(sentence, columns)
+                    sentence, columns = [], []
+                self._emit_sentence(sentence, columns)
+
+    def _emit_sentence(self, sentence, columns):
+        if not columns:
+            return
+        rows = list(zip(*columns))  # rows[i] = column i down the sentence
+        verbs = [v for v in rows[0] if v != "-"]
+        for vi, col in enumerate(rows[1:]):
+            tags, cur, in_bracket = [], None, False
+            for tok in col:
+                if tok == "*":
+                    tags.append("I-" + cur if in_bracket else "O")
+                elif tok == "*)":
+                    tags.append("I-" + cur)
+                    in_bracket = False
+                elif "(" in tok and ")" in tok:
+                    cur = tok[1:tok.find("*")]
+                    tags.append("B-" + cur)
+                    in_bracket = False
+                elif "(" in tok:
+                    cur = tok[1:tok.find("*")]
+                    tags.append("B-" + cur)
+                    in_bracket = True
+                else:
+                    raise RuntimeError(f"unexpected SRL label: {tok}")
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[vi])
+            self.labels.append(tags)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        vi = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, key, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                              (0, "0", None), (1, "p1", "eos"),
+                              (2, "p2", "eos")):
+            j = vi + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[key] = sentence[j]
+            else:
+                ctx[key] = pad
+        word_idx = [self.word_dict.get(w, _CONLL_UNK_IDX) for w in sentence]
+        ctx_arr = {k: [self.word_dict.get(v, _CONLL_UNK_IDX)] * n
+                   for k, v in ctx.items()}
+        pred_idx = [self.predicate_dict.get(self.predicates[idx])] * n
+        label_idx = [self.label_dict.get(t) for t in labels]
+        return (np.array(word_idx), np.array(ctx_arr["n2"]),
+                np.array(ctx_arr["n1"]), np.array(ctx_arr["0"]),
+                np.array(ctx_arr["p1"]), np.array(ctx_arr["p2"]),
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file
+
+
+class UCIHousing(Dataset):
+    """Boston housing: 14 whitespace-separated floats per row; features
+    mean-normalized by (max-min); 80/20 train/test split (reference
+    uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, download, "UCIHousing",
+                                    "data file")
+        self._load_data()
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(np.float32), row[-1:].astype(np.float32))
+
+    def __len__(self):
+        return len(self.data)
+
+
+_WMT_START, _WMT_END, _WMT_UNK = "<s>", "<e>", "<unk>"
+_WMT_UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr (preprocessed tar with src.dict/trg.dict inside).
+    Examples: (src_ids, trg_ids, trg_ids_next), sequences over 80 tokens
+    dropped (reference wmt14.py)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, download, "WMT14")
+        assert dict_size > 0, "dict_size should be a positive number"
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(f, size):
+            d = {}
+            for i, line in enumerate(f):
+                if i >= size:
+                    break
+                d[line.strip().decode()] = i
+            return d
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            names = tf.getnames()
+            src = [n for n in names if n.endswith("src.dict")]
+            trg = [n for n in names if n.endswith("trg.dict")]
+            assert len(src) == 1 and len(trg) == 1, \
+                "archive must contain exactly one src.dict and trg.dict"
+            self.src_dict = to_dict(tf.extractfile(src[0]), self.dict_size)
+            self.trg_dict = to_dict(tf.extractfile(trg[0]), self.dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            for name in (n for n in names if n.endswith(suffix)):
+                for line in tf.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [self.src_dict.get(w, _WMT_UNK_IDX)
+                               for w in ([_WMT_START] + parts[0].split()
+                                         + [_WMT_END])]
+                    trg_words = [self.trg_dict.get(w, _WMT_UNK_IDX)
+                                 for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg_words) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append(
+                        [self.trg_dict[_WMT_START]] + trg_words)
+                    self.trg_ids_next.append(
+                        trg_words + [self.trg_dict[_WMT_END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(Dataset):
+    """WMT16 en-de (tar with wmt16/{train,test,val} TSVs). Vocabularies
+    are built from the training split in memory (the reference caches
+    them under DATA_HOME; a pure function of the archive is kept here)
+    (reference wmt16.py)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode.lower() in ("train", "test", "val"), mode
+        self.mode = mode.lower()
+        self.data_file = _need_file(data_file, download, "WMT16")
+        self.lang = lang
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict sizes should be positive numbers"
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._build_dict(src_dict_size, lang)
+        self.trg_dict = self._build_dict(trg_dict_size,
+                                         "de" if lang == "en" else "en")
+        self._load_data()
+
+    def _build_dict(self, dict_size, lang):
+        col = 0 if lang == "en" else 1
+        freq = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    freq[w] += 1
+        words = [_WMT_START, _WMT_END, _WMT_UNK]
+        for w, _ in sorted(freq.items(), key=lambda x: (-x[1], x[0])):
+            if len(words) == dict_size:
+                break
+            words.append(w)
+        return {w: i for i, w in enumerate(words)}
+
+    def _load_data(self):
+        start_id = self.src_dict[_WMT_START]
+        end_id = self.src_dict[_WMT_END]
+        unk_id = self.src_dict[_WMT_UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.src_dict.get(w, unk_id)
+                       for w in parts[src_col].split()]
+                trg = [self.trg_dict.get(w, unk_id)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append([start_id] + src + [end_id])
+                self.trg_ids.append([start_id] + trg)
+                self.trg_ids_next.append(trg + [end_id])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)

@@ -127,8 +127,10 @@ def test_spectrogram_and_mfcc_shapes():
     assert db.shape == mel.shape
 
 
-def test_text_dataset_stub_raises():
-    with pytest.raises(RuntimeError):
+def test_text_dataset_requires_local_archive():
+    # real loaders now (tests/test_text_datasets.py); without a local
+    # archive the zero-egress contract still raises with guidance
+    with pytest.raises(RuntimeError, match="local archive"):
         text.datasets.Imdb()
 
 

@@ -1,0 +1,184 @@
+"""text.datasets loaders against tiny synthetic archives in the official
+formats (reference test strategy: corpus fixtures, no network)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_imdb(tmp_path):
+    p = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0.txt": b"good great good film, truly great!",
+        "aclImdb/train/neg/0.txt": b"bad awful bad film.",
+        "aclImdb/test/pos/0.txt": b"great good",
+        "aclImdb/test/neg/0.txt": b"awful bad bad",
+    }
+    with tarfile.open(p, "w:gz") as tf:
+        for name, data in docs.items():
+            _add_bytes(tf, name, data)
+    ds = Imdb(data_file=str(p), mode="train", cutoff=1)
+    # vocabulary: words with freq > 1 across the whole corpus (byte
+    # tokens, like the reference's bytes-level tokenizer; imdb.py:127)
+    assert set(ds.word_idx) >= {b"good", b"great", b"bad", "<unk>"}
+    assert len(ds) == 2
+    doc, label = ds[0]
+    assert doc.ndim == 1 and label.shape == (1,)
+    labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+    assert labels == [0, 1]  # pos=0, neg=1
+    # punctuation stripped: no OOV spike from "film," vs "film"
+    test = Imdb(data_file=str(p), mode="test", cutoff=1)
+    assert len(test) == 2
+
+
+def test_imdb_requires_local_file():
+    with pytest.raises(RuntimeError, match="local archive"):
+        Imdb(data_file=None, download=True)
+
+
+def test_imikolov(tmp_path):
+    p = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat\nthe dog sat\n"
+    valid = b"the cat ran\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    ds = Imikolov(data_file=str(p), data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=0)
+    grams = [tuple(int(x) for x in ds[i]) for i in range(len(ds))]
+    # "<s> the cat sat <e>" -> 4 bigrams per line
+    assert len(grams) == 8
+    seq = Imikolov(data_file=str(p), data_type="SEQ", window_size=-1,
+                   mode="test", min_word_freq=0)
+    src, trg = seq[0]
+    assert src[0] == seq.word_idx["<s>"]
+    assert trg[-1] == seq.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_movielens(tmp_path):
+    p = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Heat (1995)::Action\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::F::1::10::48067\n2::M::25::16::70072\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::2::1::978302109\n"
+                   "1::2::4::978301968\n2::1::3::978300275\n")
+    train = Movielens(data_file=str(p), mode="train", test_ratio=0.0)
+    assert len(train) == 4
+    ex = train[0]
+    # usr(4) + movie(3) + rating(1) feature groups
+    assert len(ex) == 8
+    uid, gender, age, job, mid, cats, title, rating = ex
+    assert uid.shape == (1,) and rating.shape == (1,)
+    assert rating[0] in (5.0, -3.0, 3.0, 1.0)  # r*2-5 for r in 5,1,4,3
+    test = Movielens(data_file=str(p), mode="test", test_ratio=1.0)
+    assert len(test) == 4
+
+
+def test_conll05st(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    props = b"-  *\nsit  (V*)\n-  (A1*)\n\n"
+    # column 0 = predicate lemmas; column 1 = one predicate's labels
+    words_gz = gzip.compress(words)
+    props_gz = gzip.compress(props)
+    p = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   words_gz)
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   props_gz)
+    wd = tmp_path / "words.dict"
+    wd.write_text("The\ncat\nsat\n")
+    vd = tmp_path / "verbs.dict"
+    vd.write_text("sit\n")
+    td = tmp_path / "targets.dict"
+    td.write_text("B-V\nI-V\nB-A1\nI-A1\n")
+    ds = Conll05st(data_file=str(p), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td),
+                   emb_file=None)
+    assert len(ds) == 1
+    (word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx, mark,
+     label_idx) = ds[0]
+    assert word_idx.tolist() == [0, 1, 2]
+    # predicate at position 1 ("cat" row labeled (V*))
+    assert mark.tolist() == [1, 1, 1]
+    assert pred_idx.tolist() == [0, 0, 0]
+    ldict = ds.get_dict()[2]
+    assert label_idx.tolist() == [ldict["O"], ldict["B-V"], ldict["B-A1"]]
+
+
+def test_uci_housing(tmp_path):
+    rows = np.arange(14 * 10, dtype=np.float64).reshape(10, 14)
+    p = tmp_path / "housing.data"
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(" ".join(str(x) for x in r) + "\n")
+    train = UCIHousing(data_file=str(p), mode="train")
+    test = UCIHousing(data_file=str(p), mode="test")
+    assert len(train) == 8 and len(test) == 2
+    feat, target = train[0]
+    assert feat.shape == (13,) and target.shape == (1,)
+    assert feat.dtype == np.float32
+    # normalized features: (x - mean) / (max - min), target raw
+    assert abs(float(feat[0]) - (-0.5)) < 1e-6
+    assert float(target[0]) == 13.0
+
+
+def test_wmt14(tmp_path):
+    p = tmp_path / "wmt14.tgz"
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    pairs = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/src.dict", src_dict)
+        _add_bytes(tf, "wmt14/trg.dict", trg_dict)
+        _add_bytes(tf, "wmt14/train/train", pairs)
+    ds = WMT14(data_file=str(p), mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src.tolist() == [0, 3, 4, 1]  # <s> hello world <e>
+    assert trg.tolist() == [0, 3, 4]
+    assert trg_next.tolist() == [3, 4, 1]
+    sd, td = ds.get_dict()
+    assert sd["hello"] == 3 and td["monde"] == 4
+    rd, _ = ds.get_dict(reverse=True)
+    assert rd[3] == "hello"
+
+
+def test_wmt16(tmp_path):
+    p = tmp_path / "wmt16.tar.gz"
+    train = b"a b a\tx y\nb a\ty\n"
+    val = b"a\tx\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", train)
+        _add_bytes(tf, "wmt16/val", val)
+        _add_bytes(tf, "wmt16/test", val)
+    ds = WMT16(data_file=str(p), mode="train", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["<unk>"] == 2
+    assert ds.src_dict["a"] == 3  # most frequent after specials
+    src, trg, trg_next = ds[0]
+    assert src.tolist() == [0, 3, 4, 3, 1]
+    assert trg[0] == 0 and trg_next[-1] == 1
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    val_ds = WMT16(data_file=str(p), mode="val", src_dict_size=10,
+                   trg_dict_size=10)
+    assert len(val_ds) == 1
