@@ -28,6 +28,26 @@ way). Randomness: PRNG keys recorded in op args are re-derived from a
 per-call key threaded into every segment, so dropout resamples across
 replays instead of baking the capture-time mask.
 
+Contract (matches tests/test_sot.py's adversarial section):
+
+* Graph breaks / branch points are EXACTLY the force set: ``bool()``,
+  ``int()``, ``float()``, ``.item()``, ``.numpy()``, ``.tolist()`` on a
+  trace Tensor. Each concrete outcome keys one cached path (ndarray
+  outcomes by sha1 digest, so trie memory is O(paths)).
+* Non-tensor side effects (prints, container mutation, global counters)
+  execute at CAPTURE only and are skipped on replay — the jax.jit
+  contract. Tensor dataflow through mutated containers stays correct
+  (ops are recorded SSA, the container surgery is capture-time Python).
+* Non-tensor Python values (closures, literals, config) are baked per
+  input signature; tensors/arrays guard by shape/dtype only. Changing a
+  baked value without changing the signature replays the stale capture.
+* Branch-table overflow (``MAX_PATHS_PER_SIG`` outcomes for one
+  signature — e.g. a predicate on continuous data like
+  ``float(loss) > t``): the trie is evicted and recaptured up to
+  ``MAX_TRIE_RESETS`` times (bounded memory), then the signature falls
+  back to eager permanently, with a warning each time pointing at
+  ``lax.cond``/``jnp.where`` restructuring.
+
 Entry points: `symbolic_translate(fn)` (reference `sot/translate.py`
 name) / `sot_capture(fn)`.
 """

@@ -20,6 +20,7 @@ downstream (+ the forced value). Chains are cached in a trie keyed by
 from __future__ import annotations
 
 import functools
+import hashlib
 import threading
 import warnings
 
@@ -31,6 +32,12 @@ from ...core import dispatch, rng
 from ...core.tensor import Tensor
 
 MAX_PATHS_PER_SIG = 64
+# On branch-table overflow the whole trie for that signature is evicted
+# and recaptured (bounded memory, hot paths recompile); only after this
+# many evictions does the signature fall back to eager permanently —
+# a function forcing continuous data (float(loss) > t) degrades to
+# capture-per-call then eager instead of silently pinning 64 stale paths.
+MAX_TRIE_RESETS = 3
 
 _RECAPTURE = object()  # _replay sentinel: guard miss / unseen branch
 
@@ -57,6 +64,13 @@ def _is_prng_key(x):
         return False
 
 
+def _digest(value: np.ndarray):
+    # fixed-size content key: raw tobytes in a trie key would hold the
+    # whole array alive per branch and grow memory without bound for
+    # large forced arrays (round-3 ADVICE)
+    return hashlib.sha1(value.tobytes()).digest()
+
+
 def _sig_of(x):
     if isinstance(x, Tensor):
         return ("T", tuple(x._value.shape), str(x._value.dtype))
@@ -67,14 +81,14 @@ def _sig_of(x):
     if isinstance(x, dict):
         return tuple(sorted((k, _sig_of(v)) for k, v in x.items()))
     if isinstance(x, np.ndarray):
-        return ("N", x.shape, str(x.dtype), x.tobytes())
+        return ("N", x.shape, str(x.dtype), _digest(x))
     return ("S", repr(x))
 
 
 def _outcome_key(kind, value):
     """Hashable branch-table key for a forced value."""
     if isinstance(value, np.ndarray):
-        return (kind, value.shape, str(value.dtype), value.tobytes())
+        return (kind, value.shape, str(value.dtype), _digest(value))
     if isinstance(value, (list, tuple)):
         return (kind, repr(value))
     return (kind, value)
@@ -343,6 +357,7 @@ class SOTFunction:
         self._fn = fn
         self._entries = {}   # sig -> {"head": _Node, "paths": int,
                              #         "implicit": {ref: Tensor}}
+        self._trie_resets = {}  # sig -> eviction count (overflow policy)
         functools.update_wrapper(self, fn)
 
     # ---- capture ----
@@ -466,12 +481,31 @@ class SOTFunction:
         entry = self._entries.get(sig)
         if entry is not None:
             if entry["paths"] >= MAX_PATHS_PER_SIG:
+                resets = self._trie_resets.get(sig, 0)
+                if resets >= MAX_TRIE_RESETS:
+                    # repeated overflow: the function branches on
+                    # continuous data — permanently eager for this sig
+                    warnings.warn(
+                        f"sot: {self._fn.__name__} exceeded "
+                        f"{MAX_PATHS_PER_SIG} traced branch paths "
+                        f"{resets + 1}x for one signature (likely a "
+                        "predicate on continuous data, e.g. "
+                        "float(x) > t); falling back to eager — "
+                        "restructure with lax.cond/jnp.where or move the "
+                        "predicate out of the captured function",
+                        stacklevel=2)
+                    return self._fn(*args, **kwargs)
+                # evict the trie and recapture: bounded memory, hot
+                # paths rebuild; beats pinning 64 stale paths forever
+                self._trie_resets[sig] = resets + 1
+                self._entries.pop(sig, None)
                 warnings.warn(
                     f"sot: {self._fn.__name__} exceeded "
-                    f"{MAX_PATHS_PER_SIG} traced branch paths for one "
-                    "signature; falling back to eager execution",
+                    f"{MAX_PATHS_PER_SIG} traced branch paths; evicting "
+                    f"the cached trie for this signature "
+                    f"(reset {resets + 1}/{MAX_TRIE_RESETS})",
                     stacklevel=2)
-                return self._fn(*args, **kwargs)
+                return self._capture(args, kwargs, sig)
             out = self._replay(sig, entry, args, kwargs)
             if out is not _RECAPTURE:
                 return out

@@ -295,3 +295,143 @@ def test_while_loop_with_tensor_predicate_captures():
     np.testing.assert_allclose(sf(x2).numpy(), [6, 6])
     x3 = P.to_tensor(np.full((2,), 3.0, np.float32))  # 6 -> 12: one iter
     np.testing.assert_allclose(sf(x3).numpy(), [6, 6])
+
+
+# =================== adversarial section (VERDICT r3 Next #7) ===================
+
+
+def test_container_mutation_between_ops():
+    """Mutating Python containers between ops must not corrupt capture:
+    the dataflow is SSA over tensors, list surgery is capture-time-only
+    Python."""
+    def f(x):
+        acc = []
+        for i in range(4):
+            acc.append(x * float(i))
+        acc.pop(1)             # mutate mid-build
+        acc.insert(0, x + 10.0)
+        acc[2] = acc[2] - acc[0]
+        d = {"a": acc[0]}
+        d["b"] = d.pop("a") * 2.0  # dict churn
+        return sum(acc[1:], d["b"])
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.arange(3, dtype=np.float32))
+    ref = f(x)
+    np.testing.assert_allclose(sf(x).numpy(), ref.numpy(), rtol=1e-6)
+    # replay (cached path), fresh value
+    y = P.to_tensor(np.arange(3, dtype=np.float32) + 5)
+    np.testing.assert_allclose(sf(y).numpy(), f(y).numpy(), rtol=1e-6)
+    assert _entry(sf)["paths"] == 1  # no spurious branches
+
+
+def test_input_list_mutation_is_capture_time_only():
+    """In-place mutation of a PASSED container is a side effect: it runs
+    at capture, not at replay (documented jit-like contract)."""
+    def f(x, sink):
+        y = x * 2.0
+        sink.append("ran")
+        return y
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.ones(2, np.float32))
+    s1 = []
+    sf(x, s1)
+    assert s1 == ["ran"]  # capture executed the append
+    s2 = []
+    out = sf(P.to_tensor(np.ones(2, np.float32) * 3), s2)
+    np.testing.assert_allclose(out.numpy(), [6, 6])
+    assert s2 == []  # replay did NOT re-run the side effect
+
+
+def test_non_tensor_side_effects_replay_skipped():
+    """print/global counters run once (at capture) — same contract as
+    jax.jit; results stay correct."""
+    calls = {"n": 0}
+
+    def f(x):
+        calls["n"] += 1
+        return x + 1.0
+
+    sf = symbolic_translate(f)
+    for i in range(5):
+        out = sf(P.to_tensor(np.full(2, float(i), np.float32)))
+        np.testing.assert_allclose(out.numpy(), [i + 1, i + 1])
+    assert calls["n"] == 1  # captured once, replayed 4x
+
+
+def test_python_scalar_closure_is_baked_per_signature():
+    """A non-tensor closure value is a baked literal within a signature —
+    the documented guard boundary (tensors guard by shape/dtype only)."""
+    state = {"scale": 2.0}
+
+    def f(x):
+        return x * state["scale"]
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose(sf(x).numpy(), [2, 2])
+    state["scale"] = 5.0  # invisible to the cached path: baked at capture
+    np.testing.assert_allclose(sf(x).numpy(), [2, 2])
+    # a NEW signature recaptures and sees the current value
+    x3 = P.to_tensor(np.ones(3, np.float32))
+    np.testing.assert_allclose(sf(x3).numpy(), [5, 5, 5])
+
+
+def test_trie_eviction_then_permanent_eager():
+    """Overflow policy: trie evicted + recaptured MAX_TRIE_RESETS times,
+    then permanently eager (ADVICE r3: no silent 64-path pin; loud final
+    fallback with guidance)."""
+    from paddle_tpu.jit.sot import capture as cap
+
+    def f(x, t):
+        return x * float(int(t.sum()))
+
+    sf = symbolic_translate(f)
+    old_paths, old_resets = cap.MAX_PATHS_PER_SIG, cap.MAX_TRIE_RESETS
+    cap.MAX_PATHS_PER_SIG, cap.MAX_TRIE_RESETS = 2, 2
+    try:
+        x = P.to_tensor(np.ones(2, np.float32))
+        n = 0
+        evictions = 0
+        with pytest.warns(UserWarning) as rec:
+            for i in range(12):
+                out = sf(x, P.to_tensor(np.int32(i)))
+                np.testing.assert_allclose(out.numpy(), [i, i])
+                n += 1
+        msgs = [str(w.message) for w in rec]
+        evictions = sum("evicting" in m for m in msgs)
+        finals = sum("falling back to eager" in m for m in msgs)
+        assert evictions == 2  # exactly MAX_TRIE_RESETS evictions
+        assert finals >= 1     # then the permanent eager fallback
+        # still correct after the fallback
+        out = sf(x, P.to_tensor(np.int32(77)))
+        np.testing.assert_allclose(out.numpy(), [77, 77])
+    finally:
+        cap.MAX_PATHS_PER_SIG, cap.MAX_TRIE_RESETS = old_paths, old_resets
+
+
+def test_large_forced_array_key_is_bounded():
+    """numpy()-forced arrays key branches by sha1 digest, not raw bytes —
+    trie memory stays O(paths), not O(paths * array size) (ADVICE r3)."""
+    import sys
+
+    from paddle_tpu.jit.sot import capture as cap
+
+    def f(x):
+        m = (x > 0).numpy()  # force a big array (graph break)
+        return x * 2.0 if m.all() else x * 3.0
+
+    sf = symbolic_translate(f)
+    big = P.to_tensor(np.ones(4096, np.float32))
+    sf(big)
+    node = _entry(sf)["head"]
+    for outcome in node.branches:
+        for part in outcome:
+            if isinstance(part, bytes):
+                assert len(part) <= 20, "branch key holds raw array bytes"
+    # digest keys still separate branches correctly
+    neg = P.to_tensor(-np.ones(4096, np.float32))
+    np.testing.assert_allclose(sf(neg).numpy()[:2], [-3, -3])
+    np.testing.assert_allclose(sf(big).numpy()[:2], [2, 2])
+    assert _entry(sf)["paths"] == 2
