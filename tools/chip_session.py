@@ -79,29 +79,50 @@ def phase_sweep():
 
     from paddle_tpu.ops.pallas import flash_attention as FA
 
-    B, H, S, D = 32, 12, 1024, 64
     rs = np.random.RandomState(0)
-    q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
-    k = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
-    v = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
-    flops = B * H * 4 * S * S * D * 0.5
-    for bq, bk in [(1024, 1024), (512, 1024), (256, 512), (512, 512),
-                   (256, 256), (128, 128)]:
-        try:
-            f = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core(
-                x, k, v, True, bq, bk))
-            t = slope(f, q)
-            g = jax.jit(jax.grad(lambda x, bq=bq, bk=bk: FA._flash_core(
-                x, k, v, True, bq, bk).astype(jnp.float32).sum()))
-            tg = slope(g, q)
-            log("sweep", {"blocks": f"{bq}x{bk}",
-                          "fwd_ms": round(t * 1e3, 2),
-                          "fwd_tflops": round(flops / t / 1e12, 1),
-                          "fwdbwd_ms": round(tg * 1e3, 2),
-                          "fwdbwd_tflops": round(3.5 * flops / tg / 1e12, 1)})
+    # bench shape + a D=128 LLaMA-class shape (VERDICT r3 Next #2: flash
+    # must beat the jnp reference >=1.5x fwd+bwd or it leaves the hot path)
+    for (B, H, S, D), pairs in (
+            ((32, 12, 1024, 64), [(1024, 1024), (512, 1024), (256, 512),
+                                  (512, 512), (256, 256), (128, 128)]),
+            ((8, 16, 2048, 128), [(1024, 1024), (512, 1024), (512, 512),
+                                  (256, 512)])):
+        q = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(B, S, H, D), jnp.bfloat16)
+        flops = B * H * 4 * S * S * D * 0.5
+        shape_tag = f"B{B}H{H}S{S}D{D}"
+        try:  # the bar: XLA's fused-softmax reference attention
+            fr = jax.jit(lambda x: FA._ref_attention(x, k, v, None, True))
+            tr = slope(fr, q)
+            gr = jax.jit(jax.grad(lambda x: FA._ref_attention(
+                x, k, v, None, True).astype(jnp.float32).sum()))
+            tgr = slope(gr, q)
+            log("sweep", {"shape": shape_tag, "blocks": "jnp-ref",
+                          "fwd_ms": round(tr * 1e3, 2),
+                          "fwdbwd_ms": round(tgr * 1e3, 2)})
         except Exception as e:
-            log("sweep", {"blocks": f"{bq}x{bk}",
+            log("sweep", {"shape": shape_tag, "blocks": "jnp-ref",
                           "error": f"{type(e).__name__}: {str(e)[:100]}"})
+        for bq, bk in pairs:
+            try:
+                f = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core(
+                    x, k, v, True, bq, bk))
+                t = slope(f, q)
+                g = jax.jit(jax.grad(
+                    lambda x, bq=bq, bk=bk: FA._flash_core(
+                        x, k, v, True, bq, bk).astype(jnp.float32).sum()))
+                tg = slope(g, q)
+                log("sweep", {
+                    "shape": shape_tag, "blocks": f"{bq}x{bk}",
+                    "fwd_ms": round(t * 1e3, 2),
+                    "fwd_tflops": round(flops / t / 1e12, 1),
+                    "fwdbwd_ms": round(tg * 1e3, 2),
+                    "fwdbwd_tflops": round(3.5 * flops / tg / 1e12, 1)})
+            except Exception as e:
+                log("sweep", {"shape": shape_tag, "blocks": f"{bq}x{bk}",
+                              "error": f"{type(e).__name__}: "
+                                       f"{str(e)[:100]}"})
 
 
 def phase_kernels():
@@ -221,6 +242,152 @@ GOOD_BENCH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "last_good_bench.jsonl")
 
 
+def phase_memory_headroom():
+    """Largest single-chip GPT training run (VERDICT r3 Next #8): GPT-760M
+    with remat + bf16 AMP + donation; records tokens/s, MFU, and peak HBM
+    toward the BASELINE configs 2-3 memory story."""
+    import gc
+
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
+                    num_heads=16, max_seq_len=1024, recompute=True)
+    seq, iters = 1024, 8
+    for batch in (16, 8, 4, 2):
+        model = opt = step = None
+        gc.collect()
+        try:
+            topology.reset_topology()
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {
+                "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                "sep_degree": 1, "sharding_degree": 1}
+            fleet.init(is_collective=True, strategy=strategy)
+            P.seed(0)
+            model = fleet.distributed_model(GPTForCausalLM(cfg))
+            opt = fleet.distributed_optimizer(P.optimizer.AdamW(
+                parameters=model.parameters(), learning_rate=1e-4))
+            step = model.build_train_step(
+                opt, GPTPretrainingCriterion(), amp_dtype="bfloat16")
+            rs = np.random.RandomState(0)
+            ids = P.to_tensor(
+                rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+            labels = P.to_tensor(
+                rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+            losses = step.run_steps(ids, labels, repeat=iters)  # warmup
+            float(np.asarray(losses._value[-1]))
+            t0 = time.perf_counter()
+            losses = step.run_steps(ids, labels, repeat=iters)
+            final = float(np.asarray(losses._value[-1]))
+            dt = time.perf_counter() - t0
+            n_params = sum(int(np.prod(p.shape))
+                           for p in model.parameters())
+            tps = batch * seq * iters / dt
+            mfu = tps * 6 * n_params / 197e12
+            peak = P.device.max_memory_allocated()
+            log("memory_headroom", {
+                "model": "gpt-760m", "params": n_params, "batch": batch,
+                "tokens_per_s": round(tps, 1), "mfu": round(mfu, 4),
+                "peak_memory_gb": round(peak / 2**30, 2) if peak else None,
+                "loss": round(final, 4)})
+            return
+        except Exception as e:
+            log("memory_headroom", {
+                "batch": batch,
+                "error": f"{type(e).__name__}: {str(e)[:150]}"})
+
+
+def phase_decode_quant():
+    """weight-only int8 vs bf16 linear at decode GEMV shapes (VERDICT r3
+    Next #4): int8 weights halve HBM reads — decode is bandwidth-bound, so
+    the kernel must show ~2x or it is overhead."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.nn import quant as Q
+
+    rs = np.random.RandomState(0)
+    B = 8
+    for h_in, h_out, tag in ((2048, 8192, "mlp-up"), (8192, 2048, "mlp-dn"),
+                             (2048, 50304, "lm-head")):
+        try:
+            # slope() chains f(f(x)): use an up+down GEMM pair so shapes
+            # round-trip; both weights stream from HBM each call
+            w1 = jnp.asarray(rs.randn(h_in, h_out) * 0.02, jnp.float32)
+            w2 = jnp.asarray(rs.randn(h_out, h_in) * 0.02, jnp.float32)
+            x = jnp.asarray(rs.randn(B, h_in), jnp.bfloat16)
+            b1, b2 = w1.astype(jnp.bfloat16), w2.astype(jnp.bfloat16)
+            f_bf16 = jax.jit(lambda x, b1=b1, b2=b2: (x @ b1) @ b2)
+            q1, s1 = (t._value for t in Q.weight_quantize(
+                w1, algo="weight_only_int8"))
+            q2, s2 = (t._value for t in Q.weight_quantize(
+                w2, algo="weight_only_int8"))
+
+            def int8_pair(x, q1=q1, s1=s1, q2=q2, s2=s2):
+                d1 = Q.weight_dequantize.raw(q1, s1, "weight_only_int8",
+                                             jnp.bfloat16, -1)
+                d2 = Q.weight_dequantize.raw(q2, s2, "weight_only_int8",
+                                             jnp.bfloat16, -1)
+                return (x @ d1) @ d2
+
+            f_int8 = jax.jit(int8_pair)
+            t_bf = slope(f_bf16, x)
+            t_q = slope(f_int8, x)
+            bytes_bf = 2 * h_in * h_out * 2  # two bf16 weight streams
+            log("decode_quant", {
+                "shape": f"{tag}-pair {B}x{h_in}x{h_out}",
+                "bf16_ms": round(t_bf * 1e3, 3),
+                "int8_ms": round(t_q * 1e3, 3),
+                "bf16_gbps": round(bytes_bf / t_bf / 1e9, 1),
+                "speedup": round(t_bf / t_q, 2)})
+        except Exception as e:
+            log("decode_quant", {"shape": tag,
+                                 "error": f"{type(e).__name__}: "
+                                          f"{str(e)[:150]}"})
+
+
+def phase_generate_1p3b():
+    """GPT-1.3B-shape single-chip decode throughput, bf16 weights
+    (serving metric at a real deployment size)."""
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_1p3b
+
+    P.seed(0)
+    cfg = gpt_1p3b()
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+    rs = np.random.RandomState(0)
+    B, S0, NEW = 8, 128, 64
+    prompt = P.to_tensor(rs.randint(0, cfg.vocab_size, (B, S0)), "int32")
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=NEW)
+    _ = np.asarray(out._value)
+    warm = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = model.generate(prompt, max_new_tokens=NEW)
+    _ = np.asarray(out._value)
+    dt = time.perf_counter() - t0
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # decode is HBM-bound: each token step reads all params once
+    gbps = n_params * 2 * (NEW / dt) / 1e9
+    log("generate_1p3b", {"params": n_params, "warm_s": round(warm, 1),
+                          "steady_s": round(dt, 2),
+                          "tokens_per_s": round(B * NEW / dt, 1),
+                          "ms_per_token_step": round(dt / NEW * 1e3, 2),
+                          "weight_stream_gbps": round(gbps, 1)})
+
+
 def phase_bench():
     t0 = time.perf_counter()
     r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
@@ -253,12 +420,18 @@ def phase_bench():
 
 PHASES = {"sanity": phase_sanity, "sweep": phase_sweep,
           "kernels": phase_kernels, "autotune": phase_autotune_seed,
-          "generate": phase_generate, "bench": phase_bench}
+          "generate": phase_generate, "decode_quant": phase_decode_quant,
+          "generate_1p3b": phase_generate_1p3b,
+          "memory_headroom": phase_memory_headroom, "bench": phase_bench}
 
 
 def main():
+    # order: cheap sanity + kernel evidence first, bench (the round's
+    # headline artifact) before the heavier serving/memory phases, so an
+    # early tunnel drop costs the least important data
     names = sys.argv[1:] or ["sanity", "sweep", "kernels", "autotune",
-                             "generate", "bench"]
+                             "bench", "generate", "decode_quant",
+                             "generate_1p3b", "memory_headroom"]
     for n in names:
         try:
             PHASES[n]()
