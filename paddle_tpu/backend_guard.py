@@ -98,18 +98,12 @@ def force_cpu_mesh(n_devices: int = 8):
 
     import jax
 
-    # sitecustomize imported jax before us, so the config snapshot may
-    # already hold JAX_PLATFORMS=axon — override at the config level too.
-    for key, val in (("jax_platforms", "cpu"),
-                     ("jax_num_cpu_devices", n_devices)):
-        try:
-            jax.config.update(key, val)
-        except Exception:
-            pass
-
-    # If a backend was already initialized (e.g. entry() compile-checked
-    # on TPU in this process), clear it so the forced platform + device
-    # count are honored on re-init.
+    # If a backend was already initialized (e.g. entry() compile-checked,
+    # or a previous force_cpu_mesh with a different count ran), clear it
+    # FIRST: `jax_num_cpu_devices` refuses updates while backends are
+    # live, and the old (swallowed) order left the previous device count
+    # pinned — a force_cpu_mesh(1) followed by force_cpu_mesh(8) stayed
+    # at 1 device (slow-tier ordering bug, round 4).
     try:
         from jax._src import xla_bridge as _xb
 
@@ -118,4 +112,13 @@ def force_cpu_mesh(n_devices: int = 8):
             _xb._clear_backends()
     except Exception:
         pass
+
+    # sitecustomize imported jax before us, so the config snapshot may
+    # already hold JAX_PLATFORMS=axon — override at the config level too.
+    for key, val in (("jax_platforms", "cpu"),
+                     ("jax_num_cpu_devices", n_devices)):
+        try:
+            jax.config.update(key, val)
+        except Exception:
+            pass
     return jax

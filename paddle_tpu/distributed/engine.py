@@ -148,6 +148,23 @@ class Engine:
                 "est_memory_bytes": self.plan_result.est_mem_bytes,
                 "strategy": repr(self.plan_result)}
 
+    def analyze(self, *batch, verbose=False):
+        """Compiler ground truth for the prepared step (completion +
+        reshard evidence, `distributed.completion`): the shardings GSPMD
+        assigned and the collectives it inserted, to audit the planner's
+        claims against the program that will actually run."""
+        from . import completion
+
+        if self._step is None:  # auto-prepare from the batch, like fit()
+            self._ensure_prepared(
+                global_batch=int(np.shape(
+                    batch[0]._value if hasattr(batch[0], "_value")
+                    else batch[0])[0]))
+        report = completion.analyze(self._step, *batch)
+        if verbose:
+            print(completion.format_report(report))
+        return report
+
     # --- running ------------------------------------------------------------
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
             valid_data=None, log_freq=10):

@@ -329,16 +329,37 @@ class DistributedTrainStep:
         self._state = {"params": params, "opt": opt, "buffers": buffers,
                        "key": key}
 
+    def _ensure_compiled(self, treedef):
+        """One compile-cache keying for __call__ and lower(): a drift
+        between the lowered-for-analysis and executed programs would
+        defeat the analyzer's purpose."""
+        if self._compiled is None or \
+                getattr(self, "_batch_treedef", None) != treedef:
+            self._batch_treedef = treedef
+            self._compiled = self._build(treedef, None)
+        return self._compiled
+
+    def lower(self, *batch):
+        """Lower the compiled step for `batch` without executing it
+        (state does NOT advance). Feeds the completion/reshard analyzers
+        (`distributed.completion.analyze`): `.as_text()` carries the
+        GSPMD sharding annotations, `.compile().as_text()` the inserted
+        collectives."""
+        placed, treedef = self._place_batch(batch, batch_axis=0)
+        compiled = self._ensure_compiled(treedef)
+        s = self._state
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        return compiled.lower(
+            s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
+
     def __call__(self, *batch):
         """batch: (inputs, labels) Tensors (loss_fn mode) or raw model args.
         Returns the loss as a Tensor; model/optimizer state advances."""
         placed, treedef = self._place_batch(batch, batch_axis=0)
-        if self._compiled is None or self._batch_treedef != treedef:
-            self._batch_treedef = treedef
-            self._compiled = self._build(treedef, None)
+        compiled = self._ensure_compiled(treedef)
         s = self._state
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, params, opt, buffers, key = self._compiled(
+        loss, params, opt, buffers, key = compiled(
             s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
         self._swap_state(params, opt, buffers, key)
         return Tensor(loss)

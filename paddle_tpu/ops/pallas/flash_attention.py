@@ -238,6 +238,116 @@ def _fwd(q, k, v, causal, block_q, block_k):
     return jnp.swapaxes(out, 1, 2), lse
 
 
+# ================== multi-head-block forward (no transposes) ==================
+
+def _fwd_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                   causal, seq_q, seq_k, n_heads):
+    """All-heads-in-block variant: operates directly on [B,S,H,D] arrays.
+
+    Mosaic cannot lower a squeezed-H block over [B,S,H,D] (the last two
+    block dims must be divisible by (8,128) or EQUAL the array dims —
+    a squeezed H=12 between S and D is neither), but a block carrying the
+    FULL head dim is legal (equal-to-array-dim rule). The kernel then
+    walks heads with static slices — a sublane extract per head, O(bq*d),
+    negligible next to the O(bq*sk*d) dots — and the [B,S,H,D]<->[B,H,S,D]
+    transposes around every attention call (~25 ms/step, PERF.md) never
+    exist. VMEM holds K/V for ALL heads (seq_k*H*D*2*itemsize), so this
+    path suits moderate S*H*D; the dispatcher keeps the transpose path
+    for larger shapes.
+    q_ref/o_ref: [block_q, H, D]; k_ref/v_ref: [seq_k, H, D];
+    lse_ref: [H, block_q, 1].
+    """
+    block_q = q_ref.shape[0]
+    iq = pl.program_id(1)
+    off = seq_k - seq_q
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        num_full = jnp.clip((iq * block_q + off + 1) // block_k,
+                            0, num_k_blocks)
+        num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
+                             num_full, num_k_blocks)
+    for hh in range(n_heads):
+        q = q_ref[:, hh, :]
+        d = q.shape[-1]
+
+        def make_body(masked, hh=hh, q=q):
+            def body(j, carry):
+                m, l, acc = carry
+                k = k_ref[pl.ds(j * block_k, block_k), hh, :]
+                v = v_ref[pl.ds(j * block_k, block_k), hh, :]
+                s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                s = s * scale
+                if masked:
+                    q_ids = iq * block_q + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 0)
+                    k_ids = j * block_k + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_q, block_k), 1)
+                    valid = k_ids < seq_k
+                    if causal:
+                        valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                    s = jnp.where(valid, s, NEG_INF)
+                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m - m_new)
+                l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+                acc_new = acc * alpha + jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, acc_new
+            return body
+
+        carry0 = (jnp.full((block_q, 1), NEG_INF, jnp.float32),
+                  jnp.zeros((block_q, 1), jnp.float32),
+                  jnp.zeros((block_q, d), jnp.float32))
+        if causal:
+            carry = jax.lax.fori_loop(0, num_full, make_body(False), carry0)
+            m, l, acc = jax.lax.fori_loop(num_full, num_iters,
+                                          make_body(True), carry)
+        else:
+            m, l, acc = jax.lax.fori_loop(
+                0, num_k_blocks, make_body(seq_k % block_k != 0), carry0)
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[:, hh, :] = (acc / l_safe).astype(o_ref.dtype)
+        lse_ref[hh, :, :] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _fwd_mh(q, k, v, causal, block_q, block_k):
+    """Forward on [B,S,H,D] with zero layout changes (see _fwd_kernel_mh).
+    Returns (out [B,S,H,D], lse [B,H,Sq,1])."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    dimsem = None
+    if not _interpret():
+        dimsem = pltpu.CompilerParams(dimension_semantics=(
+            pltpu.GridDimensionSemantics.PARALLEL,
+            pltpu.GridDimensionSemantics.ARBITRARY))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_mh, scale=scale, block_k=block_k,
+                          causal=causal, seq_q=sq, seq_k=sk, n_heads=h),
+        grid=(b, pl.cdiv(sq, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, block_q, h, d), lambda bi, qi: (bi, qi, 0, 0)),
+            pl.BlockSpec((None, sk, h, d), lambda bi, qi: (bi, 0, 0, 0)),
+            pl.BlockSpec((None, sk, h, d), lambda bi, qi: (bi, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, h, d), lambda bi, qi: (bi, qi, 0, 0)),
+            pl.BlockSpec((None, h, block_q, 1), lambda bi, qi: (bi, 0, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+        compiler_params=dimsem,
+    )(q, k, v)
+    return out, lse
+
+
 # =========================== backward kernels ===========================
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,

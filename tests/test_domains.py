@@ -134,6 +134,7 @@ def test_text_dataset_requires_local_archive():
         text.datasets.Imdb()
 
 
+@pytest.mark.slow
 def test_incubate_fused_layer_zoo():
     """incubate.nn fused Layers (fused_transformer.py role): construct,
     forward, backward; pre-LN and post-LN variants."""

@@ -14,6 +14,7 @@ from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
 from paddle_tpu.vision import models as V
 
 
+@pytest.mark.slow
 def test_llama_forward_and_train_step():
     cfg = llama_tiny()
     model = LlamaForCausalLM(cfg)
@@ -69,6 +70,7 @@ def test_llama_jit_parity():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_incremental_decode_matches_full():
     """KV-cache decode must equal full-sequence attention (RoPE offsets)."""
     from paddle_tpu.models.llama import LlamaAttention
@@ -91,6 +93,7 @@ def test_llama_incremental_decode_matches_full():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_vit_forward():
     m = V.VisionTransformer(img_size=32, patch_size=8, embed_dim=64,
                             depth=2, num_heads=4, num_classes=10)
@@ -110,6 +113,7 @@ def test_vit_forward():
     (lambda: V.ShuffleNetV2(0.5, num_classes=10), 64),
     (lambda: V.GoogLeNet(num_classes=10), 64),
 ])
+@pytest.mark.slow
 def test_vision_zoo_smoke(ctor, img):
     m = ctor()
     m.eval()
@@ -119,6 +123,7 @@ def test_vision_zoo_smoke(ctor, img):
     assert out.shape == [1, 10]
 
 
+@pytest.mark.slow
 def test_fused_chunked_ce_matches_plain():
     """The chunked online-logsumexp CE must match F.cross_entropy in value
     AND gradient (it is the default GPT loss for large vocabs)."""
@@ -163,6 +168,7 @@ def test_fused_chunked_ce_matches_plain():
     ("wide_resnet50_2", 64), ("densenet169", 64), ("inception_v3", 128),
     ("shufflenet_v2_x0_5", 64),
 ])
+@pytest.mark.slow
 def test_vision_zoo_extended_forward(ctor, img):
     """New zoo families: forward shape + grads flow (tiny inputs)."""
     from paddle_tpu.vision import models as V
@@ -177,6 +183,7 @@ def test_vision_zoo_extended_forward(ctor, img):
     assert np.isfinite(out.numpy()).all()
 
 
+@pytest.mark.slow
 def test_gpt_generate_matches_full_forward_loop():
     """generate() (static KV cache + decode kernel path) must produce the
     same greedy tokens as re-running the full forward every step."""
@@ -201,6 +208,7 @@ def test_gpt_generate_matches_full_forward_loop():
     np.testing.assert_array_equal(np.asarray(out._value), ids)
 
 
+@pytest.mark.slow
 def test_llama_generate_gqa_matches_full_forward_loop():
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
@@ -390,6 +398,7 @@ def test_generate_rejects_bad_masks():
                            np.array([[1, 0, 1, 1]]), "int32"))
 
 
+@pytest.mark.slow
 def test_beam_search_beats_or_equals_greedy():
     """num_beams=1 == greedy exactly; wider beams find a sequence whose
     total log-prob is >= greedy's (the point of beam search)."""

@@ -105,6 +105,7 @@ def test_ring_attention_matches_reference():
                                    err_msg=f"causal={causal}")
 
 
+@pytest.mark.slow
 def test_ring_attention_grad():
     from paddle_tpu.ops.pallas.flash_attention import _ref_attention
     from paddle_tpu.ops.pallas.ring_attention import ring_attention

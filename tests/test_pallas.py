@@ -289,3 +289,18 @@ def test_flash_indivisible_seq_raises_loud():
     ref = fa._ref_attention(q, q, q, None, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_mh_forward_matches_transpose_path(causal):
+    """All-heads-in-block forward (_fwd_mh, zero layout changes) must be
+    numerically identical to the transpose path — including the LSE, so
+    either forward can feed the same backward."""
+    B, S, H, D = 2, 128, 3, 32
+    q, k, v = _rand((B, S, H, D)), _rand((B, S, H, D)), _rand((B, S, H, D))
+    out_mh, lse_mh = fa._fwd_mh(q, k, v, causal, 64, 64)
+    out_t, lse_t = fa._fwd(q, k, v, causal, 64, 64)
+    np.testing.assert_allclose(out_mh, out_t, atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(lse_mh, lse_t, atol=1e-6, rtol=1e-6)
+    ref = fa._ref_attention(q, k, v, None, causal)
+    np.testing.assert_allclose(out_mh, ref, atol=2e-5, rtol=2e-5)

@@ -38,6 +38,7 @@ def test_segment_layers():
     assert all(len(s) >= 1 for s in segs)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["1F1B", "FThenB"])
 def test_pp_training_decreases(schedule):
     _init(pp=4, dp=2)
@@ -129,6 +130,7 @@ def test_vpp_reduces_bubble():
     assert b2 <= b1 * 0.75, (b1, b2)
 
 
+@pytest.mark.slow
 def test_vpp_parity_with_plain_pipeline():
     """num_virtual_pipeline_stages=2 must give the same losses as the
     non-interleaved pipeline (same init/data/SGD)."""

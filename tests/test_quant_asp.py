@@ -4,6 +4,7 @@ Parity model: reference `test/quantization/` (QAT swap + convert) and
 `test/asp/` (mask creation, prune_model, optimizer guarantee).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as P
 import paddle_tpu.nn as nn
@@ -173,6 +174,7 @@ def test_int4_odd_in_features_raises():
         Q.WeightOnlyLinear(33, 8, weight_dtype="int4")
 
 
+@pytest.mark.slow
 def test_weight_only_quantize_model_generates():
     """End-to-end serving quantization: swap a GPT's linears for int8
     weight-only layers and generate; outputs stay close to float greedy."""

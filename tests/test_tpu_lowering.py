@@ -165,3 +165,16 @@ def test_gate_catches_bad_blockspec():
     x = jnp.zeros((4, 128, 128), jnp.float32)
     with pytest.raises(Exception):
         _lower_for_tpu(bad, x)
+
+
+@pytest.mark.parametrize("shape", [(8, 1024, 12, 64), (2, 2048, 32, 128)])
+def test_flash_mh_fwd_lowers(shape):
+    """The multi-head-block forward reads [B,S,H,D] in place (full-H
+    blocks — the equal-to-array-dim rule); the squeezed-H alternative is
+    un-lowerable, so this gate is what keeps the transpose-free path
+    honest."""
+    b, s, h, d = shape
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    f = lambda q, k, v: fa._fwd_mh(q, k, v, True, 128, 128)[0]
+    mlir = _lower_for_tpu(f, q, q, q)
+    _assert_mosaic(mlir)

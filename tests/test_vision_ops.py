@@ -4,6 +4,7 @@ Parity model: reference `test/legacy_test/test_nms_op.py`,
 `test_roi_align_op.py`, `test_deform_conv2d.py` — NumPy references.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as P
 from paddle_tpu.vision import ops as VO
@@ -76,6 +77,7 @@ def test_deform_conv2d_mask_halves_output():
     np.testing.assert_allclose(half, full * 0.5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_swin_forward_and_grads():
     m = V.SwinTransformer(img_size=32, patch_size=4, embed_dim=24,
                           depths=(2, 2), num_heads=(2, 4), window_size=4,
@@ -94,6 +96,7 @@ def test_swin_forward_and_grads():
     assert any(s > 0 for s in shifts)
 
 
+@pytest.mark.slow
 def test_swin_jit_parity():
     m = V.swin_t(img_size=32, patch_size=4, window_size=4, num_classes=4)
     m.eval()

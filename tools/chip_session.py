@@ -123,6 +123,26 @@ def phase_sweep():
                 log("sweep", {"shape": shape_tag, "blocks": f"{bq}x{bk}",
                               "error": f"{type(e).__name__}: "
                                        f"{str(e)[:100]}"})
+        # layout A/B (fwd only): transpose path (incl. its transposes)
+        # vs the all-heads-in-block kernel reading [B,S,H,D] in place
+        for bq, bk in ((512, 512), (256, 512), (1024, 1024)):
+            try:
+                f_t = jax.jit(lambda x, bq=bq, bk=bk: FA._fwd(
+                    x, k, v, True, bq, bk)[0])
+                f_mh = jax.jit(lambda x, bq=bq, bk=bk: FA._fwd_mh(
+                    x, k, v, True, bq, bk)[0])
+                tt = slope(f_t, q)
+                tm = slope(f_mh, q)
+                log("layout_ab", {
+                    "shape": shape_tag, "blocks": f"{bq}x{bk}",
+                    "transpose_fwd_ms": round(tt * 1e3, 2),
+                    "mh_fwd_ms": round(tm * 1e3, 2),
+                    "mh_speedup": round(tt / tm, 2)})
+            except Exception as e:
+                log("layout_ab", {"shape": shape_tag,
+                                  "blocks": f"{bq}x{bk}",
+                                  "error": f"{type(e).__name__}: "
+                                           f"{str(e)[:100]}"})
 
 
 def phase_kernels():
