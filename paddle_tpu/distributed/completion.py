@@ -30,8 +30,6 @@ from __future__ import annotations
 import collections
 import re
 
-import numpy as np
-
 __all__ = ["sharding_report", "collective_report", "analyze"]
 
 _COLLECTIVE_RE = re.compile(

@@ -104,39 +104,40 @@ def flash_attention_available(q) -> bool:
     if q.ndim != 4:
         return False
     b, s, h, d = q.shape
-    if not (d % 8 == 0 and d <= 256 and s % 8 == 0):
+    # odd sequence lengths (ViT's 197, ragged NLP batches) are handled by
+    # padding to a multiple of 8 with real-length masking in the entry
+    # point — only the head_dim constraints gate the kernel now
+    if not (d % 8 == 0 and d <= 256):
         return False
     return not _interpret()
 
 
 # =========================== forward kernel ===========================
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                causal, seq_q, seq_k):
-    # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d]; o_ref: [block_q, d];
-    # lse_ref: [block_q, 1].  Softmax stats are carried rank-2 (q positions
-    # along sublanes, a single lane) — Mosaic requires >=2-D blocks whose
-    # trailing dims tile to (8, 128) or equal the array dims; a rank-1
-    # (block_q,) stats block does not lower (VERDICT r2 missing #2).
-    # Causal is bottom-right aligned like the reference (_ref_attention
-    # tril k=sk-sq): q row i attends k cols <= i + (seq_k - seq_q).
-    block_q = q_ref.shape[0]
-    d = q_ref.shape[1]
-    iq = pl.program_id(2)
+def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
+                    seq_q, seq_k):
+    """The shared flash recurrence: walk KV blocks with f32 running
+    max/sum/acc; logits never materialize in HBM. One body for BOTH
+    forward kernels (per-head transpose layout and all-heads block) —
+    the tests' bit-identical-forwards invariant rests on this being the
+    single source of the numerics.
+
+    q: [block_q, d] (input dtype; dots accumulate in f32 via
+    preferred_element_type). load_kv(j) -> (k, v) each [block_k, d].
+    Causal is bottom-right aligned like the reference (_ref_attention
+    tril k=sk-sq): q row i attends k cols <= i + (seq_k - seq_q).
+    Returns (out [block_q, d] f32, lse [block_q, 1] f32); stats are
+    rank-2 — a rank-1 (block_q,) block does not lower to Mosaic
+    (VERDICT r2 missing #2).
+    """
+    d = q.shape[-1]
     off = seq_k - seq_q  # causal diagonal offset (0 for self-attention)
-
-    q = q_ref[:]  # input dtype; dots accumulate in f32
-
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
     num_k_blocks = pl.cdiv(seq_k, block_k)
 
     def make_body(masked):
         def body(j, carry):
             m, l, acc = carry
-            k = k_ref[pl.ds(j * block_k, block_k), :]
-            v = v_ref[pl.ds(j * block_k, block_k), :]
+            k, v = load_kv(j)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             s = s * scale
@@ -159,6 +160,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
             return m_new, l_new, acc_new
         return body
 
+    carry0 = (jnp.full((block_q, 1), NEG_INF, jnp.float32),
+              jnp.zeros((block_q, 1), jnp.float32),
+              jnp.zeros((block_q, d), jnp.float32))
     if causal:
         # blocks with max k_id <= min q_id + off are fully unmasked:
         # mask-free body; the diagonal remainder runs the masked body.
@@ -166,16 +170,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
                             0, num_k_blocks)
         num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
                              num_full, num_k_blocks)
-        carry = jax.lax.fori_loop(0, num_full, make_body(False),
-                                  (m0, l0, acc0))
+        carry = jax.lax.fori_loop(0, num_full, make_body(False), carry0)
         m, l, acc = jax.lax.fori_loop(num_full, num_iters, make_body(True),
                                       carry)
     else:
         m, l, acc = jax.lax.fori_loop(
-            0, num_k_blocks, make_body(seq_k % block_k != 0), (m0, l0, acc0))
+            0, num_k_blocks, make_body(seq_k % block_k != 0), carry0)
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    return acc / l_safe, m + jnp.log(l_safe)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                causal, seq_q, seq_k):
+    # q_ref: [block_q, d]; k_ref/v_ref: [seq_k, d]; o_ref: [block_q, d];
+    # lse_ref: [block_q, 1].
+    block_q = q_ref.shape[0]
+    out, lse = _online_softmax(
+        q_ref[:],
+        lambda j: (k_ref[pl.ds(j * block_k, block_k), :],
+                   v_ref[pl.ds(j * block_k, block_k), :]),
+        iq=pl.program_id(2), block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k)
+    o_ref[:] = out.astype(o_ref.dtype)
+    lse_ref[:] = lse.astype(jnp.float32)
 
 
 def _pick_block(seq, pref):
@@ -197,18 +214,27 @@ def _pick_block(seq, pref):
     return max(b, 8)
 
 
-def _fwd_t(qt, kt, vt, causal, block_q, block_k):
+def _fwd_t(qt, kt, vt, causal, block_q, block_k, seq_q_real=None,
+           seq_k_real=None):
     """Forward on head-major [B,H,S,D] operands (the kernels' native
-    layout). Returns (out_t [B,H,Sq,D], lse [B,H,Sq,1])."""
+    layout). Returns (out_t [B,H,Sq,D], lse [B,H,Sq,1]).
+
+    seq_q_real/seq_k_real: logical lengths when the arrays are padded to
+    a block-friendly multiple (odd ViT-style lengths, e.g. 197): the
+    kernels mask on the REAL bounds (k_ids < seq_k), padded key rows
+    never contribute, and the caller slices padded q rows off the
+    output."""
     b, h, sq, d = qt.shape
     sk = kt.shape[2]
+    sq_r = seq_q_real or sq
+    sk_r = seq_k_real or sk
     scale = 1.0 / math.sqrt(d)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     grid = (b, h, pl.cdiv(sq, block_q))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_k=block_k,
-                          causal=causal, seq_q=sq, seq_k=sk),
+                          causal=causal, seq_q=sq_r, seq_k=sk_r),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
@@ -259,57 +285,15 @@ def _fwd_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
     """
     block_q = q_ref.shape[0]
     iq = pl.program_id(1)
-    off = seq_k - seq_q
-    num_k_blocks = pl.cdiv(seq_k, block_k)
-    if causal:
-        num_full = jnp.clip((iq * block_q + off + 1) // block_k,
-                            0, num_k_blocks)
-        num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
-                             num_full, num_k_blocks)
     for hh in range(n_heads):
-        q = q_ref[:, hh, :]
-        d = q.shape[-1]
-
-        def make_body(masked, hh=hh, q=q):
-            def body(j, carry):
-                m, l, acc = carry
-                k = k_ref[pl.ds(j * block_k, block_k), hh, :]
-                v = v_ref[pl.ds(j * block_k, block_k), hh, :]
-                s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-                s = s * scale
-                if masked:
-                    q_ids = iq * block_q + jax.lax.broadcasted_iota(
-                        jnp.int32, (block_q, block_k), 0)
-                    k_ids = j * block_k + jax.lax.broadcasted_iota(
-                        jnp.int32, (block_q, block_k), 1)
-                    valid = k_ids < seq_k
-                    if causal:
-                        valid = jnp.logical_and(valid, q_ids + off >= k_ids)
-                    s = jnp.where(valid, s, NEG_INF)
-                m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-                p = jnp.exp(s - m_new)
-                alpha = jnp.exp(m - m_new)
-                l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
-                acc_new = acc * alpha + jax.lax.dot_general(
-                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)
-                return m_new, l_new, acc_new
-            return body
-
-        carry0 = (jnp.full((block_q, 1), NEG_INF, jnp.float32),
-                  jnp.zeros((block_q, 1), jnp.float32),
-                  jnp.zeros((block_q, d), jnp.float32))
-        if causal:
-            carry = jax.lax.fori_loop(0, num_full, make_body(False), carry0)
-            m, l, acc = jax.lax.fori_loop(num_full, num_iters,
-                                          make_body(True), carry)
-        else:
-            m, l, acc = jax.lax.fori_loop(
-                0, num_k_blocks, make_body(seq_k % block_k != 0), carry0)
-        l_safe = jnp.maximum(l, 1e-30)
-        o_ref[:, hh, :] = (acc / l_safe).astype(o_ref.dtype)
-        lse_ref[hh, :, :] = (m + jnp.log(l_safe)).astype(jnp.float32)
+        out, lse = _online_softmax(
+            q_ref[:, hh, :],
+            lambda j, hh=hh: (k_ref[pl.ds(j * block_k, block_k), hh, :],
+                              v_ref[pl.ds(j * block_k, block_k), hh, :]),
+            iq=iq, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        o_ref[:, hh, :] = out.astype(o_ref.dtype)
+        lse_ref[hh, :, :] = lse.astype(jnp.float32)
 
 
 def _fwd_mh(q, k, v, causal, block_q, block_k):
@@ -471,15 +455,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k):
+def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k,
+           seq_q_real=None, seq_k_real=None):
     """Backward on head-major [B,H,S,D] operands; returns dq/dk/dv in the
     same head-major layout. The custom VJP saves residuals head-major
     (the forward already computed them), so backward only transposes the
     incoming cotangent and the outgoing grads — half the transpose HBM
     traffic of re-deriving all five operands from [B,S,H,D]
-    (PERF.md: ~25 ms/step of transposes at the bench shape)."""
+    (PERF.md: ~25 ms/step of transposes at the bench shape).
+    seq_*_real: logical lengths for padded arrays (see _fwd_t) — kernels
+    bound loops/masks on the real lengths, so padded key rows contribute
+    nothing and the caller slices padded grad rows off."""
     b, h, sq, d = qt.shape
     sk = kt.shape[2]
+    sq_r = seq_q_real or sq
+    sk_r = seq_k_real or sk
     scale = 1.0 / math.sqrt(d)
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
@@ -497,7 +487,7 @@ def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_k=block_k,
-                          causal=causal, seq_q=sq, seq_k=sk),
+                          causal=causal, seq_q=sq_r, seq_k=sk_r),
         grid=(b, h, pl.cdiv(sq, block_q)),
         in_specs=[q_spec, k_spec_full, k_spec_full, q_spec, lse_spec, q_spec],
         out_specs=q_spec,
@@ -510,7 +500,7 @@ def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k):
                            lambda bi, hi, j: (bi, hi, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          causal=causal, seq_q=sq, seq_k=sk),
+                          causal=causal, seq_q=sq_r, seq_k=sk_r),
         grid=(b, h, pl.cdiv(sk, block_k)),
         in_specs=[full_q, kv_spec, kv_spec, full_q, full_lse, full_q],
         out_specs=[kv_spec, kv_spec],
@@ -534,13 +524,19 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k):
 
 # =========================== public entry ===========================
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, causal, block_q, block_k)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, block_q, block_k, seq_q_real=None,
+                seq_k_real=None):
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out, _ = _fwd_t(qt, kt, vt, causal, block_q, block_k,
+                    seq_q_real, seq_k_real)
+    return jnp.swapaxes(out, 1, 2)
 
 
-def _flash_core_fwd(q, k, v, causal, block_q, block_k):
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, seq_q_real=None,
+                    seq_k_real=None):
     # residuals saved HEAD-MAJOR: forward already computed the [B,H,S,D]
     # transposes, so backward reuses them instead of re-transposing all
     # five operands from [B,S,H,D] — only the cotangent (in) and the three
@@ -548,14 +544,16 @@ def _flash_core_fwd(q, k, v, causal, block_q, block_k):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out_t, lse = _fwd_t(qt, kt, vt, causal, block_q, block_k)
+    out_t, lse = _fwd_t(qt, kt, vt, causal, block_q, block_k,
+                        seq_q_real, seq_k_real)
     return jnp.swapaxes(out_t, 1, 2), (qt, kt, vt, out_t, lse)
 
 
-def _flash_core_bwd(causal, block_q, block_k, res, g):
+def _flash_core_bwd(causal, block_q, block_k, seq_q_real, seq_k_real,
+                    res, g):
     qt, kt, vt, ot, lse = res
     dq, dk, dv = _bwd_t(qt, kt, vt, ot, lse, jnp.swapaxes(g, 1, 2),
-                        causal, block_q, block_k)
+                        causal, block_q, block_k, seq_q_real, seq_k_real)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))
 
@@ -638,14 +636,29 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
                         block_q=None, block_k=None):
     """[B, S, H, D] in/out. Pallas kernel for causal/full; additive or
     boolean masks use the fused-softmax reference path. Block sizes are
-    autotuned per signature unless passed explicitly."""
-    if mask is not None or not flash_attention_available(q) \
-            or k.shape[1] % 8 != 0:
+    autotuned per signature unless passed explicitly. Odd sequence
+    lengths (ViT's 197, ragged batches) run zero-padded to a multiple of
+    8 with real-length masking inside the kernels — padded keys never
+    contribute, padded query rows are sliced off (gradients included,
+    via the custom VJP's real-length bounds)."""
+    if mask is not None or not flash_attention_available(q):
         return _ref_attention(q, k, v, mask, is_causal)
+    sq, sk = q.shape[1], k.shape[1]
+    pad_q = (-sq) % 8
+    pad_k = (-sk) % 8
+    if pad_q or pad_k:
+        widths = lambda p: ((0, 0), (0, p), (0, 0), (0, 0))
+        q = jnp.pad(q, widths(pad_q))
+        k = jnp.pad(k, widths(pad_k))
+        v = jnp.pad(v, widths(pad_k))
     if block_q is None or block_k is None:
         bq, bk = _tuned_blocks(q.shape[0], q.shape[1], k.shape[1],
                                q.shape[2], q.shape[3], q.dtype,
                                bool(is_causal))
         block_q = block_q or bq
         block_k = block_k or bk
+    if pad_q or pad_k:
+        out = _flash_core(q, k, v, bool(is_causal), block_q, block_k,
+                          sq, sk)
+        return out[:, :sq]
     return _flash_core(q, k, v, bool(is_causal), block_q, block_k)

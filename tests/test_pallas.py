@@ -279,12 +279,16 @@ def test_flash_causal_cross_length_grads():
 
 
 def test_flash_indivisible_seq_raises_loud():
-    """seq % 8 != 0 must be a loud error on the kernel path (the public
-    entry falls back to the reference path before reaching it)."""
+    """seq % 8 != 0 must be a loud error when the kernel is invoked
+    DIRECTLY without padding. The public entry handles odd lengths by
+    zero-padding + real-length masking on TPU (see
+    test_flash_padded_odd_lengths_match_reference); on CPU (interpret
+    mode gated off) it uses the reference path — correct either way."""
     q = _rand((1, 20, 2, 16))
     with pytest.raises(ValueError, match="seq % 8"):
         fa._flash_core(q, q, q, True, 8, 8)
-    # public entry: silently correct via reference path
+    # public entry: correct on every backend (reference path here;
+    # padded kernel on TPU)
     out = fa.flash_attention_fwd(q, q, q, is_causal=True)
     ref = fa._ref_attention(q, q, q, None, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -304,3 +308,33 @@ def test_flash_mh_forward_matches_transpose_path(causal):
     np.testing.assert_allclose(lse_mh, lse_t, atol=1e-6, rtol=1e-6)
     ref = fa._ref_attention(q, k, v, None, causal)
     np.testing.assert_allclose(out_mh, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_padded_odd_lengths_match_reference(causal):
+    """Odd (ViT-style) sequence lengths: zero-pad to a multiple of 8,
+    mask on the REAL lengths inside the kernels, slice the output.
+    Values and grads must match the unpadded reference exactly — padded
+    keys contribute nothing, padded query rows carry no gradient."""
+    B, SQ, SK, H, D = 2, 52, 52, 2, 16
+    q, k, v = _rand((B, SQ, H, D)), _rand((B, SK, H, D)), _rand((B, SK, H, D))
+    pad = (-SQ) % 8
+    w = ((0, 0), (0, pad), (0, 0), (0, 0))
+    qp, kp, vp = jnp.pad(q, w), jnp.pad(k, w), jnp.pad(v, w)
+    out = fa._flash_core(qp, kp, vp, causal, 8, 8, SQ, SK)[:, :SQ]
+    ref = fa._ref_attention(q, k, v, None, causal)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+
+    def loss_flash(q_, k_, v_):
+        qq, kk, vv = jnp.pad(q_, w), jnp.pad(k_, w), jnp.pad(v_, w)
+        o = fa._flash_core(qq, kk, vv, causal, 8, 8, SQ, SK)[:, :SQ]
+        return (o.astype(jnp.float32) * 0.01).sum()
+
+    def loss_ref(q_, k_, v_):
+        o = fa._ref_attention(q_, k_, v_, None, causal)
+        return (o.astype(jnp.float32) * 0.01).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)

@@ -178,3 +178,17 @@ def test_flash_mh_fwd_lowers(shape):
     f = lambda q, k, v: fa._fwd_mh(q, k, v, True, 128, 128)[0]
     mlir = _lower_for_tpu(f, q, q, q)
     _assert_mosaic(mlir)
+
+
+def test_flash_padded_vit_length_lowers():
+    """The padded odd-length path (flash_attention_fwd at ViT's S=197)
+    must lower: pad -> kernel with real-length masking -> slice."""
+    b, s, h, d = 2, 197, 12, 64
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+
+    def f(q, k, v):
+        return fa.flash_attention_fwd(q, k, v, is_causal=False,
+                                      block_q=128, block_k=128)
+
+    mlir = _lower_for_tpu(f, q, q, q)
+    _assert_mosaic(mlir)
