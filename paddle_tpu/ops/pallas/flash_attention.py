@@ -334,24 +334,18 @@ def _fwd_mh(q, k, v, causal, block_q, block_k):
 
 # =========================== backward kernels ===========================
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
-                   scale, block_k, causal, seq_q, seq_k):
-    block_q = q_ref.shape[0]
-    d = q_ref.shape[1]
-    iq = pl.program_id(2)
+def _dq_loop(q, do, lse, delta, load_kv, *, iq, block_q, block_k, scale,
+             causal, seq_q, seq_k):
+    """Shared dQ recurrence (replays blocked logits from lse; bf16 dots,
+    f32 accumulation). One body for the per-head and all-heads-block dQ
+    kernels. load_kv(j) -> (k, v). Returns dq [block_q, d] f32."""
+    d = q.shape[-1]
     off = seq_k - seq_q
-
-    q = q_ref[:]
-    do = do_ref[:]
-    lse = lse_ref[:]  # [bq, 1] f32
-    delta = jnp.sum(do_ref[:].astype(jnp.float32) *
-                    o_ref[:].astype(jnp.float32), axis=1, keepdims=True)
     num_k_blocks = pl.cdiv(seq_k, block_k)
 
     def make_body(masked):
         def body(j, dq):
-            k = k_ref[pl.ds(j * block_k, block_k), :]
-            v = v_ref[pl.ds(j * block_k, block_k), :]
+            k, v = load_kv(j)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             s = s * scale
@@ -384,26 +378,56 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
     else:
         dq = jax.lax.fori_loop(0, num_k_blocks,
                                make_body(seq_k % block_k != 0), dq0)
+    return dq
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
+                   scale, block_k, causal, seq_q, seq_k):
+    block_q = q_ref.shape[0]
+    delta = jnp.sum(do_ref[:].astype(jnp.float32) *
+                    o_ref[:].astype(jnp.float32), axis=1, keepdims=True)
+    dq = _dq_loop(
+        q_ref[:], do_ref[:], lse_ref[:], delta,
+        lambda j: (k_ref[pl.ds(j * block_k, block_k), :],
+                   v_ref[pl.ds(j * block_k, block_k), :]),
+        iq=pl.program_id(2), block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
-                    dv_ref, *, scale, block_q, causal, seq_q, seq_k):
-    block_k = k_ref.shape[0]
-    d = k_ref.shape[1]
-    jk = pl.program_id(2)
-    off = seq_k - seq_q
+def _bwd_dq_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref,
+                      *, scale, block_k, causal, seq_q, seq_k, n_heads):
+    """All-heads-block dQ: [B,S,H,D] operands in place (see
+    _fwd_kernel_mh). q/o/do/dq refs: [block_q, H, D]; k/v: [seq_k, H, D];
+    lse: [H, block_q, 1]."""
+    block_q = q_ref.shape[0]
+    iq = pl.program_id(1)
+    for hh in range(n_heads):
+        do = do_ref[:, hh, :]
+        delta = jnp.sum(do.astype(jnp.float32) *
+                        o_ref[:, hh, :].astype(jnp.float32),
+                        axis=1, keepdims=True)
+        dq = _dq_loop(
+            q_ref[:, hh, :], do, lse_ref[hh, :, :], delta,
+            lambda j, hh=hh: (k_ref[pl.ds(j * block_k, block_k), hh, :],
+                              v_ref[pl.ds(j * block_k, block_k), hh, :]),
+            iq=iq, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        dq_ref[:, hh, :] = dq.astype(dq_ref.dtype)
 
-    k = k_ref[:]
-    v = v_ref[:]
+
+def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
+              seq_q, seq_k):
+    """Shared dK/dV recurrence. One body for the per-head and
+    all-heads-block dKV kernels. load_q(i) -> (q, do, o, lse) blocks.
+    Returns (dk, dv), each [block_k, d] f32."""
+    d = k.shape[-1]
+    off = seq_k - seq_q
 
     def make_body(masked):
         def body(i, carry):
             dk, dv = carry
-            q = q_ref[pl.ds(i * block_q, block_q), :]
-            do = do_ref[pl.ds(i * block_q, block_q), :]
-            o = o_ref[pl.ds(i * block_q, block_q), :]
-            lse = lse_ref[pl.ds(i * block_q, block_q), :]  # [bq, 1]
+            q, do, o, lse = load_q(i)
             delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                             axis=1, keepdims=True)
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -446,13 +470,94 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
         first_full = jnp.clip(first_full, start_block, num_iters)
         carry = jax.lax.fori_loop(start_block, first_full, make_body(True),
                                   carry)
-        dk, dv = jax.lax.fori_loop(first_full, num_iters,
-                                   make_body(tail_masked), carry)
-    else:
-        dk, dv = jax.lax.fori_loop(0, num_iters, make_body(tail_masked),
-                                   carry)
+        return jax.lax.fori_loop(first_full, num_iters,
+                                 make_body(tail_masked), carry)
+    return jax.lax.fori_loop(0, num_iters, make_body(tail_masked), carry)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
+                    dv_ref, *, scale, block_q, causal, seq_q, seq_k):
+    block_k = k_ref.shape[0]
+    dk, dv = _dkv_loop(
+        k_ref[:], v_ref[:],
+        lambda i: (q_ref[pl.ds(i * block_q, block_q), :],
+                   do_ref[pl.ds(i * block_q, block_q), :],
+                   o_ref[pl.ds(i * block_q, block_q), :],
+                   lse_ref[pl.ds(i * block_q, block_q), :]),
+        jk=pl.program_id(2), block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k)
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dkv_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
+                       dv_ref, *, scale, block_q, causal, seq_q, seq_k,
+                       n_heads):
+    """All-heads-block dK/dV: [B,S,H,D] operands in place. k/v/dk/dv
+    refs: [block_k, H, D]; q/do/o: [seq_q, H, D]; lse: [H, seq_q, 1]."""
+    block_k = k_ref.shape[0]
+    jk = pl.program_id(1)
+    for hh in range(n_heads):
+        dk, dv = _dkv_loop(
+            k_ref[:, hh, :], v_ref[:, hh, :],
+            lambda i, hh=hh: (
+                q_ref[pl.ds(i * block_q, block_q), hh, :],
+                do_ref[pl.ds(i * block_q, block_q), hh, :],
+                o_ref[pl.ds(i * block_q, block_q), hh, :],
+                lse_ref[hh, pl.ds(i * block_q, block_q), :]),
+            jk=jk, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        dk_ref[:, hh, :] = dk.astype(dk_ref.dtype)
+        dv_ref[:, hh, :] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_mh(q, k, v, out, lse, do, causal, block_q, block_k):
+    """Backward on [B,S,H,D] with zero layout changes (mh kernels).
+    Returns dq/dk/dv in [B,S,H,D]."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    dimsem = None
+    if not _interpret():
+        dimsem = pltpu.CompilerParams(dimension_semantics=(
+            pltpu.GridDimensionSemantics.PARALLEL,
+            pltpu.GridDimensionSemantics.ARBITRARY))
+    q_spec = pl.BlockSpec((None, block_q, h, d),
+                          lambda bi, i: (bi, i, 0, 0))
+    full_q = pl.BlockSpec((None, sq, h, d), lambda bi, i: (bi, 0, 0, 0))
+    k_full = pl.BlockSpec((None, sk, h, d), lambda bi, i: (bi, 0, 0, 0))
+    lse_spec = pl.BlockSpec((None, h, block_q, 1),
+                            lambda bi, i: (bi, 0, i, 0))
+    full_lse = pl.BlockSpec((None, h, sq, 1), lambda bi, i: (bi, 0, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_mh, scale=scale, block_k=block_k,
+                          causal=causal, seq_q=sq, seq_k=sk, n_heads=h),
+        grid=(b, pl.cdiv(sq, block_q)),
+        in_specs=[q_spec, k_full, k_full, q_spec, lse_spec, q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, sq, h, d), q.dtype),
+        interpret=_interpret(),
+        compiler_params=dimsem,
+    )(q, k, v, out, lse, do)
+
+    kv_spec = pl.BlockSpec((None, block_k, h, d),
+                           lambda bi, j: (bi, j, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_mh, scale=scale, block_q=block_q,
+                          causal=causal, seq_q=sq, seq_k=sk, n_heads=h),
+        grid=(b, pl.cdiv(sk, block_k)),
+        in_specs=[full_q, kv_spec, kv_spec, full_q, full_lse, full_q],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, sk, h, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, sk, h, d), v.dtype)],
+        interpret=_interpret(),
+        compiler_params=dimsem,
+    )(q, k, v, out, lse, do)
+
+    return dq, dk, dv
 
 
 def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k,
@@ -561,6 +666,35 @@ def _flash_core_bwd(causal, block_q, block_k, seq_q_real, seq_k_real,
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core_mh(q, k, v, causal, block_q, block_k):
+    """Transpose-free core: all-heads-block kernels end to end. Same
+    numerics as _flash_core (shared loop bodies); no [B,H,S,D] arrays
+    ever materialize. Selected by FLAGS_flash_layout=mh once the on-chip
+    A/B (tools/chip_session.py layout_ab) proves it faster."""
+    out, _ = _fwd_mh(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _flash_core_mh_fwd(q, k, v, causal, block_q, block_k):
+    out, lse = _fwd_mh(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_mh_bwd(causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    return _bwd_mh(q, k, v, out, lse, g, causal, block_q, block_k)
+
+
+_flash_core_mh.defvjp(_flash_core_mh_fwd, _flash_core_mh_bwd)
+
+
+def _mh_selected() -> bool:
+    import os
+
+    return os.environ.get("FLAGS_flash_layout", "transpose") == "mh"
+
+
 def _ref_attention(q, k, v, mask, is_causal):
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d)
@@ -661,4 +795,6 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
         out = _flash_core(q, k, v, bool(is_causal), block_q, block_k,
                           sq, sk)
         return out[:, :sq]
+    if _mh_selected():
+        return _flash_core_mh(q, k, v, bool(is_causal), block_q, block_k)
     return _flash_core(q, k, v, bool(is_causal), block_q, block_k)

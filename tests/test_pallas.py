@@ -338,3 +338,23 @@ def test_flash_padded_odd_lengths_match_reference(causal):
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_mh_backward_matches_transpose_path(causal):
+    """End-to-end mh core (fwd+bwd, zero layout changes) must produce the
+    same gradients as the transpose core — both share _dq_loop/_dkv_loop,
+    so any drift means the layouts plumb different data."""
+    B, S, H, D = 2, 128, 3, 32
+    q, k, v = _rand((B, S, H, D)), _rand((B, S, H, D)), _rand((B, S, H, D))
+
+    def loss(core, q_, k_, v_):
+        return (core(q_, k_, v_, causal, 64, 64)
+                .astype(jnp.float32) * 0.01).sum()
+
+    g_t = jax.grad(lambda *a: loss(fa._flash_core, *a),
+                   argnums=(0, 1, 2))(q, k, v)
+    g_mh = jax.grad(lambda *a: loss(fa._flash_core_mh, *a),
+                    argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_t, g_mh):
+        np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-6)

@@ -192,3 +192,16 @@ def test_flash_padded_vit_length_lowers():
 
     mlir = _lower_for_tpu(f, q, q, q)
     _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("shape", [(8, 1024, 12, 64), (2, 2048, 32, 128)])
+def test_flash_mh_bwd_lowers(shape):
+    b, s, h, d = shape
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_core_mh(q, k, v, True, 128, 128).astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    _assert_mosaic(mlir)

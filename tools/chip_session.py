@@ -123,21 +123,31 @@ def phase_sweep():
                 log("sweep", {"shape": shape_tag, "blocks": f"{bq}x{bk}",
                               "error": f"{type(e).__name__}: "
                                        f"{str(e)[:100]}"})
-        # layout A/B (fwd only): transpose path (incl. its transposes)
-        # vs the all-heads-in-block kernel reading [B,S,H,D] in place
+        # layout A/B: transpose core (incl. its transposes) vs the
+        # all-heads-block core reading/writing [B,S,H,D] in place —
+        # fwd and full fwd+bwd; the winner becomes FLAGS_flash_layout
         for bq, bk in ((512, 512), (256, 512), (1024, 1024)):
             try:
-                f_t = jax.jit(lambda x, bq=bq, bk=bk: FA._fwd(
-                    x, k, v, True, bq, bk)[0])
-                f_mh = jax.jit(lambda x, bq=bq, bk=bk: FA._fwd_mh(
-                    x, k, v, True, bq, bk)[0])
-                tt = slope(f_t, q)
-                tm = slope(f_mh, q)
+                f_t = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core(
+                    x, k, v, True, bq, bk))
+                f_mh = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core_mh(
+                    x, k, v, True, bq, bk))
+                g_t = jax.jit(jax.grad(
+                    lambda x, bq=bq, bk=bk: FA._flash_core(
+                        x, k, v, True, bq, bk).astype(jnp.float32).sum()))
+                g_mh = jax.jit(jax.grad(
+                    lambda x, bq=bq, bk=bk: FA._flash_core_mh(
+                        x, k, v, True, bq, bk).astype(jnp.float32).sum()))
+                tt, tm = slope(f_t, q), slope(f_mh, q)
+                gt, gm = slope(g_t, q), slope(g_mh, q)
                 log("layout_ab", {
                     "shape": shape_tag, "blocks": f"{bq}x{bk}",
                     "transpose_fwd_ms": round(tt * 1e3, 2),
                     "mh_fwd_ms": round(tm * 1e3, 2),
-                    "mh_speedup": round(tt / tm, 2)})
+                    "transpose_fwdbwd_ms": round(gt * 1e3, 2),
+                    "mh_fwdbwd_ms": round(gm * 1e3, 2),
+                    "mh_fwd_speedup": round(tt / tm, 2),
+                    "mh_fwdbwd_speedup": round(gt / gm, 2)})
             except Exception as e:
                 log("layout_ab", {"shape": shape_tag,
                                   "blocks": f"{bq}x{bk}",
