@@ -108,7 +108,11 @@ class LlamaAttention(nn.Layer):
                 q, k, v, kv_cache, cache_pos, attn_start)
             out = self.out_proj(out.reshape([b, s, q_size]))
             return out, new_cache
-        if self.num_kv_heads != self.num_heads:
+        if self.num_kv_heads != self.num_heads and \
+                self.cfg.context_parallel:
+            # ring attention still needs expanded KV; the flash/SDPA path
+            # reads GQA heads natively (grouped index maps — KV never
+            # expands in HBM, saving Hq/Hkv x of KV traffic)
             rep = self.num_heads // self.num_kv_heads
             k = ops.repeat_interleave(k, rep, axis=2)
             v = ops.repeat_interleave(v, rep, axis=2)

@@ -25,7 +25,11 @@ __all__ = [
 
 
 def _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale):
-    # q,k,v: [B, S, H, D] (paddle flash-attention layout)
+    # q,k,v: [B, S, H, D] (paddle flash-attention layout); GQA inputs
+    # (fewer KV heads) expand here — the Pallas path reads them grouped
+    from ...ops.pallas.flash_attention import _expand_gqa_kv
+
+    q, k, v = _expand_gqa_kv(q, k, v)
     d = q.shape[-1]
     scale = scale or (1.0 / math.sqrt(d))
     qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D

@@ -219,12 +219,21 @@ def _fwd_t(qt, kt, vt, causal, block_q, block_k, seq_q_real=None,
     """Forward on head-major [B,H,S,D] operands (the kernels' native
     layout). Returns (out_t [B,H,Sq,D], lse [B,H,Sq,1]).
 
+    GQA: kt/vt may carry fewer heads ([B,Hkv,S,D], Hq % Hkv == 0) — the
+    K/V index maps group query heads onto their KV head (hi // rep), so
+    the shrunken KV is read directly instead of materializing a
+    repeat_interleave'd copy (the reference expands; on TPU that
+    multiplies KV HBM traffic by the group size for nothing).
+
     seq_q_real/seq_k_real: logical lengths when the arrays are padded to
     a block-friendly multiple (odd ViT-style lengths, e.g. 197): the
     kernels mask on the REAL bounds (k_ids < seq_k), padded key rows
     never contribute, and the caller slices padded q rows off the
     output."""
     b, h, sq, d = qt.shape
+    h_kv = kt.shape[1]
+    assert h % h_kv == 0, (h, h_kv)
+    rep = h // h_kv
     sk = kt.shape[2]
     sq_r = seq_q_real or sq
     sk_r = seq_k_real or sk
@@ -239,8 +248,10 @@ def _fwd_t(qt, kt, vt, causal, block_q, block_k, seq_q_real=None,
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, sk, d),
+                         lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((None, None, sk, d),
+                         lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, None, block_q, d),
@@ -476,18 +487,30 @@ def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
-                    dv_ref, *, scale, block_q, causal, seq_q, seq_k):
+                    dv_ref, *, scale, block_q, causal, seq_q, seq_k, rep):
+    """Grid (b, h_kv, kv_blocks). q/do/o refs carry the KV head's GROUP
+    of `rep` query heads ([rep, seq_q, d]; lse [rep, seq_q, 1]): dK/dV
+    for a KV head sum the contributions of every query head it serves
+    (rep == 1 is plain MHA)."""
     block_k = k_ref.shape[0]
-    dk, dv = _dkv_loop(
-        k_ref[:], v_ref[:],
-        lambda i: (q_ref[pl.ds(i * block_q, block_q), :],
-                   do_ref[pl.ds(i * block_q, block_q), :],
-                   o_ref[pl.ds(i * block_q, block_q), :],
-                   lse_ref[pl.ds(i * block_q, block_q), :]),
-        jk=pl.program_id(2), block_q=block_q, block_k=block_k,
-        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k)
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    jk = pl.program_id(2)
+    k = k_ref[:]
+    v = v_ref[:]
+    dk_acc = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv_acc = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
+    for r in range(rep):
+        dk, dv = _dkv_loop(
+            k, v,
+            lambda i, r=r: (q_ref[r, pl.ds(i * block_q, block_q), :],
+                            do_ref[r, pl.ds(i * block_q, block_q), :],
+                            o_ref[r, pl.ds(i * block_q, block_q), :],
+                            lse_ref[r, pl.ds(i * block_q, block_q), :]),
+            jk=jk, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k)
+        dk_acc = dk_acc + dk
+        dv_acc = dv_acc + dv
+    dk_ref[:] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[:] = dv_acc.astype(dv_ref.dtype)
 
 
 def _bwd_dkv_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
@@ -572,6 +595,9 @@ def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k,
     bound loops/masks on the real lengths, so padded key rows contribute
     nothing and the caller slices padded grad rows off."""
     b, h, sq, d = qt.shape
+    h_kv = kt.shape[1]
+    assert h % h_kv == 0, (h, h_kv)
+    rep = h // h_kv
     sk = kt.shape[2]
     sq_r = seq_q_real or sq
     sk_r = seq_k_real or sk
@@ -581,12 +607,8 @@ def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k,
 
     q_spec = pl.BlockSpec((None, None, block_q, d),
                           lambda bi, hi, i: (bi, hi, i, 0))
-    full_q = pl.BlockSpec((None, None, sq, d),
-                          lambda bi, hi, i: (bi, hi, 0, 0))
-    full_lse = pl.BlockSpec((None, None, sq, 1),
-                            lambda bi, hi, i: (bi, hi, 0, 0))
     k_spec_full = pl.BlockSpec((None, None, sk, d),
-                               lambda bi, hi, i: (bi, hi, 0, 0))
+                               lambda bi, hi, i: (bi, hi // rep, 0, 0))
     lse_spec = pl.BlockSpec((None, None, block_q, 1),
                             lambda bi, hi, i: (bi, hi, i, 0))
 
@@ -601,16 +623,22 @@ def _bwd_t(qt, kt, vt, ot, lse, dot, causal, block_q, block_k,
         compiler_params=_compiler_params(),
     )(qt, kt, vt, ot, lse, dot)
 
+    # dK/dV: grid over KV heads; each instance reads its whole group of
+    # `rep` query heads (block dim1 = rep, block-unit index hi)
+    group_q = pl.BlockSpec((None, rep, sq, d),
+                           lambda bi, hi, j: (bi, hi, 0, 0))
+    group_lse = pl.BlockSpec((None, rep, sq, 1),
+                             lambda bi, hi, j: (bi, hi, 0, 0))
     kv_spec = pl.BlockSpec((None, None, block_k, d),
                            lambda bi, hi, j: (bi, hi, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          causal=causal, seq_q=sq_r, seq_k=sk_r),
-        grid=(b, h, pl.cdiv(sk, block_k)),
-        in_specs=[full_q, kv_spec, kv_spec, full_q, full_lse, full_q],
+                          causal=causal, seq_q=sq_r, seq_k=sk_r, rep=rep),
+        grid=(b, h_kv, pl.cdiv(sk, block_k)),
+        in_specs=[group_q, kv_spec, kv_spec, group_q, group_lse, group_q],
         out_specs=[kv_spec, kv_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), kt.dtype),
-                   jax.ShapeDtypeStruct((b, h, sk, d), vt.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h_kv, sk, d), kt.dtype),
+                   jax.ShapeDtypeStruct((b, h_kv, sk, d), vt.dtype)],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(qt, kt, vt, ot, lse, dot)
@@ -695,8 +723,21 @@ def _mh_selected() -> bool:
     return os.environ.get("FLAGS_flash_layout", "transpose") == "mh"
 
 
+def _expand_gqa_kv(q, k, v):
+    """Expand GQA KV heads to the query head count (consecutive-group
+    semantics, matching the kernels' `hi // rep` maps). The ONE shared
+    expansion used by every non-grouped path."""
+    if k.shape[2] != q.shape[2]:
+        assert q.shape[2] % k.shape[2] == 0, (q.shape, k.shape)
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return q, k, v
+
+
 def _ref_attention(q, k, v, mask, is_causal):
     d = q.shape[-1]
+    q, k, v = _expand_gqa_kv(q, k, v)
     scale = 1.0 / math.sqrt(d)
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
     kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
@@ -716,7 +757,7 @@ def _ref_attention(q, k, v, mask, is_causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
+def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None):
     """Autotuned (block_q, block_k) for this attention signature
     (paddle/phi/kernels/autotune role; cached per signature on disk).
 
@@ -735,10 +776,15 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
 
     def vmem_est(bq, bk):
         # f32 logits block (s and p live together) + full K/V + q/o/acc;
-        # must leave headroom in the ~16 MB/core VMEM budget
+        # must leave headroom in the ~16 MB/core VMEM budget. GQA: the
+        # grouped dK/dV kernel additionally keeps rep x seq_q x d of
+        # q/o/do resident (block-size independent, but it eats the same
+        # budget the logits compete for).
         itemsize = jnp.dtype(dtype).itemsize
+        group = (3 * (h // h_kv) * sq * d * itemsize
+                 if h_kv and h_kv != h else 0)
         return (2 * bq * bk * 4 + 2 * sk * d * itemsize
-                + 2 * bq * d * itemsize + bq * d * 4)
+                + 2 * bq * d * itemsize + bq * d * 4 + group)
 
     cands = [(bq, bk)
              for bq, bk in pairs
@@ -752,9 +798,10 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
     def run(cfg):
         # concrete dummy data, same signature; compiled eagerly per config
         rs = np.random.RandomState(0)
+        hk = h_kv or h
         qv = jnp.asarray(rs.randn(b, sq, h, d), dtype)
-        kv = jnp.asarray(rs.randn(b, sk, h, d), dtype)
-        vv = jnp.asarray(rs.randn(b, sk, h, d), dtype)
+        kv = jnp.asarray(rs.randn(b, sk, hk, d), dtype)
+        vv = jnp.asarray(rs.randn(b, sk, hk, d), dtype)
 
         def loss(qv):
             return _flash_core(qv, kv, vv, causal, cfg[0],
@@ -762,7 +809,8 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal):
 
         return jax.grad(loss)(qv)
 
-    sig = f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
+    sig = (f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
+           + (f"|kv{h_kv}" if h_kv and h_kv != h else ""))
     return autotune.pick("flash_fwdbwd", sig, cands, run, default)
 
 
@@ -777,6 +825,16 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
     via the custom VJP's real-length bounds)."""
     if mask is not None or not flash_attention_available(q):
         return _ref_attention(q, k, v, mask, is_causal)
+    if k.shape[2] != q.shape[2]:
+        # GQA feasibility: the grouped dK/dV kernel keeps a KV head's
+        # whole query group (rep x seq_q x d of q, o, do) resident in
+        # VMEM; past the budget, fall back to expanded-KV MHA kernels
+        # (correct, just without the KV-traffic saving) rather than
+        # compile an infeasible kernel
+        rep = q.shape[2] // k.shape[2]
+        group_bytes = 3 * rep * q.shape[1] * q.shape[3] * q.dtype.itemsize
+        if group_bytes > 8 * 1024 * 1024:
+            q, k, v = _expand_gqa_kv(q, k, v)
     sq, sk = q.shape[1], k.shape[1]
     pad_q = (-sq) % 8
     pad_k = (-sk) % 8
@@ -788,13 +846,14 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
     if block_q is None or block_k is None:
         bq, bk = _tuned_blocks(q.shape[0], q.shape[1], k.shape[1],
                                q.shape[2], q.shape[3], q.dtype,
-                               bool(is_causal))
+                               bool(is_causal), h_kv=k.shape[2])
         block_q = block_q or bq
         block_k = block_k or bk
     if pad_q or pad_k:
         out = _flash_core(q, k, v, bool(is_causal), block_q, block_k,
                           sq, sk)
         return out[:, :sq]
-    if _mh_selected():
+    if _mh_selected() and k.shape[2] == q.shape[2]:
+        # the mh core is MHA-only; GQA takes the grouped transpose core
         return _flash_core_mh(q, k, v, bool(is_causal), block_q, block_k)
     return _flash_core(q, k, v, bool(is_causal), block_q, block_k)

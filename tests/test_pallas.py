@@ -358,3 +358,35 @@ def test_flash_mh_backward_matches_transpose_path(causal):
                     argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_t, g_mh):
         np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_expanded_reference(causal):
+    """GQA-native kernels (Hkv < Hq, grouped via index maps — KV never
+    expands in memory): values and grads must equal running the expanded
+    MHA reference; dk/dv come back at the KV head count, equal to the
+    group-summed expanded grads."""
+    B, S, HQ, HKV, D = 2, 128, 4, 2, 32
+    rep = HQ // HKV
+    q = _rand((B, S, HQ, D))
+    k = _rand((B, S, HKV, D))
+    v = _rand((B, S, HKV, D))
+    out = fa._flash_core(q, k, v, causal, 64, 64)
+    ref = fa._ref_attention(q, k, v, None, causal)  # expands internally
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q_, k_, v_):
+        o = fa._flash_core(q_, k_, v_, causal, 64, 64)
+        return (o.astype(jnp.float32) * 0.01).sum()
+
+    def loss_ref(q_, k_, v_):
+        ke = jnp.repeat(k_, rep, axis=2)
+        ve = jnp.repeat(v_, rep, axis=2)
+        o = fa._ref_attention(q_, ke, ve, None, causal)
+        return (o.astype(jnp.float32) * 0.01).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert gf[1].shape == (B, S, HKV, D)  # grads at KV head count
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=3e-5, rtol=3e-5)

@@ -205,3 +205,19 @@ def test_flash_mh_bwd_lowers(shape):
 
     mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
     _assert_mosaic(mlir)
+
+
+@pytest.mark.parametrize("shape", [(4, 2048, 32, 8, 128)])
+def test_flash_gqa_lowers(shape):
+    """LLaMA-2/3-class GQA (32 query / 8 KV heads): grouped index maps
+    must lower for both directions."""
+    b, s, hq, hkv, d = shape
+    q = jax.ShapeDtypeStruct((b, s, hq, d), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((b, s, hkv, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_core(q, k, v, True, 128, 128).astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, kv, kv)
+    _assert_mosaic(mlir)
