@@ -1,4 +1,5 @@
 """paddle.distributed parity surface, TPU-native (SURVEY §2.2, §2.5)."""
+from . import completion  # noqa: F401  (sharding/reshard ground truth)
 from . import fleet  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .auto_parallel import (  # noqa: F401

@@ -20,6 +20,7 @@ from ...core.dispatch import apply, op
 
 __all__ = [
     "scaled_dot_product_attention", "flash_attention",
+    "flash_attn_unpadded",
     "fused_rotary_position_embedding", "apply_rotary_pos_emb",
 ]
 
@@ -73,6 +74,66 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                                        causal, training)
     if return_softmax:
         return out, None
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="",
+                        training=True, name=None):
+    """Packed ragged-batch attention (reference
+    `flash_attention.py:302`): query/key/value [total_seq_len, H, D],
+    cu_seqlens_* [n+1] cumulative lengths. Returns (out, softmax) with
+    softmax None unless return_softmax (never materialized here).
+
+    TPU-first: segment-masked Pallas kernels
+    (`ops.pallas.varlen_attention`) — the ragged batch runs
+    block-diagonal with static shapes; no per-sequence loop, no T x T
+    mask. Attention-probability dropout is not applied on this path
+    (the fused kernel never materializes probabilities); `dropout` is
+    accepted for signature parity.
+    """
+    if return_softmax:
+        raise NotImplementedError(
+            "flash_attn_unpadded: return_softmax=True would materialize "
+            "the T x T probabilities the fused kernel exists to avoid")
+    if dropout and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention-probability dropout is not "
+            "applied on the fused path (probabilities never materialize); "
+            "pass dropout=0 and regularize elsewhere, or use "
+            "scaled_dot_product_attention's reference path")
+    if causal:
+        # per-sequence causal alignment needs IDENTICAL packings: the
+        # kernel's one global diagonal offset cannot express the
+        # reference's bottom-right alignment across differently-packed
+        # q/k (e.g. chunked prefill) — fail loudly, never silently
+        import numpy as _np
+
+        try:
+            cq = _np.asarray(cu_seqlens_q.numpy()
+                             if hasattr(cu_seqlens_q, "numpy")
+                             else cu_seqlens_q)
+            ck = _np.asarray(cu_seqlens_k.numpy()
+                             if hasattr(cu_seqlens_k, "numpy")
+                             else cu_seqlens_k)
+            same = cq.shape == ck.shape and bool((cq == ck).all())
+        except Exception:
+            same = None  # traced values: cannot validate here
+        if same is False:
+            raise NotImplementedError(
+                "flash_attn_unpadded: causal=True requires identical "
+                "cu_seqlens_q and cu_seqlens_k (per-sequence causal "
+                "alignment across different packings is not supported)")
+    from ...ops.pallas.varlen_attention import varlen_attention
+
+    def f(q, k, v, cu_q, cu_k):
+        return varlen_attention(q, k, v, cu_q, cu_k, scale=scale,
+                                causal=causal)
+
+    out = apply("flash_attn_unpadded", f, query, key, value,
+                cu_seqlens_q, cu_seqlens_k)
     return out, None
 
 

@@ -115,7 +115,7 @@ def flash_attention_available(q) -> bool:
 # =========================== forward kernel ===========================
 
 def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
-                    seq_q, seq_k):
+                    seq_q, seq_k, seg_q=None, load_seg_k=None):
     """The shared flash recurrence: walk KV blocks with f32 running
     max/sum/acc; logits never materialize in HBM. One body for BOTH
     forward kernels (per-head transpose layout and all-heads block) —
@@ -129,10 +129,17 @@ def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
     Returns (out [block_q, d] f32, lse [block_q, 1] f32); stats are
     rank-2 — a rank-1 (block_q,) block does not lower to Mosaic
     (VERDICT r2 missing #2).
+
+    seg_q/load_seg_k: varlen packed mode — segment ids ([block_q, 1] and
+    per-block [block_k, 1]); positions attend only within their segment,
+    so ragged batches run block-diagonal WITHOUT a T x T mask ever
+    materializing (flash_attn_unpadded). Segment boundaries can cut any
+    block, so every block runs the masked body in this mode.
     """
     d = q.shape[-1]
     off = seq_k - seq_q  # causal diagonal offset (0 for self-attention)
     num_k_blocks = pl.cdiv(seq_k, block_k)
+    segmented = seg_q is not None
 
     def make_body(masked):
         def body(j, carry):
@@ -149,6 +156,10 @@ def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
                 valid = k_ids < seq_k
                 if causal:
                     valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                if segmented:
+                    seg_k = load_seg_k(j)  # [block_k, 1]
+                    valid = jnp.logical_and(
+                        valid, seg_q == seg_k.reshape(1, block_k))
                 s = jnp.where(valid, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
             p = jnp.exp(s - m_new)
@@ -166,16 +177,23 @@ def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
     if causal:
         # blocks with max k_id <= min q_id + off are fully unmasked:
         # mask-free body; the diagonal remainder runs the masked body.
+        # (Segmented mode: boundaries cut anywhere, all blocks masked.)
         num_full = jnp.clip((iq * block_q + off + 1) // block_k,
                             0, num_k_blocks)
         num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
                              num_full, num_k_blocks)
-        carry = jax.lax.fori_loop(0, num_full, make_body(False), carry0)
-        m, l, acc = jax.lax.fori_loop(num_full, num_iters, make_body(True),
-                                      carry)
+        if segmented:
+            m, l, acc = jax.lax.fori_loop(0, num_iters, make_body(True),
+                                          carry0)
+        else:
+            carry = jax.lax.fori_loop(0, num_full, make_body(False),
+                                      carry0)
+            m, l, acc = jax.lax.fori_loop(num_full, num_iters,
+                                          make_body(True), carry)
     else:
         m, l, acc = jax.lax.fori_loop(
-            0, num_k_blocks, make_body(seq_k % block_k != 0), carry0)
+            0, num_k_blocks,
+            make_body(segmented or seq_k % block_k != 0), carry0)
     l_safe = jnp.maximum(l, 1e-30)
     return acc / l_safe, m + jnp.log(l_safe)
 
@@ -346,13 +364,15 @@ def _fwd_mh(q, k, v, causal, block_q, block_k):
 # =========================== backward kernels ===========================
 
 def _dq_loop(q, do, lse, delta, load_kv, *, iq, block_q, block_k, scale,
-             causal, seq_q, seq_k):
+             causal, seq_q, seq_k, seg_q=None, load_seg_k=None):
     """Shared dQ recurrence (replays blocked logits from lse; bf16 dots,
     f32 accumulation). One body for the per-head and all-heads-block dQ
-    kernels. load_kv(j) -> (k, v). Returns dq [block_q, d] f32."""
+    kernels. load_kv(j) -> (k, v). Returns dq [block_q, d] f32.
+    seg_q/load_seg_k: varlen segment ids (see _online_softmax)."""
     d = q.shape[-1]
     off = seq_k - seq_q
     num_k_blocks = pl.cdiv(seq_k, block_k)
+    segmented = seg_q is not None
 
     def make_body(masked):
         def body(j, dq):
@@ -368,6 +388,10 @@ def _dq_loop(q, do, lse, delta, load_kv, *, iq, block_q, block_k, scale,
                 valid = k_ids < seq_k
                 if causal:
                     valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                if segmented:
+                    seg_k = load_seg_k(j)
+                    valid = jnp.logical_and(
+                        valid, seg_q == seg_k.reshape(1, block_k))
                 s = jnp.where(valid, s, NEG_INF)
             p = jnp.exp(s - lse)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -384,11 +408,16 @@ def _dq_loop(q, do, lse, delta, load_kv, *, iq, block_q, block_k, scale,
                             0, num_k_blocks)
         num_iters = jnp.clip(pl.cdiv((iq + 1) * block_q + off, block_k),
                              num_full, num_k_blocks)
-        dq = jax.lax.fori_loop(0, num_full, make_body(False), dq0)
-        dq = jax.lax.fori_loop(num_full, num_iters, make_body(True), dq)
+        if segmented:
+            dq = jax.lax.fori_loop(0, num_iters, make_body(True), dq0)
+        else:
+            dq = jax.lax.fori_loop(0, num_full, make_body(False), dq0)
+            dq = jax.lax.fori_loop(num_full, num_iters, make_body(True),
+                                   dq)
     else:
         dq = jax.lax.fori_loop(0, num_k_blocks,
-                               make_body(seq_k % block_k != 0), dq0)
+                               make_body(segmented or
+                                         seq_k % block_k != 0), dq0)
     return dq
 
 
@@ -428,12 +457,14 @@ def _bwd_dq_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref,
 
 
 def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
-              seq_q, seq_k):
+              seq_q, seq_k, seg_k=None, load_seg_q=None):
     """Shared dK/dV recurrence. One body for the per-head and
     all-heads-block dKV kernels. load_q(i) -> (q, do, o, lse) blocks.
-    Returns (dk, dv), each [block_k, d] f32."""
+    Returns (dk, dv), each [block_k, d] f32.
+    seg_k/load_seg_q: varlen segment ids (see _online_softmax)."""
     d = k.shape[-1]
     off = seq_k - seq_q
+    segmented = seg_k is not None
 
     def make_body(masked):
         def body(i, carry):
@@ -452,6 +483,10 @@ def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
                 valid = q_ids < seq_q
                 if causal:
                     valid = jnp.logical_and(valid, q_ids + off >= k_ids)
+                if segmented:
+                    seg_q = load_seg_q(i)  # [block_q, 1]
+                    valid = jnp.logical_and(
+                        valid, seg_q == seg_k.reshape(1, block_k))
                 s = jnp.where(valid, s, NEG_INF)
             p = jnp.exp(s - lse)
             pc = p.astype(do.dtype)
@@ -470,11 +505,12 @@ def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
     num_iters = pl.cdiv(seq_q, block_q)
     carry = (jnp.zeros((block_k, d), jnp.float32),
              jnp.zeros((block_k, d), jnp.float32))
-    tail_masked = seq_q % block_q != 0
+    tail_masked = segmented or seq_q % block_q != 0
     if causal:
         # bottom-right alignment: kv block jk is seen by q rows
         # >= jk*block_k - off. q blocks with min q_id + off >= max k_id
         # are fully unmasked; between the diagonal and there runs masked.
+        # (Segmented mode: boundaries cut anywhere, all blocks masked.)
         start_block = jnp.clip((jk * block_k - off) // block_q,
                                0, num_iters)
         first_full = -(-((jk + 1) * block_k - 1 - off) // block_q)  # ceil

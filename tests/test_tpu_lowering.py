@@ -221,3 +221,20 @@ def test_flash_gqa_lowers(shape):
 
     mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, kv, kv)
     _assert_mosaic(mlir)
+
+
+def test_varlen_attention_lowers():
+    """Segment-masked packed attention (flash_attn_unpadded role) must
+    lower for both directions at a real packed size."""
+    from paddle_tpu.ops.pallas import varlen_attention as vla
+
+    T, H, D = 4096, 12, 64
+    cu = jnp.asarray([0, 1024, 2560, 4096], jnp.int32)
+    q = jax.ShapeDtypeStruct((T, H, D), jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = vla.varlen_attention(q, k, v, cu, cu, causal=True)
+        return jnp.sum(o.astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    _assert_mosaic(mlir)
