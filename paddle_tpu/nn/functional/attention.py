@@ -11,6 +11,7 @@ implementation with identical semantics.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 
 import jax
@@ -20,9 +21,31 @@ from ...core.dispatch import apply, op
 
 __all__ = [
     "scaled_dot_product_attention", "flash_attention",
-    "flash_attn_unpadded",
+    "flash_attn_unpadded", "sdp_kernel",
     "fused_rotary_position_embedding", "apply_rotary_pos_emb",
 ]
+
+# sdp_kernel() dispatch policy (reference flash_attention.py:27): which
+# backends scaled_dot_product_attention may pick. On TPU there are two
+# real tiers: the Pallas flash kernel and the jnp math path (the
+# mem_efficient flag maps onto flash — one fused tier owns both roles).
+_sdp_policy = {"math": True, "flash": True}
+
+
+@contextlib.contextmanager
+def sdp_kernel(enable_math=False, enable_flash=True,
+               enable_mem_efficient=True):
+    """Constrain scaled_dot_product_attention's kernel choice inside the
+    context (reference sdp_kernel). enable_flash/enable_mem_efficient
+    both gate the fused Pallas tier; enable_math the jnp reference."""
+    global _sdp_policy
+    old = _sdp_policy
+    _sdp_policy = {"math": bool(enable_math),
+                   "flash": bool(enable_flash or enable_mem_efficient)}
+    try:
+        yield
+    finally:
+        _sdp_policy = old
 
 
 def _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale):
@@ -59,8 +82,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     from ...ops import pallas as _pl
 
     def f(q, k, v, m):
-        if _pl.flash_attention_available(q):
+        if _sdp_policy["flash"] and _pl.flash_attention_available(q):
             return _pl.flash_attention_fwd(q, k, v, m, is_causal)
+        if not _sdp_policy["math"] and not _sdp_policy["flash"]:
+            raise RuntimeError(
+                "sdp_kernel: every backend disabled for "
+                "scaled_dot_product_attention")
         return _sdpa_ref(q, k, v, m, dropout_p, is_causal, None)
 
     return apply("scaled_dot_product_attention", f, query, key, value,

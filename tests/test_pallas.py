@@ -489,3 +489,25 @@ def test_flash_attn_unpadded_rejects_unsupported():
     out, _ = F.flash_attn_unpadded(q, q, q, cu_a, cu_a, dropout=0.1,
                                    training=False, **kw)
     assert np.isfinite(out.numpy()).all()
+
+
+def test_sdp_kernel_policy_context():
+    """sdp_kernel() (reference flash_attention.py:27): constrains which
+    backend scaled_dot_product_attention picks; restores on exit; all
+    backends disabled is a loud error."""
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional import attention as attn_mod
+
+    x = P.to_tensor(np.random.RandomState(0)
+                    .randn(1, 16, 2, 16).astype(np.float32))
+    with F.sdp_kernel(enable_math=True, enable_flash=False,
+                      enable_mem_efficient=False):
+        assert attn_mod._sdp_policy == {"math": True, "flash": False}
+        out = F.scaled_dot_product_attention(x, x, x, is_causal=True)
+        assert np.isfinite(out.numpy()).all()
+    assert attn_mod._sdp_policy == {"math": True, "flash": True}
+    with pytest.raises(RuntimeError, match="backend"):
+        with F.sdp_kernel(enable_math=False, enable_flash=False,
+                          enable_mem_efficient=False):
+            F.scaled_dot_product_attention(x, x, x, is_causal=True)
