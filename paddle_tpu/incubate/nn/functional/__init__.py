@@ -203,18 +203,44 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
                  sequence_lengths, src_mask)
 
 
-def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+def variable_length_memory_efficient_attention(query, key, value,
+                                               seq_lens=None,
                                                kv_seq_lens=None, mask=None,
-                                               scale=None, causal=False):
-    from ....nn.functional.attention import scaled_dot_product_attention
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """Reference `variable_length_memory_efficient_attention` (cutlass
+    memory-efficient varlen kernel role): [B, H, S, D] inputs, per-row
+    valid lengths. Keys/values beyond `kv_seq_lens[b]` never contribute
+    (additive -inf fold); query rows beyond `seq_lens[b]` compute
+    don't-care outputs exactly like the reference kernel. Explicit
+    `scale` folds into q."""
+    import math as _math
 
-    # [B,H,S,D] reference layout -> [B,S,H,D]
-    q = query.transpose([0, 2, 1, 3])
-    k = key.transpose([0, 2, 1, 3])
-    v = value.transpose([0, 2, 1, 3])
-    out = scaled_dot_product_attention(q, k, v, attn_mask=mask,
-                                       is_causal=causal)
-    return out.transpose([0, 2, 1, 3])
+    from ....core.dispatch import apply
+
+    def f(qv, kv, vv, sl, kvl, mk):
+        b, h, sq, d = qv.shape
+        sk = kv.shape[2]
+        if scale is not None:
+            qv = qv * jnp.asarray(scale * _math.sqrt(d), qv.dtype)
+        add = None
+        if mk is not None:
+            add = mk.astype(jnp.float32)
+        if kvl is not None:
+            valid_k = jnp.arange(sk)[None, None, None, :] < \
+                jnp.reshape(kvl, (b, 1, 1, 1))
+            lmask = jnp.where(valid_k, 0.0, -1e30).astype(jnp.float32)
+            add = lmask if add is None else add + lmask
+        from ....ops.pallas.flash_attention import _ref_attention
+
+        # [B,H,S,D] -> [B,S,H,D] for the attention body
+        out = _ref_attention(jnp.swapaxes(qv, 1, 2),
+                             jnp.swapaxes(kv, 1, 2),
+                             jnp.swapaxes(vv, 1, 2), add, causal)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply("variable_length_memory_efficient_attention", f,
+                 query, key, value, seq_lens, kv_seq_lens, mask)
 
 
 

@@ -80,3 +80,39 @@ def test_block_multihead_attention_decode_matches_dense():
     # the new token landed in the right physical block slot
     kc2 = np.asarray(kc2.numpy())
     assert np.allclose(kc2[bt[0, 1], :, 1], kn[0])  # seq0: pos5 -> blk1 slot1
+
+
+def test_variable_length_memory_efficient_attention_lengths():
+    """Per-row kv lengths must actually mask (r4 fix: seq_lens were
+    silently ignored): row 0 truncated to 3 keys == dense attention on
+    the 3-key prefix; explicit scale honored."""
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rs = np.random.RandomState(0)
+    B, H, S, D = 2, 2, 8, 16
+    q = P.to_tensor(rs.randn(B, H, S, D).astype(np.float32))
+    k = P.to_tensor(rs.randn(B, H, S, D).astype(np.float32))
+    v = P.to_tensor(rs.randn(B, H, S, D).astype(np.float32))
+    kv_lens = P.to_tensor(np.array([3, 8], np.int32))
+    scale = 0.31
+    out = IF.variable_length_memory_efficient_attention(
+        q, k, v, seq_lens=kv_lens, kv_seq_lens=kv_lens, scale=scale)
+    o = np.asarray(out.numpy())
+
+    def dense(qr, kr, vr):
+        logits = np.einsum("hqd,hkd->hqk", qr, kr) * scale
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("hqk,hkd->hqd", p, vr)
+
+    qn, kn, vn = (np.asarray(t.numpy()) for t in (q, k, v))
+    # row 0: only first 3 keys participate
+    np.testing.assert_allclose(
+        o[0], dense(qn[0], kn[0, :, :3], vn[0, :, :3]), rtol=2e-5,
+        atol=2e-5)
+    # row 1: full length
+    np.testing.assert_allclose(o[1], dense(qn[1], kn[1], vn[1]),
+                               rtol=2e-5, atol=2e-5)
