@@ -26,6 +26,10 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1, bias=None, residual=None,
                    quant_scale=-1, **kw):
     """fused_rms_norm parity (residual-add + bias + rmsnorm in one op)."""
+    if quant_scale not in (-1, None):
+        raise NotImplementedError(
+            "fused_rms_norm: quantized output (quant_scale) is not "
+            "supported — quantize with nn.quant after the norm")
     def f(xv, w, b, bias_v, res):
         from ....ops.pallas.fused_norm import (
             fused_norm_available, fused_norm_pallas,
@@ -119,6 +123,8 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
                             activation="gelu"):
+    if trans_x:
+        x = x.T
     out = fused_linear(x, y, bias, trans_y)
     from ....nn import functional as F
 
@@ -266,6 +272,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         dropout as _dropout, scaled_dot_product_attention as _sdpa,
     )
 
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: cache_kv decode is served by "
+            "masked_multihead_attention / block_multihead_attention")
     residual = x
     if pre_layer_norm and ln_scale is not None or pre_ln_scale is not None:
         out = fused_layer_norm(x, pre_ln_scale, pre_ln_bias,
@@ -335,6 +345,14 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             trans_qkvw=True, ring_id=-1, name=None):
     """N pre-LN decoder layers over packed per-layer weight lists
     (fused_multi_transformer_op role)."""
+    if cache_kvs is not None or time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: cached decode is served by "
+            "masked_multihead_attention / models.generate")
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "fused_multi_transformer: trans_qkvw=False (untransposed qkv "
+            "weights) is not supported — pass [3, H, D, hidden] weights")
     out = x
     for i in range(len(qkv_weights)):
         out = fused_multi_head_attention(
@@ -344,7 +362,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             qkv_bias=qkv_biases[i] if qkv_biases else None,
             linear_bias=linear_biases[i] if linear_biases else None,
             attn_mask=attn_mask, dropout_rate=dropout_rate,
-            attn_dropout_rate=dropout_rate, training=training)
+            attn_dropout_rate=dropout_rate, training=training,
+            pre_ln_epsilon=epsilon, ln_epsilon=epsilon)
         out = fused_feedforward(
             out, ffn1_weights[i], ffn2_weights[i],
             linear1_bias=ffn1_biases[i] if ffn1_biases else None,
@@ -352,7 +371,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             ln1_scale=ffn_ln_scales[i],
             ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
-            activation=activation, pre_layer_norm=True, training=training)
+            activation=activation, pre_layer_norm=True, training=training,
+            ln1_epsilon=epsilon)
     return out
 
 
@@ -430,14 +450,41 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         raise NotImplementedError(
             "block_multihead_attention: int8/smooth-quant cache scales "
             "are not supported (no int8 cache tier in this build)")
+    if rope_emb is not None or tgt_mask is not None or \
+            pre_key_cache is not None or pre_value_cache is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: rope_emb/tgt_mask/pre-caches are "
+            "not supported — apply RoPE before the qkv fuse and use "
+            "mask= for attention masking")
+    # decode-step subset: a PREFILL batch (nonzero encoder lens) would
+    # silently compute garbage — fail loudly when detectable (concrete
+    # eager values; traced values are the caller's contract)
+    if seq_lens_encoder is not None:
+        try:
+            import numpy as _np
+
+            enc = _np.asarray(seq_lens_encoder.numpy()
+                              if hasattr(seq_lens_encoder, "numpy")
+                              else seq_lens_encoder)
+            if (enc > 0).any():
+                raise NotImplementedError(
+                    "block_multihead_attention: prefill (nonzero "
+                    "seq_lens_encoder) is not supported — prefill with "
+                    "the dense flash path, decode here")
+        except NotImplementedError:
+            raise
+        except Exception:
+            pass
 
     from ....core.dispatch import apply
     import jax
     import jax.numpy as jnp
 
-    def f(qkv_v, kc, vc, dec_lens, bt):
+    def f(qkv_v, kc, vc, dec_lens, bt, qb):
         b = qkv_v.shape[0]
         nb, h, bs, d = kc.shape
+        if qb is not None:
+            qkv_v = qkv_v + qb.reshape(-1)
         qkv3 = qkv_v.reshape(b, 3, h, d)
         q, k_new, v_new = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
         lens = dec_lens.reshape(-1).astype(jnp.int32)   # tokens already cached
@@ -465,7 +512,7 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         return (out.reshape(b, h * d).astype(qkv_v.dtype), kc, vc)
 
     return apply("block_multihead_attention", f, qkv, key_cache,
-                 value_cache, seq_lens_decoder, block_tables)
+                 value_cache, seq_lens_decoder, block_tables, qkv_bias)
 
 
 __all__ += [

@@ -183,6 +183,10 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     name=None):
     """q/k/v: [B, S, H, D]. Matches incubate.nn.functional.
     fused_rotary_position_embedding semantics (fused_rope_kernel.cu)."""
+    if time_major:
+        raise NotImplementedError(
+            "fused_rotary_position_embedding: time_major=True ([S, B, ...]"
+            " layout) is not supported — pass batch-major tensors")
     b, s, h, d = q.shape
     if sin is None or cos is None:
         inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))

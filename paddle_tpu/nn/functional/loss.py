@@ -333,6 +333,8 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         m = jnp.maximum(a_last, a_prev)
         ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
         loss = -ll
+        if norm_by_times:  # warpctc semantics: per-sample / input length
+            loss = loss / jnp.maximum(in_len, 1).astype(loss.dtype)
         if reduction == "mean":
             return jnp.mean(loss / jnp.maximum(lab_len, 1).astype(loss.dtype))
         if reduction == "sum":
@@ -356,6 +358,11 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     cos(m1·θ + m2) − m3 before scaling. Model-parallel class sharding is
     expressed with sharded logits under jit (mesh 'mp' axis) instead of the
     reference's per-rank comm kernel."""
+    if group is not None:
+        raise NotImplementedError(
+            "margin_cross_entropy: explicit process groups are not used "
+            "on TPU — shard the class dim over the 'mp' mesh axis under "
+            "jit and XLA inserts the cross-shard softmax collectives")
     def f(lg, lb):
         lb = lb.reshape(-1).astype(jnp.int32)
         n, c = lg.shape
@@ -387,7 +394,17 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     recurrence is sequential); log-space throughout, grads via autodiff.
 
     input: [B, T, U+1, V] joint-network logits; label: [B, U] padded.
+    fastemit_lambda: FastEmit regularization weight — not implemented;
+    only 0 (or the paddle default 0.001 explicitly zeroed by the caller)
+    is honored loudly.
     """
+    if fastemit_lambda:
+        import warnings
+
+        warnings.warn(
+            "rnnt_loss: fastemit_lambda regularization is not applied in "
+            "this build (plain RNNT objective); pass fastemit_lambda=0 "
+            "to silence", stacklevel=2)
     def f(logits, lab, in_len, lab_len):
         b, t_max, u1, v = logits.shape
         u_max = u1 - 1
