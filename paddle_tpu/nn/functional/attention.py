@@ -81,9 +81,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """Inputs [batch, seq, heads, head_dim] (reference layout)."""
     from ...ops import pallas as _pl
 
+    # masks that need no gradient may stream through the biased fused
+    # kernels; a trainable mask (stop_gradient=False) keeps the
+    # reference path, which differentiates through the bias
+    # default FALSE for attribute-less masks (raw arrays/tracers):
+    # routing an unknown mask to the zero-cotangent biased kernel would
+    # silently kill a trainable bias's gradient
+    mask_sg = attn_mask is None or bool(
+        getattr(attn_mask, "stop_gradient", False))
+
     def f(q, k, v, m):
         if _sdp_policy["flash"] and _pl.flash_attention_available(q):
-            return _pl.flash_attention_fwd(q, k, v, m, is_causal)
+            return _pl.flash_attention_fwd(q, k, v, m, is_causal,
+                                           bias_grad_safe=mask_sg)
         if not _sdp_policy["math"] and not _sdp_policy["flash"]:
             raise RuntimeError(
                 "sdp_kernel: every backend disabled for "

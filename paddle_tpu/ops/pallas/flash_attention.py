@@ -29,8 +29,9 @@ Design (TPU-first, not a CUDA translation):
     CPU tests validate the exact kernel code (fake-backend strategy,
     SURVEY §4.5).
 
-Supports is_causal and (optionally) an additive float mask broadcastable to
-[B, H, Sq, Sk] via the reference path; the Pallas path handles causal/full.
+Supports is_causal; grad-free additive/boolean masks broadcastable to
+[B, H, Sq, Sk] stream blockwise through the biased kernels (_flash_core_b),
+trainable masks take the fused-softmax reference path.
 """
 from __future__ import annotations
 
@@ -115,7 +116,8 @@ def flash_attention_available(q) -> bool:
 # =========================== forward kernel ===========================
 
 def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
-                    seq_q, seq_k, seg_q=None, load_seg_k=None):
+                    seq_q, seq_k, seg_q=None, load_seg_k=None,
+                    load_bias=None):
     """The shared flash recurrence: walk KV blocks with f32 running
     max/sum/acc; logits never materialize in HBM. One body for BOTH
     forward kernels (per-head transpose layout and all-heads block) —
@@ -135,6 +137,11 @@ def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
     so ragged batches run block-diagonal WITHOUT a T x T mask ever
     materializing (flash_attn_unpadded). Segment boundaries can cut any
     block, so every block runs the masked body in this mode.
+
+    load_bias(j) -> [block_q, block_k] f32 additive bias (rel-pos /
+    ALiBi / additive masks), added to the scaled logits before the
+    running softmax — the bias streams blockwise, never a full [Sq, Sk]
+    logits materialization.
     """
     d = q.shape[-1]
     off = seq_k - seq_q  # causal diagonal offset (0 for self-attention)
@@ -148,6 +155,8 @@ def _online_softmax(q, load_kv, *, iq, block_q, block_k, scale, causal,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             s = s * scale
+            if load_bias is not None:
+                s = s + load_bias(j)
             if masked:
                 q_ids = iq * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
@@ -209,6 +218,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
                    v_ref[pl.ds(j * block_k, block_k), :]),
         iq=pl.program_id(2), block_q=block_q, block_k=block_k,
         scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k)
+    o_ref[:] = out.astype(o_ref.dtype)
+    lse_ref[:] = lse.astype(jnp.float32)
+
+
+def _fwd_kernel_bias(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, *, scale,
+                     block_k, causal, seq_q, seq_k):
+    # b_ref: [block_q, seq_k] f32 additive bias row-band for this q block
+    block_q = q_ref.shape[0]
+    out, lse = _online_softmax(
+        q_ref[:],
+        lambda j: (k_ref[pl.ds(j * block_k, block_k), :],
+                   v_ref[pl.ds(j * block_k, block_k), :]),
+        iq=pl.program_id(2), block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k,
+        load_bias=lambda j: b_ref[:, pl.ds(j * block_k, block_k)]
+        .astype(jnp.float32))
     o_ref[:] = out.astype(o_ref.dtype)
     lse_ref[:] = lse.astype(jnp.float32)
 
@@ -364,11 +389,14 @@ def _fwd_mh(q, k, v, causal, block_q, block_k):
 # =========================== backward kernels ===========================
 
 def _dq_loop(q, do, lse, delta, load_kv, *, iq, block_q, block_k, scale,
-             causal, seq_q, seq_k, seg_q=None, load_seg_k=None):
+             causal, seq_q, seq_k, seg_q=None, load_seg_k=None,
+             load_bias=None):
     """Shared dQ recurrence (replays blocked logits from lse; bf16 dots,
     f32 accumulation). One body for the per-head and all-heads-block dQ
     kernels. load_kv(j) -> (k, v). Returns dq [block_q, d] f32.
-    seg_q/load_seg_k: varlen segment ids (see _online_softmax)."""
+    seg_q/load_seg_k: varlen segment ids; load_bias: additive bias
+    blocks (see _online_softmax) — the bias replays into the logits so
+    p matches forward."""
     d = q.shape[-1]
     off = seq_k - seq_q
     num_k_blocks = pl.cdiv(seq_k, block_k)
@@ -380,6 +408,8 @@ def _dq_loop(q, do, lse, delta, load_kv, *, iq, block_q, block_k, scale,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             s = s * scale
+            if load_bias is not None:
+                s = s + load_bias(j)
             if masked:
                 q_ids = iq * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
@@ -435,6 +465,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
+def _bwd_dq_kernel_bias(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                        do_ref, dq_ref, *, scale, block_k, causal, seq_q,
+                        seq_k):
+    block_q = q_ref.shape[0]
+    delta = jnp.sum(do_ref[:].astype(jnp.float32) *
+                    o_ref[:].astype(jnp.float32), axis=1, keepdims=True)
+    dq = _dq_loop(
+        q_ref[:], do_ref[:], lse_ref[:], delta,
+        lambda j: (k_ref[pl.ds(j * block_k, block_k), :],
+                   v_ref[pl.ds(j * block_k, block_k), :]),
+        iq=pl.program_id(2), block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k,
+        load_bias=lambda j: b_ref[:, pl.ds(j * block_k, block_k)]
+        .astype(jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
 def _bwd_dq_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref,
                       *, scale, block_k, causal, seq_q, seq_k, n_heads):
     """All-heads-block dQ: [B,S,H,D] operands in place (see
@@ -457,11 +504,12 @@ def _bwd_dq_kernel_mh(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref,
 
 
 def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
-              seq_q, seq_k, seg_k=None, load_seg_q=None):
+              seq_q, seq_k, seg_k=None, load_seg_q=None, load_bias=None):
     """Shared dK/dV recurrence. One body for the per-head and
     all-heads-block dKV kernels. load_q(i) -> (q, do, o, lse) blocks.
     Returns (dk, dv), each [block_k, d] f32.
-    seg_k/load_seg_q: varlen segment ids (see _online_softmax)."""
+    seg_k/load_seg_q: varlen segment ids; load_bias(i) -> [block_q,
+    block_k] additive bias (see _online_softmax)."""
     d = k.shape[-1]
     off = seq_k - seq_q
     segmented = seg_k is not None
@@ -475,6 +523,8 @@ def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
             s = s * scale
+            if load_bias is not None:
+                s = s + load_bias(i)
             if masked:
                 q_ids = i * block_q + jax.lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 0)
@@ -520,6 +570,25 @@ def _dkv_loop(k, v, load_q, *, jk, block_q, block_k, scale, causal,
         return jax.lax.fori_loop(first_full, num_iters,
                                  make_body(tail_masked), carry)
     return jax.lax.fori_loop(0, num_iters, make_body(tail_masked), carry)
+
+
+def _bwd_dkv_kernel_bias(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                         do_ref, dk_ref, dv_ref, *, scale, block_q,
+                         causal, seq_q, seq_k):
+    # b_ref: [seq_q, block_k] f32 bias column-band for this kv block
+    block_k = k_ref.shape[0]
+    dk, dv = _dkv_loop(
+        k_ref[:], v_ref[:],
+        lambda i: (q_ref[pl.ds(i * block_q, block_q), :],
+                   do_ref[pl.ds(i * block_q, block_q), :],
+                   o_ref[pl.ds(i * block_q, block_q), :],
+                   lse_ref[pl.ds(i * block_q, block_q), :]),
+        jk=pl.program_id(2), block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal, seq_q=seq_q, seq_k=seq_k,
+        load_bias=lambda i: b_ref[pl.ds(i * block_q, block_q), :]
+        .astype(jnp.float32))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
@@ -759,6 +828,158 @@ def _mh_selected() -> bool:
     return os.environ.get("FLAGS_flash_layout", "transpose") == "mh"
 
 
+# ===================== biased (additive-mask) core =====================
+
+def _bias_idx(bias_shape, b_dims):
+    """Index map for a broadcastable [Bb, Hb, ., .] bias: size-1 batch /
+    head dims pin to block 0."""
+    has_b = 1 if bias_shape[0] != 1 else 0
+    has_h = 1 if bias_shape[1] != 1 else 0
+    if b_dims == "q":  # fwd/dq: [block_q, sk] row band, idx by q block
+        return lambda bi, hi, i: (bi * has_b, hi * has_h, i, 0)
+    return lambda bi, hi, j: (bi * has_b, hi * has_h, 0, j)  # dkv band
+
+
+def _fwd_tb(qt, kt, vt, bias, causal, block_q, block_k):
+    """Biased forward, head-major operands; bias [Bb, Hb, Sq, Sk] f32
+    (Bb/Hb broadcastable). Returns (out_t, lse)."""
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_bias, scale=scale, block_k=block_k,
+                          causal=causal, seq_q=sq, seq_k=sk),
+        grid=(b, h, pl.cdiv(sq, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, sk, d),
+                         lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, sk, d),
+                         lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, block_q, sk),
+                         _bias_idx(bias.shape, "q")),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )(qt, kt, vt, bias)
+    return out, lse
+
+
+def _bwd_tb(qt, kt, vt, bias, ot, lse, dot, causal, block_q, block_k):
+    b, h, sq, d = qt.shape
+    sk = kt.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+
+    q_spec = pl.BlockSpec((None, None, block_q, d),
+                          lambda bi, hi, i: (bi, hi, i, 0))
+    full_q = pl.BlockSpec((None, None, sq, d),
+                          lambda bi, hi, i: (bi, hi, 0, 0))
+    full_lse = pl.BlockSpec((None, None, sq, 1),
+                            lambda bi, hi, i: (bi, hi, 0, 0))
+    k_full = pl.BlockSpec((None, None, sk, d),
+                          lambda bi, hi, i: (bi, hi, 0, 0))
+    lse_spec = pl.BlockSpec((None, None, block_q, 1),
+                            lambda bi, hi, i: (bi, hi, i, 0))
+    bias_q = pl.BlockSpec((None, None, block_q, sk),
+                          _bias_idx(bias.shape, "q"))
+    bias_k = pl.BlockSpec((None, None, sq, block_k),
+                          _bias_idx(bias.shape, "k"))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_bias, scale=scale,
+                          block_k=block_k, causal=causal, seq_q=sq,
+                          seq_k=sk),
+        grid=(b, h, pl.cdiv(sq, block_q)),
+        in_specs=[q_spec, k_full, k_full, bias_q, q_spec, lse_spec,
+                  q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )(qt, kt, vt, bias, ot, lse, dot)
+
+    kv_spec = pl.BlockSpec((None, None, block_k, d),
+                           lambda bi, hi, j: (bi, hi, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_bias, scale=scale,
+                          block_q=block_q, causal=causal, seq_q=sq,
+                          seq_k=sk),
+        grid=(b, h, pl.cdiv(sk, block_k)),
+        in_specs=[full_q, kv_spec, kv_spec, bias_k, full_q, full_lse,
+                  full_q],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk, d), kt.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk, d), vt.dtype)],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(),
+    )(qt, kt, vt, bias, ot, lse, dot)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_core_b(q, k, v, bias, causal, block_q, block_k):
+    """Additive-bias core (rel-pos bias, ALiBi, additive/boolean masks on
+    the fused tier): bias streams blockwise into the logits — the
+    [Sq, Sk] score matrix never materializes. The bias itself receives NO
+    gradient (zero cotangent): the entry only routes stop-gradient masks
+    here; trainable biases take the reference path."""
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out, _ = _fwd_tb(qt, kt, vt, bias, causal, block_q, block_k)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_core_b_fwd(q, k, v, bias, causal, block_q, block_k):
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t, lse = _fwd_tb(qt, kt, vt, bias, causal, block_q, block_k)
+    return jnp.swapaxes(out_t, 1, 2), (qt, kt, vt, bias, out_t, lse)
+
+
+def _flash_core_b_bwd(causal, block_q, block_k, res, g):
+    qt, kt, vt, bias, ot, lse = res
+    dq, dk, dv = _bwd_tb(qt, kt, vt, bias, ot, lse,
+                         jnp.swapaxes(g, 1, 2), causal, block_q, block_k)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2), jnp.zeros_like(bias))
+
+
+_flash_core_b.defvjp(_flash_core_b_fwd, _flash_core_b_bwd)
+
+
+def _biased_flash_ok(q, k, mask) -> bool:
+    """Gate for the biased kernel path: MHA only (the grouped dKV kernel
+    has no bias plumbing), block-friendly lengths (the dKV bias band's
+    trailing block dim must tile to 128), rank-4 broadcastable mask."""
+    if k.shape[2] != q.shape[2]:
+        return False
+    sq, sk = q.shape[1], k.shape[1]
+    if sq % 8 != 0 or sk % 128 != 0:
+        return False
+    if getattr(mask, "ndim", 0) != 4:
+        return False
+    mb, mh, msq, msk = mask.shape
+    return (mb in (1, q.shape[0]) and mh in (1, q.shape[2])
+            and msq == sq and msk == sk)
+
+
 def _expand_gqa_kv(q, k, v):
     """Expand GQA KV heads to the query head count (consecutive-group
     semantics, matching the kernels' `hi // rep` maps). The ONE shared
@@ -793,7 +1014,8 @@ def _ref_attention(q, k, v, mask, is_causal):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None):
+def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
+                  biased=False):
     """Autotuned (block_q, block_k) for this attention signature
     (paddle/phi/kernels/autotune role; cached per signature on disk).
 
@@ -819,8 +1041,12 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None):
         itemsize = jnp.dtype(dtype).itemsize
         group = (3 * (h // h_kv) * sq * d * itemsize
                  if h_kv and h_kv != h else 0)
+        # biased kernels hold an f32 bias band: [bq, sk] (fwd/dQ) or
+        # [sq, bk] (dKV) — budget the larger
+        bias_band = max(bq * sk, sq * bk) * 4 if biased else 0
         return (2 * bq * bk * 4 + 2 * sk * d * itemsize
-                + 2 * bq * d * itemsize + bq * d * 4 + group)
+                + 2 * bq * d * itemsize + bq * d * 4 + group
+                + bias_band)
 
     cands = [(bq, bk)
              for bq, bk in pairs
@@ -839,27 +1065,64 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None):
         kv = jnp.asarray(rs.randn(b, sk, hk, d), dtype)
         vv = jnp.asarray(rs.randn(b, sk, hk, d), dtype)
 
-        def loss(qv):
-            return _flash_core(qv, kv, vv, causal, cfg[0],
-                               cfg[1]).astype(jnp.float32).sum()
+        if biased:  # benchmark the kernel that will actually run
+            bias_v = jnp.zeros((1, 1, sq, sk), jnp.float32)
+
+            def loss(qv):
+                return _flash_core_b(qv, kv, vv, bias_v, causal, cfg[0],
+                                     cfg[1]).astype(jnp.float32).sum()
+        else:
+            def loss(qv):
+                return _flash_core(qv, kv, vv, causal, cfg[0],
+                                   cfg[1]).astype(jnp.float32).sum()
 
         return jax.grad(loss)(qv)
 
     sig = (f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
-           + (f"|kv{h_kv}" if h_kv and h_kv != h else ""))
+           + (f"|kv{h_kv}" if h_kv and h_kv != h else "")
+           + ("|bias" if biased else ""))
     return autotune.pick("flash_fwdbwd", sig, cands, run, default)
 
 
 def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
-                        block_q=None, block_k=None):
-    """[B, S, H, D] in/out. Pallas kernel for causal/full; additive or
-    boolean masks use the fused-softmax reference path. Block sizes are
-    autotuned per signature unless passed explicitly. Odd sequence
+                        block_q=None, block_k=None,
+                        bias_grad_safe=False):
+    """[B, S, H, D] in/out. Pallas kernel for causal/full. Block sizes
+    are autotuned per signature unless passed explicitly. Odd sequence
     lengths (ViT's 197, ragged batches) run zero-padded to a multiple of
     8 with real-length masking inside the kernels — padded keys never
     contribute, padded query rows are sliced off (gradients included,
-    via the custom VJP's real-length bounds)."""
-    if mask is not None or not flash_attention_available(q):
+    via the custom VJP's real-length bounds).
+
+    Masks: with bias_grad_safe=True (the caller vouches the mask needs
+    no gradient — scaled_dot_product_attention checks stop_gradient),
+    additive/boolean masks stream blockwise through the biased kernels
+    ([Sq, Sk] scores never materialize); otherwise the fused-softmax
+    reference path runs."""
+    if mask is not None:
+        if not (flash_attention_available(q) and bias_grad_safe
+                and _biased_flash_ok(q, k, mask)):
+            return _ref_attention(q, k, v, mask, is_causal)
+        bias = mask
+        if bias.dtype == jnp.bool_:
+            bias = jnp.where(bias, 0.0, NEG_INF)
+        bias = bias.astype(jnp.float32)
+        if block_q is None or block_k is None:
+            bq, bk = _tuned_blocks(q.shape[0], q.shape[1], k.shape[1],
+                                   q.shape[2], q.shape[3], q.dtype,
+                                   bool(is_causal), h_kv=k.shape[2],
+                                   biased=True)
+            block_q = block_q or bq
+            block_k = block_k or bk
+        # validate the FINAL block_k (after _pick_block shrinking): the
+        # dKV bias band's trailing block dim must tile to 128 or equal sk
+        sk_arr = k.shape[1]
+        final_bk = _pick_block(sk_arr, block_k)
+        if final_bk % 128 != 0 and final_bk != sk_arr:
+            return _ref_attention(q, k, v, mask, is_causal)
+        return _flash_core_b(q, k, v, bias, bool(is_causal), block_q,
+                             final_bk)
+    if not flash_attention_available(q):
         return _ref_attention(q, k, v, mask, is_causal)
     if k.shape[2] != q.shape[2]:
         # GQA feasibility: the grouped dK/dV kernel keeps a KV head's

@@ -238,3 +238,18 @@ def test_varlen_attention_lowers():
 
     mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
     _assert_mosaic(mlir)
+
+
+def test_flash_biased_lowers():
+    """Biased kernels (additive mask on the fused tier) must lower for
+    both directions at the bench shape with a broadcast [1,H,S,S] bias."""
+    b, s, h, d = 8, 1024, 12, 64
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    bias = jax.ShapeDtypeStruct((1, h, s, s), jnp.float32)
+
+    def loss(q, k, v, bias):
+        o = fa._flash_core_b(q, k, v, bias, False, 256, 512)
+        return jnp.sum(o.astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q, bias)
+    _assert_mosaic(mlir)
