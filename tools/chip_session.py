@@ -226,6 +226,46 @@ def phase_kernels():
         log("kernels", {"op": "decode", "error": str(e)[:150]})
 
 
+def phase_gqa_ab():
+    """GQA grouped kernels vs expanded-KV MHA at a LLaMA-2-class shape:
+    the grouped path reads Hq/Hkv x less KV from HBM — prove it."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as FA
+
+    B, S, HQ, HKV, D = 4, 2048, 32, 8, 128
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, HQ, D), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, S, HKV, D), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, S, HKV, D), jnp.bfloat16)
+    rep = HQ // HKV
+    for bq, bk in ((512, 512), (256, 512)):
+        try:
+            f_g = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core(
+                x, k, v, True, bq, bk))
+            f_e = jax.jit(lambda x, bq=bq, bk=bk: FA._flash_core(
+                x, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+                True, bq, bk))
+            g_g = jax.jit(jax.grad(lambda x, bq=bq, bk=bk: FA._flash_core(
+                x, k, v, True, bq, bk).astype(jnp.float32).sum()))
+            g_e = jax.jit(jax.grad(lambda x, bq=bq, bk=bk: FA._flash_core(
+                x, jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2),
+                True, bq, bk).astype(jnp.float32).sum()))
+            log("gqa_ab", {
+                "shape": f"B{B}S{S} {HQ}q/{HKV}kv D{D}",
+                "blocks": f"{bq}x{bk}",
+                "grouped_fwd_ms": round(slope(f_g, q) * 1e3, 2),
+                "expanded_fwd_ms": round(slope(f_e, q) * 1e3, 2),
+                "grouped_fwdbwd_ms": round(slope(g_g, q) * 1e3, 2),
+                "expanded_fwdbwd_ms": round(slope(g_e, q) * 1e3, 2)})
+        except Exception as e:
+            log("gqa_ab", {"blocks": f"{bq}x{bk}",
+                           "error": f"{type(e).__name__}: {str(e)[:120]}"})
+
+
 def phase_autotune_seed():
     import jax.numpy as jnp
 
@@ -449,7 +489,8 @@ def phase_bench():
 
 
 PHASES = {"sanity": phase_sanity, "sweep": phase_sweep,
-          "kernels": phase_kernels, "autotune": phase_autotune_seed,
+          "kernels": phase_kernels, "gqa_ab": phase_gqa_ab,
+          "autotune": phase_autotune_seed,
           "generate": phase_generate, "decode_quant": phase_decode_quant,
           "generate_1p3b": phase_generate_1p3b,
           "memory_headroom": phase_memory_headroom, "bench": phase_bench}
@@ -460,8 +501,9 @@ def main():
     # headline artifact) before the heavier serving/memory phases, so an
     # early tunnel drop costs the least important data
     names = sys.argv[1:] or ["sanity", "sweep", "kernels", "autotune",
-                             "bench", "generate", "decode_quant",
-                             "generate_1p3b", "memory_headroom"]
+                             "bench", "gqa_ab", "generate",
+                             "decode_quant", "generate_1p3b",
+                             "memory_headroom"]
     for n in names:
         try:
             PHASES[n]()
