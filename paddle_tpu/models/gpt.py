@@ -32,7 +32,8 @@ class GPTConfig:
                  dropout=0.0, attn_dropout=0.0, use_rope=False,
                  use_rmsnorm=False, use_swiglu=False, tie_embeddings=True,
                  recompute=False, sequence_parallel=False,
-                 context_parallel=False, layer_norm_eps=1e-5):
+                 context_parallel=False, layer_norm_eps=1e-5,
+                 fused_head_ce=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -51,6 +52,10 @@ class GPTConfig:
         self.sequence_parallel = sequence_parallel
         self.context_parallel = context_parallel
         self.layer_norm_eps = layer_norm_eps
+        # training returns hidden states; GPTPretrainingCriterion fuses
+        # the LM-head projection into the chunked CE ("cut cross
+        # entropy" — the [B,S,V] logits never materialize)
+        self.fused_head_ce = fused_head_ce
 
 
 def _in_trace():
@@ -249,6 +254,14 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
                                      attn_start=attn_start)
         else:
             x = self.gpt(input_ids)
+        if self.cfg.fused_head_ce and self.training and kv_caches is None:
+            # hidden states out; GPTPretrainingCriterion(model=...) owns
+            # the projection (fused with the CE — no [B,S,V] logits).
+            # The marker (via the Tensor's name slot) makes a
+            # mismatched plain criterion fail loudly instead of treating
+            # hidden states as logits.
+            x.name = "fused_head_hidden"
+            return x
         if self.cfg.tie_embeddings:
             logits = x.matmul(self.gpt.wte.weight, transpose_y=True)
         else:
@@ -265,6 +278,31 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         return init_kv_caches(cfg.num_layers, batch, cfg.num_heads,
                               cfg.hidden_size // cfg.num_heads, max_len,
                               dtype)
+
+
+def _ce_fwd_chunk(carry, blk, base, safe_labels, chunk):
+    """One online-logsumexp CE step over a [N, chunk] f32 logits block —
+    the single source of the running max/sum/picked math for BOTH the
+    chunked-softmax CE and the fused linear+CE."""
+    m, l, picked = carry
+    bm = jnp.max(blk, axis=1)
+    m_new = jnp.maximum(m, bm)
+    l_new = l * jnp.exp(m - m_new) + \
+        jnp.sum(jnp.exp(blk - m_new[:, None]), axis=1)
+    in_chunk = (safe_labels >= base) & (safe_labels < base + chunk)
+    idx = jnp.clip(safe_labels - base, 0, chunk - 1)
+    val = jnp.take_along_axis(blk, idx[:, None], axis=1)[:, 0]
+    picked = jnp.where(in_chunk, val, picked)
+    return (m_new, l_new, picked)
+
+
+def _ce_bwd_chunk(blk, base, lse, safe_labels, valid, chunk):
+    """d(loss)/d(logits block): softmax recompute minus the one-hot,
+    masked to valid tokens — shared by both CE backward scans."""
+    p = jnp.exp(blk - lse[:, None])
+    idx = safe_labels - base
+    onehot = (jnp.arange(chunk)[None, :] == idx[:, None])
+    return (p - onehot) * valid[:, None]
 
 
 def _chunked_softmax_ce(logits, labels, ignore_index, n_chunks=8):
@@ -297,18 +335,9 @@ def _chunked_softmax_ce(logits, labels, ignore_index, n_chunks=8):
         lgp = pad_logits(lg).reshape(n, n_chunks, chunk)
 
         def body(carry, ci):
-            m, l, picked = carry
             blk = lgp[:, ci, :].astype(jnp.float32)
-            bm = jnp.max(blk, axis=1)
-            m_new = jnp.maximum(m, bm)
-            l_new = l * jnp.exp(m - m_new) + \
-                jnp.sum(jnp.exp(blk - m_new[:, None]), axis=1)
-            base = ci * chunk
-            in_chunk = (safe_labels >= base) & (safe_labels < base + chunk)
-            idx = jnp.clip(safe_labels - base, 0, chunk - 1)
-            val = jnp.take_along_axis(blk, idx[:, None], axis=1)[:, 0]
-            picked = jnp.where(in_chunk, val, picked)
-            return (m_new, l_new, picked), None
+            return _ce_fwd_chunk(carry, blk, ci * chunk, safe_labels,
+                                 chunk), None
 
         init = (jnp.full((n,), -1e30, jnp.float32),
                 jnp.zeros((n,), jnp.float32),
@@ -333,11 +362,8 @@ def _chunked_softmax_ce(logits, labels, ignore_index, n_chunks=8):
 
         def body(_, ci):
             blk = lgp[:, ci, :].astype(jnp.float32)
-            p = jnp.exp(blk - lse[:, None])
-            base = ci * chunk
-            idx = safe_labels - base
-            onehot = (jnp.arange(chunk)[None, :] == idx[:, None])
-            d = (p - onehot) * valid[:, None]
+            d = _ce_bwd_chunk(blk, ci * chunk, lse, safe_labels, valid,
+                              chunk)
             return None, (g * d).astype(lg.dtype)
 
         _, dchunks = jax.lax.scan(body, None, jnp.arange(n_chunks))
@@ -348,22 +374,140 @@ def _chunked_softmax_ce(logits, labels, ignore_index, n_chunks=8):
     return core(logits), valid.astype(jnp.float32).sum()
 
 
+def _fused_linear_ce(h, w, labels, ignore_index, n_chunks=16):
+    """Cross entropy fused WITH the LM-head projection ("cut cross
+    entropy"): the [N, V] logits never exist. A `lax.scan` over vocab
+    chunks computes `h @ w_chunk.T` on the MXU, folds it into a running
+    logsumexp, and picks the target logit; backward recomputes each
+    chunk's probabilities and accumulates dh / dW without storing
+    activations of size N*V. At GPT-125M bench shape this removes the
+    ~3.3 GB bf16 logits (plus their cotangent) from HBM — the largest
+    single tensor in the training step.
+
+    h: [N, Hd]; w: [V, Hd] (tied-embedding layout); labels: [N].
+    Returns (total_loss_f32, valid_count_f32)."""
+    import jax
+
+    n, hd = h.shape
+    v = w.shape[0]
+    chunk = -(-v // n_chunks)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
+
+    def chunk_logits(hh, wp, ci):
+        # wp: the ONCE-padded weight (pad hoisted out of the scans — a
+        # per-iteration pad would re-copy the whole [V, Hd] matrix every
+        # chunk in both directions)
+        base = ci * chunk
+        wc = jax.lax.dynamic_slice_in_dim(wp, base, chunk, axis=0)
+        blk = jax.lax.dot_general(
+            hh, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [N, chunk]
+        col_ok = base + jnp.arange(chunk) < v
+        return jnp.where(col_ok[None, :], blk, -1e30), base, wc
+
+    def _padded(ww):
+        return jnp.pad(ww, ((0, chunk * n_chunks - v), (0, 0)))
+
+    def fwd_scan(hh, ww):
+        wp = _padded(ww)
+
+        def body(carry, ci):
+            blk, base, _ = chunk_logits(hh, wp, ci)
+            return _ce_fwd_chunk(carry, blk, base, safe_labels,
+                                 chunk), None
+
+        init = (jnp.full((n,), -1e30, jnp.float32),
+                jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.float32))
+        (m, l, picked), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        per_tok = jnp.where(valid, lse - picked, 0.0)
+        return per_tok.sum(), lse
+
+    @jax.custom_vjp
+    def core(hh, ww):
+        return fwd_scan(hh, ww)[0]
+
+    def core_f(hh, ww):
+        total, lse = fwd_scan(hh, ww)
+        return total, (hh, ww, lse)
+
+    def core_b(res, g):
+        # everything differentiable rides the residuals — a custom_vjp
+        # bwd closing over outer tracers leaks them out of the linearize
+        hh, ww, lse = res
+        wp = _padded(ww)
+
+        def body(dh, ci):
+            blk, base, wc = chunk_logits(hh, wp, ci)
+            d = _ce_bwd_chunk(blk, base, lse, safe_labels, valid,
+                              chunk).astype(hh.dtype)          # [N,C]
+            dh = dh + jax.lax.dot_general(
+                d, wc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dwc = jax.lax.dot_general(
+                d, hh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)       # [C, Hd]
+            return dh, dwc
+
+        dh, dw_chunks = jax.lax.scan(
+            body, jnp.zeros((n, hd), jnp.float32), jnp.arange(n_chunks))
+        dw = dw_chunks.reshape(n_chunks * chunk, hd)[:v]
+        return ((g * dh).astype(hh.dtype), (g * dw).astype(ww.dtype))
+
+    core.defvjp(core_f, core_b)
+    return core(h, w), valid.astype(jnp.float32).sum()
+
+
 class GPTPretrainingCriterion(nn.Layer):
     """Token-level LM loss with masked mean (parity: the Fleet GPT criterion;
     vocab-parallel CE comes from the logits' mp annotation).
 
     fused=True (default for large vocabs) uses the chunked online-
     logsumexp CE above; fused=False is the plain F.cross_entropy path.
-    Both produce identical values (tested to 1e-5)."""
+    Both produce identical values (tested to 1e-5).
 
-    def __init__(self, ignore_index=-100, fused=True):
+    model= (with cfg.fused_head_ce=True on the model): the criterion
+    receives HIDDEN states and fuses the LM-head projection into the
+    chunked CE (`_fused_linear_ce`) — the [B,S,V] logits and their
+    cotangent never exist. Reads the tied embedding weight through the
+    live parameter, so the train step's bind_state makes it
+    differentiable like any other param."""
+
+    def __init__(self, ignore_index=-100, fused=True, model=None):
         super().__init__()
         self.ignore_index = ignore_index
         self.fused = fused
+        self._model = model
+        if model is not None:
+            assert model.cfg.tie_embeddings, \
+                "fused head+CE currently requires tied embeddings"
 
     def forward(self, logits, labels):
         lv = logits._value if hasattr(logits, "_value") else logits
         yv = labels._value if hasattr(labels, "_value") else labels
+        is_hidden = getattr(logits, "name", None) == "fused_head_hidden"
+        if is_hidden and self._model is None:
+            raise RuntimeError(
+                "model was built with cfg.fused_head_ce=True (returns "
+                "hidden states in training) but the criterion has no "
+                "model= — construct GPTPretrainingCriterion(model=model)")
+        if self._model is not None and self.fused and is_hidden:
+            from ..core.dispatch import apply
+
+            w = self._model.gpt.wte.weight  # live (bindable) param
+
+            def f(hh, lb, wv):
+                n = 1
+                for d in hh.shape[:-1]:
+                    n *= d
+                total, count = _fused_linear_ce(
+                    hh.reshape(n, hh.shape[-1]), wv, lb.reshape(n),
+                    self.ignore_index)
+                return total / jnp.maximum(count, 1.0)
+
+            return apply("fused_linear_ce", f, logits, labels, w)
         if self.fused and lv.shape[-1] >= 8192:
             from ..core.dispatch import apply
 

@@ -481,3 +481,59 @@ def test_beam_search_keeps_finished_hypothesis():
     if (gen == eos).any():
         first = int(np.argmax(gen == eos))
         assert (gen[first:] == eos).all()
+
+
+def test_fused_head_ce_matches_unfused():
+    """cfg.fused_head_ce + GPTPretrainingCriterion(model=...): the
+    projection fuses into the chunked CE (no [B,S,V] logits). Losses and
+    parameter updates (incl. the tied embedding, which now gets its
+    head-side gradient through the fused VJP) must match the unfused
+    path step for step."""
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    kw = dict(vocab_size=317, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=32, dropout=0.0)
+    losses = {}
+    for fused in (False, True):
+        topology.reset_topology()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sep_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        P.seed(7)
+        model = GPTForCausalLM(GPTConfig(fused_head_ce=fused, **kw))
+        crit = GPTPretrainingCriterion(model=model if fused else None)
+        dm = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(
+            P.optimizer.SGD(parameters=model.parameters(),
+                            learning_rate=0.1))
+        step = dm.build_train_step(opt, crit)
+        rs = np.random.RandomState(0)
+        ids = P.to_tensor(rs.randint(0, 317, (2, 32)), "int32")
+        lab = P.to_tensor(rs.randint(0, 317, (2, 32)), "int32")
+        losses[fused] = [float(step(ids, lab)) for _ in range(3)]
+    np.testing.assert_allclose(losses[False], losses[True], rtol=2e-5)
+
+
+def test_fused_head_ce_mismatched_criterion_raises():
+    """A fused_head_ce model paired with a PLAIN criterion must fail
+    loudly — hidden states silently scored as logits was the failure
+    mode (r4 review)."""
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    P.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=16, fused_head_ce=True)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    ids = P.randint(0, 256, [2, 16])
+    out = model(ids)
+    crit = GPTPretrainingCriterion()  # no model= — mismatch
+    with pytest.raises(RuntimeError, match="fused_head_ce"):
+        crit(out, ids)
