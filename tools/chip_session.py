@@ -327,7 +327,8 @@ def phase_memory_headroom():
     )
 
     cfg = GPTConfig(vocab_size=50304, hidden_size=1536, num_layers=24,
-                    num_heads=16, max_seq_len=1024, recompute=True)
+                    num_heads=16, max_seq_len=1024, recompute=True,
+                    fused_head_ce=True)
     seq, iters = 1024, 8
     for batch in (16, 8, 4, 2):
         model = opt = step = None
@@ -340,11 +341,13 @@ def phase_memory_headroom():
                 "sep_degree": 1, "sharding_degree": 1}
             fleet.init(is_collective=True, strategy=strategy)
             P.seed(0)
-            model = fleet.distributed_model(GPTForCausalLM(cfg))
+            inner = GPTForCausalLM(cfg)
+            model = fleet.distributed_model(inner)
             opt = fleet.distributed_optimizer(P.optimizer.AdamW(
                 parameters=model.parameters(), learning_rate=1e-4))
             step = model.build_train_step(
-                opt, GPTPretrainingCriterion(), amp_dtype="bfloat16")
+                opt, GPTPretrainingCriterion(model=inner),
+                amp_dtype="bfloat16")
             rs = np.random.RandomState(0)
             ids = P.to_tensor(
                 rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
