@@ -151,6 +151,67 @@ def train_step_cost(shape, global_batch, micro_batch, dp=1, mp=1, pp=1,
     return compute + comm
 
 
+def comm_bytes_per_step(param_count, local_batch, seq, hidden, num_layers,
+                        dp=1, mp=1, sep=1, sharding_stage=0,
+                        sequence_parallel=False, context_parallel=False,
+                        grad_dtype_bytes=4, param_dtype_bytes=4,
+                        act_dtype_bytes=2):
+    """Predicted per-device collective payload bytes for ONE optimizer step
+    of the compiled hybrid train step (VERDICT r4 Next #6: the analytic
+    half of the planner's feedback loop — validated against
+    `completion.collective_report`'s compiler ground truth, which reads
+    the per-device shapes out of the partitioned HLO).
+
+    Structural terms (per device, matching what GSPMD inserts):
+      * dp grad sync     — all-reduce (or reduce-scatter + param
+                           all-gather under ZeRO>=1 weight-update
+                           sharding) of the mp-local grads
+      * ZeRO-3           — extra param all-gathers in fwd+bwd
+      * TP (mp)          — 4 activation all-reduces per layer (2 fwd +
+                           2 bwd; Megatron); with sequence_parallel the
+                           same bytes move as all-gather+reduce-scatter
+      * SEP ring         — K/V (and their grads) rotating sep-1 hops per
+                           layer via collective-permute
+
+    Returns {"by_kind": {...}, "total": int}. Agreement with the
+    measured report within ~3x is expected; the planner re-ranks with
+    the measured bytes (Engine.search).
+    """
+    by = {"all-reduce": 0.0, "reduce-scatter": 0.0, "all-gather": 0.0,
+          "collective-permute": 0.0, "all-to-all": 0.0}
+    p_local = param_count / max(mp, 1)
+    if dp > 1:
+        g = p_local * grad_dtype_bytes
+        if sharding_stage >= 3:
+            # params stay dp-sharded through the update (no post-update
+            # gather); fwd + bwd each re-gather them on demand
+            by["reduce-scatter"] += g
+            by["all-gather"] += 2 * p_local * param_dtype_bytes
+        elif sharding_stage == 2:
+            by["reduce-scatter"] += g
+            by["all-gather"] += p_local * param_dtype_bytes
+        elif sharding_stage == 1:
+            by["all-reduce"] += g
+            by["all-gather"] += p_local * param_dtype_bytes
+        else:
+            by["all-reduce"] += g
+    if mp > 1:
+        a = local_batch * seq * hidden * act_dtype_bytes
+        if sequence_parallel:
+            by["all-gather"] += 2 * num_layers * a
+            by["reduce-scatter"] += 2 * num_layers * a
+        else:
+            by["all-reduce"] += 4 * num_layers * a
+    if sep > 1 and context_parallel:
+        # ring attention: K+V rotate (sep-1) hops forward; backward
+        # re-rotates K/V and accumulates dK/dV around the ring
+        kv = local_batch * (seq // sep) * hidden * act_dtype_bytes
+        by["collective-permute"] += 5 * num_layers * (sep - 1) * kv
+    total = sum(by.values())
+    return {"by_kind": {k: int(v) for k, v in by.items() if v},
+            "total": int(total)}
+
+
 def memory_per_chip(shape, micro_batch, dp=1, mp=1, pp=1, sharding_stage=0,
                     recompute=False, optimizer_bytes_per_param=12):
     """Bytes/chip estimate for pruning infeasible plans (weights + grads +

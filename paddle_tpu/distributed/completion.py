@@ -32,11 +32,10 @@ import re
 
 __all__ = ["sharding_report", "collective_report", "analyze"]
 
-_COLLECTIVE_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
-    r"([^=]*?)\s*"  # result shapes, e.g. "f32[2,32,128]{2,1,0}" or a tuple
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"(-start|-done)?\(", re.MULTILINE)
+_COLLECTIVE_KIND_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|all-to-all)(-start|-done)?\(")
+_HLO_COMMENT_RE = re.compile(r"/\*.*?\*/")
 
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
@@ -84,10 +83,22 @@ def collective_report(compiled_text: str) -> dict:
     approximation — the start tuple aliases operand+result+context)."""
     ops = []
     totals = collections.defaultdict(int)
-    for m in _COLLECTIVE_RE.finditer(compiled_text):
-        shapes_str, kind, phase = m.group(1), m.group(2), m.group(3)
+    for line in compiled_text.splitlines():
+        m = _COLLECTIVE_KIND_RE.search(line)
+        if m is None:
+            continue
+        kind, phase = m.group(1), m.group(2)
         if phase == "-done":
             continue
+        eq = line.find("=")
+        if eq < 0 or eq > m.start():
+            continue  # an operand reference, not a defining instruction
+        # result shapes sit between the `=` and the op name; long tuples
+        # carry `/*index=N*/` comments that must be stripped before the
+        # shape scan (r5 fix: the old regex stopped at the first `=` and
+        # silently dropped every bundled multi-operand collective — the
+        # grad all-reduce is exactly such a bundle)
+        shapes_str = _HLO_COMMENT_RE.sub("", line[eq + 1:m.start()])
         elems, bytes_ = _shape_bytes(shapes_str,
                                      largest_only=phase == "-start")
         ops.append({"kind": kind, "elems": elems, "bytes": bytes_})

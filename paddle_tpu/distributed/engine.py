@@ -117,20 +117,33 @@ class Engine:
         if isinstance(strategy, dict):  # a Candidate.as_strategy() dict
             d = strategy
             strategy = fleet.DistributedStrategy()
+            stage = d.get("sharding_stage", 0)
             strategy.hybrid_configs = {
                 "dp_degree": d.get("dp_degree", 1),
                 "mp_degree": d.get("mp_degree", 1),
                 "pp_degree": d.get("pp_degree", 1),
                 "sep_degree": d.get("sep_degree", 1),
-                "sharding_degree": d.get("sharding_degree", 1),
+                # ZeRO shards over the dp axis unless explicitly set
+                "sharding_degree": d.get("sharding_degree",
+                                         d.get("dp_degree", 1)
+                                         if stage else 1),
             }
-            stage = d.get("sharding_stage", 0)
             if stage:
-                strategy.hybrid_configs["sharding_stage"] = stage
+                # what build_train_step actually reads (fleet.__init__):
+                # strategy.sharding + sharding_configs["stage"]
+                strategy.sharding = True
+                strategy.sharding_configs = {"stage": stage}
         topology.reset_topology()
         fleet.init(is_collective=True, strategy=strategy)
+        # search() leaves factories behind: rebuild the net under the
+        # winning topology (TP layers read mesh degrees at construction)
+        if getattr(self, "_model_factory", None) is not None:
+            self.model = self._model_factory()
         self._wrapped = fleet.distributed_model(self.model)
-        opt = fleet.distributed_optimizer(self.optimizer)
+        opt = self.optimizer
+        if opt is None and getattr(self, "_opt_factory", None) is not None:
+            opt = self._opt_factory(self._wrapped.parameters())
+        opt = fleet.distributed_optimizer(opt)
         self._step = self._wrapped.build_train_step(
             opt, self.loss, amp_dtype="bfloat16")
 
@@ -138,6 +151,116 @@ class Engine:
                 global_batch=32, seq_len=1024):
         self._ensure_prepared(global_batch, seq_len)
         return self
+
+    def search(self, model_factory, optimizer_factory, sample_batch,
+               global_batch=8, seq_len=32, top_k=3, chip=None):
+        """Placement search closed on compiler ground truth (VERDICT r4
+        Next #6; reference `auto_parallel/static/engine.py:59` + `tuner/`
+        explore placements — here the explore loop is: enumerate → rank
+        analytically → compile the leaders → re-rank on measured comm).
+
+        1. Enumerate (dp, mp, zero, micro-batch) factorizations of the
+           live mesh and rank by the analytic cost model (AutoTuner).
+        2. For the ``top_k`` compilable leaders (pp=1 — pipeline plans
+           rank analytically but execute through PipelineParallel, not
+           this step builder), build the hybrid step under that topology
+           and read the collectives XLA/GSPMD *actually* inserted
+           (`completion.collective_report`).
+        3. Audit the predicted comm bytes
+           (`cost_model.comm_bytes_per_step`) against the measured bytes
+           and re-rank by the cost estimate with the comm term replaced
+           by the measured bytes — a mispredicted plan can no longer win
+           on its misprediction.
+
+        ``model_factory``/``optimizer_factory`` rebuild the net under
+        each candidate topology (TP layers pick up mesh degrees at
+        construction). ``sample_batch`` is an (inputs, labels) pair of
+        numpy arrays at the global batch size used to trace the step.
+
+        Returns ``(best, trials)``: ``best`` is the winning trial dict
+        (its ``"strategy"`` feeds fleet.init / Engine(strategy=...)),
+        ``trials`` has one entry per validated candidate with
+        ``predicted_bytes`` / ``measured_bytes`` / ``agreement`` /
+        ``measured_time_s``. The Engine's own strategy is set to the
+        winner."""
+        import jax
+
+        import paddle_tpu as P
+        from . import completion, fleet, topology
+        from ..cost_model import V5P, comm_bytes_per_step
+
+        chip = chip or V5P
+        n_devices = jax.device_count()
+        shape = _infer_shape(self.model, seq_len, global_batch)
+        cands = plan(self.model, n_devices, global_batch, seq_len,
+                     chip=chip, top_k=max(top_k * 4, 8))
+        xs, ys = sample_batch
+        trials = []
+        for cand in cands:
+            if len(trials) >= top_k:
+                break
+            if cand.pp > 1 or global_batch % cand.dp != 0:
+                continue
+            topology.reset_topology()
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = {
+                "dp_degree": cand.dp, "mp_degree": cand.mp,
+                "pp_degree": 1, "sep_degree": 1,
+                "sharding_degree": cand.dp}
+            if cand.sharding_stage:
+                strategy.sharding = True
+                strategy.sharding_configs = {"stage": cand.sharding_stage}
+            fleet.init(is_collective=True, strategy=strategy)
+            P.seed(0)
+            model = fleet.distributed_model(model_factory())
+            opt = fleet.distributed_optimizer(
+                optimizer_factory(model.parameters()))
+            step = model.build_train_step(opt, self.loss,
+                                          amp_dtype="bfloat16")
+            report = completion.analyze(
+                step, P.to_tensor(xs), P.to_tensor(ys))
+            measured = report["collectives"]["total_bytes"]
+            n_params = sum(int(np.prod(p.shape))
+                           for p in model.parameters())
+            pred = comm_bytes_per_step(
+                n_params, local_batch=global_batch // cand.dp,
+                seq=seq_len, hidden=shape.h, num_layers=shape.L,
+                dp=cand.dp, mp=cand.mp,
+                sharding_stage=cand.sharding_stage)
+            # re-rank: the analytic compute/memory roofline with the comm
+            # term re-priced at the MEASURED bytes (ring steps ~ 2x
+            # payload/bw). Rebuilt from train_step_cost's components —
+            # subtracting a differently-modelled comm estimate from
+            # est_time_s would not cancel and can go negative.
+            from ..cost_model import train_step_cost
+
+            est = train_step_cost(
+                shape, global_batch, cand.micro_batch, dp=cand.dp,
+                mp=cand.mp, pp=1, sharding_stage=cand.sharding_stage,
+                chip=chip)
+            measured_comm_s = 2.0 * measured / chip.ici_bw
+            measured_time = max(est.compute_s, est.memory_s) + \
+                measured_comm_s
+            trials.append({
+                "strategy": cand.as_strategy(),
+                "candidate": repr(cand),
+                "predicted_bytes": pred["total"],
+                "predicted_by_kind": pred["by_kind"],
+                "measured_bytes": measured,
+                "measured_by_kind": report["collectives"]["totals"],
+                "agreement": pred["total"] / max(measured, 1),
+                "est_time_s": cand.est_time_s,
+                "measured_time_s": measured_time,
+            })
+        if not trials:
+            raise RuntimeError("no compilable (pp=1) candidate to search")
+        best = min(trials, key=lambda t: t["measured_time_s"])
+        self.strategy = best["strategy"]
+        self.plan_result = None
+        self._step = None  # prepare() rebuilds under the winner
+        self._model_factory = model_factory
+        self._opt_factory = optimizer_factory
+        return best, trials
 
     def cost(self, mode="train"):
         """Planner estimate for the chosen strategy (reference

@@ -124,7 +124,9 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
                             activation="gelu"):
     if trans_x:
-        x = x.T
+        # matrix-dims transpose only (reference fused_gemm_epilogue
+        # semantics); .T on ndim>2 would reverse ALL dims
+        x = x.mT if getattr(x, "ndim", 2) > 2 else x.T
     out = fused_linear(x, y, bias, trans_y)
     from ....nn import functional as F
 

@@ -488,11 +488,15 @@ class GPTPretrainingCriterion(nn.Layer):
         lv = logits._value if hasattr(logits, "_value") else logits
         yv = labels._value if hasattr(labels, "_value") else labels
         is_hidden = getattr(logits, "name", None) == "fused_head_hidden"
-        if is_hidden and self._model is None:
+        if is_hidden and (self._model is None or not self.fused):
+            # either mismatch silently scores hidden states as logits
             raise RuntimeError(
                 "model was built with cfg.fused_head_ce=True (returns "
-                "hidden states in training) but the criterion has no "
-                "model= — construct GPTPretrainingCriterion(model=model)")
+                "hidden states in training) but the criterion cannot fuse "
+                "— construct GPTPretrainingCriterion(model=model) with "
+                "fused=True (got model="
+                f"{'set' if self._model is not None else 'None'}, "
+                f"fused={self.fused})")
         if self._model is not None and self.fused and is_hidden:
             from ..core.dispatch import apply
 

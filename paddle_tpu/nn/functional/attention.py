@@ -94,10 +94,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if _sdp_policy["flash"] and _pl.flash_attention_available(q):
             return _pl.flash_attention_fwd(q, k, v, m, is_causal,
                                            bias_grad_safe=mask_sg)
-        if not _sdp_policy["math"] and not _sdp_policy["flash"]:
+        if not _sdp_policy["math"]:
+            # math disabled and flash unavailable (or also disabled):
+            # falling through to the reference path would silently
+            # violate the sdp_kernel policy
             raise RuntimeError(
-                "sdp_kernel: every backend disabled for "
-                "scaled_dot_product_attention")
+                "sdp_kernel: math backend disabled and the flash "
+                "(Pallas) kernel is "
+                + ("unavailable for this input (CPU/interpret mode or "
+                   "unsupported shape/dtype)"
+                   if _sdp_policy["flash"] else "also disabled"))
         return _sdpa_ref(q, k, v, m, dropout_p, is_causal, None)
 
     return apply("scaled_dot_product_attention", f, query, key, value,
@@ -157,12 +163,18 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                              else cu_seqlens_k)
             same = cq.shape == ck.shape and bool((cq == ck).all())
         except Exception:
-            same = None  # traced values: cannot validate here
+            same = None  # traced values: cannot validate here — a
+            # jitted call with mismatched packings computes the wrong
+            # causal alignment undetected (documented hole; validate
+            # packings before jit, or pass concrete cu_seqlens)
         if same is False:
             raise NotImplementedError(
                 "flash_attn_unpadded: causal=True requires identical "
                 "cu_seqlens_q and cu_seqlens_k (per-sequence causal "
-                "alignment across different packings is not supported)")
+                "alignment across different packings is not supported). "
+                "NOTE: this check only runs on concrete cu_seqlens — "
+                "under jit the values are traced and a mismatch cannot "
+                "be detected; validate before tracing.")
     from ...ops.pallas.varlen_attention import varlen_attention
 
     def f(q, k, v, cu_q, cu_k):

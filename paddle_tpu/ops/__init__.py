@@ -14,6 +14,8 @@ from .logic import *  # noqa: F401,F403
 from .extra import *  # noqa: F401,F403
 from .logic import is_tensor  # noqa: F401
 
+from . import _op_table  # noqa: F401  (generated surface — kept importable
+# so a missing/broken regeneration breaks the build, not just the tests)
 from ..core.dispatch import apply, op  # noqa: F401
 from ..core.tensor import Tensor
 

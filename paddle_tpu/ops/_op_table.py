@@ -1,0 +1,240 @@
+"""AUTO-GENERATED from OPS_MANIFEST.json by
+tools/gen_op_manifest.py --emit.  DO NOT EDIT BY HAND —
+regenerate with:  python tools/gen_op_manifest.py --emit
+
+Generated op table (`ops.yaml` generator role): the public op
+surface, Tensor-method set, grad-checked set, and inplace pairs,
+emitted FROM the manifest so the schema is the single source of
+truth in both directions (tests/test_manifest_ops.py).
+"""
+
+# op name -> namespace that must resolve it
+PUBLIC_OPS = {
+    "paddle_tpu": (
+        "abs", "abs_", "accuracy", "acos", "acos_", "acosh", "acosh_", "add",
+        "add_", "add_n", "addmm", "addmm_", "all", "allclose", "amax",
+        "amin", "angle", "any", "arange", "argmax", "argmin", "argsort",
+        "as_complex", "as_real", "as_strided", "asin", "asin_", "asinh",
+        "asinh_", "assign", "atan", "atan2", "atan_", "atanh", "atanh_",
+        "atleast_1d", "atleast_2d", "atleast_3d", "auc", "bernoulli",
+        "bincount", "binomial", "bitwise_and", "bitwise_and_", "bitwise_not",
+        "bitwise_not_", "bitwise_or", "bitwise_or_", "bitwise_xor",
+        "bitwise_xor_", "bmm", "broadcast_shape", "broadcast_tensors",
+        "broadcast_to", "bucketize", "cast", "cast_", "cauchy_", "cdist",
+        "ceil", "ceil_", "cholesky", "cholesky_solve", "chunk", "clip",
+        "clip_", "clip_by_norm", "combinations", "complex", "concat", "conj",
+        "corrcoef", "cos", "cos_", "cosh", "cosh_", "count_nonzero", "cov",
+        "create_parameter", "create_tensor", "crop", "cross", "cummax",
+        "cummin", "cumprod", "cumprod_", "cumsum", "cumsum_",
+        "cumulative_trapezoid", "deg2rad", "det", "diag", "diag_embed",
+        "diagflat", "diagonal", "diagonal_scatter", "diff", "digamma",
+        "digamma_", "dirichlet", "dist", "divide", "divide_", "dot",
+        "dsplit", "edit_distance", "eig", "eigh", "eigvals", "eigvalsh",
+        "einsum", "empty", "empty_like", "equal", "equal_", "equal_all",
+        "erf", "erfinv", "erfinv_", "exp", "exp_", "expand", "expand_as",
+        "expm1", "exponential_", "eye", "fill", "fill_diagonal",
+        "fill_diagonal_tensor", "flatten", "flatten_", "flip", "floor",
+        "floor_", "floor_divide", "floor_divide_", "floor_mod", "floor_mod_",
+        "fmax", "fmin", "frac", "frac_", "frexp", "full", "full_like",
+        "gammaln", "gammaln_", "gather", "gather_nd", "gather_tree",
+        "gaussian", "gcd", "gcd_", "geometric_", "greater_equal",
+        "greater_equal_", "greater_than", "greater_than_", "heaviside",
+        "histogram", "histogramdd", "householder_product", "hsplit", "hypot",
+        "hypot_", "i0", "i0_", "i0e", "i1", "i1e", "identity_loss", "imag",
+        "increment", "index_add", "index_add_", "index_fill", "index_fill_",
+        "index_put", "index_put_", "index_sample", "index_select", "inner",
+        "inverse", "is_complex", "is_empty", "is_floating_point",
+        "is_integer", "is_tensor", "isclose", "isfinite", "isinf", "isnan",
+        "kron", "kthvalue", "lcm", "lcm_", "ldexp", "ldexp_", "lerp",
+        "lerp_", "less_equal", "less_equal_", "less_than", "less_than_",
+        "lgamma", "lgamma_", "linspace", "log", "log10", "log10_", "log1p",
+        "log1p_", "log2", "log2_", "log_", "logaddexp", "logcumsumexp",
+        "logical_and", "logical_and_", "logical_not", "logical_not_",
+        "logical_or", "logical_or_", "logical_xor", "logical_xor_", "logit",
+        "logit_", "logspace", "logsumexp", "lstsq", "lu", "lu_unpack",
+        "masked_fill", "masked_fill_", "masked_scatter", "masked_scatter_",
+        "masked_select", "matmul", "matrix_power", "matrix_rank", "max",
+        "maximum", "mean", "median", "meshgrid", "min", "minimum", "mm",
+        "mod", "mod_", "mode", "moveaxis", "multi_dot", "multigammaln",
+        "multigammaln_", "multinomial", "multiplex", "multiply", "multiply_",
+        "mv", "nan_to_num", "nan_to_num_", "nanmean", "nanmedian",
+        "nanquantile", "nansum", "neg", "neg_", "nextafter", "nonzero",
+        "norm", "normal_", "not_equal", "not_equal_", "numel", "one_hot",
+        "ones", "ones_like", "outer", "pad", "pca_lowrank", "pinv",
+        "poisson", "polar", "polygamma", "polygamma_", "pow", "pow_", "prod",
+        "put_along_axis", "put_along_axis_", "qr", "quantile", "rad2deg",
+        "randint", "randperm", "rank", "real", "reciprocal", "reciprocal_",
+        "remainder", "remainder_", "renorm", "renorm_", "repeat_interleave",
+        "reshape", "reshape_", "reverse", "roll", "rot90", "round", "round_",
+        "rsqrt", "rsqrt_", "scale", "scale_", "scatter", "scatter_",
+        "scatter_nd", "scatter_nd_add", "searchsorted", "select_scatter",
+        "sgn", "shape", "shard_index", "sigmoid", "sigmoid_", "sign",
+        "signbit", "sin", "sin_", "sinh", "sinh_", "slice", "slice_scatter",
+        "slogdet", "solve", "sort", "split", "split_with_num", "sqrt",
+        "sqrt_", "square", "squeeze", "squeeze_", "stack", "standard_gamma",
+        "stanh", "std", "strided_slice", "subtract", "subtract_", "sum",
+        "svd", "t", "t_", "take", "take_along_axis", "tan", "tan_", "tanh",
+        "tanh_", "temporal_shift", "tensor_split", "tensordot", "tile",
+        "top_p_sampling", "topk", "trace", "transpose", "transpose_",
+        "trapezoid", "triangular_solve", "tril", "tril_", "tril_indices",
+        "triu", "triu_", "triu_indices", "trunc", "trunc_", "unbind",
+        "unflatten", "unfold", "uniform", "uniform_", "unique",
+        "unique_consecutive", "unsqueeze", "unsqueeze_", "unstack", "vander",
+        "var", "view", "view_as", "viterbi_decode", "vsplit", "where",
+        "where_", "zeros", "zeros_like",
+    ),
+    "paddle_tpu.geometric": (
+        "reindex_graph", "send_u_recv", "send_ue_recv", "send_uv",
+        "weighted_sample_neighbors",
+    ),
+    "paddle_tpu.linalg": (
+        "cond",
+    ),
+    "paddle_tpu.nn.functional": (
+        "affine_grid", "batch_norm", "bilinear", "celu", "channel_shuffle",
+        "class_center_sample", "conv2d", "conv2d_transpose", "conv3d",
+        "conv3d_transpose", "dropout", "elu", "embedding",
+        "flash_attn_unpadded", "fold", "gelu", "grid_sample", "group_norm",
+        "gumbel_softmax", "hardshrink", "hardsigmoid", "hardswish",
+        "hardtanh", "hsigmoid_loss", "instance_norm", "label_smooth",
+        "layer_norm", "leaky_relu", "log_loss", "log_softmax",
+        "margin_cross_entropy", "maxout", "mish", "nll_loss",
+        "pixel_shuffle", "pixel_unshuffle", "prelu", "relu", "relu6",
+        "rms_norm", "rrelu", "selu", "sequence_mask", "silu", "softmax",
+        "softplus", "softshrink", "softsign", "swish", "thresholded_relu",
+    ),
+    "paddle_tpu.nn.quant": (
+        "llm_int8_linear", "weight_dequantize", "weight_only_linear",
+        "weight_quantize",
+    ),
+    "paddle_tpu.signal": (
+        "frame", "istft", "overlap_add", "stft",
+    ),
+    "paddle_tpu.vision.ops": (
+        "box_coder", "decode_jpeg", "distribute_fpn_proposals",
+        "generate_proposals", "matrix_nms", "nms", "prior_box", "psroi_pool",
+        "read_file", "roi_align", "roi_pool", "yolo_box", "yolo_loss",
+    ),
+}
+
+TENSOR_METHODS = (
+    "abs", "abs_", "acos", "acos_", "acosh", "acosh_", "add", "add_",
+    "add_n", "addmm", "addmm_", "all", "allclose", "amax", "amin", "angle",
+    "any", "argmax", "argmin", "argsort", "as_complex", "as_real",
+    "as_strided", "asin", "asin_", "asinh", "asinh_", "assign", "atan",
+    "atan2", "atan_", "atanh", "atanh_", "atleast_1d", "atleast_2d",
+    "atleast_3d", "auc", "bernoulli", "bincount", "binomial", "bitwise_and",
+    "bitwise_and_", "bitwise_not", "bitwise_not_", "bitwise_or",
+    "bitwise_or_", "bitwise_xor", "bitwise_xor_", "bmm", "broadcast_shape",
+    "broadcast_tensors", "broadcast_to", "bucketize", "cast", "cast_",
+    "cauchy_", "cdist", "ceil", "ceil_", "cholesky", "cholesky_solve",
+    "chunk", "clip", "clip_", "clip_by_norm", "combinations", "complex",
+    "concat", "cond", "conj", "corrcoef", "cos", "cos_", "cosh", "cosh_",
+    "count_nonzero", "cov", "create_tensor", "crop", "cross", "cummax",
+    "cummin", "cumprod", "cumprod_", "cumsum", "cumsum_",
+    "cumulative_trapezoid", "deg2rad", "det", "diag", "diag_embed",
+    "diagflat", "diagonal", "diagonal_scatter", "diff", "digamma",
+    "digamma_", "dirichlet", "dist", "divide", "divide_", "dot", "dsplit",
+    "edit_distance", "eig", "eigh", "eigvals", "eigvalsh", "einsum",
+    "empty_like", "equal", "equal_", "equal_all", "erf", "erfinv", "erfinv_",
+    "exp", "exp_", "expand", "expand_as", "expm1", "exponential_", "fill",
+    "fill_diagonal", "fill_diagonal_tensor", "flatten", "flatten_", "flip",
+    "floor", "floor_", "floor_divide", "floor_divide_", "floor_mod",
+    "floor_mod_", "fmax", "fmin", "frac", "frac_", "frexp", "full_like",
+    "gammaln", "gammaln_", "gather", "gather_nd", "gather_tree", "gaussian",
+    "gcd", "gcd_", "geometric_", "greater_equal", "greater_equal_",
+    "greater_than", "greater_than_", "heaviside", "histogram", "histogramdd",
+    "householder_product", "hsplit", "hypot", "hypot_", "i0", "i0_", "i0e",
+    "i1", "i1e", "identity_loss", "imag", "increment", "index_add",
+    "index_add_", "index_fill", "index_fill_", "index_put", "index_put_",
+    "index_sample", "index_select", "inner", "inverse", "is_complex",
+    "is_empty", "is_floating_point", "is_integer", "is_tensor", "isclose",
+    "isfinite", "isinf", "isnan", "istft", "kron", "kthvalue", "lcm", "lcm_",
+    "ldexp", "ldexp_", "lerp", "lerp_", "less_equal", "less_equal_",
+    "less_than", "less_than_", "lgamma", "lgamma_", "log", "log10", "log10_",
+    "log1p", "log1p_", "log2", "log2_", "log_", "logaddexp", "logcumsumexp",
+    "logical_and", "logical_and_", "logical_not", "logical_not_",
+    "logical_or", "logical_or_", "logical_xor", "logical_xor_", "logit",
+    "logit_", "logsumexp", "lstsq", "lu", "lu_unpack", "masked_fill",
+    "masked_fill_", "masked_scatter", "masked_scatter_", "masked_select",
+    "matmul", "matrix_power", "matrix_rank", "max", "maximum", "mean",
+    "median", "min", "minimum", "mm", "mod", "mod_", "mode", "moveaxis",
+    "multi_dot", "multigammaln", "multigammaln_", "multinomial", "multiplex",
+    "multiply", "multiply_", "mv", "nan_to_num", "nan_to_num_", "nanmean",
+    "nanmedian", "nanquantile", "nansum", "neg", "neg_", "nextafter",
+    "nonzero", "norm", "normal_", "not_equal", "not_equal_", "numel",
+    "one_hot", "ones_like", "outer", "pad", "pca_lowrank", "pinv", "poisson",
+    "polar", "polygamma", "polygamma_", "pow", "pow_", "prod",
+    "put_along_axis", "put_along_axis_", "qr", "quantile", "rad2deg", "rank",
+    "real", "reciprocal", "reciprocal_", "remainder", "remainder_", "renorm",
+    "renorm_", "repeat_interleave", "reshape", "reshape_", "reverse", "roll",
+    "rot90", "round", "round_", "rsqrt", "rsqrt_", "scale", "scale_",
+    "scatter", "scatter_", "scatter_nd", "scatter_nd_add", "searchsorted",
+    "select_scatter", "sgn", "shape", "shard_index", "sigmoid", "sigmoid_",
+    "sign", "signbit", "sin", "sin_", "sinh", "sinh_", "slice",
+    "slice_scatter", "slogdet", "solve", "sort", "split", "split_with_num",
+    "sqrt", "sqrt_", "square", "squeeze", "squeeze_", "stack",
+    "standard_gamma", "stanh", "std", "stft", "strided_slice", "subtract",
+    "subtract_", "sum", "svd", "t", "t_", "take", "take_along_axis", "tan",
+    "tan_", "tanh", "tanh_", "temporal_shift", "tensor_split", "tensordot",
+    "tile", "top_p_sampling", "topk", "trace", "transpose", "transpose_",
+    "trapezoid", "triangular_solve", "tril", "tril_", "tril_indices", "triu",
+    "triu_", "triu_indices", "trunc", "trunc_", "unbind", "unflatten",
+    "unfold", "uniform_", "unique", "unique_consecutive", "unsqueeze",
+    "unsqueeze_", "unstack", "vander", "var", "view", "view_as",
+    "viterbi_decode", "vsplit", "where", "where_", "zeros_like",
+)
+
+GRAD_CHECKED = (
+    "abs", "acos", "acosh", "add", "asin", "asinh", "atan", "atan2", "atanh",
+    "cos", "cosh", "digamma", "divide", "erf", "erfinv", "exp", "expm1",
+    "fmax", "fmin", "gammaln", "hypot", "i0", "i0e", "i1", "i1e", "lerp",
+    "lgamma", "log", "log10", "log1p", "log2", "logaddexp", "logit",
+    "maximum", "minimum", "multiply", "neg", "pow", "reciprocal", "rsqrt",
+    "sigmoid", "sin", "sinh", "sqrt", "square", "subtract", "tan", "tanh",
+)
+
+INPLACE_OPS = (
+    "abs", "acos", "acosh", "add", "addmm", "asin", "asinh", "atan", "atanh",
+    "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor", "cast",
+    "ceil", "clip", "cos", "cosh", "cumprod", "cumsum", "digamma", "divide",
+    "elu", "equal", "erf", "erfinv", "exp", "expm1", "fill", "fill_diagonal",
+    "flatten", "floor", "floor_divide", "floor_mod", "frac", "gammaln",
+    "gcd", "greater_equal", "greater_than", "hardtanh", "hypot", "i0",
+    "index_add", "index_fill", "index_put", "lcm", "ldexp", "leaky_relu",
+    "lerp", "less_equal", "less_than", "lgamma", "log", "log10", "log1p",
+    "log2", "logical_and", "logical_not", "logical_or", "logical_xor",
+    "logit", "masked_fill", "masked_scatter", "mod", "multigammaln",
+    "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",
+    "put_along_axis", "reciprocal", "relu", "remainder", "renorm", "reshape",
+    "round", "rsqrt", "scale", "scatter", "sigmoid", "sin", "sinh",
+    "softmax", "sqrt", "square", "squeeze", "subtract", "t", "tan", "tanh",
+    "thresholded_relu", "transpose", "tril", "triu", "trunc", "uniform",
+    "unsqueeze", "where",
+)
+
+
+def validate():
+    """Resolve the generated surface against the live package;
+    returns a list of violations (empty == green)."""
+    import importlib
+
+    problems = []
+    for where, names in PUBLIC_OPS.items():
+        mod = importlib.import_module(where)
+        for n in names:
+            if getattr(mod, n, None) is None:
+                problems.append(f"{where}.{n} missing")
+    from paddle_tpu.core.tensor import Tensor
+
+    for n in TENSOR_METHODS:
+        if not hasattr(Tensor, n):
+            problems.append(f"Tensor.{n} missing")
+    import paddle_tpu as P
+
+    for n in INPLACE_OPS:
+        t = n + '_'
+        if (getattr(P, t, None) is None and not hasattr(Tensor, t)
+                and getattr(P.nn.functional, t, None) is None):
+            problems.append(f"inplace twin {t} missing")
+    return problems

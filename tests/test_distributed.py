@@ -384,6 +384,48 @@ def test_run_steps_repeat_matches_stacked():
                                np.asarray(stacked._value), rtol=2e-4)
 
 
+@pytest.mark.slow
+def test_engine_search_validates_against_compiler():
+    """Engine.search (VERDICT r4 Next #6): enumerate placements, rank
+    analytically, compile the leaders on the live mesh, audit the
+    predicted comm bytes against the collectives GSPMD actually inserted,
+    and pick the winner on the measured-informed estimate."""
+    from paddle_tpu.distributed.engine import Engine
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    topology.reset_topology()
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32)
+    eng = Engine(model=GPTForCausalLM(cfg),
+                 loss=GPTPretrainingCriterion())
+    rs = np.random.RandomState(0)
+    xs = rs.randint(0, 256, (8, 32)).astype(np.int32)
+    ys = rs.randint(0, 256, (8, 32)).astype(np.int32)
+    best, trials = eng.search(
+        model_factory=lambda: GPTForCausalLM(cfg),
+        optimizer_factory=lambda params: P.optimizer.AdamW(
+            parameters=params, learning_rate=1e-3),
+        sample_batch=(xs, ys), global_batch=8, seq_len=32, top_k=3)
+    # >=3 plans validated against compiler ground truth, within tolerance
+    assert len(trials) >= 3, trials
+    for t in trials:
+        assert t["measured_bytes"] > 0, t
+        assert 1 / 3 <= t["agreement"] <= 3, (
+            f"predicted comm bytes disagree with compiler truth: {t}")
+    s = best["strategy"]
+    assert s["dp_degree"] * s["mp_degree"] * s["pp_degree"] == 8
+    assert best["measured_time_s"] == min(
+        t["measured_time_s"] for t in trials)
+    # the engine carries the winner: prepare + one step trains under it,
+    # including the ZeRO stage the search measured (not silently stage-0)
+    eng.prepare(global_batch=8, seq_len=32)
+    assert eng._step.sharding_stage == s.get("sharding_stage", 0)
+    loss = eng._step(P.to_tensor(xs), P.to_tensor(ys))
+    assert np.isfinite(float(np.asarray(loss._value)))
+
+
 def test_completion_reshard_evidence():
     """distributed.completion: the compiled hybrid step must show GSPMD's
     completion (per-value shardings incl. the mp axis) and reshard

@@ -26,10 +26,12 @@ from paddle_tpu.backend_guard import probe_default_backend
 p = probe_default_backend(timeout=90.0, retries=1)
 sys.exit(0 if p is not None and p[0] in ("tpu", "axon") else 1)'
 
+STATE=tools/tunnel_state.json
 echo "[$(date +%H:%M:%S)] tunnel_watch start" >>"$LOG"
 CAPTURES=0
 while true; do
     if python -c "$PROBE" >>"$LOG" 2>&1; then
+        printf '{"status": "up", "t": %s}\n' "$(date +%s)" >"$STATE"
         echo "[$(date +%H:%M:%S)] tunnel UP — running chip_session" >>"$LOG"
         timeout 5400 python tools/chip_session.py >>"$LOG" 2>&1
         rc=$?
@@ -39,6 +41,7 @@ while true; do
         # captures stay fresh without hogging the chip
         sleep 2400
     else
+        printf '{"status": "down", "t": %s}\n' "$(date +%s)" >"$STATE"
         echo "[$(date +%H:%M:%S)] tunnel down" >>"$LOG"
         sleep 150
     fi
