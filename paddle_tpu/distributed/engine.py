@@ -40,7 +40,11 @@ def _infer_shape(model, seq_len=1024, global_batch=32):
             ffn_hidden=getattr(cfg, "ffn_hidden", None)
             or 4 * cfg.hidden_size,
             num_heads=cfg.num_heads,
-            seq_len=getattr(cfg, "max_seq_len", seq_len),
+            # the WORKLOAD's sequence length prices compute and comm
+            # commensurately (cfg.max_seq_len only caps it) — costing at
+            # max_seq_len while measuring comm at seq_len would skew the
+            # re-rank whenever they differ
+            seq_len=min(seq_len, getattr(cfg, "max_seq_len", seq_len)),
             vocab_size=getattr(cfg, "vocab_size", 50304),
             num_layers=cfg.num_layers)
     # fall back: estimate from parameter shapes (largest 2-D weight is
@@ -86,6 +90,30 @@ def plan(model, n_devices=None, global_batch=32, seq_len=1024, chip=None,
     return ranked[:top_k]
 
 
+def _strategy_from_dict(d):
+    """Candidate.as_strategy() dict → DistributedStrategy (one shared
+    conversion: search() measures and prepare() builds the SAME config)."""
+    from . import fleet
+
+    stage = d.get("sharding_stage", 0)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": d.get("dp_degree", 1),
+        "mp_degree": d.get("mp_degree", 1),
+        "pp_degree": d.get("pp_degree", 1),
+        "sep_degree": d.get("sep_degree", 1),
+        # ZeRO shards over the dp axis unless explicitly set
+        "sharding_degree": d.get("sharding_degree",
+                                 d.get("dp_degree", 1) if stage else 1),
+    }
+    if stage:
+        # what build_train_step actually reads (fleet.__init__):
+        # strategy.sharding + sharding_configs["stage"]
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": stage}
+    return strategy
+
+
 class Engine:
     """Plan → topology → compiled step → run (static Engine role)."""
 
@@ -115,33 +143,20 @@ class Engine:
             self.strategy = self.plan_result.as_strategy()
         strategy = self.strategy
         if isinstance(strategy, dict):  # a Candidate.as_strategy() dict
-            d = strategy
-            strategy = fleet.DistributedStrategy()
-            stage = d.get("sharding_stage", 0)
-            strategy.hybrid_configs = {
-                "dp_degree": d.get("dp_degree", 1),
-                "mp_degree": d.get("mp_degree", 1),
-                "pp_degree": d.get("pp_degree", 1),
-                "sep_degree": d.get("sep_degree", 1),
-                # ZeRO shards over the dp axis unless explicitly set
-                "sharding_degree": d.get("sharding_degree",
-                                         d.get("dp_degree", 1)
-                                         if stage else 1),
-            }
-            if stage:
-                # what build_train_step actually reads (fleet.__init__):
-                # strategy.sharding + sharding_configs["stage"]
-                strategy.sharding = True
-                strategy.sharding_configs = {"stage": stage}
+            strategy = _strategy_from_dict(strategy)
         topology.reset_topology()
         fleet.init(is_collective=True, strategy=strategy)
         # search() leaves factories behind: rebuild the net under the
-        # winning topology (TP layers read mesh degrees at construction)
-        if getattr(self, "_model_factory", None) is not None:
+        # winning topology (TP layers read mesh degrees at construction).
+        # A rebuilt model also invalidates any pre-existing optimizer —
+        # its parameter list references the discarded instance.
+        rebuilt = getattr(self, "_model_factory", None) is not None
+        if rebuilt:
             self.model = self._model_factory()
         self._wrapped = fleet.distributed_model(self.model)
         opt = self.optimizer
-        if opt is None and getattr(self, "_opt_factory", None) is not None:
+        if getattr(self, "_opt_factory", None) is not None and (
+                opt is None or rebuilt):
             opt = self._opt_factory(self._wrapped.parameters())
         opt = fleet.distributed_optimizer(opt)
         self._step = self._wrapped.build_train_step(
@@ -202,15 +217,8 @@ class Engine:
             if cand.pp > 1 or global_batch % cand.dp != 0:
                 continue
             topology.reset_topology()
-            strategy = fleet.DistributedStrategy()
-            strategy.hybrid_configs = {
-                "dp_degree": cand.dp, "mp_degree": cand.mp,
-                "pp_degree": 1, "sep_degree": 1,
-                "sharding_degree": cand.dp}
-            if cand.sharding_stage:
-                strategy.sharding = True
-                strategy.sharding_configs = {"stage": cand.sharding_stage}
-            fleet.init(is_collective=True, strategy=strategy)
+            fleet.init(is_collective=True,
+                       strategy=_strategy_from_dict(cand.as_strategy()))
             P.seed(0)
             model = fleet.distributed_model(model_factory())
             opt = fleet.distributed_optimizer(

@@ -42,6 +42,13 @@ def test_op_table_generated_no_drift():
     assert emit_op_table(recorded) == on_disk, (
         "generated op table drifted — regenerate with "
         "python tools/gen_op_manifest.py --emit")
+    from gen_op_manifest import OPS_DOC_PATH, emit_ops_doc
+
+    with open(OPS_DOC_PATH) as f:
+        doc_on_disk = f.read()
+    assert emit_ops_doc(recorded) == doc_on_disk, (
+        "generated docs/OPS.md drifted — regenerate with "
+        "python tools/gen_op_manifest.py --emit")
 
 
 def test_op_table_validates_against_live_package():

@@ -411,6 +411,73 @@ def test_trie_eviction_then_permanent_eager():
         cap.MAX_PATHS_PER_SIG, cap.MAX_TRIE_RESETS = old_paths, old_resets
 
 
+def test_replay_container_tensor_inplace_vs_rebinding():
+    """Replay-time container semantics (VERDICT r4 Next #8 torture): an
+    implicit (closure-container) tensor binds by OBJECT IDENTITY and is
+    re-read live at every replay — in-place value updates are visible
+    (the optimizer-step contract), while REBINDING the container slot to
+    a brand-new Tensor is invisible within a signature (identity guard,
+    same observable contract as the reference's id()-based guards,
+    `sot/opcode_translator/executor/guard.py`). docs/SOT.md §contract."""
+    holder = [P.to_tensor(np.float32(2.0))]
+
+    def f(x):
+        return x * holder[0]
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose(sf(x).numpy(), [2, 2])
+    # in-place update of the SAME Tensor object: visible on replay
+    holder[0].set_value(P.to_tensor(np.float32(7.0)))
+    np.testing.assert_allclose(sf(x).numpy(), [7, 7])
+    # rebinding the slot to a NEW Tensor: invisible within the signature
+    holder[0] = P.to_tensor(np.float32(11.0))
+    np.testing.assert_allclose(sf(x).numpy(), [7, 7])
+    # a new signature recaptures and sees the rebound object
+    x3 = P.to_tensor(np.ones(3, np.float32))
+    np.testing.assert_allclose(sf(x3).numpy(), [11, 11, 11])
+
+
+def test_returned_container_mutation_does_not_corrupt_cache():
+    """Mutating the RETURNED container between calls must not corrupt the
+    cached chain: outputs are rebuilt from the template per replay, never
+    aliased to caller-visible structures."""
+    def f(x):
+        return {"a": x * 2.0, "b": [x + 1.0, x + 2.0]}
+
+    sf = symbolic_translate(f)
+    x = P.to_tensor(np.ones(2, np.float32))
+    out1 = sf(x)
+    out1["b"].pop()          # mutate returned structures
+    out1["a"] = None
+    out1["junk"] = object()
+    y = P.to_tensor(np.full(2, 3.0, np.float32))
+    out2 = sf(y)             # cached replay: fresh, correct structure
+    np.testing.assert_allclose(out2["a"].numpy(), [6, 6])
+    assert len(out2["b"]) == 2
+    np.testing.assert_allclose(out2["b"][1].numpy(), [5, 5])
+    assert _entry(sf)["paths"] == 1
+
+
+def test_input_dict_structure_change_recaptures():
+    """Container STRUCTURE is part of the entry signature: adding a key
+    recaptures instead of replaying the stale path."""
+    def f(d):
+        out = d["a"] * 2.0
+        if "b" in d:
+            out = out + d["b"]
+        return out
+
+    sf = symbolic_translate(f)
+    a = P.to_tensor(np.ones(2, np.float32))
+    b = P.to_tensor(np.full(2, 10.0, np.float32))
+    np.testing.assert_allclose(sf({"a": a}).numpy(), [2, 2])
+    np.testing.assert_allclose(sf({"a": a, "b": b}).numpy(), [12, 12])
+    # both signatures stay cached and correct
+    np.testing.assert_allclose(sf({"a": a}).numpy(), [2, 2])
+    assert len(sf._entries) == 2
+
+
 def test_large_forced_array_key_is_bounded():
     """numpy()-forced arrays key branches by sha1 digest, not raw bytes —
     trie memory stays O(paths), not O(paths * array size) (ADVICE r3)."""

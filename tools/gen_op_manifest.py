@@ -341,10 +341,51 @@ def emit_op_table(manifest) -> str:
     return "\n".join(lines)
 
 
+OPS_DOC_PATH = os.path.join(REPO, "docs", "OPS.md")
+
+
+def emit_ops_doc(manifest) -> str:
+    """Render docs/OPS.md from the manifest: the public op surface with
+    namespace, grad-check status, inplace twin, and test coverage — the
+    doc-stub half of the ops.yaml generator role."""
+    lines = [
+        "<!-- AUTO-GENERATED from OPS_MANIFEST.json by",
+        "     tools/gen_op_manifest.py --emit.  DO NOT EDIT BY HAND. -->",
+        "",
+        "# Op surface (generated)",
+        "",
+        f"{manifest['present']}/{manifest['total']} reference ops present "
+        f"({manifest['coverage_pct']}% of the applicable surface; "
+        f"{manifest['internal']} kernel-internal names subsumed by "
+        "XLA/the fused train step — see tools/gen_op_manifest.py "
+        "INTERNAL_OPS for the per-group story).",
+        "",
+        "| op | namespace | Tensor method | grad-checked | inplace twin "
+        "| tests |",
+        "|---|---|---|---|---|---|",
+    ]
+    for e in manifest["ops"]:
+        if not e["present"]:
+            continue
+        lines.append(
+            f"| `{e['name']}` | {e['where']} "
+            f"| {'yes' if e['tensor_method'] else ''} "
+            f"| {'yes' if e['grad'] == 'checked' else ''} "
+            f"| {'yes' if e['inplace'] else ''} "
+            f"| {len(e['tested_by'])} |")
+    missing = [e["name"] for e in manifest["ops"]
+               if not e["present"] and not e["internal"]]
+    if missing:
+        lines += ["", "Missing (tracked): " +
+                  " ".join(f"`{n}`" for n in missing)]
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main():
     out_path = os.path.join(REPO, "OPS_MANIFEST.json")
     if "--emit" in sys.argv:
-        # emit the generated op table from the RECORDED manifest (the
+        # emit the generated artifacts from the RECORDED manifest (the
         # committed schema — no paddle_tpu import needed); --check guards
         # recorded-vs-fresh separately
         with open(out_path) as f:
@@ -352,6 +393,9 @@ def main():
         with open(OP_TABLE_PATH, "w") as f:
             f.write(emit_op_table(recorded))
         print(f"wrote {OP_TABLE_PATH}")
+        with open(OPS_DOC_PATH, "w") as f:
+            f.write(emit_ops_doc(recorded))
+        print(f"wrote {OPS_DOC_PATH}")
         return 0
     manifest = generate()
     if manifest["unproven"]:
