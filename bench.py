@@ -356,7 +356,16 @@ def _emit_from_chip_session(reason: str) -> bool:
     return True
 
 
+_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", ".jax_tpu_cache")
+
+
 def main() -> None:
+    # share the watcher's persistent TPU compile cache: programs compiled
+    # in an earlier tunnel window load instead of recompiling
+    from paddle_tpu.backend_guard import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(_TPU_CACHE)
     if "--force-cpu" in sys.argv[1:]:
         from paddle_tpu.backend_guard import force_cpu_mesh
 

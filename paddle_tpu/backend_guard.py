@@ -36,6 +36,25 @@ _PROBE_CODE = (
 )
 
 
+def enable_persistent_compile_cache(cache_dir: str,
+                                    min_compile_secs: float = 1.0) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir`` (one
+    shared helper so the watcher's chip sessions and the driver's
+    bench.py read/write the SAME executable cache — on a tunnel that
+    yields minutes-long windows, compile reuse across processes is the
+    difference between a window producing data and producing nothing).
+    Returns True when enabled."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+        return True
+    except Exception:
+        return False
+
+
 def probe_default_backend(timeout: float = 120.0, retries: int = 2,
                           backoff: float = 0.0):
     """Probe the default jax backend in a subprocess.

@@ -71,21 +71,25 @@ def _sync_fetch(r):
                             np.float32)[0])
 
 
-def _slope_time(f, n1=2, n2=6) -> float:
-    """Per-iteration seconds of `f` (a nullary fn returning a jax array),
-    amortizing the tunnel's fixed dispatch+fetch overhead."""
-    def chain(n):
-        r = None
-        for _ in range(n):
-            r = f()
-        _sync_fetch(r)
+def _slope_time(f, x, n1=2, n2=8) -> float:
+    """Per-iteration seconds of shape-preserving `f` starting from `x`.
 
-    chain(1)  # compile + warm
+    The whole chain runs inside ONE jitted fori_loop with a traced trip
+    count (round-5 methodology v2, PERF.md): chaining separate dispatches
+    measures the tunnel's ~17 ms per-dispatch stall, not the kernel —
+    r4's autotune picks at sub-10 ms kernel times were dispatch noise.
+    One dispatch + one fetch per timing; the (d2-d1)/(n2-n1) difference
+    cancels the constant."""
+    @jax.jit
+    def loop(x, n):
+        return jax.lax.fori_loop(0, n, lambda i, y: f(y), x)
+
+    _sync_fetch(loop(x, n1))  # compile + warm
     t0 = time.perf_counter()
-    chain(n1)
+    _sync_fetch(loop(x, n1))
     d1 = time.perf_counter() - t0
     t0 = time.perf_counter()
-    chain(n2)
+    _sync_fetch(loop(x, n2))
     d2 = time.perf_counter() - t0
     return max((d2 - d1) / (n2 - n1), 1e-9)
 
@@ -93,10 +97,11 @@ def _slope_time(f, n1=2, n2=6) -> float:
 def pick(op: str, signature, candidates, run, default):
     """Return the fastest of `candidates` for this signature.
 
-    run(config) must execute the kernel with that config on REAL device
-    data and return a jax array. Results are cached under
-    (device_kind, op, signature). Falls back to `default` when autotune
-    is disabled or every candidate fails.
+    run(config) must return ``(f, x)`` — a shape-preserving jax function
+    executing the kernel with that config and its REAL device input — so
+    timing can chain f inside one compiled loop (see _slope_time).
+    Results are cached under (device_kind, op, signature). Falls back to
+    `default` when autotune is disabled or every candidate fails.
     """
     if not _enabled() or len(candidates) <= 1:
         return default
@@ -118,7 +123,8 @@ def pick(op: str, signature, candidates, run, default):
     best, best_t, timings = None, float("inf"), {}
     for cfg in candidates:
         try:
-            t = _slope_time(lambda: run(cfg))
+            f, x = run(cfg)
+            t = _slope_time(f, x)
         except Exception:
             continue  # a config that fails to compile just loses
         timings[str(cfg)] = round(t * 1e3, 4)

@@ -1058,7 +1058,9 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
         return default
 
     def run(cfg):
-        # concrete dummy data, same signature; compiled eagerly per config
+        # concrete dummy data, same signature; the returned (f, x) pair
+        # chains fwd+bwd inside autotune's one-dispatch timing loop
+        # (grad(loss)(q) is q-shaped, so y = f(y) composes)
         rs = np.random.RandomState(0)
         hk = h_kv or h
         qv = jnp.asarray(rs.randn(b, sq, h, d), dtype)
@@ -1076,7 +1078,7 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
                 return _flash_core(qv, kv, vv, causal, cfg[0],
                                    cfg[1]).astype(jnp.float32).sum()
 
-        return jax.grad(loss)(qv)
+        return jax.grad(loss), qv
 
     sig = (f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
            + (f"|kv{h_kv}" if h_kv and h_kv != h else "")

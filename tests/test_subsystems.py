@@ -202,6 +202,72 @@ def test_launcher_env_build():
     assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
 
 
+@pytest.mark.slow
+def test_launcher_end_to_end(tmp_path):
+    """Shell out to the REAL launcher (SURVEY §4 mechanism 2c — the
+    reference's test_communication_api_base.py:59 drives
+    `python -m paddle.distributed.launch` the same way): two workers on
+    localhost, per-rank env wiring, per-rank log files, rc 0."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['PADDLE_TRAINER_ID'], 'of',\n"
+        "      os.environ['PADDLE_TRAINERS_NUM'], flush=True)\n")
+    log_dir = tmp_path / "logs"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep workers off the tunnel
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir), str(script)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "rank 0 of 2" in (log_dir / "workerlog.0").read_text()
+    assert "rank 1 of 2" in (log_dir / "workerlog.1").read_text()
+
+
+@pytest.mark.slow
+def test_launcher_restart_recovers_and_gives_up(tmp_path):
+    """--max_restart semantics end-to-end: a worker that fails once is
+    relaunched and the pod exits 0; a permanently failing worker exhausts
+    the budget and the launcher surfaces its exit code."""
+    import subprocess
+    import sys
+
+    marker = tmp_path / "attempted"
+    flaky = tmp_path / "flaky.py"
+    flaky.write_text(
+        f"import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        f"if not os.path.exists(m):\n"
+        f"    open(m, 'w').close()\n"
+        f"    sys.exit(7)\n"
+        f"print('recovered', flush=True)\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "2", "--log_dir", str(tmp_path / "l1"),
+         str(flaky)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "restarting pod" in r.stderr
+
+    dead = tmp_path / "dead.py"
+    dead.write_text("import sys; sys.exit(9)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--max_restart", "1", "--log_dir", str(tmp_path / "l2"),
+         str(dead)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 9, (r.stdout, r.stderr)
+    assert "giving up" in r.stderr
+
+
 def test_jit_save_load_roundtrip(tmp_path):
     P.seed(0)
     m = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 3))
