@@ -28,6 +28,10 @@ import jax.numpy as jnp
 from ..core import flags, rng
 from ..core.tensor import Tensor
 
+# decode steps per compiled lax.scan dispatch (generate's fast path): the
+# host leaves the token loop for this many steps at a time
+DECODE_CHUNK = 32
+
 
 def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
     """Shared attention-over-static-cache body for the model families.
@@ -155,9 +159,9 @@ class GenerationMixin:
 
     def _gen_programs(self, b, s0, cap, do_sample, temperature, top_k,
                       has_mask):
-        """Compiled prefill/decode programs, cached per signature — a
-        serving loop calling generate() repeatedly must not pay the XLA
-        compile per call."""
+        """Compiled prefill program, cached per signature — a serving
+        loop calling generate() repeatedly must not pay the XLA compile
+        per call. (Decode runs through `_decode_chunk_program`.)"""
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -175,19 +179,54 @@ class GenerationMixin:
                                  jnp.zeros((), jnp.int32), start)
             return logits[:, -1, :], caches
 
-        # caches are donated: the step overwrites one position in each
-        # buffer, and donation lets XLA update in place instead of
-        # copying ~2*L*B*H*max*D bytes every token
-        @functools.partial(jax.jit, donate_argnums=(3,))
-        def decode(params, buffers, tok, caches, pos, key, start):
-            logits, caches = run(params, buffers, tok[:, None], caches,
-                                 pos, start)
-            nxt = _sample(logits[:, -1, :], key, do_sample,
-                          temperature, top_k)
-            return nxt, caches
-
-        cache[sig] = (prefill, decode)
+        cache[sig] = prefill
         return cache[sig]
+
+    def _decode_chunk_program(self, n, b, cap, do_sample, temperature,
+                              top_k, has_mask, eos_token_id):
+        """n decode steps inside ONE compiled lax.scan (TPU-first: the
+        per-token python loop pays a host dispatch per token — tens of ms
+        through a tunneled PJRT — while the kernel itself is ~1 ms; the
+        scan removes the host from the loop entirely). Bit-identical to
+        n iterations of the single-step path: the PRNG split order, eos
+        freezing, and cache updates follow the same sequence. Caches are
+        donated: each step overwrites one position per buffer, and
+        donation lets XLA update in place instead of copying
+        ~2*L*B*H*max*D bytes every token."""
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        sig = ("chunk", n, b, cap, bool(do_sample), float(temperature),
+               int(top_k), bool(has_mask),
+               -1 if eos_token_id is None else int(eos_token_id))
+        hit = cache.get(sig)
+        if hit is not None:
+            return hit
+        run = self._model_run
+
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def decode_n(params, buffers, tok, caches, pos0, key, start,
+                     finished):
+            def body(carry, i):
+                tok, caches, key, finished = carry
+                key, sub = jax.random.split(key)
+                logits, caches = run(params, buffers, tok[:, None],
+                                     caches, pos0 + i, start)
+                nxt = _sample(logits[:, -1, :], sub, do_sample,
+                              temperature, top_k)
+                if eos_token_id is not None:
+                    # frozen rows keep emitting eos, not live continuations
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                return (nxt, caches, key, finished), (nxt, finished.all())
+
+            (tok, caches, key, finished), (toks, fin_all) = jax.lax.scan(
+                body, (tok, caches, key, finished),
+                jnp.arange(n, dtype=jnp.int32))
+            return toks.T, tok, caches, key, finished, fin_all
+
+        cache[sig] = decode_n
+        return decode_n
 
     # ---- beam search ----
     def _beam_programs(self, b, n, s0, cap, eos_id, length_penalty):
@@ -376,7 +415,7 @@ class GenerationMixin:
             params, buffers = self.functional_state()
             caches = self.init_kv_caches(b, max_len)
             cap = caches[0][0].shape[2]
-            prefill, decode = self._gen_programs(
+            prefill = self._gen_programs(
                 b, s0, cap, do_sample, temperature, top_k,
                 start is not None)
             key = (jax.random.PRNGKey(seed) if seed is not None
@@ -389,21 +428,41 @@ class GenerationMixin:
             finished = jnp.zeros((b,), bool)
             if eos_token_id is not None:
                 finished = tok == eos_token_id
-            out_toks = [tok]
-            for i in range(1, max_new_tokens):
+            # chunked scanned decode: CHUNK tokens per host dispatch (the
+            # per-token loop paid one dispatch — tens of ms on tunneled
+            # PJRT — per ~1 ms kernel). Token stream, PRNG order, and eos
+            # freezing are bit-identical to the single-step path; the
+            # all-finished early-exit is checked once per chunk and the
+            # exact per-token stop length restored by the trim below.
+            CHUNK = DECODE_CHUNK
+            chunks = [tok[:, None]]
+            fin_alls = [finished.all()[None]]
+            i = 1
+            while i < max_new_tokens:
                 if eos_token_id is not None and bool(
                         np.asarray(jax.device_get(finished.all()))):
                     break
-                key, sub = jax.random.split(key)
-                tok, caches = decode(params, buffers, tok, caches,
-                                     jnp.asarray(s0 + i - 1, jnp.int32),
-                                     sub, start)
-                if eos_token_id is not None:
-                    # frozen rows keep emitting eos, not live continuations
-                    tok = jnp.where(finished, eos_token_id, tok)
-                    finished = finished | (tok == eos_token_id)
-                out_toks.append(tok)
-            gen = jnp.stack(out_toks, axis=1)
+                n = min(CHUNK, max_new_tokens - i)
+                decode_n = self._decode_chunk_program(
+                    n, b, cap, do_sample, temperature, top_k,
+                    start is not None, eos_token_id)
+                toks, tok, caches, key, finished, fin_all = decode_n(
+                    params, buffers, tok, caches,
+                    jnp.asarray(s0 + i - 1, jnp.int32), key, start,
+                    finished)
+                chunks.append(toks)
+                fin_alls.append(fin_all)
+                i += n
+            gen = jnp.concatenate(chunks, axis=1)
+            if eos_token_id is not None and gen.shape[1] > 1:
+                # trim to the single-step loop's stop point: it breaks
+                # BEFORE step j+1 when all rows were finished after step
+                # j, so keep j+1 tokens for the earliest such j
+                fin_h = np.asarray(
+                    jax.device_get(jnp.concatenate(fin_alls)))
+                hits = np.flatnonzero(fin_h)
+                if hits.size:
+                    gen = gen[:, :int(hits[0]) + 1]
             return Tensor(jnp.concatenate([ids, gen], axis=1))
         finally:
             if was_training:

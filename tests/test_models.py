@@ -283,12 +283,44 @@ def test_generate_program_cache_reused():
     model = GPTForCausalLM(cfg)
     model.eval()
     prompt = P.to_tensor(np.ones((1, 3), np.int64), "int32")
+    # each signature caches a (prefill, decode) pair + the chunked-scan
+    # decode program
     model.generate(prompt, max_new_tokens=2)
-    assert len(model._gen_cache) == 1
-    model.generate(prompt, max_new_tokens=2)   # same sig -> cache hit
-    assert len(model._gen_cache) == 1
-    model.generate(prompt, max_new_tokens=2, do_sample=True, seed=0)
     assert len(model._gen_cache) == 2
+    model.generate(prompt, max_new_tokens=2)   # same sig -> cache hit
+    assert len(model._gen_cache) == 2
+    model.generate(prompt, max_new_tokens=2, do_sample=True, seed=0)
+    assert len(model._gen_cache) == 4
+
+
+def test_generate_chunked_decode_crosses_boundaries(monkeypatch):
+    """The scanned-decode fast path must be bit-identical across chunk
+    boundaries (token stream, PRNG order, eos trim) to a 1-token-per-
+    dispatch run — shrink DECODE_CHUNK so a short generate spans several
+    scans, and compare against CHUNK=1 which degenerates to the
+    single-step sequence."""
+    from paddle_tpu.models import generation
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=23, hidden_size=16, num_layers=1,
+                    num_heads=2, max_seq_len=64, use_rope=True)
+    prompt_np = np.ones((2, 3), np.int64)
+
+    def run(chunk, **kw):
+        monkeypatch.setattr(generation, "DECODE_CHUNK", chunk)
+        P.seed(6)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        return model.generate(P.to_tensor(prompt_np, "int32"),
+                              max_new_tokens=11, **kw).numpy()
+
+    # greedy, sampling (same seed -> same key stream), and eos trim
+    np.testing.assert_array_equal(run(4), run(1))
+    np.testing.assert_array_equal(run(4, do_sample=True, seed=3),
+                                  run(1, do_sample=True, seed=3))
+    a = run(4, eos_token_id=5)
+    b = run(1, eos_token_id=5)
+    np.testing.assert_array_equal(a, b)
 
 
 def test_llama_gqa_cache_stores_kv_heads_only():
