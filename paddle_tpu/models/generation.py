@@ -270,9 +270,11 @@ class GenerationMixin:
             scores = jnp.where(done, -1e30, scores)   # slot leaves the beam
             return scores, (fin_norm, fin_step, fin_beam)
 
-        @functools.partial(jax.jit, donate_argnums=(3,))
         def beam_step(params, buffers, tok, caches, pos, scores, lengths,
                       pool, step_idx):
+            # plain traceable body — jitted by the scanned program below
+            # (the whole beam loop runs in ONE dispatch; see
+            # _beam_scan_program)
             # tok: [B, N]; scores: [B, N] running log-probs (finished
             # slots already at -1e30); lengths: [B, N] tokens generated
             logits, caches = run(params, buffers,
@@ -297,6 +299,43 @@ class GenerationMixin:
         cache[sig] = (beam_prefill, beam_step, pool_update)
         return cache[sig]
 
+    def _beam_scan_program(self, steps, b, n, s0, cap, eos_id,
+                           length_penalty):
+        """steps-1 beam steps inside ONE compiled lax.scan (the beam loop
+        has no early exit, so the entire search after prefill is a single
+        dispatch; the per-step (tok, parent) history for backtracking is
+        the scan's stacked output). Caches donated, as in greedy decode."""
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        sig = ("beamscan", steps, b, n, cap,
+               -1 if eos_id is None else int(eos_id),
+               float(length_penalty))
+        hit = cache.get(sig)
+        if hit is not None:
+            return hit
+        _, beam_step, _ = self._beam_programs(b, n, s0, cap, eos_id,
+                                              length_penalty)
+
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def beam_scan(params, buffers, tok, caches, pos0, scores, lengths,
+                      pool):
+            def body(carry, i):
+                tok, scores, lengths, pool, caches = carry
+                tok, scores, parent, lengths, pool, caches = beam_step(
+                    params, buffers, tok, caches, pos0 + i - 1, scores,
+                    lengths, pool, i)
+                return (tok, scores, lengths, pool, caches), (tok, parent)
+
+            carry, hist = jax.lax.scan(
+                body, (tok, scores, lengths, pool, caches),
+                jnp.arange(1, steps, dtype=jnp.int32))
+            tok, scores, lengths, pool, caches = carry
+            return tok, scores, lengths, pool, caches, hist
+
+        cache[sig] = beam_scan
+        return beam_scan
+
     def _beam_search(self, ids, max_new_tokens, num_beams, eos_token_id,
                      length_penalty):
         b, s0 = ids.shape
@@ -305,7 +344,7 @@ class GenerationMixin:
         caches = self.init_kv_caches(b, s0 + max_new_tokens)
         # prefill at batch B (tiling N identical prefills would waste N-1x)
         cap = caches[0][0].shape[2]
-        beam_prefill, beam_step, pool_update = self._beam_programs(
+        beam_prefill, _, pool_update = self._beam_programs(
             b, n, s0, cap, eos_token_id, length_penalty)
 
         tok, scores, caches = beam_prefill(params, buffers, ids, caches)
@@ -317,15 +356,27 @@ class GenerationMixin:
                 jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32))
         if eos_token_id is not None:
             scores, pool = pool_update(0, tok, scores, lengths, pool)
-        history = [(tok, jnp.tile(jnp.arange(n), (b, 1)))]
-        for i in range(1, max_new_tokens):
-            tok, scores, parent, lengths, pool, caches = beam_step(
-                params, buffers, tok, caches,
-                jnp.asarray(s0 + i - 1, jnp.int32), scores, lengths, pool,
-                jnp.asarray(i, jnp.int32))
-            history.append((tok, parent))
+        tok0 = tok
+        par0 = jnp.tile(jnp.arange(n), (b, 1))
+        if max_new_tokens > 1:
+            beam_scan = self._beam_scan_program(
+                max_new_tokens, b, n, s0, cap, eos_token_id,
+                length_penalty)
+            tok, scores, lengths, pool, caches, (toks_s, pars_s) = \
+                beam_scan(params, buffers, tok, caches,
+                          jnp.asarray(s0, jnp.int32), scores, lengths,
+                          pool)
+            toks_all = np.concatenate(
+                [np.asarray(jax.device_get(tok0))[None],
+                 np.asarray(jax.device_get(toks_s))])
+            parents_all = np.concatenate(
+                [np.asarray(jax.device_get(par0))[None],
+                 np.asarray(jax.device_get(pars_s))])
+        else:
+            toks_all = np.asarray(jax.device_get(tok0))[None]
+            parents_all = np.asarray(jax.device_get(par0))[None]
         # pick per row: best finished hypothesis vs best live beam
-        steps = len(history)
+        steps = max_new_tokens
         live_norm = scores / (jnp.maximum(lengths, 1.0) ** length_penalty)
         live_best = jnp.argmax(live_norm, axis=1)
         live_val = jnp.take_along_axis(live_norm, live_best[:, None],
@@ -336,8 +387,6 @@ class GenerationMixin:
             jnp.where(use_fin, fin_step, steps - 1)))
         sel_beam = np.asarray(jax.device_get(
             jnp.where(use_fin, fin_beam, live_best.astype(jnp.int32))))
-        toks_h = [np.asarray(jax.device_get(t)) for t, _ in history]
-        parents_h = [np.asarray(jax.device_get(p)) for _, p in history]
         # rows whose winner finished at sel_step keep an eos-filled tail
         # (rectangular output)
         eos_fill = eos_token_id if eos_token_id is not None else 0
@@ -346,8 +395,8 @@ class GenerationMixin:
         rows = np.arange(b)
         for t in range(steps - 1, -1, -1):
             take = t <= sel_step
-            out[take, t] = toks_h[t][rows[take], beam[take]]
-            beam[take] = parents_h[t][rows[take], beam[take]]
+            out[take, t] = toks_all[t][rows[take], beam[take]]
+            beam[take] = parents_all[t][rows[take], beam[take]]
         return Tensor(jnp.concatenate([ids, jnp.asarray(out)], axis=1))
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
