@@ -1,0 +1,14 @@
+"""paddle.dataset parity (`python/paddle/dataset/`): the legacy
+reader-creator dataset namespace (still public in the reference's
+top-level import). Each module reads a LOCAL copy of its official
+archive from DATA_HOME (`common.DATA_HOME`; `PADDLE_TPU_DATA_HOME`
+overrides) or an explicit `data_file=` — this build has no network
+egress, so nothing auto-downloads. The modern tier is
+`paddle_tpu.vision.datasets` / `paddle_tpu.text` / `paddle_tpu.audio`.
+"""
+from . import (  # noqa: F401
+    cifar, common, conll05, flowers, image, imdb, imikolov, mnist,
+    movielens, uci_housing, voc2012, wmt14, wmt16,
+)
+
+__all__ = []

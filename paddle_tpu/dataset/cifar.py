@@ -1,0 +1,63 @@
+"""paddle.dataset.cifar parity (`python/paddle/dataset/cifar.py`):
+readers yielding (flattened float32 image / 255, label int)."""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from . import common
+from ..vision.datasets import Cifar10, Cifar100
+
+__all__ = []
+
+_NAME10 = "cifar-10-python.tar.gz"
+_NAME100 = "cifar-100-python.tar.gz"
+_HINT = "the CIFAR python tarballs"
+
+
+def reader_creator(filename, sub_name, cycle=False):
+    """cifar.py:47 — sub_name selects the split: CIFAR-100 uses
+    'train'/'test' members, CIFAR-10 'data_batch'/'test_batch' (which
+    also disambiguates the loader — the file PATH may contain '100'
+    without being the 100-class archive)."""
+    cls = Cifar10 if "batch" in sub_name else Cifar100
+    mode = "train" if "train" in sub_name or "data_batch" in sub_name \
+        else "test"
+    ds = cls(data_file=filename, mode=mode)
+
+    def reader():
+        it = itertools.cycle(range(len(ds))) if cycle else range(len(ds))
+        for i in it:
+            img, label = ds[i]
+            yield (np.asarray(img, np.float32).reshape(-1) / 255.0,
+                   int(label))
+
+    return reader
+
+
+def train100(data_file=None):
+    return reader_creator(
+        common.require_local("cifar", _NAME100, _HINT, data_file), "train")
+
+
+def test100(data_file=None):
+    return reader_creator(
+        common.require_local("cifar", _NAME100, _HINT, data_file), "test")
+
+
+def train10(cycle=False, data_file=None):
+    return reader_creator(
+        common.require_local("cifar", _NAME10, _HINT, data_file),
+        "data_batch", cycle=cycle)
+
+
+def test10(cycle=False, data_file=None):
+    return reader_creator(
+        common.require_local("cifar", _NAME10, _HINT, data_file),
+        "test_batch", cycle=cycle)
+
+
+def fetch():
+    return (common.require_local("cifar", _NAME10, _HINT),
+            common.require_local("cifar", _NAME100, _HINT))

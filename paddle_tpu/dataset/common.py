@@ -1,0 +1,124 @@
+"""paddle.dataset.common parity (`python/paddle/dataset/common.py`):
+DATA_HOME, archive lookup, md5, reader splitting. Zero-egress build:
+`download()` never fetches — it verifies a pre-placed local copy under
+DATA_HOME and raises with instructions otherwise (the same contract as
+`paddle_tpu.text.datasets`)."""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+__all__ = []
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "dataset"))
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None):
+    """Resolve the local copy of `url` under DATA_HOME/module_name (this
+    build has no network egress — reference common.py:73 would fetch).
+    Raises with placement instructions when the file is absent or fails
+    the md5 check."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split("/")[-1].split("?")[0])
+    if not os.path.exists(filename):
+        raise RuntimeError(
+            f"no network egress in this build: place the archive from "
+            f"{url} at {filename} (or set PADDLE_TPU_DATA_HOME)")
+    if md5sum and md5file(filename) != md5sum:
+        raise RuntimeError(
+            f"{filename} exists but fails its md5 check ({md5sum}); "
+            f"re-obtain the archive from {url}")
+    return filename
+
+
+def local_path(module_name, filename):
+    """DATA_HOME/module_name/filename (no existence check)."""
+    return os.path.join(DATA_HOME, module_name, filename)
+
+
+def require_local(module_name, filename, hint, override=None):
+    """The archive for a dataset module: `override` if given, else the
+    DATA_HOME location; raises with placement guidance when absent."""
+    path = override or local_path(module_name, filename)
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"paddle_tpu.dataset.{module_name}: archive not found at "
+            f"{path} (no network egress in this build). Obtain {hint} "
+            f"and place it there, set PADDLE_TPU_DATA_HOME, or pass "
+            f"data_file= explicitly.")
+    return path
+
+
+def fetch_all():
+    raise RuntimeError(
+        "fetch_all() downloads every corpus — unsupported in this "
+        "zero-egress build; place archives under DATA_HOME instead "
+        f"({DATA_HOME})")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split a reader's samples into chunked files of `line_count` each
+    (reference common.py:146). Returns the number of files written."""
+    if not callable(reader):
+        raise TypeError("reader should be callable")
+    if "%" not in suffix:
+        raise ValueError("suffix should contain %d")
+    lines = []
+    indx_f = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+        indx_f += 1
+    return indx_f
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Reader over this trainer's shard of the chunked files produced by
+    `split` (reference common.py:184)."""
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [f for i, f in enumerate(file_list)
+                    if i % trainer_count == trainer_id]
+        for fn in my_files:
+            with open(fn, "rb") as f:
+                for item in loader(f):
+                    yield item
+
+    return reader
+
+
+def _check_exists_and_download(path, url, md5, module_name, download_=True):
+    """Reference `_check_exists_and_download` role: path if it exists,
+    else the DATA_HOME copy (never a network fetch here)."""
+    if path and os.path.exists(path):
+        return path
+    if download_:
+        return download(url, module_name, md5)
+    raise ValueError(f"{path} not exists and auto download disabled")

@@ -1,0 +1,58 @@
+"""paddle.dataset.conll05 parity (`python/paddle/dataset/conll05.py`):
+SRL test-split reader + dict/embedding accessors, built on
+`paddle_tpu.text.Conll05st`."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+from ..text.datasets import Conll05st
+
+__all__ = []
+
+_FILES = {
+    "data_file": ("conll05st-tests.tar.gz", "the CoNLL-2005 test tar"),
+    "word_dict_file": ("wordDict.txt", "the CoNLL word dict"),
+    "verb_dict_file": ("verbDict.txt", "the CoNLL verb dict"),
+    "target_dict_file": ("targetDict.txt", "the CoNLL target dict"),
+}
+
+
+def _dataset(emb_file=None, **overrides):
+    kw = {}
+    for key, (name, hint) in _FILES.items():
+        kw[key] = common.require_local("conll05", name, hint,
+                                       overrides.get(key))
+    if emb_file is None:
+        p = common.local_path("conll05", "emb")
+        import os
+
+        emb_file = p if os.path.exists(p) else None
+    return Conll05st(emb_file=emb_file, **kw)
+
+
+def get_dict(**overrides):
+    """(word_dict, verb_dict, label_dict) (conll05.py:207)."""
+    return _dataset(**overrides).get_dict()
+
+
+def get_embedding(emb_file=None, **overrides):
+    """Path of the pretrained embedding file (conll05.py:229)."""
+    return _dataset(emb_file=emb_file, **overrides).get_embedding()
+
+
+def test(**overrides):
+    """Reader over the WSJ test split: 9-tuples of per-token index
+    sequences (conll05.py:242)."""
+    ds = _dataset(**overrides)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v) for v in ds[i])
+
+    return reader
+
+
+def fetch():
+    return tuple(common.require_local("conll05", name, hint)
+                 for name, hint in _FILES.values())

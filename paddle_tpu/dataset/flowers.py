@@ -1,0 +1,80 @@
+"""paddle.dataset.flowers parity (`python/paddle/dataset/flowers.py`):
+Oxford-102 readers; mapper applied via paddle_tpu.reader pipelines."""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from . import common
+from .. import reader as reader_mod
+from ..vision.datasets import Flowers
+
+__all__ = []
+
+_HINT = "102flowers.tgz + imagelabels.mat + setid.mat"
+
+
+def default_mapper(is_train, sample):
+    """Identity-with-layout mapper: the Dataset class already decodes;
+    reference flowers.py:58 resizes/crops via paddle.dataset.image."""
+    img, label = sample
+    return np.asarray(img), int(np.asarray(label).ravel()[0])
+
+
+train_mapper = lambda sample: default_mapper(True, sample)   # noqa: E731
+test_mapper = lambda sample: default_mapper(False, sample)   # noqa: E731
+
+
+def _dataset(mode, data_file=None, label_file=None, setid_file=None):
+    return Flowers(
+        data_file=common.require_local("flowers", "102flowers.tgz",
+                                       _HINT, data_file),
+        label_file=common.require_local("flowers", "imagelabels.mat",
+                                        _HINT, label_file),
+        setid_file=common.require_local("flowers", "setid.mat", _HINT,
+                                        setid_file),
+        mode=mode, download=False)
+
+
+def reader_creator(data_file, label_file, setid_file, dataset_name,
+                   mapper, buffered_size=1024, use_xmap=True,
+                   cycle=False):
+    # reference flag swap (flowers.py:53): TRAIN_FLAG='tstid' (the larger
+    # split trains); the vision class mode names already encode the swap
+    mode = {"tstid": "train", "trnid": "test", "valid": "valid"}.get(
+        dataset_name, dataset_name)
+    ds = _dataset(mode, data_file, label_file, setid_file)
+
+    def base_reader():
+        it = itertools.cycle(range(len(ds))) if cycle else range(len(ds))
+        for i in it:
+            yield ds[i]
+
+    if use_xmap:
+        return reader_mod.xmap_readers(mapper, base_reader, 4,
+                                       buffered_size)
+    return reader_mod.map_readers(mapper, base_reader)
+
+
+def train(mapper=train_mapper, buffered_size=1024, use_xmap=True,
+          cycle=False):
+    return reader_creator(None, None, None, "tstid", mapper,
+                          buffered_size, use_xmap, cycle)
+
+
+def test(mapper=test_mapper, buffered_size=1024, use_xmap=True,
+         cycle=False):
+    return reader_creator(None, None, None, "trnid", mapper,
+                          buffered_size, use_xmap, cycle)
+
+
+def valid(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    return reader_creator(None, None, None, "valid", mapper,
+                          buffered_size, use_xmap)
+
+
+def fetch():
+    return (common.require_local("flowers", "102flowers.tgz", _HINT),
+            common.require_local("flowers", "imagelabels.mat", _HINT),
+            common.require_local("flowers", "setid.mat", _HINT))

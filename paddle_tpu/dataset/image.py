@@ -1,0 +1,95 @@
+"""paddle.dataset.image parity (`python/paddle/dataset/image.py`):
+numpy/PIL image helpers for the legacy reader pipelines (the reference
+uses cv2; PIL is this build's decoder — same semantics, HWC uint8 in,
+documented layouts out)."""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = []
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "paddle_tpu.dataset.image needs Pillow for decoding") from e
+    return Image
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode an encoded image from bytes (image.py role): HWC uint8
+    (RGB) or HW (grayscale)."""
+    img = _pil().open(io.BytesIO(bytes_))
+    return np.asarray(img.convert("RGB" if is_color else "L"))
+
+
+def load_image(file_path, is_color=True):
+    with open(file_path, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def resize_short(im, size):
+    """Resize so the SHORter edge equals `size`, keeping aspect."""
+    h, w = im.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(round(w * size / h))
+    else:
+        new_h, new_w = int(round(h * size / w)), size
+    pil_img = _pil().fromarray(im)
+    return np.asarray(pil_img.resize((new_w, new_h)))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (image.py to_chw)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    return im[h_start:h_start + size, w_start:w_start + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """resize_short -> crop (random+flip when training, center else) ->
+    CHW float32, optionally mean-subtracted (image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and len(im.shape) == 3:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

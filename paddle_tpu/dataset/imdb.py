@@ -1,0 +1,75 @@
+"""paddle.dataset.imdb parity (`python/paddle/dataset/imdb.py`): the
+legacy reader API over the aclImdb archive (caller-provided word_idx, vs
+`paddle_tpu.text.Imdb` which builds its own)."""
+from __future__ import annotations
+
+import collections
+import re
+
+from . import common
+from ..text.datasets import imdb_tokenize
+
+__all__ = []
+
+_HINT = "aclImdb_v1.tar.gz (Stanford IMDB sentiment)"
+_NAME = "aclImdb_v1.tar.gz"
+
+
+def _archive(data_file=None):
+    return common.require_local("imdb", _NAME, _HINT, data_file)
+
+
+def tokenize(pattern, data_file=None):
+    """Token lists of tar members matching `pattern` (imdb.py:38)."""
+    yield from imdb_tokenize(_archive(data_file), pattern)
+
+
+def build_dict(pattern, cutoff, data_file=None):
+    """word -> id for words with freq > cutoff, ordered by (-freq, word),
+    '<unk>' appended (imdb.py:58)."""
+    freq = collections.defaultdict(int)
+    for doc in tokenize(pattern, data_file):
+        for w in doc:
+            freq[w] += 1
+    kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                  key=lambda x: (-x[1], x[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(kept)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx, data_file=None):
+    unk = word_idx["<unk>"]
+
+    def reader():
+        for doc in tokenize(pos_pattern, data_file):
+            yield [word_idx.get(w, unk) for w in doc], 0
+        for doc in tokenize(neg_pattern, data_file):
+            yield [word_idx.get(w, unk) for w in doc], 1
+
+    return reader
+
+
+def train(word_idx, data_file=None):
+    """Reader of (doc_ids, label) with label 0=pos 1=neg (imdb.py:107)."""
+    return reader_creator(
+        re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        re.compile(r"aclImdb/train/neg/.*\.txt$"), word_idx, data_file)
+
+
+def test(word_idx, data_file=None):
+    return reader_creator(
+        re.compile(r"aclImdb/test/pos/.*\.txt$"),
+        re.compile(r"aclImdb/test/neg/.*\.txt$"), word_idx, data_file)
+
+
+def word_dict(data_file=None, cutoff=150):
+    """The full-corpus dictionary at the reference's default cutoff
+    (imdb.py:157)."""
+    return build_dict(
+        re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+        cutoff, data_file)
+
+
+def fetch():
+    return _archive()

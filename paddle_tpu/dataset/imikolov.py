@@ -1,0 +1,98 @@
+"""paddle.dataset.imikolov parity (`python/paddle/dataset/imikolov.py`):
+PTB language-model readers with a caller-provided word_idx."""
+from __future__ import annotations
+
+import collections
+import tarfile
+
+from . import common
+
+__all__ = []
+
+_NAME = "simple-examples.tgz"
+_HINT = "the PTB simple-examples tarball"
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _archive(data_file=None):
+    return common.require_local("imikolov", _NAME, _HINT, data_file)
+
+
+def _member(tf, suffix):
+    for name in tf.getnames():
+        if name.endswith(suffix):
+            return tf.extractfile(name)
+    raise RuntimeError(f"imikolov: no member *{suffix} in archive")
+
+
+def word_count(f, word_freq=None):
+    """Accumulate word frequencies from a PTB file, counting <s>/<e>
+    per line (imikolov.py:40)."""
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for line in f:
+        for w in line.strip().split():
+            word_freq[w] += 1
+        word_freq[b"<s>"] += 1
+        word_freq[b"<e>"] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50, data_file=None):
+    """word -> id over train+valid with freq > min_word_freq, '<unk>'
+    appended; reference drops the corpus's own '<unk>' token first
+    (imikolov.py:53)."""
+    with tarfile.open(_archive(data_file)) as tf:
+        freq = word_count(_member(tf, "data/ptb.valid.txt"),
+                          word_count(_member(tf, "data/ptb.train.txt")))
+    freq.pop(b"<unk>", None)
+    kept = sorted(((w, c) for w, c in freq.items() if c > min_word_freq),
+                  key=lambda x: (-x[1], x[0]))
+    word_idx = {w.decode(): i for i, (w, _) in enumerate(kept)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def reader_creator(filename, word_idx, n, data_type, data_file=None):
+    def reader():
+        unk = word_idx["<unk>"]
+        with tarfile.open(_archive(data_file)) as tf:
+            for line in _member(tf, filename):
+                words = [w.decode() for w in line.strip().split()]
+                if data_type == DataType.NGRAM:
+                    assert n > -1, "Invalid gram length"
+                    toks = ["<s>"] + words + ["<e>"]
+                    if len(toks) >= n:
+                        ids = [word_idx.get(w, unk) for w in toks]
+                        for i in range(n, len(ids) + 1):
+                            yield tuple(ids[i - n:i])
+                elif data_type == DataType.SEQ:
+                    ids = [word_idx.get(w, unk) for w in words]
+                    src = [word_idx["<s>"]] + ids
+                    trg = ids + [word_idx["<e>"]]
+                    if n <= 0 or len(src) <= n:
+                        yield src, trg
+                else:
+                    raise ValueError(f"Unknown data type {data_type}")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM, data_file=None):
+    """Reader of n-grams (NGRAM) or (src, trg) pairs (SEQ) over
+    ptb.train.txt (imikolov.py:122)."""
+    return reader_creator("data/ptb.train.txt", word_idx, n, data_type,
+                          data_file)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM, data_file=None):
+    return reader_creator("data/ptb.valid.txt", word_idx, n, data_type,
+                          data_file)
+
+
+def fetch():
+    return _archive()

@@ -1,0 +1,57 @@
+"""paddle.dataset.mnist parity (`python/paddle/dataset/mnist.py`): IDX
+readers yielding (image [784] float32 in [-1, 1], label int64)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+from ..vision.datasets import MNIST
+
+__all__ = []
+
+_FILES = {
+    "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+    "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+}
+
+
+def _paths(mode):
+    img, lab = _FILES[mode]
+    return (common.require_local("mnist", img, "the MNIST IDX archives"),
+            common.require_local("mnist", lab, "the MNIST IDX archives"))
+
+
+def reader_creator(image_filename, label_filename, buffer_size=None):
+    """mnist.py:42 — images scaled to [-1, 1] float32, flattened."""
+    import os
+
+    for p in (image_filename, label_filename):
+        if not os.path.exists(p):
+            # the vision MNIST class falls back to synthetic digits for
+            # missing paths (its documented CI behavior); the legacy
+            # reader must raise like the reference would on open
+            raise FileNotFoundError(f"mnist: no such IDX file: {p}")
+    ds = MNIST(image_path=image_filename, label_path=label_filename)
+
+    def reader():
+        for i in range(len(ds)):
+            img = ds.images[i].reshape(-1).astype(np.float32)
+            yield img / 127.5 - 1.0, int(ds.labels[i])
+
+    return reader
+
+
+def train(image_path=None, label_path=None):
+    if image_path is None or label_path is None:
+        image_path, label_path = _paths("train")
+    return reader_creator(image_path, label_path)
+
+
+def test(image_path=None, label_path=None):
+    if image_path is None or label_path is None:
+        image_path, label_path = _paths("test")
+    return reader_creator(image_path, label_path)
+
+
+def fetch():
+    return _paths("train") + _paths("test")

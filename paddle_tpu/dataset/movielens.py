@@ -1,0 +1,82 @@
+"""paddle.dataset.movielens parity (`python/paddle/dataset/
+movielens.py`): ml-1m readers + metadata queries, built on
+`paddle_tpu.text.Movielens`'s parser."""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from . import common
+from ..text.datasets import Movielens, MovieInfo, UserInfo  # noqa: F401
+
+__all__ = []
+
+_NAME = "ml-1m.zip"
+_HINT = "the MovieLens ml-1m zip"
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def _archive(data_file=None):
+    return common.require_local("movielens", _NAME, _HINT, data_file)
+
+
+def _dataset(mode="train", data_file=None, **kw):
+    return Movielens(data_file=_archive(data_file), mode=mode, **kw)
+
+
+def __reader_creator__(mode, data_file=None, **kwargs):
+    ds = _dataset(mode, data_file, **kwargs)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v) for v in ds[i])
+
+    return reader
+
+
+def train(data_file=None):
+    return __reader_creator__("train", data_file)
+
+
+def test(data_file=None):
+    return __reader_creator__("test", data_file)
+
+
+def get_movie_title_dict(data_file=None):
+    """word -> id over movie titles (movielens.py:194)."""
+    return _dataset(data_file=data_file).movie_title_dict
+
+
+def movie_categories(data_file=None):
+    """category -> id (movielens.py:266)."""
+    return _dataset(data_file=data_file).categories_dict
+
+
+def max_movie_id(data_file=None):
+    return max(_dataset(data_file=data_file).movie_info)
+
+
+def max_user_id(data_file=None):
+    return max(_dataset(data_file=data_file).user_info)
+
+
+def max_job_id(data_file=None):
+    return max(int(u.job_id)
+               for u in _dataset(data_file=data_file)
+               .user_info.values())
+
+
+def movie_info(data_file=None):
+    """movie id -> MovieInfo (movielens.py:294)."""
+    return _dataset(data_file=data_file).movie_info
+
+
+def user_info(data_file=None):
+    """user id -> UserInfo (movielens.py:280)."""
+    return _dataset(data_file=data_file).user_info
+
+
+def fetch():
+    return _archive()

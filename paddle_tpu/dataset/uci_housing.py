@@ -1,0 +1,77 @@
+"""paddle.dataset.uci_housing parity (`python/paddle/dataset/
+uci_housing.py`): Boston-housing readers over the whitespace-float file,
+mean-normalized features, 80/20 split."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = []
+
+_NAME = "housing.data"
+_HINT = "the UCI Boston housing.data file"
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+UCI_TRAIN_DATA = None
+UCI_TEST_DATA = None
+
+
+def _archive(data_file=None):
+    return common.require_local("uci_housing", _NAME, _HINT, data_file)
+
+
+def feature_range(maximums, minimums):  # plotting hook in the reference
+    return None
+
+
+def load_data(filename, feature_num=14, ratio=0.8):
+    """Populate the train/test splits (uci_housing.py:80)."""
+    global UCI_TRAIN_DATA, UCI_TEST_DATA
+    if UCI_TRAIN_DATA is not None and UCI_TEST_DATA is not None:
+        return
+    data = np.fromfile(filename, sep=" ")
+    data = data.reshape(data.shape[0] // feature_num, feature_num)
+    maximums, minimums = data.max(axis=0), data.min(axis=0)
+    avgs = data.mean(axis=0)
+    feature_range(maximums[:-1], minimums[:-1])
+    for i in range(feature_num - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+    offset = int(data.shape[0] * ratio)
+    UCI_TRAIN_DATA = data[:offset]
+    UCI_TEST_DATA = data[offset:]
+
+
+def _reader_creator(split_name, data_file):
+    def reader():
+        load_data(_archive(data_file))
+        rows = UCI_TRAIN_DATA if split_name == "train" else UCI_TEST_DATA
+        for row in rows:
+            yield row[:-1], row[-1:]
+
+    return reader
+
+
+def train(data_file=None):
+    """Reader of (features [13] f64, price [1]) (uci_housing.py:107)."""
+    return _reader_creator("train", data_file)
+
+
+def test(data_file=None):
+    return _reader_creator("test", data_file)
+
+
+def predict_reader(data_file=None):
+    """First 100 test samples, features only (uci_housing.py:171)."""
+    def reader():
+        load_data(_archive(data_file))
+        for row in UCI_TEST_DATA[:100]:
+            yield (row[:-1],)
+
+    return reader
+
+
+def fetch():
+    return _archive()

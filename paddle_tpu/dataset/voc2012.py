@@ -1,0 +1,43 @@
+"""paddle.dataset.voc2012 parity (`python/paddle/dataset/voc2012.py`):
+segmentation readers yielding (image CHW, label HW)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+from ..vision.datasets import VOC2012
+
+__all__ = []
+
+_NAME = "VOCtrainval_11-May-2012.tar"
+_HINT = "the VOC2012 trainval tar"
+
+
+def reader_creator(filename, sub_name):
+    ds = VOC2012(data_file=filename, mode=sub_name, download=False)
+
+    def reader():
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield np.asarray(img), np.asarray(label)
+
+    return reader
+
+
+def train(data_file=None):
+    return reader_creator(
+        common.require_local("voc2012", _NAME, _HINT, data_file), "train")
+
+
+def test(data_file=None):
+    return reader_creator(
+        common.require_local("voc2012", _NAME, _HINT, data_file), "test")
+
+
+def val(data_file=None):
+    return reader_creator(
+        common.require_local("voc2012", _NAME, _HINT, data_file), "valid")
+
+
+def fetch():
+    return common.require_local("voc2012", _NAME, _HINT)

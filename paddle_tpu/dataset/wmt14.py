@@ -1,0 +1,58 @@
+"""paddle.dataset.wmt14 parity (`python/paddle/dataset/wmt14.py`):
+en→fr readers over the preprocessed tar (src.dict/trg.dict inside),
+built on `paddle_tpu.text.WMT14`."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+from ..text.datasets import WMT14
+
+__all__ = []
+
+_NAME = "wmt14.tgz"
+_HINT = "the preprocessed WMT14 en-fr tarball"
+
+
+def _archive(data_file=None):
+    return common.require_local("wmt14", _NAME, _HINT, data_file)
+
+
+def _reader(mode, dict_size, data_file=None):
+    ds = WMT14(data_file=_archive(data_file), mode=mode,
+               dict_size=dict_size)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v) for v in ds[i])
+
+    return reader
+
+
+def train(dict_size, data_file=None):
+    """Reader of (src_ids, trg_ids, trg_ids_next) (wmt14.py:120)."""
+    return _reader("train", dict_size, data_file)
+
+
+def test(dict_size, data_file=None):
+    return _reader("test", dict_size, data_file)
+
+
+def gen(dict_size, data_file=None):
+    return _reader("gen", dict_size, data_file)
+
+
+def get_dict(dict_size, reverse=True, data_file=None):
+    """(src_dict, trg_dict); reverse=True returns id->word
+    (wmt14.py:182)."""
+    ds = WMT14(data_file=_archive(data_file), mode="train",
+               dict_size=dict_size)
+    src, trg = ds.get_dict(reverse=False)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def fetch():
+    return _archive()

@@ -1,0 +1,59 @@
+"""paddle.dataset.wmt16 parity (`python/paddle/dataset/wmt16.py`):
+en↔de readers over the wmt16 tar, built on `paddle_tpu.text.WMT16`."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+from ..text.datasets import WMT16
+
+__all__ = []
+
+_NAME = "wmt16.tar.gz"
+_HINT = "the WMT16 en-de tarball (wmt16/{train,test,val} TSVs)"
+
+
+def _archive(data_file=None):
+    return common.require_local("wmt16", _NAME, _HINT, data_file)
+
+
+def _reader(mode, src_dict_size, trg_dict_size, src_lang, data_file=None):
+    ds = WMT16(data_file=_archive(data_file), mode=mode,
+               src_dict_size=src_dict_size, trg_dict_size=trg_dict_size,
+               lang=src_lang)
+
+    def reader():
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v) for v in ds[i])
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en", data_file=None):
+    """Reader of (src_ids, trg_ids, trg_ids_next) (wmt16.py:150)."""
+    return _reader("train", src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en", data_file=None):
+    return _reader("test", src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en",
+               data_file=None):
+    return _reader("val", src_dict_size, trg_dict_size, src_lang,
+                   data_file)
+
+
+def get_dict(lang, dict_size, reverse=False, data_file=None):
+    """Vocabulary for `lang` at `dict_size`; reverse=True returns
+    id->word (wmt16.py:328)."""
+    ds = WMT16(data_file=_archive(data_file), mode="train",
+               src_dict_size=dict_size, trg_dict_size=dict_size,
+               lang=lang)
+    return ds.get_dict(lang=lang, reverse=reverse)
+
+
+def fetch():
+    return _archive()

@@ -32,8 +32,12 @@ def init_parallel_env():
                 coordinator_address=addr,
                 num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", 1)),
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
-        except Exception:
-            pass
+        except RuntimeError as e:
+            # re-init in the same process is fine; anything else (bad
+            # coordinator, rank clash, timeout) must surface — silently
+            # proceeding single-process would train on 1/N of the data
+            if "already" not in str(e).lower():
+                raise
     topo_mod.get_topology()
     return ParallelEnv()
 

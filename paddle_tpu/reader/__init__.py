@@ -1,0 +1,9 @@
+"""paddle.reader parity (`python/paddle/reader/`): legacy reader-creator
+decorators used by `paddle_tpu.dataset`. A *reader creator* is a zero-arg
+callable returning an iterable of samples."""
+from .decorator import (  # noqa: F401
+    ComposeNotAligned, buffered, cache, chain, compose, firstn,
+    map_readers, multiprocess_reader, shuffle, xmap_readers,
+)
+
+__all__ = []

@@ -1,0 +1,271 @@
+"""paddle.reader decorators (reference `python/paddle/reader/decorator.py`):
+composable transformations over *reader creators* — zero-arg callables
+returning a fresh iterable of samples. The legacy io tier still used by
+`paddle.dataset.*`; `paddle_tpu.io.DataLoader` is the modern path.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import queue as queue_mod
+import random
+import threading
+
+__all__ = []
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Materialize `reader`'s samples on the first pass; later passes
+    replay from memory (reference decorator.py:45)."""
+    all_data = []
+    filled = [False]
+
+    def cached_reader():
+        if filled[0]:
+            yield from all_data
+            return
+        for item in reader():
+            all_data.append(item)
+            yield item
+        filled[0] = True
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Yield func(*items) over the zipped readers (decorator.py:86)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        yield from map(func, *rs)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: read `buf_size` samples, shuffle, emit
+    (decorator.py:127)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers: all of A's samples, then B's, …
+    (decorator.py:172)."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples: (a, (b1, b2), c) -> (a, b1,
+    b2, c). check_alignment=True (default) raises ComposeNotAligned when
+    the readers run out at different lengths (decorator.py:235)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Producer thread fills a bounded queue of up to `size` samples the
+    consumer drains — overlaps data reading with compute
+    (decorator.py:292). A producer exception is forwarded and re-raised
+    in the consumer — a broken stream must not masquerade as a short
+    dataset."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q = queue_mod.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                q.put(_MapperError(e))
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            if isinstance(e, _MapperError):
+                raise e.exc
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """Only the first n samples (decorator.py:357)."""
+
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+class _MapperError:
+    """Exception carrier from an xmap worker thread to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Apply `mapper` over samples with `process_num` worker THREADS and
+    a `buffer_size`-bounded pipeline; order=True preserves input order
+    (decorator.py:402 — the reference also uses threads)."""
+
+    end_token = object()
+
+    def xreader():
+        in_q = queue_mod.Queue(buffer_size)
+        out_q = queue_mod.Queue(buffer_size)
+
+        def read_worker():
+            # end tokens ALWAYS go out (finally): a reader exception must
+            # surface in the consumer, never strand the worker threads
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d) if order else d)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                out_q.put(_MapperError(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end_token)
+
+        def handle_worker():
+            # the end token ALWAYS goes out (finally): a mapper exception
+            # must surface to the consumer, never hang it
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end_token:
+                        return
+                    if order:
+                        i, d = item
+                        out_q.put((i, mapper(d)))
+                    else:
+                        out_q.put(mapper(item))
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                out_q.put(_MapperError(e))
+            finally:
+                out_q.put(end_token)
+
+        threading.Thread(target=read_worker, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=handle_worker, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending = {}
+            nxt = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_token:
+                    finished += 1
+                    continue
+                if isinstance(item, _MapperError):
+                    raise item.exc
+                i, d = item
+                pending[i] = d
+                while nxt in pending:
+                    yield pending.pop(nxt)
+                    nxt += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_token:
+                    finished += 1
+                    continue
+                if isinstance(item, _MapperError):
+                    raise item.exc
+                yield item
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run each reader in its own PROCESS, merging samples into one
+    stream (decorator.py:498). Samples must be picklable."""
+    if len(readers) < 1:
+        raise ValueError("readers must not be empty")
+
+    def _worker(r, q):
+        # a worker exception is forwarded (as a repr — the exception
+        # object itself may not pickle) and re-raised in the consumer,
+        # never reported as a clean short stream
+        try:
+            for d in r():
+                q.put(d)
+        except BaseException as e:  # noqa: BLE001 — forwarded
+            q.put(("__reader_error__", f"{type(e).__name__}: {e}"))
+        finally:
+            q.put(None)
+
+    def merged():
+        q = multiprocessing.Queue(queue_size)
+        procs = [multiprocessing.Process(target=_worker, args=(r, q),
+                                         daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is None:
+                finished += 1
+                continue
+            if isinstance(sample, tuple) and len(sample) == 2 and \
+                    sample[0] == "__reader_error__":
+                raise RuntimeError(
+                    f"multiprocess_reader worker failed: {sample[1]}")
+            yield sample
+        for p in procs:
+            p.join()
+
+    return merged

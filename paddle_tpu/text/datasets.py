@@ -45,6 +45,24 @@ def _need_file(data_file, download, name, what="archive"):
         f"official archive and pass data_file=")
 
 
+def imdb_tokenize(data_file, pattern):
+    """Token lists (bytes, lowercased, punctuation stripped) of every tar
+    member matching `pattern` — shared by the Imdb Dataset class and the
+    legacy `paddle_tpu.dataset.imdb` reader API."""
+    docs = []
+    strip = string.punctuation.encode("latin-1")
+    with tarfile.open(data_file) as tf:
+        member = tf.next()
+        while member is not None:
+            if pattern.match(member.name):
+                raw = tf.extractfile(member).read()
+                docs.append(
+                    raw.rstrip(b"\n\r").translate(None, strip)
+                    .lower().split())
+            member = tf.next()
+    return docs
+
+
 class Imdb(Dataset):
     """IMDB sentiment (aclImdb_v1.tar.gz). Examples: (doc_ids [T] int64,
     label [1]) with label 0=pos 1=neg; vocabulary built from the whole
@@ -59,18 +77,7 @@ class Imdb(Dataset):
         self._load_anno()
 
     def _tokenize(self, pattern):
-        docs = []
-        strip = string.punctuation.encode("latin-1")
-        with tarfile.open(self.data_file) as tf:
-            member = tf.next()
-            while member is not None:
-                if pattern.match(member.name):
-                    raw = tf.extractfile(member).read()
-                    docs.append(
-                        raw.rstrip(b"\n\r").translate(None, strip)
-                        .lower().split())
-                member = tf.next()
-        return docs
+        return imdb_tokenize(self.data_file, pattern)
 
     def _build_word_dict(self, cutoff):
         pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")

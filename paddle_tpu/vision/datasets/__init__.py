@@ -198,55 +198,102 @@ class ImageFolder(Dataset):
         return len(self.samples)
 
 
+# official readme quirk kept by the reference (flowers.py:38): tstid is
+# the LARGER split and serves as training data
+_FLOWERS_MODE_FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+
 class Flowers(Dataset):
-    """Flowers-102 (paddle.vision.datasets.Flowers). Zero-egress build:
-    pass local `data_file`/`label_file`/`setid_file` paths (the same
-    .mat/.tgz artifacts the reference downloads); there is no
-    auto-download here."""
+    """Flowers-102 (paddle.vision.datasets.Flowers): images from the
+    102flowers.tgz, labels from imagelabels.mat, splits from setid.mat
+    (reference `vision/datasets/flowers.py`). Zero-egress build: pass
+    the local archives; there is no auto-download."""
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=False,
                  backend=None):
-        if download or not (data_file and label_file and setid_file):
+        assert mode.lower() in ("train", "valid", "test"), mode
+        if not (data_file and label_file and setid_file):
             raise RuntimeError(
                 "no network egress: place the Flowers-102 archives "
                 "locally and pass data_file/label_file/setid_file")
-        raise NotImplementedError(
-            "Flowers requires scipy.io loadmat of the official .mat "
-            "files; wire your local copies through DatasetFolder or a "
-            "custom Dataset")
+        import tarfile
+        import threading
+
+        from scipy.io import loadmat
+
+        self.data_file = data_file
+        self.transform = transform
+        # 1-based image ids for this split; labels stay 1-based 1..102
+        # (reference vision/datasets/flowers.py:172 returns them raw)
+        self.indexes = loadmat(setid_file)[
+            _FLOWERS_MODE_FLAG[mode.lower()]].ravel().astype(int)
+        self.labels = loadmat(label_file)["labels"].ravel().astype(
+            np.int64)
+        # one persistent handle: the .tgz has no random access, so
+        # per-item reopen would re-decompress the whole archive per
+        # fetch (O(N^2) per epoch); tarfile isn't thread-safe -> lock
+        self._tar = tarfile.open(data_file)
+        self._tar_lock = threading.Lock()
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        img_id = int(self.indexes[idx])
+        with self._tar_lock:
+            f = self._tar.extractfile(f"jpg/image_{img_id:05d}.jpg")
+            img = np.asarray(Image.open(f).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[img_id - 1]])
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+# reference quirk (vision/datasets/voc2012.py:36): 'train' serves the
+# full trainval list, 'test' the train list, 'valid' the val list
+_VOC_MODE_FLAG = {"train": "trainval", "test": "train", "valid": "val"}
+_VOC_SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_VOC_DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_VOC_LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
 
 class VOC2012(Dataset):
-    """VOC2012 segmentation (paddle.vision.datasets.VOC2012); local
-    `data_file` tar required (zero egress)."""
+    """VOC2012 segmentation (paddle.vision.datasets.VOC2012): items are
+    (image HWC uint8, label HW uint8) pairs for the segmentation split
+    lists; local `data_file` tar required (zero egress)."""
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
-        if download or not data_file:
+        assert mode.lower() in ("train", "valid", "test"), mode
+        if not data_file:
             raise RuntimeError(
                 "no network egress: pass the local VOCtrainval tar as "
                 "data_file")
         import tarfile
 
-        self._items = []
-        with tarfile.open(data_file) as tf:
-            names = tf.getnames()
-        self._names = [n for n in names if n.endswith(".jpg")]
         self.data_file = data_file
         self.transform = transform
+        set_name = _VOC_SET.format(_VOC_MODE_FLAG[mode.lower()])
+        with tarfile.open(data_file) as tf:
+            lines = tf.extractfile(set_name).read().decode().split()
+        self._ids = [l.strip() for l in lines if l.strip()]
 
     def __getitem__(self, idx):
         import tarfile
 
         from PIL import Image
 
+        name = self._ids[idx]
         with tarfile.open(self.data_file) as tf:
-            f = tf.extractfile(self._names[idx])
-            img = np.asarray(Image.open(f).convert("RGB"))
+            img = np.asarray(Image.open(
+                tf.extractfile(_VOC_DATA.format(name))).convert("RGB"))
+            label = np.asarray(Image.open(
+                tf.extractfile(_VOC_LABEL.format(name))))
         if self.transform is not None:
             img = self.transform(img)
-        return img
+        return img, label
 
     def __len__(self):
-        return len(self._names)
+        return len(self._ids)

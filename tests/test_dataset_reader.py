@@ -1,0 +1,314 @@
+"""Legacy paddle.dataset / paddle.reader tiers (reference
+`python/paddle/dataset/`, `python/paddle/reader/decorator.py`): reader
+decorators and the reader-creator dataset APIs against tiny synthetic
+archives in the official formats (no network)."""
+import gzip
+import io
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+# --------------------------- reader decorators ---------------------------
+
+def _r(items):
+    def reader():
+        yield from items
+
+    return reader
+
+
+def test_reader_cache_and_firstn():
+    calls = {"n": 0}
+
+    def reader():
+        calls["n"] += 1
+        yield from range(5)
+
+    c = P.reader.cache(reader)
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert calls["n"] == 1  # second pass served from memory
+    assert list(P.reader.firstn(_r(range(100)), 3)()) == [0, 1, 2]
+
+
+def test_reader_map_chain_shuffle_buffered():
+    assert list(P.reader.map_readers(
+        lambda a, b: a + b, _r([1, 2]), _r([10, 20]))()) == [11, 22]
+    assert list(P.reader.chain(_r([1, 2]), _r([3]))()) == [1, 2, 3]
+    got = sorted(P.reader.shuffle(_r(range(10)), 4)())
+    assert got == list(range(10))
+    assert sorted(P.reader.buffered(_r(range(7)), 2)()) == list(range(7))
+
+
+def test_reader_compose_alignment():
+    comp = P.reader.compose(_r([1, 2]), _r([(3, 4), (5, 6)]))
+    assert list(comp()) == [(1, 3, 4), (2, 5, 6)]
+    bad = P.reader.compose(_r([1, 2, 3]), _r([1]))
+    with pytest.raises(P.reader.ComposeNotAligned):
+        list(bad())
+    ok = P.reader.compose(_r([1, 2, 3]), _r([1]), check_alignment=False)
+    assert list(ok()) == [(1, 1)]
+
+
+def test_reader_xmap_ordered_and_unordered():
+    sq = lambda x: x * x  # noqa: E731
+    ordered = list(P.reader.xmap_readers(sq, _r(range(20)), 3, 4,
+                                         order=True)())
+    assert ordered == [i * i for i in range(20)]
+    unordered = sorted(P.reader.xmap_readers(sq, _r(range(20)), 3, 4)())
+    assert unordered == sorted(i * i for i in range(20))
+
+
+def test_reader_xmap_mapper_error_surfaces():
+    """A crashing mapper must raise in the consumer, not hang the
+    pipeline (the worker forwards the exception and always emits its
+    end token)."""
+    def bad(x):
+        if x == 5:
+            raise ValueError("boom at 5")
+        return x
+
+    with pytest.raises(ValueError, match="boom at 5"):
+        list(P.reader.xmap_readers(bad, _r(range(10)), 2, 4)())
+
+
+def test_reader_errors_surface_not_truncate():
+    """A broken stream must raise, never masquerade as a short dataset:
+    buffered() and xmap_readers() forward producer/reader exceptions."""
+    def bad_reader():
+        yield 1
+        yield 2
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError, match="disk gone"):
+        list(P.reader.buffered(bad_reader, 2)())
+    with pytest.raises(IOError, match="disk gone"):
+        list(P.reader.xmap_readers(lambda v: v, bad_reader, 2, 4)())
+
+
+def test_reader_multiprocess():
+    merged = P.reader.multiprocess_reader(
+        [_r([1, 2, 3]), _r([4, 5])], queue_size=8)
+    assert sorted(merged()) == [1, 2, 3, 4, 5]
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    from paddle_tpu.dataset import common
+
+    n = common.split(_r(list(range(10))), 4,
+                     suffix=str(tmp_path / "part-%05d.pickle"))
+    assert n >= 2
+    shard0 = common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 0)
+    shard1 = common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 1)
+    assert sorted(list(shard0()) + list(shard1())) == list(range(10))
+
+
+def test_download_is_zero_egress(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    with pytest.raises(RuntimeError, match="no network egress"):
+        common.download("http://example.com/foo.tgz", "foo")
+    d = tmp_path / "foo"
+    d.mkdir()
+    (d / "foo.tgz").write_bytes(b"hello")
+    assert common.download("http://example.com/foo.tgz", "foo") == \
+        str(d / "foo.tgz")
+    assert common.md5file(str(d / "foo.tgz")) == \
+        __import__("hashlib").md5(b"hello").hexdigest()
+
+
+# --------------------------- dataset modules ---------------------------
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_dataset_imdb(tmp_path):
+    from paddle_tpu.dataset import imdb
+
+    p = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0.txt": b"good great good film",
+        "aclImdb/train/neg/0.txt": b"bad awful bad film",
+        "aclImdb/test/pos/0.txt": b"great good",
+        "aclImdb/test/neg/0.txt": b"awful bad",
+    }
+    with tarfile.open(p, "w:gz") as tf:
+        for name, data in docs.items():
+            _add_bytes(tf, name, data)
+    wd = imdb.word_dict(data_file=str(p), cutoff=1)
+    assert b"good" in wd and "<unk>" in wd
+    samples = list(imdb.train(wd, data_file=str(p))())
+    assert len(samples) == 2
+    labels = sorted(lab for _, lab in samples)
+    assert labels == [0, 1]
+    assert all(isinstance(ids, list) for ids, _ in samples)
+
+
+def test_dataset_imikolov(tmp_path):
+    from paddle_tpu.dataset import imikolov
+
+    p = tmp_path / "simple-examples.tgz"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt",
+                   b"the cat sat\nthe dog sat\n")
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt",
+                   b"the cat ran\n")
+    wd = imikolov.build_dict(min_word_freq=1, data_file=str(p))
+    assert "the" in wd and "<unk>" in wd
+    grams = list(imikolov.train(wd, 2, data_file=str(p))())
+    assert grams and all(len(g) == 2 for g in grams)
+    pairs = list(imikolov.test(wd, -1, imikolov.DataType.SEQ,
+                               data_file=str(p))())
+    src, trg = pairs[0]
+    assert src[0] == wd["<s>"] and trg[-1] == wd["<e>"]
+
+
+def test_dataset_uci_housing(tmp_path):
+    from paddle_tpu.dataset import uci_housing
+
+    rows = np.arange(10 * 14, dtype=np.float64).reshape(10, 14)
+    p = tmp_path / "housing.data"
+    with open(p, "w") as f:
+        for row in rows:
+            f.write(" ".join(str(v) for v in row) + "\n")
+    uci_housing.UCI_TRAIN_DATA = uci_housing.UCI_TEST_DATA = None
+    train = list(uci_housing.train(data_file=str(p))())
+    test = list(uci_housing.test(data_file=str(p))())
+    assert len(train) == 8 and len(test) == 2
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    uci_housing.UCI_TRAIN_DATA = uci_housing.UCI_TEST_DATA = None
+
+
+def test_dataset_mnist(tmp_path):
+    from paddle_tpu.dataset import mnist
+
+    def idx_images(path, n):
+        with gzip.open(path, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(np.full(n * 28 * 28, 128, np.uint8).tobytes())
+
+    def idx_labels(path, n):
+        with gzip.open(path, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(np.arange(n, dtype=np.uint8).tobytes())
+
+    img, lab = tmp_path / "im.gz", tmp_path / "lb.gz"
+    idx_images(str(img), 3)
+    idx_labels(str(lab), 3)
+    samples = list(mnist.train(image_path=str(img),
+                               label_path=str(lab))())
+    assert len(samples) == 3
+    x, y = samples[0]
+    assert x.shape == (784,) and x.dtype == np.float32
+    assert -1.0 <= x.min() and x.max() <= 1.0
+    assert [s[1] for s in samples] == [0, 1, 2]
+
+
+def test_dataset_voc2012(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.dataset import voc2012
+
+    p = tmp_path / "VOCtrainval_11-May-2012.tar"
+
+    def png_bytes(arr):
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        return buf.getvalue()
+
+    def jpg_bytes(arr):
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        return buf.getvalue()
+
+    rs = np.random.RandomState(0)
+    with tarfile.open(p, "w") as tf:
+        _add_bytes(tf,
+                   "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+                   b"a\nb\n")
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                   b"a\n")
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   b"b\n")
+        for name in ("a", "b"):
+            _add_bytes(tf, f"VOCdevkit/VOC2012/JPEGImages/{name}.jpg",
+                       jpg_bytes(rs.randint(0, 255, (8, 8, 3), np.uint8)))
+            _add_bytes(tf, f"VOCdevkit/VOC2012/SegmentationClass/{name}.png",
+                       png_bytes(rs.randint(0, 20, (8, 8), np.uint8)))
+    samples = list(voc2012.train(data_file=str(p))())
+    assert len(samples) == 2  # reference quirk: train == trainval list
+    img, label = samples[0]
+    assert img.shape == (8, 8, 3) and label.shape == (8, 8)
+    assert len(list(voc2012.val(data_file=str(p))())) == 1
+
+
+def test_dataset_flowers(tmp_path):
+    from PIL import Image
+    from scipy.io import savemat
+
+    from paddle_tpu.dataset import common, flowers
+
+    d = tmp_path / "flowers"
+    d.mkdir()
+    rs = np.random.RandomState(0)
+    with tarfile.open(d / "102flowers.tgz", "w:gz") as tf:
+        for i in range(1, 5):
+            buf = io.BytesIO()
+            Image.fromarray(
+                rs.randint(0, 255, (6, 6, 3), np.uint8)).save(
+                buf, format="JPEG")
+            _add_bytes(tf, f"jpg/image_{i:05d}.jpg", buf.getvalue())
+    savemat(d / "imagelabels.mat",
+            {"labels": np.array([[1, 2, 1, 2]])})
+    savemat(d / "setid.mat", {"trnid": np.array([[1]]),
+                              "tstid": np.array([[2, 3]]),
+                              "valid": np.array([[4]])})
+    import pytest as _pytest
+
+    mp = _pytest.MonkeyPatch()
+    mp.setattr(common, "DATA_HOME", str(tmp_path))
+    try:
+        train = list(flowers.train(use_xmap=False)())
+        assert len(train) == 2  # tstid (the larger split) trains
+        img, label = train[0]
+        assert img.shape == (6, 6, 3)
+        test = list(flowers.test(use_xmap=False)())
+        assert len(test) == 1
+    finally:
+        mp.undo()
+
+
+def test_dataset_image_helpers(tmp_path):
+    from paddle_tpu.dataset import image as dimg
+
+    im = np.zeros((10, 20, 3), np.uint8)
+    small = dimg.resize_short(im, 5)
+    assert min(small.shape[:2]) == 5
+    crop = dimg.center_crop(small, 4)
+    assert crop.shape[:2] == (4, 4)
+    chw = dimg.to_chw(crop)
+    assert chw.shape == (3, 4, 4)
+    out = dimg.simple_transform(im, 8, 4, is_train=False,
+                                mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 4, 4) and out.dtype == np.float32
+
+
+def test_dataset_namespace_importable():
+    import paddle_tpu.dataset as D
+
+    for mod in ("cifar", "common", "conll05", "flowers", "image", "imdb",
+                "imikolov", "mnist", "movielens", "uci_housing",
+                "voc2012", "wmt14", "wmt16"):
+        assert hasattr(D, mod), mod
