@@ -30,8 +30,9 @@ def reader_creator(filename, sub_name, cycle=False):
         it = itertools.cycle(range(len(ds))) if cycle else range(len(ds))
         for i in it:
             img, label = ds[i]
-            yield (np.asarray(img, np.float32).reshape(-1) / 255.0,
-                   int(label))
+            # the Dataset item is already float32/255 CHW; the legacy
+            # contract is the flattened [0,1] vector (cifar.py:47)
+            yield np.asarray(img, np.float32).reshape(-1), int(label)
 
     return reader
 

@@ -41,16 +41,23 @@ def reader_creator(image_filename, label_filename, buffer_size=None):
     return reader
 
 
-def train(image_path=None, label_path=None):
+def _resolve(mode, image_path, label_path):
+    if image_path is None and label_path is None:
+        return _paths(mode)
     if image_path is None or label_path is None:
-        image_path, label_path = _paths("train")
-    return reader_creator(image_path, label_path)
+        raise ValueError(
+            "mnist: pass BOTH image_path and label_path (or neither, to "
+            "use DATA_HOME) — defaulting just one would silently pair "
+            "mismatched files")
+    return image_path, label_path
+
+
+def train(image_path=None, label_path=None):
+    return reader_creator(*_resolve("train", image_path, label_path))
 
 
 def test(image_path=None, label_path=None):
-    if image_path is None or label_path is None:
-        image_path, label_path = _paths("test")
-    return reader_creator(image_path, label_path)
+    return reader_creator(*_resolve("test", image_path, label_path))
 
 
 def fetch():

@@ -17,6 +17,7 @@ feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
 
 UCI_TRAIN_DATA = None
 UCI_TEST_DATA = None
+_LOADED_FILE = None
 
 
 def _archive(data_file=None):
@@ -28,10 +29,14 @@ def feature_range(maximums, minimums):  # plotting hook in the reference
 
 
 def load_data(filename, feature_num=14, ratio=0.8):
-    """Populate the train/test splits (uci_housing.py:80)."""
-    global UCI_TRAIN_DATA, UCI_TEST_DATA
-    if UCI_TRAIN_DATA is not None and UCI_TEST_DATA is not None:
+    """Populate the train/test splits (uci_housing.py:80). The cache is
+    keyed by filename — a different data_file reloads rather than
+    silently serving the previous file's splits."""
+    global UCI_TRAIN_DATA, UCI_TEST_DATA, _LOADED_FILE
+    if UCI_TRAIN_DATA is not None and UCI_TEST_DATA is not None \
+            and _LOADED_FILE == filename:
         return
+    _LOADED_FILE = filename
     data = np.fromfile(filename, sep=" ")
     data = data.reshape(data.shape[0] // feature_num, feature_num)
     maximums, minimums = data.max(axis=0), data.min(axis=0)

@@ -33,10 +33,13 @@ def init_parallel_env():
                 num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", 1)),
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
         except RuntimeError as e:
-            # re-init in the same process is fine; anything else (bad
-            # coordinator, rank clash, timeout) must surface — silently
-            # proceeding single-process would train on 1/N of the data
-            if "already" not in str(e).lower():
+            # re-init in the same process is fine (jax 0.9 raises
+            # "distributed.initialize should only be called once.");
+            # anything else (bad coordinator, rank clash, timeout) must
+            # surface — silently proceeding single-process would train
+            # on 1/N of the data
+            msg = str(e).lower()
+            if "already" not in msg and "only be called once" not in msg:
                 raise
     topo_mod.get_topology()
     return ParallelEnv()

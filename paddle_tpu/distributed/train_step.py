@@ -100,18 +100,32 @@ class DistributedTrainStep:
         return NamedSharding(self.topo.spmd_mesh, P(*spec))
 
     # --- state ---------------------------------------------------------------
+    def _put_state(self, v, sharding):
+        """Place a host value (held in FULL on every process) with
+        `sharding`. Single-process: plain device_put. Multi-process
+        (multi-host training over the jax coordination service): the
+        sharding spans non-addressable devices, which device_put rejects
+        — build the global array from per-device slices of the full
+        value instead (each process materializes only its addressable
+        shards)."""
+        if jax.process_count() == 1:
+            return jax.device_put(v, sharding)
+        v = jnp.asarray(v)
+        return jax.make_array_from_callback(v.shape, sharding,
+                                            lambda idx: v[idx])
+
     def init_state(self):
         params, buffers = self.model.functional_state()
         opt_state = self.optimizer.init_state(params)
         p_spec, s_spec = self._plan(params, opt_state["slots"])
         mesh = self.topo.spmd_mesh
 
-        params = {n: jax.device_put(v, self._sharding(p_spec[n]))
+        params = {n: self._put_state(v, self._sharding(p_spec[n]))
                   for n, v in params.items()}
-        slots = {n: {k: jax.device_put(v, self._sharding(s_spec[n][k]))
+        slots = {n: {k: self._put_state(v, self._sharding(s_spec[n][k]))
                      for k, v in sd.items()}
                  for n, sd in opt_state["slots"].items()}
-        buffers = {n: jax.device_put(v, NamedSharding(mesh, P()))
+        buffers = {n: self._put_state(v, NamedSharding(mesh, P()))
                    for n, v in buffers.items()}
         self._p_spec, self._s_spec = p_spec, s_spec
         # every leaf — including the scalar step counter and the PRNG key —
@@ -122,12 +136,12 @@ class DistributedTrainStep:
         self._state = {
             "params": params,
             "opt": {"slots": slots,
-                    "step": jax.device_put(jnp.asarray(opt_state["step"]),
-                                           rep)},
+                    "step": self._put_state(
+                        jnp.asarray(opt_state["step"]), rep)},
             "buffers": buffers,
             # fresh buffer: the step donates its state, so it must NOT alias
             # the global generator's key array
-            "key": jax.device_put(
+            "key": self._put_state(
                 jax.random.fold_in(rng.default_generator.get_state(), 7),
                 rep),
         }
@@ -315,14 +329,38 @@ class DistributedTrainStep:
         mesh = self.topo.spmd_mesh
         dp = mesh.shape.get("dp", 1)
         placed = []
+        multiproc = jax.process_count() > 1
+        # multi-host: each process holds its LOCAL shard, which must be
+        # divisible by the dp devices *this process* contributes — not
+        # by the global degree
+        dp_div = max(dp // jax.process_count(), 1) if multiproc \
+            else max(dp, 1)
         for b in leaves:
-            if np.ndim(b) > batch_axis and \
-                    b.shape[batch_axis] % max(dp, 1) == 0:
+            batched = np.ndim(b) > batch_axis
+            if batched and b.shape[batch_axis] % dp_div == 0:
                 spec = [None] * batch_axis + ["dp"] + \
                     [None] * (np.ndim(b) - batch_axis - 1)
+            elif batched and multiproc and dp > 1:
+                # replicating per-rank-DIFFERENT data as a "replicated"
+                # global array would silently diverge the ranks — refuse
+                raise ValueError(
+                    f"multi-process batch leaf with local batch "
+                    f"{b.shape[batch_axis]} not divisible by the "
+                    f"process-local dp share ({dp_div}); pad or resize "
+                    f"the per-rank batch")
             else:
                 spec = [None] * np.ndim(b)
-            placed.append(jax.device_put(b, NamedSharding(mesh, P(*spec))))
+            if multiproc:
+                # assemble the global array across processes (global
+                # batch = sum of local batches along the dp axis)
+                from jax.experimental import multihost_utils
+
+                placed.append(
+                    multihost_utils.host_local_array_to_global_array(
+                        np.asarray(b), mesh, P(*spec)))
+            else:
+                placed.append(
+                    jax.device_put(b, NamedSharding(mesh, P(*spec))))
         return placed, treedef
 
     def _swap_state(self, params, opt, buffers, key):

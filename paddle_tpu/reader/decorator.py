@@ -19,8 +19,10 @@ class ComposeNotAligned(ValueError):
 
 
 def cache(reader):
-    """Materialize `reader`'s samples on the first pass; later passes
-    replay from memory (reference decorator.py:45)."""
+    """Materialize `reader`'s samples on the first COMPLETE pass; later
+    passes replay from memory (reference decorator.py:45). An abandoned
+    partial pass discards its accumulation — a later full pass re-reads
+    from scratch rather than replaying duplicated samples."""
     all_data = []
     filled = [False]
 
@@ -28,9 +30,11 @@ def cache(reader):
         if filled[0]:
             yield from all_data
             return
+        data = []
         for item in reader():
-            all_data.append(item)
+            data.append(item)
             yield item
+        all_data[:] = data
         filled[0] = True
 
     return cached_reader

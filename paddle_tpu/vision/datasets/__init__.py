@@ -86,15 +86,48 @@ class FashionMNIST(MNIST):
 
 
 class _CifarBase(Dataset):
+    """Reads the official cifar-python tar.gz when `data_file` is given
+    (pickled batches, images [N,3072] uint8, reference
+    `vision/datasets/cifar.py`); falls back to deterministic synthetic
+    data with NO archive — the MNIST-style CI contract."""
+
     N_CLASSES = 10
+    _MEMBERS = {"train": ("data_batch",), "test": ("test_batch",)}
+    _LABEL_KEY = b"labels"
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):
+        assert mode.lower() in ("train", "test"), mode
         self.transform = transform
+        if data_file:
+            self._load_archive(data_file, mode.lower())
+            return
         n = min(50000 if mode == "train" else 10000, 2048)
         rng = np.random.RandomState(0 if mode == "train" else 1)
         self.labels = rng.randint(0, self.N_CLASSES, n).astype(np.int64)
         self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+
+    def _load_archive(self, data_file, mode):
+        import pickle
+        import tarfile
+
+        wanted = self._MEMBERS[mode]
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for member in sorted(tf.getnames()):
+                base = member.rsplit("/", 1)[-1]
+                if not any(base.startswith(w) for w in wanted):
+                    continue
+                batch = pickle.load(tf.extractfile(member),
+                                    encoding="bytes")
+                images.append(np.asarray(batch[b"data"], np.uint8))
+                labels.extend(batch[self._LABEL_KEY])
+        if not images:
+            raise RuntimeError(
+                f"{type(self).__name__}: no {wanted} members in "
+                f"{data_file} — wrong archive?")
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
 
     def __len__(self):
         return len(self.labels)
@@ -114,6 +147,8 @@ class Cifar10(_CifarBase):
 
 class Cifar100(_CifarBase):
     N_CLASSES = 100
+    _MEMBERS = {"train": ("train",), "test": ("test",)}
+    _LABEL_KEY = b"fine_labels"
 
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
@@ -230,10 +265,36 @@ class Flowers(Dataset):
             _FLOWERS_MODE_FLAG[mode.lower()]].ravel().astype(int)
         self.labels = loadmat(label_file)["labels"].ravel().astype(
             np.int64)
-        # one persistent handle: the .tgz has no random access, so
-        # per-item reopen would re-decompress the whole archive per
-        # fetch (O(N^2) per epoch); tarfile isn't thread-safe -> lock
-        self._tar = tarfile.open(data_file)
+        # one persistent handle per process, opened lazily: the .tgz has
+        # no random access, so per-item reopen would re-decompress the
+        # whole archive per fetch (O(N^2) per epoch); tarfile isn't
+        # thread-safe -> lock. Lazy + excluded from pickling keeps the
+        # dataset fork/worker-safe (each process opens its own handle).
+        self._tar = None
+        self._tar_lock = threading.Lock()
+
+    def _handle(self):
+        import tarfile
+
+        if self._tar is None:
+            self._tar = tarfile.open(self.data_file)
+        return self._tar
+
+    def close(self):
+        if self._tar is not None:
+            self._tar.close()
+            self._tar = None
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tar"] = None
+        state["_tar_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
         self._tar_lock = threading.Lock()
 
     def __getitem__(self, idx):
@@ -241,7 +302,7 @@ class Flowers(Dataset):
 
         img_id = int(self.indexes[idx])
         with self._tar_lock:
-            f = self._tar.extractfile(f"jpg/image_{img_id:05d}.jpg")
+            f = self._handle().extractfile(f"jpg/image_{img_id:05d}.jpg")
             img = np.asarray(Image.open(f).convert("RGB"))
         if self.transform is not None:
             img = self.transform(img)

@@ -36,6 +36,17 @@ def test_reader_cache_and_firstn():
     assert list(P.reader.firstn(_r(range(100)), 3)()) == [0, 1, 2]
 
 
+def test_reader_cache_abandoned_pass_not_corrupted():
+    """An abandoned partial first pass must not poison the cache with
+    duplicated samples."""
+    import itertools
+
+    c = P.reader.cache(_r(range(5)))
+    assert list(itertools.islice(c(), 3)) == [0, 1, 2]  # abandoned
+    assert list(c()) == [0, 1, 2, 3, 4]
+    assert list(c()) == [0, 1, 2, 3, 4]
+
+
 def test_reader_map_chain_shuffle_buffered():
     assert list(P.reader.map_readers(
         lambda a, b: a + b, _r([1, 2]), _r([10, 20]))()) == [11, 22]
@@ -214,6 +225,54 @@ def test_dataset_mnist(tmp_path):
     assert x.shape == (784,) and x.dtype == np.float32
     assert -1.0 <= x.min() and x.max() <= 1.0
     assert [s[1] for s in samples] == [0, 1, 2]
+
+
+def test_dataset_cifar_real_archives(tmp_path):
+    """CIFAR loaders parse the official pickled-batch tar format (and
+    raise on the wrong archive) — the legacy reader yields the flat
+    [0, 1] float vector exactly once normalized."""
+    import pickle
+
+    from paddle_tpu.dataset import cifar
+
+    rs = np.random.RandomState(0)
+
+    def make_tar(path, members):
+        with tarfile.open(path, "w:gz") as tf:
+            for name, batch in members.items():
+                data = pickle.dumps(batch)
+                _add_bytes(tf, name, data)
+
+    img = (rs.rand(4, 3072) * 255).astype(np.uint8)
+    p10 = tmp_path / "cifar-10-python.tar.gz"
+    make_tar(p10, {
+        "cifar-10-batches-py/data_batch_1":
+            {b"data": img[:2], b"labels": [1, 2]},
+        "cifar-10-batches-py/data_batch_2":
+            {b"data": img[2:], b"labels": [3, 4]},
+        "cifar-10-batches-py/test_batch":
+            {b"data": img[:1], b"labels": [5]},
+    })
+    train = list(cifar.train10(data_file=str(p10))())
+    assert len(train) == 4
+    x, y = train[0]
+    assert x.shape == (3072,) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0 and x.max() > 0.01
+    assert sorted(s[1] for s in train) == [1, 2, 3, 4]
+    assert len(list(cifar.test10(data_file=str(p10))())) == 1
+
+    p100 = tmp_path / "cifar-100-python.tar.gz"
+    make_tar(p100, {
+        "cifar-100-python/train":
+            {b"data": img[:3], b"fine_labels": [10, 20, 30]},
+        "cifar-100-python/test":
+            {b"data": img[3:], b"fine_labels": [40]},
+    })
+    assert [s[1] for s in cifar.train100(data_file=str(p100))()] == \
+        [10, 20, 30]
+    # wrong archive fails loudly, never parses as the other format
+    with pytest.raises(RuntimeError, match="wrong archive"):
+        list(cifar.train100(data_file=str(p10))())
 
 
 def test_dataset_voc2012(tmp_path):
