@@ -310,18 +310,15 @@ def test_launcher_two_process_jax_distributed(tmp_path):
     assert "rank 0 allgather ok" in logs and "rank 1 allgather ok" in logs
 
 
-@pytest.mark.slow
-def test_two_process_data_parallel_training(tmp_path):
-    """REAL multi-host-style training (SURVEY §2.2 comm backend at
-    scale): two launcher-spawned processes form one global 2-device
-    mesh, each feeds its LOCAL batch shard, and the compiled hybrid
-    train step assembles global arrays and syncs grads across processes.
-    Loss must be identical on both ranks and decrease."""
+def _two_process_training(tmp_path, dp, mp, sharding, per_rank_seed):
+    """Two launcher-spawned processes over the jax coordination service
+    form one global 2-device mesh and run the compiled hybrid train step
+    (SURVEY §2.2 comm backend at scale). Returns per-rank loss strings."""
     import subprocess
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = tmp_path / "dp_worker.py"
+    worker = tmp_path / "worker.py"
     worker.write_text(
         "import os, sys\n"
         f"sys.path.insert(0, {repo!r})\n"
@@ -337,16 +334,22 @@ def test_two_process_data_parallel_training(tmp_path):
         "rank = jax.process_index()\n"
         "topology.reset_topology()\n"
         "strategy = fleet.DistributedStrategy()\n"
-        "strategy.hybrid_configs = {'dp_degree': 2, 'mp_degree': 1,\n"
-        "    'pp_degree': 1, 'sep_degree': 1, 'sharding_degree': 2}\n"
-        "fleet.init(is_collective=True, strategy=strategy)\n"
+        f"strategy.hybrid_configs = {{'dp_degree': {dp}, "
+        f"'mp_degree': {mp},\n"
+        "    'pp_degree': 1, 'sep_degree': 1, "
+        f"'sharding_degree': {dp if sharding else 1}}}\n"
+        + ("strategy.sharding = True\n"
+           "strategy.sharding_configs = {'stage': 2}\n" if sharding
+           else "")
+        + "fleet.init(is_collective=True, strategy=strategy)\n"
         "P.seed(0)  # same init on both ranks\n"
         "model = fleet.distributed_model(GPTForCausalLM(gpt_tiny()))\n"
         "opt = fleet.distributed_optimizer(P.optimizer.AdamW(\n"
         "    parameters=model.parameters(), learning_rate=1e-3))\n"
         "crit = GPTPretrainingCriterion()\n"
-        "rs = np.random.RandomState(100 + rank)  # per-rank data shard\n"
-        "ids = P.to_tensor(rs.randint(0, 1024, (2, 32)), 'int32')\n"
+        + (f"rs = np.random.RandomState(100 + rank)\n" if per_rank_seed
+           else "rs = np.random.RandomState(100)\n")
+        + "ids = P.to_tensor(rs.randint(0, 1024, (2, 32)), 'int32')\n"
         "labels = P.to_tensor(rs.randint(0, 1024, (2, 32)), 'int32')\n"
         "losses = [float(model.train_batch((ids, labels), optimizer=opt,\n"
         "    loss_fn=crit)) for _ in range(3)]\n"
@@ -369,9 +372,28 @@ def test_two_process_data_parallel_training(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr, logs)
     import re as _re
 
-    got = {i: _re.search(r"losses \[([^\]]+)\]", logs[i]).group(1)
-           for i in logs}
-    # grad all-reduce across processes: both ranks saw the SAME losses
+    return {i: _re.search(r"losses \[([^\]]+)\]", logs[i]).group(1)
+            for i in logs}
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_training(tmp_path):
+    """dp=2 + ZeRO-2 across processes: each rank feeds its LOCAL batch
+    shard; grads all-reduce and dp-sharded optimizer slots assemble
+    across processes. Losses identical on both ranks and decreasing."""
+    got = _two_process_training(tmp_path, dp=2, mp=1, sharding=True,
+                                per_rank_seed=True)
+    assert got[0] == got[1], got
+
+
+@pytest.mark.slow
+def test_two_process_tensor_parallel_training(tmp_path):
+    """mp=2 across processes: Column/RowParallelLinear weights are
+    SHARDED over non-addressable devices (global-array assembly in
+    _put_state) and activations all-reduce over ICI-analog sockets.
+    Same data both ranks; losses identical and decreasing."""
+    got = _two_process_training(tmp_path, dp=1, mp=2, sharding=False,
+                                per_rank_seed=False)
     assert got[0] == got[1], got
 
 
