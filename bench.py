@@ -281,6 +281,13 @@ def run_secondary_benches(degraded: bool = False) -> None:
         "vit_b16_train_images_per_sec_per_chip",
         flops_per_image=3 * 17.6e9, degraded=degraded,
         batch_candidates=[128, 64, 32] if not degraded else [2], **kw))
+    # config 5 (second model family): Swin-T windowed attention.
+    # 224x224 fwd ~4.5 GFLOPs/img; train ~3x.
+    _emit(_bench_vision_model(
+        lambda: V.swin_t(num_classes=1000),
+        "swin_t_train_images_per_sec_per_chip",
+        flops_per_image=3 * 4.5e9, degraded=degraded,
+        batch_candidates=[128, 64, 32] if not degraded else [2], **kw))
     try:
         _emit(_bench_decode(degraded))
     except Exception as e:

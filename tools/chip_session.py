@@ -510,25 +510,36 @@ def phase_decode_quant():
             x = jnp.asarray(rs.randn(B, h_in), jnp.bfloat16)
             b1, b2 = w1.astype(jnp.bfloat16), w2.astype(jnp.bfloat16)
             f_bf16 = jax.jit(lambda x, b1=b1, b2=b2: (x @ b1) @ b2)
-            q1, s1 = (t._value for t in Q.weight_quantize(
-                w1, algo="weight_only_int8"))
-            q2, s2 = (t._value for t in Q.weight_quantize(
-                w2, algo="weight_only_int8"))
+            def quant_pair(algo):
+                """jitted up+down GEMM pair over `algo`-quantized
+                weights (both weight streams come from HBM each call)."""
+                q1, s1 = (t._value for t in Q.weight_quantize(w1,
+                                                              algo=algo))
+                q2, s2 = (t._value for t in Q.weight_quantize(w2,
+                                                              algo=algo))
 
-            def int8_pair(x, q1=q1, s1=s1, q2=q2, s2=s2):
-                d1 = Q.weight_dequantize.raw(q1, s1, "weight_only_int8",
-                                             jnp.bfloat16, -1)
-                d2 = Q.weight_dequantize.raw(q2, s2, "weight_only_int8",
-                                             jnp.bfloat16, -1)
-                return (x @ d1) @ d2
+                def pair(x, q1=q1, s1=s1, q2=q2, s2=s2):
+                    d1 = Q.weight_dequantize.raw(q1, s1, algo,
+                                                 jnp.bfloat16, -1)
+                    d2 = Q.weight_dequantize.raw(q2, s2, algo,
+                                                 jnp.bfloat16, -1)
+                    return (x @ d1) @ d2
 
-            f_int8 = jax.jit(int8_pair)
+                return jax.jit(pair)
+
             t_bf = slope(f_bf16, x, n1=8, n2=40)
-            t_q = slope(f_int8, x, n1=8, n2=40)
+            t_q = slope(quant_pair("weight_only_int8"), x, n1=8, n2=40)
+            try:  # best-effort: int4 must not cost the bf16/int8 data
+                t_q4 = slope(quant_pair("weight_only_int4"), x,
+                             n1=8, n2=40)
+            except Exception:
+                t_q4 = None
             bytes_bf = 2 * h_in * h_out * 2  # two bf16 weight streams
             bytes_q = 2 * h_in * h_out  # two int8 weight streams
+            bytes_q4 = h_in * h_out  # two packed-nibble streams
             bf_gbps = bytes_bf / t_bf / 1e9
             q_gbps = bytes_q / t_q / 1e9
+            q4_gbps = bytes_q4 / t_q4 / 1e9 if t_q4 else None
             # roofline sanity (r4 lesson: 3.8 GB/s meant the harness was
             # timing dispatch, not the kernel): flag implausible numbers
             # in-band so a bad methodology can never pass silently again
@@ -537,9 +548,12 @@ def phase_decode_quant():
                 "shape": f"{tag}-pair {B}x{h_in}x{h_out}",
                 "bf16_ms": round(t_bf * 1e3, 3),
                 "int8_ms": round(t_q * 1e3, 3),
+                "int4_ms": round(t_q4 * 1e3, 3) if t_q4 else None,
                 "bf16_gbps": round(bf_gbps, 1),
                 "int8_gbps": round(q_gbps, 1),
+                "int4_gbps": round(q4_gbps, 1) if t_q4 else None,
                 "speedup": round(t_bf / t_q, 2),
+                "speedup_int4": round(t_bf / t_q4, 2) if t_q4 else None,
                 "roofline_sane": sane})
         except Exception as e:
             log("decode_quant", {"shape": tag,
