@@ -17,9 +17,34 @@ from ..core.tensor import Tensor
 
 __all__ = ["recompute", "recompute_sequential"]
 
+# Named rematerialization policies (the TPU memory/FLOPs dial — SURVEY §7
+# hard part (c)). "full" replays everything in backward (max memory
+# savings); "dots" saves every matmul output (min recompute FLOPs);
+# "dots_no_batch" saves matmul outputs except batched dots — the
+# standard transformer sweet spot: the attention/mlp GEMMs whose
+# recompute costs real MXU time are saved, cheap elementwise replays.
+_POLICIES = {
+    None: None,
+    "full": None,
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+}
+
+
+def _resolve_policy(name):
+    if name not in _POLICIES:
+        raise ValueError(
+            f"recompute policy must be one of {sorted(k for k in _POLICIES if k)}"
+            f" or None, got {name!r}")
+    attr = _POLICIES[name]
+    return getattr(jax.checkpoint_policies, attr) if attr else None
+
 
 def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
-              **kwargs):
+              policy=None, **kwargs):
+    # validate uniformly: a typo'd policy must fail in eager debugging
+    # too, not only once the job reaches a traced run
+    pol = _resolve_policy(policy)
     if not flags.in_trace():
         return function(*args, **kwargs)
 
@@ -38,7 +63,9 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
             lambda o: o._value if isinstance(o, Tensor) else o, out,
             is_leaf=lambda x: isinstance(x, Tensor))
 
-    out_vals = jax.checkpoint(pure)(*vals)
+    ckpt = jax.checkpoint(pure, policy=pol) if pol is not None \
+        else jax.checkpoint(pure)
+    out_vals = ckpt(*vals)
     return jax.tree_util.tree_map(lambda v: Tensor(v), out_vals)
 
 

@@ -31,7 +31,8 @@ class GPTConfig:
                  num_heads=12, max_seq_len=1024, ffn_hidden=None,
                  dropout=0.0, attn_dropout=0.0, use_rope=False,
                  use_rmsnorm=False, use_swiglu=False, tie_embeddings=True,
-                 recompute=False, sequence_parallel=False,
+                 recompute=False, recompute_policy=None,
+                 sequence_parallel=False,
                  context_parallel=False, layer_norm_eps=1e-5,
                  fused_head_ce=False):
         self.vocab_size = vocab_size
@@ -49,6 +50,8 @@ class GPTConfig:
         self.use_swiglu = use_swiglu
         self.tie_embeddings = tie_embeddings
         self.recompute = recompute
+        # named remat policy: None/'full' | 'dots' | 'dots_no_batch'
+        self.recompute_policy = recompute_policy
         self.sequence_parallel = sequence_parallel
         self.context_parallel = context_parallel
         self.layer_norm_eps = layer_norm_eps
@@ -191,7 +194,8 @@ class GPTBlock(nn.Layer):
             x = x + self.mlp(self.ln_2(x))
             return x, new_cache
         if self.cfg.recompute and self.training:
-            return _recompute(self._body, x)
+            return _recompute(self._body, x,
+                              policy=self.cfg.recompute_policy)
         return self._body(x)
 
 

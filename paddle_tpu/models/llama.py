@@ -29,7 +29,8 @@ class LlamaConfig:
                  num_heads=32, num_kv_heads=None, max_seq_len=2048,
                  ffn_hidden=11008, rope_theta=10000.0, rms_eps=1e-6,
                  dropout=0.0, tie_embeddings=False, recompute=False,
-                 sequence_parallel=False, context_parallel=False):
+                 recompute_policy=None, sequence_parallel=False,
+                 context_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -42,6 +43,8 @@ class LlamaConfig:
         self.dropout = dropout
         self.tie_embeddings = tie_embeddings
         self.recompute = recompute
+        # named remat policy: None/'full' | 'dots' | 'dots_no_batch'
+        self.recompute_policy = recompute_policy
         self.sequence_parallel = sequence_parallel
         self.context_parallel = context_parallel
 
@@ -172,7 +175,8 @@ class LlamaBlock(nn.Layer):
             x = x + a
             return x + self.mlp(self.post_norm(x)), new_cache
         if self.cfg.recompute and self.training:
-            return _recompute(self._body, x)
+            return _recompute(self._body, x,
+                              policy=self.cfg.recompute_policy)
         return self._body(x)
 
 

@@ -148,11 +148,14 @@ def test_recompute_matches():
     _init(dp=2, mp=2)
     crit = GPTPretrainingCriterion()
     losses = {}
-    for rc in (False, True):
+    # remat policies only change WHAT XLA saves vs replays — every
+    # variant must train identically to the no-remat baseline
+    for rc in (False, True, "dots", "dots_no_batch"):
         P.seed(0)
         topology.reset_topology()
         _init(dp=2, mp=2)
-        cfg = gpt_tiny(recompute=rc, dropout=0.0)
+        cfg = gpt_tiny(recompute=bool(rc), dropout=0.0,
+                       recompute_policy=rc if isinstance(rc, str) else None)
         model = fleet.distributed_model(GPTForCausalLM(cfg))
         opt = fleet.distributed_optimizer(
             P.optimizer.SGD(parameters=model.parameters(), learning_rate=0.1))
@@ -164,7 +167,15 @@ def test_recompute_matches():
         l = [float(model.train_batch((ids, labels), optimizer=opt,
                                      loss_fn=crit)) for _ in range(2)]
         losses[rc] = l
-    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-4)
+    for rc in (True, "dots", "dots_no_batch"):
+        np.testing.assert_allclose(losses[False], losses[rc], rtol=1e-4,
+                                   err_msg=f"policy={rc}")
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.distributed.recompute import recompute as _rec
+
+    with pytest.raises(ValueError, match="recompute policy"):
+        with _flags.trace_guard():
+            _rec(lambda x: x, P.ones([2]), policy="bogus")
 
 
 @pytest.mark.slow
