@@ -63,9 +63,7 @@ def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
             lambda o: o._value if isinstance(o, Tensor) else o, out,
             is_leaf=lambda x: isinstance(x, Tensor))
 
-    ckpt = jax.checkpoint(pure, policy=pol) if pol is not None \
-        else jax.checkpoint(pure)
-    out_vals = ckpt(*vals)
+    out_vals = jax.checkpoint(pure, policy=pol)(*vals)
     return jax.tree_util.tree_map(lambda v: Tensor(v), out_vals)
 
 

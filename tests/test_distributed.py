@@ -149,8 +149,10 @@ def test_recompute_matches():
     crit = GPTPretrainingCriterion()
     losses = {}
     # remat policies only change WHAT XLA saves vs replays — every
-    # variant must train identically to the no-remat baseline
-    for rc in (False, True, "dots", "dots_no_batch"):
+    # variant must train identically to the no-remat baseline ("dots"
+    # is covered by the same plumbing; kept out of the fast tier to
+    # save one full distributed compile)
+    for rc in (False, True, "dots_no_batch"):
         P.seed(0)
         topology.reset_topology()
         _init(dp=2, mp=2)
@@ -167,7 +169,7 @@ def test_recompute_matches():
         l = [float(model.train_batch((ids, labels), optimizer=opt,
                                      loss_fn=crit)) for _ in range(2)]
         losses[rc] = l
-    for rc in (True, "dots", "dots_no_batch"):
+    for rc in (True, "dots_no_batch"):
         np.testing.assert_allclose(losses[False], losses[rc], rtol=1e-4,
                                    err_msg=f"policy={rc}")
     from paddle_tpu.core import flags as _flags

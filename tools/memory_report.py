@@ -25,8 +25,9 @@ import sys
 sys.path.insert(0, ".")
 
 
-def step_memory(cfg_kwargs, batch, seq):
-    """Compile one GPT train step; return XLA memory analysis numbers."""
+def _build_lowered(cfg_kwargs, batch, seq):
+    """One GPT train step lowered for (batch, seq); returns
+    (lowered, model) — the shared setup for every report below."""
     import numpy as np
 
     import paddle_tpu as P
@@ -53,7 +54,15 @@ def step_memory(cfg_kwargs, batch, seq):
     ids = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
     labels = P.to_tensor(
         rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
-    compiled = step.lower(ids, labels).compile()
+    return step.lower(ids, labels), model
+
+
+def step_memory(cfg_kwargs, batch, seq):
+    """Compile one GPT train step; return XLA memory analysis numbers."""
+    import numpy as np
+
+    lowered, model = _build_lowered(cfg_kwargs, batch, seq)
+    compiled = lowered.compile()
     ma = compiled.memory_analysis()
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     out = {"params": n_params,
@@ -95,6 +104,27 @@ def main():
         print(f"# cut-CE saves {saved:.0f} MiB of XLA temp buffers "
               f"({ok[0]['temp_mb']:.0f} -> {ok[1]['temp_mb']:.0f} MiB) "
               f"at B{batch} S{seq} V50304", flush=True)
+
+    # remat-policy A/B: XLA:CPU's buffer assignment does NOT realize
+    # remat's memory win (temp pools come out identical), so the
+    # chip-free evidence here is program STRUCTURE — the backward
+    # recomputes forward ops under remat, and dots_no_batch recomputes
+    # fewer GEMMs than full remat. The on-chip memory_headroom phase
+    # carries the device-memory half.
+    deep = dict(vocab_size=1024, hidden_size=512, num_layers=8,
+                num_heads=8, max_seq_len=512, fused_head_ce=True)
+    for rc, pol in ((False, None), (True, None), (True, "dots_no_batch")):
+        try:
+            lowered, _ = _build_lowered(
+                dict(deep, recompute=rc, recompute_policy=pol), batch, seq)
+            txt = lowered.as_text()
+            m = {"lowered_lines": len(txt.splitlines()),
+                 "dot_generals": txt.count("dot_general")}
+        except Exception as e:
+            m = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+        row = {"shape": "deep-h512-L8", "recompute": rc,
+               "policy": pol or ("full" if rc else None), **m}
+        print(json.dumps(row), flush=True)
     return 0
 
 
