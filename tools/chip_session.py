@@ -607,6 +607,120 @@ def phase_generate_1p3b():
                           "weight_stream_gbps": round(gbps, 1)})
 
 
+def phase_breakdown():
+    """Step-cost breakdown at the bench shape (r5: MFU is 27.6% while the
+    sanity matmul hits 90% of peak — find the ~300 ms of non-matmul time).
+    Times, inside one fori_loop each (slope methodology): fwd-only,
+    fwd+bwd over all params, fwd+bwd excluding the tied embedding (its
+    grad = CE-head GEMM + gather-bwd scatter — the scatter is the prime
+    TPU suspect), and the full train step. Differences localize the cost:
+      embed_grad = fwdbwd_all - fwdbwd_no_wte
+      optimizer+cast = step - fwdbwd_all
+    The loop body depends on the carry through a 1e-12 param perturbation
+    so LICM cannot hoist the (otherwise loop-invariant) computation."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as P
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    batch, seq = 32, 1024
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=seq, fused_head_ce=True)
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+        "sep_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    inner = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(model=inner)
+    params, buffers = inner.functional_state()
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    wte_key = next(k for k in params if k.endswith("wte.weight"))
+
+    def loss_from(p):
+        with _flags.trace_guard():
+            with inner.bind_state(p, buffers):
+                inner.train()
+                out = inner(Tensor(ids))
+                return crit(out, Tensor(labels))._value
+
+    def perturbed(p, t):
+        q = dict(p)
+        q[wte_key] = q[wte_key] + t * 1e-12
+        return q
+
+    def f_fwd(t):
+        return t + loss_from(perturbed(params, t)) * 1e-20
+
+    def f_bwd_all(t):
+        g = jax.grad(lambda p: loss_from(p))(perturbed(params, t))
+        return t + g[wte_key][0, 0] * 1e-20
+
+    no_wte = {k: v for k, v in params.items() if k != wte_key}
+
+    def f_bwd_no_wte(t):
+        g = jax.grad(lambda q: loss_from(
+            {**q, wte_key: params[wte_key] + t * 1e-12}))(no_wte)
+        leaf = next(iter(g.values()))
+        return t + leaf.ravel()[0] * 1e-20
+
+    t0 = jnp.zeros((1,), jnp.float32)
+    out = {}
+    for name, f in (("fwd_ms", f_fwd), ("fwdbwd_ms", f_bwd_all),
+                    ("fwdbwd_no_wte_ms", f_bwd_no_wte)):
+        try:
+            out[name] = round(slope(f, t0, n1=2, n2=8) * 1e3, 2)
+        except Exception as e:
+            out[name] = f"{type(e).__name__}: {str(e)[:80]}"
+    # full train step via run_steps at two repeats (same slope idea)
+    try:
+        model = fleet.distributed_model(inner)
+        opt = fleet.distributed_optimizer(P.optimizer.AdamW(
+            parameters=model.parameters(), learning_rate=1e-4))
+        step = model.build_train_step(opt, crit, amp_dtype="bfloat16")
+        tids = P.to_tensor(np.asarray(ids), "int32")
+        tlabels = P.to_tensor(np.asarray(labels), "int32")
+        float(np.asarray(step.run_steps(tids, tlabels,
+                                        repeat=2)._value[-1]))  # warm
+        best = 1e9
+        for _ in range(2):
+            t1 = time.perf_counter()
+            float(np.asarray(step.run_steps(tids, tlabels,
+                                            repeat=2)._value[-1]))
+            d1 = time.perf_counter() - t1
+            t1 = time.perf_counter()
+            float(np.asarray(step.run_steps(tids, tlabels,
+                                            repeat=8)._value[-1]))
+            d2 = time.perf_counter() - t1
+            if d2 > d1:
+                best = min(best, (d2 - d1) / 6)
+        out["step_ms"] = round(best * 1e3, 2)
+    except Exception as e:
+        out["step_ms"] = f"{type(e).__name__}: {str(e)[:80]}"
+    if isinstance(out.get("fwdbwd_ms"), float) and \
+            isinstance(out.get("fwdbwd_no_wte_ms"), float):
+        out["embed_grad_ms"] = round(
+            out["fwdbwd_ms"] - out["fwdbwd_no_wte_ms"], 2)
+    if isinstance(out.get("step_ms"), float) and \
+            isinstance(out.get("fwdbwd_ms"), float):
+        out["opt_overhead_ms"] = round(out["step_ms"] - out["fwdbwd_ms"], 2)
+    log("breakdown", {"shape": f"B{batch}S{seq}", **out})
+
+
 def phase_bench():
     t0 = time.perf_counter()
     r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
@@ -638,6 +752,7 @@ def phase_bench():
 
 
 PHASES = {"bench_quick": phase_bench_quick,
+          "breakdown": phase_breakdown,
           "sanity": phase_sanity, "sweep": phase_sweep,
           "kernels": phase_kernels, "gqa_ab": phase_gqa_ab,
           "autotune": phase_autotune_seed,
