@@ -85,13 +85,27 @@ def _slope_time(f, x, n1=2, n2=8) -> float:
         return jax.lax.fori_loop(0, n, lambda i, y: f(y), x)
 
     _sync_fetch(loop(x, n1))  # compile + warm
-    t0 = time.perf_counter()
-    _sync_fetch(loop(x, n1))
-    d1 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    _sync_fetch(loop(x, n2))
-    d2 = time.perf_counter() - t0
-    return max((d2 - d1) / (n2 - n1), 1e-9)
+    # a tunnel stall during either timing corrupts the difference —
+    # clamping a negative diff to ~0 once made the WORST candidate "win"
+    # a search (r5: (128,128) cached for 16x1024x12x64). Only positive
+    # diffs count; a candidate with no valid timing in 4 tries loses.
+    best = float("inf")
+    valid = 0
+    for _ in range(4):
+        t0 = time.perf_counter()
+        _sync_fetch(loop(x, n1))
+        d1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _sync_fetch(loop(x, n2))
+        d2 = time.perf_counter() - t0
+        if d2 > d1:
+            valid += 1
+            best = min(best, (d2 - d1) / (n2 - n1))
+            if valid >= 2:
+                break
+    if valid == 0:
+        raise RuntimeError("no valid timing (tunnel stalls)")
+    return best
 
 
 def pick(op: str, signature, candidates, run, default):
