@@ -43,7 +43,7 @@ def sync(x):
     return float(np.asarray(jax.device_get(x.ravel()[0:1]), np.float32)[0])
 
 
-def slope(f, x, n1=4, n2=16, reps=2):
+def slope(f, x, n1=4, n2=16, reps=2, consts=()):
     """Per-iteration time of a shape-preserving f, with dispatch overhead
     cancelled OUT OF THE COMPILED PROGRAM, not just out of the host loop.
 
@@ -58,11 +58,16 @@ def slope(f, x, n1=4, n2=16, reps=2):
     scanned decode/train step has."""
     import jax
 
+    # `consts` ride as jit ARGUMENTS, not closure captures: a closed-over
+    # device array is baked into the HLO as a literal, and through this
+    # remote-compile tunnel a big weight constant blows the request-body
+    # limit (r5: lm-head decode_quant died with HTTP 413)
     @jax.jit
-    def run(x, n):
-        return jax.lax.fori_loop(0, n, lambda i, y: f(y), x)
+    def run(x, n, *cs):
+        body = (lambda i, y: f(y, *cs)) if cs else (lambda i, y: f(y))
+        return jax.lax.fori_loop(0, n, body, x)
 
-    sync(run(x, n1))  # compile + warm (one executable serves both n)
+    sync(run(x, n1, *consts))  # compile + warm (one executable, both n)
     best = 1e9
     # a tunnel hiccup during either timing makes (d2-d1) negative or
     # absurd (observed r5: fwd_ms=-184): only positive diffs count, and
@@ -71,9 +76,9 @@ def slope(f, x, n1=4, n2=16, reps=2):
     valid = 0
     while valid < reps and attempts < reps + 3:
         attempts += 1
-        t0 = time.perf_counter(); sync(run(x, n1))
+        t0 = time.perf_counter(); sync(run(x, n1, *consts))
         d1 = time.perf_counter() - t0
-        t0 = time.perf_counter(); sync(run(x, n2))
+        t0 = time.perf_counter(); sync(run(x, n2, *consts))
         d2 = time.perf_counter() - t0
         per_it = (d2 - d1) / (n2 - n1)
         if per_it > 0:
@@ -516,34 +521,39 @@ def phase_decode_quant():
                              (2048, 50304, "lm-head")):
         try:
             # slope() chains f(f(x)): use an up+down GEMM pair so shapes
-            # round-trip; both weights stream from HBM each call
+            # round-trip; both weights stream from HBM each call. Weights
+            # ride as slope consts (jit args) — closure-captured device
+            # arrays become HLO literals and the lm-head pair's ~400 MB
+            # of constants blew the remote-compile body limit (HTTP 413)
             w1 = jnp.asarray(rs.randn(h_in, h_out) * 0.02, jnp.float32)
             w2 = jnp.asarray(rs.randn(h_out, h_in) * 0.02, jnp.float32)
             x = jnp.asarray(rs.randn(B, h_in), jnp.bfloat16)
             b1, b2 = w1.astype(jnp.bfloat16), w2.astype(jnp.bfloat16)
-            f_bf16 = jax.jit(lambda x, b1=b1, b2=b2: (x @ b1) @ b2)
-            def quant_pair(algo):
-                """jitted up+down GEMM pair over `algo`-quantized
-                weights (both weight streams come from HBM each call)."""
+
+            def bf16_pair(x, b1, b2):
+                return (x @ b1) @ b2
+
+            def quant_args(algo):
                 q1, s1 = (t._value for t in Q.weight_quantize(w1,
                                                               algo=algo))
                 q2, s2 = (t._value for t in Q.weight_quantize(w2,
                                                               algo=algo))
 
-                def pair(x, q1=q1, s1=s1, q2=q2, s2=s2):
+                def pair(x, q1, s1, q2, s2, algo=algo):
                     d1 = Q.weight_dequantize.raw(q1, s1, algo,
                                                  jnp.bfloat16, -1)
                     d2 = Q.weight_dequantize.raw(q2, s2, algo,
                                                  jnp.bfloat16, -1)
                     return (x @ d1) @ d2
 
-                return jax.jit(pair)
+                return pair, (q1, s1, q2, s2)
 
-            t_bf = slope(f_bf16, x, n1=8, n2=40)
-            t_q = slope(quant_pair("weight_only_int8"), x, n1=8, n2=40)
+            t_bf = slope(bf16_pair, x, n1=8, n2=40, consts=(b1, b2))
+            f8, c8 = quant_args("weight_only_int8")
+            t_q = slope(f8, x, n1=8, n2=40, consts=c8)
             try:  # best-effort: int4 must not cost the bf16/int8 data
-                t_q4 = slope(quant_pair("weight_only_int4"), x,
-                             n1=8, n2=40)
+                f4, c4 = quant_args("weight_only_int4")
+                t_q4 = slope(f4, x, n1=8, n2=40, consts=c4)
             except Exception:
                 t_q4 = None
             bytes_bf = 2 * h_in * h_out * 2  # two bf16 weight streams
@@ -651,6 +661,12 @@ def phase_breakdown():
                          jnp.int32)
     wte_key = next(k for k in params if k.endswith("wte.weight"))
 
+    # params ride as slope consts (jit args, not closure constants —
+    # 125M params as HLO literals would blow the remote-compile limit)
+    keys = sorted(params)
+    leaves = tuple(params[k]._value if hasattr(params[k], "_value")
+                   else params[k] for k in keys)
+
     def loss_from(p):
         with _flags.trace_guard():
             with inner.bind_state(p, buffers):
@@ -658,23 +674,22 @@ def phase_breakdown():
                 out = inner(Tensor(ids))
                 return crit(out, Tensor(labels))._value
 
-    def perturbed(p, t):
-        q = dict(p)
-        q[wte_key] = q[wte_key] + t * 1e-12
-        return q
+    def rebuild(t, *lv):
+        p = dict(zip(keys, lv))
+        p[wte_key] = p[wte_key] + t.ravel()[0] * 1e-12
+        return p
 
-    def f_fwd(t):
-        return t + loss_from(perturbed(params, t)) * 1e-20
+    def f_fwd(t, *lv):
+        return t + loss_from(rebuild(t, *lv)) * 1e-20
 
-    def f_bwd_all(t):
-        g = jax.grad(lambda p: loss_from(p))(perturbed(params, t))
+    def f_bwd_all(t, *lv):
+        g = jax.grad(lambda p: loss_from(p))(rebuild(t, *lv))
         return t + g[wte_key][0, 0] * 1e-20
 
-    no_wte = {k: v for k, v in params.items() if k != wte_key}
-
-    def f_bwd_no_wte(t):
-        g = jax.grad(lambda q: loss_from(
-            {**q, wte_key: params[wte_key] + t * 1e-12}))(no_wte)
+    def f_bwd_no_wte(t, *lv):
+        p = rebuild(t, *lv)
+        wte = p.pop(wte_key)
+        g = jax.grad(lambda q: loss_from({**q, wte_key: wte}))(p)
         leaf = next(iter(g.values()))
         return t + leaf.ravel()[0] * 1e-20
 
@@ -683,7 +698,8 @@ def phase_breakdown():
     for name, f in (("fwd_ms", f_fwd), ("fwdbwd_ms", f_bwd_all),
                     ("fwdbwd_no_wte_ms", f_bwd_no_wte)):
         try:
-            out[name] = round(slope(f, t0, n1=2, n2=8) * 1e3, 2)
+            out[name] = round(
+                slope(f, t0, n1=2, n2=8, consts=leaves) * 1e3, 2)
         except Exception as e:
             out[name] = f"{type(e).__name__}: {str(e)[:80]}"
     # full train step via run_steps at two repeats (same slope idea)
