@@ -1025,11 +1025,13 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
     fwd ≈ 1.3 ms vs 128x128 ≈ 6.0 ms (PERF.md)."""
     from . import autotune
 
-    # curated candidate pairs: the full {128..1024}^2 grid costs ~16 TPU
-    # compiles of fwd+bwd on the first call for a new signature (~10 min
+    # curated candidate pairs, preference-ordered by the round-5 hardware
+    # sweep (PERF.md: (512, 1024) wins fwd+bwd at BOTH the GPT-125M bench
+    # shape, 3.18 ms vs 4.23 for the old (256, 512) default, and the
+    # LLaMA-class B8 H16 S2048 D128 shape). The full {128..1024}^2 grid
+    # costs ~16 TPU compiles of fwd+bwd per new signature (~10 min
     # through a tunnel); these six cover the measured-good region
-    # (PERF.md round-3 sweep: big blocks win until VMEM pressure)
-    pairs = ((1024, 1024), (512, 1024), (256, 512), (512, 512),
+    pairs = ((512, 1024), (1024, 1024), (512, 512), (256, 512),
              (256, 256), (128, 128))
 
     def vmem_est(bq, bk):
@@ -1052,8 +1054,12 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
              for bq, bk in pairs
              if sq % bq == 0 and sk % bk == 0 and bq <= sq and bk <= sk
              and vmem_est(bq, bk) <= 12 * 1024 * 1024]
-    default = (_pick_block(sq, DEFAULT_BLOCK_Q),
-               _pick_block(sk, DEFAULT_BLOCK_K))
+    # static default = best measured pair that FITS this shape (pairs are
+    # preference-ordered and vmem-filtered above), so an autotune-cold run
+    # (fresh checkout, FLAGS_use_autotune off, 3-minute tunnel window)
+    # still gets the hardware winner instead of a conservative constant
+    default = cands[0] if cands else (
+        _pick_block(sq, DEFAULT_BLOCK_Q), _pick_block(sk, DEFAULT_BLOCK_K))
     if len(cands) <= 1:
         return default
 

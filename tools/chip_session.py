@@ -737,6 +737,212 @@ def phase_breakdown():
     log("breakdown", {"shape": f"B{batch}S{seq}", **out})
 
 
+def phase_mh_bisect():
+    """Localize the real-toolchain rejection of the transpose-free (mh)
+    flash kernels (PERF.md r5: local lowering gate green, server-side
+    Mosaic HTTP 500 at every block config — the A/B was decided against
+    mh by default). Compiles a ladder of progressively richer mh-style
+    kernels on the real backend; the first rung that fails names the
+    feature the server's Mosaic rejects, which is the fix target."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    import paddle_tpu.ops.pallas.flash_attention as FA
+
+    b, s, h, d = 2, 256, 4, 64
+    bq, bk = 128, 128
+    # arrays ride as jit ARGUMENTS (not closure captures) — a captured
+    # device array bakes into the HLO as a literal and oversized constant
+    # payloads already broke this tunnel's remote compile (HTTP 413,
+    # fixed in slope(); same rule here)
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    qbench = jnp.zeros((4, 1024, 12, 64), jnp.bfloat16)
+
+    def block3d(bi, qi):
+        return (bi, qi, 0, 0)
+
+    def rung_copy3d(x):
+        def kern(q_ref, o_ref):
+            o_ref[...] = q_ref[...]
+
+        return pl.pallas_call(
+            kern, grid=(b, s // bq),
+            in_specs=[pl.BlockSpec((None, bq, h, d), block3d)],
+            out_specs=pl.BlockSpec((None, bq, h, d), block3d),
+            out_shape=jax.ShapeDtypeStruct((b, s, h, d), x.dtype))(x)
+
+    def rung_headwalk(x):
+        def kern(q_ref, o_ref):
+            for hh in range(h):
+                o_ref[:, hh, :] = q_ref[:, hh, :] * 2.0
+
+        return pl.pallas_call(
+            kern, grid=(b, s // bq),
+            in_specs=[pl.BlockSpec((None, bq, h, d), block3d)],
+            out_specs=pl.BlockSpec((None, bq, h, d), block3d),
+            out_shape=jax.ShapeDtypeStruct((b, s, h, d), x.dtype))(x)
+
+    def rung_lse_out(x):
+        def kern(q_ref, o_ref, lse_ref):
+            for hh in range(h):
+                o_ref[:, hh, :] = q_ref[:, hh, :]
+                lse_ref[hh, :, :] = jnp.zeros((bq, 1), jnp.float32)
+
+        return pl.pallas_call(
+            kern, grid=(b, s // bq),
+            in_specs=[pl.BlockSpec((None, bq, h, d), block3d)],
+            out_specs=[pl.BlockSpec((None, bq, h, d), block3d),
+                       pl.BlockSpec((None, h, bq, 1),
+                                    lambda bi, qi: (bi, 0, qi, 0))],
+            out_shape=[jax.ShapeDtypeStruct((b, s, h, d), x.dtype),
+                       jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32)])(x)
+
+    def rung_headdot(x):
+        def kern(q_ref, k_ref, o_ref):
+            for hh in range(h):
+                sblk = jax.lax.dot_general(
+                    q_ref[:, hh, :], k_ref[pl.ds(0, bk), hh, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                o_ref[:, hh, :] = (
+                    sblk[:, :d] * 0.0 + q_ref[:, hh, :].astype(jnp.float32)
+                ).astype(o_ref.dtype)
+
+        return pl.pallas_call(
+            kern, grid=(b, s // bq),
+            in_specs=[pl.BlockSpec((None, bq, h, d), block3d),
+                      pl.BlockSpec((None, s, h, d),
+                                   lambda bi, qi: (bi, 0, 0, 0))],
+            out_specs=pl.BlockSpec((None, bq, h, d), block3d),
+            out_shape=jax.ShapeDtypeStruct((b, s, h, d), x.dtype))(x, x)
+
+    def rung_fwd_mh_small(x):
+        return FA._fwd_mh(x, x, x, True, bq, bk)[0]
+
+    def rung_fwd_mh_bench(x):
+        return FA._fwd_mh(x, x, x, True, 256, 512)[0]
+
+    def rung_bwd_mh_small(x):
+        out, lse = FA._fwd_mh(x, x, x, True, bq, bk)
+        return FA._bwd_mh(x, x, x, out, lse, x, True, bq, bk)[0]
+
+    rungs = [("copy3d", rung_copy3d, q), ("headwalk", rung_headwalk, q),
+             ("lse_out", rung_lse_out, q), ("headdot", rung_headdot, q),
+             ("fwd_mh_small", rung_fwd_mh_small, q),
+             ("fwd_mh_bench", rung_fwd_mh_bench, qbench),
+             ("bwd_mh_small", rung_bwd_mh_small, q)]
+    for name, fn, arg in rungs:
+        t0 = time.perf_counter()
+        try:
+            r = jax.jit(fn).lower(arg).compile()
+            del r
+            log("mh_bisect", {"rung": name, "ok": True,
+                              "s": round(time.perf_counter() - t0, 1)})
+        except Exception as e:
+            log("mh_bisect",
+                {"rung": name, "ok": False,
+                 "error": f"{type(e).__name__}: {str(e)[:300]}"})
+
+
+def _swin_attention_variant(kind):
+    """Ablated WindowAttention.forward bodies for phase_vision_breakdown
+    (module-level so the CPU suite can exercise them without hardware)."""
+    import jax
+
+    from paddle_tpu.core.dispatch import apply as _apply
+
+    def forward(self, x, mask=None):
+        if kind == "identity":
+            return self.proj(x)
+        n_tok = self.ws * self.ws
+        heads = self.num_heads
+        hd = self.dim // heads
+        qkv = self.qkv(x)
+
+        def f(qkv_v, bias_tab, mask_v):
+            Bw = qkv_v.shape[0]
+            qkv_ = qkv_v.reshape(Bw, n_tok, 3, heads, hd)
+            q, k, v = (qkv_[:, :, i].transpose(0, 2, 1, 3)
+                       for i in range(3))
+            attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
+            if kind != "mm_only":
+                if mask_v is not None:
+                    nw = mask_v.shape[0]
+                    attn = attn.reshape(Bw // nw, nw, heads, n_tok,
+                                        n_tok) + mask_v[None, :, None]
+                    attn = attn.reshape(Bw, heads, n_tok, n_tok)
+                attn = jax.nn.softmax(attn, axis=-1)
+            return (attn @ v).transpose(0, 2, 1, 3).reshape(
+                Bw, n_tok, self.dim)
+
+        return self.proj(_apply("window_attention", f, qkv,
+                                self.rel_bias, mask))
+
+    return forward
+
+
+def phase_vision_breakdown():
+    """Localize the vision-bench MFU gap (r5 hardware: ResNet50 ~9.7%,
+    ViT-B ~15%, Swin-T ~3.3% MFU vs GPT-125M's 37.9%). All three share
+    the train-step builder + AMP + slope timing with GPT, so the gap is
+    model-structure cost. Swin is timed at one fixed batch under three
+    attention ablations; differences localize the windowed-attention
+    pipeline:
+      full − no_bias     = relative-position bias gather+add
+      no_bias − mm_only  = softmax (+ shift mask) on [.,h,49,49] tiles
+      mm_only − identity = the tiny 49x32x49 batched attention matmuls
+      identity           = GEMMs + norms + partition/roll transposes
+    ResNet50/ViT-B are re-timed at the same batch for a comparable row."""
+    import bench as bench_mod
+    from paddle_tpu.vision import models as V
+    from paddle_tpu.vision.models import swin as swin_mod
+
+    swin_variant = _swin_attention_variant
+    batch = 64
+    orig = swin_mod.WindowAttention.forward
+    for kind in ("full", "no_bias", "mm_only", "identity"):
+        try:
+            swin_mod.WindowAttention.forward = (
+                orig if kind == "full" else swin_variant(kind))
+            r = bench_mod._bench_vision_model(
+                lambda: V.swin_t(num_classes=1000), f"swin_{kind}",
+                flops_per_image=3 * 4.5e9, batch_candidates=[batch],
+                iters=6)
+            log("vision_breakdown",
+                {"model": f"swin_t[{kind}]", "batch": batch,
+                 "images_per_sec": r.get("value"),
+                 "ms_per_step": round(batch / r["value"] * 1e3, 2)
+                 if r.get("value") else None,
+                 "note": r.get("note", "")})
+        except Exception as e:
+            log("vision_breakdown",
+                {"model": f"swin_t[{kind}]",
+                 "error": f"{type(e).__name__}: {str(e)[:200]}"})
+        finally:
+            swin_mod.WindowAttention.forward = orig
+    for name, factory, fpi in (
+            ("resnet50", lambda: V.resnet50(num_classes=1000), 3 * 4.09e9),
+            ("vit_b_16", lambda: V.vit_b_16(num_classes=1000), 3 * 17.6e9)):
+        try:
+            r = bench_mod._bench_vision_model(
+                factory, name, flops_per_image=fpi,
+                batch_candidates=[batch], iters=6)
+            log("vision_breakdown",
+                {"model": name, "batch": batch,
+                 "images_per_sec": r.get("value"),
+                 "ms_per_step": round(batch / r["value"] * 1e3, 2)
+                 if r.get("value") else None,
+                 "mfu_pct": round((r.get("value") or 0.0) * fpi / 197e12
+                                  * 100, 1),
+                 "note": r.get("note", "")})
+        except Exception as e:
+            log("vision_breakdown",
+                {"model": name,
+                 "error": f"{type(e).__name__}: {str(e)[:200]}"})
+
+
 def phase_bench():
     t0 = time.perf_counter()
     r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
@@ -769,12 +975,14 @@ def phase_bench():
 
 PHASES = {"bench_quick": phase_bench_quick,
           "breakdown": phase_breakdown,
+          "vision_breakdown": phase_vision_breakdown,
           "sanity": phase_sanity, "sweep": phase_sweep,
           "kernels": phase_kernels, "gqa_ab": phase_gqa_ab,
           "autotune": phase_autotune_seed,
           "generate": phase_generate, "decode_quant": phase_decode_quant,
           "generate_1p3b": phase_generate_1p3b,
-          "memory_headroom": phase_memory_headroom, "bench": phase_bench}
+          "memory_headroom": phase_memory_headroom,
+          "mh_bisect": phase_mh_bisect, "bench": phase_bench}
 
 
 def main():
@@ -791,9 +999,10 @@ def main():
     # then sanity/kernels/full-bench, then the heavier serving/memory
     # phases. An early tunnel drop costs the least important data.
     names = sys.argv[1:] or ["bench_quick", "sweep", "sanity", "kernels",
-                             "autotune", "bench", "gqa_ab",
+                             "autotune", "bench", "breakdown", "gqa_ab",
                              "decode_quant", "generate",
-                             "generate_1p3b", "memory_headroom"]
+                             "generate_1p3b", "memory_headroom",
+                             "vision_breakdown", "mh_bisect"]
     for n in names:
         try:
             PHASES[n]()
