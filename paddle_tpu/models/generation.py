@@ -483,7 +483,14 @@ class GenerationMixin:
             # freezing are bit-identical to the single-step path; the
             # all-finished early-exit is checked once per chunk and the
             # exact per-token stop length restored by the trim below.
-            CHUNK = DECODE_CHUNK
+            # Without an eos there is nothing to check between chunks —
+            # the decode runs as ONE scanned dispatch for lengths up to
+            # 128 (same recurrence, larger n, identical token/PRNG
+            # stream). The 128 cap bounds per-length program compiles: a
+            # caller sweeping long lengths reuses the n=128 program for
+            # full chunks (tail-chunk programs were always per-length).
+            CHUNK = (DECODE_CHUNK if eos_token_id is not None
+                     else max(1, min(max_new_tokens - 1, 128)))
             chunks = [tok[:, None]]
             fin_alls = [finished.all()[None]]
             i = 1

@@ -1057,9 +1057,15 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
     # static default = best measured pair that FITS this shape (pairs are
     # preference-ordered and vmem-filtered above), so an autotune-cold run
     # (fresh checkout, FLAGS_use_autotune off, 3-minute tunnel window)
-    # still gets the hardware winner instead of a conservative constant
-    default = cands[0] if cands else (
-        _pick_block(sq, DEFAULT_BLOCK_Q), _pick_block(sk, DEFAULT_BLOCK_K))
+    # still gets the hardware winner instead of a conservative constant.
+    # The default is also what a failed tuning run falls back to, and it
+    # runs UNVALIDATED — so it gets a tighter 8 MB bound (vmem_est omits
+    # backward-only accumulators), falling back to the smallest fitting
+    # pair rather than the most aggressive one
+    default = next(
+        (c for c in cands if vmem_est(*c) <= 8 * 1024 * 1024),
+        cands[-1] if cands else (_pick_block(sq, DEFAULT_BLOCK_Q),
+                                 _pick_block(sk, DEFAULT_BLOCK_K)))
     if len(cands) <= 1:
         return default
 
