@@ -172,3 +172,19 @@ def test_ctc_loss_norm_by_times():
     np.testing.assert_allclose(
         np.asarray(normed.numpy()),
         np.asarray(plain.numpy()) / np.array([6.0, 4.0]), rtol=1e-6)
+
+
+def test_fused_linear_activation_trans_x_matrix_dims_only():
+    """trans_x must transpose the MATRIX dims (reference
+    fused_gemm_epilogue semantics), not reverse all dims — a 3-D input
+    through .T would silently produce a wrong layout (r4 ADVICE)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 8, 4).astype(np.float32)   # [batch, k, m] pre-trans
+    w = rng.randn(8, 5).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    out = IF.fused_linear_activation(
+        P.to_tensor(x), P.to_tensor(w), P.to_tensor(b), trans_x=True,
+        activation="relu")
+    ref = np.maximum(np.swapaxes(x, -1, -2) @ w + b, 0.0)
+    assert list(out.shape) == [2, 4, 5]
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5)

@@ -511,6 +511,13 @@ def test_sdp_kernel_policy_context():
         with F.sdp_kernel(enable_math=False, enable_flash=False,
                           enable_mem_efficient=False):
             F.scaled_dot_product_attention(x, x, x, is_causal=True)
+    # math disabled + flash enabled-but-unavailable (CPU eager has no
+    # Mosaic kernel): silently falling through to the disabled math path
+    # would violate the policy — must raise instead (ADVICE r4)
+    with pytest.raises(RuntimeError, match="unavailable"):
+        with F.sdp_kernel(enable_math=False, enable_flash=True,
+                          enable_mem_efficient=False):
+            F.scaled_dot_product_attention(x, x, x, is_causal=True)
 
 
 # ===================== biased (additive-mask) flash =====================

@@ -621,17 +621,14 @@ def phase_generate_1p3b():
     # lm-head stay bf16 pending the lm-head pair re-run)
     try:
         from paddle_tpu.nn.quant import WeightOnlyLinear
+        from paddle_tpu.quantization import weight_only_quantize
 
         bf16_tps = B * NEW / dt
-        n_q = 0
-        for blk in model.gpt.h:
-            for parent, attr in ((blk.attn, "qkv_proj"),
-                                 (blk.attn, "out_proj"),
-                                 (blk.mlp, "up_proj"),
-                                 (blk.mlp, "down_proj")):
-                setattr(parent, attr,
-                        WeightOnlyLinear.from_linear(getattr(parent, attr)))
-                n_q += 1
+        # two-phase atomic swap of every Linear-family sublayer (the
+        # embedding + tied lm-head are not Linears and stay bf16)
+        weight_only_quantize(model, inplace=True)
+        n_q = sum(1 for _, sl in model.named_sublayers()
+                  if isinstance(sl, WeightOnlyLinear))
         model.eval()
         out = model.generate(prompt, max_new_tokens=NEW)  # compile+warm
         _ = np.asarray(out._value)
@@ -892,9 +889,14 @@ def _swin_attention_variant(kind):
         qkv = self.qkv(x)
         if kind == "identity":
             # keep BOTH projection GEMMs (qkv + proj) so the
-            # mm_only-identity delta isolates the attention math alone
+            # mm_only-identity delta isolates the attention math alone.
+            # All three qkv slices are consumed (summed) — a lone
+            # [..., :dim] slice would let XLA's slice-of-dot rewrite
+            # shrink the qkv GEMM to a third and skew the ablation
             return self.proj(_apply(
-                "window_attention", lambda v: v[..., :self.dim], qkv))
+                "window_attention",
+                lambda v: (v[..., :self.dim] + v[..., self.dim:2 * self.dim]
+                           + v[..., 2 * self.dim:]), qkv))
 
         def f(qkv_v, bias_tab, mask_v):
             Bw = qkv_v.shape[0]
