@@ -583,6 +583,30 @@ def test_flash_biased_bool_mask_and_gate():
                                    jnp.zeros((1, 1, 200, 200)))
 
 
+def test_tuned_blocks_untuned_default(monkeypatch):
+    """Autotune-cold default = the hardware sweep winner that FITS the
+    shape under the tightened 8 MB bound (PERF.md r5: (512,1024) wins
+    fwd+bwd at the bench and LLaMA shapes), never an oversized pair."""
+    from paddle_tpu.ops.pallas import autotune
+
+    monkeypatch.setattr(autotune, "_enabled", lambda: False)
+    # bench shape B32 H12 S1024 D64: winner fits well under 8 MB
+    assert fa._tuned_blocks(32, 1024, 1024, 12, 64, jnp.bfloat16,
+                            True) == (512, 1024)
+    # LLaMA-class shape: same winner at D=128
+    assert fa._tuned_blocks(8, 2048, 2048, 16, 128, jnp.bfloat16,
+                            True) == (512, 1024)
+    # biased at S=2048 the (512,1024) bias band alone is 8 MB — the
+    # default must shrink rather than return an unvalidated near-limit
+    # pair (vmem_est omits backward-only accumulators)
+    bq, bk = fa._tuned_blocks(8, 2048, 2048, 16, 128, jnp.bfloat16,
+                              True, biased=True)
+    assert (bq, bk) != (512, 1024) and bq <= 512
+    # short sequences: blocks clamp to the sequence
+    bq, bk = fa._tuned_blocks(8, 128, 128, 4, 64, jnp.bfloat16, True)
+    assert bq <= 128 and bk <= 128
+
+
 def test_autotune_pick_contract(monkeypatch, tmp_path):
     """autotune.pick's (f, x) chainable-runner contract (round-5 timing
     methodology v2): candidates are timed inside one compiled loop, the

@@ -71,6 +71,22 @@ def test_flash_attention_bwd_lowers(shape):
     _assert_mosaic(mlir)
 
 
+def test_flash_attention_default_blocks_lower():
+    """The untuned default pair is whatever the hardware sweep last won
+    ((512,1024) since r5) and runs UNVALIDATED when autotune is off — so
+    the gate must prove it lowers, fwd and bwd, at the bench shape."""
+    b, s, h, d = 32, 1024, 12, 64
+    bq, bk = fa._tuned_blocks(b, s, s, h, d, jnp.bfloat16, True)
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_core(q, k, v, True, bq, bk).astype(jnp.float32))
+
+    _assert_mosaic(_lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)),
+                                  q, q, q))
+
+
 @pytest.mark.parametrize("kind", ["ln", "rms"])
 @pytest.mark.parametrize("rows,d", [(32 * 1024, 768), (4096, 1024)])
 def test_fused_norm_lowers(kind, rows, d):

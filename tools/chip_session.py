@@ -105,9 +105,10 @@ def phase_bench_quick():
     on_tpu = platform in ("tpu", "axon")
     # static flash blocks for the FIRST record: a cold autotune cache
     # would spend the window searching 6 fwd+bwd compiles before the
-    # step even builds (static (256,512) measured within ~16% of tuned,
-    # PERF.md r3); the later autotune+bench phases capture the tuned
-    # number and supersede this record in last_good_bench.jsonl
+    # step even builds. Since r5 the untuned default IS the measured
+    # sweep winner ((512,1024) where it fits — flash_attention.py
+    # _tuned_blocks), so this record starts near-tuned; the later
+    # autotune+bench phases still supersede it in last_good_bench.jsonl
     from paddle_tpu.core import flags as _flags
 
     prior_autotune = _flags.get_flags(
