@@ -21,6 +21,7 @@ from . import mpu  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_tuner import AutoTuner  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, PipelineParallel  # noqa: F401
+from .pipeline_spmd import spmd_pipeline, stack_stages  # noqa: F401
 
 
 def is_initialized():

@@ -19,6 +19,12 @@ runtime:
   cross jit boundaries.
 * Gradient accumulation across micro-batches happens on-device per stage;
   the optimizer update runs per stage after the last cooldown backward.
+
+This tier is single-process by construction (a process can only jit onto
+devices it owns). The companion `pipeline_spmd.py` is the COLLECTIVE tier:
+one jit program over the global mesh, stage shifts via ppermute — it runs
+across processes/hosts and composes with dp/mp through partial-manual
+shard_map.
 """
 from __future__ import annotations
 

@@ -630,12 +630,17 @@ def test_autotune_pick_contract(monkeypatch, tmp_path):
 
     def run(cfg):
         calls.append(cfg)
-        scale = 1.0 if cfg == "small" else 1.0001
+        # millisecond-scale per iteration: with a microsecond toy body the
+        # n2-vs-n1 slope is pure scheduler noise under a loaded CPU and
+        # every candidate can "fail" its timing (observed flake: no cache
+        # write -> the re-search assertion below trips)
+        w = jnp.eye(256, dtype=jnp.float32) * (
+            1.0 if cfg == "small" else 1.0001)
 
         def f(y):
-            return y * scale
+            return y @ w
 
-        return f, jnp.ones((8,), jnp.float32)
+        return f, jnp.ones((256, 256), jnp.float32)
 
     got = autotune.pick("testop", "sig1", ["small", "big"], run, "small")
     assert got in ("small", "big")
@@ -650,7 +655,8 @@ def test_autotune_pick_contract(monkeypatch, tmp_path):
     def run2(cfg):
         if cfg == "bad":
             raise RuntimeError("no compile")
-        return (lambda y: y + 1.0), jnp.zeros((4,), jnp.float32)
+        w2 = jnp.eye(128, dtype=jnp.float32)
+        return (lambda y: y @ w2 + 1.0), jnp.zeros((128, 128), jnp.float32)
 
     assert autotune.pick("testop", "sig2", ["bad", "ok"], run2,
                          "bad") == "ok"

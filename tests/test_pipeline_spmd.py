@@ -1,0 +1,162 @@
+"""SPMD collective pipeline (one-program, ppermute stage shifts) vs the
+sequential oracle — values AND gradients.
+
+The schedule itself is what's under test: a wrong permutation, a
+mis-clamped injection index, or a collection off-by-one produces wrong
+values; a wrong psum/where masking produces wrong or scaled gradients.
+Reference role: fleet/meta_parallel/pipeline_parallel.py:440 +
+pp_utils/p2p_communication.py (send/recv tier), rebuilt as collectives.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.pipeline_spmd import (
+    spmd_pipeline, spmd_pipeline_reference, stack_stages,
+)
+
+
+def _block(params, act):
+    # transformer-ish stage: matmul + gelu + residual + rms-ish norm
+    h = act @ params["w"] + params["b"]
+    h = jax.nn.gelu(h)
+    act = act + h
+    return act / jnp.sqrt(jnp.mean(act * act, -1, keepdims=True) + 1e-6)
+
+
+def _stages(pp, width, seed=0):
+    rs = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rs.randn(width, width) * 0.1, jnp.float32),
+             "b": jnp.asarray(rs.randn(width) * 0.1, jnp.float32)}
+            for _ in range(pp)]
+
+
+def _mesh(pp, extra=()):
+    devs = jax.devices()
+    need = pp * int(np.prod([d for _, d in extra])) if extra else pp
+    assert len(devs) >= need, (len(devs), need)
+    names = ("pp",) + tuple(n for n, _ in extra)
+    shape = (pp,) + tuple(d for _, d in extra)
+    return Mesh(np.array(devs[:int(np.prod(shape))]).reshape(shape), names)
+
+
+@pytest.mark.parametrize("pp,m", [(2, 4), (4, 8), (4, 3)])
+def test_spmd_pipeline_matches_sequential(pp, m):
+    width, mb = 16, 2
+    stages = _stages(pp, width)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(m, mb, width), jnp.float32)
+    want = spmd_pipeline_reference(_block, stages, x)
+    got = spmd_pipeline(_block, stack_stages(stages), x, mesh=_mesh(pp))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_spmd_pipeline_grad_matches_sequential(remat):
+    """jax.grad through the scanned ppermute schedule IS the backward
+    pipeline; parameter and input grads must match the oracle (a psum/
+    mask error would scale or misroute them)."""
+    pp, m, width, mb = 4, 6, 8, 2
+    stages = _stages(pp, width, seed=2)
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(m, mb, width), jnp.float32)
+    tgt = jnp.asarray(rs.randn(m, mb, width), jnp.float32)
+    mesh = _mesh(pp)
+
+    def loss_seq(stages, x):
+        y = spmd_pipeline_reference(_block, stages, x)
+        return jnp.mean((y - tgt) ** 2)
+
+    def loss_pp(stacked, x):
+        y = spmd_pipeline(_block, stacked, x, mesh=mesh,
+                          remat_stage=remat)
+        return jnp.mean((y - tgt) ** 2)
+
+    lw, (gw, gxw) = jax.value_and_grad(loss_seq, argnums=(0, 1))(stages, x)
+    lp, (gp, gxp) = jax.value_and_grad(loss_pp, argnums=(0, 1))(
+        stack_stages(stages), x)
+    np.testing.assert_allclose(float(lp), float(lw), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(gxp), np.asarray(gxw),
+                               rtol=2e-4, atol=2e-6)
+    want_stacked = stack_stages(gw)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(gp[k]),
+                                   np.asarray(want_stacked[k]),
+                                   rtol=2e-4, atol=2e-6)
+
+
+def test_spmd_pipeline_composes_with_dp_axis():
+    """Partial-manual shard_map: only 'pp' is manual — a dp axis on the
+    same mesh keeps sharding the microbatch dim through GSPMD, so the
+    one-program pipeline composes with data parallelism."""
+    pp, dp, m, width, mb = 2, 2, 4, 8, 4
+    stages = _stages(pp, width, seed=4)
+    mesh = _mesh(pp, extra=(("dp", dp),))
+    rs = np.random.RandomState(5)
+    xh = rs.randn(m, mb, width).astype(np.float32)
+    x = jax.device_put(
+        jnp.asarray(xh), NamedSharding(mesh, P(None, "dp", None)))
+    stacked = jax.tree_util.tree_map(
+        lambda l: jax.device_put(
+            l, NamedSharding(mesh, P(*(("pp",) + (None,) * (l.ndim - 1))))),
+        stack_stages(stages))
+    got = jax.jit(lambda s, x: spmd_pipeline(_block, s, x, mesh=mesh))(
+        stacked, x)
+    want = spmd_pipeline_reference(_block, stages, jnp.asarray(xh))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_spmd_pipeline_validates_inputs():
+    stages = _stages(2, 8)
+    x = jnp.zeros((4, 2, 8))
+    with pytest.raises(ValueError, match="pp"):
+        spmd_pipeline(_block, stack_stages(stages), x, mesh=_mesh(4))
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    with pytest.raises(ValueError, match="axis"):
+        spmd_pipeline(_block, stack_stages(stages), x, mesh=mesh2)
+
+
+def test_spmd_pipeline_pp1_is_sequential():
+    stages = _stages(1, 8)
+    x = jnp.asarray(np.random.RandomState(6).randn(3, 2, 8), np.float32)
+    got = spmd_pipeline(_block, stack_stages(stages), x, mesh=_mesh(1))
+    want = spmd_pipeline_reference(_block, stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_spmd_pipeline_carries_real_gpt_blocks():
+    """The collective schedule must carry REAL transformer stages
+    (attention + MLP + norms through the dispatch gate), not just pure
+    toy closures: 4 GPTBlocks, one per stage, params stacked over pp —
+    output parity vs running the same blocks sequentially."""
+    import paddle_tpu as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTBlock, gpt_tiny
+
+    cfg = gpt_tiny()
+    pp, m, mb, seq = 4, 4, 2, 16
+    P.seed(11)
+    blocks = [GPTBlock(cfg) for _ in range(pp)]
+    for b in blocks:
+        b.eval()
+    states = [b.functional_state() for b in blocks]
+    stage_params = [dict(s[0]) for s in states]
+    buffers = states[0][1]
+    proto = blocks[0]
+
+    def stage_fn(params, act):
+        with proto.bind_state(params, buffers):
+            return proto(Tensor(act))._value
+
+    rs = np.random.RandomState(12)
+    x = jnp.asarray(rs.randn(m, mb, seq, cfg.hidden_size), jnp.float32)
+    want = spmd_pipeline_reference(stage_fn, stage_params, x)
+    got = spmd_pipeline(stage_fn, stack_stages(stage_params), x,
+                        mesh=_mesh(4))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)

@@ -268,20 +268,41 @@ def test_launcher_restart_recovers_and_gives_up(tmp_path):
     assert "giving up" in r.stderr
 
 
-@pytest.mark.slow
-def test_launcher_two_process_jax_distributed(tmp_path):
-    """REAL multi-process collective through the launcher (SURVEY §2.2
-    TCPStore role → jax coordination service): two ranks initialize
-    jax.distributed over the launcher-provided COORDINATOR_ADDRESS, see
-    a 2-device global topology, and allgather across processes."""
+def _launch_two_process(tmp_path, worker_src, timeout=420):
+    """Shared 2-process launcher harness: writes the worker (sys.path
+    preamble prepended), scrubs the TPU tunnel out of the env, launches
+    via `paddle_tpu.distributed.launch`, asserts rc == 0, and returns
+    {rank: workerlog text}."""
     import subprocess
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     worker = tmp_path / "worker.py"
     worker.write_text(
-        "import os, sys\n"
-        f"sys.path.insert(0, {repo!r})\n"
+        f"import os, sys\nsys.path.insert(0, {repo!r})\n" + worker_src)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep ranks off the tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    log_dir = tmp_path / "logs"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         str(worker)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=timeout)
+    logs = {i: (log_dir / f"workerlog.{i}").read_text()
+            for i in range(2) if (log_dir / f"workerlog.{i}").exists()}
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+    return logs
+
+
+@pytest.mark.slow
+def test_launcher_two_process_jax_distributed(tmp_path):
+    """REAL multi-process collective through the launcher (SURVEY §2.2
+    TCPStore role → jax coordination service): two ranks initialize
+    jax.distributed over the launcher-provided COORDINATOR_ADDRESS, see
+    a 2-device global topology, and allgather across processes."""
+    logs = _launch_two_process(tmp_path, (
         "import jax\n"
         "import jax.numpy as jnp\n"
         "from paddle_tpu.distributed.parallel import init_parallel_env\n"
@@ -293,35 +314,16 @@ def test_launcher_two_process_jax_distributed(tmp_path):
         "got = multihost_utils.process_allgather(\n"
         "    jnp.asarray([float(rank + 1)]))\n"
         "assert got.ravel().tolist() == [1.0, 2.0], got\n"
-        "print('rank', rank, 'allgather ok', flush=True)\n")
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # keep ranks off the tunnel
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    log_dir = tmp_path / "logs"
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", "2", "--log_dir", str(log_dir),
-         str(worker)],
-        env=env, cwd=repo, capture_output=True, text=True, timeout=420)
-    logs = "".join((log_dir / f"workerlog.{i}").read_text()
-                   for i in range(2) if (log_dir / f"workerlog.{i}").exists())
-    assert r.returncode == 0, (r.stdout, r.stderr, logs)
-    assert "rank 0 allgather ok" in logs and "rank 1 allgather ok" in logs
+        "print('rank', rank, 'allgather ok', flush=True)\n"))
+    text = "".join(logs.values())
+    assert "rank 0 allgather ok" in text and "rank 1 allgather ok" in text
 
 
 def _two_process_training(tmp_path, dp, mp, sharding, per_rank_seed):
     """Two launcher-spawned processes over the jax coordination service
     form one global 2-device mesh and run the compiled hybrid train step
     (SURVEY §2.2 comm backend at scale). Returns per-rank loss strings."""
-    import subprocess
-    import sys
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = tmp_path / "worker.py"
-    worker.write_text(
-        "import os, sys\n"
-        f"sys.path.insert(0, {repo!r})\n"
+    logs = _launch_two_process(tmp_path, (
         "import numpy as np\n"
         "import jax\n"
         "import paddle_tpu as P\n"
@@ -356,20 +358,7 @@ def _two_process_training(tmp_path, dp, mp, sharding, per_rank_seed):
         "assert all(np.isfinite(l) for l in losses), losses\n"
         "assert losses[-1] < losses[0], losses\n"
         "print('rank', rank, 'losses', [round(l, 6) for l in losses],\n"
-        "      flush=True)\n")
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    log_dir = tmp_path / "logs"
-    r = subprocess.run(
-        [sys.executable, "-m", "paddle_tpu.distributed.launch",
-         "--nproc_per_node", "2", "--log_dir", str(log_dir),
-         str(worker)],
-        env=env, cwd=repo, capture_output=True, text=True, timeout=420)
-    logs = {i: (log_dir / f"workerlog.{i}").read_text()
-            for i in range(2) if (log_dir / f"workerlog.{i}").exists()}
-    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+        "      flush=True)\n"))
     import re as _re
 
     return {i: _re.search(r"losses \[([^\]]+)\]", logs[i]).group(1)
@@ -395,6 +384,60 @@ def test_two_process_tensor_parallel_training(tmp_path):
     got = _two_process_training(tmp_path, dp=1, mp=2, sharding=False,
                                 per_rank_seed=False)
     assert got[0] == got[1], got
+
+
+@pytest.mark.slow
+def test_two_process_spmd_pipeline(tmp_path):
+    """pp=2 ACROSS processes: the collective (one-program) pipeline runs
+    stage 0 on rank 0's device and stage 1 on rank 1's, boundary
+    activations crossing processes as ppermute collectives — the thing
+    the per-stage-jit tier cannot do (a process cannot jit onto devices
+    it does not own). Both ranks must see the sequential oracle's values
+    and gradients."""
+    logs = _launch_two_process(tmp_path, (
+        "import numpy as np\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "import paddle_tpu  # noqa: F401 (plugin/bootstrap parity)\n"
+        "from paddle_tpu.distributed.parallel import init_parallel_env\n"
+        "from paddle_tpu.distributed.pipeline_spmd import (\n"
+        "    spmd_pipeline, spmd_pipeline_reference, stack_stages)\n"
+        "init_parallel_env()\n"
+        "assert jax.process_count() == 2\n"
+        "mesh = Mesh(np.array(jax.devices()), ('pp',))\n"
+        "def block(p, a):\n"
+        "    h = jax.nn.gelu(a @ p['w'] + p['b'])\n"
+        "    return a + h\n"
+        "rs = np.random.RandomState(0)\n"
+        "stages = [{'w': jnp.asarray(rs.randn(8, 8) * 0.1, jnp.float32),\n"
+        "           'b': jnp.asarray(rs.randn(8) * 0.1, jnp.float32)}\n"
+        "          for _ in range(2)]\n"
+        "x = jnp.asarray(rs.randn(4, 2, 8), jnp.float32)\n"
+        "stacked = jax.tree_util.tree_map(\n"
+        "    lambda l: jax.device_put(l, NamedSharding(\n"
+        "        mesh, P(*(('pp',) + (None,) * (l.ndim - 1))))),\n"
+        "    stack_stages(stages))\n"
+        "def loss_pp(s, x):\n"
+        "    return jnp.mean(spmd_pipeline(block, s, x, mesh=mesh) ** 2)\n"
+        "def loss_seq(ss, x):\n"
+        "    return jnp.mean(spmd_pipeline_reference(block, ss, x) ** 2)\n"
+        "lp, gp = jax.value_and_grad(loss_pp)(stacked, x)\n"
+        "lw, gw = jax.value_and_grad(loss_seq)(stages, x)\n"
+        "gw = stack_stages(gw)\n"
+        "lp = float(jax.device_get(lp))\n"
+        "np.testing.assert_allclose(lp, float(lw), rtol=2e-5)\n"
+        "for k in ('w', 'b'):\n"
+        "    got = np.asarray(jax.device_get(\n"
+        "        jax.jit(lambda g: g, out_shardings=NamedSharding(\n"
+        "            mesh, P()))(gp[k])))\n"
+        "    np.testing.assert_allclose(got, np.asarray(gw[k]),\n"
+        "                               rtol=2e-4, atol=2e-6)\n"
+        "print('rank', jax.process_index(), 'spmd-pp parity ok',\n"
+        "      flush=True)\n"))
+    text = "".join(logs.values())
+    assert "rank 0 spmd-pp parity ok" in text
+    assert "rank 1 spmd-pp parity ok" in text
 
 
 def test_jit_save_load_roundtrip(tmp_path):
