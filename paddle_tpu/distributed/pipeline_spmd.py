@@ -32,11 +32,20 @@ Bubble fraction is the GPipe (pp-1)/(m+pp-1); the schedule runs
 m + pp - 1 ticks and every device computes every tick (devices outside
 their active window compute on zeros — in SPMD the bubble is wasted FLOPs,
 not idleness, which is exactly how GSPMD-pipelined TPU programs behave).
+
+No interleaved (VPP) variant here, by design: VPP's bubble win comes from
+interleaving FORWARD and BACKWARD micro-steps, and in this tier the
+backward order belongs to autodiff (that is the point — the reverse
+schedule is derived, not hand-written). Interleaved 1F1B lives in the
+per-stage tier (`pipeline.py`), which owns its backward explicitly.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["stack_stages", "spmd_pipeline", "spmd_pipeline_reference"]
@@ -79,8 +88,6 @@ def spmd_pipeline(stage_fn, stage_params, x_mb, mesh=None, axis="pp",
           inside the stage is still GSPMD's job)
     Returns [m, ...] outputs of the LAST stage, replicated over `axis`.
     """
-    from jax import shard_map
-
     if mesh is None:
         from . import topology as topo_mod
 
@@ -93,15 +100,29 @@ def spmd_pipeline(stage_fn, stage_params, x_mb, mesh=None, axis="pp",
         raise ValueError(
             f"stage_params leaves must carry a leading [pp={pp}] dim "
             f"(stack_stages); got leading dims {sorted(lead)}")
-    m = x_mb.shape[0]
-    fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
     if pp == 1:
+        fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
         p0 = jax.tree_util.tree_map(lambda l: l[0], stage_params)
         return spmd_pipeline_reference(fn, [p0], x_mb)
+    treedef = jax.tree_util.tree_structure(stage_params)
+    compiled = _compiled_pipeline(stage_fn, mesh, axis, pp, remat_stage,
+                                  treedef)
+    return compiled(stage_params, x_mb)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_pipeline(stage_fn, mesh, axis, pp, remat_stage, treedef):
+    """One jitted pipeline program per (stage_fn, mesh, axis, pp, remat,
+    param treedef): an eager caller in a loop hits jit's compile cache
+    instead of rebuilding (and retracing) a fresh closure per call. The
+    jit is also load-bearing for eager use at all — shard_map cannot
+    eagerly evaluate closed_call bodies (a lax.scan inside stage_fn)."""
+    fn = jax.checkpoint(stage_fn) if remat_stage else stage_fn
 
     def body(params_local, xloc):
         # shard_map hands each device its [1, ...] stage slice
         params_i = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        m = xloc.shape[0]
         sid = jax.lax.axis_index(axis)
         perm = [(i, i + 1) for i in range(pp - 1)]
         # carries must enter the scan already marked varying-over-pp:
@@ -138,11 +159,12 @@ def spmd_pipeline(stage_fn, stage_params, x_mb, mesh=None, axis="pp",
             jnp.where(sid == pp - 1, ys, jnp.zeros_like(ys)), axis)
         return ys
 
-    pspecs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    return shard_map(
+    pspecs = jax.tree_util.tree_unflatten(
+        treedef, [P(axis)] * treedef.num_leaves)
+    return jax.jit(shard_map(
         body,
         mesh=mesh,
         in_specs=(pspecs, P()),
         out_specs=P(),
         axis_names=frozenset({axis}),
-    )(stage_params, x_mb)
+    ))

@@ -160,3 +160,89 @@ def test_spmd_pipeline_carries_real_gpt_blocks():
                         mesh=_mesh(4))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_spmd_pipeline_full_lm_step_grads():
+    """End-to-end LM training composition through the collective tier:
+    tied embedding -> microbatched GPT blocks in the pipeline (2 blocks
+    per stage via scan-over-local-layers) -> final norm -> tied-head CE.
+    Gradients wrt the embedding (used at BOTH ends — its cotangent must
+    accumulate through the masked-psum exit AND the stage-0 injection),
+    the stacked block params, and the final norm must all match the
+    sequential oracle."""
+    import paddle_tpu as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTBlock, GPTConfig
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=8,
+                    num_heads=2, max_seq_len=16, dropout=0.0)
+    pp, per_stage, m, mb, seq = 4, 2, 4, 2, 16
+    P.seed(21)
+    blocks = [GPTBlock(cfg) for _ in range(pp * per_stage)]
+    for b in blocks:
+        b.eval()
+    states = [b.functional_state() for b in blocks]
+    buffers = states[0][1]
+    proto = blocks[0]
+    # [pp] stages, each leaf [per_stage, ...]
+    groups = [jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls),
+        *[dict(states[s * per_stage + j][0]) for j in range(per_stage)])
+        for s in range(pp)]
+    rs = np.random.RandomState(22)
+    wte = jnp.asarray(rs.randn(cfg.vocab_size, cfg.hidden_size) * 0.02,
+                      jnp.float32)
+    lnw = jnp.ones((cfg.hidden_size,), jnp.float32)
+    lnb = jnp.zeros((cfg.hidden_size,), jnp.float32)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (m, mb, seq)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, cfg.vocab_size, (m, mb, seq)),
+                         jnp.int32)
+    mesh = _mesh(pp)
+
+    def stage_fn(params, act):
+        def body(a, blk):
+            with proto.bind_state(blk, buffers):
+                return proto(Tensor(a))._value, None
+
+        act, _ = jax.lax.scan(body, act, params)
+        return act
+
+    def loss_from(run_blocks, stages, wte, lnw, lnb):
+        x = wte[ids]                                   # [m, mb, s, h]
+        y = run_blocks(stages, x)
+        mu = jnp.mean(y, -1, keepdims=True)
+        var = jnp.var(y, -1, keepdims=True)
+        y = (y - mu) / jnp.sqrt(var + 1e-5) * lnw + lnb
+        logits = y @ wte.T                             # tied head
+        lse = jax.scipy.special.logsumexp(logits, -1)
+        tok = jnp.take_along_axis(logits, labels[..., None],
+                                  -1)[..., 0]
+        return jnp.mean(lse - tok)
+
+    def loss_pp(stacked, wte, lnw, lnb):
+        return loss_from(
+            lambda s, x: spmd_pipeline(stage_fn, s, x, mesh=mesh,
+                                       remat_stage=True),
+            stacked, wte, lnw, lnb)
+
+    def loss_seq(groups, wte, lnw, lnb):
+        return loss_from(
+            lambda gs, x: spmd_pipeline_reference(stage_fn, gs, x),
+            groups, wte, lnw, lnb)
+
+    lp, gp = jax.value_and_grad(loss_pp, argnums=(0, 1, 2, 3))(
+        stack_stages(groups), wte, lnw, lnb)
+    lw, gw = jax.value_and_grad(loss_seq, argnums=(0, 1, 2, 3))(
+        groups, wte, lnw, lnb)
+    np.testing.assert_allclose(float(lp), float(lw), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gw[1]),
+                               rtol=3e-4, atol=3e-6)  # tied wte
+    np.testing.assert_allclose(np.asarray(gp[2]), np.asarray(gw[2]),
+                               rtol=3e-4, atol=3e-6)
+    np.testing.assert_allclose(np.asarray(gp[3]), np.asarray(gw[3]),
+                               rtol=3e-4, atol=3e-6)
+    want_stacked = stack_stages(gw[0])
+    for k in sorted(want_stacked):
+        np.testing.assert_allclose(
+            np.asarray(gp[0][k]), np.asarray(want_stacked[k]),
+            rtol=3e-4, atol=3e-6, err_msg=k)
