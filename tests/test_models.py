@@ -602,3 +602,23 @@ def test_fused_head_ce_cuts_xla_temp_buffers():
     logits_mb = batch * seq * 50304 * 4 / 2**20
     assert plain["temp_mb"] - fused["temp_mb"] >= 0.75 * logits_mb, (
         plain, fused)
+
+
+@pytest.mark.slow
+def test_train_step_has_no_f32_operand_gemms():
+    """MFU guard (tools/hlo_audit.py): every dot in the bf16 AMP train
+    step must take bf16 OPERANDS (f32 accumulation via
+    preferred_element_type is the full-rate MXU mode; an f32-operand dot
+    runs at quarter rate). The round-5 audit measured 40/40 bf16 — this
+    pins it."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from hlo_audit import audit_hlo, train_step_hlo
+
+    report = audit_hlo(train_step_hlo(batch=2, seq=256, layers=2))
+    assert report["dot_counts"]["f32_operands"] == 0, report
+    assert report["dot_counts"]["mixed"] == 0, report
+    assert not report["big_non_bf16_dots"], report
+    assert report["dot_counts"]["bf16_operands"] > 0, report
