@@ -983,8 +983,15 @@ def phase_vision_breakdown():
 
 def phase_bench():
     t0 = time.perf_counter()
+    # op-level trace of the timed GPT run (bench.py honors
+    # BENCH_XPROF_DIR): an unattended window leaves the xplane artifact
+    # on disk for later per-op analysis (the r3 step-cost table came
+    # from exactly this kind of trace)
+    xprof_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "xprof_r5")
+    env = dict(os.environ, BENCH_XPROF_DIR=xprof_dir)
     r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
-                       text=True, timeout=3600)
+                       text=True, timeout=3600, env=env)
     lines = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
     log("bench", {"seconds": round(time.perf_counter() - t0, 1),
                   "json_lines": lines,
