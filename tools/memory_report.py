@@ -87,10 +87,15 @@ def baseline_config_memory(which="1p3b"):
     assignment differs from TPU in layout padding, and XLA:CPU does not
     realize remat's temp-pool win, so the temp number is an upper bound.
 
-      1p3b: BASELINE config 2 — GPT-1.3B data-parallel, ZeRO stage-2
-            (dp=8, global batch 8 x seq 2048)
-      6p7b: BASELINE config 3 — GPT-6.7B tensor-parallel mp=4 (x dp=2,
-            stage-2 over the dp axis)
+      1p3b:      BASELINE config 2 — GPT-1.3B data-parallel, ZeRO
+                 stage-2 (dp=8, global batch 8 x seq 2048)
+      6p7b:      BASELINE config 3 — GPT-6.7B tensor-parallel mp=4
+                 (x dp=2, stage-2 over the dp axis). WARNING: the full
+                 model's ~81 GB f32 state + compile workspace OOMs a
+                 125 GB host — use 6p7b_half there
+      6p7b_half: config 3 at 16 of 32 layers, full width (the mp=4
+                 sharding of h=4096 layers is what's being validated;
+                 depth scales the rest linearly)
     """
     import numpy as np
 
@@ -100,16 +105,33 @@ def baseline_config_memory(which="1p3b"):
         GPTForCausalLM, GPTPretrainingCriterion, gpt_1p3b, gpt_6p7b,
     )
 
+    extrap = None
     if which == "1p3b":
         cfg = gpt_1p3b(fused_head_ce=True, recompute=True, dropout=0.0)
         hybrid = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
                   "sep_degree": 1, "sharding_degree": 8}
         batch, seq = 8, 2048
-    else:
+    elif which == "6p7b_half":
+        cfg = gpt_6p7b(fused_head_ce=True, recompute=True, dropout=0.0)
+        cfg.num_layers = 16  # ffn width depends only on hidden_size —
+        # post-init depth override keeps every other literal shared with
+        # the full preset
+        hybrid = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+                  "sep_degree": 1, "sharding_degree": 2}
+        batch, seq = 2, 2048
+        extrap = ("16 of 32 layers at full width (tied embeddings: "
+                  "3.44B of the full 6.66B params): per-layer temp and "
+                  "arg bytes scale linearly in depth — double the "
+                  "layer-proportional parts for the full model")
+    elif which == "6p7b":
         cfg = gpt_6p7b(fused_head_ce=True, recompute=True, dropout=0.0)
         hybrid = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
                   "sep_degree": 1, "sharding_degree": 2}
         batch, seq = 2, 2048
+    else:
+        raise ValueError(
+            f"unknown baseline config {which!r}: expected one of "
+            "'1p3b', '6p7b', '6p7b_half'")
     topology.reset_topology()
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = hybrid
@@ -144,6 +166,8 @@ def baseline_config_memory(which="1p3b"):
                     "8-device CPU mesh; CPU layouts differ from TPU and "
                     "CPU does not realize remat's temp win — treat as "
                     "an upper bound")}
+    if extrap:
+        out["extrapolation"] = extrap
     return out
 
 
