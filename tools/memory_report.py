@@ -111,23 +111,19 @@ def baseline_config_memory(which="1p3b"):
         hybrid = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
                   "sep_degree": 1, "sharding_degree": 8}
         batch, seq = 8, 2048
-    elif which == "6p7b_half":
-        cfg = gpt_6p7b(fused_head_ce=True, recompute=True, dropout=0.0)
-        cfg.num_layers = 16  # ffn width depends only on hidden_size —
-        # post-init depth override keeps every other literal shared with
-        # the full preset
-        hybrid = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
-                  "sep_degree": 1, "sharding_degree": 2}
-        batch, seq = 2, 2048
-        extrap = ("16 of 32 layers at full width (tied embeddings: "
-                  "3.44B of the full 6.66B params): per-layer temp and "
-                  "arg bytes scale linearly in depth — double the "
-                  "layer-proportional parts for the full model")
-    elif which == "6p7b":
+    elif which in ("6p7b", "6p7b_half"):
         cfg = gpt_6p7b(fused_head_ce=True, recompute=True, dropout=0.0)
         hybrid = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
                   "sep_degree": 1, "sharding_degree": 2}
         batch, seq = 2, 2048
+        if which == "6p7b_half":
+            cfg.num_layers = 16  # ffn width depends only on
+            # hidden_size — the post-init depth override keeps every
+            # other literal shared with the full preset
+            extrap = ("16 of 32 layers at full width (tied embeddings: "
+                      "3.44B of the full 6.66B params): per-layer temp "
+                      "and arg bytes scale linearly in depth — double "
+                      "the layer-proportional parts for the full model")
     else:
         raise ValueError(
             f"unknown baseline config {which!r}: expected one of "
