@@ -7,3 +7,7 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, LlamaPretrainingCriterion,
     llama2_70b_shapes, llama_13b, llama_7b, llama_pipe_layers, llama_tiny,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieForPretraining, ErnieModel,
+    ErniePretrainingCriterion, ernie_3_0_medium, ernie_base, ernie_tiny,
+)

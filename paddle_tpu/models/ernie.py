@@ -1,0 +1,181 @@
+"""ERNIE-style bidirectional encoder (BASELINE config 4's named model
+family; role parity: the ERNIE-3.0 encoders the reference ecosystem
+trains through `paddle.nn.TransformerEncoder` —
+python/paddle/nn/layer/transformer.py:646 — with MLM+NSP pretraining
+heads).
+
+TPU-first notes: the encoder rides this framework's `nn.Transformer*`
+stack, so full-sequence bidirectional attention runs the fused-softmax
+path on CPU and the additive-bias flash kernels on TPU (the padding mask
+is a stop-gradient additive bias, streamed blockwise — docs/ATTENTION.md
+"additive/boolean masks" row). The MLM decoder ties the word-embedding
+matrix (transposed matmul, MXU-shaped); masked positions score through
+the whole [B,S,V] only at encoder scale (S<=512 typical), so the cut-CE
+machinery is not needed here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining",
+           "ErniePretrainingCriterion", "ernie_tiny", "ernie_base",
+           "ernie_3_0_medium"]
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=40000, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden=None, max_position=2048,
+                 type_vocab_size=4, dropout=0.1, layer_norm_eps=1e-12,
+                 pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden = ffn_hidden or 4 * hidden_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as P
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = P.ops.broadcast_to(
+                P.ops.arange(0, s, dtype="int32").unsqueeze(0), [b, s])
+        if token_type_ids is None:
+            token_type_ids = P.zeros([b, s], "int32")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieModel(nn.Layer):
+    """Encoder trunk. `attention_mask`: [B, S] with 1 for real tokens,
+    0 for padding (reference semantics); internally an additive
+    stop-gradient bias [B, 1, 1, S] so the fused biased-attention tier
+    applies. Returns (sequence_output [B,S,H], pooled_output [B,H])."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.ffn_hidden,
+            dropout=cfg.dropout, activation="gelu",
+            layer_norm_eps=cfg.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        import paddle_tpu as P
+
+        if attention_mask is None:
+            attention_mask = (
+                input_ids != self.cfg.pad_token_id).astype("float32")
+        if attention_mask.ndim == 2:
+            # additive bias: 0 where attendable, -1e4 on padding. Only
+            # the mask WE build is stamped stop_gradient (routing it to
+            # the zero-cotangent biased flash kernel); a caller-supplied
+            # 4-D bias keeps its own flag — flipping it here would
+            # silently kill a trainable bias's gradient
+            attention_mask = ((1.0 - attention_mask.astype("float32"))
+                              * -1e4).unsqueeze(1).unsqueeze(1)
+            attention_mask.stop_gradient = True
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = self.encoder(x, attention_mask)
+        pooled = P.ops.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM (decoder tied to the word embeddings) + NSP/sentence-order
+    head — the ERNIE pretraining objective pair."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.mlm_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True,
+            default_initializer=lambda *_: np.zeros(cfg.vocab_size,
+                                                    np.float32))
+        self.nsp_head = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        import paddle_tpu as P
+
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        w = self.ernie.embeddings.word_embeddings.weight  # [V, H]
+        logits = P.ops.matmul(h, w, transpose_y=True) + self.mlm_bias
+        return logits, self.nsp_head(pooled)
+
+
+class ErniePretrainingCriterion(nn.Layer):
+    """MLM CE over masked positions (labels == ignore_index elsewhere)
+    plus NSP CE; both terms are masked means, summed."""
+
+    def __init__(self, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.ce = nn.CrossEntropyLoss(ignore_index=ignore_index)
+        self.nsp_ce = nn.CrossEntropyLoss()
+
+    def forward(self, prediction_logits, nsp_logits, masked_lm_labels,
+                next_sentence_labels=None):
+        v = prediction_logits.shape[-1]
+        mlm = self.ce(prediction_logits.reshape([-1, v]),
+                      masked_lm_labels.reshape([-1]))
+        if next_sentence_labels is None:
+            return mlm
+        return mlm + self.nsp_ce(nsp_logits,
+                                 next_sentence_labels.reshape([-1]))
+
+
+def ernie_tiny(**kw):
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_position", 128)
+    return ErnieConfig(**kw)
+
+
+def ernie_base(**kw):
+    kw.setdefault("vocab_size", 40000)
+    kw.setdefault("hidden_size", 768)
+    kw.setdefault("num_layers", 12)
+    kw.setdefault("num_heads", 12)
+    return ErnieConfig(**kw)
+
+
+def ernie_3_0_medium(**kw):
+    kw.setdefault("num_layers", 6)
+    return ernie_base(**kw)
