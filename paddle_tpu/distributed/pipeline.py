@@ -523,3 +523,63 @@ class PipelineParallel:
     def state_dict(self):
         self.sync_to_model()
         return self.pipe.state_dict()
+
+    # --- exact training resume (per-stage params + slots + step) ------------
+    def train_state_dict(self):
+        """Flat resumable state across ALL stages/chunks: per-stage
+        params, optimizer slots, step counter, buffers — keys
+        `stage{c}.param.<n>` / `stage{c}.slot.<slot>.<n>` /
+        `stage{c}.opt.step` / `stage{c}.buffer.<n>` (mirrors
+        DistributedTrainStep.train_state_dict for the hybrid step)."""
+        self._ensure_opt()
+        out = {}
+        for c, (st, state) in enumerate(zip(self.stages,
+                                            self._opt_states)):
+            def pin(v, spec, _mesh=st.mesh):
+                # uncommitted leaves (fresh init slots/step) must be
+                # pinned to THIS stage's mesh before becoming checkpoint
+                # targets — the loader commits into the target's
+                # placement, and a default-device commit would fight the
+                # committed stage params inside the jitted update
+                v = jnp.asarray(v)
+                if getattr(v, "committed", True):
+                    return v
+                return jax.device_put(v, NamedSharding(_mesh, spec))
+
+            for n, v in st.params.items():
+                out[f"stage{c}.param.{n}"] = Tensor(v)
+            for n, sd in state["slots"].items():
+                pspec = st.param_specs.get(n, P())
+                for k, v in sd.items():
+                    spec = pspec if np.shape(v) == np.shape(
+                        st.params[n]) else P()
+                    out[f"stage{c}.slot.{k}.{n}"] = Tensor(pin(v, spec))
+            out[f"stage{c}.opt.step"] = Tensor(pin(state["step"], P()))
+            for n, v in st.buffers.items():
+                out[f"stage{c}.buffer.{n}"] = Tensor(pin(v, P()))
+        return out
+
+    def save_train_state(self, path):
+        from .train_step import save_train_checkpoint
+
+        save_train_checkpoint(self.train_state_dict(), path,
+                              self.optimizer._learning_rate)
+
+    def load_train_state(self, path):
+        """Strict resume incl. the host-side LR scheduler position (see
+        load_train_checkpoint for why partial matches refuse)."""
+        from .train_step import load_train_checkpoint
+
+        self._ensure_opt()
+        tgt = self.train_state_dict()
+        load_train_checkpoint(tgt, path, self.optimizer._learning_rate)
+        for c, (st, state) in enumerate(zip(self.stages,
+                                            self._opt_states)):
+            st.params = {n: tgt[f"stage{c}.param.{n}"]._value
+                         for n in st.params}
+            state["slots"] = {
+                n: {k: tgt[f"stage{c}.slot.{k}.{n}"]._value for k in sd}
+                for n, sd in state["slots"].items()}
+            state["step"] = tgt[f"stage{c}.opt.step"]._value
+            st.buffers = {n: tgt[f"stage{c}.buffer.{n}"]._value
+                          for n in st.buffers}

@@ -35,7 +35,65 @@ from ..core import flags, rng
 from ..core.tensor import Tensor
 from . import topology as topo_mod
 
-__all__ = ["DistributedTrainStep", "param_placements"]
+__all__ = ["DistributedTrainStep", "param_placements",
+           "save_train_checkpoint", "load_train_checkpoint"]
+
+_LR_SIDECAR = "lr_scheduler.json"
+
+
+def save_train_checkpoint(tensors, path, lr_sched=None):
+    """Shared writer for both training tiers (hybrid step + pipeline):
+    distributed checkpoint of the flat leaf dict, plus a host-side LR
+    scheduler sidecar JSON when one is attached."""
+    import json as _json
+    import os as _os
+
+    from ..optimizer.lr import LRScheduler
+    from .checkpoint import save_state_dict
+
+    save_state_dict(tensors, path)
+    if isinstance(lr_sched, LRScheduler):
+        with open(_os.path.join(path, _LR_SIDECAR), "w") as f:
+            _json.dump(lr_sched.state_dict(), f)
+
+
+def load_train_checkpoint(tensors, path, lr_sched=None):
+    """Shared strict loader: every leaf in `tensors` must exist in the
+    checkpoint (a partial match would silently mix loaded and fresh
+    state), and when the caller trains under an LRScheduler its sidecar
+    must be present too (restoring the step counter but restarting the
+    warmup/decay schedule is the same silent divergence). Loads in place
+    (leaves reshard onto each target tensor's placement)."""
+    import json as _json
+    import os as _os
+
+    from ..optimizer.lr import LRScheduler
+    from .checkpoint import load_state_dict
+    from .checkpoint.api import _load_metadata
+
+    meta = _load_metadata(path)
+    if meta is None:
+        raise ValueError(f"no checkpoint metadata found under {path!r}")
+    missing = sorted(set(tensors) - set(meta.state_dict_metadata))
+    if missing:
+        raise ValueError(
+            f"checkpoint at {path!r} is missing {len(missing)} of "
+            f"{len(tensors)} training-state leaves (first: "
+            f"{missing[:5]}) — refusing a partial resume (wrong model "
+            "config or corrupt checkpoint?)")
+    sched_file = _os.path.join(path, _LR_SIDECAR)
+    if isinstance(lr_sched, LRScheduler):
+        if not _os.path.exists(sched_file):
+            raise ValueError(
+                f"checkpoint at {path!r} has no {_LR_SIDECAR} but this "
+                "run trains under an LRScheduler — resuming would "
+                "restart the schedule at step 0 (was the checkpoint "
+                "saved with a float learning rate?)")
+        with open(sched_file) as f:
+            state = _json.load(f)
+    load_state_dict(tensors, path)
+    if isinstance(lr_sched, LRScheduler):
+        lr_sched.set_state_dict(state)
 
 
 def param_placements(param, ndim=None):
@@ -453,17 +511,8 @@ class DistributedTrainStep:
         scheduler's position (warmup/decay progress) rides alongside as
         JSON — the device step counter alone would resume Adam bias
         correction correctly but silently restart the LR schedule."""
-        import json as _json
-        import os as _os
-
-        from ..optimizer.lr import LRScheduler
-        from .checkpoint import save_state_dict
-
-        save_state_dict(self.train_state_dict(), path)
-        sched = self.optimizer._learning_rate
-        if isinstance(sched, LRScheduler):
-            with open(_os.path.join(path, "lr_scheduler.json"), "w") as f:
-                _json.dump(sched.state_dict(), f)
+        save_train_checkpoint(self.train_state_dict(), path,
+                              self.optimizer._learning_rate)
 
     def load_train_state(self, path):
         """Resume exactly: load a `save_train_state` checkpoint into
@@ -474,25 +523,10 @@ class DistributedTrainStep:
         freshly-initialized state (wrong model/config checkpoints fail
         loudly instead). The optimizer's step counter AND any host-side
         LR scheduler position resume mid-schedule."""
-        import json as _json
-        import os as _os
-
-        from ..optimizer.lr import LRScheduler
-        from .checkpoint import load_state_dict
-        from .checkpoint.api import _load_metadata
-
         if self._state is None:
             self.init_state()
         tgt = self.train_state_dict()
-        have = set(_load_metadata(path).state_dict_metadata)
-        missing = sorted(set(tgt) - have)
-        if missing:
-            raise ValueError(
-                f"checkpoint at {path!r} is missing {len(missing)} of "
-                f"{len(tgt)} training-state leaves (first: "
-                f"{missing[:5]}) — refusing a partial resume (wrong "
-                "model config or corrupt checkpoint?)")
-        load_state_dict(tgt, path)
+        load_train_checkpoint(tgt, path, self.optimizer._learning_rate)
         s = self._state
         s["params"] = {n: tgt[f"param.{n}"]._value for n in s["params"]}
         s["opt"]["slots"] = {
@@ -501,8 +535,3 @@ class DistributedTrainStep:
         s["opt"]["step"] = tgt["opt.step"]._value
         s["buffers"] = {n: tgt[f"buffer.{n}"]._value
                         for n in s["buffers"]}
-        sched = self.optimizer._learning_rate
-        sched_file = _os.path.join(path, "lr_scheduler.json")
-        if isinstance(sched, LRScheduler) and _os.path.exists(sched_file):
-            with open(sched_file) as f:
-                sched.set_state_dict(_json.load(f))
