@@ -246,3 +246,16 @@ def test_spmd_pipeline_full_lm_step_grads():
         np.testing.assert_allclose(
             np.asarray(gp[0][k]), np.asarray(want_stacked[k]),
             rtol=3e-4, atol=3e-6, err_msg=k)
+
+
+def test_spmd_pipeline_single_microbatch():
+    """m=1 edge: the pipeline degenerates to a pp-tick relay — clamped
+    injection must not corrupt the one real microbatch."""
+    pp, width = 4, 8
+    stages = _stages(pp, width, seed=7)
+    x = jnp.asarray(np.random.RandomState(8).randn(1, 2, width),
+                    np.float32)
+    got = spmd_pipeline(_block, stack_stages(stages), x, mesh=_mesh(pp))
+    want = spmd_pipeline_reference(_block, stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)

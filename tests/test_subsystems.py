@@ -613,9 +613,12 @@ def _resume_run(topo_cfg, batches, n_steps, ckpt=None, save_at=None,
 
     topology.reset_topology()
     strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = dict(
-        {"pp_degree": 1, "sep_degree": 1, "sharding_degree": 1},
-        **topo_cfg)
+    cfg = dict({"pp_degree": 1, "sep_degree": 1, "sharding_degree": 1},
+               **topo_cfg)
+    strategy.hybrid_configs = cfg
+    if cfg["sharding_degree"] > 1:
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
     fleet.init(is_collective=True, strategy=strategy)
     P.seed(0)
     model = fleet.distributed_model(
@@ -666,6 +669,15 @@ def test_train_resume_exact_and_across_topologies(tmp_path):
     c_tail = _resume_run({"dp_degree": 2, "mp_degree": 4}, batches[3:], 3,
                          ckpt=ck)
     np.testing.assert_allclose(c_tail, a[3:], rtol=5e-4)
+    # ZeRO-2 slots: dp4-sharded moments reshard into a dp2-sharded step
+    z = _resume_run({"dp_degree": 4, "mp_degree": 2,
+                     "sharding_degree": 4}, batches, 3,
+                    save_at=3, save_path=str(tmp_path / "z_ck"))
+    np.testing.assert_allclose(z, a[:3], rtol=1e-5)
+    z_tail = _resume_run({"dp_degree": 2, "mp_degree": 4,
+                          "sharding_degree": 2}, batches[3:], 3,
+                         ckpt=str(tmp_path / "z_ck"))
+    np.testing.assert_allclose(z_tail, a[3:], rtol=5e-4)
     # strictness: a different model's checkpoint refuses to partially
     # resume (missing leaves raise instead of silently mixing loaded
     # and fresh state)
