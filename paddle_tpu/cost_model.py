@@ -12,7 +12,6 @@ over ICI, matching the scaling-book mental model.
 """
 from __future__ import annotations
 
-import math
 
 
 class ChipSpec:

@@ -13,7 +13,6 @@ import contextlib
 
 import jax
 
-from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from . import topology as topo_mod
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

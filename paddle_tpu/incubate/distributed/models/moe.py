@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from ....core.dispatch import apply
-from ....core.tensor import Tensor
 from ....nn.initializer import XavierUniform
 from ....nn.layer_base import Layer
 

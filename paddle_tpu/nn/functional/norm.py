@@ -55,7 +55,6 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     """Stateful batch norm: updates running stats in-place during training
     (reference semantics: `paddle/phi/kernels/gpu/batch_norm_kernel.cu`)."""
     from ...core.dispatch import apply
-    from ...core.tensor import Tensor
 
     c_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
     if x.ndim == 2:

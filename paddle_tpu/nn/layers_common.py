@@ -4,10 +4,9 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
-from ..core import dtypes as _dtypes
-from ..core.tensor import Parameter, Tensor
+from ..core.tensor import Parameter
 from . import functional as F
-from .initializer import Constant, Normal, XavierUniform
+from .initializer import Normal, XavierUniform
 from .layer_base import Layer
 
 __all__ = [
@@ -55,7 +54,6 @@ class Embedding(Layer):
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=Normal(0.0, 1.0))
         if padding_idx is not None:
-            import jax.numpy as jnp
 
             self.weight._value = self.weight._value.at[padding_idx].set(0.0)
 

@@ -297,7 +297,6 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, output_time_major=False
     Returns (predicted_ids [B, T, W], final_scores [B, W]) (+ lengths)."""
     import jax
     import jax.numpy as jnp
-    import numpy as np_
 
     import paddle_tpu as P
 

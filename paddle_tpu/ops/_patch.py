@@ -3,7 +3,6 @@ reference monkey-patches `python/paddle/tensor/` functions onto the pybind
 Tensor class)."""
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from . import creation, extra, linalg, logic, manipulation, math, reduction

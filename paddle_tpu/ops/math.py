@@ -6,7 +6,6 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import op
-from ..core.tensor import Tensor
 from ..core import dtypes as _dtypes
 
 __all__ = [

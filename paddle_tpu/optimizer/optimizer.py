@@ -19,7 +19,6 @@ import numpy as np
 
 from ..core.dispatch import apply
 from ..core.tensor import Parameter, Tensor
-from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from ..core.tensor import Parameter
 from .framework import OpRecord, Variable, default_main_program
 
 
