@@ -863,17 +863,22 @@ def phase_mh_bisect():
              ("fwd_mh_small", rung_fwd_mh_small, q),
              ("fwd_mh_bench", rung_fwd_mh_bench, qbench),
              ("bwd_mh_small", rung_bwd_mh_small, q)]
+    n_ok = 0
     for name, fn, arg in rungs:
         t0 = time.perf_counter()
         try:
             r = jax.jit(fn).lower(arg).compile()
             del r
+            n_ok += 1
             log("mh_bisect", {"rung": name, "ok": True,
                               "s": round(time.perf_counter() - t0, 1)})
         except Exception as e:
             log("mh_bisect",
                 {"rung": name, "ok": False,
                  "error": f"{type(e).__name__}: {str(e)[:300]}"})
+    # a transport-dead tunnel fails every rung with no data; a live
+    # bisect always compiles at least copy3d
+    return n_ok > 0
 
 
 def _swin_attention_variant(kind):
@@ -939,6 +944,7 @@ def phase_vision_breakdown():
 
     swin_variant = _swin_attention_variant
     batch = 64
+    n_ok = 0
     orig = swin_mod.WindowAttention.forward
     for kind in ("full", "no_bias", "mm_only", "identity"):
         try:
@@ -954,6 +960,7 @@ def phase_vision_breakdown():
                  "ms_per_step": round(batch / r["value"] * 1e3, 2)
                  if r.get("value") else None,
                  "note": r.get("note", "")})
+            n_ok += bool(r.get("value"))
         except Exception as e:
             log("vision_breakdown",
                 {"model": f"swin_t[{kind}]",
@@ -975,10 +982,12 @@ def phase_vision_breakdown():
                  "mfu_pct": round((r.get("value") or 0.0) * fpi / 197e12
                                   * 100, 1),
                  "note": r.get("note", "")})
+            n_ok += bool(r.get("value"))
         except Exception as e:
             log("vision_breakdown",
                 {"model": name,
                  "error": f"{type(e).__name__}: {str(e)[:200]}"})
+    return n_ok > 0
 
 
 def phase_bench():
@@ -1016,6 +1025,9 @@ def phase_bench():
         with open(GOOD_BENCH, "a") as f:
             for obj in good:
                 f.write(json.dumps(obj) + "\n")
+    # success = the subprocess completed AND emitted results; a dead
+    # tunnel (rc != 0, no lines) must not write a done marker
+    return r.returncode == 0 and bool(lines)
 
 
 PHASES = {"bench_quick": phase_bench_quick,
@@ -1028,6 +1040,28 @@ PHASES = {"bench_quick": phase_bench_quick,
           "generate_1p3b": phase_generate_1p3b,
           "memory_headroom": phase_memory_headroom,
           "mh_bisect": phase_mh_bisect, "bench": phase_bench}
+
+
+def _completed_phases(max_age_s=24 * 3600):
+    """Phases with a fresh completion marker in the log. Consecutive
+    SHORT windows must make cumulative progress: without this, every
+    watcher-triggered run restarts at bench_quick and a series of
+    5-minute windows never reaches the later phases. A phase that
+    crashed or was cut mid-run leaves no marker and reruns."""
+    done = set()
+    try:
+        with open(LOG) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if e.get("done") and "phase" in e and \
+                        time.time() - e.get("at", 0) <= max_age_s:
+                    done.add(e["phase"])
+    except OSError:
+        pass
+    return done
 
 
 def main():
@@ -1043,14 +1077,30 @@ def main():
     # 2. the flash fwd+bwd sweep + layout A/B decide the kernel story,
     # then sanity/kernels/full-bench, then the heavier serving/memory
     # phases. An early tunnel drop costs the least important data.
-    names = sys.argv[1:] or ["bench_quick", "sweep", "sanity", "kernels",
-                             "autotune", "bench", "breakdown", "gqa_ab",
-                             "decode_quant", "generate",
-                             "generate_1p3b", "memory_headroom",
-                             "vision_breakdown", "mh_bisect"]
+    args = [a for a in sys.argv[1:] if a != "--force"]
+    force = "--force" in sys.argv[1:]
+    names = args or ["bench_quick", "sweep", "sanity", "kernels",
+                     "autotune", "bench", "breakdown", "gqa_ab",
+                     "decode_quant", "generate",
+                     "generate_1p3b", "memory_headroom",
+                     "vision_breakdown", "mh_bisect"]
+    done = set() if (force or args) else _completed_phases()
     for n in names:
+        if n in done:
+            print(f"[skip] {n}: completed within 24h "
+                  "(pass phases explicitly or --force to rerun)",
+                  flush=True)
+            continue
         try:
-            PHASES[n]()
+            ok = PHASES[n]()
+            # None = raise-through phase (reaching here IS success);
+            # phases that swallow per-item errors return an explicit
+            # bool so an all-failed run never writes a marker
+            if ok is None or ok:
+                log(n, {"done": True, "at": time.time()})
+            else:
+                log(n, {"error": "phase produced no successful "
+                                 "measurements (no done marker)"})
         except Exception as e:
             log(n, {"error": f"{type(e).__name__}: {str(e)[:300]}"})
 
