@@ -36,6 +36,15 @@ while true; do
         timeout 5400 python tools/chip_session.py >>"$LOG" 2>&1
         rc=$?
         echo "[$(date +%H:%M:%S)] chip_session rc=$rc" >>"$LOG"
+        # durability: measured data must survive even if this session
+        # ends before anyone commits by hand (the r5 session-2 checkout
+        # wiped the r5 session-1 capture). git locks serialize against
+        # concurrent builder commits; a transient failure just retries
+        # next capture.
+        git add tools/chip_session_log.jsonl tools/last_good_bench.jsonl \
+            2>>"$LOG" && \
+            git commit -q -m "chip_session: captured measurement data (auto-commit by tunnel_watch)" \
+                >>"$LOG" 2>&1 || true
         CAPTURES=$((CAPTURES + 1))
         # evidence captured — re-refresh at a slow cadence so later
         # captures stay fresh without hogging the chip
