@@ -259,3 +259,32 @@ def test_spmd_pipeline_single_microbatch():
     want = spmd_pipeline_reference(_block, stages, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_spmd_pipeline_composes_with_mp_sharded_weights():
+    """Stages whose WEIGHTS are tensor-parallel over an auto mp axis:
+    GSPMD shards the per-stage GEMMs while the manual pp axis runs the
+    schedule — the hybrid the one-program tier exists for."""
+    pp, mp, m, width, mb = 2, 2, 4, 16, 2
+    mesh = _mesh(pp, extra=(("mp", mp),))
+    rs = np.random.RandomState(9)
+    stages = [{"up": jnp.asarray(rs.randn(width, 4 * width) * 0.1,
+                                 jnp.float32),
+               "down": jnp.asarray(rs.randn(4 * width, width) * 0.1,
+                                   jnp.float32)}
+              for _ in range(pp)]
+
+    def block(p, a):
+        h = jax.nn.gelu(a @ p["up"])      # column-parallel under mp
+        return a + h @ p["down"]          # row-parallel under mp
+
+    spec = {"up": P("pp", None, "mp"), "down": P("pp", "mp", None)}
+    stacked = {
+        k: jax.device_put(v, NamedSharding(mesh, spec[k]))
+        for k, v in stack_stages(stages).items()}
+    x = jnp.asarray(rs.randn(m, mb, width), jnp.float32)
+    got = jax.jit(lambda s, xv: spmd_pipeline(block, s, xv, mesh=mesh))(
+        stacked, x)
+    want = spmd_pipeline_reference(block, stages, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
