@@ -1,0 +1,68 @@
+"""Render tools/chip_session_log.jsonl into a markdown digest.
+
+The watcher auto-commits raw capture data; this turns it into the
+PERF.md-style tables: one section per phase, latest entry per unique
+key, errors listed last. Run: python tools/analyze_chip_log.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import OrderedDict
+
+LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "chip_session_log.jsonl")
+
+
+def load(path=LOG):
+    entries = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return entries
+
+
+def digest(entries):
+    phases: "OrderedDict[str, OrderedDict]" = OrderedDict()
+    errors = []
+    for e in entries:
+        ph = e.get("phase", "?")
+        if "error" in e:
+            errors.append((ph, e.get("t", ""), e["error"]))
+            continue
+        if e.get("done"):
+            continue
+        # latest entry wins per (phase, discriminator): sweeps key on
+        # blocks/shape/variant/rung/model, single-result phases on phase
+        disc = tuple(str(e.get(k)) for k in
+                     ("blocks", "shape", "variant", "rung", "model",
+                      "metric", "batch") if k in e)
+        phases.setdefault(ph, OrderedDict())[disc] = e
+    lines = []
+    for ph, rows in phases.items():
+        lines.append(f"\n## {ph}  ({len(rows)} rows)\n")
+        for disc, e in rows.items():
+            body = {k: v for k, v in e.items()
+                    if k not in ("phase", "t")}
+            lines.append(f"- `{e.get('t', '')}` "
+                         + json.dumps(body, default=str))
+    if errors:
+        lines.append(f"\n## errors ({len(errors)})\n")
+        for ph, t, err in errors[-30:]:
+            lines.append(f"- `{t}` **{ph}**: {err[:200]}")
+    return "\n".join(lines) or "(log empty)"
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else LOG
+    print(digest(load(path)))
