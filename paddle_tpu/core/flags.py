@@ -89,7 +89,26 @@ _flags = {
     "FLAGS_disable_pallas_rope": _env_bool("FLAGS_disable_pallas_rope"),
     "FLAGS_disable_pallas_decode": _env_bool("FLAGS_disable_pallas_decode"),
     "FLAGS_use_autotune": _env_bool("FLAGS_use_autotune", "1"),
+    # Extra scoped-VMEM budget for Pallas kernels (KiB, 0 = compiler
+    # default of 16 MiB). The round-5 kv-native flash kernels keep all
+    # heads' intermediates on the Mosaic stack and need ~32-64 MiB at
+    # training block sizes; v5e has 128 MiB VMEM, so raising the limit
+    # is real headroom, not overcommit. Applied via jit compiler_options
+    # at the train-step jit sites (the local XLA_FLAGS parser rejects
+    # TPU-only flags on a CPU-built jaxlib, so env XLA_FLAGS cannot
+    # carry it).
+    "FLAGS_scoped_vmem_limit_kib": int(
+        os.environ.get("FLAGS_scoped_vmem_limit_kib", "0")),
 }
+
+
+def jit_compiler_options():
+    """Per-jit XLA compiler options implied by flags (None when empty):
+    pass as jax.jit(..., compiler_options=...) at hot jit sites."""
+    lim = _flags.get("FLAGS_scoped_vmem_limit_kib") or 0
+    if lim:
+        return {"xla_tpu_scoped_vmem_limit_kib": int(lim)}
+    return None
 
 
 def pallas_enabled(kernel: str) -> bool:

@@ -280,7 +280,8 @@ class DistributedTrainStep:
             return loss, new_params, new_opt, new_buffers, new_key
 
         self._step_fn = step
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3),
+                       compiler_options=flags.jit_compiler_options())
 
     def _build_multi(self, batch_treedef, is_repeat):
         """N steps in ONE compiled program: lax.scan over the leading batch
@@ -311,7 +312,8 @@ class DistributedTrainStep:
                 body, (params, opt_state, buffers, key), xs)
             return losses, p, o, b, k
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2, 3))
+        return jax.jit(multi, donate_argnums=(0, 1, 2, 3),
+                       compiler_options=flags.jit_compiler_options())
 
     def run_steps(self, *batch, lrs=None, repeat=None):
         """Run one optimizer step per leading-axis slice of `batch` (every

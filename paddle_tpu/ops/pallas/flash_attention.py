@@ -54,6 +54,15 @@ _DIMSEM = (pltpu.GridDimensionSemantics.PARALLEL,
            pltpu.GridDimensionSemantics.PARALLEL,
            pltpu.GridDimensionSemantics.ARBITRARY)
 
+# Flash layout default: "transpose" (per-head kernels over [B,H,S,D]
+# with layout transposes around the call), "kv" (mixed: K/V/dK/dV stay
+# native [B,S,H,D] — round-5 kernels, see the kv-native section),
+# "flat" (everything on unpadded [B,S,H*D] views — round-5 kernels),
+# "mh" (all-native all-heads blocks — rejected by the deployed server
+# Mosaic, kept for newer toolchains), "auto" (FLAT when it fits VMEM,
+# else transpose). Overridable via env FLAGS_flash_layout.
+_DEFAULT_LAYOUT = "transpose"
+
 
 _FORCE_COMPILED = False  # see force_tpu_lowering()
 
@@ -822,10 +831,585 @@ def _flash_core_mh_bwd(causal, block_q, block_k, res, g):
 _flash_core_mh.defvjp(_flash_core_mh_fwd, _flash_core_mh_bwd)
 
 
-def _mh_selected() -> bool:
+# ================= mixed-layout (kv-native) kernels =================
+#
+# Round-5 on-chip bisect (tools/chip_session.py phase_mh_bisect plus a
+# follow-up compile ladder on the real toolchain): the deployed Mosaic
+# rejects a middle-dim-squeezed load as a dot LHS ("infer-vector-layout:
+# unsupported shape cast") and any DYNAMIC index into a middle dim
+# ("cannot statically prove that index ... is a multiple of 4"), but it
+# accepts
+#   (a) STATIC middle-dim squeezes as dot RHS operands,
+#   (b) static middle-dim-squeezed stores, and
+#   (c) leading-dim indexing of head-major blocks (free: offset only).
+# Every dot in the shared flash loops uses K/V strictly as the RHS
+# (_online_softmax, _dq_loop, _dkv_loop), so K/V/dK/dV can stay in the
+# model's NATIVE [B,S,H,D] layout end to end while Q/O/dO/dQ travel
+# head-major: the K/V transposes in forward and the dK/dV transposes in
+# backward never exist. The round-5 xprof trace put the flash layout
+# transposes at ~66 ms/step (20%) of the GPT-125M bench step; this tier
+# removes half of them (the full-mh core that would remove the rest is
+# what the toolchain rejects, see docs/ATTENTION.md "layout A/B").
+
+
+def _fwd_kernel_kv(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                   block_k, causal, seq_q, seq_k, n_heads, rep):
+    """q_ref/o_ref: [H, block_q, D] head-major; k_ref/v_ref:
+    [seq_k, Hkv, D] native; lse_ref: [H, block_q, 1]. Heads walk a
+    static Python loop (dynamic head indices do not lower, see above);
+    per-head K/V loads are static middle-dim squeezes used only as dot
+    RHS."""
+    block_q = q_ref.shape[1]
+    iq = pl.program_id(1)
+    for hh in range(n_heads):
+        hkv = hh // rep
+        out, lse = _online_softmax(
+            q_ref[hh],
+            lambda j, hkv=hkv: (
+                k_ref[pl.ds(j * block_k, block_k), hkv, :],
+                v_ref[pl.ds(j * block_k, block_k), hkv, :]),
+            iq=iq, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        o_ref[hh] = out.astype(o_ref.dtype)
+        lse_ref[hh] = lse.astype(jnp.float32)
+
+
+def _kv_dimsem():
+    # vmem_limit_bytes: the kv kernels keep all heads' loop intermediates
+    # on the Mosaic stack (statically unrolled head walk) and need
+    # ~20-35 MiB at training block sizes — above the 16 MiB default but
+    # real headroom on v5e's 128 MiB VMEM. Raising the limit PER KERNEL
+    # (instead of the program-wide xla_tpu_scoped_vmem_limit_kib flag)
+    # leaves XLA's own ops on the default budget — a program-wide raise
+    # makes large fusion/transpose ops pick >40 MiB scoped strategies
+    # that then fail allocation (observed on-chip this round).
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=(
+            pltpu.GridDimensionSemantics.PARALLEL,
+            pltpu.GridDimensionSemantics.ARBITRARY),
+        vmem_limit_bytes=34 * 1024 * 1024)
+
+
+def _fwd_kv(qt, k, v, causal, block_q, block_k):
+    """Forward with head-major Q/O ([B,H,Sq,D]) and native-layout K/V
+    ([B,Sk,Hkv,D]); GQA reads the shrunken KV directly (hh // rep).
+    Returns (out_t [B,H,Sq,D], lse [B,H,Sq,1])."""
+    b, h, sq, d = qt.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    assert h % h_kv == 0, (h, h_kv)
+    rep = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_kv, scale=scale, block_k=block_k,
+                          causal=causal, seq_q=sq, seq_k=sk, n_heads=h,
+                          rep=rep),
+        grid=(b, pl.cdiv(sq, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, h, block_q, d),
+                         lambda bi, qi: (bi, 0, qi, 0)),
+            pl.BlockSpec((None, sk, h_kv, d),
+                         lambda bi, qi: (bi, 0, 0, 0)),
+            pl.BlockSpec((None, sk, h_kv, d),
+                         lambda bi, qi: (bi, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, h, block_q, d),
+                         lambda bi, qi: (bi, 0, qi, 0)),
+            pl.BlockSpec((None, h, block_q, 1),
+                         lambda bi, qi: (bi, 0, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+        compiler_params=_kv_dimsem(),
+    )(qt, k, v)
+    return out, lse
+
+
+def _bwd_dq_kernel_kv(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                      dq_ref, *, scale, block_k, causal, seq_q, seq_k,
+                      n_heads, rep):
+    """q/o/do/dq refs: [H, block_q, D] head-major; k/v: [seq_k, Hkv, D]
+    native; lse: [H, block_q, 1]."""
+    block_q = q_ref.shape[1]
+    iq = pl.program_id(1)
+    for hh in range(n_heads):
+        hkv = hh // rep
+        do = do_ref[hh]
+        delta = jnp.sum(do.astype(jnp.float32) *
+                        o_ref[hh].astype(jnp.float32),
+                        axis=1, keepdims=True)
+        dq = _dq_loop(
+            q_ref[hh], do, lse_ref[hh], delta,
+            lambda j, hkv=hkv: (
+                k_ref[pl.ds(j * block_k, block_k), hkv, :],
+                v_ref[pl.ds(j * block_k, block_k), hkv, :]),
+            iq=iq, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        dq_ref[hh] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_kv(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                       dk_ref, dv_ref, *, scale, block_q, causal, seq_q,
+                       seq_k, rep):
+    """k/v/dk/dv refs: [block_k, Hkv, D] native (squeezed static stores);
+    q/o/do: [H, seq_q, D] head-major; lse: [H, seq_q, 1]. dK/dV for a KV
+    head sum the contributions of its whole query group (rep == 1 is
+    plain MHA)."""
+    block_k = k_ref.shape[0]
+    jk = pl.program_id(1)
+    for hkv in range(k_ref.shape[1]):
+        k = k_ref[:, hkv, :]
+        v = v_ref[:, hkv, :]
+        dk_acc = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+        dv_acc = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
+        for r in range(rep):
+            hh = hkv * rep + r
+            dk, dv = _dkv_loop(
+                k, v,
+                lambda i, hh=hh: (
+                    q_ref[hh, pl.ds(i * block_q, block_q), :],
+                    do_ref[hh, pl.ds(i * block_q, block_q), :],
+                    o_ref[hh, pl.ds(i * block_q, block_q), :],
+                    lse_ref[hh, pl.ds(i * block_q, block_q), :]),
+                jk=jk, block_q=block_q, block_k=block_k, scale=scale,
+                causal=causal, seq_q=seq_q, seq_k=seq_k)
+            dk_acc = dk_acc + dk
+            dv_acc = dv_acc + dv
+        # The deployed Mosaic cannot shape-cast a dot-accumulator value
+        # into a middle-dim-squeezed STORE directly ("infer-vector-layout:
+        # unsupported shape cast"); storing a splat zero first (constants
+        # are layout-flexible) and re-loading gives the accumulator a
+        # store-compatible layout via a supported relayout. The extra
+        # VMEM round-trip is noise next to the dK/dV HBM transposes this
+        # kernel eliminates.
+        dk_ref[:, hkv, :] = jnp.zeros((block_k, k.shape[-1]),
+                                      dk_ref.dtype)
+        dv_ref[:, hkv, :] = jnp.zeros((block_k, v.shape[-1]),
+                                      dv_ref.dtype)
+        dk_ref[:, hkv, :] = (dk_ref[:, hkv, :].astype(jnp.float32) +
+                             dk_acc).astype(dk_ref.dtype)
+        dv_ref[:, hkv, :] = (dv_ref[:, hkv, :].astype(jnp.float32) +
+                             dv_acc).astype(dv_ref.dtype)
+
+
+def _bwd_kv(qt, k, v, ot, lse, dot, causal, block_q, block_k):
+    """Backward companion of _fwd_kv: head-major q/o/do in, head-major dq
+    + NATIVE-layout dk/dv out (no transposes behind dK/dV)."""
+    b, h, sq, d = qt.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    rep = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+
+    hm_spec = pl.BlockSpec((None, h, block_q, d),
+                           lambda bi, qi: (bi, 0, qi, 0))
+    hm_lse = pl.BlockSpec((None, h, block_q, 1),
+                          lambda bi, qi: (bi, 0, qi, 0))
+    kv_full = pl.BlockSpec((None, sk, h_kv, d),
+                           lambda bi, qi: (bi, 0, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_kv, scale=scale, block_k=block_k,
+                          causal=causal, seq_q=sq, seq_k=sk, n_heads=h,
+                          rep=rep),
+        grid=(b, pl.cdiv(sq, block_q)),
+        in_specs=[hm_spec, kv_full, kv_full, hm_spec, hm_lse, hm_spec],
+        out_specs=hm_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+        interpret=_interpret(),
+        compiler_params=_kv_dimsem(),
+    )(qt, k, v, ot, lse, dot)
+
+    hm_full = pl.BlockSpec((None, h, sq, d), lambda bi, kj: (bi, 0, 0, 0))
+    hm_full_lse = pl.BlockSpec((None, h, sq, 1),
+                               lambda bi, kj: (bi, 0, 0, 0))
+    kv_spec = pl.BlockSpec((None, block_k, h_kv, d),
+                           lambda bi, kj: (bi, kj, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_kv, scale=scale,
+                          block_q=block_q, causal=causal, seq_q=sq,
+                          seq_k=sk, rep=rep),
+        grid=(b, pl.cdiv(sk, block_k)),
+        in_specs=[hm_full, kv_spec, kv_spec, hm_full, hm_full_lse,
+                  hm_full],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, sk, h_kv, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, sk, h_kv, d), v.dtype)],
+        interpret=_interpret(),
+        compiler_params=_kv_dimsem(),
+    )(qt, k, v, ot, lse, dot)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core_kv(q, k, v, causal, block_q, block_k):
+    """Mixed-layout core: only Q and O (and in backward dO/dQ) cross the
+    [B,S,H,D]<->[B,H,S,D] boundary; K/V/dK/dV stay native. Numerics are
+    the shared flash loops — bit-identical to _flash_core."""
+    out_t, _ = _fwd_kv(_to_hm(q), k, v, causal, block_q, block_k)
+    return _from_hm(out_t)
+
+
+def _flash_core_kv_fwd(q, k, v, causal, block_q, block_k):
+    qt = _to_hm(q)
+    out_t, lse = _fwd_kv(qt, k, v, causal, block_q, block_k)
+    return _from_hm(out_t), (qt, k, v, out_t, lse)
+
+
+def _flash_core_kv_bwd(causal, block_q, block_k, res, g):
+    qt, k, v, ot, lse = res
+    dq_t, dk, dv = _bwd_kv(qt, k, v, ot, lse, _to_hm(g),
+                           causal, block_q, block_k)
+    return _from_hm(dq_t), dk, dv
+
+
+_flash_core_kv.defvjp(_flash_core_kv_fwd, _flash_core_kv_bwd)
+
+# ----- Pallas layout relayout ([B,S,H,D] <-> [B,H,S,D]) -----
+#
+# Two reasons these are Pallas kernels instead of jnp.swapaxes:
+# 1. Speed: the round-5 xprof trace measured XLA's flash layout
+#    transposes at ~209 GB/s apparent bandwidth (~25% of v5e roofline)
+#    — ~66 ms/step at the GPT-125M bench shape.
+# 2. The kv-native kernels need a raised per-kernel VMEM limit, and the
+#    deployed toolchain applies the largest per-kernel limit to the
+#    WHOLE program's scoped-vmem check, under which XLA's own big
+#    transpose fusions pick >40 MiB stack strategies and fail to
+#    compile. Pallas relayouts keep every layout move inside kernels
+#    that carry their own budgets.
+# Only the VPU touches data here (squeezed loads/stores are the
+# bisect-proven headwalk pattern), so lowering is compile-safe on the
+# deployed Mosaic.
+
+
+def _relayout_kernel_to_hm(x_ref, o_ref, *, n_heads):
+    # x_ref: [block_s, H, D] native; o_ref: [H, block_s, D] head-major.
+    # A middle-squeezed LOAD and a leading-index STORE carry different
+    # Mosaic layout flavors; a bare store needs an unsupported shape
+    # cast. Storing a splat zero first (constants are layout-flexible)
+    # and accumulating routes the conversion through a supported
+    # relayout instead (same trick as the dKV store).
+    block_s, _, d = x_ref.shape
+    for hh in range(n_heads):
+        o_ref[hh] = jnp.zeros((block_s, d), o_ref.dtype)
+        o_ref[hh] = o_ref[hh] + x_ref[:, hh, :]
+
+
+def _relayout_kernel_from_hm(x_ref, o_ref, *, n_heads):
+    # x_ref: [H, block_s, D] head-major; o_ref: [block_s, H, D] native
+    _, block_s, d = x_ref.shape
+    for hh in range(n_heads):
+        o_ref[:, hh, :] = jnp.zeros((block_s, d), o_ref.dtype)
+        o_ref[:, hh, :] = o_ref[:, hh, :] + x_ref[hh]
+
+
+def _relayout_block(s):
+    # biggest multiple of 8 dividing s, capped at 512 rows per block
+    b = min(512, s)
+    b -= b % 8
+    while b > 8 and s % b:
+        b -= 8
+    return max(b, 8)
+
+
+@jax.custom_vjp
+def _to_hm(x):
+    """[B,S,H,D] -> [B,H,S,D] as a Pallas copy on TPU (jnp.swapaxes on
+    the interpreter). Adjoint is _from_hm."""
+    b, s, h, d = x.shape
+    if _interpret():
+        return jnp.swapaxes(x, 1, 2)
+    bs = _relayout_block(s)
+    return pl.pallas_call(
+        functools.partial(_relayout_kernel_to_hm, n_heads=h),
+        grid=(b, pl.cdiv(s, bs)),
+        in_specs=[pl.BlockSpec((None, bs, h, d),
+                               lambda bi, si: (bi, si, 0, 0))],
+        out_specs=pl.BlockSpec((None, h, bs, d),
+                               lambda bi, si: (bi, 0, si, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), x.dtype),
+        compiler_params=_kv_dimsem(),
+    )(x)
+
+
+@jax.custom_vjp
+def _from_hm(xt):
+    """[B,H,S,D] -> [B,S,H,D]; adjoint is _to_hm."""
+    b, h, s, d = xt.shape
+    if _interpret():
+        return jnp.swapaxes(xt, 1, 2)
+    bs = _relayout_block(s)
+    return pl.pallas_call(
+        functools.partial(_relayout_kernel_from_hm, n_heads=h),
+        grid=(b, pl.cdiv(s, bs)),
+        in_specs=[pl.BlockSpec((None, h, bs, d),
+                               lambda bi, si: (bi, 0, si, 0))],
+        out_specs=pl.BlockSpec((None, bs, h, d),
+                               lambda bi, si: (bi, si, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, s, h, d), xt.dtype),
+        compiler_params=_kv_dimsem(),
+    )(xt)
+
+
+def _to_hm_fwd(x):
+    return _to_hm(x), None
+
+
+def _to_hm_bwd(_, g):
+    return (_from_hm(g),)
+
+
+def _from_hm_fwd(xt):
+    return _from_hm(xt), None
+
+
+def _from_hm_bwd(_, g):
+    return (_to_hm(g),)
+
+
+_to_hm.defvjp(_to_hm_fwd, _to_hm_bwd)
+_from_hm.defvjp(_from_hm_fwd, _from_hm_bwd)
+
+# ================= flat-native kernels ([B, S, H*D] views) =================
+#
+# The end state of the round-5 layout work. The deployed Mosaic accepts
+# STATIC 64-lane slices of a flat [*, H*D] block as MXU dot operands and
+# as stores (compile-proven on-chip), which makes head-major arrays
+# unnecessary ALTOGETHER:
+#   - q/k/v/o and all gradients stay [B, S, H*D] — the trailing dims
+#     (S, 768) are tile-aligned, so none of the 2-2.7x T(8,128) padding
+#     that [B,H,S,D]/[B,S,H,D] 4-D arrays with D=64 pay in HBM;
+#   - zero transposes and zero relayout copies: XLA sees the same flat
+#     layout the surrounding GEMMs use (the [B,S,3,H,D] reshape/unbind
+#     around the qkv projection is a free bitcast);
+#   - no layout-pinned custom-call boundary for XLA to insert scoped-
+#     stack transpose copies around (the failure mode that killed the
+#     4-D kv-native tier at raised VMEM limits: those copies size
+#     themselves just over whatever per-kernel limit leaks into the
+#     program-wide scoped check).
+# Heads walk a static Python loop; per-head operands are lane slices
+# hh*D:(hh+1)*D. The shared recurrences (_online_softmax, _dq_loop,
+# _dkv_loop) are reused as-is — numerics identical to every other core.
+
+
+def _fwd_kernel_flat(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                     block_k, causal, seq_q, seq_k, n_heads, rep, d):
+    # q_ref/o_ref: [block_q, H*D]; k_ref/v_ref: [seq_k, Hkv*D];
+    # lse_ref: [H, block_q, 1]
+    block_q = q_ref.shape[0]
+    iq = pl.program_id(1)
+    for hh in range(n_heads):
+        lo = (hh // rep) * d
+        out, lse = _online_softmax(
+            q_ref[:, hh * d:(hh + 1) * d],
+            lambda j, lo=lo: (
+                k_ref[pl.ds(j * block_k, block_k), lo:lo + d],
+                v_ref[pl.ds(j * block_k, block_k), lo:lo + d]),
+            iq=iq, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        o_ref[:, hh * d:(hh + 1) * d] = out.astype(o_ref.dtype)
+        lse_ref[hh] = lse.astype(jnp.float32)
+
+
+def _fwd_flat(q, k, v, h, causal, block_q, block_k):
+    """Forward on flat [B,Sq,H*D] q and [B,Sk,Hkv*D] k/v.
+    Returns (out [B,Sq,H*D], lse [B,H,Sq,1])."""
+    b, sq, hd = q.shape
+    d = hd // h
+    sk, hkvd = k.shape[1], k.shape[2]
+    h_kv = hkvd // d
+    rep = h // h_kv
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_flat, scale=scale, block_k=block_k,
+                          causal=causal, seq_q=sq, seq_k=sk, n_heads=h,
+                          rep=rep, d=d),
+        grid=(b, pl.cdiv(sq, block_q)),
+        in_specs=[
+            pl.BlockSpec((None, block_q, hd),
+                         lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, sk, hkvd), lambda bi, qi: (bi, 0, 0)),
+            pl.BlockSpec((None, sk, hkvd), lambda bi, qi: (bi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, hd),
+                         lambda bi, qi: (bi, qi, 0)),
+            pl.BlockSpec((None, h, block_q, 1),
+                         lambda bi, qi: (bi, 0, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+        compiler_params=_kv_dimsem(),
+    )(q, k, v)
+    return out, lse
+
+
+def _bwd_dq_kernel_flat(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                        dq_ref, *, scale, block_k, causal, seq_q, seq_k,
+                        n_heads, rep, d):
+    block_q = q_ref.shape[0]
+    iq = pl.program_id(1)
+    for hh in range(n_heads):
+        lo = (hh // rep) * d
+        sl = slice(hh * d, (hh + 1) * d)
+        do = do_ref[:, sl]
+        delta = jnp.sum(do.astype(jnp.float32) *
+                        o_ref[:, sl].astype(jnp.float32),
+                        axis=1, keepdims=True)
+        dq = _dq_loop(
+            q_ref[:, sl], do, lse_ref[hh], delta,
+            lambda j, lo=lo: (
+                k_ref[pl.ds(j * block_k, block_k), lo:lo + d],
+                v_ref[pl.ds(j * block_k, block_k), lo:lo + d]),
+            iq=iq, block_q=block_q, block_k=block_k, scale=scale,
+            causal=causal, seq_q=seq_q, seq_k=seq_k)
+        dq_ref[:, sl] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_flat(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref,
+                         dk_ref, dv_ref, *, scale, block_q, causal,
+                         seq_q, seq_k, n_heads, rep, d):
+    block_k = k_ref.shape[0]
+    jk = pl.program_id(1)
+    h_kv = n_heads // rep
+    for hkv in range(h_kv):
+        ksl = slice(hkv * d, (hkv + 1) * d)
+        k = k_ref[:, ksl]
+        v = v_ref[:, ksl]
+        dk_acc = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc = jnp.zeros((block_k, d), jnp.float32)
+        for r in range(rep):
+            hh = hkv * rep + r
+            qsl = slice(hh * d, (hh + 1) * d)
+            dk, dv = _dkv_loop(
+                k, v,
+                lambda i, qsl=qsl, hh=hh: (
+                    q_ref[pl.ds(i * block_q, block_q), qsl],
+                    do_ref[pl.ds(i * block_q, block_q), qsl],
+                    o_ref[pl.ds(i * block_q, block_q), qsl],
+                    lse_ref[hh, pl.ds(i * block_q, block_q), :]),
+                jk=jk, block_q=block_q, block_k=block_k, scale=scale,
+                causal=causal, seq_q=seq_q, seq_k=seq_k)
+            dk_acc = dk_acc + dk
+            dv_acc = dv_acc + dv
+        dk_ref[:, ksl] = dk_acc.astype(dk_ref.dtype)
+        dv_ref[:, ksl] = dv_acc.astype(dv_ref.dtype)
+
+
+def _bwd_flat(q, k, v, out, lse, do, h, causal, block_q, block_k):
+    """Backward companion of _fwd_flat: everything stays [B,S,H*D]."""
+    b, sq, hd = q.shape
+    d = hd // h
+    sk, hkvd = k.shape[1], k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    rep = hd // hkvd
+
+    q_spec = pl.BlockSpec((None, block_q, hd), lambda bi, qi: (bi, qi, 0))
+    lse_spec = pl.BlockSpec((None, h, block_q, 1),
+                            lambda bi, qi: (bi, 0, qi, 0))
+    kv_full = pl.BlockSpec((None, sk, hkvd), lambda bi, qi: (bi, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_flat, scale=scale,
+                          block_k=block_k, causal=causal, seq_q=sq,
+                          seq_k=sk, n_heads=h, rep=rep, d=d),
+        grid=(b, pl.cdiv(sq, block_q)),
+        in_specs=[q_spec, kv_full, kv_full, q_spec, lse_spec, q_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, sq, hd), q.dtype),
+        interpret=_interpret(),
+        compiler_params=_kv_dimsem(),
+    )(q, k, v, out, lse, do)
+
+    q_full = pl.BlockSpec((None, sq, hd), lambda bi, kj: (bi, 0, 0))
+    lse_full = pl.BlockSpec((None, h, sq, 1), lambda bi, kj: (bi, 0, 0, 0))
+    kv_spec = pl.BlockSpec((None, block_k, hkvd),
+                           lambda bi, kj: (bi, kj, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_flat, scale=scale,
+                          block_q=block_q, causal=causal, seq_q=sq,
+                          seq_k=sk, n_heads=h, rep=rep, d=d),
+        grid=(b, pl.cdiv(sk, block_k)),
+        in_specs=[q_full, kv_spec, kv_spec, q_full, lse_full, q_full],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, sk, hkvd), k.dtype),
+                   jax.ShapeDtypeStruct((b, sk, hkvd), v.dtype)],
+        interpret=_interpret(),
+        compiler_params=_kv_dimsem(),
+    )(q, k, v, out, lse, do)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core_flat(q, k, v, causal, block_q, block_k):
+    """Flat-native core: public [B,S,H,D] in/out, but every kernel
+    operand rides an unpadded [B,S,H*D] view (free reshape). Zero
+    transposes, zero relayouts, zero padded arrays. Numerics are the
+    shared flash loops — identical to _flash_core."""
+    b, sq, h, d = q.shape
+    out, _ = _fwd_flat(q.reshape(b, sq, h * d),
+                       k.reshape(b, k.shape[1], -1),
+                       v.reshape(b, v.shape[1], -1),
+                       h, causal, block_q, block_k)
+    return out.reshape(b, sq, h, d)
+
+
+def _flash_core_flat_fwd(q, k, v, causal, block_q, block_k):
+    b, sq, h, d = q.shape
+    qf = q.reshape(b, sq, h * d)
+    kf = k.reshape(b, k.shape[1], -1)
+    vf = v.reshape(b, v.shape[1], -1)
+    out, lse = _fwd_flat(qf, kf, vf, h, causal, block_q, block_k)
+    return out.reshape(b, sq, h, d), (qf, kf, vf, out, lse, h, d)
+
+
+def _flash_core_flat_bwd(causal, block_q, block_k, res, g):
+    qf, kf, vf, out, lse, h, d = res
+    b, sq, hd = qf.shape
+    dq, dk, dv = _bwd_flat(qf, kf, vf, out, lse,
+                           g.reshape(b, sq, hd), h, causal,
+                           block_q, block_k)
+    return (dq.reshape(b, sq, h, d),
+            dk.reshape(b, kf.shape[1], -1, d),
+            dv.reshape(b, vf.shape[1], -1, d))
+
+
+_flash_core_flat.defvjp(_flash_core_flat_fwd, _flash_core_flat_bwd)
+
+_KV_VMEM_BOUND = 8 * 1024 * 1024
+
+
+def _kv_native_ok(q, k) -> bool:
+    """VMEM feasibility of the kv-native kernels: the forward holds full
+    K+V ([Sk, Hkv, D] each) per batch row; the dKV kernel holds full
+    head-major q/o/do ([H, Sq, D] each). Past the bound, the transpose
+    core (block-sliced K/V) is the safe path."""
+    b, sq, h, d = q.shape
+    sk, h_kv = k.shape[1], k.shape[2]
+    esz = q.dtype.itemsize
+    fwd_bytes = 2 * sk * h_kv * d * esz + 2 * h * min(sq, 512) * d * esz
+    dkv_bytes = (3 * h * sq * d * esz + 4 * h * sq +
+                 4 * min(sk, 512) * h_kv * d * esz)
+    return max(fwd_bytes, dkv_bytes) <= _KV_VMEM_BOUND
+
+
+def _layout_flag() -> str:
     import os
 
-    return os.environ.get("FLAGS_flash_layout", "transpose") == "mh"
+    return os.environ.get("FLAGS_flash_layout", _DEFAULT_LAYOUT)
 
 
 # ===================== biased (additive-mask) core =====================
@@ -1166,7 +1750,15 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
         out = _flash_core(q, k, v, bool(is_causal), block_q, block_k,
                           sq, sk)
         return out[:, :sq]
-    if _mh_selected() and k.shape[2] == q.shape[2]:
+    layout = _layout_flag()
+    if layout == "mh" and k.shape[2] == q.shape[2]:
         # the mh core is MHA-only; GQA takes the grouped transpose core
         return _flash_core_mh(q, k, v, bool(is_causal), block_q, block_k)
+    if layout in ("flat", "auto") and _kv_native_ok(q, k):
+        # flat-native: unpadded [B,S,H*D] views, zero transposes
+        return _flash_core_flat(q, k, v, bool(is_causal), block_q,
+                                block_k)
+    if layout == "kv" and _kv_native_ok(q, k):
+        # mixed layout: K/V/dK/dV never transpose (GQA-native via rep)
+        return _flash_core_kv(q, k, v, bool(is_causal), block_q, block_k)
     return _flash_core(q, k, v, bool(is_causal), block_q, block_k)

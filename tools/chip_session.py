@@ -767,6 +767,48 @@ def phase_breakdown():
     log("breakdown", {"shape": f"B{batch}S{seq}", **out})
 
 
+def phase_layout_step_ab():
+    """Full-train-step A/B of the flash layouts (docs/ATTENTION.md "The
+    layout story"): the chained slope A/B cannot decide layouts because
+    back-to-back swapaxes cancel inside the timing loop; only the real
+    step pays the transpose cost. Each layout runs as a SUBPROCESS with
+    a hard timeout — a pathological Mosaic compile (observed once for
+    the flat layout this round: remote compile hung >25 min) must cost
+    one variant, not the window."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    layouts = ("transpose", "flat")
+    n_ok = 0
+    for layout in layouts:
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(here, "step_ab.py"), layout],
+                capture_output=True, text=True, timeout=1500)
+            line = next((l for l in r.stdout.splitlines()
+                         if l.startswith("AB ")), None)
+            if line:
+                n_ok += 1
+                log("layout_step_ab", {
+                    "layout": layout, "result": line,
+                    "seconds": round(time.perf_counter() - t0, 1)})
+            else:
+                log("layout_step_ab", {
+                    "layout": layout, "rc": r.returncode,
+                    "stderr_tail": r.stderr[-200:]})
+        except subprocess.TimeoutExpired:
+            log("layout_step_ab", {
+                "layout": layout,
+                "error": "timeout after 1500s (hung remote compile?)"})
+        except Exception as e:
+            log("layout_step_ab", {
+                "layout": layout,
+                "error": f"{type(e).__name__}: {str(e)[:200]}"})
+    # the phase exists to COMPARE layouts: a half-complete A/B (e.g. the
+    # flat compile hanging into its timeout while transpose finished)
+    # must rerun next window, not hide behind a done marker
+    return n_ok == len(layouts)
+
+
 def phase_mh_bisect():
     """Localize the real-toolchain rejection of the transpose-free (mh)
     flash kernels (PERF.md r5: local lowering gate green, server-side
@@ -1039,7 +1081,8 @@ PHASES = {"bench_quick": phase_bench_quick,
           "generate": phase_generate, "decode_quant": phase_decode_quant,
           "generate_1p3b": phase_generate_1p3b,
           "memory_headroom": phase_memory_headroom,
-          "mh_bisect": phase_mh_bisect, "bench": phase_bench}
+          "mh_bisect": phase_mh_bisect, "bench": phase_bench,
+          "layout_step_ab": phase_layout_step_ab}
 
 
 def _completed_phases(max_age_s=24 * 3600):
@@ -1083,7 +1126,8 @@ def main():
                      "autotune", "bench", "breakdown", "gqa_ab",
                      "decode_quant", "generate",
                      "generate_1p3b", "memory_headroom",
-                     "vision_breakdown", "mh_bisect"]
+                     "vision_breakdown", "mh_bisect",
+                     "layout_step_ab"]
     done = set() if (force or args) else _completed_phases()
     for n in names:
         if n in done:

@@ -1,0 +1,65 @@
+"""One GPT-125M train-step benchmark at a chosen flash layout.
+
+Usage: python tools/step_ab.py [transpose|kv|flat|mh|auto]
+
+Mirrors chip_session's bench_quick body (batch 32, seq 1024, autotune
+off, 8 scanned steps) and prints ONE line:
+    AB layout=<layout> tokens/s=<v> mfu=<v> loss=<v>
+Run once per layout and compare — the chained-kernel slope A/B cannot
+decide layouts because back-to-back swapaxes cancel inside the timing
+loop; only the real step sees the transpose cost (docs/ATTENTION.md
+"The layout story"). Invoked by chip_session's layout_step_ab phase as
+a subprocess with a hard timeout: a pathological Mosaic compile (seen
+once on the flat layout this round) must cost one phase, not the
+window.
+"""
+import os, sys, time
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+import numpy as np
+
+layout = sys.argv[1] if len(sys.argv) > 1 else "transpose"
+os.environ["FLAGS_flash_layout"] = layout
+
+from paddle_tpu.backend_guard import enable_persistent_compile_cache
+enable_persistent_compile_cache(__import__("os").path.join(__import__("os").path.dirname(__import__("os").path.abspath(__file__)), ".jax_tpu_cache"))
+
+import jax
+import paddle_tpu as P
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.models.gpt import (
+    GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+)
+
+_flags.set_flags({"FLAGS_use_autotune": 0})
+cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=1024, fused_head_ce=True)
+rs = np.random.RandomState(0)
+batch, seq, iters = 32, 1024, 8
+topology.reset_topology()
+strategy = fleet.DistributedStrategy()
+strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                           "sep_degree": 1, "sharding_degree": 1}
+fleet.init(is_collective=True, strategy=strategy)
+P.seed(0)
+inner = GPTForCausalLM(cfg)
+model = fleet.distributed_model(inner)
+opt = fleet.distributed_optimizer(P.optimizer.AdamW(
+    parameters=model.parameters(), learning_rate=1e-4))
+step = model.build_train_step(opt, GPTPretrainingCriterion(model=inner),
+                              amp_dtype="bfloat16")
+ids = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+labels = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)), "int32")
+losses = step.run_steps(ids, labels, repeat=iters)
+final = float(np.asarray(losses._value[-1]))
+best = 0.0
+for _ in range(3):
+    t0 = time.perf_counter()
+    losses = step.run_steps(ids, labels, repeat=iters)
+    f2 = float(np.asarray(losses._value[-1]))
+    dt = time.perf_counter() - t0
+    best = max(best, batch * seq * iters / dt)
+n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+mfu = best * 6 * n_params / 197e12
+print(f"AB layout={layout} tokens/s={best:.1f} mfu={mfu:.4f} "
+      f"loss={final:.4f}")
