@@ -223,6 +223,47 @@ def test_flash_mh_bwd_lowers(shape):
     _assert_mosaic(mlir)
 
 
+@pytest.mark.parametrize("shape", [(8, 1024, 12, 64), (2, 1024, 12, 64)])
+def test_flash_flat_fwd_bwd_lowers(shape):
+    """The flat-native core (unpadded [B,S,H*D] views, per-head 64-lane
+    slices — round-5 kernels) must lower for both directions. NOTE: the
+    local gate is necessary but not sufficient for this tier — the
+    deployed server Mosaic has stricter rules, see docs/ATTENTION.md
+    'The layout story'."""
+    b, s, h, d = shape
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    f = lambda q, k, v: fa._flash_core_flat(q, k, v, True, 128, 128)
+    mlir = _lower_for_tpu(f, q, q, q)
+    _assert_mosaic(mlir)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_core_flat(q, k, v, True, 128, 128)
+            .astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    _assert_mosaic(mlir)
+
+
+def test_flash_kv_native_fwd_bwd_lowers():
+    """The kv-native core (K/V/dK/dV native layout, Pallas relayouts for
+    Q/O) must lower for both directions."""
+    b, s, h, d = 2, 1024, 12, 64
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_core_kv(q, k, v, True, 128, 128)
+            .astype(jnp.float32))
+
+    mlir = _lower_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+    _assert_mosaic(mlir)
+    n_calls = mlir.count("tpu_custom_call")
+    assert n_calls >= 6, (
+        f"kv core backward should contain relayout + fwd + dq + dkv "
+        f"kernels (got {n_calls} custom calls)")
+
+
 @pytest.mark.parametrize("shape", [(4, 2048, 32, 8, 128)])
 def test_flash_gqa_lowers(shape):
     """LLaMA-2/3-class GQA (32 query / 8 KV heads): grouped index maps
