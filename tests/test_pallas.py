@@ -783,3 +783,67 @@ def test_autotune_pick_contract(monkeypatch, tmp_path):
 
     assert autotune.pick("testop", "sig2", ["bad", "ok"], run2,
                          "bad") == "ok"
+
+
+@pytest.mark.slow
+def test_train_step_layout_parity(monkeypatch):
+    """FULL GPT train step, loss parity across flash layouts: on the
+    interpreter every layout runs the same shared recurrences, so three
+    steps of training must produce identical losses whether the flash
+    dispatch routes transpose, kv-native, or flat-native. Guards the
+    opt-in layouts at the train-step level (not just the kernel level)."""
+    import paddle_tpu as P
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    import paddle_tpu.ops.pallas as _pl
+
+    # BOTH bindings: fa.flash_attention_fwd consults the module global,
+    # but nn.functional.attention gates on the package re-export — the
+    # unpatched one silently routes everything to the reference path
+    monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
+    monkeypatch.setattr(_pl, "flash_attention_available",
+                        lambda q_: True)
+    kw = dict(vocab_size=211, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=32, dropout=0.0, attn_dropout=0.0)
+    losses = {}
+    routed = {}
+    cores = {"transpose": "_flash_core", "kv": "_flash_core_kv",
+             "flat": "_flash_core_flat"}
+    for layout in ("transpose", "kv", "flat"):
+        monkeypatch.setenv("FLAGS_flash_layout", layout)
+        orig_core = getattr(fa, cores[layout])
+
+        def spy(*a, _oc=orig_core, _ly=layout, **kw2):
+            routed[_ly] = True
+            return _oc(*a, **kw2)
+
+        monkeypatch.setattr(fa, cores[layout], spy)
+        topology.reset_topology()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sep_degree": 1, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        P.seed(11)
+        model = GPTForCausalLM(GPTConfig(**kw))
+        crit = GPTPretrainingCriterion()
+        dm = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(
+            P.optimizer.SGD(parameters=model.parameters(),
+                            learning_rate=0.1))
+        step = dm.build_train_step(opt, crit)
+        rs = np.random.RandomState(3)
+        ids = P.to_tensor(rs.randint(0, 211, (2, 32)), "int32")
+        lab = P.to_tensor(rs.randint(0, 211, (2, 32)), "int32")
+        losses[layout] = [float(step(ids, lab)) for _ in range(3)]
+        monkeypatch.setattr(fa, cores[layout], orig_core)
+        assert routed.get(layout), (
+            f"layout {layout!r} never reached its flash core — "
+            "dispatch fell back, the parity comparison would be vacuous")
+    np.testing.assert_allclose(losses["transpose"], losses["kv"],
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(losses["transpose"], losses["flat"],
+                               rtol=1e-6, atol=1e-6)
