@@ -1393,10 +1393,10 @@ _KV_VMEM_BOUND = 8 * 1024 * 1024
 
 
 def _kv_native_ok(q, k) -> bool:
-    """VMEM feasibility of the kv-native kernels: the forward holds full
-    K+V ([Sk, Hkv, D] each) per batch row; the dKV kernel holds full
-    head-major q/o/do ([H, Sq, D] each). Past the bound, the transpose
-    core (block-sliced K/V) is the safe path."""
+    """VMEM feasibility of the kv-native AND flat kernels (same block
+    geometry): the forward holds full K+V per batch row; the dKV kernel
+    holds full-sequence q/o/do per head walk. Past the bound, the
+    transpose core (block-sliced K/V) is the safe path."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
     esz = q.dtype.itemsize
