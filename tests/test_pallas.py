@@ -481,6 +481,22 @@ def test_flash_kv_native_dispatch_gate(monkeypatch):
     ref = fa._ref_attention(q, q, q, None, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+    # flat (and auto, which prefers flat) route to the flat core
+    orig_flat = fa._flash_core_flat
+
+    def spy_flat(*a, **kw):
+        called["flat"] = True
+        return orig_flat(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_core_flat", spy_flat)
+    for flag in ("flat", "auto"):
+        called.pop("flat", None)
+        monkeypatch.setenv("FLAGS_flash_layout", flag)
+        out = fa.flash_attention_fwd(q, q, q, is_causal=True)
+        assert called.get("flat"), (
+            f"layout {flag!r} did not route to the flat core")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
 
 
 @pytest.mark.parametrize("causal", [False, True])
