@@ -1406,6 +1406,21 @@ def _kv_native_ok(q, k) -> bool:
     return max(fwd_bytes, dkv_bytes) <= _KV_VMEM_BOUND
 
 
+def _flat_native_ok(q, k) -> bool:
+    """Eligibility of the FLAT kernels specifically: the VMEM bound of
+    _kv_native_ok plus lane alignment — the flat kernels slice per-head
+    lane windows out of an [*, H*D] block and were real-compile-proven
+    only with the flat width a multiple of the 128-lane tile; off-tile
+    widths stay on the transpose core rather than risking a server-side
+    Mosaic reject. (The kv-native kernels index 4-D [S,Hkv,D] blocks and
+    need no lane gate.)"""
+    h, d = q.shape[2], q.shape[3]
+    h_kv = k.shape[2]
+    if (h * d) % 128 != 0 or (h_kv * d) % 128 != 0:
+        return False
+    return _kv_native_ok(q, k)
+
+
 def _layout_flag() -> str:
     import os
 
@@ -1754,7 +1769,7 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
     if layout == "mh" and k.shape[2] == q.shape[2]:
         # the mh core is MHA-only; GQA takes the grouped transpose core
         return _flash_core_mh(q, k, v, bool(is_causal), block_q, block_k)
-    if layout in ("flat", "auto") and _kv_native_ok(q, k):
+    if layout in ("flat", "auto") and _flat_native_ok(q, k):
         # flat-native: unpadded [B,S,H*D] views, zero transposes
         return _flash_core_flat(q, k, v, bool(is_causal), block_q,
                                 block_k)

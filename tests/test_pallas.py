@@ -463,6 +463,14 @@ def test_flash_kv_native_dispatch_gate(monkeypatch):
         dtype = jnp.dtype(jnp.bfloat16)
 
     assert not fa._kv_native_ok(_Fake(), _Fake())
+    assert fa._flat_native_ok(q, q)  # H*D = 128: lane-aligned
+
+    class _OffTile:  # H*D = 64 — below the 128-lane tile
+        shape = (2, 128, 4, 16)
+        dtype = jnp.dtype(jnp.bfloat16)
+
+    assert fa._kv_native_ok(_OffTile(), _OffTile())  # kv: no lane gate
+    assert not fa._flat_native_ok(_OffTile(), _OffTile())
     monkeypatch.setenv("FLAGS_flash_layout", "kv")
     # on CPU the public entry routes to the reference path
     # (flash_attention_available gates on TPU); force the interpreter
@@ -822,7 +830,9 @@ def test_train_step_layout_parity(monkeypatch):
     monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
     monkeypatch.setattr(_pl, "flash_attention_available",
                         lambda q_: True)
-    kw = dict(vocab_size=211, hidden_size=64, num_layers=2, num_heads=4,
+    # hidden 128 / 4 heads -> head_dim 32, H*D = 128: satisfies the
+    # lane-alignment eligibility gate (_kv_native_ok) so kv/flat route
+    kw = dict(vocab_size=211, hidden_size=128, num_layers=2, num_heads=4,
               max_seq_len=32, dropout=0.0, attn_dropout=0.0)
     losses = {}
     routed = {}
