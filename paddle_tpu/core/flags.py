@@ -89,6 +89,10 @@ _flags = {
     "FLAGS_disable_pallas_rope": _env_bool("FLAGS_disable_pallas_rope"),
     "FLAGS_disable_pallas_decode": _env_bool("FLAGS_disable_pallas_decode"),
     "FLAGS_use_autotune": _env_bool("FLAGS_use_autotune", "1"),
+    # force the expanded-KV MHA kernels for GQA attention (grouped is
+    # the default: less KV HBM traffic; the round-5 on-chip A/B showed
+    # backward can favor expanded at some block shapes — PERF.md)
+    "FLAGS_flash_gqa_expand": _env_bool("FLAGS_flash_gqa_expand"),
     # Extra scoped-VMEM budget for Pallas kernels (KiB, 0 = compiler
     # default of 16 MiB). The round-5 kv-native flash kernels keep all
     # heads' intermediates on the Mosaic stack and need ~32-64 MiB at

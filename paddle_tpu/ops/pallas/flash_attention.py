@@ -1745,7 +1745,17 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
         # compile an infeasible kernel
         rep = q.shape[2] // k.shape[2]
         group_bytes = 3 * rep * q.shape[1] * q.shape[3] * q.dtype.itemsize
-        if group_bytes > 8 * 1024 * 1024:
+        # FLAGS_flash_gqa_expand: operator escape hatch — the round-5
+        # on-chip A/B (chip_session gqa_ab) measured grouped winning
+        # forward (1.6x at B4 S2048 32q/8kv D128) but LOSING backward at
+        # 512x512 blocks (4.06 vs 2.87 ms), so the best choice is
+        # shape-dependent; grouped (less KV HBM traffic) stays the
+        # default
+        from ...core import flags as _flags
+
+        if _flags.get_flags(["FLAGS_flash_gqa_expand"])[
+                "FLAGS_flash_gqa_expand"] or \
+                group_bytes > 8 * 1024 * 1024:
             q, k, v = _expand_gqa_kv(q, k, v)
     sq, sk = q.shape[1], k.shape[1]
     pad_q = (-sq) % 8

@@ -507,6 +507,44 @@ def test_flash_kv_native_dispatch_gate(monkeypatch):
                                    atol=2e-5, rtol=2e-5)
 
 
+def test_flash_gqa_expand_flag_routes(monkeypatch):
+    """FLAGS_flash_gqa_expand forces the expanded-KV path: the kernels
+    then see Hkv == Hq (and the result still matches the reference)."""
+    from paddle_tpu.core import flags as _flags
+
+    B, S, HQ, HKV, D = 2, 128, 4, 2, 32
+    q = _rand((B, S, HQ, D))
+    k = _rand((B, S, HKV, D))
+    v = _rand((B, S, HKV, D))
+    monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
+    # pin the layout: an inherited FLAGS_flash_layout=flat/kv would route
+    # past the _flash_core spy and fail this test spuriously
+    monkeypatch.setenv("FLAGS_flash_layout", "transpose")
+    seen = {}
+    orig = fa._flash_core
+
+    def spy(q_, k_, v_, *a, **kw):
+        seen["h_kv"] = k_.shape[2]
+        return orig(q_, k_, v_, *a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_core", spy)
+    _flags.set_flags({"FLAGS_flash_gqa_expand": True})
+    try:
+        out = fa.flash_attention_fwd(q, k, v, is_causal=True)
+    finally:
+        _flags.set_flags({"FLAGS_flash_gqa_expand": False})
+    assert seen.get("h_kv") == HQ, "expand flag did not expand KV heads"
+    ref = fa._ref_attention(q, k, v, None, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # default: grouped (KV stays shrunk)
+    seen.clear()
+    out = fa.flash_attention_fwd(q, k, v, is_causal=True)
+    assert seen.get("h_kv") == HKV
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_gqa_matches_expanded_reference(causal):
     """GQA-native kernels (Hkv < Hq, grouped via index maps — KV never
