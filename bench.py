@@ -25,8 +25,28 @@ import numpy as np
 
 _ACCEL_PLATFORMS = ("tpu", "axon")
 
+_TELEMETRY_FLAG = "--telemetry"
 
-def run_bench(degraded: bool = False, note: str = "") -> dict:
+
+def _telemetry_requested() -> bool:
+    return _TELEMETRY_FLAG in sys.argv[1:]
+
+
+def _attach_telemetry():
+    """Enable the observability stack for this bench process.  The
+    metrics snapshot is embedded in the emitted bench JSON
+    (`"telemetry"` key), so every BENCH_*.json line carries its own
+    provenance: which flash tiers actually dispatched, autotune
+    hits/misses, retraces, per-step walls — the antidote to round-5's
+    "stale reused number with no provenance" headline."""
+    from paddle_tpu import observability as obs
+
+    obs.attach()
+    return obs
+
+
+def run_bench(degraded: bool = False, note: str = "",
+              telemetry: bool = False) -> dict:
     import jax
 
     import paddle_tpu as P
@@ -60,6 +80,10 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
 
     trace_dir = os.environ.get("BENCH_XPROF_DIR")
 
+    obs = timer = None
+    if telemetry:
+        obs = _attach_telemetry()
+
     rs = np.random.RandomState(0)
     tps = None
     model = opt = crit = step = ids = labels = loss = None
@@ -72,7 +96,13 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
         gc.collect()
         try:
             # fresh model/opt/step per attempt: a failed donated step leaves
-            # state unusable
+            # state unusable.  The StepTimer is fresh per attempt too —
+            # a failed larger-batch attempt's walls must not pollute the
+            # winning batch's telemetry summary (tokens_per_step would
+            # misprice them)
+            if obs is not None:
+                timer = obs.StepTimer(run_id=f"bench_gpt125m_b{batch}",
+                                      sink=os.environ.get("BENCH_STEP_LOG"))
             P.seed(0)
             inner = GPTForCausalLM(cfg)
             model = fleet.distributed_model(inner)
@@ -88,9 +118,17 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
             # warmup/compile — two calls: the first call's inputs are fresh
             # device_puts; the second proves the steady-state executable is
             # reused (train_step pins state shardings so there is no
-            # second-call retrace)
+            # second-call retrace).  Under --telemetry the first wall is
+            # the compile-ledger entry (trace+compile+step), and the
+            # input upload bytes are the host->device transfer estimate.
+            t_first = time.perf_counter()
             loss = step(ids, labels)
             loss.block_until_ready()
+            if timer is not None:
+                timer.tokens_per_step = batch * seq
+                timer.record(time.perf_counter() - t_first,
+                             compile_step=True,
+                             transfer_bytes=2 * batch * seq * 4)
             loss = step(ids, labels)
             loss.block_until_ready()
 
@@ -114,6 +152,10 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
                 losses = step.run_steps(ids, labels, repeat=iters)
                 final_loss = float(np.asarray(losses._value[-1]))
                 dt = time.perf_counter() - t0
+                if timer is not None:
+                    # one compiled N-step scan: one record, walls
+                    # divided per step
+                    timer.record(dt, n_steps=iters)
             finally:
                 if trace_dir:
                     jax.profiler.stop_trace()
@@ -146,6 +188,14 @@ def run_bench(degraded: bool = False, note: str = "") -> dict:
         result["degraded"] = True
     if note:
         result["note"] = note
+    if timer is not None:
+        # MFU rates use the same FLOPs accounting as the headline metric
+        timer.flops_per_step = flops_per_token * batch * seq
+        timer.peak_flops = peak
+        result["telemetry"] = {
+            "metrics": obs.metrics.snapshot(),
+            "step_stats": timer.summary(),
+        }
     return result
 
 
@@ -399,7 +449,8 @@ def main() -> None:
         from paddle_tpu.backend_guard import force_cpu_mesh
 
         force_cpu_mesh(1)
-        result = run_bench(degraded=True, note="forced-cpu")
+        result = run_bench(degraded=True, note="forced-cpu",
+                           telemetry=_telemetry_requested())
         _emit_secondaries_degraded()
         _emit(result)
         return
@@ -409,13 +460,14 @@ def main() -> None:
     )
 
     note = ""
+    telemetry = _telemetry_requested()
     # a down tunnel often comes back within minutes: retry for up to
     # ~7.5 min worst case (5 x 75 s timeouts + 4 x 20 s sleeps) before
     # surrendering the round's datapoint to the CPU proxy
     probe = probe_default_backend(timeout=75.0, retries=5, backoff=20.0)
     if probe is not None and probe[0] in _ACCEL_PLATFORMS:
         try:
-            result = run_bench()
+            result = run_bench(telemetry=telemetry)
             # secondary metrics (BASELINE configs 1 & 5) must never sink
             # the headline: emitted first, failures noted in their lines
             try:
@@ -438,8 +490,11 @@ def main() -> None:
                 if os.environ.get("FLAGS_disable_pallas") == "1":
                     raise RuntimeError("already pallas-disabled")
                 env = dict(os.environ, FLAGS_disable_pallas="1")
+                retry_cmd = [sys.executable, os.path.abspath(__file__)]
+                if telemetry:
+                    retry_cmd.append(_TELEMETRY_FLAG)
                 r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
+                    retry_cmd,
                     capture_output=True, text=True, timeout=900, env=env)
                 for line in reversed(r.stdout.splitlines()):
                     line = line.strip()
@@ -459,9 +514,12 @@ def main() -> None:
             # CPU fallback needs a fresh process: this one holds a live
             # TPU backend and possibly poisoned device state.
             try:
+                cpu_cmd = [sys.executable, os.path.abspath(__file__),
+                           "--force-cpu"]
+                if telemetry:
+                    cpu_cmd.append(_TELEMETRY_FLAG)
                 r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--force-cpu"],
+                    cpu_cmd,
                     capture_output=True, text=True, timeout=600)
                 for line in reversed(r.stdout.splitlines()):
                     line = line.strip()
@@ -493,7 +551,7 @@ def main() -> None:
     # so an in-process forced-CPU run is safe.
     force_cpu_mesh(1)
     try:
-        result = run_bench(degraded=True, note=note)
+        result = run_bench(degraded=True, note=note, telemetry=telemetry)
         _emit_secondaries_degraded()  # trend data even on the proxy
         _emit(result)
     except Exception as e:

@@ -54,7 +54,7 @@ def __getattr__(name):
             "distribution", "sparse", "text", "audio", "quantization",
             "geometric", "fft", "signal", "linalg", "regularizer",
             "static", "inference", "onnx", "utils", "sysconfig", "hub",
-            "cost_model", "dataset", "reader"}
+            "cost_model", "dataset", "reader", "observability"}
     if name in lazy:
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core import flags
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
+from ..observability import metrics as _metrics
 from . import topology as topo_mod
 
 __all__ = [
@@ -132,13 +133,20 @@ def _eager_collective(name, x, group, per_shard_fn, out_sharding_spec=None):
     mesh = g.mesh
     axis = g.axis
     val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-    from jax import shard_map
+    try:  # jax>=0.5 exports shard_map at top level
+        from jax import shard_map
+    except ImportError:  # jax 0.4.x: experimental namespace
+        from jax.experimental.shard_map import shard_map
 
     in_spec = _infer_spec(val, mesh, axis)
     out_spec = out_sharding_spec if out_sharding_spec is not None else in_spec
 
-    fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
-                   out_specs=out_spec, check_vma=False)
+    try:
+        fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
+                       out_specs=out_spec, check_vma=False)
+    except TypeError:  # jax 0.4.x spells the replication check check_rep
+        fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
+                       out_specs=out_spec, check_rep=False)
     return apply(name, fn, x if isinstance(x, Tensor) else Tensor(val))
 
 
@@ -152,6 +160,7 @@ def _infer_spec(val, mesh, axis):
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    _metrics.inc("collective.calls", kind="all_reduce")
     g = _default_group(group)
     axis = g.axis
     if flags.in_trace():
@@ -176,6 +185,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    _metrics.inc("collective.calls", kind="all_gather")
     g = _default_group(group)
     ax = g.axis
 
@@ -209,6 +219,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    _metrics.inc("collective.calls", kind="reduce_scatter")
     g = _default_group(group)
     ax = g.axis
     src = tensor_or_tensor_list
@@ -232,6 +243,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    _metrics.inc("collective.calls", kind="alltoall")
     g = _default_group(group)
     ax = g.axis
     from .. import ops
@@ -257,6 +269,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
+    _metrics.inc("collective.calls", kind="alltoall_single")
     g = _default_group(group)
     ax = g.axis
 
@@ -277,6 +290,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 def broadcast(tensor, src=0, group=None, sync_op=True):
     # single-controller: values are already consistent; inside shard_map the
     # source shard's value is selected
+    _metrics.inc("collective.calls", kind="broadcast")
     g = _default_group(group)
     ax = g.axis
     if flags.in_trace() or _axis_bound(ax):
@@ -303,6 +317,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def send(tensor, dst=0, group=None, sync_op=True):
     """Point-to-point on TPU = ppermute along the pp/mesh axis; outside SPMD
     tracing this is the pipeline runner's device_put (see parallel/pipeline)."""
+    _metrics.inc("collective.calls", kind="send")
     g = _default_group(group)
     if flags.in_trace():
         ax = g.axis
@@ -321,6 +336,7 @@ irecv = recv
 
 
 def barrier(group=None):
+    _metrics.inc("collective.calls", kind="barrier")
     for d in jax.local_devices():
         try:
             jax.device_put(0, d).block_until_ready()

@@ -45,7 +45,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:  # jax>=0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["stack_stages", "spmd_pipeline", "spmd_pipeline_reference"]
@@ -129,8 +132,15 @@ def _compiled_pipeline(stage_fn, mesh, axis, pp, remat_stage, treedef):
         # the tick output is (per-device activations differ), and scan
         # requires carry-in/out types — including the vma component —
         # to match
-        act0 = jax.lax.pcast(jnp.zeros_like(xloc[0]), axis, to="varying")
-        ys0 = jax.lax.pcast(jnp.zeros_like(xloc), axis, to="varying")
+        if hasattr(jax.lax, "pcast"):
+            act0 = jax.lax.pcast(jnp.zeros_like(xloc[0]), axis,
+                                 to="varying")
+            ys0 = jax.lax.pcast(jnp.zeros_like(xloc), axis, to="varying")
+        else:
+            # jax 0.4.x has no varying-manual-axes tracking (check_rep
+            # era): the carries need no vma marking there
+            act0 = jnp.zeros_like(xloc[0])
+            ys0 = jnp.zeros_like(xloc)
 
         def tick(carry, t):
             act, ys = carry
@@ -161,10 +171,25 @@ def _compiled_pipeline(stage_fn, mesh, axis, pp, remat_stage, treedef):
 
     pspecs = jax.tree_util.tree_unflatten(
         treedef, [P(axis)] * treedef.num_leaves)
-    return jax.jit(shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(pspecs, P()),
-        out_specs=P(),
-        axis_names=frozenset({axis}),
-    ))
+    try:
+        mapped = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(pspecs, P()),
+            out_specs=P(),
+            axis_names=frozenset({axis}),
+        )
+    except TypeError:
+        # jax 0.4.x: no axis_names — the manual-axes set is expressed as
+        # its complement via `auto` (axes left to the compiler), and its
+        # replication checker predates vma marking (mis-flags the
+        # pipeline's ppermute carries), so it is disabled
+        mapped = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(pspecs, P()),
+            out_specs=P(),
+            auto=frozenset(mesh.axis_names) - {axis},
+            check_rep=False,
+        )
+    return jax.jit(mapped)

@@ -22,12 +22,15 @@ import os
 import pickle
 
 import jax
+import jax.export  # noqa: F401  (binds jax.export on builds without the lazy attr)
 import jax.numpy as jnp
 
 from ..core import flags, rng
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
 
 
 def _sig_of(x):
@@ -122,9 +125,23 @@ class StaticFunction:
                      if l is not _DYN), training)
         compiled = self._cache.get(key)
         if compiled is None:
+            # trace-cache telemetry: a miss past the first build is a
+            # RETRACE — the silent recompile class the round-5 "44 ms
+            # IDLE per step" hunt chased by hand.  Counted, and the
+            # triggering signature lands in the flight recorder.
+            _metrics.inc("jit.trace_cache.miss")
+            if self._cache:
+                _metrics.inc("jit.retrace")
+                _flight.record(
+                    "jit.retrace",
+                    fn=getattr(self._fn, "__name__", "fn"),
+                    n_cached=len(self._cache),
+                    dyn_sig=repr(key[0])[:200])
             compiled = self._build(treedef, static_leaves, len(dyn_idx),
                                    training)
             self._cache[key] = compiled
+        else:
+            _metrics.inc("jit.trace_cache.hit")
         self._last_concrete = (compiled, treedef, static_leaves, dyn_idx)
 
         params, buffers = self._collect_state()

@@ -94,6 +94,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if _sdp_policy["flash"] and _pl.flash_attention_available(q):
             return _pl.flash_attention_fwd(q, k, v, m, is_causal,
                                            bias_grad_safe=mask_sg)
+        if _sdp_policy["flash"]:
+            # flash requested but unavailable for this input/backend —
+            # the dispatch-tier fallback that used to be silent
+            from ...observability import metrics as _obs_metrics
+
+            _obs_metrics.inc("flash.dispatch", tier="fallback")
+            _obs_metrics.inc("flash.fallback_reason",
+                             reason="unavailable")
         if not _sdp_policy["math"]:
             # math disabled and flash unavailable (or also disabled):
             # falling through to the reference path would silently

@@ -1,0 +1,64 @@
+"""paddle_tpu.observability — framework-wide runtime telemetry.
+
+Three pillars (docs/OBSERVABILITY.md):
+  * `metrics`    — process-local counters / gauges / histograms with
+    labels; disabled by default, near-zero cost when disabled; JSONL
+    snapshot + Prometheus text export.  Wired into flash-attention
+    dispatch (tier + gate-reject counters), the autotune cache,
+    `jit.to_static` trace cache / retraces, collectives, and the
+    allocator peak.
+  * `step_stats` — `StepTimer` for train/serve loops and bench.py:
+    per-step wall, tokens/s, MFU, compile-time ledger, transfer bytes,
+    streamed as chip-session-compatible JSONL.
+  * `flight`     — bounded ring of recent structured events (dispatch
+    decisions, gate rejects, retraces) dumped on crash or on demand.
+
+`attach()` turns the whole stack on with a stable snapshot schema —
+what `bench.py --telemetry` calls.
+"""
+from __future__ import annotations
+
+from . import flight, metrics, step_stats  # noqa: F401
+from .step_stats import StepTimer  # noqa: F401
+
+__all__ = ["metrics", "flight", "step_stats", "StepTimer", "attach",
+           "detach"]
+
+# The snapshot-schema floor `attach()` guarantees: these counters exist
+# (at 0) in every telemetry snapshot even when the path never fired in
+# this process — a CPU bench run still reports autotune.hit == 0 rather
+# than omitting the key (ISSUE 1 acceptance schema).  Every entry here
+# carries EXACTLY the label set its live increment site uses, so the
+# declared key is the key that counts (zeros never sit next to the real
+# series under a different label set).
+_SCHEMA_COUNTERS = tuple(
+    [("flash.dispatch", {"tier": t})
+     for t in ("transpose", "kv", "flat", "mh", "fallback", "biased")]
+    + [("autotune.hit", {}), ("autotune.miss", {})]
+    + [("autotune.cross_layout_reject", {"layout": lt})
+       for lt in ("kv", "flat", "mh")]
+    + [("jit.trace_cache.hit", {}), ("jit.trace_cache.miss", {}),
+       ("jit.retrace", {})]
+    + [("collective.calls", {"kind": k})
+       for k in ("all_reduce", "all_gather", "reduce_scatter", "alltoall",
+                 "alltoall_single", "broadcast", "send", "barrier")]
+)
+
+
+def attach(crash_hook: bool = True):
+    """Enable the full telemetry stack: metrics registry on, schema
+    counters pre-declared, flight recorder on (+ crash-dump excepthook).
+    Returns the metrics registry (snapshot() it at the end of the run)."""
+    metrics.enable()
+    for name, labels in _SCHEMA_COUNTERS:
+        metrics.declare(name, **labels)
+    flight.get_recorder().enabled = True
+    if crash_hook:
+        flight.install_crash_hook()
+    return metrics.get_registry()
+
+
+def detach():
+    """Disable metric recording (flight stays on — it is cheap and the
+    crash evidence is the point).  Does not clear collected data."""
+    metrics.disable()

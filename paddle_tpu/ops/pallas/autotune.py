@@ -29,6 +29,9 @@ import numpy as np
 
 import jax
 
+from ...observability import flight as _flight
+from ...observability import metrics as _metrics
+
 _CACHE_PATH = os.environ.get(
     "PADDLE_TPU_AUTOTUNE_CACHE",
     os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
@@ -108,6 +111,32 @@ def _slope_time(f, x, n1=2, n2=8) -> float:
     return best
 
 
+def _devkind():
+    try:
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return None
+        return getattr(dev, "device_kind", dev.platform)
+    except Exception:
+        return None
+
+
+def cached_config(op: str, signature):
+    """The cached winner for (device_kind, op, signature), else None.
+    Pure lookup — never searches, never counts hit/miss (dispatch sites
+    use it to detect deliberate non-reuse, e.g. the flash layout tag's
+    cross-layout refusal)."""
+    devkind = _devkind()
+    if devkind is None:
+        return None
+    with _lock:
+        hit = _load().get(f"{devkind}|{op}|{signature}")
+    if hit is None:
+        return None
+    cfg = hit["config"]
+    return tuple(cfg) if isinstance(cfg, list) else cfg
+
+
 def pick(op: str, signature, candidates, run, default):
     """Return the fastest of `candidates` for this signature.
 
@@ -116,23 +145,28 @@ def pick(op: str, signature, candidates, run, default):
     timing can chain f inside one compiled loop (see _slope_time).
     Results are cached under (device_kind, op, signature). Falls back to
     `default` when autotune is disabled or every candidate fails.
+
+    Telemetry: cache reuse counts `autotune.hit`, a fresh search counts
+    `autotune.miss` (the search itself and its winner land in the flight
+    recorder) — the counters that make a cold or poisoned cache visible
+    instead of a silent 4x kernel slowdown (PERF.md r5).
     """
     if not _enabled() or len(candidates) <= 1:
         return default
-    try:
-        dev = jax.devices()[0]
-        if dev.platform != "tpu":
-            return default
-        devkind = getattr(dev, "device_kind", dev.platform)
-    except Exception:
+    devkind = _devkind()
+    if devkind is None:
         return default
     key = f"{devkind}|{op}|{signature}"
     with _lock:
         cache = _load()
         hit = cache.get(key)
-        if hit is not None:
-            cfg = hit["config"]
-            return tuple(cfg) if isinstance(cfg, list) else cfg
+    if hit is not None:
+        _metrics.inc("autotune.hit")
+        cfg = hit["config"]
+        return tuple(cfg) if isinstance(cfg, list) else cfg
+    _metrics.inc("autotune.miss")
+    _flight.record("autotune.search", op=op, signature=str(signature),
+                   n_candidates=len(candidates))
     # search outside the lock: candidate compiles can take seconds each
     best, best_t, timings = None, float("inf"), {}
     for cfg in candidates:
@@ -145,7 +179,12 @@ def pick(op: str, signature, candidates, run, default):
         if t < best_t:
             best, best_t = cfg, t
     if best is None:
+        _metrics.inc("autotune.search_failed")
+        _flight.record("autotune.search_failed", op=op,
+                       signature=str(signature), default=str(default))
         return default
+    _flight.record("autotune.tuned", op=op, signature=str(signature),
+                   winner=str(best), ms=timings)
     with _lock:
         cache = _load()
         cache[key] = {"config": list(best) if isinstance(best, tuple)

@@ -46,13 +46,29 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# telemetry (stdlib-only package — safe to import at kernel-module load).
+# Dispatch decisions, gate rejects, and autotune reuse all happen at
+# TRACE time, so the counters cost nothing per device step; the metrics
+# registry itself is a no-op until observability.attach() enables it.
+from ...observability import flight as _flight
+from ...observability import metrics as _metrics
+
+# jax-version compat: the deployed toolchain uses the modern pallas API
+# (CompilerParams + GridDimensionSemantics enum); older jaxlib builds
+# (0.4.x, the CPU CI image) spell them TPUCompilerParams + plain strings.
+try:
+    _PLL = pltpu.GridDimensionSemantics.PARALLEL
+    _ARB = pltpu.GridDimensionSemantics.ARBITRARY
+    _TPUCompilerParams = pltpu.CompilerParams
+except AttributeError:
+    _PLL, _ARB = "parallel", "arbitrary"
+    _TPUCompilerParams = pltpu.TPUCompilerParams
+
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
-_DIMSEM = (pltpu.GridDimensionSemantics.PARALLEL,
-           pltpu.GridDimensionSemantics.PARALLEL,
-           pltpu.GridDimensionSemantics.ARBITRARY)
+_DIMSEM = (_PLL, _PLL, _ARB)
 
 # Flash layout default: "transpose" (per-head kernels over [B,H,S,D]
 # with layout transposes around the call), "kv" (mixed: K/V/dK/dV stay
@@ -82,7 +98,7 @@ def _compiler_params():
     # accumulation carries). Interpreter mode rejects TPU compiler params.
     if _interpret():
         return None
-    return pltpu.CompilerParams(dimension_semantics=_DIMSEM)
+    return _TPUCompilerParams(dimension_semantics=_DIMSEM)
 
 
 @contextlib.contextmanager
@@ -369,9 +385,8 @@ def _fwd_mh(q, k, v, causal, block_q, block_k):
     block_k = _pick_block(sk, block_k)
     dimsem = None
     if not _interpret():
-        dimsem = pltpu.CompilerParams(dimension_semantics=(
-            pltpu.GridDimensionSemantics.PARALLEL,
-            pltpu.GridDimensionSemantics.ARBITRARY))
+        dimsem = _TPUCompilerParams(
+            dimension_semantics=(_PLL, _ARB))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_mh, scale=scale, block_k=block_k,
                           causal=causal, seq_q=sq, seq_k=sk, n_heads=h),
@@ -658,9 +673,8 @@ def _bwd_mh(q, k, v, out, lse, do, causal, block_q, block_k):
     block_k = _pick_block(sk, block_k)
     dimsem = None
     if not _interpret():
-        dimsem = pltpu.CompilerParams(dimension_semantics=(
-            pltpu.GridDimensionSemantics.PARALLEL,
-            pltpu.GridDimensionSemantics.ARBITRARY))
+        dimsem = _TPUCompilerParams(
+            dimension_semantics=(_PLL, _ARB))
     q_spec = pl.BlockSpec((None, block_q, h, d),
                           lambda bi, i: (bi, i, 0, 0))
     full_q = pl.BlockSpec((None, sq, h, d), lambda bi, i: (bi, 0, 0, 0))
@@ -885,10 +899,8 @@ def _kv_dimsem():
     # that then fail allocation (observed on-chip this round).
     if _interpret():
         return None
-    return pltpu.CompilerParams(
-        dimension_semantics=(
-            pltpu.GridDimensionSemantics.PARALLEL,
-            pltpu.GridDimensionSemantics.ARBITRARY),
+    return _TPUCompilerParams(
+        dimension_semantics=(_PLL, _ARB),
         vmem_limit_bytes=34 * 1024 * 1024)
 
 
@@ -1392,33 +1404,76 @@ _flash_core_flat.defvjp(_flash_core_flat_fwd, _flash_core_flat_bwd)
 _KV_VMEM_BOUND = 8 * 1024 * 1024
 
 
-def _kv_native_ok(q, k) -> bool:
+def _gate_reject(gate: str, reason: str, q, k, blocks) -> None:
+    """Counter + flight-recorder evidence for a kernel-tier gate reject:
+    the silent-fallback class of failure (ADVICE r5) becomes a metric
+    (`flash.gate_reject{gate,reason}`) and a ring event carrying the
+    shapes and the blocks the gate actually estimated."""
+    _metrics.inc("flash.gate_reject", gate=gate, reason=reason)
+    _flight.record("flash.gate_reject", gate=gate, reason=reason,
+                   q_shape=list(q.shape), kv_shape=list(k.shape),
+                   blocks=list(blocks))
+
+
+def _kv_native_ok(q, k, block_q=512, block_k=512, _gate="kv") -> bool:
     """VMEM feasibility of the kv-native AND flat kernels (same block
     geometry): the forward holds full K+V per batch row; the dKV kernel
     holds full-sequence q/o/do per head walk. Past the bound, the
-    transpose core (block-sliced K/V) is the safe path."""
+    transpose core (block-sliced K/V) is the safe path.
+
+    block_q/block_k are the blocks that will REALLY run (the dispatch
+    site passes the tuned values; advisor-medium r5: the old gate
+    hardcoded a 512 estimate, so 1024-tuned blocks sailed through and
+    died at Mosaic compile time).  They are resolved through _pick_block
+    exactly as the kernels will resolve them."""
     b, sq, h, d = q.shape
     sk, h_kv = k.shape[1], k.shape[2]
+    if sq % 8 != 0 or sk % 8 != 0:
+        # off-8 lengths run padded through the transpose core (the
+        # dispatch pads before gating); a direct probe gets False, not
+        # the _pick_block ValueError
+        return False
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
     esz = q.dtype.itemsize
-    fwd_bytes = 2 * sk * h_kv * d * esz + 2 * h * min(sq, 512) * d * esz
+    fwd_bytes = 2 * sk * h_kv * d * esz + 2 * h * bq * d * esz
     dkv_bytes = (3 * h * sq * d * esz + 4 * h * sq +
-                 4 * min(sk, 512) * h_kv * d * esz)
-    return max(fwd_bytes, dkv_bytes) <= _KV_VMEM_BOUND
+                 4 * bk * h_kv * d * esz)
+    if max(fwd_bytes, dkv_bytes) > _KV_VMEM_BOUND:
+        _gate_reject(_gate, "vmem", q, k, (bq, bk))
+        return False
+    return True
 
 
-def _flat_native_ok(q, k) -> bool:
-    """Eligibility of the FLAT kernels specifically: the VMEM bound of
-    _kv_native_ok plus lane alignment — the flat kernels slice per-head
-    lane windows out of an [*, H*D] block and were real-compile-proven
-    only with the flat width a multiple of the 128-lane tile; off-tile
-    widths stay on the transpose core rather than risking a server-side
-    Mosaic reject. (The kv-native kernels index 4-D [S,Hkv,D] blocks and
-    need no lane gate.)"""
+def _flat_static_ok(q, k) -> bool:
+    """Block-INDEPENDENT flat eligibility: lane alignment — the flat
+    kernels slice per-head lane windows out of an [*, H*D] block and
+    were real-compile-proven only with the flat width a multiple of the
+    128-lane tile — AND per-head slice width ``d % 64 == 0`` (the only
+    compile-proven head width; off-64 widths shape-cast inside the lane
+    slice and the deployed Mosaic rejects them).  The dispatch site
+    checks this BEFORE layout-tagged block tuning, so an ineligible
+    shape never launches an autotune search timing the flat core it can
+    never run.  Rejects surface through the flight recorder."""
     h, d = q.shape[2], q.shape[3]
     h_kv = k.shape[2]
     if (h * d) % 128 != 0 or (h_kv * d) % 128 != 0:
+        _gate_reject("flat", "lane_align", q, k, ())
         return False
-    return _kv_native_ok(q, k)
+    if d % 64 != 0:
+        _gate_reject("flat", "head_width", q, k, ())
+        return False
+    return True
+
+
+def _flat_native_ok(q, k, block_q=512, block_k=512) -> bool:
+    """Full flat-kernel eligibility: the block-independent gates of
+    _flat_static_ok plus the VMEM bound of _kv_native_ok at the blocks
+    that will really run.  (The kv-native kernels index 4-D [S,Hkv,D]
+    blocks and need neither flat-specific gate.)"""
+    if not _flat_static_ok(q, k):
+        return False
+    return _kv_native_ok(q, k, block_q, block_k, _gate="flat")
 
 
 def _layout_flag() -> str:
@@ -1614,14 +1669,23 @@ def _ref_attention(q, k, v, mask, is_causal):
 
 
 def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
-                  biased=False):
+                  biased=False, layout=None):
     """Autotuned (block_q, block_k) for this attention signature
     (paddle/phi/kernels/autotune role; cached per signature on disk).
 
     Tuned on a fwd+bwd run — training is the dominant workload and the
     same (block_q, block_k) pair parameterizes both directions through
     the custom VJP. Measured at B32 H12 S1024 D64 bf16: tuned (1024,1024)
-    fwd ≈ 1.3 ms vs 128x128 ≈ 6.0 ms (PERF.md)."""
+    fwd ≈ 1.3 ms vs 128x128 ≈ 6.0 ms (PERF.md).
+
+    layout: the kernel tier that will consume the blocks.  kv/flat/mh
+    layouts tune under their OWN cache signature (``|Lkv`` etc.) —
+    advisor-low r5: the kv/flat cores have different VMEM geometry than
+    the transpose core, so silently reusing transpose-tuned blocks is
+    wrong.  A transpose-tuned entry existing while the layout entry is
+    cold is counted as `autotune.cross_layout_reject` (the refusal is
+    deliberate and now visible).  `layout=None`/"transpose" keeps the
+    original signature, so existing on-disk caches stay valid."""
     from . import autotune
 
     # curated candidate pairs, preference-ordered by the round-5 hardware
@@ -1668,6 +1732,8 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
     if len(cands) <= 1:
         return default
 
+    lt = layout if layout in ("kv", "flat", "mh") else None
+
     def run(cfg):
         # concrete dummy data, same signature; the returned (f, x) pair
         # chains fwd+bwd inside autotune's one-dispatch timing loop
@@ -1685,15 +1751,32 @@ def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,
                 return _flash_core_b(qv, kv, vv, bias_v, causal, cfg[0],
                                      cfg[1]).astype(jnp.float32).sum()
         else:
+            # per-layout signatures time the layout's OWN core — caching
+            # transpose-core timings under a kv/flat key would be the
+            # same silent mismatch the layout tag exists to prevent
+            core = {"kv": _flash_core_kv, "flat": _flash_core_flat,
+                    "mh": _flash_core_mh}.get(lt, _flash_core)
+
             def loss(qv):
-                return _flash_core(qv, kv, vv, causal, cfg[0],
-                                   cfg[1]).astype(jnp.float32).sum()
+                return core(qv, kv, vv, causal, cfg[0],
+                            cfg[1]).astype(jnp.float32).sum()
 
         return jax.grad(loss), qv
 
     sig = (f"{b}x{sq}x{sk}x{h}x{d}|{jnp.dtype(dtype).name}|c{int(causal)}"
            + (f"|kv{h_kv}" if h_kv and h_kv != h else "")
            + ("|bias" if biased else ""))
+    if lt:
+        # layout-tagged signature; a transpose-tuned winner for the same
+        # shape is NOT reused (it was measured on different kernels) —
+        # count the refusal so cold layout caches are visible
+        lsig = sig + f"|L{lt}"
+        if autotune.cached_config("flash_fwdbwd", lsig) is None and \
+                autotune.cached_config("flash_fwdbwd", sig) is not None:
+            _metrics.inc("autotune.cross_layout_reject", layout=lt)
+            _flight.record("autotune.cross_layout_reject", layout=lt,
+                           signature=sig)
+        sig = lsig
     return autotune.pick("flash_fwdbwd", sig, cands, run, default)
 
 
@@ -1715,6 +1798,8 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
     if mask is not None:
         if not (flash_attention_available(q) and bias_grad_safe
                 and _biased_flash_ok(q, k, mask)):
+            _metrics.inc("flash.dispatch", tier="fallback")
+            _metrics.inc("flash.fallback_reason", reason="biased_gate")
             return _ref_attention(q, k, v, mask, is_causal)
         bias = mask
         if bias.dtype == jnp.bool_:
@@ -1732,10 +1817,17 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
         sk_arr = k.shape[1]
         final_bk = _pick_block(sk_arr, block_k)
         if final_bk % 128 != 0 and final_bk != sk_arr:
+            _gate_reject("biased", "bias_block_k", q, k,
+                         (block_q, final_bk))
+            _metrics.inc("flash.dispatch", tier="fallback")
+            _metrics.inc("flash.fallback_reason", reason="bias_block_k")
             return _ref_attention(q, k, v, mask, is_causal)
+        _metrics.inc("flash.dispatch", tier="biased")
         return _flash_core_b(q, k, v, bias, bool(is_causal), block_q,
                              final_bk)
     if not flash_attention_available(q):
+        _metrics.inc("flash.dispatch", tier="fallback")
+        _metrics.inc("flash.fallback_reason", reason="unavailable")
         return _ref_attention(q, k, v, mask, is_causal)
     if k.shape[2] != q.shape[2]:
         # GQA feasibility: the grouped dK/dV kernel keeps a KV head's
@@ -1765,25 +1857,65 @@ def flash_attention_fwd(q, k, v, mask=None, is_causal=False,
         q = jnp.pad(q, widths(pad_q))
         k = jnp.pad(k, widths(pad_k))
         v = jnp.pad(v, widths(pad_k))
-    if block_q is None or block_k is None:
+    # tier intent from the layout flag (before block tuning: kv/flat/mh
+    # blocks tune under their own layout-tagged autotune signature)
+    layout = _layout_flag()
+    if pad_q or pad_k:
+        intended = "transpose"  # padded shapes run the transpose core
+    elif layout == "mh" and k.shape[2] == q.shape[2]:
+        intended = "mh"  # the mh core is MHA-only; GQA stays grouped
+    elif layout in ("flat", "auto"):
+        # block-independent flat gates run BEFORE layout-tagged tuning:
+        # an off-gate shape must not launch an autotune search that
+        # times (and on TPU, Mosaic-compiles) the flat core it can
+        # never run (review finding on the r6 dispatch restructure)
+        intended = "flat" if _flat_static_ok(q, k) else "transpose"
+    elif layout == "kv":
+        intended = "kv"
+    else:
+        intended = "transpose"
+
+    user_bq, user_bk = block_q, block_k
+
+    def _resolve(tag):
         bq, bk = _tuned_blocks(q.shape[0], q.shape[1], k.shape[1],
                                q.shape[2], q.shape[3], q.dtype,
-                               bool(is_causal), h_kv=k.shape[2])
-        block_q = block_q or bq
-        block_k = block_k or bk
+                               bool(is_causal), h_kv=k.shape[2],
+                               layout=tag)
+        return (user_bq if user_bq is not None else bq,
+                user_bk if user_bk is not None else bk)
+
+    if user_bq is None or user_bk is None:
+        block_q, block_k = _resolve(intended)
     if pad_q or pad_k:
+        _metrics.inc("flash.dispatch", tier="transpose")
         out = _flash_core(q, k, v, bool(is_causal), block_q, block_k,
                           sq, sk)
         return out[:, :sq]
-    layout = _layout_flag()
-    if layout == "mh" and k.shape[2] == q.shape[2]:
-        # the mh core is MHA-only; GQA takes the grouped transpose core
+    if intended == "mh":
+        _metrics.inc("flash.dispatch", tier="mh")
         return _flash_core_mh(q, k, v, bool(is_causal), block_q, block_k)
-    if layout in ("flat", "auto") and _flat_native_ok(q, k):
-        # flat-native: unpadded [B,S,H*D] views, zero transposes
-        return _flash_core_flat(q, k, v, bool(is_causal), block_q,
-                                block_k)
-    if layout == "kv" and _kv_native_ok(q, k):
-        # mixed layout: K/V/dK/dV never transpose (GQA-native via rep)
-        return _flash_core_kv(q, k, v, bool(is_causal), block_q, block_k)
+    # the VMEM gates estimate with the blocks that will REALLY run (the
+    # tuned values above, resolved via _pick_block exactly as the kernels
+    # resolve them) — advisor-medium r5; gate rejects fall back to the
+    # transpose core with transpose-signature blocks
+    if intended == "flat":
+        # static gates already passed above; only the block-dependent
+        # VMEM bound remains
+        if _kv_native_ok(q, k, block_q, block_k, _gate="flat"):
+            # flat-native: unpadded [B,S,H*D] views, zero transposes
+            _metrics.inc("flash.dispatch", tier="flat")
+            return _flash_core_flat(q, k, v, bool(is_causal), block_q,
+                                    block_k)
+        if user_bq is None or user_bk is None:
+            block_q, block_k = _resolve("transpose")
+    elif intended == "kv":
+        if _kv_native_ok(q, k, block_q, block_k):
+            # mixed layout: K/V/dK/dV never transpose (GQA-native via rep)
+            _metrics.inc("flash.dispatch", tier="kv")
+            return _flash_core_kv(q, k, v, bool(is_causal), block_q,
+                                  block_k)
+        if user_bq is None or user_bk is None:
+            block_q, block_k = _resolve("transpose")
+    _metrics.inc("flash.dispatch", tier="transpose")
     return _flash_core(q, k, v, bool(is_causal), block_q, block_k)

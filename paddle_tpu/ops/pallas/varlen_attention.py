@@ -48,9 +48,9 @@ def segment_ids_from_cu_seqlens(cu_seqlens, total):
 def _dimsem():
     if _interpret():
         return None
-    return pltpu.CompilerParams(dimension_semantics=(
-        pltpu.GridDimensionSemantics.PARALLEL,
-        pltpu.GridDimensionSemantics.ARBITRARY))
+    from .flash_attention import _ARB, _PLL, _TPUCompilerParams
+
+    return _TPUCompilerParams(dimension_semantics=(_PLL, _ARB))
 
 
 def _vl_fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, *,

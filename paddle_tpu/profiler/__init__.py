@@ -16,6 +16,8 @@ import threading
 import time
 from enum import Enum
 
+from ..observability import metrics as _obs_metrics
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
 
@@ -59,10 +61,16 @@ _recorder = _HostEventRecorder()
 
 
 class RecordEvent:
-    """User scope marker (platform::RecordEvent parity)."""
+    """User scope marker (platform::RecordEvent parity).
+
+    Doubles as the observability scope boundary: while the span is open,
+    metrics recorded on this thread (and flight-recorder events / step
+    records) are tagged ``scope=<name>`` — the RecordEvent ↔ telemetry
+    integration from docs/OBSERVABILITY.md."""
 
     def __init__(self, name, event_type=None):
         self.name = name
+        self._scope_token = None
 
     def __enter__(self):
         self.begin()
@@ -73,10 +81,14 @@ class RecordEvent:
 
     def begin(self):
         self._t0 = time.perf_counter_ns()
+        self._scope_token = _obs_metrics.push_scope(self.name)
 
     def end(self):
         _recorder.add(self.name, self._t0, time.perf_counter_ns(),
                       threading.get_ident())
+        if self._scope_token is not None:
+            _obs_metrics.pop_scope(self._scope_token)
+            self._scope_token = None
 
 
 def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
@@ -87,9 +99,13 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
         if s < 0:
             return ProfilerState.CLOSED
         period = closed + ready + record
+        if period == 0:
+            # degenerate schedule (record=0 and nothing else): there is
+            # never anything to record — CLOSED, not a perpetual RECORD
+            return ProfilerState.CLOSED
         if repeat and s >= period * repeat:
             return ProfilerState.CLOSED
-        pos = s % period if period else 0
+        pos = s % period
         if pos < closed:
             return ProfilerState.CLOSED
         if pos < closed + ready:

@@ -13,6 +13,7 @@ import os
 import pickle
 
 import jax
+import jax.export  # noqa: F401  (binds jax.export on builds without the lazy attr)
 import jax.numpy as jnp
 import numpy as np
 

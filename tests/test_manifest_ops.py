@@ -15,8 +15,12 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 
 def test_manifest_no_drift_and_coverage():
-    from gen_op_manifest import generate
+    from gen_op_manifest import REF, generate
 
+    if not os.path.exists(REF):
+        pytest.skip("reference checkout not available on this host — "
+                    "the manifest regenerates from the reference op "
+                    "inventory (tools/gen_op_manifest.py REF)")
     with open(os.path.join(REPO, "OPS_MANIFEST.json")) as f:
         recorded = json.load(f)
     current = generate()

@@ -242,37 +242,42 @@ def test_c_inference_api(tmp_path):
 
     static.reset_default_programs()
     P.enable_static()
-    x = static.data("x", [-1, 4], "float32")
-    lin = nn.Linear(4, 3)
-    out = lin(x)
-    exe = static.Executor()
-    prefix = str(tmp_path / "cmodel")
-    static.save_inference_model(prefix, [x], [out], exe)
+    try:
+        x = static.data("x", [-1, 4], "float32")
+        lin = nn.Linear(4, 3)
+        out = lin(x)
+        exe = static.Executor()
+        prefix = str(tmp_path / "cmodel")
+        static.save_inference_model(prefix, [x], [out], exe)
 
-    lib = capi.load()
-    h = lib.PD_PredictorCreate(prefix.encode())
-    assert h > 0, lib.PD_LastError().decode()
-    assert lib.PD_PredictorInputNum(h) == 1
-    assert lib.PD_PredictorOutputNum(h) == 1
-    buf = ctypes.create_string_buffer(64)
-    n = lib.PD_PredictorInputName(h, 0, buf, 64)
-    assert n > 0 and buf.value == b"x"
+        lib = capi.load()
+        h = lib.PD_PredictorCreate(prefix.encode())
+        assert h > 0, lib.PD_LastError().decode()
+        assert lib.PD_PredictorInputNum(h) == 1
+        assert lib.PD_PredictorOutputNum(h) == 1
+        buf = ctypes.create_string_buffer(64)
+        n = lib.PD_PredictorInputName(h, 0, buf, 64)
+        assert n > 0 and buf.value == b"x"
 
-    xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
-    td_in = capi.np_to_td(xv)
-    outs = (capi.PD_TensorData * 4)()
-    n_out = lib.PD_PredictorRun(h, ctypes.byref(td_in), 1, outs, 4)
-    assert n_out == 1, lib.PD_LastError().decode()
-    got = capi.td_to_np(outs[0])
-    lib.PD_ReleaseOutputs(outs, n_out)
+        xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        td_in = capi.np_to_td(xv)
+        outs = (capi.PD_TensorData * 4)()
+        n_out = lib.PD_PredictorRun(h, ctypes.byref(td_in), 1, outs, 4)
+        assert n_out == 1, lib.PD_LastError().decode()
+        got = capi.td_to_np(outs[0])
+        lib.PD_ReleaseOutputs(outs, n_out)
 
-    (ref,) = exe.run(feed={"x": xv}, fetch_list=[out])
-    np.testing.assert_allclose(got, ref, rtol=1e-5)
+        (ref,) = exe.run(feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
 
-    # error surface: bad handle
-    assert lib.PD_PredictorRun(9999, ctypes.byref(td_in), 1, outs, 4) < 0
-    assert b"9999" in lib.PD_LastError() or lib.PD_LastError()
-    assert lib.PD_PredictorDestroy(h) == 1
-    assert lib.PD_PredictorDestroy(h) == 0
-    P.disable_static()
-    static.reset_default_programs()
+        # error surface: bad handle
+        assert lib.PD_PredictorRun(9999, ctypes.byref(td_in), 1, outs,
+                                   4) < 0
+        assert b"9999" in lib.PD_LastError() or lib.PD_LastError()
+        assert lib.PD_PredictorDestroy(h) == 1
+        assert lib.PD_PredictorDestroy(h) == 0
+    finally:
+        # a mid-test failure must not leave global static mode on —
+        # it silently breaks every later dygraph/SOT test in the run
+        P.disable_static()
+        static.reset_default_programs()

@@ -453,7 +453,7 @@ def test_flash_kv_native_dispatch_gate(monkeypatch):
     """FLAGS_flash_layout=kv routes eligible unpadded shapes through the
     kv-native core and leaves VMEM-infeasible shapes on the transpose
     path (_kv_native_ok)."""
-    B, S, H, D = 2, 128, 4, 32
+    B, S, H, D = 2, 128, 2, 64
     q = _rand((B, S, H, D))
     assert fa._kv_native_ok(q, q)
     big = jax.ShapeDtypeStruct((1, 8192, 32, 128), jnp.bfloat16)
@@ -463,7 +463,7 @@ def test_flash_kv_native_dispatch_gate(monkeypatch):
         dtype = jnp.dtype(jnp.bfloat16)
 
     assert not fa._kv_native_ok(_Fake(), _Fake())
-    assert fa._flat_native_ok(q, q)  # H*D = 128: lane-aligned
+    assert fa._flat_native_ok(q, q)  # H*D = 128: lane-aligned, D%64==0
 
     class _OffTile:  # H*D = 64 — below the 128-lane tile
         shape = (2, 128, 4, 16)
@@ -471,6 +471,24 @@ def test_flash_kv_native_dispatch_gate(monkeypatch):
 
     assert fa._kv_native_ok(_OffTile(), _OffTile())  # kv: no lane gate
     assert not fa._flat_native_ok(_OffTile(), _OffTile())
+
+    class _OffHead:  # H*D = 128 lane-aligned but D=32: not compile-proven
+        shape = (2, 128, 4, 32)
+        dtype = jnp.dtype(jnp.bfloat16)
+
+    assert fa._kv_native_ok(_OffHead(), _OffHead())  # kv: no width gate
+    assert not fa._flat_native_ok(_OffHead(), _OffHead())
+
+    class _Mid:  # VMEM-borderline: feasible at 512 blocks, not at 1024
+        shape = (1, 1024, 12, 64)
+        dtype = jnp.dtype(jnp.bfloat16)
+
+    # advisor-medium r5: the gate estimates with the blocks that will
+    # REALLY run — tuned 1024-blocks must be gated as 1024, not as the
+    # old hardcoded 512 estimate
+    assert fa._kv_native_ok(_Mid(), _Mid(), 512, 512)
+    assert not fa._kv_native_ok(_Mid(), _Mid(), 1024, 1024)
+
     monkeypatch.setenv("FLAGS_flash_layout", "kv")
     # on CPU the public entry routes to the reference path
     # (flash_attention_available gates on TPU); force the interpreter
@@ -868,9 +886,10 @@ def test_train_step_layout_parity(monkeypatch):
     monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
     monkeypatch.setattr(_pl, "flash_attention_available",
                         lambda q_: True)
-    # hidden 128 / 4 heads -> head_dim 32, H*D = 128: satisfies the
-    # lane-alignment eligibility gate (_kv_native_ok) so kv/flat route
-    kw = dict(vocab_size=211, hidden_size=128, num_layers=2, num_heads=4,
+    # hidden 128 / 2 heads -> head_dim 64, H*D = 128: satisfies both the
+    # lane-alignment gate AND the d%64 head-width gate (_flat_native_ok)
+    # so kv/flat route
+    kw = dict(vocab_size=211, hidden_size=128, num_layers=2, num_heads=2,
               max_seq_len=32, dropout=0.0, attn_dropout=0.0)
     losses = {}
     routed = {}

@@ -14,6 +14,7 @@ Reference parity: kernels are compiled and run on-device in CI
 import functools
 
 import jax
+import jax.export  # noqa: F401  (binds jax.export on builds without the lazy attr)
 import jax.numpy as jnp
 import pytest
 

@@ -2,10 +2,15 @@
 
 The watcher auto-commits raw capture data; this turns it into the
 PERF.md-style tables: one section per phase, latest entry per unique
-key, errors listed last. Run: python tools/analyze_chip_log.py
+key, errors listed last.  `step_stats` entries (the observability
+StepTimer stream, docs/OBSERVABILITY.md) get schema validation plus a
+per-run summary (compile ledger vs steady walls, tokens/s, MFU) instead
+of the latest-entry-wins table.  Run: python tools/analyze_chip_log.py
+[log.jsonl]
 """
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import sys
@@ -13,6 +18,22 @@ from collections import OrderedDict
 
 LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "chip_session_log.jsonl")
+
+
+def _load_step_stats_module():
+    """File-load observability/step_stats.py (stdlib-only module by
+    contract) so this tool works without importing jax-heavy
+    paddle_tpu."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "paddle_tpu", "observability",
+                        "step_stats.py")
+    spec = importlib.util.spec_from_file_location("_step_stats", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_step_stats = _load_step_stats_module()
 
 
 def load(path=LOG):
@@ -32,13 +53,17 @@ def load(path=LOG):
     return entries
 
 
-def digest(entries):
+def digest(entries, schema_errors=None):
     phases: "OrderedDict[str, OrderedDict]" = OrderedDict()
     errors = []
+    step_entries = []
     for e in entries:
         ph = e.get("phase", "?")
         if "error" in e:
             errors.append((ph, e.get("t", ""), e["error"]))
+            continue
+        if ph == _step_stats.STEP_PHASE:
+            step_entries.append(e)
             continue
         if e.get("done"):
             continue
@@ -56,6 +81,16 @@ def digest(entries):
                     if k not in ("phase", "t")}
             lines.append(f"- `{e.get('t', '')}` "
                          + json.dumps(body, default=str))
+    if step_entries:
+        lines.append(f"\n## step_stats  ({len(step_entries)} records)\n")
+        if schema_errors is None:
+            schema_errors = _step_stats.validate_stream(step_entries)
+        if schema_errors:
+            lines.append(f"**schema errors ({len(schema_errors)}):**")
+            for err in schema_errors[:20]:
+                lines.append(f"- {err}")
+        for run_id, s in _step_stats.summarize_stream(step_entries).items():
+            lines.append(f"- **{run_id}**: " + json.dumps(s, default=str))
     if errors:
         lines.append(f"\n## errors ({len(errors)})\n")
         for ph, t, err in errors[-30:]:
@@ -63,6 +98,15 @@ def digest(entries):
     return "\n".join(lines) or "(log empty)"
 
 
+def main(argv):
+    path = argv[1] if len(argv) > 1 else LOG
+    entries = load(path)
+    # validate once; digest renders the same result and the exit code
+    # makes a corrupt step-stats stream fail loudly in CI contexts
+    errors = _step_stats.validate_stream(entries)
+    print(digest(entries, schema_errors=errors))
+    return 1 if errors else 0
+
+
 if __name__ == "__main__":
-    path = sys.argv[1] if len(sys.argv) > 1 else LOG
-    print(digest(load(path)))
+    sys.exit(main(sys.argv))
