@@ -196,6 +196,17 @@ def run_bench(degraded: bool = False, note: str = "",
             "metrics": obs.metrics.snapshot(),
             "step_stats": timer.summary(),
         }
+        # merged Perfetto timeline: the tracer buffer already correlates
+        # compile spans (cost_analysis-annotated), flight instants, and
+        # step frames — one export IS the merged trace (ISSUE 2
+        # acceptance).  Opt-in via env so plain --telemetry runs stay
+        # single-file JSON.
+        trace_path = os.environ.get("BENCH_TRACE")
+        if trace_path:
+            try:
+                result["trace_file"] = obs.trace.export(trace_path)
+            except OSError as e:
+                print(f"trace-export-failed: {e}", file=sys.stderr)
     return result
 
 

@@ -27,6 +27,7 @@ from ..core import flags
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from ..observability import metrics as _metrics
+from ..observability import trace as _trace
 from . import topology as topo_mod
 
 __all__ = [
@@ -147,7 +148,13 @@ def _eager_collective(name, x, group, per_shard_fn, out_sharding_spec=None):
     except TypeError:  # jax 0.4.x spells the replication check check_rep
         fn = shard_map(per_shard_fn, mesh=mesh, in_specs=(in_spec,),
                        out_specs=out_spec, check_rep=False)
-    return apply(name, fn, x if isinstance(x, Tensor) else Tensor(val))
+    # span wrapper (timeline correlation): the eager collective is a
+    # host-dispatched program, so its wall is a real slice on the trace;
+    # the SPMD path compiles into the surrounding program and is covered
+    # by that program's compile span instead
+    with _trace.span(name, cat="collective", axis=axis,
+                     shape=list(getattr(val, "shape", ()))):
+        return apply(name, fn, x if isinstance(x, Tensor) else Tensor(val))
 
 
 def _infer_spec(val, mesh, axis):

@@ -38,6 +38,7 @@ from ..core import flags
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..nn.layers_common import Sequential
+from ..observability import trace as _trace
 from . import topology as topo_mod
 from .train_step import param_placements
 
@@ -468,28 +469,36 @@ class PipelineParallel:
             order = self._schedule_fthenb(m)
         for (i, op, mb) in order:
             st = self.stages[i]
-            if op == "F":
-                if i == 0:
-                    xin = st.to_mesh(mb_x[mb])
+            # stage-op span: each F/B micro-step is a slice on the trace
+            # timeline, so the schedule's real (host-dispatch) shape —
+            # warmup ramp, 1F1B steady state, drain — is visible per
+            # chunk/microbatch in Perfetto
+            with _trace.span(
+                    f"pp.stage{i}.{'fwd' if op == 'F' else 'bwd'}",
+                    cat="pipeline", chunk=i, mb=mb):
+                if op == "F":
+                    if i == 0:
+                        xin = st.to_mesh(mb_x[mb])
+                    else:
+                        xin = st.to_mesh(outs[(i - 1, mb)])
+                    acts[(i, mb)] = xin
+                    lab = st.to_mesh(mb_y[mb]) if st.is_last else None
+                    out = st.forward(xin, lab)
+                    outs[(i, mb)] = out
+                    if st.is_last:
+                        losses.append(out)
                 else:
-                    xin = st.to_mesh(outs[(i - 1, mb)])
-                acts[(i, mb)] = xin
-                lab = st.to_mesh(mb_y[mb]) if st.is_last else None
-                out = st.forward(xin, lab)
-                outs[(i, mb)] = out
-                if st.is_last:
-                    losses.append(out)
-            else:
-                if st.is_last:
-                    gx = st.backward(acts[(i, mb)], None, st.to_mesh(mb_y[mb]))
-                else:
-                    gy = self.stages[i].to_mesh(gys[(i, mb)])
-                    gx = st.backward(acts[(i, mb)], gy)
-                if i > 0:
-                    gys[(i - 1, mb)] = gx
-                # free activations for this microbatch at this stage
-                acts.pop((i, mb), None)
-                outs.pop((i, mb), None)
+                    if st.is_last:
+                        gx = st.backward(acts[(i, mb)], None,
+                                         st.to_mesh(mb_y[mb]))
+                    else:
+                        gy = self.stages[i].to_mesh(gys[(i, mb)])
+                        gx = st.backward(acts[(i, mb)], gy)
+                    if i > 0:
+                        gys[(i - 1, mb)] = gx
+                    # free activations for this microbatch at this stage
+                    acts.pop((i, mb), None)
+                    outs.pop((i, mb), None)
 
         # optimizer step per stage (grads averaged over micro-batches)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)

@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import flags, rng
 from ..core.tensor import Tensor
+from ..observability import xla_cost as _xla_cost
 from . import topology as topo_mod
 
 __all__ = ["DistributedTrainStep", "param_placements",
@@ -280,8 +281,13 @@ class DistributedTrainStep:
             return loss, new_params, new_opt, new_buffers, new_key
 
         self._step_fn = step
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3),
-                       compiler_options=flags.jit_compiler_options())
+        # with telemetry on, the compile happens inside an
+        # `xla.compile:train_step` span annotated with cost_analysis
+        # FLOPs/bytes (plain jit call otherwise)
+        return _xla_cost.instrument(
+            jax.jit(step, donate_argnums=(0, 1, 2, 3),
+                    compiler_options=flags.jit_compiler_options()),
+            label="train_step")
 
     def _build_multi(self, batch_treedef, is_repeat):
         """N steps in ONE compiled program: lax.scan over the leading batch
@@ -312,8 +318,10 @@ class DistributedTrainStep:
                 body, (params, opt_state, buffers, key), xs)
             return losses, p, o, b, k
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2, 3),
-                       compiler_options=flags.jit_compiler_options())
+        return _xla_cost.instrument(
+            jax.jit(multi, donate_argnums=(0, 1, 2, 3),
+                    compiler_options=flags.jit_compiler_options()),
+            label="train_step_multi")
 
     def run_steps(self, *batch, lrs=None, repeat=None):
         """Run one optimizer step per leading-axis slice of `batch` (every

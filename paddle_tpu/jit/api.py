@@ -22,15 +22,16 @@ import os
 import pickle
 
 import jax
-import jax.export  # noqa: F401  (binds jax.export on builds without the lazy attr)
 import jax.numpy as jnp
 
 from ..core import flags, rng
 from ..core.dispatch import apply
+from ..core.export_compat import get_jax_export
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
+from ..observability import xla_cost as _xla_cost
 
 
 def _sig_of(x):
@@ -110,7 +111,13 @@ class StaticFunction:
                 is_leaf=lambda x: isinstance(x, Tensor))
             return out_vals, new_buffers, new_key
 
-        return jax.jit(pure)
+        # compile-cost capture: with telemetry on, the first call per
+        # signature AOT-compiles inside an `xla.compile:jit::<fn>` span
+        # carrying cost_analysis FLOPs/bytes; with telemetry off (or
+        # under an outer trace) this is a plain jit call
+        return _xla_cost.instrument(
+            jax.jit(pure),
+            label=f"jit::{getattr(self._fn, '__name__', 'fn')}")
 
     def __call__(self, *args, **kwargs):
         leaves, treedef = jax.tree_util.tree_flatten(
@@ -226,6 +233,8 @@ def save(layer, path, input_spec=None, **configs):
     Parity: `paddle.jit.save` (program + persistables); the exported artifact
     is the AOT analog of the saved ProgramDesc.
     """
+    if input_spec is not None:
+        get_jax_export()  # fail before writing partial artifacts
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     from ..framework.io_utils import save as fsave
 
@@ -262,7 +271,7 @@ def save(layer, path, input_spec=None, **configs):
         was_training = target.training
         target.eval()
         try:
-            exp = jax.export.export(jax.jit(pure))(
+            exp = get_jax_export().export(jax.jit(pure))(
                 jax.tree_util.tree_map(
                     lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params),
                 jax.tree_util.tree_map(
@@ -318,7 +327,7 @@ def load(path, **configs):
             meta = pickle.load(f)
     if meta.get("exported") and os.path.exists(path + ".pdmodel"):
         with open(path + ".pdmodel", "rb") as f:
-            exp = jax.export.deserialize(bytearray(f.read()))
+            exp = get_jax_export().deserialize(bytearray(f.read()))
         return TranslatedLayer(exp, state, rng.default_generator.get_state(),
                                meta.get("param_names", ()),
                                meta.get("buffer_names", ()))

@@ -12,17 +12,23 @@ Three pillars (docs/OBSERVABILITY.md):
     streamed as chip-session-compatible JSONL.
   * `flight`     — bounded ring of recent structured events (dispatch
     decisions, gate rejects, retraces) dumped on crash or on demand.
+  * `trace`      — unified span timeline (Chrome trace-event / Perfetto
+    export): RecordEvent scopes, flight events, StepTimer frames,
+    collective/pipeline-stage spans, and compile spans annotated by
+    `xla_cost` all land in ONE correlated buffer.
+  * `xla_cost`   — compile-time `cost_analysis()`/`memory_analysis()`
+    capture: FLOPs/bytes per compiled program as span metadata + gauges.
 
 `attach()` turns the whole stack on with a stable snapshot schema —
 what `bench.py --telemetry` calls.
 """
 from __future__ import annotations
 
-from . import flight, metrics, step_stats  # noqa: F401
+from . import flight, metrics, step_stats, trace, xla_cost  # noqa: F401
 from .step_stats import StepTimer  # noqa: F401
 
-__all__ = ["metrics", "flight", "step_stats", "StepTimer", "attach",
-           "detach"]
+__all__ = ["metrics", "flight", "step_stats", "trace", "xla_cost",
+           "StepTimer", "attach", "detach"]
 
 # The snapshot-schema floor `attach()` guarantees: these counters exist
 # (at 0) in every telemetry snapshot even when the path never fired in
@@ -47,18 +53,22 @@ _SCHEMA_COUNTERS = tuple(
 
 def attach(crash_hook: bool = True):
     """Enable the full telemetry stack: metrics registry on, schema
-    counters pre-declared, flight recorder on (+ crash-dump excepthook).
-    Returns the metrics registry (snapshot() it at the end of the run)."""
+    counters pre-declared, flight recorder on (+ crash-dump excepthook),
+    span tracer buffering.  Returns the metrics registry (snapshot() it
+    at the end of the run; `trace.export(path)` writes the timeline)."""
     metrics.enable()
     for name, labels in _SCHEMA_COUNTERS:
         metrics.declare(name, **labels)
     flight.get_recorder().enabled = True
+    trace.enable()
     if crash_hook:
         flight.install_crash_hook()
     return metrics.get_registry()
 
 
 def detach():
-    """Disable metric recording (flight stays on — it is cheap and the
-    crash evidence is the point).  Does not clear collected data."""
+    """Disable metric recording and span buffering (flight stays on — it
+    is cheap and the crash evidence is the point).  Does not clear
+    collected data."""
     metrics.disable()
+    trace.disable()

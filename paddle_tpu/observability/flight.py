@@ -20,6 +20,7 @@ import threading
 import time
 
 from . import metrics as _metrics
+from . import trace as _trace
 
 __all__ = ["FlightRecorder", "get_recorder", "record", "events", "dump",
            "clear", "install_crash_hook"]
@@ -52,6 +53,15 @@ class FlightRecorder:
             self._seq += 1
             evt["seq"] = self._seq
             self._events.append(evt)
+        # correlate onto the span timeline: every ring event doubles as
+        # an instant between the spans that caused it (only when the
+        # tracer is buffering — instant() is one branch otherwise).  A
+        # payload key colliding with instant()'s own parameters must not
+        # sink the recording path.
+        try:
+            _trace.instant(kind, cat="flight", **data)
+        except TypeError:
+            _trace.instant(kind, cat="flight")
 
     def events(self) -> list:
         with self._lock:

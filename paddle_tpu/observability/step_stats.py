@@ -53,14 +53,14 @@ def _iso_now() -> str:
 
 
 def _obs_modules():
-    """(metrics, flight) from the observability package, or (None, None)
+    """(metrics, flight, trace) from the observability package, or Nones
     when running standalone (file-loaded by tools/)."""
     try:
-        from . import flight, metrics  # type: ignore
+        from . import flight, metrics, trace  # type: ignore
 
-        return metrics, flight
+        return metrics, flight, trace
     except ImportError:
-        return None, None
+        return None, None, None
 
 
 def _device_peak_bytes():
@@ -111,7 +111,7 @@ class StepTimer:
         """Record a measured wall of `n_steps` device steps."""
         n = max(int(n_steps), 1)
         per_step_s = float(wall_s) / n
-        metrics, _flight = _obs_modules()
+        metrics, _flight, trace = _obs_modules()
         rec = {"phase": STEP_PHASE, "t": _iso_now(), "run_id": self.run_id,
                "step": -1, "n_steps": n,
                "wall_ms": round(per_step_s * 1e3, 4),
@@ -145,6 +145,21 @@ class StepTimer:
             rec["step"] = self._next_step
             self._next_step += n
             self.records.append(rec)
+        if trace is not None and trace.enabled():
+            # frame marker on the run's synthetic track: the step just
+            # finished, so it occupies [now - wall, now] on the timeline
+            name = "compile+step" if compile_step else (
+                f"step {rec['step']}" if n == 1
+                else f"steps {rec['step']}..{rec['step'] + n - 1}")
+            trace.frame(name, float(wall_s) * 1e6,
+                        track=f"steps:{self.run_id}",
+                        step=rec["step"], n_steps=n,
+                        wall_ms=rec["wall_ms"],
+                        compile=bool(compile_step))
+            if "peak_bytes_in_use" in rec:
+                trace.counter("mem.peak_bytes_in_use",
+                              track=f"mem:{self.run_id}",
+                              bytes=rec["peak_bytes_in_use"])
         if self._sink_path:
             try:
                 d = os.path.dirname(os.path.abspath(self._sink_path))

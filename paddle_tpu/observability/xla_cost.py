@@ -1,0 +1,208 @@
+"""XLA compile-time cost/memory annotation for the trace timeline.
+
+Every jit compile the framework performs can carry the compiler's OWN
+accounting — `Compiled.cost_analysis()` (FLOPs, bytes accessed,
+transcendentals) and `Compiled.memory_analysis()` (argument/output/temp
+buffer bytes, generated code size) — instead of only the host-side wall
+the compile ledger records.  Three consumers per capture:
+
+  * the trace timeline: the `xla.compile:<label>` span that wrapped the
+    compile gets the cost dict attached as span args, so clicking a
+    compile slice in Perfetto shows what the compiler thought it built;
+  * the metrics registry: `xla.cost.*{label=...}` gauges (latest compile
+    per label wins — the steady-state executable);
+  * the flight recorder: an `xla.compile` event, so a crash dump shows
+    the last programs built before the incident.
+
+`instrument(jitted, label)` wraps a `jax.jit` callable with capture-on-
+first-call-per-signature semantics.  When the telemetry stack is off
+(neither metrics nor trace enabled) — or when the call is happening
+under an outer jax trace (autograd through the dispatch gate hands the
+wrapped program Tracers) — the wrapper forwards straight to the jitted
+callable: byte-identical behavior to an uninstrumented jit.  When on,
+the first call for a new aval signature lowers + AOT-compiles (the same
+work `jitted(...)` would do on that call), captures the analysis, and
+replays the compiled executable on subsequent calls; any failure in the
+AOT path falls back to the plain jitted call.
+
+jax is imported lazily: this module loads during
+``paddle_tpu.observability`` import, which must stay stdlib-cheap.
+"""
+from __future__ import annotations
+
+import threading
+
+from . import flight as _flight
+from . import metrics as _metrics
+from . import trace as _trace
+
+__all__ = ["analyze_compiled", "capture", "instrument", "last_costs",
+           "InstrumentedJit"]
+
+# cost_analysis keys -> snapshot keys (values are floats)
+_COST_KEYS = (("flops", "flops"),
+              ("bytes accessed", "bytes_accessed"),
+              ("transcendentals", "transcendentals"))
+# memory_analysis attrs -> snapshot keys (values are ints)
+_MEM_KEYS = (("argument_size_in_bytes", "argument_bytes"),
+             ("output_size_in_bytes", "output_bytes"),
+             ("temp_size_in_bytes", "temp_bytes"),
+             ("alias_size_in_bytes", "alias_bytes"),
+             ("generated_code_size_in_bytes", "code_bytes"))
+# the subset worth a registry gauge per label
+_GAUGE_KEYS = ("flops", "bytes_accessed", "temp_bytes", "argument_bytes",
+               "output_bytes")
+
+_last: dict = {}
+_last_lock = threading.Lock()
+
+
+def analyze_compiled(compiled, label: str = "jit") -> dict:
+    """Cost/memory dict from a `jax.stages.Compiled` (best-effort: every
+    backend/version quirk degrades to fewer keys, never an exception)."""
+    out = {"label": str(label)}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # one entry per device program
+            ca = ca[0] if ca else {}
+        if ca:
+            for src, dst in _COST_KEYS:
+                if src in ca:
+                    out[dst] = float(ca[src])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr, dst in _MEM_KEYS:
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[dst] = int(v)
+    except Exception:
+        pass
+    return out
+
+
+def capture(compiled, label: str = "jit") -> dict:
+    """Analyze `compiled` and fan the result out to gauges + flight (and
+    remember it per label for `last_costs`).  Returns the cost dict so
+    the caller can also attach it to the surrounding compile span."""
+    costs = analyze_compiled(compiled, label)
+    for k in _GAUGE_KEYS:
+        if k in costs:
+            _metrics.set_gauge(f"xla.cost.{k}", costs[k], label=label)
+    _flight.record("xla.compile", **costs)
+    with _last_lock:
+        _last[str(label)] = dict(costs)
+    return costs
+
+
+def last_costs(label=None):
+    """Most recent capture for `label`, or the whole {label: costs} map."""
+    with _last_lock:
+        if label is not None:
+            return _last.get(str(label))
+        return dict(_last)
+
+
+def _telemetry_on() -> bool:
+    return _metrics.enabled() or _trace.enabled()
+
+
+# sentinel marking a signature whose compile is in flight on another
+# thread (callers fall back to the jitted path until it resolves)
+_PENDING = object()
+
+
+class InstrumentedJit:
+    """Wraps a jax.jit callable; first call per aval signature compiles
+    AOT inside an `xla.compile:<label>` span and captures cost_analysis.
+    Exposes `.lower()` (delegated) so callers that lower-for-analysis
+    (DistributedTrainStep.lower) keep working."""
+
+    def __init__(self, jitted, label: str):
+        self._jitted = jitted
+        self.label = str(label)
+        self._compiled: dict = {}
+        self._lock = threading.Lock()
+        try:
+            self.__name__ = getattr(jitted, "__name__", self.label)
+        except Exception:
+            pass
+
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    def _sig(self, leaves):
+        """Hashable aval signature, or None when any leaf isn't a plain
+        array (then capture is skipped — a repr-based key could differ
+        every call and turn the AOT cache into a compile-per-call).
+
+        Shardings are deliberately NOT in the key: jit outputs fed back
+        as inputs (train-step state) carry GSPMDSharding objects that
+        hash differently from the NamedSharding the first call was
+        placed with even when semantically equal, which would recompile
+        the steady-state executable every step.  A genuinely different
+        sharding is still safe — the Compiled call rejects it before
+        executing and __call__ falls back to the plain jit path."""
+        sig = []
+        for l in leaves:
+            shape = getattr(l, "shape", None)
+            dtype = getattr(l, "dtype", None)
+            if shape is None or dtype is None:
+                return None
+            sig.append((tuple(shape), str(dtype),
+                        bool(getattr(l, "weak_type", False))))
+        return tuple(sig)
+
+    def __call__(self, *args, **kwargs):
+        if not _telemetry_on():
+            return self._jitted(*args, **kwargs)
+        import jax
+
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            # under an outer trace (autograd through the dispatch gate):
+            # Compiled objects refuse tracers; jit composes fine
+            return self._jitted(*args, **kwargs)
+        key = self._sig(leaves)
+        if key is None:
+            return self._jitted(*args, **kwargs)
+        if key not in self._compiled:
+            # claim the signature under the lock so concurrent first
+            # calls never run the multi-second lower+compile twice;
+            # losers (and callers racing the winner) take the plain
+            # jitted path, whose own cache dedupes the compile
+            with self._lock:
+                claimed = key not in self._compiled
+                if claimed:
+                    self._compiled[key] = _PENDING
+            if claimed:
+                with _trace.span(f"xla.compile:{self.label}",
+                                 cat="compile") as sp:
+                    try:
+                        compiled = self._jitted.lower(
+                            *args, **kwargs).compile()
+                        costs = capture(compiled, self.label)
+                        if sp is not None:
+                            sp.args.update(costs)
+                    except Exception:
+                        compiled = None  # permanent fallback for this sig
+                self._compiled[key] = compiled
+        entry = self._compiled[key]
+        if entry is None or entry is _PENDING:
+            return self._jitted(*args, **kwargs)
+        try:
+            return entry(*args, **kwargs)
+        except (TypeError, ValueError):
+            # aval/sharding drift the signature key didn't see: the
+            # Compiled rejects the call before executing, so the plain
+            # jitted path (which re-specializes) is still safe to run
+            return self._jitted(*args, **kwargs)
+
+
+def instrument(jitted, label: str = "jit"):
+    """Wrap a jax.jit callable for compile-cost capture; returns the
+    input unchanged when it has no `.lower` (not an AOT-capable stage)."""
+    if not hasattr(jitted, "lower"):
+        return jitted
+    return InstrumentedJit(jitted, label)

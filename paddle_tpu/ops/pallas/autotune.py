@@ -31,6 +31,7 @@ import jax
 
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
+from ...observability import trace as _trace
 
 _CACHE_PATH = os.environ.get(
     "PADDLE_TPU_AUTOTUNE_CACHE",
@@ -167,17 +168,26 @@ def pick(op: str, signature, candidates, run, default):
     _metrics.inc("autotune.miss")
     _flight.record("autotune.search", op=op, signature=str(signature),
                    n_candidates=len(candidates))
-    # search outside the lock: candidate compiles can take seconds each
+    # search outside the lock: candidate compiles can take seconds each.
+    # The whole search is one trace span (it can cost seconds of bench
+    # wall — it must be visible as a slice, not mystery idle time), with
+    # the per-candidate timings attached once the winner is known.
     best, best_t, timings = None, float("inf"), {}
-    for cfg in candidates:
-        try:
-            f, x = run(cfg)
-            t = _slope_time(f, x)
-        except Exception:
-            continue  # a config that fails to compile just loses
-        timings[str(cfg)] = round(t * 1e3, 4)
-        if t < best_t:
-            best, best_t = cfg, t
+    with _trace.span(f"autotune.search:{op}", cat="autotune",
+                     signature=str(signature),
+                     n_candidates=len(candidates)) as _sp:
+        for cfg in candidates:
+            try:
+                f, x = run(cfg)
+                t = _slope_time(f, x)
+            except Exception:
+                continue  # a config that fails to compile just loses
+            timings[str(cfg)] = round(t * 1e3, 4)
+            if t < best_t:
+                best, best_t = cfg, t
+        if _sp is not None:
+            _sp.args["winner"] = str(best)
+            _sp.args["ms"] = timings
     if best is None:
         _metrics.inc("autotune.search_failed")
         _flight.record("autotune.search_failed", op=op,

@@ -17,6 +17,7 @@ import time
 from enum import Enum
 
 from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
@@ -66,11 +67,15 @@ class RecordEvent:
     Doubles as the observability scope boundary: while the span is open,
     metrics recorded on this thread (and flight-recorder events / step
     records) are tagged ``scope=<name>`` — the RecordEvent ↔ telemetry
-    integration from docs/OBSERVABILITY.md."""
+    integration from docs/OBSERVABILITY.md.  When the span tracer is
+    buffering, every RecordEvent also lands as a span on the unified
+    timeline (cat="user_scope"), so user scopes, flight instants, and
+    step frames correlate in one Perfetto view."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._scope_token = None
+        self._trace_span = None
 
     def __enter__(self):
         self.begin()
@@ -82,10 +87,13 @@ class RecordEvent:
     def begin(self):
         self._t0 = time.perf_counter_ns()
         self._scope_token = _obs_metrics.push_scope(self.name)
+        self._trace_span = _obs_trace.begin(self.name, cat="user_scope")
 
     def end(self):
         _recorder.add(self.name, self._t0, time.perf_counter_ns(),
                       threading.get_ident())
+        _obs_trace.end(self._trace_span)
+        self._trace_span = None
         if self._scope_token is not None:
             _obs_metrics.pop_scope(self._scope_token)
             self._scope_token = None
@@ -209,12 +217,33 @@ class Profiler:
         self._state = ProfilerState.CLOSED
 
     def _export_chrome(self, path):
-        events = [{
-            "name": name, "ph": "X", "pid": os.getpid(), "tid": tid,
-            "ts": t0 / 1000.0, "dur": (t1 - t0) / 1000.0, "cat": "host",
-        } for (name, t0, t1, tid) in _recorder.events]
+        # Raw thread idents are huge unstable integers; Perfetto needs
+        # small stable tids plus thread_name/process_name metadata
+        # records or every scope collapses onto one unlabeled row.
+        pid = os.getpid()
+        tid_map = {}
+        events = []
+        for (name, t0, t1, raw_tid) in _recorder.events:
+            tid = tid_map.get(raw_tid)
+            if tid is None:
+                tid = tid_map[raw_tid] = len(tid_map) + 1
+            events.append({
+                "name": name, "ph": "X", "pid": pid, "tid": tid,
+                "ts": t0 / 1000.0, "dur": (t1 - t0) / 1000.0,
+                "cat": "host",
+            })
+        meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "args": {"name": "paddle_tpu host"}}]
+        for raw_tid, tid in sorted(tid_map.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid,
+                         "args": {"name": f"host-thread-{tid}"}})
+            meta.append({"name": "thread_sort_index", "ph": "M",
+                         "pid": pid, "tid": tid,
+                         "args": {"sort_index": tid}})
         with open(path, "w") as f:
-            json.dump({"traceEvents": events,
+            json.dump({"traceEvents": meta + events,
+                       "displayTimeUnit": "ms",
                        "xprof_dir": self._jax_dir}, f)
         return path
 

@@ -13,10 +13,10 @@ import os
 import pickle
 
 import jax
-import jax.export  # noqa: F401  (binds jax.export on builds without the lazy attr)
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.export_compat import get_jax_export
 from ..core.tensor import Tensor
 from .executor import _build
 from .framework import default_main_program
@@ -24,6 +24,7 @@ from .framework import default_main_program
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
+    je = get_jax_export()  # raises ExportUnavailableError up front
     program = program or default_main_program()
     if not isinstance(feed_vars, (list, tuple)):
         feed_vars = [feed_vars]
@@ -61,7 +62,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         return fetches
 
     # symbolic batch dims: every declared -1 becomes its own export symbol
-    scope = jax.export.SymbolicScope()
+    scope = je.SymbolicScope()
     feed_avals = []
     has_symbolic = False
     for i, v in enumerate(feed_vars):
@@ -70,14 +71,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             has_symbolic = True
             spec = ",".join(f"d{i}_{j}" if d == -1 else str(d)
                             for j, d in enumerate(decl))
-            shape = jax.export.symbolic_shape(spec, scope=scope)
+            shape = je.symbolic_shape(spec, scope=scope)
         else:
             shape = tuple(decl)
         feed_avals.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
     cap_avals = [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in cap_vals]
 
     try:
-        exp = jax.export.export(jax.jit(infer_fn))(cap_avals, feed_avals)
+        exp = je.export(jax.jit(infer_fn))(cap_avals, feed_avals)
     except Exception as e:
         if not has_symbolic:
             raise
@@ -95,7 +96,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                       for d in (getattr(v, "declared_shape", None) or v.shape)),
                 v._value.dtype)
             for v in feed_vars]
-        exp = jax.export.export(jax.jit(infer_fn))(cap_avals, feed_avals)
+        exp = je.export(jax.jit(infer_fn))(cap_avals, feed_avals)
 
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     with open(path_prefix + ".pdmodel", "wb") as f:
@@ -135,10 +136,11 @@ class _ExportedInferenceProgram:
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
+    je = get_jax_export()
     with open(path_prefix + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
     with open(path_prefix + ".pdmodel", "rb") as f:
-        exp = jax.export.deserialize(bytearray(f.read()))
+        exp = je.deserialize(bytearray(f.read()))
     prog = _ExportedInferenceProgram(
         exp, meta["caps"], meta["feed_names"], meta["fetch_names"])
     return [prog, prog.feed_names, prog.fetch_names]

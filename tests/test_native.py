@@ -7,6 +7,11 @@ import numpy as np
 import pytest
 
 import paddle_tpu as P
+from paddle_tpu.core.export_compat import jax_export_available
+
+requires_jax_export = pytest.mark.skipif(
+    not jax_export_available(),
+    reason="jax.export unavailable in this jax build")
 
 
 def test_native_builds():
@@ -230,6 +235,7 @@ def test_custom_op_runtime_registration():
     np.testing.assert_allclose(out2.numpy(), 2 * x + 3 * y, rtol=1e-5)
 
 
+@requires_jax_export
 def test_c_inference_api(tmp_path):
     """C inference ABI (reference capi_exp role): build libpaddle_tpu_capi,
     load it with ctypes, and run a saved model end-to-end through the raw

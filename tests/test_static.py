@@ -9,6 +9,11 @@ import pytest
 
 import paddle_tpu as P
 from paddle_tpu import static
+from paddle_tpu.core.export_compat import jax_export_available
+
+requires_jax_export = pytest.mark.skipif(
+    not jax_export_available(),
+    reason="jax.export unavailable in this jax build")
 
 
 @pytest.fixture(autouse=True)
@@ -118,6 +123,7 @@ def test_append_backward_grads():
     np.testing.assert_allclose(grads[0], np.full((4, 2), 3.0), rtol=1e-6)
 
 
+@requires_jax_export
 def test_save_load_inference_model(tmp_path):
     import paddle_tpu.nn as nn
 
@@ -135,6 +141,7 @@ def test_save_load_inference_model(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-5)
 
 
+@requires_jax_export
 def test_inference_predictor(tmp_path):
     import paddle_tpu.nn as nn
     from paddle_tpu import inference

@@ -5,8 +5,10 @@ PERF.md-style tables: one section per phase, latest entry per unique
 key, errors listed last.  `step_stats` entries (the observability
 StepTimer stream, docs/OBSERVABILITY.md) get schema validation plus a
 per-run summary (compile ledger vs steady walls, tokens/s, MFU) instead
-of the latest-entry-wins table.  Run: python tools/analyze_chip_log.py
-[log.jsonl]
+of the latest-entry-wins table; `trace_event` entries (span-tracer
+`dump_jsonl` streams) get schema validation plus an event/span digest.
+Exit is non-zero on any schema error in either stream (the CI hook).
+Run: python tools/analyze_chip_log.py [log.jsonl]
 """
 from __future__ import annotations
 
@@ -20,20 +22,20 @@ LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "chip_session_log.jsonl")
 
 
-def _load_step_stats_module():
-    """File-load observability/step_stats.py (stdlib-only module by
-    contract) so this tool works without importing jax-heavy
-    paddle_tpu."""
+def _load_obs_module(name):
+    """File-load an observability module (stdlib-only by contract) so
+    this tool works without importing jax-heavy paddle_tpu."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         os.pardir, "paddle_tpu", "observability",
-                        "step_stats.py")
-    spec = importlib.util.spec_from_file_location("_step_stats", path)
+                        name + ".py")
+    spec = importlib.util.spec_from_file_location("_" + name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-_step_stats = _load_step_stats_module()
+_step_stats = _load_obs_module("step_stats")
+_trace = _load_obs_module("trace")
 
 
 def load(path=LOG):
@@ -53,10 +55,11 @@ def load(path=LOG):
     return entries
 
 
-def digest(entries, schema_errors=None):
+def digest(entries, schema_errors=None, trace_errors=None):
     phases: "OrderedDict[str, OrderedDict]" = OrderedDict()
     errors = []
     step_entries = []
+    trace_entries = []
     for e in entries:
         ph = e.get("phase", "?")
         if "error" in e:
@@ -64,6 +67,9 @@ def digest(entries, schema_errors=None):
             continue
         if ph == _step_stats.STEP_PHASE:
             step_entries.append(e)
+            continue
+        if ph == _trace.TRACE_PHASE:
+            trace_entries.append(e)
             continue
         if e.get("done"):
             continue
@@ -91,6 +97,16 @@ def digest(entries, schema_errors=None):
                 lines.append(f"- {err}")
         for run_id, s in _step_stats.summarize_stream(step_entries).items():
             lines.append(f"- **{run_id}**: " + json.dumps(s, default=str))
+    if trace_entries:
+        lines.append(f"\n## trace_events  ({len(trace_entries)} events)\n")
+        if trace_errors is None:
+            trace_errors = _trace.validate_trace_stream(trace_entries)
+        if trace_errors:
+            lines.append(f"**schema errors ({len(trace_errors)}):**")
+            for err in trace_errors[:20]:
+                lines.append(f"- {err}")
+        s = _trace.summarize_trace_stream(trace_entries)
+        lines.append("- " + json.dumps(s, default=str))
     if errors:
         lines.append(f"\n## errors ({len(errors)})\n")
         for ph, t, err in errors[-30:]:
@@ -102,10 +118,11 @@ def main(argv):
     path = argv[1] if len(argv) > 1 else LOG
     entries = load(path)
     # validate once; digest renders the same result and the exit code
-    # makes a corrupt step-stats stream fail loudly in CI contexts
+    # makes a corrupt step-stats or trace stream fail loudly in CI
     errors = _step_stats.validate_stream(entries)
-    print(digest(entries, schema_errors=errors))
-    return 1 if errors else 0
+    trace_errors = _trace.validate_trace_stream(entries)
+    print(digest(entries, schema_errors=errors, trace_errors=trace_errors))
+    return 1 if (errors or trace_errors) else 0
 
 
 if __name__ == "__main__":

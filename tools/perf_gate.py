@@ -1,0 +1,448 @@
+"""Perf-regression gate + trace merge for bench telemetry.
+
+Closes the observability loop (ISSUE 2): BENCH_* numbers stop being
+trend data a human eyeballs and become an enforced floor.
+
+Gate mode (default):
+    python tools/perf_gate.py results.json [--baseline tools/last_good_bench.jsonl]
+        [--tolerance 0.10] [--metric-tolerance METRIC=FRAC ...] [--update]
+
+  `results.json` is whatever `bench.py --telemetry` printed: JSON lines
+  (one per metric, headline last), a single object, or an array.  Each
+  row's `value` is compared against the freshest non-degraded baseline
+  row for the same metric: higher-is-better metrics (throughputs) fail
+  when value < baseline*(1-tol); lower-is-better (``*_ms`` / rows
+  flagged ``lower_better``) fail when value > baseline*(1+tol).
+  Headline rows carrying an embedded telemetry block also gate the
+  derived `<metric>.mfu` (higher-better) and `<metric>.steady_wall_ms`
+  (lower-better) series once the baseline knows them.  Degraded
+  (CPU-proxy) current rows are skipped — a proxy number must never be
+  judged against an on-chip floor.  Exit codes: 0 pass, 2 regression,
+  1 usage/IO error.  `--update` appends the current non-degraded rows
+  to the baseline (rolling the floor forward after a verified win).
+
+Check mode:
+    python tools/perf_gate.py --check-only [--baseline PATH]
+  Validates that the baseline parses and every row is gateable — the
+  fast CI smoke (wired as a non-slow test).
+
+Merge mode:
+    python tools/perf_gate.py --merge-trace out.json
+        [--spans tracer.json ...] [--step-stats steps.jsonl ...]
+        [--flight flight.jsonl ...]
+  Folds span-tracer exports (Chrome JSON or trace_event JSONL),
+  step-stats JSONL, and flight-recorder dumps into ONE Perfetto file:
+  each source family gets its own process row so unrelated clocks never
+  falsely align.
+
+stdlib-only on purpose: the gate must run in CI contexts (and on hosts)
+without importing jax-heavy paddle_tpu.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "last_good_bench.jsonl")
+DEFAULT_TOLERANCE = 0.10
+
+# pids for merged-trace source families (span events keep the pid the
+# tracer recorded — theirs was a real process)
+_PID_STEPS = 9001
+_PID_FLIGHT = 9002
+
+
+# ------------------------------ loading ------------------------------
+
+def _iter_json_values(text):
+    """Yield parsed JSON values from `text`: JSON-lines first, falling
+    back to one whole-document parse (object or array)."""
+    vals, bad = [], 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            vals.append(json.loads(line))
+        except ValueError:
+            bad += 1
+    if vals and not bad:
+        return vals
+    try:
+        whole = json.loads(text)
+    except ValueError:
+        return vals
+    return whole if isinstance(whole, list) else [whole]
+
+
+def _metric_rows(values):
+    return [v for v in values
+            if isinstance(v, dict) and isinstance(v.get("metric"), str)
+            and isinstance(v.get("value"), (int, float))
+            and not isinstance(v.get("value"), bool)]
+
+
+def load_results(path):
+    """Gateable rows from a bench output file, with derived telemetry
+    metrics (mfu, steady wall) expanded from embedded telemetry blocks."""
+    with open(path) as f:
+        rows = _metric_rows(_iter_json_values(f.read()))
+    out = list(rows)
+    for r in rows:
+        tele = r.get("telemetry")
+        if not isinstance(tele, dict):
+            continue
+        ss = tele.get("step_stats")
+        if not isinstance(ss, dict):
+            continue
+        base = r["metric"]
+        if isinstance(ss.get("mfu"), (int, float)):
+            out.append({"metric": base + ".mfu", "value": float(ss["mfu"]),
+                        "unit": "mfu", "degraded": r.get("degraded", False)})
+        wall = ss.get("wall_ms")
+        if isinstance(wall, dict) and \
+                isinstance(wall.get("mean"), (int, float)):
+            out.append({"metric": base + ".steady_wall_ms",
+                        "value": float(wall["mean"]), "unit": "ms",
+                        "lower_better": True,
+                        "degraded": r.get("degraded", False)})
+    return out
+
+
+def load_baseline(path):
+    """{metric: row} — freshest (captured_at, then file order)
+    non-degraded, non-zero row per metric."""
+    best = {}
+    with open(path) as f:
+        rows = _metric_rows(_iter_json_values(f.read()))
+    for i, r in enumerate(rows):
+        if r.get("degraded") or r["value"] <= 0:
+            continue
+        m = r["metric"]
+        key = (r.get("captured_at", 0), i)
+        if m not in best or key >= best[m][0]:
+            best[m] = (key, r)
+    return {m: r for m, (_k, r) in best.items()}
+
+
+def _lower_better(row, base_row):
+    if row.get("lower_better") or (base_row or {}).get("lower_better"):
+        return True
+    return row["metric"].endswith("_ms")
+
+
+# ------------------------------ gating ------------------------------
+
+def gate(results, baseline, tolerance=DEFAULT_TOLERANCE,
+         metric_tolerances=None):
+    """Compare result rows to baseline rows.  Returns (failures, report)
+    where report is a list of human-readable lines covering every row."""
+    metric_tolerances = metric_tolerances or {}
+    failures, report = [], []
+    for r in results:
+        m = r["metric"]
+        if r.get("degraded"):
+            report.append(f"SKIP  {m}: degraded run (value {r['value']}) — "
+                          "proxy numbers are not judged against the floor")
+            continue
+        base = baseline.get(m)
+        if base is None:
+            report.append(f"NEW   {m}: {r['value']} (no baseline; "
+                          "--update to start gating it)")
+            continue
+        tol = float(metric_tolerances.get(m, tolerance))
+        bv, cv = float(base["value"]), float(r["value"])
+        if _lower_better(r, base):
+            floor = bv * (1.0 + tol)
+            ok = cv <= floor
+            direction = "above"
+        else:
+            floor = bv * (1.0 - tol)
+            ok = cv >= floor
+            direction = "below"
+        delta = (cv - bv) / bv if bv else 0.0
+        line = (f"{'PASS' if ok else 'FAIL'}  {m}: {cv} vs baseline {bv} "
+                f"({delta:+.2%}, tolerance {tol:.0%})")
+        if not ok:
+            line += f" — {direction} the gated floor {floor:.4g}"
+            failures.append(line)
+        report.append(line)
+    return failures, report
+
+
+def update_baseline(results, path):
+    """Append the current non-degraded rows to the baseline JSONL (the
+    telemetry block is dropped — the baseline stores gateable facts, not
+    provenance payloads)."""
+    now = time.time()
+    n = 0
+    with open(path, "a") as f:
+        for r in results:
+            if r.get("degraded") or r["value"] <= 0:
+                continue
+            row = {k: v for k, v in r.items() if k != "telemetry"}
+            row["captured_at"] = now
+            f.write(json.dumps(row) + "\n")
+            n += 1
+    return n
+
+
+def check_baseline(path):
+    """Errors that would make the baseline un-gateable (the --check-only
+    CI smoke)."""
+    errors = []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return [f"cannot read baseline {path}: {e}"]
+    n_rows = 0
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {i + 1}: not JSON ({e})")
+            continue
+        if not isinstance(obj, dict):
+            errors.append(f"line {i + 1}: not an object")
+            continue
+        if not isinstance(obj.get("metric"), str):
+            errors.append(f"line {i + 1}: missing metric name")
+        v = obj.get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errors.append(f"line {i + 1}: missing numeric value")
+        n_rows += 1
+    if n_rows == 0:
+        errors.append(f"baseline {path} has no metric rows")
+    return errors
+
+
+# ------------------------------ merging ------------------------------
+
+def _load_jsonl(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def _span_events(path):
+    """Events from a tracer export: Chrome JSON ({"traceEvents": [...]})
+    or JSONL of trace_event lines."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+        return [e for e in doc["traceEvents"] if isinstance(e, dict)]
+    events = []
+    for obj in _iter_json_values(text):
+        if isinstance(obj, dict) and obj.get("phase") == "trace_event":
+            events.append({k: v for k, v in obj.items()
+                           if k not in ("phase", "t")})
+    return events
+
+
+def _step_events(path):
+    """step_stats JSONL -> per-run frame events (walls accumulated from
+    0 in record order: the stream has no sub-second timestamps, so the
+    reconstruction preserves durations and order, not absolute time)."""
+    events, cursor, tids = [], {}, {}
+    for e in _load_jsonl(path):
+        if not isinstance(e, dict) or e.get("phase") != "step_stats":
+            continue
+        run = str(e.get("run_id", "?"))
+        tid = tids.setdefault(run, len(tids) + 1)
+        n = int(e.get("n_steps", 1))
+        wall_us = float(e.get("wall_ms", 0)) * 1e3 * n
+        t0 = cursor.get(run, 0.0)
+        cursor[run] = t0 + wall_us
+        step = e.get("step", 0)
+        # mirror StepTimer's own frame naming: an n-step compiled scan is
+        # one block, not one anomalously slow step
+        name = "compile+step" if e.get("compile") else (
+            f"step {step}" if n == 1 else f"steps {step}..{step + n - 1}")
+        args = {k: e[k] for k in ("step", "n_steps", "wall_ms", "compile",
+                                  "tokens_per_s", "mfu") if k in e}
+        events.append({"name": name, "cat": "step", "ph": "X",
+                       "ts": round(t0, 3), "dur": round(wall_us, 3),
+                       "pid": _PID_STEPS, "tid": tid, "args": args})
+    meta = [{"name": "thread_name", "ph": "M", "pid": _PID_STEPS,
+             "tid": tid, "args": {"name": f"steps:{run}"}}
+            for run, tid in tids.items()]
+    return meta + events
+
+
+def _flight_events(path):
+    """flight dump JSONL -> instant events (epoch walls normalized so the
+    first event sits at ts 0)."""
+    rows = [e for e in _load_jsonl(path)
+            if isinstance(e, dict) and e.get("kind")
+            and e.get("kind") != "flight.dump"]
+    if not rows:
+        return []
+    t0 = min(float(e.get("t", 0)) for e in rows)
+    events = []
+    for e in rows:
+        args = {k: v for k, v in e.items() if k not in ("kind", "t", "seq")}
+        events.append({"name": str(e["kind"]), "cat": "flight", "ph": "i",
+                       "s": "t",
+                       "ts": round((float(e.get("t", t0)) - t0) * 1e6, 3),
+                       "pid": _PID_FLIGHT, "tid": 1, "args": args})
+    return events
+
+
+def merge_trace(out_path, spans=(), step_stats=(), flight=()):
+    """Fold the three stream families into one Perfetto-loadable file."""
+    events = []
+    for p in spans:
+        events.extend(_span_events(p))
+    steps = []
+    for p in step_stats:
+        steps.extend(_step_events(p))
+    flights = []
+    for p in flight:
+        flights.extend(_flight_events(p))
+    meta = []
+    if steps:
+        meta.append({"name": "process_name", "ph": "M", "pid": _PID_STEPS,
+                     "tid": 0, "args": {"name": "step_stats (reconstructed "
+                                        "timeline)"}})
+    if flights:
+        meta.append({"name": "process_name", "ph": "M", "pid": _PID_FLIGHT,
+                     "tid": 0, "args": {"name": "flight recorder"}})
+        meta.append({"name": "thread_name", "ph": "M", "pid": _PID_FLIGHT,
+                     "tid": 1, "args": {"name": "events"}})
+    doc = {"traceEvents": events + meta + steps + flights,
+           "displayTimeUnit": "ms",
+           "otherData": {"merged_from": {
+               "spans": list(spans), "step_stats": list(step_stats),
+               "flight": list(flight)}}}
+    d = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(d, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, default=str)
+    return out_path
+
+
+# ------------------------------ CLI ------------------------------
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="perf_gate",
+        description="perf-regression gate + trace merge (see module doc)")
+    p.add_argument("results", nargs="?", help="bench output to gate")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="allowed fractional drop (default 0.10)")
+    p.add_argument("--metric-tolerance", action="append", default=[],
+                   metavar="METRIC=FRAC",
+                   help="per-metric tolerance override (repeatable)")
+    p.add_argument("--update", action="store_true",
+                   help="append current rows to the baseline")
+    p.add_argument("--check-only", action="store_true",
+                   help="validate the baseline file and exit")
+    p.add_argument("--merge-trace", metavar="OUT",
+                   help="write a merged Perfetto file instead of gating")
+    p.add_argument("--spans", nargs="*", default=[],
+                   help="span-tracer exports (chrome JSON or JSONL)")
+    p.add_argument("--step-stats", nargs="*", default=[],
+                   help="step_stats JSONL streams")
+    p.add_argument("--flight", nargs="*", default=[],
+                   help="flight-recorder dump JSONL files")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    if args.merge_trace:
+        try:
+            out = merge_trace(args.merge_trace, spans=args.spans,
+                              step_stats=args.step_stats,
+                              flight=args.flight)
+        except OSError as e:
+            print(f"perf_gate: merge failed: {e}", file=sys.stderr)
+            return 1
+        with open(out) as f:
+            n = len(json.load(f)["traceEvents"])
+        print(f"perf_gate: merged {n} events -> {out}")
+        return 0
+
+    if args.check_only:
+        errors = check_baseline(args.baseline)
+        if errors:
+            print(f"perf_gate: baseline {args.baseline} INVALID:")
+            for e in errors[:20]:
+                print(f"  - {e}")
+            return 1
+        base = load_baseline(args.baseline)
+        print(f"perf_gate: baseline OK — {len(base)} gateable metrics "
+              f"({args.baseline})")
+        return 0
+
+    if not args.results:
+        print("perf_gate: results file required (or --check-only / "
+              "--merge-trace)", file=sys.stderr)
+        return 1
+
+    per_metric = {}
+    for spec in args.metric_tolerance:
+        if "=" not in spec:
+            print(f"perf_gate: bad --metric-tolerance {spec!r} "
+                  "(want METRIC=FRAC)", file=sys.stderr)
+            return 1
+        m, frac = spec.split("=", 1)
+        try:
+            per_metric[m] = float(frac)
+        except ValueError:
+            print(f"perf_gate: bad tolerance in {spec!r}", file=sys.stderr)
+            return 1
+
+    try:
+        results = load_results(args.results)
+    except OSError as e:
+        print(f"perf_gate: cannot read results: {e}", file=sys.stderr)
+        return 1
+    if not results:
+        print(f"perf_gate: no metric rows in {args.results}",
+              file=sys.stderr)
+        return 1
+    try:
+        baseline = load_baseline(args.baseline)
+    except OSError as e:
+        print(f"perf_gate: cannot read baseline: {e}", file=sys.stderr)
+        return 1
+
+    failures, report = gate(results, baseline, tolerance=args.tolerance,
+                            metric_tolerances=per_metric)
+    for line in report:
+        print(line)
+    if args.update:
+        n = update_baseline(results, args.baseline)
+        print(f"perf_gate: baseline updated (+{n} rows)")
+    if failures:
+        print(f"perf_gate: {len(failures)} regression(s) beyond tolerance",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
