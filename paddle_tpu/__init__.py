@@ -54,7 +54,8 @@ def __getattr__(name):
             "distribution", "sparse", "text", "audio", "quantization",
             "geometric", "fft", "signal", "linalg", "regularizer",
             "static", "inference", "onnx", "utils", "sysconfig", "hub",
-            "cost_model", "dataset", "reader", "observability"}
+            "cost_model", "dataset", "reader", "observability",
+            "resilience"}
     if name in lazy:
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

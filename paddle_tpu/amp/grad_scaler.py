@@ -33,6 +33,17 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._opt_state = OptimizerState.INIT
+        self._guard = None
+
+    def attach_guard(self, guard):
+        """Compose with a `resilience.StepGuard`: every `update()`
+        reports this step's overflow verdict.  Overflows while dynamic
+        scaling still has room to shrink the scale are EXPECTED (source
+        "amp": recorded as skips, no escalation); an overflow with the
+        scale already at its floor is a genuinely sick step (source
+        "amp_floor") and counts toward the warn→skip→rollback ladder."""
+        self._guard = guard
+        return self
 
     def is_enable(self):
         return self._enable
@@ -72,6 +83,17 @@ class GradScaler:
     def update(self):
         if not self._enable:
             return
+        if self._guard is not None:
+            # before the static-scaling early return: overflows must
+            # reach the guard either way.  Static scaling (and a
+            # dynamic scale already at its floor) has no room to shrink
+            # out of the overflow, so those count toward the ladder.
+            if self._found_inf:
+                at_floor = (not self._dynamic) or self._scale <= 1.0
+                self._guard.observe(
+                    False, source="amp_floor" if at_floor else "amp")
+            else:
+                self._guard.observe(True, source="amp")
         if not self._dynamic:
             self._opt_state = OptimizerState.INIT
             return

@@ -25,7 +25,10 @@ import numpy as np
 import jax
 
 from ...core.tensor import Tensor
-from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .metadata import (
+    CheckpointCorruptionError, LocalTensorIndex, LocalTensorMetadata,
+    Metadata, shard_checksum,
+)
 
 # introspection for tests: peak block size (elements) assembled by the last
 # load, and which keys fell back to full-tensor materialization
@@ -50,6 +53,31 @@ def _np_dtype(name):
 
 
 _async_save_thread = None
+_async_save_error = None  # exception raised inside the async save thread
+
+
+def _fire_fault(point, **ctx):
+    """Resilience fault-point hook (None when the harness is idle)."""
+    try:
+        from ...resilience import faults as _faults
+    except ImportError:
+        return None
+    return _faults.fire(point, **ctx)
+
+
+def _fsync_and_rename(tmp_path, final_path):
+    """Commit one file atomically: the tmp is already fsync'd; rename
+    over the final name, then fsync the directory so the rename itself
+    is durable."""
+    os.rename(tmp_path, final_path)
+    try:
+        dfd = os.open(os.path.dirname(final_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # directory fsync is best-effort (not all filesystems)
 
 
 def _snapshot_host(state_dict):
@@ -78,19 +106,44 @@ def _snapshot_host(state_dict):
 
 
 def _write_snapshot(snap, path, pid, coordinator_rank):
+    """Atomic, checksummed write of one process's shards + metadata.
+
+    Torn-write hardening (docs/RESILIENCE.md): all bytes land in
+    `*.tmp` files that are fsync'd then renamed into place; the
+    metadata file is committed LAST, so a kill at any point leaves
+    either the complete previous checkpoint or a loadable new one —
+    never a half-written state a loader would trust.  Each stored
+    byte-range records its CRC32 for verification on load.
+    """
+    action = _fire_fault("checkpoint.write", path=path, pid=pid)
     meta = Metadata()
     fname = f"{pid}.distcp"
+    tmp_data = os.path.join(path, fname + ".tmp")
+    total = sum(arr.nbytes for _k, _g, _d, shards in snap
+                for _o, arr in shards)
     pos = 0
-    with open(os.path.join(path, fname), "wb") as f:
+    with open(tmp_data, "wb") as f:
         for key, gshape, dtype_str, shards in snap:
             entries = []
             for offset, arr in shards:
                 raw = arr.tobytes()
+                if action is not None and action.kind == "torn" and \
+                        pos + len(raw) > total // 2:
+                    # simulated mid-write kill: half the bytes are down,
+                    # no rename, no metadata — the previous checkpoint
+                    # must remain the loadable one
+                    f.write(raw[:max(1, len(raw) // 2)])
+                    f.flush()
+                    from ...resilience.faults import InjectedFault
+
+                    raise InjectedFault("checkpoint.write", kind="torn",
+                                        call=action.call, file=fname)
                 f.write(raw)
                 entries.append(LocalTensorMetadata(
                     offset, tuple(arr.shape), dtype_str))
                 meta.storage_metadata[LocalTensorIndex(key, offset)] = {
                     "file": fname, "byte_offset": pos, "nbytes": len(raw),
+                    "crc32": shard_checksum(raw),
                 }
                 pos += len(raw)
             meta.state_dict_metadata[key] = {
@@ -98,9 +151,23 @@ def _write_snapshot(snap, path, pid, coordinator_rank):
                 "dtype": dtype_str,
                 "shards": entries,
             }
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_and_rename(tmp_data, os.path.join(path, fname))
+    if action is not None and action.kind == "corrupt":
+        # simulated bit-rot AFTER a clean commit: the CRCs recorded in
+        # the metadata no longer match the bytes on disk
+        from ...resilience.faults import corrupt_file
+
+        corrupt_file(os.path.join(path, fname),
+                     seed=action.payload.get("seed", 0))
     if pid == coordinator_rank:
-        with open(os.path.join(path, f"{pid}.metadata"), "wb") as f:
+        tmp_meta = os.path.join(path, f"{pid}.metadata.tmp")
+        with open(tmp_meta, "wb") as f:
             pickle.dump(meta, f, protocol=4)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_and_rename(tmp_meta, os.path.join(path, f"{pid}.metadata"))
 
 
 def save_state_dict(state_dict, path, process_group=None,
@@ -116,28 +183,75 @@ def save_state_dict(state_dict, path, process_group=None,
     """
     os.makedirs(path, exist_ok=True)
     pid = _proc_id()
-    wait_async_save()  # serialize with any in-flight save
+    wait_async_save()  # serialize with (and surface errors from) any
+    # in-flight save
     snap = _snapshot_host(state_dict)
     if async_save:
         global _async_save_thread
         import threading
 
+        def _run():
+            global _async_save_error
+            try:
+                _write_snapshot(snap, path, pid, coordinator_rank)
+            except BaseException as e:  # captured, re-raised on wait
+                _async_save_error = e
+
         _async_save_thread = threading.Thread(
-            target=_write_snapshot, args=(snap, path, pid, coordinator_rank),
-            daemon=False, name="distcp-async-save")
+            target=_run, daemon=False, name="distcp-async-save")
         _async_save_thread.start()
+        _register_atexit_join()
         return
     _write_snapshot(snap, path, pid, coordinator_rank)
 
 
 def wait_async_save():
     """Block until the last `save_state_dict(..., async_save=True)` has
-    fully hit disk (completion barrier; no-op when nothing is in flight)."""
-    global _async_save_thread
+    fully hit disk (completion barrier; no-op when nothing is in flight).
+
+    An exception raised inside the save thread is captured there and
+    RE-RAISED here — the first save/load/wait after the failure sees it
+    (a silently-lost async checkpoint is a checkpoint you discover is
+    missing only when restoring from a crash)."""
+    global _async_save_thread, _async_save_error
     t = _async_save_thread
     if t is not None:
         t.join()
         _async_save_thread = None
+    err = _async_save_error
+    if err is not None:
+        _async_save_error = None
+        raise err
+
+
+_atexit_registered = False
+
+
+def _register_atexit_join():
+    """Join a still-running async save at interpreter exit (a clean
+    process teardown must not truncate a checkpoint mid-write); a
+    captured failure is reported, not raised (atexit can't propagate)."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import atexit
+
+    def _drain():
+        global _async_save_thread, _async_save_error
+        t = _async_save_thread
+        if t is not None:
+            t.join()
+            _async_save_thread = None
+        if _async_save_error is not None:
+            import sys
+
+            print(f"[checkpoint] async save failed: "
+                  f"{type(_async_save_error).__name__}: "
+                  f"{_async_save_error}", file=sys.stderr)
+            _async_save_error = None
+
+    atexit.register(_drain)
 
 
 def _load_metadata(path):
@@ -184,6 +298,18 @@ class _ShardReader:
             self._files[loc["file"]] = f
         f.seek(loc["byte_offset"])
         raw = f.read(loc["nbytes"])
+        if len(raw) != loc["nbytes"]:
+            raise CheckpointCorruptionError(
+                f"checkpoint shard {key!r}@{entry.global_offset} in "
+                f"{loc['file']} truncated: wanted {loc['nbytes']} bytes, "
+                f"got {len(raw)}", key=key, file=loc["file"])
+        want = loc.get("crc32")
+        if want is not None and shard_checksum(raw) != want:
+            raise CheckpointCorruptionError(
+                f"checkpoint shard {key!r}@{entry.global_offset} in "
+                f"{loc['file']} failed CRC32 verification (stored "
+                f"{want:#010x}, computed {shard_checksum(raw):#010x})",
+                key=key, file=loc["file"])
         dt = _np_dtype(entry.dtype)
         return np.frombuffer(raw, dtype=dt).reshape(entry.local_shape)
 
@@ -283,3 +409,299 @@ def load_state_dict(state_dict, path, process_group=None,
     finally:
         reader.close()
     return state_dict
+
+
+def verify_checkpoint(path, deep=True):
+    """Integrity-check the checkpoint at `path`.
+
+    deep=True (tools/tests): read every stored byte range and check it
+    against its recorded CRC32 — full bit-rot detection without
+    materializing tensors.  deep=False (the restore hot path): only
+    structural checks — metadata present, shard files exist, every
+    recorded byte range fits the file — leaving CRC verification to the
+    shard reader, which checksums each range as it streams it anyway
+    (so a restore pays ONE read+CRC pass, not two).
+
+    Returns {"files", "shards", "bytes", "unverified"} on success
+    (`unverified` counts v1 entries with no CRC); raises
+    `CheckpointCorruptionError` on any failure.
+    """
+    meta = _load_metadata(path)
+    if meta is None:
+        raise CheckpointCorruptionError(
+            f"no checkpoint metadata found under {path!r}")
+    files, shards, nbytes, unverified = set(), 0, 0, 0
+    handles = {}
+    sizes = {}
+    try:
+        for idx, loc in meta.storage_metadata.items():
+            if isinstance(loc, str):  # legacy whole-file pickle layout
+                unverified += 1
+                continue
+            fpath = os.path.join(path, loc["file"])
+            if deep:
+                f = handles.get(fpath)
+                if f is None:
+                    try:
+                        f = handles[fpath] = open(fpath, "rb")
+                    except OSError as e:
+                        raise CheckpointCorruptionError(
+                            f"checkpoint shard file {loc['file']!r} missing "
+                            f"under {path!r}: {e}", key=idx.tensor_key,
+                            file=loc["file"]) from e
+                f.seek(loc["byte_offset"])
+                raw = f.read(loc["nbytes"])
+                if len(raw) != loc["nbytes"]:
+                    raise CheckpointCorruptionError(
+                        f"shard {idx.tensor_key!r}@{idx.global_offset} "
+                        f"truncated in {loc['file']}", key=idx.tensor_key,
+                        file=loc["file"])
+                want = loc.get("crc32")
+                if want is None:
+                    unverified += 1
+                elif shard_checksum(raw) != want:
+                    raise CheckpointCorruptionError(
+                        f"shard {idx.tensor_key!r}@{idx.global_offset} "
+                        f"failed CRC32 in {loc['file']}",
+                        key=idx.tensor_key, file=loc["file"])
+            else:
+                size = sizes.get(fpath)
+                if size is None:
+                    try:
+                        size = sizes[fpath] = os.path.getsize(fpath)
+                    except OSError as e:
+                        raise CheckpointCorruptionError(
+                            f"checkpoint shard file {loc['file']!r} missing "
+                            f"under {path!r}: {e}", key=idx.tensor_key,
+                            file=loc["file"]) from e
+                if loc["byte_offset"] + loc["nbytes"] > size:
+                    raise CheckpointCorruptionError(
+                        f"shard {idx.tensor_key!r}@{idx.global_offset} "
+                        f"extends past {loc['file']} ({size} bytes)",
+                        key=idx.tensor_key, file=loc["file"])
+                if loc.get("crc32") is None:
+                    unverified += 1
+            files.add(loc["file"])
+            shards += 1
+            nbytes += loc["nbytes"]
+    finally:
+        for f in handles.values():
+            f.close()
+    return {"files": len(files), "shards": shards, "bytes": nbytes,
+            "unverified": unverified}
+
+
+class CheckpointManager:
+    """Keep-last-K checkpoint rotation with a `latest` pointer and
+    verify-then-rollback restore — the recovery target the guard
+    escalation and the elastic restart path load through.
+
+    Layout under `root`:
+        ckpt_00000007/          one save_state_dict checkpoint each
+        ckpt_00000008/
+        latest                  text file: basename of the newest commit
+    Saves are atomic end-to-end (shard files and metadata commit via
+    tmp+fsync+rename inside save_state_dict; the pointer file commits
+    the same way).  With `async_save=True` the pointer is written
+    optimistically before the background write lands — safe because
+    `latest_step()`/`restore()` only ever trust COMMITTED checkpoints
+    (metadata present, CRCs verified) and fall back otherwise.
+    Pruning keeps the newest `keep_last_k` directories,
+    and `restore()` walks newest → oldest, quarantining any checkpoint
+    that fails CRC verification (renamed to `<dir>.corrupt`) until one
+    verifies — a torn/corrupted latest falls back to the previous one
+    instead of killing the run.
+    """
+
+    LATEST = "latest"
+
+    def __init__(self, root, keep_last_k=3):
+        self.root = str(root)
+        self.keep_last_k = max(1, int(keep_last_k))
+        self._inflight_step = None  # step a possibly-async save targets
+        os.makedirs(self.root, exist_ok=True)
+
+    # --- naming -------------------------------------------------------------
+    def _dir(self, step):
+        return os.path.join(self.root, f"ckpt_{int(step):08d}")
+
+    def _step_of(self, name):
+        try:
+            return int(name.split("_", 1)[1])
+        except (IndexError, ValueError):
+            return None
+
+    def checkpoints(self):
+        """Committed checkpoint steps, oldest → newest (a checkpoint is
+        committed iff its metadata file exists)."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for name in names:
+            step = None
+            if name.startswith("ckpt_") and not name.endswith(".corrupt"):
+                step = self._step_of(name)
+            if step is None:
+                continue
+            d = os.path.join(self.root, name)
+            if any(n.endswith(".metadata") for n in
+                   (os.listdir(d) if os.path.isdir(d) else ())):
+                out.append(step)
+        return sorted(out)
+
+    def _committed(self, step):
+        d = self._dir(step)
+        return os.path.isdir(d) and any(
+            n.endswith(".metadata") for n in os.listdir(d))
+
+    def latest_step(self):
+        """The step the `latest` pointer names — but only if that
+        checkpoint is COMMITTED (metadata present).  The pointer is
+        written optimistically before an async save lands, so a pointer
+        to a not-yet/never-committed dir (crash mid-async-write) falls
+        back to the newest committed checkpoint instead of handing a
+        torn directory to an elastic restart."""
+        p = os.path.join(self.root, self.LATEST)
+        try:
+            with open(p) as f:
+                step = self._step_of(f.read().strip())
+            if step is not None and self._committed(step):
+                return step
+        except OSError:
+            pass
+        steps = self.checkpoints()
+        return steps[-1] if steps else None
+
+    def latest_path(self):
+        step = self.latest_step()
+        return None if step is None else self._dir(step)
+
+    # --- save ---------------------------------------------------------------
+    def save(self, state_dict, step=None, async_save=False):
+        """Write checkpoint `step` (default: newest+1), move the
+        `latest` pointer, prune beyond keep_last_k.  Returns the
+        checkpoint directory path."""
+        if step is None:
+            # join any in-flight async save FIRST: its metadata commit
+            # is what makes its step visible to checkpoints(), and
+            # without it back-to-back async saves would both pick the
+            # same step and overwrite each other
+            wait_async_save()
+            steps = self.checkpoints()
+            step = (steps[-1] + 1) if steps else 0
+        path = self._dir(step)
+        self._inflight_step = int(step)  # prune must never touch it
+        save_state_dict(state_dict, path, async_save=async_save)
+        self._commit_pointer(path)
+        self.prune()
+        return path
+
+    def _commit_pointer(self, path):
+        tmp = os.path.join(self.root, self.LATEST + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(path) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_and_rename(tmp, os.path.join(self.root, self.LATEST))
+
+    def prune(self):
+        """Drop committed checkpoints beyond the newest keep_last_k
+        (never the one `latest` points at), plus dead torn-save litter:
+        uncommitted ckpt dirs OLDER than the newest commit can never be
+        finished (only the newest save may still be landing async), so
+        they are removed instead of leaking one per mid-write kill.
+        Quarantined `.corrupt` dirs are kept — they are evidence."""
+        import shutil
+
+        steps = self.checkpoints()
+        keep = set(steps[-self.keep_last_k:])
+        latest = self.latest_step()
+        if latest is not None:
+            keep.add(latest)
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(self._dir(s), ignore_errors=True)
+        newest = steps[-1] if steps else None
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("ckpt_") or name.endswith(".corrupt"):
+                continue
+            s = self._step_of(name)
+            if s is None or s in steps or s == self._inflight_step:
+                # _inflight_step may still be landing on the async
+                # writer thread (an explicit step below the newest
+                # commit is legal) — never rmtree under it
+                continue
+            if newest is not None and s < newest:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    # --- restore ------------------------------------------------------------
+    def restore(self, state_dict):
+        """Fill `state_dict` from the newest checkpoint that passes CRC
+        verification (checked shard-by-shard as the load streams),
+        quarantining failed ones and rolling back to the previous —
+        raises CheckpointCorruptionError only when NO checkpoint in the
+        rotation survives.  Returns the step loaded.  A corrupt attempt
+        may partially fill `state_dict` before the fallback load
+        rewrites it; rotation checkpoints of one run share a key set,
+        so the successful load overwrites every touched leaf."""
+        try:
+            wait_async_save()  # an in-flight save must land first...
+        except Exception as e:
+            # ...but a FAILED async save must not block recovery: the
+            # whole point of restore() is falling back to the last
+            # committed checkpoint.  The failure is recorded, consumed,
+            # and the rotation walk below decides what is loadable.
+            try:
+                from ...observability import flight as _flight
+
+                _flight.record("resilience.async_save_error_at_restore",
+                               error=f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+        steps = self.checkpoints()
+        latest = self.latest_step()
+        if latest in steps:  # pointer order wins, then newest-first
+            steps = [s for s in steps if s != latest] + [latest]
+        if not steps:
+            raise CheckpointCorruptionError(
+                f"no committed checkpoints under {self.root!r}")
+        last_err = None
+        for step in reversed(steps):
+            path = self._dir(step)
+            try:
+                # structural gate only — the shard reader CRC-verifies
+                # every byte range as the load streams it, so recovery
+                # pays one read pass, not verify+load double I/O
+                verify_checkpoint(path, deep=False)
+                load_state_dict(state_dict, path)
+                return step
+            except CheckpointCorruptionError as e:
+                last_err = e
+                self._quarantine(path, e)
+        raise CheckpointCorruptionError(
+            f"every checkpoint under {self.root!r} failed verification "
+            f"(last: {last_err})") from last_err
+
+    def _quarantine(self, path, err):
+        """Move a corrupt checkpoint aside (evidence, and so the next
+        restore doesn't re-verify it) and record the rollback."""
+        try:
+            os.rename(path, path + ".corrupt")
+        except OSError:
+            pass
+        try:
+            from ...observability import flight as _flight
+            from ...observability import metrics as _metrics
+
+            _metrics.inc("resilience.rollbacks")
+            _flight.record("resilience.checkpoint_rollback", path=path,
+                           error=f"{type(err).__name__}: {err}")
+        except Exception:
+            pass

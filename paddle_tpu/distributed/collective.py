@@ -28,6 +28,7 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
+from ..resilience import faults as _faults
 from . import topology as topo_mod
 
 __all__ = [
@@ -128,6 +129,23 @@ def _axis_bound(axis):
         return False
 
 
+def _collective_retry():
+    """Retry policy for eager collectives: a host-dispatched collective
+    that dies on a transient fault (tunnel drop, preempted slice,
+    injected collective.call) is re-issued with backoff before the
+    error surfaces — "retry then raise" (EQuARX-class collective
+    faults, ISSUE 3).  PADDLE_TPU_COLLECTIVE_RETRIES tunes attempts."""
+    from ..resilience.retry import env_policy
+
+    return env_policy(
+        "collective", "PADDLE_TPU_COLLECTIVE_RETRIES", 3,
+        base_delay=0.02, max_delay=0.5,
+        # shape/dtype/spec mistakes are deterministic — only
+        # runtime-class failures (infra, injected) are transient
+        give_up_on=(TypeError, ValueError, KeyError, AttributeError,
+                    IndexError))
+
+
 def _eager_collective(name, x, group, per_shard_fn, out_sharding_spec=None):
     """Run `per_shard_fn` under shard_map over the group axis."""
     g = _default_group(group)
@@ -154,7 +172,16 @@ def _eager_collective(name, x, group, per_shard_fn, out_sharding_spec=None):
     # by that program's compile span instead
     with _trace.span(name, cat="collective", axis=axis,
                      shape=list(getattr(val, "shape", ()))):
-        return apply(name, fn, x if isinstance(x, Tensor) else Tensor(val))
+        def _dispatch():
+            # fault point INSIDE the retried callable: an armed
+            # collective.call rule with times=N fails the first N
+            # dispatches, then the retry succeeds — exactly the
+            # transient-fault shape the policy exists for
+            _faults.fire("collective.call", op=name, axis=axis)
+            return apply(name, fn,
+                         x if isinstance(x, Tensor) else Tensor(val))
+
+        return _collective_retry().call(_dispatch)
 
 
 def _infer_spec(val, mesh, axis):

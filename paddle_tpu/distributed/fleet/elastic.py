@@ -67,6 +67,19 @@ class ElasticManager:
         self._membership_version = 0
         self.enabled = os.environ.get("PADDLE_ELASTIC_ENABLE",
                                       "1") not in ("0", "false")
+        # heartbeat store traffic rides the resilience retry policy: a
+        # transient TCPStore error (master restarting, tunnel blip) is
+        # retried with backoff instead of silently dropping beats — and
+        # a persistent one is COUNTED (resilience.giveups) while the
+        # watch thread stays alive to beat again next interval
+        from ...resilience.retry import RetryPolicy
+
+        self._hb_retry = RetryPolicy(
+            "elastic.heartbeat", max_attempts=3,
+            base_delay=min(0.1, heartbeat_interval / 10.0),
+            max_delay=max(0.25, heartbeat_interval / 2.0))
+        self.missed_beats = 0
+        self._done_marked = False
 
     # --- registry ------------------------------------------------------------
     def _hb_key(self, rank=None):
@@ -74,17 +87,34 @@ class ElasticManager:
         return f"elastic/{self.job_id}/hb/{r}"
 
     def register(self):
-        """Join the registry and start heartbeating."""
-        self.store.set(self._hb_key(), str(time.time()))
-        self._thread = threading.Thread(target=self._beat, daemon=True)
+        """Join the registry and start heartbeating (idempotent: a
+        second register on a live manager is a no-op, and a register
+        after exit() restarts the beat)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._hb_retry.call(self._set_heartbeat)
+        self._thread = threading.Thread(target=self._beat, daemon=True,
+                                        name="elastic-heartbeat")
         self._thread.start()
+
+    def _set_heartbeat(self):
+        from ...resilience import faults as _faults
+
+        _faults.fire("store.op", op="heartbeat", rank=self.rank)
+        self.store.set(self._hb_key(), str(time.time()))
 
     def _beat(self):
         while not self._stop.is_set():
             try:
-                self.store.set(self._hb_key(), str(time.time()))
+                self._hb_retry.call(self._set_heartbeat)
             except Exception:
-                pass
+                # beats missed past the retry budget: the registry will
+                # age this rank out after heartbeat_ttl — but the thread
+                # MUST survive to resume beating if the store comes back
+                # (a dead watch thread turns one transient blip into a
+                # permanent eviction)
+                self.missed_beats += 1
             self._stop.wait(self.heartbeat_interval)
 
     def alive_ranks(self, scan_up_to=None):
@@ -137,14 +167,37 @@ class ElasticManager:
         self.store.set(f"elastic/{self.job_id}/done", "1")
 
     def exit(self, completed=True):
+        """Stop heartbeating and (rank 0, completed=True) mark the job
+        done.  Idempotent on BOTH effects independently: repeated
+        exit()/stop() calls — launcher teardown racing a signal handler
+        racing atexit — are safe, and a stop() followed by a genuine
+        exit(completed=True) still marks done (the done-marker has its
+        own once-guard, not the stop flag's)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-        if completed and self.rank == 0:
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2)
+        if t is None or t is threading.current_thread() \
+                or not t.is_alive():
+            self._thread = None
+        # else: the beat thread is stuck in a blocked store call — KEEP
+        # the handle so register() refuses to spawn a duplicate; _stop
+        # stays set, so the orphan exits when the call finally returns
+        if completed and self.rank == 0 and not self._done_marked:
             try:
                 self.mark_done()
+                self._done_marked = True
             except Exception:
                 pass
+
+    def stop(self):
+        """Generic teardown (failure paths, signal handlers, atexit):
+        stops heartbeating WITHOUT marking the job done — only an
+        explicit exit(completed=True) may cancel the restart protocol
+        for the other ranks."""
+        self.exit(completed=False)
+
+    shutdown = stop
 
     # --- restart protocol ----------------------------------------------------
     @staticmethod

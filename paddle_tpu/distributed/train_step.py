@@ -120,7 +120,8 @@ def _zero_shard_spec(spec, shape, dp_size, used_axes):
 class DistributedTrainStep:
     def __init__(self, model, optimizer, loss_fn=None, topo=None,
                  sharding_stage=0, recompute=False, amp_dtype=None,
-                 grad_clip_norm=None, loss_has_aux=False):
+                 grad_clip_norm=None, loss_has_aux=False, guard=None,
+                 checkpoint_manager=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -131,6 +132,22 @@ class DistributedTrainStep:
         self._compiled = None
         self._state = None
         self._param_names = [n for n, _ in model.named_parameters()]
+        # --- resilience (docs/RESILIENCE.md) ---
+        # guard=True/StepGuard: a finiteness reduction over loss+grads is
+        # fused into the compiled step and bad steps keep the previous
+        # state ON DEVICE (jnp.where select — with ok the selected leaves
+        # are the new values bit-for-bit, so a fault-free guarded run
+        # matches the unguarded trajectory exactly); the host sees one
+        # ok scalar per dispatch and escalates warn→skip→rollback.
+        if guard is True:
+            from ..resilience.guards import StepGuard
+
+            guard = StepGuard(name="train_step")
+        self.guard = guard or None
+        self._ckpt_mgr = checkpoint_manager
+        if self.guard is not None and self._ckpt_mgr is not None \
+                and self.guard.on_rollback is None:
+            self.guard.set_rollback(self.rollback)
 
     # --- sharding planning ---------------------------------------------------
     def _plan(self, params, slots):
@@ -253,6 +270,8 @@ class DistributedTrainStep:
                 lv = jnp.mean(lv)
             return lv.astype(jnp.float32), (new_buffers, new_key)
 
+        guarded = self.guard is not None
+
         def step(params, opt_state, buffers, key, lr, *batch_leaves):
             (loss, (new_buffers, new_key)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params, buffers, key,
@@ -278,7 +297,22 @@ class DistributedTrainStep:
                     for k, v in sd.items()}
                 for n, sd in new_opt["slots"].items()}
             new_opt = {"slots": new_opt_slots, "step": new_opt["step"]}
-            return loss, new_params, new_opt, new_buffers, new_key
+            if guarded:
+                # in-step NaN/Inf guard: one fused finiteness reduction
+                # over loss + grads; a bad step keeps params/opt/buffers
+                # (incl. the opt step counter) on device — no host
+                # round-trip, no torn half-applied update.  The PRNG key
+                # still advances: a skipped step must not replay the
+                # same dropout mask into the retry.
+                from ..resilience import guards as _guards
+
+                ok = _guards.tree_finite(loss, grads)
+                new_params = _guards.tree_select(ok, new_params, params)
+                new_opt = _guards.tree_select(ok, new_opt, opt_state)
+                new_buffers = _guards.tree_select(ok, new_buffers, buffers)
+            else:
+                ok = jnp.bool_(True)
+            return loss, ok, new_params, new_opt, new_buffers, new_key
 
         self._step_fn = step
         # with telemetry on, the compile happens inside an
@@ -305,18 +339,18 @@ class DistributedTrainStep:
                 params, opt_state, buffers, key = carry
                 lr_i = sl[0]
                 batch_sl = batch_leaves if is_repeat else sl[1:]
-                loss, p2, o2, b2, k2 = step(params, opt_state, buffers, key,
-                                            lr_i, *batch_sl)
-                return (p2, o2, b2, k2), loss
+                loss, ok, p2, o2, b2, k2 = step(params, opt_state, buffers,
+                                                key, lr_i, *batch_sl)
+                return (p2, o2, b2, k2), (loss, ok)
 
             # scan length comes from lrs' leading dim: one jit WRAPPER
             # serves every step count in this mode (a new N still
             # retraces inside it, since lrs' shape changes — but the
             # previous N's executable stays cached alongside)
             xs = (lrs,) if is_repeat else (lrs,) + tuple(batch_leaves)
-            (p, o, b, k), losses = jax.lax.scan(
+            (p, o, b, k), (losses, oks) = jax.lax.scan(
                 body, (params, opt_state, buffers, key), xs)
-            return losses, p, o, b, k
+            return losses, oks, p, o, b, k
 
         return _xla_cost.instrument(
             jax.jit(multi, donate_argnums=(0, 1, 2, 3),
@@ -378,10 +412,14 @@ class DistributedTrainStep:
             self._multi_sig = multi_sig
             self._compiled_multi = self._build_multi(
                 treedef, repeat is not None)
+        placed = self._maybe_poison(placed, n_steps=n_steps)
         s = self._state
-        losses, params, opt, buffers, key = self._compiled_multi(
+        losses, oks, params, opt, buffers, key = self._compiled_multi(
             s["params"], s["opt"], s["buffers"], s["key"], lrs, *placed)
         self._swap_state(params, opt, buffers, key)
+        if self.guard is not None:
+            for ok in np.asarray(oks):
+                self.guard.observe(bool(ok))
         return Tensor(losses)
 
     def _place_batch(self, batch, batch_axis):
@@ -458,16 +496,40 @@ class DistributedTrainStep:
         return compiled.lower(
             s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
 
+    def _maybe_poison(self, placed, n_steps=1):
+        """`train.step` fault point: kind="error" raises at dispatch;
+        kind="nan" poisons the first floating batch leaf so a NaN flows
+        through the REAL compiled program (loss and grads go non-finite
+        the way a genuinely bad batch/overflow makes them — the guard is
+        exercised end-to-end, not mocked)."""
+        from ..resilience import faults as _faults
+
+        action = _faults.fire("train.step", n_steps=n_steps)
+        if action is not None and action.kind == "nan":
+            for i, b in enumerate(placed):
+                if jnp.issubdtype(b.dtype, jnp.floating):
+                    placed = list(placed)
+                    # 0*nan propagates NaN elementwise, sharding intact
+                    placed[i] = b + jnp.asarray(
+                        float("nan"), b.dtype) * jnp.zeros_like(b)
+                    break
+        return placed
+
     def __call__(self, *batch):
         """batch: (inputs, labels) Tensors (loss_fn mode) or raw model args.
         Returns the loss as a Tensor; model/optimizer state advances."""
         placed, treedef = self._place_batch(batch, batch_axis=0)
         compiled = self._ensure_compiled(treedef)
+        placed = self._maybe_poison(placed)
         s = self._state
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, params, opt, buffers, key = compiled(
+        loss, ok, params, opt, buffers, key = compiled(
             s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
         self._swap_state(params, opt, buffers, key)
+        if self.guard is not None:
+            # ONE host-visible scalar per dispatch (the guarded mode's
+            # only extra transfer) drives the warn→skip→rollback ladder
+            self.guard.observe(bool(ok))
         return Tensor(loss)
 
     # --- state sync back to the eager model ---------------------------------
@@ -537,6 +599,10 @@ class DistributedTrainStep:
             self.init_state()
         tgt = self.train_state_dict()
         load_train_checkpoint(tgt, path, self.optimizer._learning_rate)
+        self._adopt(tgt)
+
+    def _adopt(self, tgt):
+        """Swap loaded train_state_dict leaves into the live state."""
         s = self._state
         s["params"] = {n: tgt[f"param.{n}"]._value for n in s["params"]}
         s["opt"]["slots"] = {
@@ -545,3 +611,37 @@ class DistributedTrainStep:
         s["opt"]["step"] = tgt["opt.step"]._value
         s["buffers"] = {n: tgt[f"buffer.{n}"]._value
                         for n in s["buffers"]}
+
+    # --- resilience: rotation checkpointing + guard rollback -----------------
+    def attach_checkpoint_manager(self, manager):
+        """Use a `distributed.checkpoint.CheckpointManager` as this
+        step's save target and (when a guard is active with no explicit
+        rollback) the guard's rollback source."""
+        self._ckpt_mgr = manager
+        if self.guard is not None and self.guard.on_rollback is None:
+            self.guard.set_rollback(self.rollback)
+        return self
+
+    def save_checkpoint(self, step=None, async_save=False):
+        """Checkpoint the full training state through the attached
+        manager (atomic, CRC'd, rotated); returns the checkpoint dir."""
+        if self._ckpt_mgr is None:
+            raise ValueError("no CheckpointManager attached "
+                             "(attach_checkpoint_manager first)")
+        return self._ckpt_mgr.save(self.train_state_dict(), step=step,
+                                   async_save=async_save)
+
+    def rollback(self):
+        """Restore the newest VERIFIED checkpoint from the attached
+        manager into the live state (corrupt ones are quarantined and
+        skipped) — the guard escalation lands here after K consecutive
+        non-finite steps.  Returns the checkpoint step restored."""
+        if self._ckpt_mgr is None:
+            raise ValueError("no CheckpointManager attached "
+                             "(attach_checkpoint_manager first)")
+        if self._state is None:
+            self.init_state()
+        tgt = self.train_state_dict()
+        step = self._ckpt_mgr.restore(tgt)
+        self._adopt(tgt)
+        return step

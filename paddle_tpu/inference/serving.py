@@ -27,17 +27,43 @@ from . import Config, create_predictor
 
 __all__ = ["InferenceServer", "InferenceClient", "serve"]
 
+# error classes that cannot be transient: no retry, no batch bisection
+_DETERMINISTIC_ERRORS = (TypeError, ValueError, KeyError, IndexError,
+                         AttributeError)
+
 
 class InferenceServer:
     """Serve one predictor. `start()` returns immediately (daemon thread);
     `serve_forever()` blocks. Concurrent requests serialize around the
-    predictor (one device queue) via a lock."""
+    predictor (one device queue) via a lock.
+
+    Resilience (docs/RESILIENCE.md): each request runs under a retry
+    policy (`request_retries` attempts within the `request_timeout`
+    deadline); when retries are exhausted and every input shares a
+    splittable leading batch dim, the request DEGRADES — the batch is
+    halved recursively (down to single items), halves run independently
+    and results re-concatenate, so one poisoned/oversized example costs
+    its half-batch a recompile instead of failing the whole request.
+    """
 
     def __init__(self, model_path: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, request_retries: int = 2,
+                 request_timeout: float = 30.0):
+        from ..resilience.retry import RetryPolicy
+
         cfg = Config(model_path)
         self._predictor = create_predictor(cfg)
         self._plock = threading.Lock()
+        self._request_timeout = (None if request_timeout is None
+                                 else float(request_timeout))
+        self._retry = RetryPolicy(
+            "serving", max_attempts=max(1, int(request_retries)),
+            base_delay=0.01, max_delay=0.25, deadline=request_timeout,
+            # deterministic request errors (wrong dtype/rank for the
+            # model) fail identically on every retry AND every split —
+            # surface them immediately (no retry, and _run_resilient
+            # re-raises them without bisecting the batch)
+            give_up_on=_DETERMINISTIC_ERRORS)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -98,10 +124,68 @@ class InferenceServer:
             inputs = [arrays[n] for n in feed_order]
         else:  # positional arr_0, arr_1, ... (np.savez default keys)
             inputs = [arrays[k] for k in sorted(arrays)]
-        with self._plock:
-            outs = p.run(inputs)
+        outs = self._run_resilient(inputs)
         return {n: np.asarray(v)
                 for n, v in zip(p.get_output_names(), outs)}
+
+    def _run_once(self, inputs):
+        from ..resilience import faults as _faults
+
+        _faults.fire("serving.request",
+                     batch=int(np.shape(inputs[0])[0])
+                     if inputs and np.ndim(inputs[0]) else 0)
+        with self._plock:
+            return self._predictor.run(inputs)
+
+    def _run_resilient(self, inputs, _depth=0, _deadline=None):
+        """Retry, then degrade-to-smaller-batch: split the batch in half
+        and serve each half independently (recursive, so a single bad
+        example bounds the blast radius to itself).  `request_timeout`
+        bounds the WHOLE request including the split tree — a wedged
+        predictor fails the request once, not once per half."""
+        import time as _time
+
+        if _deadline is None and self._request_timeout is not None:
+            _deadline = _time.monotonic() + self._request_timeout
+        if _deadline is not None and _time.monotonic() > _deadline:
+            raise TimeoutError(
+                f"serving request exceeded its {self._request_timeout}s "
+                f"deadline while degrading (depth {_depth})")
+        try:
+            return self._retry.call(self._run_once, inputs)
+        except _DETERMINISTIC_ERRORS:
+            raise  # same failure at any batch size — don't bisect
+        except Exception:
+            bs = {int(np.shape(x)[0]) for x in inputs if np.ndim(x) > 0}
+            if _depth >= 8 or len(bs) != 1 or next(iter(bs)) < 2 or (
+                    _deadline is not None
+                    and _time.monotonic() > _deadline):
+                raise  # nothing left to split — surface the real error
+            n = next(iter(bs))
+            self._note_degrade(n, _depth)
+
+            def half(sl):
+                # scalars/0-d inputs ride along unsliced
+                return [x[sl] if np.ndim(x) > 0 else x for x in inputs]
+
+            lo = self._run_resilient(half(slice(None, n // 2)),
+                                     _depth + 1, _deadline)
+            hi = self._run_resilient(half(slice(n // 2, None)),
+                                     _depth + 1, _deadline)
+            return [np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
+                    for a, b in zip(lo, hi)]
+
+    @staticmethod
+    def _note_degrade(batch, depth):
+        try:
+            from ..observability import flight as _flight
+            from ..observability import metrics as _metrics
+
+            _metrics.inc("resilience.degraded_batches")
+            _flight.record("resilience.serving_degrade", batch=batch,
+                           depth=depth)
+        except Exception:
+            pass
 
     def start(self):
         self._thread = threading.Thread(

@@ -19,6 +19,28 @@ from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 
+def _fetch_retry():
+    """Retry policy for `dataloader.batch`: a flaky storage read (or an
+    injected fault) re-fetches the same indices instead of killing the
+    epoch.  PADDLE_TPU_DATALOADER_RETRIES tunes attempts (default 2)."""
+    from ..resilience.retry import env_policy
+
+    return env_policy(
+        "dataloader", "PADDLE_TPU_DATALOADER_RETRIES", 2,
+        base_delay=0.01, max_delay=0.2,
+        # deterministic dataset bugs (bad index math, type errors in
+        # collate) fail the same way twice — don't re-fetch.  ValueError
+        # is DELIBERATELY retryable here: truncated/corrupt reads often
+        # surface as decode ValueErrors and deserve one re-fetch.
+        give_up_on=(TypeError, KeyError, AttributeError, IndexError))
+
+
+def _fire_batch_fault(n):
+    from ..resilience import faults as _faults
+
+    _faults.fire("dataloader.batch", n=int(n))
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
@@ -73,8 +95,12 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
-        samples = [self.dataset[i] for i in indices]
-        return self.collate_fn(samples)
+        def _once():
+            _fire_batch_fault(len(indices))
+            samples = [self.dataset[i] for i in indices]
+            return self.collate_fn(samples)
+
+        return _fetch_retry().call(_once)
 
     def _iter_single(self):
         if self._iterable_mode:
@@ -82,9 +108,13 @@ class DataLoader:
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
+                    # fault point only (no retry: an iterable source
+                    # cannot be re-asked for the same items)
+                    _fire_batch_fault(len(batch))
                     yield self.collate_fn(batch)
                     batch = []
             if batch and not self.drop_last:
+                _fire_batch_fault(len(batch))
                 yield self.collate_fn(batch)
             return
         if self.batch_sampler is None:

@@ -34,6 +34,21 @@ from ..observability import metrics as _metrics
 from ..observability import xla_cost as _xla_cost
 
 
+def _compile_retry():
+    """Retry policy for trace/compile builds: transient compile-path
+    faults (remote-chip tunnel blips, injected jit.compile) retry with
+    backoff before surfacing.  PADDLE_TPU_COMPILE_RETRIES tunes it."""
+    from ..resilience.retry import env_policy
+
+    return env_policy(
+        "jit.compile", "PADDLE_TPU_COMPILE_RETRIES", 2,
+        base_delay=0.05, max_delay=1.0,
+        # deterministic user bugs (shape/type errors in the traced
+        # fn) must not pay a second multi-second trace+compile
+        give_up_on=(TypeError, ValueError, KeyError, AttributeError,
+                    IndexError))
+
+
 def _sig_of(x):
     if isinstance(x, Tensor):
         return ("T", tuple(x._value.shape), str(x._value.dtype))
@@ -72,6 +87,13 @@ class StaticFunction:
         return self._layer.functional_state()
 
     def _build(self, treedef, static_leaves, n_dyn, training):
+        from ..resilience import faults as _faults
+
+        # `jit.compile` fault point: the round-5 incident class (tunnel
+        # window closed mid-compile) — the caller retries the build via
+        # the jit.compile retry policy before raising
+        _faults.fire("jit.compile",
+                     fn=getattr(self._fn, "__name__", "fn"))
         from . import dy2static
 
         # AST tier: rewrite tensor-dependent if/while to lax.cond/while_loop
@@ -144,8 +166,9 @@ class StaticFunction:
                     fn=getattr(self._fn, "__name__", "fn"),
                     n_cached=len(self._cache),
                     dyn_sig=repr(key[0])[:200])
-            compiled = self._build(treedef, static_leaves, len(dyn_idx),
-                                   training)
+            compiled = _compile_retry().call(
+                self._build, treedef, static_leaves, len(dyn_idx),
+                training)
             self._cache[key] = compiled
         else:
             _metrics.inc("jit.trace_cache.hit")

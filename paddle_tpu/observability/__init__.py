@@ -48,6 +48,25 @@ _SCHEMA_COUNTERS = tuple(
     + [("collective.calls", {"kind": k})
        for k in ("all_reduce", "all_gather", "reduce_scatter", "alltoall",
                  "alltoall_single", "broadcast", "send", "barrier")]
+    # resilience subsystem (ISSUE 3): fault injections, retry traffic,
+    # guard skips, checkpoint/guard rollbacks, watchdog trips — declared
+    # so a clean run reports zeros instead of omitting the keys
+    + [("resilience.faults", {"point": p})
+       for p in ("checkpoint.write", "collective.call", "dataloader.batch",
+                 "jit.compile", "train.step", "serving.request",
+                 "store.op")]
+    + [("resilience.retries", {"policy": p})
+       for p in ("collective", "elastic.heartbeat", "serving",
+                 "dataloader", "jit.compile")]
+    + [("resilience.giveups", {"policy": p})
+       for p in ("collective", "elastic.heartbeat", "serving",
+                 "dataloader", "jit.compile")]
+    + [("resilience.circuit_open", {"policy": p})
+       for p in ("collective", "elastic.heartbeat", "serving")]
+    + [("resilience.skipped_steps", {"source": s})
+       for s in ("guard", "amp", "amp_floor")]
+    + [("resilience.rollbacks", {}), ("resilience.watchdog_trips", {}),
+       ("resilience.degraded_batches", {})]
 )
 
 

@@ -36,7 +36,7 @@ import threading
 import time
 
 __all__ = ["StepTimer", "STEP_PHASE", "SCHEMA_VERSION", "validate_stream",
-           "summarize_stream"]
+           "summarize_stream", "add_record_hook", "remove_record_hook"]
 
 STEP_PHASE = "step_stats"
 SCHEMA_VERSION = "step_stats/v1"
@@ -61,6 +61,22 @@ def _obs_modules():
         return metrics, flight, trace
     except ImportError:
         return None, None, None
+
+
+# record hooks: callables invoked with each finished step record —
+# how the resilience watchdog heartbeats off step progress without
+# step_stats importing resilience (no cycle, no per-site wiring)
+_record_hooks: list = []
+
+
+def add_record_hook(fn) -> None:
+    if fn not in _record_hooks:
+        _record_hooks.append(fn)
+
+
+def remove_record_hook(fn) -> None:
+    if fn in _record_hooks:
+        _record_hooks.remove(fn)
 
 
 def _device_peak_bytes():
@@ -168,6 +184,11 @@ class StepTimer:
                     f.write(json.dumps(rec) + "\n")
             except OSError:
                 pass  # telemetry must never sink the run
+        for hook in list(_record_hooks):
+            try:
+                hook(rec)
+            except Exception:
+                pass  # a broken hook must never sink the run
         return rec
 
     def summary(self) -> dict:

@@ -1,0 +1,41 @@
+"""paddle_tpu.resilience — framework-wide fault tolerance (docs/RESILIENCE.md).
+
+PR 1-2 built the eyes (metrics, flight recorder, trace timeline); this
+package is the reflexes, and makes every failure mode deterministically
+injectable so the reflexes are testable in CI:
+
+  * `faults`   — seeded fault-injection harness with named fault points
+    (checkpoint.write, collective.call, dataloader.batch, jit.compile,
+    train.step, serving.request, store.op); every injection is a flight
+    event + `resilience.faults{point}` counter.
+  * `retry`    — RetryPolicy (exponential backoff + seeded jitter,
+    deadlines, circuit breaker) wrapped around eager collectives, the
+    elastic manager's TCPStore heartbeats, and serving requests.
+  * `guards`   — in-step NaN/Inf guard fused into the compiled train
+    step (finiteness reduction, on-device skip via `where`) + host-side
+    warn → skip → rollback escalation that composes with amp's
+    GradScaler and rolls back through hardened checkpoints.
+  * `watchdog` — heartbeat hang watchdog fed by StepTimer; dumps the
+    flight ring + Perfetto trace on stall before raising.
+
+Recovery state (what rollback restores through) lives in the hardened
+distributed checkpoint: atomic tmp+fsync+rename saves, per-shard CRC32s
+verified on load, keep-last-K rotation with a `latest` pointer
+(`distributed.checkpoint.CheckpointManager`).
+"""
+from __future__ import annotations
+
+from . import faults, guards, retry, watchdog  # noqa: F401
+from .faults import InjectedFault, inject  # noqa: F401
+from .guards import StepGuard  # noqa: F401
+from .retry import (  # noqa: F401
+    CircuitBreaker, CircuitOpenError, DeadlineExceeded, RetryPolicy,
+)
+from .watchdog import Watchdog, WatchdogStall  # noqa: F401
+
+__all__ = [
+    "faults", "retry", "guards", "watchdog",
+    "InjectedFault", "inject", "StepGuard", "RetryPolicy",
+    "CircuitBreaker", "CircuitOpenError", "DeadlineExceeded",
+    "Watchdog", "WatchdogStall",
+]

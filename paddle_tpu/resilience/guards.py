@@ -1,0 +1,153 @@
+"""In-step NaN/Inf guards: finiteness reductions that compile into the
+train step, plus the host-side warn → skip → rollback escalation.
+
+Two layers, split along the host/device boundary:
+
+* device (traced, zero host sync): `tree_finite(loss, grads)` reduces
+  loss + every grad leaf to ONE boolean; `tree_select(ok, new, old)`
+  keeps the previous state when the step went bad.  With `ok` True the
+  selected leaves are the new values bit-for-bit — a guarded step with
+  no faults matches the unguarded trajectory exactly (acceptance
+  criterion; `jnp.where` selects, it does not recompute).
+* host (`StepGuard`): consumes the per-step ok flag (one scalar
+  transfer), counts CONSECUTIVE bad steps and escalates:
+      1st bad        → "warn"  (flight event, counter — state already
+                                 kept by the in-program select)
+      2..K-1th bad   → "skip"
+      Kth bad        → "rollback" (invokes the registered callback —
+                                 typically CheckpointManager.restore —
+                                 and resets the streak)
+  Composes with `amp.GradScaler`: scaler-reported overflow steps are
+  EXPECTED while dynamic loss scaling searches for the right scale, so
+  they count toward the streak only after the scale has bottomed out
+  (scaler at min scale and still overflowing = genuinely sick run).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["tree_finite", "tree_select", "StepGuard", "RollbackError"]
+
+
+class RollbackError(RuntimeError):
+    """Escalation reached rollback but no rollback callback is
+    registered (or the callback itself failed)."""
+
+
+def tree_finite(loss, grads=None):
+    """One scalar bool: loss AND every floating grad leaf all-finite.
+    Traced — lowers to cheap reductions fused into the step program."""
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.all(jnp.isfinite(loss))
+    if grads is not None:
+        for g in jax.tree_util.tree_leaves(grads):
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+    return ok
+
+
+def tree_select(ok, new_tree, old_tree):
+    """Per-leaf `where(ok, new, old)` across a pytree (skip-step on
+    device: bad step keeps the old state without a host round-trip)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree)
+
+
+class StepGuard:
+    """Host-side escalation ladder over per-step finiteness flags.
+
+    observe(ok) → "ok" | "warn" | "skip" | "rollback".  Thread-safe;
+    wire a rollback with `on_rollback=` (callable, no args) or
+    `set_rollback(fn)` — DistributedTrainStep does this automatically
+    when it owns a CheckpointManager.
+    """
+
+    def __init__(self, max_consecutive_bad=3, on_rollback=None,
+                 raise_without_rollback=True, name="train"):
+        self.max_consecutive_bad = max(1, int(max_consecutive_bad))
+        self.on_rollback = on_rollback
+        self.raise_without_rollback = bool(raise_without_rollback)
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self.consecutive_bad = 0
+        self.total_bad = 0
+        self.total_steps = 0
+        self.rollbacks = 0
+
+    def set_rollback(self, fn):
+        self.on_rollback = fn
+
+    def observe(self, ok, source="guard"):
+        """Feed one step's finiteness verdict; returns the action taken.
+
+        source="amp": a GradScaler-reported overflow.  While the scaler
+        still has room to decrease the loss scale this is part of normal
+        dynamic-scaling operation — recorded (counter + flight) but not
+        escalated.  Pass source="amp_floor" (scaler at minimum scale)
+        to count it against the streak like a guard-detected bad step.
+        """
+        ok = bool(ok)
+        with self._lock:
+            self.total_steps += 1
+            if ok:
+                self.consecutive_bad = 0
+                return "ok"
+            self.total_bad += 1
+            if source == "amp":
+                self._emit("skip", source)
+                return "skip"
+            self.consecutive_bad += 1
+            streak = self.consecutive_bad
+            if streak >= self.max_consecutive_bad:
+                self.consecutive_bad = 0
+                self.rollbacks += 1
+                action = "rollback"
+            elif streak == 1:
+                action = "warn"
+            else:
+                action = "skip"
+        self._emit(action, source, streak=streak)
+        if action == "rollback":
+            self._rollback(streak)
+        return action
+
+    def _rollback(self, streak):
+        cb = self.on_rollback
+        if cb is None:
+            if self.raise_without_rollback:
+                raise RollbackError(
+                    f"guard {self.name!r}: {streak} consecutive non-finite "
+                    f"steps and no rollback target registered")
+            return
+        try:
+            cb()
+        except Exception as e:
+            raise RollbackError(
+                f"guard {self.name!r}: rollback callback failed: {e}") from e
+
+    def _emit(self, action, source, streak=None):
+        try:
+            from ..observability import flight as _flight
+            from ..observability import metrics as _metrics
+
+            if action in ("warn", "skip"):
+                _metrics.inc("resilience.skipped_steps", source=source)
+            elif action == "rollback":
+                _metrics.inc("resilience.rollbacks")
+            extra = {} if streak is None else {"streak": streak}
+            _flight.record(f"resilience.guard_{action}", guard=self.name,
+                           source=source, **extra)
+        except Exception:
+            pass
+
+    def state_dict(self):
+        with self._lock:
+            return {"consecutive_bad": self.consecutive_bad,
+                    "total_bad": self.total_bad,
+                    "total_steps": self.total_steps,
+                    "rollbacks": self.rollbacks}
