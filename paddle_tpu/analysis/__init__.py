@@ -1,0 +1,38 @@
+"""Framework-aware static analysis for the TPU build.
+
+Three layers, one report format (``file:line RULE message``):
+
+  * :mod:`.trace_safety` — AST trace-safety lint (PT001–PT007): tracer
+    leaks, concretization under jit, PRNG key reuse, bad static args,
+    silent exception swallows, mutable defaults, unmarked slow tests.
+  * :mod:`.lock_check` — lock-discipline race checker (PT101/PT102):
+    attributes written under ``with self._lock:`` must not be touched
+    outside it.
+  * :mod:`.hlo_audit` — jaxpr/StableHLO audit (PT201–PT203): host
+    transfers, silent f64 promotion, un-donated train-step buffers.
+
+Plus :mod:`.manifest_check` (PT301): OPS_MANIFEST.json claims vs the
+live module surface.
+
+CLI: ``python tools/pt_lint.py`` (``--check`` gates against
+``tools/lint_baseline.json``; ``--update-baseline`` refreshes it).
+Docs: ``docs/STATIC_ANALYSIS.md`` (rule catalog, suppression syntax).
+
+This package's fast path is stdlib-only by design: importing
+``paddle_tpu.analysis`` and running the ast/lock layers must never pay
+a jax import (the CLI runs pre-commit; the repo gate runs in tier-1).
+"""
+from .report import (  # noqa: F401
+    Suppressions, Violation, baseline_counts, diff_against_baseline,
+    load_baseline, render_report, save_baseline,
+)
+from .runner import (  # noqa: F401
+    DEFAULT_ROOTS, analyze_one_file, analyze_repo, iter_python_files,
+)
+
+__all__ = [
+    "Violation", "Suppressions", "load_baseline", "save_baseline",
+    "baseline_counts", "diff_against_baseline", "render_report",
+    "analyze_repo", "analyze_one_file", "iter_python_files",
+    "DEFAULT_ROOTS",
+]

@@ -1,0 +1,342 @@
+"""Layer 2: lock-discipline race checker (rules PT101/PT102).
+
+The threaded modules (observability trace/metrics/flight/step_stats,
+resilience watchdog, serving, autotune, elastic) share one idiom: a
+``threading.Lock`` guards a small set of mutable attributes, and every
+access is supposed to happen inside ``with self._lock:``. The bug class
+this catches is the one that bites ring-buffer/span code under the
+watchdog thread: a write that *usually* runs on one thread quietly
+starts racing when a daemon thread (watchdog poll, serving handler,
+heartbeat) touches the same attribute.
+
+Inference, per class:
+
+  * lock attributes — ``self.X = threading.Lock()/RLock()/Condition()``
+    (or any assignment to a name containing "lock"/"cv"/"cond");
+  * guarded set — attributes *written* at least once inside a
+    ``with self.<lock>:`` body anywhere in the class;
+  * violations — any access to a guarded attribute outside a lock body:
+    PT101 for writes, PT102 for reads.
+
+Deliberately excluded: ``__init__``/``__del__``/``__new__`` bodies
+(construction precedes sharing), the lock attributes themselves, and
+calls to the class's own methods (``self.beat()`` is a call, not state
+access — the callee's body is analyzed on its own).  Nested functions
+reset the lock context: a closure handed to another thread does NOT
+inherit the ``with`` that created it.
+
+The same inference runs at module level for the module-global
+``_lock``/``_cache`` idiom (autotune): globals written under a
+module-level lock become guarded; functions touching them outside the
+lock are flagged.  Helpers that are only ever called with the lock held
+annotate their ``def`` line with ``# pt-lint: ok[PT101,PT102]``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .report import Violation
+
+__all__ = ["analyze_source", "analyze_file", "RULE_IDS"]
+
+RULE_IDS = ("PT101", "PT102")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_SKIP_METHODS = {"__init__", "__new__", "__del__", "__init_subclass__"}
+# method calls that mutate their receiver: `self._events.append(x)` is
+# a WRITE to _events for guarded-set inference, same as subscript
+# assignment — the exact mutation a racing reader tears
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "update", "add",
+    "discard", "setdefault", "sort", "reverse",
+}
+# attributes holding these ctors are internally synchronized — calling
+# set()/clear()/put() on an Event/Queue needs no external lock, so they
+# never enter the guarded set
+_THREADSAFE_CTORS = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+                     "PriorityQueue", "local", "Barrier"}
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lock_ctor(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        _dotted(node.func).rsplit(".", 1)[-1] in _LOCK_CTORS
+
+
+def _lock_name_like(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or low.endswith(("_cv", "_cond", "_mutex"))
+
+
+class _Access:
+    __slots__ = ("attr", "write", "locked", "line", "func")
+
+    def __init__(self, attr, write, locked, line, func):
+        self.attr = attr
+        self.write = write
+        self.locked = locked
+        self.line = line
+        self.func = func
+
+
+def _self_attr(node):
+    """'X' when node is `self.X`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _with_locks(stmt: ast.With, lock_names, owner="self"):
+    """Lock attrs among this with-statement's context managers."""
+    held = set()
+    for item in stmt.items:
+        expr = item.context_expr
+        if owner == "self":
+            attr = _self_attr(expr)
+            if attr is not None and attr in lock_names:
+                held.add(attr)
+        else:
+            if isinstance(expr, ast.Name) and expr.id in lock_names:
+                held.add(expr.id)
+    return held
+
+
+def _scan_method(fn, lock_names, accesses, method_names):
+    """Collect self.X accesses in one method with lock-held context."""
+
+    def walk(node, locked):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            # a closure does not inherit the lock it was created under
+            for child in node.body:
+                walk(child, False)
+            return
+        if isinstance(node, ast.With):
+            held = _with_locks(node, lock_names)
+            for item in node.items:
+                walk(item.context_expr, locked)
+            for child in node.body:
+                walk(child, locked or bool(held))
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                accesses.append(_Access(attr, write, locked,
+                                        node.lineno, fn.name))
+            for child in ast.iter_child_nodes(node):
+                walk(child, locked)
+            return
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            # self._map[k] = v mutates _map: record the write, then the
+            # normal walk records the Load of the container
+            attr = _self_attr(node.value)
+            if attr is not None:
+                accesses.append(_Access(attr, True, locked,
+                                        node.lineno, fn.name))
+        if isinstance(node, ast.Call):
+            # self.method(...) is a call, not state access — skip the
+            # func attribute but scan the arguments
+            attr = _self_attr(node.func)
+            if attr is not None and attr in method_names:
+                for child in list(node.args) + [
+                        kw.value for kw in node.keywords]:
+                    walk(child, locked)
+                return
+            # self._events.append(x): a mutating method on a container
+            # attribute is a write to that attribute
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    accesses.append(_Access(attr, True, locked,
+                                            node.lineno, fn.name))
+        if isinstance(node, ast.AugAssign):
+            # x += 1 parses the target as Store only; it is a read AND
+            # a write — record both so `self.n += 1` outside the lock
+            # is caught as the read-modify-write race it is
+            attr = _self_attr(node.target)
+            if attr is not None:
+                accesses.append(_Access(attr, False, locked,
+                                        node.lineno, fn.name))
+        for child in ast.iter_child_nodes(node):
+            walk(child, locked)
+
+    for stmt in fn.body:
+        walk(stmt, False)
+
+
+def _analyze_class(cls: ast.ClassDef, path: str, out: list) -> None:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    method_names = {m.name for m in methods}
+    lock_names, threadsafe = set(), set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if _is_lock_ctor(node.value) or (
+                        _lock_name_like(attr)
+                        and isinstance(node.value, ast.Call)):
+                    lock_names.add(attr)
+                elif isinstance(node.value, ast.Call) and _dotted(
+                        node.value.func).rsplit(".", 1)[-1] in \
+                        _THREADSAFE_CTORS:
+                    threadsafe.add(attr)
+    if not lock_names:
+        return
+    accesses: list = []
+    for m in methods:
+        if m.name in _SKIP_METHODS:
+            continue
+        _scan_method(m, lock_names, accesses, method_names)
+    guarded = {a.attr for a in accesses
+               if a.write and a.locked and a.attr not in lock_names
+               and a.attr not in threadsafe}
+    for a in accesses:
+        if a.attr not in guarded or a.locked or a.attr in lock_names:
+            continue
+        if a.write:
+            out.append(Violation(
+                path, a.line, "PT101",
+                f"{cls.name}.{a.func} writes lock-guarded attribute "
+                f"`{a.attr}` outside `with self.<lock>:`"))
+        else:
+            out.append(Violation(
+                path, a.line, "PT102",
+                f"{cls.name}.{a.func} reads lock-guarded attribute "
+                f"`{a.attr}` outside `with self.<lock>:`"))
+
+
+def _local_bindings(fn) -> set:
+    """Names bound locally in `fn` (params, plain assignments, loop/
+    with/except targets) MINUS its `global` declarations — a Name whose
+    id is in this set refers to a local, not the module global."""
+    declared = {name for node in ast.walk(fn)
+                if isinstance(node, ast.Global)
+                for name in node.names}
+    bound = {a.arg for a in (
+        list(fn.args.posonlyargs) + list(fn.args.args)
+        + list(fn.args.kwonlyargs))}
+    for a in (fn.args.vararg, fn.args.kwarg):
+        if a is not None:
+            bound.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            bound.add(node.name)
+    return bound - declared
+
+
+def _analyze_module_level(tree: ast.Module, path: str, out: list) -> None:
+    """The `_lock = threading.Lock()` + module-global state idiom.
+
+    Candidate globals are the module's top-level assigned names; a
+    function's access counts whenever the name is not shadowed by a
+    local binding — reads never need a `global` statement, so requiring
+    one would make every lock-free read invisible (the exact race class
+    this layer exists for)."""
+    lock_names = set()
+    module_vars = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            names = {t.id for t in targets if isinstance(t, ast.Name)}
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                lock_names.update(names)
+            else:
+                module_vars.update(names)
+    module_vars -= lock_names
+    if not lock_names or not module_vars:
+        return
+    functions = [n for n in tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    accesses: list = []
+    for fn in functions:
+        visible = module_vars - _local_bindings(fn)
+        declared = {name for node in ast.walk(fn)
+                    if isinstance(node, ast.Global)
+                    for name in node.names}
+        watched = visible | (declared & module_vars)
+        if not watched:
+            continue
+
+        def walk(node, locked, fn=fn, watched=watched):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return
+            if isinstance(node, ast.With):
+                held = _with_locks(node, lock_names, owner="global")
+                for child in node.body:
+                    walk(child, locked or bool(held))
+                return
+            if isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)) and isinstance(
+                    node.value, ast.Name) and node.value.id in watched:
+                accesses.append(_Access(node.value.id, True, locked,
+                                        node.lineno, fn.name))
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and isinstance(
+                    node.func.value, ast.Name) and \
+                    node.func.value.id in watched:
+                accesses.append(_Access(node.func.value.id, True,
+                                        locked, node.lineno, fn.name))
+            if isinstance(node, ast.Name) and node.id in watched:
+                accesses.append(_Access(
+                    node.id, isinstance(node.ctx, (ast.Store, ast.Del)),
+                    locked, node.lineno, fn.name))
+            for child in ast.iter_child_nodes(node):
+                walk(child, locked)
+
+        for stmt in fn.body:
+            walk(stmt, False)
+    guarded = {a.attr for a in accesses if a.write and a.locked}
+    for a in accesses:
+        if a.attr not in guarded or a.locked:
+            continue
+        rule = "PT101" if a.write else "PT102"
+        verb = "writes" if a.write else "reads"
+        out.append(Violation(
+            path, a.line, rule,
+            f"{a.func} {verb} module-lock-guarded global `{a.attr}` "
+            f"outside `with <lock>:`"))
+
+
+def analyze_source(source: str, path: str,
+                   tree: ast.Module | None = None) -> list:
+    if tree is None:
+        tree = ast.parse(source)
+    out: list = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _analyze_class(node, path, out)
+    _analyze_module_level(tree, path, out)
+    out.sort(key=Violation.sort_key)
+    return out
+
+
+def analyze_file(path: str, rel: str | None = None) -> list:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return analyze_source(source, rel or path)

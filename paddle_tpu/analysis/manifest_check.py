@@ -1,0 +1,82 @@
+"""Manifest consistency audit (rule PT301): OPS_MANIFEST.json vs the
+live module surface.
+
+`tools/gen_op_manifest.py` stamps each op with `present` (resolvable in
+a public paddle_tpu namespace) and `tensor_method` (available as
+``Tensor.<name>``). Those claims rot silently: a refactor that drops an
+export keeps the manifest green until the next full regeneration. This
+audit re-derives both bits from the *imported* package and fails
+`pt_lint --check` on drift, so the manifest stays machine-true between
+regenerations.
+
+Resolution reuses `tools/gen_op_manifest._resolve` — the exact namespace
+list the generator used — so the audit can never disagree with the
+generator about what "present" means.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from .report import Violation
+
+__all__ = ["audit_manifest", "RULE_IDS"]
+
+RULE_IDS = ("PT301",)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _entry_line(manifest_text: str, name: str) -> int:
+    """Line of the op's entry in the json (file:line reporting)."""
+    needle = f'"name": "{name}"'
+    for i, line in enumerate(manifest_text.splitlines(), start=1):
+        if needle in line:
+            return i
+    return 0
+
+
+def audit_manifest(manifest_path: str | None = None) -> list:
+    path = manifest_path or os.path.join(_REPO, "OPS_MANIFEST.json")
+    rel = os.path.relpath(path, _REPO).replace("\\", "/")
+    with open(path) as f:
+        text = f.read()
+    manifest = json.loads(text)
+
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from gen_op_manifest import _resolve
+    finally:
+        sys.path.pop(0)
+    import paddle_tpu as P
+
+    out = []
+    for entry in manifest.get("ops", []):
+        name = entry.get("name")
+        if not name:
+            continue
+        where = _resolve(name)
+        present = where is not None
+        if bool(entry.get("present")) != present:
+            out.append(Violation(
+                rel, _entry_line(text, name), "PT301",
+                f"op `{name}` claims present={entry.get('present')} "
+                f"but live resolution says {present} — regenerate "
+                f"the manifest"))
+        elif present and entry.get("where") and \
+                entry.get("where") != where:
+            out.append(Violation(
+                rel, _entry_line(text, name), "PT301",
+                f"op `{name}` claims where={entry.get('where')!r} but "
+                f"resolves in {where!r} — regenerate the manifest"))
+        tm = hasattr(P.Tensor, name)
+        if bool(entry.get("tensor_method")) != tm:
+            out.append(Violation(
+                rel, _entry_line(text, name), "PT301",
+                f"op `{name}` claims tensor_method="
+                f"{entry.get('tensor_method')} but Tensor.{name} "
+                f"{'exists' if tm else 'does not exist'} — regenerate "
+                f"the manifest"))
+    return out

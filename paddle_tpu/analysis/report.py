@@ -1,0 +1,177 @@
+"""Violation model, inline suppressions, and the committed baseline.
+
+Every analysis layer (AST trace-safety, lock discipline, jaxpr/HLO
+audit, manifest drift) reports findings as `Violation` objects so the
+CLI, the baseline gate, and the tests speak one format:
+
+    file:line RULE message
+
+Baselines key on ``file|rule|message`` — deliberately line-free, so an
+unrelated edit that shifts a suppressed finding by ten lines does not
+resurrect it, while a *new* instance of the same rule in the same file
+with a different message (messages name the offending attribute /
+function / op) fails the gate.
+
+Inline suppressions (``# pt-lint: ok[PT005]`` or bare ``# pt-lint: ok``)
+work at three scopes: the violating line, the line directly above it, or
+a ``def``/``class`` header line (covers the whole body — the idiom for
+"this helper is always called with the lock held").
+
+Stdlib-only on purpose: `tools/pt_lint.py` must run without importing
+jax-heavy `paddle_tpu`.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+
+__all__ = [
+    "Violation", "Suppressions", "load_baseline", "save_baseline",
+    "baseline_counts", "diff_against_baseline", "render_report",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*pt-lint\s*:\s*ok(?:\[([A-Za-z0-9_, ]+)\])?")
+
+
+class Violation:
+    """One finding. `file` is a repo-relative posix path; `message` must
+    be stable across unrelated edits (name things, don't quote lines)."""
+
+    __slots__ = ("file", "line", "rule", "message")
+
+    def __init__(self, file: str, line: int, rule: str, message: str):
+        self.file = str(file).replace("\\", "/")
+        self.line = int(line)
+        self.rule = str(rule)
+        self.message = str(message)
+
+    def key(self) -> str:
+        return f"{self.file}|{self.rule}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line} {self.rule} {self.message}"
+
+    def sort_key(self):
+        return (self.file, self.line, self.rule, self.message)
+
+    def __repr__(self):  # debugging convenience
+        return f"Violation({self.render()!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Violation) and \
+            self.sort_key() == other.sort_key()
+
+    def __hash__(self):
+        return hash(self.sort_key())
+
+
+class Suppressions:
+    """Per-file suppression index built from source text (+ AST for
+    def/class-scoped suppressions)."""
+
+    def __init__(self, source: str, tree: ast.AST | None = None):
+        # line -> set of rule ids (empty set = suppress every rule)
+        self._lines: dict = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = m.group(1)
+                self._lines[i] = (
+                    set() if rules is None
+                    else {r.strip() for r in rules.split(",") if r.strip()})
+        # (start, end, rules) ranges from def/class headers carrying a
+        # suppression comment — covers the whole body
+        self._ranges: list = []
+        if tree is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    rules = self._lines.get(node.lineno)
+                    if rules is not None:
+                        end = getattr(node, "end_lineno", node.lineno)
+                        self._ranges.append((node.lineno, end, rules))
+
+    @staticmethod
+    def _matches(rules: set, rule: str) -> bool:
+        return not rules or rule in rules
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for probe in (line, line - 1):
+            rules = self._lines.get(probe)
+            if rules is not None and self._matches(rules, rule):
+                return True
+        for start, end, rules in self._ranges:
+            if start <= line <= end and self._matches(rules, rule):
+                return True
+        return False
+
+    def apply(self, violations):
+        return [v for v in violations
+                if not self.suppressed(v.line, v.rule)]
+
+
+# --------------------------- baseline ---------------------------
+
+BASELINE_VERSION = 1
+
+
+def baseline_counts(violations) -> dict:
+    counts: dict = {}
+    for v in violations:
+        counts[v.key()] = counts.get(v.key(), 0) + 1
+    return counts
+
+
+def save_baseline(path: str, violations) -> dict:
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": "pt_lint suppression baseline — regenerate with "
+                   "`python tools/pt_lint.py --update-baseline`. The "
+                   "gate fails only on violations NOT counted here.",
+        "counts": dict(sorted(baseline_counts(violations).items())),
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def load_baseline(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    counts = data.get("counts", {})
+    return counts if isinstance(counts, dict) else {}
+
+
+def diff_against_baseline(violations, baseline: dict):
+    """Split `violations` into (new, known) against baseline counts and
+    report stale baseline keys (fixed findings still counted).
+
+    When a file has more instances of an identical (rule, message) key
+    than the baseline allows, the *later* ones (by line) are the new
+    ones — deterministic, and matches "the code you just added is below
+    the code that was already there" often enough to be useful."""
+    by_key: dict = {}
+    for v in sorted(violations, key=Violation.sort_key):
+        by_key.setdefault(v.key(), []).append(v)
+    new, known = [], []
+    for key, vs in by_key.items():
+        allowed = int(baseline.get(key, 0))
+        known.extend(vs[:allowed])
+        new.extend(vs[allowed:])
+    stale = sorted(
+        key for key, allowed in baseline.items()
+        if allowed > len(by_key.get(key, [])))
+    new.sort(key=Violation.sort_key)
+    known.sort(key=Violation.sort_key)
+    return new, known, stale
+
+
+def render_report(violations) -> str:
+    return "\n".join(
+        v.render() for v in sorted(violations, key=Violation.sort_key))

@@ -1,0 +1,624 @@
+"""Layer 1: framework-aware AST trace-safety lint (rules PT001–PT007).
+
+Stdlib-``ast`` only. The rules encode the trace-time failure modes this
+jit+SPMD stack actually bites people with — each one is a bug class a
+tier-1 unit test cannot see because the poisoned value is only wrong
+*across* traces or *across* threads:
+
+  PT001  tracer leak        jit-traced code stores a traced value on
+                            ``self``/a global; the Tracer outlives its
+                            trace and the next call explodes (or worse,
+                            silently constant-folds the stale value)
+  PT002  concretization     ``bool()/int()/float()/.item()/if tensor:``
+                            on a traced value forces a host sync or a
+                            ConcretizationTypeError under ``to_static``
+  PT003  PRNG key reuse     the same key fed to two consumers without a
+                            ``split`` — correlated randomness, the
+                            classic silent-statistics bug
+  PT004  bad static args    ``static_argnames`` naming a parameter that
+                            does not exist (the arg silently stays
+                            traced) or a static parameter with a
+                            non-hashable default
+  PT005  silent swallow     broad ``except:`` whose body is only
+                            pass/continue — a black hole PR 3's fault
+                            injection cannot see through
+  PT006  mutable default    the shared-across-calls list/dict default
+  PT007  unmarked slow test test sleeps or runs a huge loop without a
+                            ``slow``/``chaos`` marker (tier-1 budget)
+
+Reachability: a function is considered jit-traced when it is decorated
+with / passed to ``jax.jit``/``pjit``/``to_static`` (any dotted
+spelling), or is called — by unambiguous name — from such a function in
+the same module (one module-local BFS; cross-module reachability is out
+of scope and handled by the baseline).
+"""
+from __future__ import annotations
+
+import ast
+
+from .report import Violation
+
+__all__ = ["analyze_source", "analyze_file", "RULE_IDS"]
+
+RULE_IDS = ("PT001", "PT002", "PT003", "PT004", "PT005", "PT006",
+            "PT007")
+
+_JIT_SUFFIXES = ("jit", "pjit", "to_static")
+# split/fold_in/key only mint keys in a PRNG context: either the dotted
+# callee mentions the rng machinery, or the receiver is a tracked key
+# (`cats.split("|")` on a string must not register)
+_KEY_MAKER_NAMES = ("prngkey", "key", "fold_in", "split")
+_KEY_CONTEXTS = ("random", "rng", "generator", "prng")
+_KEY_REFRESHERS = {"split", "fold_in", "clone"}
+_KEY_EXEMPT_SINKS = {"str", "repr", "print", "len", "id", "hash",
+                     "isinstance", "type", "list", "tuple", "format"}
+_CONCRETIZERS = {"bool", "int", "float"}
+_CONCRETIZING_METHODS = {"item", "tolist", "numpy", "__bool__",
+                         "__int__", "__float__"}
+# a call in an except body with one of these names counts as "the
+# failure was observed" (logging, metrics, flight, re-raise helpers)
+_OBSERVERS = {
+    "log", "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "record", "inc", "observe", "set_gauge", "instant",
+    "dump", "print", "emit", "fire", "fail", "abort",
+}
+_SLEEP_THRESHOLD_S = 0.5
+_LOOP_THRESHOLD = 100_000
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted name for a Name/Attribute chain ('' else)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_callee(node) -> bool:
+    dotted = _dotted(node)
+    if not dotted:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    return last in _JIT_SUFFIXES
+
+
+def _jit_decorator(dec) -> bool:
+    """True for @jax.jit / @to_static / @partial(jax.jit, ...) /
+    @jit.to_static(input_spec=...) style decorators."""
+    if _is_jit_callee(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_callee(dec.func):
+            return True
+        if _dotted(dec.func).rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _is_jit_callee(dec.args[0])
+    return False
+
+
+def _mutable_default(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) in ("list", "dict", "set", "bytearray")
+    return False
+
+
+def _const_num(node):
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+class _FunctionIndex:
+    """All function/method defs in a module plus a name->def map that
+    only answers for *unambiguous* simple names (the conservative basis
+    of the reachability BFS)."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: list = []
+        by_name: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.append(node)
+                by_name.setdefault(node.name, []).append(node)
+        self._unique = {name: defs[0] for name, defs in by_name.items()
+                        if len(defs) == 1}
+
+    def resolve(self, name: str):
+        return self._unique.get(name)
+
+
+def _called_names(fn) -> set:
+    """Simple callee names invoked inside `fn` (not inside nested
+    defs — those have their own trace context)."""
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.depth = 0
+
+        def visit_FunctionDef(self, node):
+            if node is fn:
+                self.generic_visit(node)
+            # nested defs: their calls happen when *they* run, not here
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            dotted = _dotted(node.func)
+            if dotted:
+                names.add(dotted.rsplit(".", 1)[-1])
+            self.generic_visit(node)
+
+    V().visit(fn)
+    return names
+
+
+def _traced_functions(tree: ast.Module, index: _FunctionIndex) -> set:
+    """The set of FunctionDef nodes reachable from a jit entry point."""
+    entries = set()
+    for fn in index.defs:
+        if any(_jit_decorator(d) for d in fn.decorator_list):
+            entries.add(fn)
+    # call sites: jax.jit(fn) / to_static(fn, ...) with fn a bare name
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_callee(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    target = index.resolve(arg.id)
+                    if target is not None:
+                        entries.add(target)
+    # BFS through unambiguous module-local callees
+    traced, frontier = set(), list(entries)
+    while frontier:
+        fn = frontier.pop()
+        if fn in traced:
+            continue
+        traced.add(fn)
+        for name in _called_names(fn):
+            target = index.resolve(name)
+            if target is not None and target not in traced:
+                frontier.append(target)
+    return traced
+
+
+# --------------------------- per-rule visitors ---------------------------
+
+
+def _check_traced_body(fn, path, out):
+    """PT001 + PT002 inside one jit-traced function body."""
+    params = {a.arg for a in (
+        list(fn.args.posonlyargs) + list(fn.args.args)
+        + list(fn.args.kwonlyargs))}
+    params.discard("self")
+    globals_decl = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if node is fn:
+                self.generic_visit(node)
+            # nested defs keep their own context (they are reached by
+            # the BFS if called unambiguously)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Global(self, node):
+            globals_decl.update(node.names)
+            self.generic_visit(node)
+
+        def _flag_store(self, target, node):
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                out.append(Violation(
+                    path, node.lineno, "PT001",
+                    f"jit-traced `{fn.name}` stores to "
+                    f"self.{target.attr} — a traced value leaks the "
+                    f"trace (stale Tracer on the next call)"))
+            elif isinstance(target, ast.Name) and \
+                    target.id in globals_decl:
+                out.append(Violation(
+                    path, node.lineno, "PT001",
+                    f"jit-traced `{fn.name}` stores to global "
+                    f"`{target.id}` — a traced value leaks the trace"))
+
+        def visit_Assign(self, node):
+            if not isinstance(node.value, ast.Constant):
+                for t in node.targets:
+                    self._flag_store(t, node)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._flag_store(node.target, node)
+            self.generic_visit(node)
+
+        def visit_Call(self, node):
+            dotted = _dotted(node.func)
+            if dotted in _CONCRETIZERS and node.args and isinstance(
+                    node.args[0], ast.Name) and \
+                    node.args[0].id in params:
+                out.append(Violation(
+                    path, node.lineno, "PT002",
+                    f"`{dotted}()` on traced argument "
+                    f"`{node.args[0].id}` inside jit-traced "
+                    f"`{fn.name}` — concretizes under trace"))
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _CONCRETIZING_METHODS and \
+                    not node.args:
+                base = node.func.value
+                if isinstance(base, ast.Name) and base.id in params:
+                    out.append(Violation(
+                        path, node.lineno, "PT002",
+                        f"`.{node.func.attr}()` on traced argument "
+                        f"`{base.id}` inside jit-traced `{fn.name}` — "
+                        f"forces a host transfer under trace"))
+            self.generic_visit(node)
+
+        def _flag_branch(self, node, kind):
+            test = node.test
+            if isinstance(test, ast.UnaryOp) and isinstance(
+                    test.op, ast.Not):
+                test = test.operand
+            if isinstance(test, ast.Name) and test.id in params:
+                out.append(Violation(
+                    path, node.lineno, "PT002",
+                    f"`{kind} {test.id}:` on traced argument inside "
+                    f"jit-traced `{fn.name}` — data-dependent python "
+                    f"control flow concretizes under trace"))
+
+        def visit_If(self, node):
+            self._flag_branch(node, "if")
+            self.generic_visit(node)
+
+        def visit_While(self, node):
+            self._flag_branch(node, "while")
+            self.generic_visit(node)
+
+    V().visit(fn)
+
+
+def _is_key_maker(call: ast.Call, state: dict) -> bool:
+    dotted = _dotted(call.func)
+    if not dotted:
+        return False
+    low = dotted.lower()
+    last = low.rsplit(".", 1)[-1]
+    if last == "prngkey":
+        return True
+    if last not in _KEY_MAKER_NAMES:
+        return False
+    if any(ctx in low for ctx in _KEY_CONTEXTS):
+        return True
+    # receiver is itself a tracked key: k2 = key.split()
+    base = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+    return base in state
+
+
+def _check_key_reuse(fn, path, out):
+    """PT003: statement-order scan of one function body.
+
+    Branch-aware (if/else arms see copies of the key state, merged
+    afterwards: a key consumed once in EACH arm is used once, not
+    twice) and loop-aware (loop bodies run twice, so a key minted
+    before the loop and consumed inside it without an in-loop split is
+    reuse)."""
+    found: dict = {}  # (line, var) -> Violation, deduped across passes
+
+    def flag(var, callee, line):
+        found.setdefault((line, var), Violation(
+            path, line, "PT003",
+            f"PRNG key `{var}` passed to a second consumer "
+            f"(`{callee}`) without a split in `{fn.name}` — "
+            f"correlated randomness"))
+
+    def visit_expr(node, state):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scope: separate key discipline
+        if isinstance(node, ast.Call):
+            visit_expr(node.func, state)
+            callee = _dotted(node.func).rsplit(".", 1)[-1]
+            consumes = callee not in _KEY_REFRESHERS and \
+                callee not in _KEY_EXEMPT_SINKS
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in state:
+                    if consumes:
+                        if state[arg.id] == "used":
+                            flag(arg.id, callee, node.lineno)
+                        else:
+                            state[arg.id] = "used"
+                else:
+                    visit_expr(arg, state)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit_expr(child, state)
+
+    def assign_targets(node):
+        targets = []
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                targets.extend(e.id for e in t.elts
+                               if isinstance(e, ast.Name))
+        return targets
+
+    def merge(base, arms):
+        """Key state after diverging control flow: tracked only if
+        tracked in every arm; 'used' as soon as any arm used it."""
+        for var in list(base):
+            if not all(var in arm for arm in arms):
+                del base[var]
+            elif any(arm[var] == "used" for arm in arms):
+                base[var] = "used"
+        for arm in arms:  # keys minted inside an arm
+            for var, st in arm.items():
+                if var not in base and all(var in a for a in arms):
+                    base[var] = "used" if any(
+                        a[var] == "used" for a in arms) else st
+
+    def run(stmts, state):
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Assign):
+                visit_expr(node.value, state)
+                fresh = isinstance(node.value, ast.Call) and \
+                    _is_key_maker(node.value, state)
+                for name in assign_targets(node):
+                    if fresh:
+                        state[name] = "fresh"
+                    else:
+                        state.pop(name, None)
+            elif isinstance(node, ast.If):
+                body_state = dict(state)
+                else_state = dict(state)
+                run(node.body, body_state)
+                run(node.orelse, else_state)
+                merge(state, [body_state, else_state])
+            elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    visit_expr(node.iter, state)
+                else:
+                    visit_expr(node.test, state)
+                # two passes: the second flags keys re-consumed across
+                # iterations without an in-loop split
+                run(node.body, state)
+                run(node.body, state)
+                run(node.orelse, state)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    visit_expr(item.context_expr, state)
+                run(node.body, state)
+            elif isinstance(node, ast.Try):
+                run(node.body, state)
+                for handler in node.handlers:
+                    run(handler.body, dict(state))
+                run(node.orelse, state)
+                run(node.finalbody, state)
+            else:
+                visit_expr(node, state)
+
+    run(fn.body, {})
+    out.extend(found.values())
+
+
+def _check_jit_static_args(tree, index, path, out):
+    """PT004: static_argnames/nums vs the wrapped function's signature."""
+
+    def check(fn, call, lineno):
+        pos_params = [a.arg for a in (
+            list(fn.args.posonlyargs) + list(fn.args.args))]
+        all_params = set(pos_params) | {
+            a.arg for a in fn.args.kwonlyargs}
+        defaults = {}
+        pos_with_default = pos_params[len(pos_params)
+                                      - len(fn.args.defaults):]
+        defaults.update(zip(pos_with_default, fn.args.defaults))
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if d is not None:
+                defaults[a.arg] = d
+        static_names, static_nums = [], []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                        kw.value.value, str):
+                    static_names.append(kw.value.value)
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    static_names.extend(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+            elif kw.arg == "static_argnums":
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                        kw.value.value, int):
+                    static_nums.append(kw.value.value)
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    static_nums.extend(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int))
+        has_kwargs = fn.args.kwarg is not None
+        for name in static_names:
+            if name not in all_params and not has_kwargs:
+                out.append(Violation(
+                    path, lineno, "PT004",
+                    f"static_argnames={name!r} does not name a "
+                    f"parameter of `{fn.name}` — the intended static "
+                    f"arg silently stays traced"))
+            elif name in defaults and _mutable_default(defaults[name]):
+                out.append(Violation(
+                    path, lineno, "PT004",
+                    f"static parameter `{name}` of `{fn.name}` has a "
+                    f"non-hashable default — jit cache key will raise "
+                    f"TypeError at call time"))
+        has_vararg = fn.args.vararg is not None
+        for num in static_nums:
+            if num >= len(pos_params) and not has_vararg:
+                out.append(Violation(
+                    path, lineno, "PT004",
+                    f"static_argnums={num} is out of range for "
+                    f"`{fn.name}` ({len(pos_params)} positional "
+                    f"parameters)"))
+            elif 0 <= num < len(pos_params):
+                name = pos_params[num]
+                if name in defaults and _mutable_default(defaults[name]):
+                    out.append(Violation(
+                        path, lineno, "PT004",
+                        f"static parameter `{name}` of `{fn.name}` "
+                        f"has a non-hashable default — jit cache key "
+                        f"will raise TypeError at call time"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_callee(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                fn = index.resolve(node.args[0].id)
+                if fn is not None:
+                    check(fn, node, node.lineno)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _jit_decorator(dec):
+                    check(node, dec, dec.lineno)
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in ("Exception", "BaseException")
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+def _check_silent_swallow(tree, path, out):
+    """PT005: broad except whose body is only pass/continue/break."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _broad_handler(node):
+            continue
+        trivial = all(
+            isinstance(s, (ast.Pass, ast.Continue, ast.Break))
+            or (isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Constant))
+            for s in node.body)
+        if trivial:
+            caught = _dotted(node.type) if node.type is not None else \
+                "bare except"
+            out.append(Violation(
+                path, node.lineno, "PT005",
+                f"broad `except {caught or '...'}` swallows the "
+                f"failure with no flight/metrics/log signal — "
+                f"narrow it or record it"))
+
+
+def _check_mutable_defaults(tree, path, out):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if _mutable_default(d):
+                out.append(Violation(
+                    path, d.lineno, "PT006",
+                    f"mutable default argument on `{node.name}` — "
+                    f"shared across calls"))
+
+
+def _has_marker(decorators, markers=("slow", "chaos")) -> bool:
+    for dec in decorators:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _dotted(target)
+        if dotted.rsplit(".", 1)[-1] in markers and "mark" in dotted:
+            return True
+    return False
+
+
+def _check_unmarked_slow_tests(tree, path, out):
+    """PT007 (tests/ only): sleeps/huge loops without slow|chaos mark."""
+
+    def check_test(fn, class_marked):
+        if class_marked or _has_marker(fn.decorator_list):
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                last = dotted.rsplit(".", 1)[-1]
+                if last == "sleep" and node.args:
+                    v = _const_num(node.args[0])
+                    if v is not None and v >= _SLEEP_THRESHOLD_S:
+                        out.append(Violation(
+                            path, node.lineno, "PT007",
+                            f"test `{fn.name}` sleeps {v}s without a "
+                            f"slow/chaos marker — tier-1 budget"))
+                elif last == "range" and node.args:
+                    # range(stop) / range(start, stop[, step]): the
+                    # trip count lives in the stop arg, not args[-1]
+                    stop = node.args[1] if len(node.args) >= 2 \
+                        else node.args[0]
+                    v = _const_num(stop)
+                    if v is not None and v >= _LOOP_THRESHOLD:
+                        out.append(Violation(
+                            path, node.lineno, "PT007",
+                            f"test `{fn.name}` loops over {int(v)} "
+                            f"steps without a slow/chaos marker — "
+                            f"tier-1 budget"))
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("test"):
+            check_test(node, False)
+        elif isinstance(node, ast.ClassDef):
+            marked = _has_marker(node.decorator_list)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name.startswith("test"):
+                    check_test(sub, marked)
+
+
+# --------------------------- entry points ---------------------------
+
+
+def analyze_source(source: str, path: str, is_test_file=None,
+                   tree: ast.Module | None = None) -> list:
+    """All Layer-1 violations for one file's source (suppressions NOT
+    applied here — the runner owns them; see runner.analyze_repo).
+    Pass `tree` to reuse an existing parse (the runner parses once and
+    shares it across layers)."""
+    if tree is None:
+        tree = ast.parse(source)
+    out: list = []
+    index = _FunctionIndex(tree)
+    traced = _traced_functions(tree, index)
+    for fn in sorted(traced, key=lambda f: f.lineno):
+        _check_traced_body(fn, path, out)
+    for fn in index.defs:
+        _check_key_reuse(fn, path, out)
+    _check_jit_static_args(tree, index, path, out)
+    _check_silent_swallow(tree, path, out)
+    _check_mutable_defaults(tree, path, out)
+    if is_test_file is None:
+        norm = path.replace("\\", "/")
+        is_test_file = norm.startswith("tests/") or "/tests/" in norm
+    if is_test_file:
+        _check_unmarked_slow_tests(tree, path, out)
+    out.sort(key=Violation.sort_key)
+    return out
+
+
+def analyze_file(path: str, rel: str | None = None) -> list:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return analyze_source(source, rel or path)

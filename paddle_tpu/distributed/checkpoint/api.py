@@ -403,8 +403,19 @@ def load_state_dict(state_dict, path, process_group=None,
             if tgt_sharding is not None:
                 try:
                     val = jax.device_put(val, tgt_sharding)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # the value is still correct, just not laid out on
+                    # the target sharding — a silent fallback here
+                    # becomes an OOM or a cross-host transfer storm at
+                    # first use, so leave a flight breadcrumb
+                    try:
+                        from ...observability import flight as _flight
+
+                        _flight.record(
+                            "checkpoint.resharding_failed", key=key,
+                            error=f"{type(e).__name__}: {e}")
+                    except Exception:  # pt-lint: ok[PT005]
+                        pass           # (observability fan-out guard)
             t._value = val
     finally:
         reader.close()
@@ -663,8 +674,8 @@ class CheckpointManager:
 
                 _flight.record("resilience.async_save_error_at_restore",
                                error=f"{type(e).__name__}: {e}")
-            except Exception:
-                pass
+            except Exception:  # pt-lint: ok[PT005]
+                pass           # (observability fan-out guard)
         steps = self.checkpoints()
         latest = self.latest_step()
         if latest in steps:  # pointer order wins, then newest-first
@@ -703,5 +714,5 @@ class CheckpointManager:
             _metrics.inc("resilience.rollbacks")
             _flight.record("resilience.checkpoint_rollback", path=path,
                            error=f"{type(err).__name__}: {err}")
-        except Exception:
-            pass
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard)

@@ -126,6 +126,17 @@ class ElasticManager:
             try:
                 ts = float(self.store.get(self._hb_key(r), timeout=0.5))
             except Exception:
+                # an absent key IS the signal (rank not registered /
+                # aged out) — but count the scan miss so a store that
+                # errors on every rank is distinguishable from a world
+                # that is genuinely down to one rank
+                try:
+                    from ...observability import metrics as _metrics
+
+                    _metrics.inc("resilience.heartbeat_scan_misses")
+                except Exception:  # pt-lint: ok[PT005]
+                    pass           # (observability fan-out guard: the
+                    # membership scan must survive interpreter teardown)
                 continue
             if now - ts <= self.heartbeat_ttl:
                 alive.append(r)
@@ -187,8 +198,21 @@ class ElasticManager:
             try:
                 self.mark_done()
                 self._done_marked = True
-            except Exception:
-                pass
+            except Exception as e:
+                # an unmarked done means the other ranks will treat the
+                # next membership change as a failure and restart — a
+                # state worth a flight event, not a silent shrug
+                try:
+                    from ...observability import flight as _flight
+
+                    _flight.record(
+                        "resilience.elastic_mark_done_failed",
+                        job_id=self.job_id,
+                        error=f"{type(e).__name__}: {e}")
+                except Exception:  # pt-lint: ok[PT005]
+                    pass           # (observability fan-out guard:
+                    # exit() runs in signal/atexit paths and must
+                    # never raise)
 
     def stop(self):
         """Generic teardown (failure paths, signal handlers, atexit):

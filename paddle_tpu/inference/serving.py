@@ -184,8 +184,11 @@ class InferenceServer:
             _metrics.inc("resilience.degraded_batches")
             _flight.record("resilience.serving_degrade", batch=batch,
                            depth=depth)
-        except Exception:
-            pass
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard: _note_degrade
+            # runs inside _run_resilient's recovery handler — a
+            # telemetry error escaping here would abort the
+            # degrade-to-smaller-batch recursion and fail the request)
 
     def start(self):
         self._thread = threading.Thread(

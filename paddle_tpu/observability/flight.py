@@ -128,8 +128,11 @@ def install_crash_hook() -> None:
                 p = _default.dump(reason=f"crash:{exc_type.__name__}")
                 print(f"[observability] flight recorder dumped to {p}",
                       file=sys.stderr)
-        except Exception:
-            pass
+        except Exception as e:
+            # a broken disk must never mask the original exception —
+            # but the operator should know the black box is gone
+            print(f"[observability] flight dump failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         prev(exc_type, exc, tb)
 
     sys.excepthook = hook

@@ -188,7 +188,11 @@ class StepTimer:
             try:
                 hook(rec)
             except Exception:
-                pass  # a broken hook must never sink the run
+                # a broken hook must never sink the run — but a hook
+                # that dies silently (a dead watchdog heartbeat!) is
+                # exactly the failure the metrics exist to surface
+                if metrics is not None:
+                    metrics.inc("step.record_hook_errors")
         return rec
 
     def summary(self) -> dict:

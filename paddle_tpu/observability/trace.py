@@ -133,7 +133,9 @@ class SpanTracer:
 
     def _tid(self) -> int:
         ident = threading.get_ident()
-        tid = self._tids.get(ident)
+        # double-checked locking: the lock-free read is a GIL-atomic
+        # dict get on this thread's own (immutable-once-written) entry
+        tid = self._tids.get(ident)  # pt-lint: ok[PT102]
         if tid is None:
             with self._lock:
                 tid = self._tids.get(ident)
@@ -146,7 +148,8 @@ class SpanTracer:
     def virtual_tid(self, track: str) -> int:
         """Stable tid for a synthetic track (frames, counters); rendered
         below the real threads via thread_sort_index."""
-        tid = self._virtual.get(track)
+        # same double-checked pattern as _tid (lock-free first probe)
+        tid = self._virtual.get(track)  # pt-lint: ok[PT102]
         if tid is None:
             with self._lock:
                 tid = self._virtual.get(track)

@@ -69,16 +69,21 @@ def analyze_compiled(compiled, label: str = "jit") -> dict:
             for src, dst in _COST_KEYS:
                 if src in ca:
                     out[dst] = float(ca[src])
-    except Exception:
-        pass
+    except Exception as e:
+        # degrade to fewer keys, but visibly: a backend whose
+        # cost_analysis() suddenly stops answering is a signal (it was
+        # the whole r5 MFU-forensics channel), not routine
+        _flight.record("xla.cost_analysis_failed", label=str(label),
+                       error=type(e).__name__)
     try:
         ma = compiled.memory_analysis()
         for attr, dst in _MEM_KEYS:
             v = getattr(ma, attr, None)
             if v is not None:
                 out[dst] = int(v)
-    except Exception:
-        pass
+    except Exception as e:
+        _flight.record("xla.memory_analysis_failed", label=str(label),
+                       error=type(e).__name__)
     return out
 
 
@@ -126,8 +131,8 @@ class InstrumentedJit:
         self._lock = threading.Lock()
         try:
             self.__name__ = getattr(jitted, "__name__", self.label)
-        except Exception:
-            pass
+        except (AttributeError, TypeError):
+            pass  # some wrappers refuse __name__; the label suffices
 
     def lower(self, *args, **kwargs):
         return self._jitted.lower(*args, **kwargs)
@@ -167,7 +172,9 @@ class InstrumentedJit:
         key = self._sig(leaves)
         if key is None:
             return self._jitted(*args, **kwargs)
-        if key not in self._compiled:
+        # deliberate lock-free fast path: dict membership is GIL-atomic
+        # and a stale miss only costs re-entering the claim protocol
+        if key not in self._compiled:  # pt-lint: ok[PT102]
             # claim the signature under the lock so concurrent first
             # calls never run the multi-second lower+compile twice;
             # losers (and callers racing the winner) take the plain
@@ -187,8 +194,11 @@ class InstrumentedJit:
                             sp.args.update(costs)
                     except Exception:
                         compiled = None  # permanent fallback for this sig
-                self._compiled[key] = compiled
-        entry = self._compiled[key]
+                # single-writer by the claim protocol above (only the
+                # thread that claimed `key` ever stores to it), and a
+                # one-slot dict store is GIL-atomic
+                self._compiled[key] = compiled  # pt-lint: ok[PT101,PT102]
+        entry = self._compiled[key]  # pt-lint: ok[PT102] (GIL-atomic read)
         if entry is None or entry is _PENDING:
             return self._jitted(*args, **kwargs)
         try:

@@ -47,7 +47,7 @@ def _enabled() -> bool:
     return bool(flags.get_flags("FLAGS_use_autotune")["FLAGS_use_autotune"])
 
 
-def _load() -> dict:
+def _load() -> dict:  # pt-lint: ok[PT101,PT102] (callers hold _lock)
     global _cache
     if _cache is None:
         try:
@@ -58,15 +58,19 @@ def _load() -> dict:
     return _cache
 
 
-def _save() -> None:
+def _save() -> None:  # pt-lint: ok[PT102] (callers hold _lock)
     try:
         os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
         tmp = _CACHE_PATH + ".tmp"
         with open(tmp, "w") as f:
             json.dump(_cache, f, indent=0, sort_keys=True)
         os.replace(tmp, _CACHE_PATH)
-    except Exception:
-        pass  # cache is an optimization; never fail the op over it
+    except Exception as e:
+        # cache is an optimization; never fail the op over it — but a
+        # cache that silently stops persisting means every future
+        # process re-pays the search (PERF.md r5: that is minutes)
+        _flight.record("autotune.cache_write_failed", path=_CACHE_PATH,
+                       error=f"{type(e).__name__}: {e}")
 
 
 def _sync_fetch(r):
@@ -181,7 +185,11 @@ def pick(op: str, signature, candidates, run, default):
                 f, x = run(cfg)
                 t = _slope_time(f, x)
             except Exception:
-                continue  # a config that fails to compile just loses
+                # a config that fails to compile just loses — counted,
+                # so "every candidate failed" is diagnosable from the
+                # snapshot instead of looking like a silent default
+                _metrics.inc("autotune.candidate_failed", op=op)
+                continue
             timings[str(cfg)] = round(t * 1e3, 4)
             if t < best_t:
                 best, best_t = cfg, t

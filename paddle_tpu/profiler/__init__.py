@@ -46,7 +46,10 @@ class _HostEventRecorder:
         self._lock = threading.Lock()
 
     def start(self):
-        self.events = []
+        # rebind under the lock: a start() racing an in-flight add()
+        # must not lose the append into the discarded old list
+        with self._lock:
+            self.events = []
         self.enabled = True
 
     def stop(self):
@@ -192,7 +195,10 @@ class Profiler:
 
                 jax.profiler.stop_trace()
             except Exception:
-                pass
+                # a trace that fails to stop means the xprof dump is
+                # truncated/absent — count it so the missing artifact
+                # is explainable from the metrics snapshot
+                _obs_metrics.inc("profiler.stop_trace_errors")
             self._jax_active = False
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)

@@ -287,8 +287,8 @@ def _record_injection(point, kind, call_n, ctx):
         # event-name parameter
         _flight.record("resilience.fault_injected", point=point,
                        fault_kind=kind, call=call_n, **safe_ctx)
-    except Exception:
-        pass
+    except Exception:  # pt-lint: ok[PT005] (observability fan-out
+        pass           # guard: injection must not depend on telemetry)
 
 
 def corrupt_file(path, seed=0, nbytes=1):

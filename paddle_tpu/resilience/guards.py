@@ -142,8 +142,8 @@ class StepGuard:
             extra = {} if streak is None else {"streak": streak}
             _flight.record(f"resilience.guard_{action}", guard=self.name,
                            source=source, **extra)
-        except Exception:
-            pass
+        except Exception:  # pt-lint: ok[PT005] (observability fan-out
+            pass           # guard: guarding must not depend on telemetry)
 
     def state_dict(self):
         with self._lock:

@@ -203,8 +203,10 @@ class RetryPolicy:
             from ..observability import metrics as _metrics
 
             _metrics.inc(counter, policy=self.name)
-        except Exception:
-            pass
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard: telemetry
+            # failing must never break the retried path, and there is
+            # no channel left to report the telemetry failure on)
 
     def _note(self, kind, attempt, err, **extra):
         try:
@@ -212,7 +214,7 @@ class RetryPolicy:
 
             _flight.record(kind, policy=self.name, attempt=attempt,
                            error=f"{type(err).__name__}: {err}", **extra)
-        except Exception:
+        except Exception:  # pt-lint: ok[PT005] (fan-out guard, as above)
             pass
 
 
@@ -230,7 +232,9 @@ def env_policy(name, env_var, default_attempts, **kwargs):
     `env_var` — the one factory behind the wired-in policies
     (collective dispatch, dataloader fetch, jit compile), so tuning
     lives here instead of three copy-pasted lazy-global blocks."""
-    pol = _env_policies.get(name)
+    # double-checked locking: lock-free first probe is a GIL-atomic
+    # dict get; a stale miss just re-checks under the lock
+    pol = _env_policies.get(name)  # pt-lint: ok[PT102]
     if pol is None:
         with _env_policies_lock:
             pol = _env_policies.get(name)

@@ -76,17 +76,28 @@ class Watchdog:
 
     def stop(self):
         self._stop.set()
-        t = self._thread
+        # the handle swap happens under the same lock start() uses:
+        # stop() racing start() must never join a thread start() is
+        # still publishing (PT101 — the race the lint gate now catches)
+        with self._lock:
+            t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=2.0)
-        self._thread = None
+        with self._lock:
+            # only retire the thread we stopped: a start() that ran
+            # between the two lock sections published a NEW watchdog
+            # that must not be orphaned here
+            if self._thread is t:
+                self._thread = None
         if self._hook is not None:
             try:
                 from ..observability import step_stats
 
                 step_stats.remove_record_hook(self._hook)
             except Exception:
-                pass
+                from ..observability import metrics as _metrics
+
+                _metrics.inc("resilience.watchdog_unhook_errors")
             self._hook = None
 
     def __enter__(self):
@@ -146,14 +157,26 @@ class Watchdog:
             if _trace.enabled() and _trace.events():
                 trace_path = os.path.join(d, tag + "_trace.json")
                 _trace.export(trace_path)
-        except Exception:
-            pass  # evidence collection must never mask the stall
+        except Exception as e:
+            # evidence collection must never mask the stall — but a
+            # silent evidence failure is its own black hole: say so on
+            # stderr (the one channel that cannot have been the thing
+            # that just failed)
+            import sys
+
+            print(f"[resilience] watchdog evidence dump failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         self.last_dump = (dump_path, trace_path)
         if self.on_stall is not None:
             try:
                 self.on_stall(age)
             except Exception:
-                pass
+                try:
+                    from ..observability import metrics as _metrics
+
+                    _metrics.inc("resilience.watchdog_callback_errors")
+                except Exception:  # pt-lint: ok[PT005] (observability
+                    pass           # fan-out guard: nothing left to tell)
         if self.raise_in_main:
             import _thread
 

@@ -1,0 +1,711 @@
+"""Static-analysis subsystem tests (docs/STATIC_ANALYSIS.md).
+
+Three kinds of coverage:
+  * fixture snippets — one positive and one negative per rule ID, so
+    every rule's firing condition is pinned by a test, not by folklore;
+  * repo gates — the whole tree runs through the ast+lock layers and
+    must produce no violations beyond tools/lint_baseline.json, and the
+    OPS_MANIFEST audit must show no drift (these ARE the CI gate);
+  * meta-properties — determinism (two runs, byte-identical reports),
+    suppression scoping, baseline diff semantics, CLI exit codes.
+
+The jaxpr layer's *fixtures* (tiny traces) run in tier-1; the full
+op-table + train-step audits build real programs and live in the slow
+tier.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import paddle_tpu.analysis as A
+from paddle_tpu.analysis import hlo_audit, lock_check, trace_safety
+from paddle_tpu.analysis.report import Suppressions, Violation
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(violations):
+    return {v.rule for v in violations}
+
+
+def run_ast(src):
+    return trace_safety.analyze_source(textwrap.dedent(src), "fix.py")
+
+
+def run_ast_tests(src):
+    return trace_safety.analyze_source(
+        textwrap.dedent(src), "tests/fix.py")
+
+
+def run_lock(src):
+    return lock_check.analyze_source(textwrap.dedent(src), "fix.py")
+
+
+# --------------------------- PT001 tracer leak ---------------------------
+
+PT001_POS = """
+    import jax
+
+    class M:
+        @jax.jit
+        def step(self, x):
+            y = x * 2
+            self.cache = y
+            return y
+"""
+
+PT001_NEG = """
+    import jax
+
+    class M:
+        def configure(self, x):     # not jit-traced: storing is fine
+            self.cache = x * 2
+
+        @jax.jit
+        def step(self, x):
+            return x * 2
+"""
+
+
+def test_pt001_positive():
+    v = [x for x in run_ast(PT001_POS) if x.rule == "PT001"]
+    assert len(v) == 1 and "self.cache" in v[0].message
+
+
+def test_pt001_negative():
+    assert "PT001" not in rules_of(run_ast(PT001_NEG))
+
+
+def test_pt001_reaches_through_call_graph():
+    # helper() is only traced because the jitted entry calls it
+    src = """
+        import jax
+
+        def helper(self, x):
+            self.state = x + 1
+            return x
+
+        @jax.jit
+        def entry(self, x):
+            return helper(self, x)
+    """
+    assert "PT001" in rules_of(run_ast(src))
+
+
+# ----------------------- PT002 concretization -----------------------
+
+PT002_POS = """
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(x):
+        if x:
+            return x.item()
+        return float(x)
+"""
+
+PT002_NEG = """
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(x, n):
+        y = x * int("4")      # int() of a constant: fine
+        return y + len([n])
+
+    def eager(x):
+        return float(x)       # not traced: fine
+"""
+
+
+def test_pt002_positive():
+    v = [x for x in run_ast(PT002_POS) if x.rule == "PT002"]
+    # if-on-param, .item(), float(param)
+    assert len(v) == 3
+
+
+def test_pt002_negative():
+    assert "PT002" not in rules_of(run_ast(PT002_NEG))
+
+
+# ----------------------- PT003 PRNG key reuse -----------------------
+
+PT003_POS = """
+    import jax
+
+    def sample(shape):
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, shape)
+        b = jax.random.uniform(key, shape)
+        return a, b
+"""
+
+PT003_NEG = """
+    import jax
+
+    def sample(shape):
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, shape)
+        b = jax.random.uniform(k2, shape)
+        return a, b
+"""
+
+
+def test_pt003_positive():
+    v = [x for x in run_ast(PT003_POS) if x.rule == "PT003"]
+    assert len(v) == 1 and "`key`" in v[0].message
+
+
+def test_pt003_negative():
+    assert "PT003" not in rules_of(run_ast(PT003_NEG))
+
+
+def test_pt003_branches_are_alternatives_not_reuse():
+    # one branch runs, not both — the multinomial false-positive shape
+    src = """
+        import jax
+
+        def pick(shape, replacement):
+            key = jax.random.PRNGKey(0)
+            if replacement:
+                out = jax.random.categorical(key, shape)
+            else:
+                out = jax.random.gumbel(key, shape)
+            return out
+    """
+    assert "PT003" not in rules_of(run_ast(src))
+
+
+def test_pt003_loop_reuse_fires():
+    src = """
+        import jax
+
+        def noisy(xs):
+            key = jax.random.PRNGKey(0)
+            out = []
+            for x in xs:
+                out.append(jax.random.normal(key, x.shape))
+            return out
+    """
+    assert "PT003" in rules_of(run_ast(src))
+
+
+def test_pt003_string_split_is_not_a_key():
+    src = """
+        def parse(line):
+            cats = line.strip()
+            cats = cats.split("|")
+            use(cats)
+            use(cats)
+            return cats
+    """
+    assert "PT003" not in rules_of(run_ast(src))
+
+
+# ----------------------- PT004 static args -----------------------
+
+PT004_POS = """
+    import jax
+
+    def f(x, mode="train"):
+        return x
+
+    g = jax.jit(f, static_argnames="mdoe")   # typo: never static
+"""
+
+PT004_NEG = """
+    import jax
+
+    def f(x, mode="train"):
+        return x
+
+    g = jax.jit(f, static_argnames="mode")
+"""
+
+
+def test_pt004_positive():
+    v = [x for x in run_ast(PT004_POS) if x.rule == "PT004"]
+    assert len(v) == 1 and "mdoe" in v[0].message
+
+
+def test_pt004_negative():
+    assert "PT004" not in rules_of(run_ast(PT004_NEG))
+
+
+def test_pt004_nonhashable_static_default():
+    src = """
+        import jax
+
+        def f(x, cfg=[1, 2]):
+            return x
+
+        g = jax.jit(f, static_argnames="cfg")
+    """
+    v = [x for x in run_ast(src) if x.rule == "PT004"]
+    assert len(v) == 1 and "non-hashable" in v[0].message
+
+
+def test_pt004_argnums_out_of_range():
+    src = """
+        import jax
+
+        def f(x):
+            return x
+
+        g = jax.jit(f, static_argnums=(3,))
+    """
+    v = [x for x in run_ast(src) if x.rule == "PT004"]
+    assert len(v) == 1 and "out of range" in v[0].message
+
+
+# ----------------------- PT005 silent swallow -----------------------
+
+PT005_POS = """
+    def f():
+        try:
+            work()
+        except Exception:
+            pass
+"""
+
+PT005_NEG = """
+    def f():
+        try:
+            work()
+        except Exception as e:
+            log.warning("work failed: %s", e)
+        try:
+            work()
+        except ValueError:
+            pass                    # narrow: allowed
+"""
+
+
+def test_pt005_positive():
+    assert "PT005" in rules_of(run_ast(PT005_POS))
+
+
+def test_pt005_negative():
+    assert "PT005" not in rules_of(run_ast(PT005_NEG))
+
+
+# ----------------------- PT006 mutable default -----------------------
+
+
+def test_pt006_positive_and_negative():
+    pos = run_ast("def f(x, acc=[]):\n    return acc\n")
+    neg = run_ast("def f(x, acc=None, n=3, s='a'):\n    return x\n")
+    assert "PT006" in rules_of(pos)
+    assert "PT006" not in rules_of(neg)
+
+
+# ----------------------- PT007 unmarked slow test -----------------------
+
+PT007_POS = """
+    import time
+
+    def test_waits():
+        time.sleep(2.0)
+"""
+
+PT007_NEG = """
+    import time
+    import pytest
+
+    @pytest.mark.slow
+    def test_waits():
+        time.sleep(2.0)
+
+    def test_quick():
+        time.sleep(0.01)
+"""
+
+
+def test_pt007_positive():
+    assert "PT007" in rules_of(run_ast_tests(PT007_POS))
+
+
+def test_pt007_negative():
+    assert "PT007" not in rules_of(run_ast_tests(PT007_NEG))
+
+
+def test_pt007_only_applies_to_test_files():
+    assert "PT007" not in rules_of(run_ast(PT007_POS))
+
+
+# ----------------------- PT101/PT102 lock discipline -----------------------
+
+LOCK_POS = """
+    import threading
+
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._events = []
+            self._seq = 0
+
+        def record(self, e):
+            with self._lock:
+                self._seq += 1
+                self._events.append(e)
+
+        def drain(self):
+            out = list(self._events)    # PT102: read outside lock
+            self._events = []           # PT101: write outside lock
+            return out
+"""
+
+LOCK_NEG = """
+    import threading
+
+    class Ring:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._events = []
+
+        def record(self, e):
+            with self._lock:
+                self._events.append(e)
+
+        def drain(self):
+            with self._lock:
+                out = list(self._events)
+                self._events = []
+            return out
+"""
+
+
+def test_lock_positive():
+    v = run_lock(LOCK_POS)
+    assert {"PT101", "PT102"} <= rules_of(v)
+    assert all("_events" in x.message for x in v)
+
+
+def test_lock_negative():
+    assert run_lock(LOCK_NEG) == []
+
+
+def test_lock_init_excluded_and_unguarded_ignored():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0          # construction: never flagged
+                self.flag = False
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def toggle(self):
+                self.flag = True     # never written under lock: free
+    """
+    assert run_lock(src) == []
+
+
+def test_lock_event_attrs_are_threadsafe():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = threading.Event()
+                self._n = 0
+
+            def start(self):
+                with self._lock:
+                    self._stop.clear()
+                    self._n += 1
+
+            def stop(self):
+                self._stop.set()     # Event: internally synchronized
+    """
+    assert run_lock(src) == []
+
+
+def test_pt007_three_arg_range():
+    # the trip count is the STOP arg, not args[-1] (which is the step)
+    src = """
+        def test_spin():
+            total = 0
+            for i in range(0, 1000000, 1):
+                total += i
+    """
+    assert "PT007" in rules_of(run_ast_tests(src))
+
+
+def test_lock_module_read_without_global_stmt():
+    # reads never need a `global` declaration — they must still count
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def fill(k, v):
+            with _lock:
+                _cache[k] = v
+
+        def peek(k):
+            return _cache.get(k)     # PT102, no global stmt needed
+    """
+    v = run_lock(src)
+    assert rules_of(v) == {"PT102"} and "peek" in v[0].message
+
+
+def test_lock_module_local_shadow_not_flagged():
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def fill(k, v):
+            with _lock:
+                _cache[k] = v
+
+        def local_only():
+            _cache = {}              # local shadow: not the global
+            return _cache
+    """
+    assert run_lock(src) == []
+
+
+def test_lock_module_level_globals():
+    src = """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = None
+
+        def put(k, v):
+            global _cache
+            with _lock:
+                if _cache is None:
+                    _cache = {}
+                _cache[k] = v
+
+        def peek():
+            global _cache
+            return _cache            # PT102
+    """
+    v = run_lock(src)
+    assert rules_of(v) == {"PT102"} and "peek" in v[0].message
+
+
+# ----------------------- suppressions -----------------------
+
+
+def test_suppression_same_line_and_line_above():
+    src = textwrap.dedent("""
+        def f():
+            try:
+                work()
+            except Exception:  # pt-lint: ok[PT005]
+                pass
+
+        def g():
+            try:
+                work()
+            # pt-lint: ok[PT005]
+            except Exception:
+                pass
+    """)
+    raw = trace_safety.analyze_source(src, "fix.py")
+    assert len([v for v in raw if v.rule == "PT005"]) == 2
+    import ast as _ast
+
+    kept = Suppressions(src, _ast.parse(src)).apply(raw)
+    assert kept == []
+
+
+def test_suppression_def_scope_and_rule_filter():
+    src = textwrap.dedent("""
+        def helper():  # pt-lint: ok[PT005]
+            try:
+                work()
+            except Exception:
+                pass
+
+        def other():
+            try:
+                work()
+            except Exception:  # pt-lint: ok[PT003] (wrong rule)
+                pass
+    """)
+    import ast as _ast
+
+    raw = trace_safety.analyze_source(src, "fix.py")
+    kept = Suppressions(src, _ast.parse(src)).apply(raw)
+    assert len(kept) == 1 and kept[0].rule == "PT005"
+    # the survivor is the one whose suppression names the wrong rule
+    assert kept[0].line > 6
+
+
+# ----------------------- baseline semantics -----------------------
+
+
+def test_baseline_diff_new_vs_known(tmp_path):
+    v1 = Violation("a.py", 10, "PT005", "msg")
+    v2 = Violation("a.py", 90, "PT005", "msg")   # same key, new instance
+    v3 = Violation("b.py", 5, "PT101", "other")
+    baseline = {v1.key(): 1}
+    new, known, stale = A.diff_against_baseline([v1, v2, v3], baseline)
+    assert known == [v1]          # earliest line is the baselined one
+    assert set(new) == {v2, v3}
+    assert stale == []
+
+
+def test_baseline_stale_detection():
+    baseline = {"gone.py|PT005|msg": 2}
+    new, known, stale = A.diff_against_baseline([], baseline)
+    assert new == [] and known == [] and stale == ["gone.py|PT005|msg"]
+
+
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    vs = [Violation("x.py", 1, "PT006", "m"),
+          Violation("x.py", 2, "PT006", "m")]
+    A.save_baseline(path, vs)
+    loaded = A.load_baseline(path)
+    assert loaded == {"x.py|PT006|m": 2}
+
+
+# ----------------------- repo gates (tier-1 CI) -----------------------
+
+
+def test_repo_gate_no_new_ast_lock_violations():
+    violations = A.analyze_repo(REPO, layers=("ast", "lock"))
+    baseline = A.load_baseline(
+        os.path.join(REPO, "tools", "lint_baseline.json"))
+    new, _known, _stale = A.diff_against_baseline(violations, baseline)
+    assert new == [], "new pt_lint violations:\n" + A.render_report(new)
+
+
+def test_repo_gate_manifest_no_drift():
+    from paddle_tpu.analysis.manifest_check import audit_manifest
+
+    drift = audit_manifest()
+    assert drift == [], A.render_report(drift)
+
+
+def test_report_is_deterministic():
+    r1 = A.render_report(A.analyze_repo(REPO, layers=("ast", "lock")))
+    r2 = A.render_report(A.analyze_repo(REPO, layers=("ast", "lock")))
+    assert r1 == r2
+
+
+def test_cli_check_passes_and_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pt_lint.py"),
+         "--check", "--layers", "ast,lock"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_cli_check_fails_on_new_violation(tmp_path):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text("def f():\n"
+                   "    try:\n"
+                   "        work()\n"
+                   "    except Exception:\n"
+                   "        pass\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pt_lint.py"),
+         "--check", "--layers", "ast,lock", str(bad)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "PT005" in proc.stdout
+
+
+# ----------------------- jaxpr layer fixtures (tier-1) -----------------------
+
+
+def test_pt201_host_transfer_fixture():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def f(x):
+        return jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct((2,), jnp.float32), x)
+
+    v = hlo_audit.audit_callable(f, jnp.ones(2, jnp.float32),
+                                 where="fix", enable_x64=False)
+    assert rules_of(v) == {"PT201"}
+
+
+def test_pt202_f64_promotion_fixture():
+    import jax.numpy as jnp
+
+    def f(x):
+        return x.astype("float64") * 2.0
+
+    v = hlo_audit.audit_callable(f, jnp.ones(2, jnp.float32),
+                                 where="fix")
+    assert "PT202" in rules_of(v)
+
+
+def test_jaxpr_clean_program_fixture():
+    import jax.numpy as jnp
+
+    def f(x):
+        return (x * 2.0).sum()
+
+    assert hlo_audit.audit_callable(f, jnp.ones(2, jnp.float32),
+                                    where="fix") == []
+
+
+def test_pt203_donation_fixture():
+    import jax
+    import jax.numpy as jnp
+
+    def f(p, x):
+        return {k: w - x.sum() for k, w in p.items()}, x
+
+    args = ({"w": jnp.ones((512, 512))}, jnp.ones((4,)))
+    plain = jax.jit(f).lower(*args).as_text()
+    donated = jax.jit(f, donate_argnums=(0,)).lower(*args).as_text()
+    pos = hlo_audit.audit_lowered_donation(plain, "fix", min_mbytes=0.5)
+    neg = hlo_audit.audit_lowered_donation(donated, "fix",
+                                           min_mbytes=0.5)
+    assert rules_of(pos) == {"PT203"} and neg == []
+
+
+def test_pt301_manifest_drift_fixture(tmp_path):
+    from paddle_tpu.analysis.manifest_check import audit_manifest
+
+    fake = tmp_path / "manifest.json"
+    fake.write_text(json.dumps({"ops": [
+        {"name": "definitely_not_an_op_xyz", "present": True,
+         "where": "paddle_tpu", "tensor_method": False},
+        {"name": "abs", "present": True, "where": "paddle_tpu",
+         "tensor_method": True},
+    ]}))
+    drift = audit_manifest(str(fake))
+    assert len(drift) == 1 and drift[0].rule == "PT301"
+    assert "definitely_not_an_op_xyz" in drift[0].message
+
+
+# ----------------------- slow tier: whole-program audits -----------------------
+
+
+@pytest.mark.slow
+def test_op_table_audit_clean():
+    v = hlo_audit.audit_op_table()
+    assert v == [], A.render_report(v)
+
+
+@pytest.mark.slow
+def test_train_step_audit_clean():
+    v = hlo_audit.audit_train_step()
+    assert v == [], A.render_report(v)

@@ -1,0 +1,154 @@
+"""pt_lint — the framework-aware static-analysis CLI.
+
+Layers (see docs/STATIC_ANALYSIS.md for the rule catalog):
+
+  ast       PT001–PT007  trace-safety lint (stdlib ast, fast)
+  lock      PT101/PT102  lock-discipline race checker (fast)
+  manifest  PT301        OPS_MANIFEST.json vs live module surface
+                         (imports paddle_tpu — a few seconds)
+  jaxpr     PT201–PT203  jaxpr/StableHLO audit of the exported op
+                         table and the hybrid train step (traces and
+                         lowers real programs — slow tier)
+
+Usage:
+  python tools/pt_lint.py                  # report everything (ast+lock)
+  python tools/pt_lint.py --check          # gate: exit 2 on NEW
+                                           # violations vs the baseline
+                                           # (runs ast+lock+manifest)
+  python tools/pt_lint.py --update-baseline
+  python tools/pt_lint.py --jaxpr --check  # include the slow layer
+  python tools/pt_lint.py --layers ast     # pick layers explicitly
+
+The committed baseline (tools/lint_baseline.json) counts pre-existing
+violations by line-free key, so the gate fails only on findings the
+current change introduced. Inline suppression: `# pt-lint: ok[PT005]`
+on the finding's line, the line above, or a def/class header.
+
+The ast/lock fast path never imports jax: the analysis package is
+file-loaded standalone, bypassing `paddle_tpu/__init__`.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "tools", "lint_baseline.json")
+
+# the manifest/jaxpr layers import paddle_tpu lazily; make sure the
+# repo root wins over tools/ in sys.path when invoked as a script
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+EXIT_OK = 0
+EXIT_USAGE = 1
+EXIT_NEW_VIOLATIONS = 2
+
+
+def load_analysis():
+    """paddle_tpu.analysis WITHOUT importing (jax-heavy) paddle_tpu:
+    load the subpackage as a standalone top-level package."""
+    if "paddle_tpu" in sys.modules:  # already paid — use the real one
+        import paddle_tpu.analysis as analysis
+
+        return analysis
+    if "pt_analysis" in sys.modules:
+        return sys.modules["pt_analysis"]
+    pkg_dir = os.path.join(REPO, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "pt_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["pt_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pt_lint", description=__doc__.split("\n\n")[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: repo roots "
+                         "paddle_tpu/ tools/ tests/ bench.py)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: diff against the baseline, exit 2 "
+                         "on new violations")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite tools/lint_baseline.json from the "
+                         "current findings")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline path (default tools/lint_baseline."
+                         "json)")
+    ap.add_argument("--layers", default=None,
+                    help="comma list among ast,lock,manifest,jaxpr "
+                         "(default: ast,lock; --check adds manifest)")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="include the jaxpr/HLO audit layer (slow)")
+    ap.add_argument("--select", default=None,
+                    help="only report these rule ids (comma list)")
+    args = ap.parse_args(argv)
+
+    if args.layers is not None:
+        layers = tuple(x.strip() for x in args.layers.split(",")
+                       if x.strip())
+    else:
+        # --update-baseline must record the SAME layer set --check
+        # gates on, or a manifest finding could never be baselined
+        layers = ("ast", "lock", "manifest") \
+            if (args.check or args.update_baseline) else ("ast", "lock")
+    if args.jaxpr and "jaxpr" not in layers:
+        layers = layers + ("jaxpr",)
+
+    if args.update_baseline and (args.paths or args.select):
+        # a baseline built from a subset scan would silently delete
+        # every entry outside the subset and turn the next full
+        # --check red — refuse instead
+        print("pt_lint: --update-baseline must run over the full "
+              "default scope (no paths, no --select); it rewrites the "
+              "whole baseline", file=sys.stderr)
+        return EXIT_USAGE
+
+    analysis = load_analysis()
+    roots = tuple(args.paths) if args.paths else analysis.DEFAULT_ROOTS
+    violations = analysis.analyze_repo(REPO, roots=roots, layers=layers)
+    if args.select:
+        wanted = {x.strip() for x in args.select.split(",")}
+        violations = [v for v in violations if v.rule in wanted]
+
+    if args.update_baseline:
+        analysis.save_baseline(args.baseline, violations)
+        print(f"pt_lint: baseline updated — {len(violations)} "
+              f"violation(s) recorded in "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return EXIT_OK
+
+    if args.check:
+        baseline = analysis.load_baseline(args.baseline)
+        new, known, stale = analysis.diff_against_baseline(
+            violations, baseline)
+        if stale:
+            print(f"pt_lint: note — {len(stale)} stale baseline "
+                  f"entr{'y' if len(stale) == 1 else 'ies'} (fixed "
+                  f"findings still counted; run --update-baseline):")
+            for key in stale[:10]:
+                print(f"  stale: {key}")
+        if new:
+            print(analysis.render_report(new))
+            print(f"pt_lint: FAIL — {len(new)} new violation(s) "
+                  f"({len(known)} baselined, layers={','.join(layers)})")
+            return EXIT_NEW_VIOLATIONS
+        print(f"pt_lint: OK — no new violations "
+              f"({len(known)} baselined, layers={','.join(layers)})")
+        return EXIT_OK
+
+    if violations:
+        print(analysis.render_report(violations))
+    print(f"pt_lint: {len(violations)} violation(s), "
+          f"layers={','.join(layers)}")
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())
