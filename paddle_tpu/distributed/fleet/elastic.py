@@ -223,6 +223,37 @@ class ElasticManager:
 
     shutdown = stop
 
+    # --- preemption ----------------------------------------------------------
+    def attach_preemption_guard(self, guard, install=True):
+        """Cooperative preemption (docs/RESILIENCE.md): when `guard`
+        (resilience.preemption.PreemptionGuard) trips, this rank STOPS
+        heartbeating — it ages out of membership at heartbeat_ttl and
+        the surviving ranks restart on the shrunk world — instead of
+        the legacy hard `os._exit` that vanished mid-collective while
+        its last fresh beat still advertised it alive.  The guard's
+        exit_code is set to ELASTIC_EXIT_CODE so TrainingPreempted
+        carries the launcher's relaunch protocol.  The training loop's
+        safe point (DistributedTrainStep._check_preemption) does the
+        checkpointing; this hook only handles membership."""
+        if install:
+            guard.install()
+        guard.exit_code = ELASTIC_EXIT_CODE
+        guard.on_preempt(self._on_preempt)
+        self._preemption_guard = guard
+        return guard
+
+    def _on_preempt(self, reason):
+        try:
+            from ...observability import flight as _flight
+
+            _flight.record("preemption.elastic_deregister",
+                           job_id=self.job_id, rank=self.rank,
+                           reason=reason)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard: runs in
+            # signal context — deregistration must still happen)
+        self.stop()  # stop beating; TTL ages this rank out
+
     # --- restart protocol ----------------------------------------------------
     @staticmethod
     def request_relaunch():
@@ -234,5 +265,10 @@ class ElasticManager:
         os._exit(ELASTIC_EXIT_CODE)
 
     def install_signal_handlers(self):
+        """Legacy hard-exit handlers (immediate ELASTIC_EXIT_CODE, no
+        checkpoint, no deregistration).  Prefer
+        `attach_preemption_guard(PreemptionGuard())`: same relaunch
+        protocol, but the training loop checkpoints at its next safe
+        point and the rank leaves membership cleanly first."""
         signal.signal(signal.SIGTERM, self.signal_handler)
         signal.signal(signal.SIGINT, self.signal_handler)

@@ -121,7 +121,7 @@ class DistributedTrainStep:
     def __init__(self, model, optimizer, loss_fn=None, topo=None,
                  sharding_stage=0, recompute=False, amp_dtype=None,
                  grad_clip_norm=None, loss_has_aux=False, guard=None,
-                 checkpoint_manager=None):
+                 checkpoint_manager=None, preemption_guard=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -148,6 +148,13 @@ class DistributedTrainStep:
         if self.guard is not None and self._ckpt_mgr is not None \
                 and self.guard.on_rollback is None:
             self.guard.set_rollback(self.rollback)
+        # preemption_guard: a resilience.preemption.PreemptionGuard this
+        # step consults at its safe points (between dispatches) — a
+        # SIGTERM/maintenance event checkpoints through the attached
+        # manager and raises TrainingPreempted instead of vanishing
+        # mid-collective with unsaved state.
+        self._preemption_guard = preemption_guard
+        self._preemption_handled = None  # TrainingPreempted once raised
 
     # --- sharding planning ---------------------------------------------------
     def _plan(self, params, slots):
@@ -375,6 +382,7 @@ class DistributedTrainStep:
         schedule position in that mode."""
         from ..optimizer.lr import LRScheduler
 
+        self._check_preemption()  # don't start a scan we can't keep
         if repeat is not None:
             repeat = int(repeat)
             if repeat < 1:
@@ -420,7 +428,8 @@ class DistributedTrainStep:
         if self.guard is not None:
             for ok in np.asarray(oks):
                 self.guard.observe(bool(ok))
-        return Tensor(losses)
+        self._check_preemption()  # signal landed mid-scan: state is
+        return Tensor(losses)     # post-scan consistent → save now
 
     def _place_batch(self, batch, batch_axis):
         """Unwrap/flatten a batch and device_put each leaf with the dp
@@ -518,6 +527,7 @@ class DistributedTrainStep:
     def __call__(self, *batch):
         """batch: (inputs, labels) Tensors (loss_fn mode) or raw model args.
         Returns the loss as a Tensor; model/optimizer state advances."""
+        self._check_preemption()  # safe point: pre-dispatch
         placed, treedef = self._place_batch(batch, batch_axis=0)
         compiled = self._ensure_compiled(treedef)
         placed = self._maybe_poison(placed)
@@ -530,6 +540,7 @@ class DistributedTrainStep:
             # ONE host-visible scalar per dispatch (the guarded mode's
             # only extra transfer) drives the warn→skip→rollback ladder
             self.guard.observe(bool(ok))
+        self._check_preemption()  # safe point: post-step, state swapped
         return Tensor(loss)
 
     # --- state sync back to the eager model ---------------------------------
@@ -611,6 +622,54 @@ class DistributedTrainStep:
         s["opt"]["step"] = tgt["opt.step"]._value
         s["buffers"] = {n: tgt[f"buffer.{n}"]._value
                         for n in s["buffers"]}
+
+    # --- resilience: preemption safe points ----------------------------------
+    def attach_preemption_guard(self, guard):
+        """Consult `guard` (resilience.preemption.PreemptionGuard) at
+        this step's safe points: a trip checkpoints through the attached
+        manager and raises TrainingPreempted with the resumable path."""
+        self._preemption_guard = guard
+        return self
+
+    def _check_preemption(self):
+        """Safe-point probe, called between dispatches (never inside
+        one): the live state is a complete, consistent post-step
+        snapshot here, so the emergency checkpoint it writes is exactly
+        what `load_train_state`/`rollback` resumes bit-for-bit."""
+        g = self._preemption_guard
+        if g is None or not g.check():
+            return
+        if self._preemption_handled is not None:
+            # already checkpointed for this trip: a caller ignoring the
+            # first TrainingPreempted must not silently keep training —
+            # re-raise the same resumable exception, without re-saving
+            raise self._preemption_handled
+        from ..resilience.preemption import TrainingPreempted
+
+        ckpt_dir = step_no = None
+        if self._ckpt_mgr is not None and self._state is not None:
+            try:
+                step_no = int(np.asarray(self._state["opt"]["step"]))
+            except (TypeError, ValueError):
+                step_no = None  # manager picks newest+1
+            ckpt_dir = self.save_checkpoint(step=step_no)
+            try:
+                from ..observability import flight as _flight
+                from ..observability import metrics as _metrics
+
+                _metrics.inc("preemption.checkpoints")
+                _flight.record("preemption.checkpoint_saved",
+                               path=ckpt_dir, step=step_no,
+                               reason=g.reason)
+            except Exception:  # pt-lint: ok[PT005]
+                pass           # (observability fan-out guard: the
+                # checkpoint landed — telemetry must not turn a clean
+                # preemption exit into a crash)
+        exit_code = getattr(g, "exit_code", 0)
+        self._preemption_handled = TrainingPreempted(
+            g.reason, checkpoint_dir=ckpt_dir, step=step_no,
+            exit_code=exit_code)
+        raise self._preemption_handled
 
     # --- resilience: rotation checkpointing + guard rollback -----------------
     def attach_checkpoint_manager(self, manager):

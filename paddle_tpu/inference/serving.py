@@ -10,15 +10,36 @@ Protocol (stdlib-only, zero heavy deps):
   POST /predict   body = .npz archive (numpy savez) with one array per
                   model input, keyed by feed name (or arr_0.. in feed
                   order); response = .npz with one array per fetch name.
-  GET  /health    -> {"status": "ok", "inputs": [...], "outputs": [...]}
+  GET  /health    liveness: {"status": "ok", "inputs", "outputs"} while
+                  the process is up (including during drain).
+  GET  /ready     readiness: 200 while accepting traffic; 503 with a
+                  reason while draining or while the last `ready_window`
+                  predictor calls ALL failed (load balancers route on
+                  this; liveness keeps the process from being killed
+                  mid-drain).
 
-Client helper: `InferenceClient` wraps the same protocol.
+Status mapping (docs/RESILIENCE.md): deterministic request errors
+(wrong dtype/rank/key, undecodable body) → 400; admission sheds and
+deadline overruns → 429/503 + `Retry-After`; everything else → 500.
+
+Overload behavior: every request passes the `AdmissionController`
+(bounded queue + concurrency limit + deadline-aware shedding, env knobs
+`PADDLE_TPU_MAX_INFLIGHT` / `PADDLE_TPU_QUEUE_DEPTH`) BEFORE touching
+the predictor lock, so saturation sheds cheap 429s instead of stacking
+timeouts.  `shutdown()` is a graceful drain: stop admitting → finish
+in-flight (up to `PADDLE_TPU_DRAIN_TIMEOUT`) → close the socket.
+
+Client helper: `InferenceClient` wraps the same protocol with a
+configurable timeout and bounded retry on 429/503 honoring Retry-After.
 """
 from __future__ import annotations
 
 import io
 import json
+import math
+import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -31,11 +52,40 @@ __all__ = ["InferenceServer", "InferenceClient", "serve"]
 _DETERMINISTIC_ERRORS = (TypeError, ValueError, KeyError, IndexError,
                          AttributeError)
 
+_ARR_KEY = re.compile(r"arr_(\d+)$")
+
+
+class _ServingHTTPServer(ThreadingHTTPServer):
+    """stdlib default listen backlog is 5: under a connection burst the
+    OS sheds with raw TCP RSTs before admission control ever sees the
+    request.  Shedding is the AdmissionController's decision (a polite
+    429 + Retry-After), so the accept backlog must comfortably exceed
+    the admission queue."""
+
+    request_queue_size = 128
+
+
+def _positional_order(keys):
+    """np.savez default keys sorted by NUMERIC suffix: plain
+    `sorted()` puts arr_10 before arr_2, silently permuting the feeds
+    of any model with more than 10 inputs.  Non-arr_N keys sort after,
+    lexicographically (mixed keysets stay deterministic)."""
+    def rank(k):
+        m = _ARR_KEY.fullmatch(k)
+        return (0, int(m.group(1)), k) if m else (1, 0, k)
+
+    return sorted(keys, key=rank)
+
+
+def _retry_after_header(seconds):
+    """HTTP Retry-After is a non-negative INTEGER of seconds."""
+    return str(max(0, int(math.ceil(float(seconds)))))
+
 
 class InferenceServer:
     """Serve one predictor. `start()` returns immediately (daemon thread);
     `serve_forever()` blocks. Concurrent requests serialize around the
-    predictor (one device queue) via a lock.
+    predictor (one device queue) via a lock, behind admission control.
 
     Resilience (docs/RESILIENCE.md): each request runs under a retry
     policy (`request_retries` attempts within the `request_timeout`
@@ -44,15 +94,29 @@ class InferenceServer:
     halved recursively (down to single items), halves run independently
     and results re-concatenate, so one poisoned/oversized example costs
     its half-batch a recompile instead of failing the whole request.
+
+    Overload/preemption: `admission` (an
+    `resilience.overload.AdmissionController`) gates every request;
+    `shutdown()` drains gracefully and is idempotent; pass a
+    `resilience.preemption.PreemptionGuard` to `install_preemption()`
+    (or let `serve()` do it) and SIGTERM turns into drain-then-exit.
     """
 
-    def __init__(self, model_path: str, host: str = "127.0.0.1",
+    def __init__(self, model_path=None, host: str = "127.0.0.1",
                  port: int = 0, request_retries: int = 2,
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0, max_inflight=None,
+                 queue_depth=None, drain_timeout=None, ready_window=8,
+                 predictor=None):
+        from ..resilience.overload import AdmissionController, ShedError
         from ..resilience.retry import RetryPolicy
 
-        cfg = Config(model_path)
-        self._predictor = create_predictor(cfg)
+        if predictor is not None:
+            self._predictor = predictor
+        elif model_path is not None:
+            self._predictor = create_predictor(Config(model_path))
+        else:
+            raise ValueError("InferenceServer needs a model_path or a "
+                             "predictor")
         self._plock = threading.Lock()
         self._request_timeout = (None if request_timeout is None
                                  else float(request_timeout))
@@ -64,29 +128,52 @@ class InferenceServer:
             # surface them immediately (no retry, and _run_resilient
             # re-raises them without bisecting the batch)
             give_up_on=_DETERMINISTIC_ERRORS)
+        self.admission = AdmissionController(
+            max_inflight=max_inflight, queue_depth=queue_depth,
+            name="serving")
+        self._drain_timeout = drain_timeout  # None → env/default in drain()
+        self._ready_window = max(1, int(ready_window))
+        self._recent = []          # last ready_window predictor outcomes
+        self._recent_lock = threading.Lock()
+        self._shutdown_lock = threading.Lock()
+        self._shutdown_done = False
+        self._shutdown_complete = threading.Event()
+        self._shutdown_result = True
+        self._serving = False
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
-            def _json(self, code, obj):
+            def _json(self, code, obj, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path != "/health":
-                    return self._json(404, {"error": "unknown path"})
-                p = server._predictor
-                self._json(200, {
-                    "status": "ok",
-                    "inputs": p.get_input_names(),
-                    "outputs": p.get_output_names(),
-                })
+                if self.path == "/health":
+                    # liveness: up — even while draining (killing a
+                    # draining process forfeits its in-flight work)
+                    p = server._predictor
+                    return self._json(200, {
+                        "status": "ok",
+                        "inputs": p.get_input_names(),
+                        "outputs": p.get_output_names(),
+                        "draining": server.admission.draining,
+                    })
+                if self.path == "/ready":
+                    ready, reason = server.readiness()
+                    body = {"status": "ready" if ready else "not_ready",
+                            "reason": reason}
+                    body.update(server.admission.stats())
+                    return self._json(200 if ready else 503, body)
+                return self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
                 if self.path != "/predict":
@@ -96,20 +183,46 @@ class InferenceServer:
                     raw = self.rfile.read(n)
                     with np.load(io.BytesIO(raw)) as z:
                         arrays = {k: z[k] for k in z.files}
-                    outs = server.predict(arrays)
-                    buf = io.BytesIO()
-                    np.savez(buf, **outs)
-                    body = buf.getvalue()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "application/octet-stream")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
                 except Exception as e:
-                    self._json(400, {"error": f"{type(e).__name__}: {e}"})
+                    # undecodable body: the client's fault, always
+                    return self._json(
+                        400, {"error": f"bad request body: "
+                                       f"{type(e).__name__}: {e}"})
+                try:
+                    outs = server.predict(arrays)
+                except ShedError as e:
+                    return self._json(
+                        e.http_status,
+                        {"error": str(e), "reason": e.reason},
+                        headers=[("Retry-After",
+                                  _retry_after_header(e.retry_after))])
+                except TimeoutError as e:
+                    # DeadlineExceeded is a TimeoutError subclass: the
+                    # server ran out of time, not the client out of
+                    # line — retryable, with a service-time hint
+                    stats = server.admission.stats()
+                    hint = stats.get("ewma_latency") or 1.0
+                    return self._json(
+                        503, {"error": f"{type(e).__name__}: {e}"},
+                        headers=[("Retry-After",
+                                  _retry_after_header(hint))])
+                except _DETERMINISTIC_ERRORS as e:
+                    return self._json(
+                        400, {"error": f"{type(e).__name__}: {e}"})
+                except Exception as e:
+                    return self._json(
+                        500, {"error": f"{type(e).__name__}: {e}"})
+                buf = io.BytesIO()
+                np.savez(buf, **outs)
+                body = buf.getvalue()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _ServingHTTPServer((host, port), Handler)
         self._thread = None
 
     @property
@@ -117,14 +230,51 @@ class InferenceServer:
         h, p = self._httpd.server_address[:2]
         return f"http://{h}:{p}"
 
+    # --- readiness -----------------------------------------------------------
+    def readiness(self):
+        """(ready, reason): not ready while draining, or when the last
+        `ready_window` predictor calls ALL failed (a wedged/poisoned
+        predictor should shed load balancer traffic, not collect it)."""
+        if self.admission.draining:
+            return False, "draining"
+        with self._recent_lock:
+            recent = list(self._recent)
+        if len(recent) >= self._ready_window and not any(recent):
+            return False, "predictor_failing"
+        return True, "ok"
+
+    def _note_outcome(self, ok):
+        with self._recent_lock:
+            self._recent.append(bool(ok))
+            del self._recent[:-self._ready_window]
+
+    # --- request path --------------------------------------------------------
     def predict(self, arrays: dict) -> dict:
         p = self._predictor
         feed_order = p.get_input_names()
         if set(arrays) >= set(feed_order):
             inputs = [arrays[n] for n in feed_order]
         else:  # positional arr_0, arr_1, ... (np.savez default keys)
-            inputs = [arrays[k] for k in sorted(arrays)]
-        outs = self._run_resilient(inputs)
+            inputs = [arrays[k] for k in _positional_order(arrays)]
+        deadline = (None if self._request_timeout is None
+                    else time.monotonic() + self._request_timeout)
+        ticket = self.admission.admit(deadline=deadline)
+        ok = None  # None = client-fault outcome: readiness unaffected
+        try:
+            outs = self._run_resilient(inputs, _deadline=deadline)
+            ok = True
+        except _DETERMINISTIC_ERRORS:
+            # the CLIENT's request was wrong (400) — feeding this into
+            # the readiness window would let one misbehaving client
+            # flip a healthy server to not-ready
+            raise
+        except Exception:
+            ok = False
+            raise
+        finally:
+            if ok is not None:
+                self._note_outcome(ok)
+            ticket.release(ok=bool(ok))
         return {n: np.asarray(v)
                 for n, v in zip(p.get_output_names(), outs)}
 
@@ -190,34 +340,140 @@ class InferenceServer:
             # telemetry error escaping here would abort the
             # degrade-to-smaller-batch recursion and fail the request)
 
+    # --- lifecycle -----------------------------------------------------------
     def start(self):
+        self._serving = True  # before the thread runs: a shutdown()
+        # racing start() must wait for the loop, not skip it
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
+            target=self.serve_forever, daemon=True,
             name="paddle-tpu-serving")
         self._thread.start()
         return self
 
     def serve_forever(self):
+        self._serving = True
         self._httpd.serve_forever()
 
-    def shutdown(self):
-        self._httpd.shutdown()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+    def install_preemption(self, guard=None, install_signals=True):
+        """Wire a `PreemptionGuard`: SIGTERM/SIGINT (or a maintenance
+        event) begins the drain immediately, and the full graceful
+        shutdown runs on a helper thread — `shutdown()` must never run
+        inline in signal context on the thread running serve_forever()
+        (it would deadlock waiting for its own loop to exit)."""
+        from ..resilience.preemption import PreemptionGuard
+
+        guard = guard or PreemptionGuard()
+        if install_signals:
+            guard.install()
+
+        def _drain(reason):
+            self.admission.begin_drain()  # readiness flips NOW
+            threading.Thread(target=self.shutdown, daemon=True,
+                             name="paddle-tpu-serving-drain").start()
+
+        guard.on_preempt(_drain)
+        self._preemption_guard = guard
+        return guard
+
+    def shutdown(self, drain_timeout=None):
+        """Graceful drain: stop admitting (queued requests shed 503,
+        readiness flips), finish in-flight requests up to the drain
+        deadline, stop the accept loop, CLOSE the listening socket.
+        Idempotent AND blocking — launcher teardown racing a signal
+        handler's drain thread is the normal case, and the loser must
+        WAIT for the winner's drain, not return early and let the
+        process exit with requests still in flight.  Returns True when
+        the drain completed before the deadline."""
+        with self._shutdown_lock:
+            first = not self._shutdown_done
+            self._shutdown_done = True
+        if not first:
+            # another caller is (or was) draining: ride its result —
+            # and if IT has not finished inside our wait budget, say so
+            # (True here would green-light a process exit with requests
+            # still in flight)
+            budget = drain_timeout if drain_timeout is not None \
+                else self._drain_timeout
+            if budget is None:
+                budget = 30.0
+            finished = self._shutdown_complete.wait(
+                timeout=float(budget) + 10.0)
+            return bool(finished and self._shutdown_result)
+        try:
+            if drain_timeout is None:
+                drain_timeout = self._drain_timeout
+            drained = self.admission.drain(timeout=drain_timeout)
+            try:
+                from ..observability import flight as _flight
+                from ..observability import metrics as _metrics
+
+                _metrics.inc("preemption.drains")
+                _flight.record("serving.drained", complete=bool(drained))
+            except Exception:  # pt-lint: ok[PT005]
+                pass           # (observability fan-out guard: shutdown
+                # runs in signal/atexit paths and must never raise)
+            if self._serving:  # shutdown() on a never-started server
+                self._httpd.shutdown()  # must not block on a loop
+                # that never ran
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+            # the listening socket used to leak here: without
+            # server_close() the fd (and the port) stayed held for the
+            # process lifetime
+            self._httpd.server_close()
+            self._shutdown_result = drained
+        finally:
+            self._shutdown_complete.set()
+        return self._shutdown_result
 
 
 class InferenceClient:
-    def __init__(self, address: str):
+    """Protocol client with a configurable timeout and bounded retry on
+    429/503 honoring the server's Retry-After header (capped at
+    `max_retry_wait` so a confused server cannot park the client)."""
+
+    def __init__(self, address: str, timeout: float = 120.0,
+                 retries: int = 2, max_retry_wait: float = 5.0,
+                 sleep=time.sleep):
         self.address = address.rstrip("/")
+        self.timeout = float(timeout)
+        self.retries = max(0, int(retries))
+        self.max_retry_wait = float(max_retry_wait)
+        self.sleep = sleep
 
     def health(self) -> dict:
         import urllib.request
 
         with urllib.request.urlopen(self.address + "/health",
-                                    timeout=30) as r:
+                                    timeout=self.timeout) as r:
             return json.loads(r.read())
 
+    def ready(self) -> dict:
+        """Readiness probe: {"ready": bool, ...server stats}.  A 503 is
+        a VALID readiness answer, not an error."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self.address + "/ready",
+                                        timeout=self.timeout) as r:
+                body = json.loads(r.read())
+                code = r.status
+        except urllib.error.HTTPError as e:
+            body = json.loads(e.read() or b"{}")
+            code = e.code
+        body["ready"] = code == 200
+        return body
+
+    def _retry_wait(self, headers):
+        try:
+            ra = float(headers.get("Retry-After", 0.5))
+        except (TypeError, ValueError):
+            ra = 0.5
+        return min(max(ra, 0.05), self.max_retry_wait)
+
     def predict(self, *arrays, **named) -> dict:
+        import urllib.error
         import urllib.request
 
         buf = io.BytesIO()
@@ -225,19 +481,34 @@ class InferenceClient:
             np.savez(buf, **named)
         else:
             np.savez(buf, *arrays)
-        req = urllib.request.Request(
-            self.address + "/predict", data=buf.getvalue(),
-            headers={"Content-Type": "application/octet-stream"})
-        with urllib.request.urlopen(req, timeout=120) as r:
-            with np.load(io.BytesIO(r.read())) as z:
-                return {k: z[k] for k in z.files}
+        data = buf.getvalue()
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                self.address + "/predict", data=data,
+                headers={"Content-Type": "application/octet-stream"})
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    with np.load(io.BytesIO(r.read())) as z:
+                        return {k: z[k] for k in z.files}
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503) and attempt < self.retries:
+                    self.sleep(self._retry_wait(e.headers))
+                    continue
+                raise
 
 
 def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866):
-    """Blocking entry point: `python -m paddle_tpu.inference.serving`."""
+    """Blocking entry point: `python -m paddle_tpu.inference.serving`.
+    SIGTERM/SIGINT drain gracefully (finish in-flight, close the
+    socket) instead of killing requests mid-predict."""
     srv = InferenceServer(model_path, host, port)
+    guard = srv.install_preemption()
+    srv.start()
     print(f"serving {model_path} at {srv.address}")
-    srv.serve_forever()
+    guard.wait()           # parked until preemption/Ctrl-C
+    srv.shutdown()         # idempotent with the guard's drain thread
+    print(f"drained and stopped ({guard.reason})")
 
 
 if __name__ == "__main__":

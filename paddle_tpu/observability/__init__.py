@@ -67,7 +67,22 @@ _SCHEMA_COUNTERS = tuple(
        for s in ("guard", "amp", "amp_floor")]
     + [("resilience.rollbacks", {}), ("resilience.watchdog_trips", {}),
        ("resilience.degraded_batches", {})]
+    # overload/preemption runtime (ISSUE 5): admission sheds by reason,
+    # preemption signals by name, emergency checkpoints, serving drains
+    + [("resilience.shed_requests", {"reason": r})
+       for r in ("queue_full", "deadline", "draining")]
+    + [("preemption.signals", {"signal": s})
+       for s in ("SIGTERM", "SIGINT")]
+    + [("preemption.maintenance_events", {}),
+       ("preemption.checkpoints", {}), ("preemption.drains", {}),
+       ("preemption.callback_errors", {})]
 )
+
+# Gauges attach() zeroes so the admission-control state is always
+# present in a snapshot (a server that never saw traffic still reports
+# inflight=0 rather than omitting the key).
+_SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
+                  "serving.admission_limit")
 
 
 def attach(crash_hook: bool = True):
@@ -78,6 +93,8 @@ def attach(crash_hook: bool = True):
     metrics.enable()
     for name, labels in _SCHEMA_COUNTERS:
         metrics.declare(name, **labels)
+    for name in _SCHEMA_GAUGES:
+        metrics.set_gauge(name, 0)
     flight.get_recorder().enabled = True
     trace.enable()
     if crash_hook:

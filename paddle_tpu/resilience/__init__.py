@@ -17,6 +17,12 @@ injectable so the reflexes are testable in CI:
     GradScaler and rolls back through hardened checkpoints.
   * `watchdog` — heartbeat hang watchdog fed by StepTimer; dumps the
     flight ring + Perfetto trace on stall before raising.
+  * `overload` — serving admission control: bounded wait queue,
+    concurrency limit with an AIMD adaptive ceiling fed by observed
+    latency, deadline-aware load shedding, graceful drain.
+  * `preemption` — SIGTERM/SIGINT + maintenance-event guard turning
+    preemption into a cooperative shutdown: training checkpoints at
+    the next safe point and exits resumable; serving drains.
 
 Recovery state (what rollback restores through) lives in the hardened
 distributed checkpoint: atomic tmp+fsync+rename saves, per-shard CRC32s
@@ -25,17 +31,20 @@ verified on load, keep-last-K rotation with a `latest` pointer
 """
 from __future__ import annotations
 
-from . import faults, guards, retry, watchdog  # noqa: F401
+from . import faults, guards, overload, preemption, retry, watchdog  # noqa: F401
 from .faults import InjectedFault, inject  # noqa: F401
 from .guards import StepGuard  # noqa: F401
+from .overload import AdmissionController, ShedError  # noqa: F401
+from .preemption import PreemptionGuard, TrainingPreempted  # noqa: F401
 from .retry import (  # noqa: F401
     CircuitBreaker, CircuitOpenError, DeadlineExceeded, RetryPolicy,
 )
 from .watchdog import Watchdog, WatchdogStall  # noqa: F401
 
 __all__ = [
-    "faults", "retry", "guards", "watchdog",
+    "faults", "retry", "guards", "watchdog", "overload", "preemption",
     "InjectedFault", "inject", "StepGuard", "RetryPolicy",
     "CircuitBreaker", "CircuitOpenError", "DeadlineExceeded",
-    "Watchdog", "WatchdogStall",
+    "Watchdog", "WatchdogStall", "AdmissionController", "ShedError",
+    "PreemptionGuard", "TrainingPreempted",
 ]

@@ -1,0 +1,338 @@
+"""Serving admission control: bounded queue + concurrency limit +
+deadline-aware load shedding (docs/RESILIENCE.md).
+
+The front door of `inference/serving.py`: every request must pass an
+`AdmissionController` BEFORE it can touch the predictor lock.  Without
+one, overload has exactly one failure mode — requests pile up
+unboundedly on the lock, all of them eventually time out together, and
+the clients retry in a herd that keeps the server saturated forever.
+With one, the server does bounded work and says "no" cheaply:
+
+  * **concurrency limit** (`max_inflight`): at most this many requests
+    run the predictor concurrently (the device executes one program at
+    a time; extra concurrency only buys queue depth inside XLA).
+  * **bounded wait queue** (`queue_depth`): at most this many requests
+    wait for a slot; the next one is shed immediately (`queue_full`).
+  * **deadline-aware shedding**: a request whose estimated completion
+    time (queue ahead of it x observed latency / limit + its own
+    service) already overruns its deadline is shed at the door instead
+    of timing out after consuming a slot (`deadline`).
+  * **AIMD adaptive limit**: when a `latency_target` is set, the
+    observed per-request latency EWMA drives the live limit — latency
+    over target multiplies the limit down (fast backoff under
+    overload), a window of on-target completions adds 1 back (slow
+    recovery), classic TCP-style AIMD bounded to
+    [`min_limit`, `max_inflight`].
+  * **draining**: `begin_drain()` flips the controller into shutdown
+    mode — new and queued requests are shed (`draining`, HTTP 503),
+    in-flight ones finish; `drain(timeout)` blocks until they have.
+
+Every shed increments `resilience.shed_requests{reason=...}` and lands
+a flight instant; `serving.inflight` / `serving.queue_depth` /
+`serving.admission_limit` gauges track the live state.  Clock is
+injectable — tests run the whole machine without wall-clock waits.
+
+Env knobs (read when the matching ctor arg is None):
+  PADDLE_TPU_MAX_INFLIGHT    concurrency limit        (default 4)
+  PADDLE_TPU_QUEUE_DEPTH     bounded queue length     (default 16)
+  PADDLE_TPU_QUEUE_TIMEOUT   max queue wait, seconds  (default 10)
+  PADDLE_TPU_LATENCY_TARGET  AIMD latency target, seconds (default off)
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+__all__ = ["AdmissionController", "ShedError", "AdmissionTicket"]
+
+
+def _env_num(var, default, cast):
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        raise ValueError(f"{var} must parse as {cast.__name__}, "
+                         f"got {raw!r}") from None
+
+
+class ShedError(RuntimeError):
+    """A request was refused at admission.  `reason` is one of
+    `queue_full` / `deadline` / `draining`; `retry_after` is the
+    server's estimate (seconds) of when retrying could succeed —
+    serving surfaces it as an HTTP `Retry-After` header.  Overload
+    sheds map to 429 (back off and retry), draining to 503 (this
+    instance is going away — retry elsewhere)."""
+
+    def __init__(self, reason, retry_after=1.0, detail=""):
+        super().__init__(
+            f"request shed ({reason})" + (f": {detail}" if detail else ""))
+        self.reason = str(reason)
+        self.retry_after = max(0.0, float(retry_after))
+
+    @property
+    def http_status(self):
+        return 503 if self.reason == "draining" else 429
+
+
+class AdmissionTicket:
+    """One admitted request's slot.  Context-manager form releases on
+    exit with ok = no-exception; `release()` is idempotent."""
+
+    __slots__ = ("_controller", "_start", "_released")
+
+    def __init__(self, controller, start):
+        self._controller = controller
+        self._start = start
+        self._released = False
+
+    def release(self, ok=True):
+        if self._released:
+            return
+        self._released = True
+        latency = self._controller.clock() - self._start
+        self._controller._release(ok=ok, latency=latency)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release(ok=exc_type is None)
+        return False
+
+
+class AdmissionController:
+    def __init__(self, max_inflight=None, queue_depth=None,
+                 queue_timeout=None, latency_target=None, min_limit=1,
+                 ewma_alpha=0.3, decrease_factor=0.7, name="serving",
+                 clock=time.monotonic):
+        if max_inflight is None:
+            max_inflight = _env_num("PADDLE_TPU_MAX_INFLIGHT", 4, int)
+        if queue_depth is None:
+            queue_depth = _env_num("PADDLE_TPU_QUEUE_DEPTH", 16, int)
+        if queue_timeout is None:
+            queue_timeout = _env_num("PADDLE_TPU_QUEUE_TIMEOUT", 10.0, float)
+        if latency_target is None:
+            latency_target = _env_num("PADDLE_TPU_LATENCY_TARGET", 0.0,
+                                      float) or None
+        self.max_inflight = max(1, int(max_inflight))
+        self.queue_depth = max(0, int(queue_depth))
+        self.queue_timeout = float(queue_timeout)
+        self.latency_target = latency_target
+        self.min_limit = max(1, min(int(min_limit), self.max_inflight))
+        self.ewma_alpha = float(ewma_alpha)
+        self.decrease_factor = float(decrease_factor)
+        self.name = str(name)
+        self.clock = clock
+        self._cv = threading.Condition(threading.Lock())
+        self._limit = self.max_inflight
+        self._inflight = 0
+        self._queued = 0
+        self._draining = False
+        self._ewma = None      # EWMA of observed request latency (s)
+        self._good = 0         # on-target completions since last bump
+        self._shed = {"queue_full": 0, "deadline": 0, "draining": 0}
+        self._completed = 0
+        self._failed = 0
+        self._publish_gauges()
+
+    # --- introspection ------------------------------------------------------
+    @property
+    def draining(self):
+        with self._cv:
+            return self._draining
+
+    @property
+    def limit(self):
+        """The LIVE concurrency limit (AIMD moves it within
+        [min_limit, max_inflight]; fixed at max_inflight otherwise)."""
+        return self._limit
+
+    def stats(self):
+        with self._cv:
+            return {
+                "inflight": self._inflight,
+                "queued": self._queued,
+                "limit": self._limit,
+                "max_inflight": self.max_inflight,
+                "queue_depth": self.queue_depth,
+                "draining": self._draining,
+                "ewma_latency": self._ewma,
+                "completed": self._completed,
+                "failed": self._failed,
+                "shed": dict(self._shed),
+            }
+
+    # --- admission ----------------------------------------------------------
+    def admit(self, deadline=None):
+        """Admit one request (blocking while the queue drains ahead of
+        it) and return an `AdmissionTicket`, or raise `ShedError`.
+        `deadline` is an absolute `clock()` instant the caller must
+        finish by; admission refuses work it estimates cannot finish in
+        time."""
+        with self._cv:
+            if self._draining:
+                self._shed_locked("draining", self._drain_retry_after())
+            # queue_full only applies to requests that would actually
+            # have to queue — a free slot admits regardless of depth 0
+            if self._inflight >= self._limit and \
+                    self._queued >= self.queue_depth:
+                self._shed_locked("queue_full", self._estimate_wait())
+            est = self._estimate_wait()
+            if deadline is not None and self.clock() + est > deadline:
+                self._shed_locked(
+                    "deadline", est,
+                    detail=f"estimated completion {est:.3f}s past deadline")
+            self._queued += 1
+            self._publish_gauges()
+            try:
+                # queue_timeout bounds the head-of-line wait even when
+                # the request's own deadline is laxer — whichever comes
+                # first sheds (a 30s request deadline must not grant a
+                # 30s queue camp when the operator capped waits at 1s)
+                timeout_at = self.clock() + self.queue_timeout
+                if deadline is not None:
+                    timeout_at = min(timeout_at, deadline)
+                while self._inflight >= self._limit:
+                    if self._draining:
+                        self._shed_locked("draining",
+                                          self._drain_retry_after())
+                    remaining = timeout_at - self.clock()
+                    if remaining <= 0:
+                        self._shed_locked(
+                            "deadline", self._estimate_wait(),
+                            detail="queue wait exhausted the deadline")
+                    self._cv.wait(remaining)
+                self._inflight += 1
+            finally:
+                self._queued -= 1
+                self._publish_gauges()
+                # a shed waiter leaving the queue can be the drain()
+                # waiter's last blocker — wake it to re-check
+                self._cv.notify_all()
+        return AdmissionTicket(self, self.clock())
+
+    def _release(self, ok, latency):
+        with self._cv:
+            self._inflight = max(0, self._inflight - 1)
+            if ok:
+                self._completed += 1
+            else:
+                self._failed += 1
+            self._observe_locked(latency)
+            self._publish_gauges()
+            self._cv.notify_all()
+
+    # --- load estimation / AIMD ---------------------------------------------
+    def _estimate_wait(self):  # pt-lint: ok[PT102] (callers hold _cv)
+        """Estimated time for a request admitted NOW to complete: the
+        work ahead of it (queued + inflight) served at `limit`-way
+        concurrency, plus its own service time — all at the observed
+        latency EWMA.  Zero until the first completion (no evidence of
+        slowness yet: admit optimistically, shed on facts)."""
+        if not self._ewma:
+            return 0.0
+        ahead = self._queued + self._inflight
+        return self._ewma * ahead / max(1, self._limit) + self._ewma
+
+    def _drain_retry_after(self):
+        # a draining instance never comes back; tell the client to try
+        # another replica after roughly one service time
+        return self._ewma if self._ewma else 1.0
+
+    def _observe_locked(self, latency):
+        if latency is None or latency < 0:
+            return
+        self._ewma = (latency if self._ewma is None else
+                      (1.0 - self.ewma_alpha) * self._ewma
+                      + self.ewma_alpha * latency)
+        if self.latency_target is None:
+            return
+        if self._ewma > self.latency_target:
+            new = max(self.min_limit,
+                      int(math.floor(self._limit * self.decrease_factor)))
+            if new < self._limit:
+                self._limit = new
+                self._good = 0
+                self._note("resilience.admission_limit_decrease",
+                           limit=new, ewma=round(self._ewma, 6))
+        else:
+            self._good += 1
+            # additive increase once per limit-sized window of on-target
+            # completions: recovery probes capacity slowly (AIMD)
+            if self._good >= self._limit and self._limit < self.max_inflight:
+                self._limit += 1
+                self._good = 0
+                self._note("resilience.admission_limit_increase",
+                           limit=self._limit, ewma=round(self._ewma, 6))
+
+    # --- drain ---------------------------------------------------------------
+    def begin_drain(self):
+        """Stop admitting: every new or queued request sheds with
+        `draining`; in-flight requests keep their slots.  Idempotent."""
+        with self._cv:
+            if self._draining:
+                return
+            self._draining = True
+            self._publish_gauges()
+            self._cv.notify_all()
+        self._note("resilience.drain_begin", name=self.name)
+
+    def drain(self, timeout=None):
+        """`begin_drain()` then block until no requests are in flight or
+        queued (queued ones shed themselves as they wake).  Returns True
+        when fully drained, False on timeout — the caller decides
+        whether a hard stop is acceptable then."""
+        if timeout is None:
+            timeout = _env_num("PADDLE_TPU_DRAIN_TIMEOUT", 30.0, float)
+        self.begin_drain()
+        deadline = self.clock() + float(timeout)
+        with self._cv:
+            while self._inflight > 0 or self._queued > 0:
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    self._note("resilience.drain_timeout",
+                               inflight=self._inflight,
+                               queued=self._queued)
+                    return False
+                self._cv.wait(remaining)
+        self._note("resilience.drain_complete", name=self.name)
+        return True
+
+    # --- observability (fan-out guarded: shedding must shed, not crash) -----
+    def _shed_locked(self, reason, retry_after, detail=""):  # pt-lint: ok[PT102] (callers hold _cv)
+        self._shed[reason] = self._shed.get(reason, 0) + 1
+        try:
+            from ..observability import flight as _flight
+            from ..observability import metrics as _metrics
+
+            _metrics.inc("resilience.shed_requests", reason=reason)
+            _flight.record("resilience.request_shed", reason=reason,
+                           retry_after=round(float(retry_after), 3),
+                           inflight=self._inflight, queued=self._queued,
+                           limit=self._limit)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard: a telemetry
+            # error here would turn a cheap shed into a 500)
+        raise ShedError(reason, retry_after=retry_after, detail=detail)
+
+    def _publish_gauges(self):  # pt-lint: ok[PT102] (ctor + _cv holders)
+        try:
+            from ..observability import metrics as _metrics
+
+            _metrics.set_gauge("serving.inflight", self._inflight)
+            _metrics.set_gauge("serving.queue_depth", self._queued)
+            _metrics.set_gauge("serving.admission_limit", self._limit)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard, as above)
+
+    def _note(self, kind, **data):
+        try:
+            from ..observability import flight as _flight
+
+            _flight.record(kind, **data)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard, as above)

@@ -1,0 +1,784 @@
+"""Overload- and preemption-resilience tests (ISSUE 5): admission
+control (bounded queue, AIMD limit, deadline sheds), graceful drain,
+liveness/readiness split, HTTP status discipline, client retry-on-429,
+and the preemption-safe training shutdown (SIGTERM → verified
+checkpoint → TrainingPreempted → bit-for-bit resume).  Deterministic,
+CPU-only, fast; the seeded concurrent matrices live under the `chaos`
+marker (tools/chaos_check.py scenarios), outside tier-1.
+"""
+import io
+import os
+import signal as _signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, verify_checkpoint,
+)
+from paddle_tpu.distributed.fleet.elastic import (
+    ELASTIC_EXIT_CODE, ElasticManager,
+)
+from paddle_tpu.inference.serving import (
+    InferenceClient, InferenceServer, _positional_order,
+)
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience.overload import AdmissionController, ShedError
+from paddle_tpu.resilience.preemption import (
+    PreemptionGuard, TrainingPreempted,
+)
+
+
+# --------------------------------------------------------------------------
+# shared stubs
+# --------------------------------------------------------------------------
+
+class _Clock:
+    """Injectable monotonic clock for wait-free admission tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _StubPredictor:
+    """Duck-typed predictor: records the inputs it was fed, optionally
+    sleeps (overload tests) or fails (readiness tests)."""
+
+    def __init__(self, inputs=("x",), outputs=("y",), fn=None,
+                 service_time=0.0):
+        self._inputs = list(inputs)
+        self._outputs = list(outputs)
+        self.fn = fn or (lambda ins: [np.asarray(ins[0])])
+        self.service_time = float(service_time)
+        self.calls = []
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def run(self, inputs):
+        self.calls.append([np.asarray(a) for a in inputs])
+        if self.service_time:
+            time.sleep(self.service_time)
+        return self.fn(inputs)
+
+
+def _server(**kw):
+    kw.setdefault("predictor", _StubPredictor())
+    srv = InferenceServer(**kw)
+    srv._retry.sleep = lambda s: None
+    return srv
+
+
+def _post_raw(address, data, timeout=10):
+    req = urllib.request.Request(
+        address + "/predict", data=data,
+        headers={"Content-Type": "application/octet-stream"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _post_npz(address, arrays, timeout=10):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with _post_raw(address, buf.getvalue(), timeout=timeout) as r:
+        with np.load(io.BytesIO(r.read())) as z:
+            return {k: z[k] for k in z.files}
+
+
+# --------------------------------------------------------------------------
+# admission controller: queue bound, deadline sheds, AIMD, drain
+# --------------------------------------------------------------------------
+
+def test_admission_basic_and_queue_full_shed():
+    clk = _Clock()
+    ctrl = AdmissionController(max_inflight=1, queue_depth=0, clock=clk)
+    t1 = ctrl.admit()  # free slot admits even with queue_depth=0
+    with pytest.raises(ShedError) as ei:
+        ctrl.admit()
+    assert ei.value.reason == "queue_full"
+    assert ei.value.http_status == 429
+    assert ctrl.stats()["shed"]["queue_full"] == 1
+    t1.release(ok=True)
+    ctrl.admit().release()  # slot freed → admits again
+
+
+def test_admission_deadline_shed_uses_latency_estimate():
+    clk = _Clock()
+    ctrl = AdmissionController(max_inflight=1, queue_depth=4, clock=clk)
+    t = ctrl.admit()
+    clk.advance(1.0)
+    t.release()  # observed latency EWMA = 1.0s
+    assert ctrl.stats()["ewma_latency"] == pytest.approx(1.0)
+    hold = ctrl.admit()
+    # one request ahead at 1s each: estimated completion ~2s; a 0.5s
+    # deadline cannot be met → shed at the door, not timed out later
+    with pytest.raises(ShedError) as ei:
+        ctrl.admit(deadline=clk() + 0.5)
+    assert ei.value.reason == "deadline"
+    assert ei.value.retry_after >= 1.0
+    hold.release()
+
+
+def test_admission_queue_wait_deadline_real_clock():
+    ctrl = AdmissionController(max_inflight=1, queue_depth=2,
+                               queue_timeout=0.05)
+    hold = ctrl.admit()
+    t0 = time.monotonic()
+    with pytest.raises(ShedError) as ei:
+        ctrl.admit()  # queues, then sheds when queue_timeout elapses
+    assert ei.value.reason == "deadline"
+    assert time.monotonic() - t0 >= 0.04
+    hold.release()
+
+
+def test_admission_aimd_decreases_then_recovers():
+    clk = _Clock()
+    ctrl = AdmissionController(max_inflight=8, queue_depth=8,
+                               latency_target=0.1, clock=clk)
+    assert ctrl.limit == 8
+    for _ in range(6):  # sustained 1s latencies vs a 0.1s target
+        t = ctrl.admit()
+        clk.advance(1.0)
+        t.release()
+    assert ctrl.limit == 1  # multiplicative decrease to the floor
+    for _ in range(40):  # fast completions decay the EWMA under target
+        t = ctrl.admit()
+        clk.advance(0.001)
+        t.release()
+    assert 1 < ctrl.limit <= 8  # additive increase probes back up
+
+
+def test_admission_drain_sheds_new_and_queued():
+    ctrl = AdmissionController(max_inflight=1, queue_depth=2,
+                               queue_timeout=5.0)
+    hold = ctrl.admit()
+    shed = []
+
+    def queued():
+        try:
+            ctrl.admit()
+        except ShedError as e:
+            shed.append(e.reason)
+
+    th = threading.Thread(target=queued)
+    th.start()
+    time.sleep(0.05)  # let it enter the wait queue
+    ctrl.begin_drain()
+    th.join(timeout=2)
+    assert shed == ["draining"]  # queued waiter shed on drain
+    with pytest.raises(ShedError) as ei:
+        ctrl.admit()  # new arrivals shed immediately
+    assert ei.value.reason == "draining"
+    assert ei.value.http_status == 503
+    hold.release()
+    assert ctrl.drain(timeout=1.0) is True  # in-flight finished → drained
+
+
+def test_admission_drain_timeout_reports_false():
+    ctrl = AdmissionController(max_inflight=1, queue_depth=0)
+    ctrl.admit()  # never released
+    assert ctrl.drain(timeout=0.05) is False
+
+
+# --------------------------------------------------------------------------
+# satellite: positional arr_N ordering
+# --------------------------------------------------------------------------
+
+def test_positional_order_sorts_numeric_suffix():
+    keys = [f"arr_{i}" for i in range(12)]
+    assert _positional_order(sorted(keys)) == keys  # lexicographic undone
+    assert _positional_order(["b", "arr_2", "a", "arr_10"]) == \
+        ["arr_2", "arr_10", "a", "b"]
+
+
+def test_predict_positional_fallback_feeds_numeric_order():
+    pred = _StubPredictor(inputs=[f"in_{i}" for i in range(12)],
+                          outputs=["y"],
+                          fn=lambda ins: [np.asarray(ins[0])])
+    srv = _server(predictor=pred)
+    arrays = {f"arr_{i}": np.full((1,), float(i), np.float32)
+              for i in range(12)}
+    srv.predict(arrays)
+    fed = [float(a[0]) for a in pred.calls[0]]
+    assert fed == [float(i) for i in range(12)]  # arr_2 before arr_10
+
+
+# --------------------------------------------------------------------------
+# satellite: HTTP status discipline (400 vs 429/503 vs 500)
+# --------------------------------------------------------------------------
+
+def _http_code(fn):
+    try:
+        fn()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers
+    raise AssertionError("expected an HTTPError")
+
+
+def test_http_bad_body_and_deterministic_errors_are_400():
+    srv = _server().start()
+    try:
+        code, _ = _http_code(lambda: _post_raw(srv.address, b"not-an-npz"))
+        assert code == 400
+        bad = _server(predictor=_StubPredictor(
+            fn=lambda ins: (_ for _ in ()).throw(ValueError("bad rank"))))
+        bad.start()
+        try:
+            code, _ = _http_code(lambda: _post_npz(
+                bad.address, {"x": np.zeros((1, 2), np.float32)}))
+            assert code == 400  # deterministic model error: client fault
+        finally:
+            bad.shutdown()
+    finally:
+        srv.shutdown()
+
+
+def test_http_internal_error_is_500_and_timeout_is_503():
+    boom = _server(predictor=_StubPredictor(
+        fn=lambda ins: (_ for _ in ()).throw(RuntimeError("boom"))),
+        request_retries=1).start()
+    try:
+        code, headers = _http_code(lambda: _post_npz(
+            boom.address, {"x": np.zeros((1, 2), np.float32)}))
+        assert code == 500
+        assert headers.get("Retry-After") is None
+    finally:
+        boom.shutdown()
+    # slow, failing predictor exhausts the request deadline between
+    # retries → DeadlineExceeded (a TimeoutError) → 503 + Retry-After
+    slow = _server(predictor=_StubPredictor(
+        fn=lambda ins: (_ for _ in ()).throw(RuntimeError("flaky")),
+        service_time=0.15), request_retries=3, request_timeout=0.1)
+    slow._retry.sleep = time.sleep  # real backoff so the deadline binds
+    slow.start()
+    try:
+        code, headers = _http_code(lambda: _post_npz(
+            slow.address, {"x": np.zeros((1, 2), np.float32)}))
+        assert code == 503
+        assert headers.get("Retry-After") is not None
+    finally:
+        slow.shutdown()
+
+
+# --------------------------------------------------------------------------
+# tentpole: overload shed + all-admitted-complete (acceptance criterion)
+# --------------------------------------------------------------------------
+
+def test_overload_sheds_excess_and_admitted_all_complete():
+    metrics.enable()
+    metrics.reset()
+    srv = _server(predictor=_StubPredictor(service_time=0.08),
+                  max_inflight=2, queue_depth=2,
+                  request_timeout=10.0).start()
+    n = 8  # 2x the admit+queue capacity
+    barrier = threading.Barrier(n)
+    results = []
+    lock = threading.Lock()
+
+    def one(i):
+        x = np.full((1, 2), float(i), np.float32)
+        barrier.wait()
+        try:
+            out = _post_npz(srv.address, {"x": x}, timeout=10)
+            row = ("ok", bool(np.array_equal(out["y"], x)), None)
+        except urllib.error.HTTPError as e:
+            row = ("shed", e.code, e.headers.get("Retry-After"))
+        with lock:
+            results.append(row)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    try:
+        oks = [r for r in results if r[0] == "ok"]
+        sheds = [r for r in results if r[0] == "shed"]
+        assert len(oks) + len(sheds) == n
+        assert all(r[1] for r in oks)       # zero admitted failures
+        assert len(sheds) >= 1              # overload actually shed
+        assert all(r[1] in (429, 503) for r in sheds)
+        assert all(r[2] is not None for r in sheds)  # Retry-After set
+        snap = metrics.snapshot()["counters"]
+        counted = sum(v for k, v in snap.items()
+                      if k.startswith("resilience.shed_requests"))
+        assert counted == len(sheds)        # ledger matches reality
+    finally:
+        srv.shutdown()
+        metrics.disable()
+        metrics.reset()
+
+
+# --------------------------------------------------------------------------
+# tentpole: liveness/readiness split + graceful drain + socket close
+# --------------------------------------------------------------------------
+
+def test_ready_flips_during_drain_while_health_stays_live():
+    srv = _server(predictor=_StubPredictor(service_time=0.4)).start()
+    client = InferenceClient(srv.address, timeout=10, retries=0)
+    assert client.ready()["ready"] is True
+    done = {}
+
+    def request():
+        done["out"] = _post_npz(
+            srv.address, {"x": np.ones((1, 2), np.float32)})
+
+    req = threading.Thread(target=request)
+    req.start()
+    time.sleep(0.1)  # request in flight
+    stopper = threading.Thread(target=srv.shutdown)
+    stopper.start()
+    time.sleep(0.1)  # drain begun, request still running
+    rd = client.ready()
+    assert rd["ready"] is False and rd["reason"] == "draining"
+    assert client.health()["status"] == "ok"  # liveness never flips
+    req.join(timeout=10)
+    stopper.join(timeout=10)
+    assert "out" in done  # the in-flight request finished during drain
+    # after drain: socket CLOSED (the leak this PR fixes), not just idle
+    assert srv._httpd.socket.fileno() == -1
+    with pytest.raises(urllib.error.URLError):
+        InferenceClient(srv.address, timeout=0.5, retries=0).health()
+    assert srv.shutdown() is True  # idempotent
+
+
+def test_shutdown_idempotent_without_start():
+    srv = _server()
+    assert srv.shutdown() is True  # never-started server: no hang
+    assert srv.shutdown() is True
+    assert srv._httpd.socket.fileno() == -1
+
+
+def test_ready_flips_on_consecutive_predictor_failures():
+    pred = _StubPredictor(
+        fn=lambda ins: (_ for _ in ()).throw(RuntimeError("wedged")))
+    srv = _server(predictor=pred, request_retries=1, ready_window=3)
+    for _ in range(3):
+        with pytest.raises(RuntimeError):
+            srv.predict({"x": np.zeros((1, 2), np.float32)})
+    ok, reason = srv.readiness()
+    assert not ok and reason == "predictor_failing"
+    pred.fn = lambda ins: [np.asarray(ins[0])]  # predictor recovers
+    srv.predict({"x": np.zeros((1, 2), np.float32)})
+    assert srv.readiness() == (True, "ok")
+
+
+def test_client_fault_errors_do_not_flip_readiness():
+    """Deterministic (400-class) request errors are the CLIENT's fault:
+    one misbehaving client must not drive a healthy server not-ready."""
+    srv = _server(predictor=_StubPredictor(
+        fn=lambda ins: (_ for _ in ()).throw(ValueError("bad dtype"))),
+        request_retries=1, ready_window=3)
+    for _ in range(5):
+        with pytest.raises(ValueError):
+            srv.predict({"x": np.zeros((1, 2), np.float32)})
+    assert srv.readiness() == (True, "ok")
+
+
+_VICTIM = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from paddle_tpu.inference.serving import InferenceServer
+
+class Slow:
+    def get_input_names(self): return ["x"]
+    def get_output_names(self): return ["y"]
+    def run(self, inputs):
+        time.sleep(0.8)
+        return [np.asarray(inputs[0])]
+
+srv = InferenceServer(predictor=Slow())
+guard = srv.install_preemption()
+srv.start()
+print(srv.address, flush=True)
+guard.wait()
+srv.shutdown()
+print(f"DRAINED_EXIT reason={{guard.reason}}", flush=True)
+"""
+
+
+def test_sigterm_to_serving_process_drains_in_flight(tmp_path):
+    """Acceptance: a REAL SIGTERM to a separate serving process lets
+    the in-flight request finish (200, full service time) before the
+    socket closes and the process exits 0."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "victim.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script.write_text(_VICTIM.format(repo=repo))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen([sys.executable, str(script)], env=env,
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        addr = p.stdout.readline().strip()
+        assert addr.startswith("http://")
+        result = {}
+
+        def request():
+            t0 = time.monotonic()
+            out = _post_npz(addr, {"x": np.ones((1, 2), np.float32)},
+                            timeout=15)
+            result["y"] = out["y"]
+            result["elapsed"] = time.monotonic() - t0
+
+        th = threading.Thread(target=request)
+        th.start()
+        time.sleep(0.2)  # request mid-service (0.8s)
+        p.send_signal(_signal.SIGTERM)
+        th.join(timeout=15)
+        out, _ = p.communicate(timeout=15)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert "y" in result and result["elapsed"] > 0.5  # finished, not cut
+    assert "DRAINED_EXIT reason=signal:SIGTERM" in out
+    assert p.returncode == 0  # clean exit after the drain
+
+
+# --------------------------------------------------------------------------
+# satellite: client timeout + bounded retry honoring Retry-After
+# --------------------------------------------------------------------------
+
+class _FlakyHTTPServer:
+    """Raw stub server: serves `codes` (with Retry-After: 0) then a
+    valid npz response — exercises the client's retry loop alone."""
+
+    def __init__(self, codes):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        state = {"codes": list(codes)}
+        self.state = state
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                if state["codes"]:
+                    code = state["codes"].pop(0)
+                    self.send_response(code)
+                    self.send_header("Retry-After", "0")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                buf = io.BytesIO()
+                np.savez(buf, y=np.ones((1,), np.float32))
+                body = buf.getvalue()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        h, p = self.httpd.server_address[:2]
+        self.address = f"http://{h}:{p}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_client_bounded_retry_honors_retry_after():
+    stub = _FlakyHTTPServer([429, 503])
+    sleeps = []
+    try:
+        client = InferenceClient(stub.address, timeout=5, retries=2,
+                                 sleep=sleeps.append)
+        out = client.predict(x=np.zeros((1,), np.float32))
+        assert np.array_equal(out["y"], np.ones((1,), np.float32))
+        # two retryable failures → two waits, Retry-After(0) clamped up
+        assert len(sleeps) == 2 and all(0.05 <= s <= 5.0 for s in sleeps)
+    finally:
+        stub.close()
+    # retries exhausted → the status surfaces, bounded (no infinite loop)
+    stub2 = _FlakyHTTPServer([429, 429, 429])
+    try:
+        client = InferenceClient(stub2.address, timeout=5, retries=1,
+                                 sleep=lambda s: None)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.predict(x=np.zeros((1,), np.float32))
+        assert ei.value.code == 429
+    finally:
+        stub2.close()
+
+
+# --------------------------------------------------------------------------
+# preemption guard: trip semantics, signals, maintenance hook
+# --------------------------------------------------------------------------
+
+def test_preemption_guard_trip_fires_callbacks_once():
+    g = PreemptionGuard()
+    seen = []
+    g.on_preempt(lambda r: seen.append(("early", r)))
+    assert not g.preempted
+    g.trip("signal:SIGTERM")
+    g.trip("signal:SIGINT")  # second trip: counted nowhere, no refire
+    assert g.preempted and g.reason == "signal:SIGTERM"  # first wins
+    g.on_preempt(lambda r: seen.append(("late", r)))  # late → immediate
+    assert seen == [("early", "signal:SIGTERM"), ("late", "signal:SIGTERM")]
+    assert g.wait(timeout=0.01) is True
+
+
+def test_preemption_guard_maintenance_hook_rate_limited():
+    clk = _Clock()
+    pending = {"v": None}
+    calls = []
+
+    def hook():
+        calls.append(clk())
+        return pending["v"]
+
+    g = PreemptionGuard(maintenance_hook=hook, maintenance_interval=5.0,
+                        clock=clk)
+    assert g.check() is False
+    clk.advance(1.0)
+    assert g.check() is False
+    assert len(calls) == 1  # polled once inside the interval
+    clk.advance(5.0)
+    pending["v"] = "terminate-on-host-maintenance"
+    assert g.check() is True
+    assert g.reason == "maintenance:terminate-on-host-maintenance"
+
+
+def test_preemption_guard_real_sigterm_and_uninstall():
+    metrics.enable()
+    metrics.reset()
+    prev = _signal.getsignal(_signal.SIGTERM)
+    g = PreemptionGuard().install()
+    try:
+        os.kill(os.getpid(), _signal.SIGTERM)
+        deadline = time.monotonic() + 2.0
+        while not g.preempted and time.monotonic() < deadline:
+            time.sleep(0.005)  # handler runs between bytecodes
+        assert g.preempted and g.reason == "signal:SIGTERM"
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("preemption.signals{signal=SIGTERM}", 0) == 1
+    finally:
+        g.uninstall()
+        metrics.disable()
+        metrics.reset()
+    assert _signal.getsignal(_signal.SIGTERM) is prev  # restored
+    g.uninstall()  # idempotent
+
+
+# --------------------------------------------------------------------------
+# preemption-safe training: checkpoint at safe point, resume bit-for-bit
+# --------------------------------------------------------------------------
+
+def _make_guarded_step(mgr=None):
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    model = fleet.distributed_model(nn.Linear(8, 4))
+    opt = P.optimizer.SGD(parameters=model.parameters(),
+                          learning_rate=0.1)
+    step = model.build_train_step(opt, nn.MSELoss(), guard=True)
+    if mgr is not None:
+        step.attach_checkpoint_manager(mgr)
+    return step
+
+
+def _batch():
+    P.seed(1)
+    return P.randn([8, 8]), P.randn([8, 4])
+
+
+def test_preemption_checkpoint_resume_bit_for_bit(tmp_path):
+    metrics.enable()
+    metrics.reset()
+    try:
+        # reference: 6 uninterrupted guarded steps
+        ref_step = _make_guarded_step()
+        x, y = _batch()
+        ref_losses = [float(ref_step(x, y)) for _ in range(6)]
+        ref_params = {k: np.asarray(v._value) for k, v in
+                      ref_step.train_state_dict().items()}
+
+        # preempted run: trip after 3 steps → safe point checkpoints
+        mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
+        step = _make_guarded_step(mgr)
+        x, y = _batch()
+        guard = PreemptionGuard()
+        step.attach_preemption_guard(guard)
+        pre_losses = [float(step(x, y)) for _ in range(3)]
+        guard.trip("signal:SIGTERM")
+        with pytest.raises(TrainingPreempted) as ei:
+            step(x, y)
+        exc = ei.value
+        assert exc.checkpoint_dir is not None and exc.step == 3
+        assert verify_checkpoint(exc.checkpoint_dir)["unverified"] == 0
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("preemption.checkpoints", 0) == 1
+
+        # resume on a FRESH step: rollback() loads the emergency
+        # checkpoint; the continued trajectory is bit-for-bit the
+        # reference's (guarded fault-free path is select-not-recompute)
+        step2 = _make_guarded_step(mgr)
+        assert step2.rollback() == 3
+        x, y = _batch()
+        post_losses = [float(step2(x, y)) for _ in range(3)]
+        assert pre_losses + post_losses == ref_losses  # exact floats
+        got = {k: np.asarray(v._value) for k, v in
+               step2.train_state_dict().items()}
+        for k, v in ref_params.items():
+            np.testing.assert_array_equal(got[k], v, err_msg=k)
+    finally:
+        metrics.disable()
+        metrics.reset()
+
+
+def test_preemption_checkpoints_once_and_reraises():
+    """A tripped guard without a manager still raises (no save), and a
+    second call after the trip raises again without double-saving."""
+    step = _make_guarded_step()
+    x, y = _batch()
+    float(step(x, y))
+    g = PreemptionGuard()
+    step.attach_preemption_guard(g)
+    g.trip("maintenance:test")
+    with pytest.raises(TrainingPreempted) as ei:
+        step(x, y)
+    assert ei.value.checkpoint_dir is None  # no manager attached
+    assert ei.value.exit_code == 0
+    # a caller ignoring the exception must not silently keep training
+    with pytest.raises(TrainingPreempted) as ei2:
+        step(x, y)
+    assert ei2.value is ei.value  # same exception, no double save
+
+
+def test_run_steps_checks_preemption_at_entry():
+    step = _make_guarded_step()
+    x, y = _batch()
+    xs = P.to_tensor(np.stack([x.numpy()] * 2))
+    ys = P.to_tensor(np.stack([y.numpy()] * 2))
+    float(step.run_steps(xs, ys).numpy()[-1])  # scan path works
+    g = PreemptionGuard()
+    step.attach_preemption_guard(g)
+    g.trip("signal:SIGTERM")
+    with pytest.raises(TrainingPreempted):
+        step.run_steps(xs, ys)
+
+
+# --------------------------------------------------------------------------
+# elastic: preempted rank deregisters instead of vanishing
+# --------------------------------------------------------------------------
+
+class _DictStore:
+    def __init__(self):
+        self.d = {}
+
+    def set(self, k, v):
+        self.d[k] = v
+
+    def get(self, k, timeout=None):
+        return self.d[k]
+
+    def check(self, k):
+        return k in self.d
+
+
+def test_elastic_deregisters_on_preemption():
+    st = _DictStore()
+    m = ElasticManager(store=st, job_id="preempt", np_range="1",
+                       heartbeat_interval=0.05, heartbeat_ttl=0.5)
+    g = PreemptionGuard()
+    m.attach_preemption_guard(g, install=False)
+    assert g.exit_code == ELASTIC_EXIT_CODE  # relaunch protocol rides
+    m.register()
+    assert m._thread is not None and m._thread.is_alive()
+    g.trip("signal:SIGTERM")
+    deadline = time.monotonic() + 2.0
+    while m._thread is not None and m._thread.is_alive() and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert m._thread is None or not m._thread.is_alive()  # beat stopped
+    assert not st.check("elastic/preempt/done")  # NOT marked complete
+
+
+# --------------------------------------------------------------------------
+# schema: the new counters/gauges are pre-declared by attach()
+# --------------------------------------------------------------------------
+
+def test_attach_declares_overload_preemption_schema():
+    from paddle_tpu import observability as obs
+
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    try:
+        snap = metrics.snapshot()
+        for key in ("resilience.shed_requests{reason=queue_full}",
+                    "resilience.shed_requests{reason=deadline}",
+                    "resilience.shed_requests{reason=draining}",
+                    "preemption.signals{signal=SIGTERM}",
+                    "preemption.signals{signal=SIGINT}",
+                    "preemption.maintenance_events",
+                    "preemption.checkpoints", "preemption.drains"):
+            assert key in snap["counters"] and \
+                snap["counters"][key] == 0, key
+        for key in ("serving.inflight", "serving.queue_depth",
+                    "serving.admission_limit"):
+            assert key in snap["gauges"], key
+    finally:
+        obs.detach()
+        metrics.reset()
+
+
+# --------------------------------------------------------------------------
+# chaos tier: seeded overload + preemption matrix (tools/chaos_check.py)
+# --------------------------------------------------------------------------
+
+def _load_chaos_tool():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_check", os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools", "chaos_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # tier-1 runs `-m 'not slow'`; chaos rides slow tier
+def test_chaos_overload_scenario():
+    mod = _load_chaos_tool()
+    for seed in (0, 1):
+        report = mod.run_overload(requests=24, max_inflight=2,
+                                  queue_depth=3, service_time=0.05,
+                                  seed=seed)
+        assert report["recovered"], report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_preemption_scenario(tmp_path):
+    mod = _load_chaos_tool()
+    report = mod.run_preemption(steps=10, seed=0, preempt_at=4,
+                                root=str(tmp_path))
+    assert report["recovered"], report
+    assert report["checkpoint_verified"] and report["preempted"]

@@ -1,25 +1,28 @@
 #!/usr/bin/env python
-"""Chaos smoke: a short train loop under seeded-random fault injection
-that must RECOVER, not merely survive.
+"""Chaos smoke: scenarios that must RECOVER, not merely survive.
 
-What it does (all CPU, all deterministic given --seed):
+Scenarios (--scenario, all CPU, all deterministic given --seed):
 
-  1. builds a tiny dp=2 `DistributedTrainStep` with the NaN guard armed
-     and a `CheckpointManager` attached (keep-last-2, CRC'd, atomic);
-  2. arms probabilistic faults at train.step (NaN poison), plus periodic
-     torn/corrupt checkpoint writes;
-  3. runs N steps, checkpointing every few: NaN steps must be skipped
-     (state preserved), guard escalation must roll back through the
-     checkpoint rotation, torn/corrupt saves must never take down the
-     restore path;
-  4. asserts at the end: loss finite, every injected fault accounted
-     for in the metrics registry, at least one recovery event fired.
+  * `train` (default): a short dp=2 train loop with the NaN guard armed
+    and a CRC'd keep-last-2 `CheckpointManager`, under probabilistic
+    NaN-step poison plus periodic torn/corrupt checkpoint writes — NaN
+    steps must be skipped, escalation must roll back through the
+    rotation, and the run must end healthy.
+  * `overload`: an `InferenceServer` with a deliberately slow predictor
+    takes more concurrent requests than max_inflight + queue_depth —
+    every ADMITTED request must complete, the excess must be shed with
+    429/503 + Retry-After, and the shed count must match the
+    `resilience.shed_requests` counters exactly.
+  * `preemption`: a real SIGTERM lands mid-train-loop — the guarded
+    step must write a checkpoint that passes `verify_checkpoint()`,
+    exit via `TrainingPreempted`, and a fresh step must resume from it
+    and train on to a finite loss.
 
 Exit 0 = recovered; exit 1 = a reflex failed.  CI runs this alongside
 the `chaos`-marked pytest matrix (kept out of tier-1 — see pytest.ini).
 
-Usage:  JAX_PLATFORMS=cpu python tools/chaos_check.py [--steps 40]
-        [--seed 0] [--ckpt-every 5] [--json]
+Usage:  JAX_PLATFORMS=cpu python tools/chaos_check.py [--scenario train]
+        [--steps 40] [--seed 0] [--ckpt-every 5] [--json]
 """
 from __future__ import annotations
 
@@ -28,6 +31,8 @@ import json
 import os
 import sys
 import tempfile
+
+import numpy as np
 
 # runnable as `python tools/chaos_check.py` from anywhere
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -131,27 +136,224 @@ def run_chaos(steps=40, seed=0, ckpt_every=5, root=None):
     return report
 
 
+class _SlowEchoPredictor:
+    """Stdlib+numpy predictor stub: sleeps `service_time` then echoes
+    its input — a deterministic stand-in for a saturated device queue
+    (no jax / saved model needed for the overload scenario)."""
+
+    def __init__(self, service_time=0.05):
+        self.service_time = float(service_time)
+
+    def get_input_names(self):
+        return ["x"]
+
+    def get_output_names(self):
+        return ["y"]
+
+    def run(self, inputs):
+        import time
+
+        time.sleep(self.service_time)
+        return [np.asarray(inputs[0])]
+
+
+def run_overload(requests=24, max_inflight=2, queue_depth=3,
+                 service_time=0.05, seed=0):
+    """Overload chaos: fire `requests` concurrent clients at a server
+    sized for max_inflight + queue_depth of them.  Returns a report;
+    `recovered` means zero admitted-request failures, every excess
+    request shed with a retryable status + Retry-After, and the shed
+    count agreeing with `resilience.shed_requests` exactly."""
+    import threading
+    import urllib.error
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+    from paddle_tpu.observability import metrics
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    srv = InferenceServer(
+        predictor=_SlowEchoPredictor(service_time),
+        max_inflight=max_inflight, queue_depth=queue_depth,
+        request_retries=1, request_timeout=30.0).start()
+    results = []
+    lock = threading.Lock()
+
+    def one(i):
+        client = InferenceClient(srv.address, timeout=30.0, retries=0)
+        x = np.full((2, 2), float(i), np.float32)
+        try:
+            out = client.predict(x=x)
+            ok = bool(np.array_equal(out["y"], x))
+            row = ("ok" if ok else "corrupt", None, None)
+        except urllib.error.HTTPError as e:
+            row = ("shed" if e.code in (429, 503) else "error",
+                   e.code, e.headers.get("Retry-After"))
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            row = ("error", type(e).__name__, None)
+        with lock:
+            results.append(row)
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    drained = srv.shutdown()
+    snap = metrics.snapshot()
+    obs.detach()
+    ok_n = sum(1 for r in results if r[0] == "ok")
+    shed = [r for r in results if r[0] == "shed"]
+    errors = [r for r in results if r[0] in ("error", "corrupt")]
+    shed_counted = sum(v for k, v in snap["counters"].items()
+                       if k.startswith("resilience.shed_requests"))
+    report = {
+        "scenario": "overload",
+        "requests": requests,
+        "capacity": max_inflight + queue_depth,
+        "completed": ok_n,
+        "shed": len(shed),
+        "shed_with_retry_after": sum(1 for r in shed if r[2] is not None),
+        "shed_counter": shed_counted,
+        "admitted_failures": len(errors),
+        "failure_detail": sorted({f"{r[0]}:{r[1]}" for r in errors}),
+        "drained": bool(drained),
+        "socket_closed": srv._httpd.socket.fileno() == -1,
+        # every request either completed or was shed politely; the
+        # counter agrees; at least one of each actually happened (an
+        # overload run with no sheds did not exercise overload)
+        "recovered": (len(errors) == 0 and ok_n > 0 and len(shed) > 0
+                      and len(shed) == shed_counted
+                      and all(r[2] is not None for r in shed)
+                      and bool(drained)),
+    }
+    return report
+
+
+def run_preemption(steps=12, seed=0, preempt_at=5, root=None):
+    """Preemption chaos: deliver a REAL SIGTERM mid-loop; the guarded
+    step must checkpoint (verified), raise TrainingPreempted, and a
+    fresh step must resume from the checkpoint and keep training."""
+    import signal as _signal
+
+    import paddle_tpu as P
+    import paddle_tpu.nn as nn
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.distributed.checkpoint import (
+        CheckpointManager, verify_checkpoint,
+    )
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.resilience.preemption import (
+        PreemptionGuard, TrainingPreempted,
+    )
+
+    def build_step(mgr):
+        topology.reset_topology()
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                   "pp_degree": 1, "sep_degree": 1,
+                                   "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        P.seed(0)
+        model = fleet.distributed_model(nn.Linear(16, 4))
+        opt = P.optimizer.SGD(parameters=model.parameters(),
+                              learning_rate=0.05)
+        step = model.build_train_step(opt, nn.MSELoss(), guard=True)
+        step.attach_checkpoint_manager(mgr)
+        return step
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    root = root or tempfile.mkdtemp(prefix="chaos_preempt_")
+    mgr = CheckpointManager(root, keep_last_k=2)
+    step = build_step(mgr)
+    P.seed(seed + 1)
+    x = P.randn([8, 16])
+    y = P.randn([8, 4])
+
+    guard = PreemptionGuard().install()
+    step.attach_preemption_guard(guard)
+    preempted = verified = None
+    steps_before = 0
+    try:
+        for i in range(steps):
+            if i == preempt_at:
+                # a real signal, handled at the next safe point
+                os.kill(os.getpid(), _signal.SIGTERM)
+            float(step(x, y))
+            steps_before += 1
+    except TrainingPreempted as e:
+        preempted = e
+        if e.checkpoint_dir is not None:
+            verified = verify_checkpoint(e.checkpoint_dir)
+    finally:
+        guard.uninstall()
+
+    resumed_losses = []
+    if preempted is not None and verified is not None:
+        step2 = build_step(mgr)
+        restored_step = step2.rollback()  # newest verified checkpoint
+        for _ in range(steps - steps_before):
+            resumed_losses.append(float(step2(x, y)))
+    else:
+        restored_step = None
+
+    snap = metrics.snapshot()["counters"]
+    obs.detach()
+    report = {
+        "scenario": "preemption",
+        "steps": steps,
+        "preempt_at": preempt_at,
+        "steps_before_preemption": steps_before,
+        "preempted": preempted is not None,
+        "reason": getattr(preempted, "reason", None),
+        "checkpoint_dir": getattr(preempted, "checkpoint_dir", None),
+        "checkpoint_verified": verified is not None,
+        "restored_step": restored_step,
+        "resumed_steps": len(resumed_losses),
+        "final_loss": resumed_losses[-1] if resumed_losses else None,
+        "signals_counted": snap.get(
+            "preemption.signals{signal=SIGTERM}", 0),
+        "emergency_checkpoints": snap.get("preemption.checkpoints", 0),
+        "recovered": (preempted is not None and verified is not None
+                      and bool(resumed_losses)
+                      and bool(np.isfinite(resumed_losses[-1]))),
+    }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario",
+                    choices=("train", "overload", "preemption"),
+                    default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-every", type=int, default=5)
     ap.add_argument("--json", action="store_true",
                     help="emit the full report as JSON on stdout")
     args = ap.parse_args(argv)
-    report = run_chaos(steps=args.steps, seed=args.seed,
-                       ckpt_every=args.ckpt_every)
+    if args.scenario == "overload":
+        report = run_overload(seed=args.seed)
+    elif args.scenario == "preemption":
+        report = run_preemption(steps=min(args.steps, 12), seed=args.seed)
+    else:
+        report = run_chaos(steps=args.steps, seed=args.seed,
+                           ckpt_every=args.ckpt_every)
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:
-        for k in ("steps", "injected_faults", "nan_steps_seen",
-                  "skipped_steps", "rollbacks", "torn_saves",
-                  "final_loss", "recovered"):
-            print(f"{k:>18}: {report[k]}")
+        for k, v in report.items():
+            if k != "resilience_counters":
+                print(f"{k:>24}: {v}")
     if not report["recovered"]:
-        print("CHAOS CHECK FAILED: run did not recover", file=sys.stderr)
+        print(f"CHAOS CHECK FAILED ({args.scenario}): run did not "
+              "recover", file=sys.stderr)
         return 1
-    print("chaos check: recovered OK")
+    print(f"chaos check ({args.scenario}): recovered OK")
     return 0
 
 
