@@ -11,6 +11,12 @@ Three layers, one report format (``file:line RULE message``):
   * :mod:`.hlo_audit` — jaxpr/StableHLO audit (PT201–PT203): host
     transfers, silent f64 promotion, un-donated train-step buffers.
 
+  * :mod:`.perf_audit` — static performance auditor (PT400–PT405):
+    layout-tax transposes, recompile hazards, replicated big buffers,
+    collective anti-patterns, hot-loop host syncs — quantified per
+    representative program and held to committed per-model budgets
+    (``tools/perf_budget.json``).
+
 Plus :mod:`.manifest_check` (PT301): OPS_MANIFEST.json claims vs the
 live module surface.
 
@@ -24,7 +30,8 @@ a jax import (the CLI runs pre-commit; the repo gate runs in tier-1).
 """
 from .report import (  # noqa: F401
     Suppressions, Violation, baseline_counts, diff_against_baseline,
-    load_baseline, render_report, save_baseline,
+    diff_against_budget, load_baseline, load_budget,
+    render_budget_diff, render_report, save_baseline, save_budget,
 )
 from .runner import (  # noqa: F401
     DEFAULT_ROOTS, analyze_one_file, analyze_repo, iter_python_files,
@@ -33,6 +40,8 @@ from .runner import (  # noqa: F401
 __all__ = [
     "Violation", "Suppressions", "load_baseline", "save_baseline",
     "baseline_counts", "diff_against_baseline", "render_report",
+    "save_budget", "load_budget", "diff_against_budget",
+    "render_budget_diff",
     "analyze_repo", "analyze_one_file", "iter_python_files",
     "DEFAULT_ROOTS",
 ]

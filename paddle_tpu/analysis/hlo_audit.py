@@ -215,13 +215,15 @@ def _resolve_op(name):
     return None
 
 
-def audit_op_table(limit: int | None = None, manifest_path=None) -> list:
-    """Trace every conformance-swept unary/binary op from the manifest
-    with the sweep's own input factories and audit each jaxpr.
+def iter_op_callables(limit: int | None = None, manifest_path=None):
+    """Yield ``(name, traced_fn_or_None, args)`` for every manifest op
+    with a unary/binary conformance sweep — the shared program source
+    for this layer's correctness audit and the perf layer's op-table
+    sweep (one place decides what 'the exported op surface' means).
 
-    Tracing only — no compilation, no execution — so the full ~200-op
-    sweep is seconds, not minutes; still gated behind the slow tier /
-    ``--jaxpr`` because it imports jax + paddle_tpu + the model stack."""
+    ``traced_fn`` is a plain jax-traceable callable using the sweep's
+    own domain-correct input factories; ``None`` when the op does not
+    resolve."""
     import jax.numpy as jnp
 
     import paddle_tpu as P
@@ -233,7 +235,6 @@ def audit_op_table(limit: int | None = None, manifest_path=None) -> list:
     finally:
         sys.path.pop(0)
 
-    out = []
     ops = _manifest_conformance_ops(manifest_path)
     if limit is not None:
         ops = ops[:limit]
@@ -249,10 +250,7 @@ def audit_op_table(limit: int | None = None, manifest_path=None) -> list:
             else conformance_tables.BINARY_OPS
         spec = table.get(name)
         if fn is None or spec is None:
-            out.append(Violation(
-                "OPS_MANIFEST.json", 0, "PT200",
-                f"op `{name}` claims a {kind} conformance sweep but "
-                f"does not resolve — cannot audit"))
+            yield name, None, ()
             continue
         shape = (3, 4)
         if kind == "unary":
@@ -274,10 +272,38 @@ def audit_op_table(limit: int | None = None, manifest_path=None) -> list:
             def traced(a, b, _fn=fn):
                 return unwrap(_fn(P.to_tensor(a), P.to_tensor(b)))
             args = (x, x + 0.5)
+        yield name, traced, args
+
+
+def audit_op_table(limit: int | None = None, manifest_path=None) -> list:
+    """Trace every conformance-swept unary/binary op from the manifest
+    with the sweep's own input factories and audit each jaxpr.
+
+    Tracing only — no compilation, no execution — so the full ~200-op
+    sweep is seconds, not minutes; still gated behind the slow tier /
+    ``--jaxpr`` because it imports jax + paddle_tpu + the model stack."""
+    import paddle_tpu as P
+    from paddle_tpu.core.tensor import Tensor
+
+    def unwrap(r):
+        if isinstance(r, (tuple, list)):
+            return [unwrap(x) for x in r]
+        return r._value if isinstance(r, Tensor) else r
+
+    out = []
+    for name, traced, args in iter_op_callables(limit, manifest_path):
+        if traced is None:
+            out.append(Violation(
+                "OPS_MANIFEST.json", 0, "PT200",
+                f"op `{name}` claims a conformance sweep but does not "
+                f"resolve — cannot audit"))
+            continue
         found = audit_callable(traced, *args, where=f"op:{name}")
-        if found and found[0].rule == "PT200" and kind == "binary":
+        if found and found[0].rule == "PT200" and len(args) == 2:
             # ternary-shaped "binary" ops (lerp: x, y, weight): retry
             # with a scalar third operand before reporting un-auditable
+            fn = _resolve_op(name)
+
             def traced3(a, b, _fn=fn):
                 return unwrap(_fn(P.to_tensor(a), P.to_tensor(b), 0.5))
             found = audit_callable(traced3, *args, where=f"op:{name}")

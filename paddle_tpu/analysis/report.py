@@ -29,6 +29,8 @@ import re
 __all__ = [
     "Violation", "Suppressions", "load_baseline", "save_baseline",
     "baseline_counts", "diff_against_baseline", "render_report",
+    "save_budget", "load_budget", "diff_against_budget",
+    "render_budget_diff",
 ]
 
 _SUPPRESS_RE = re.compile(
@@ -175,3 +177,96 @@ def diff_against_baseline(violations, baseline: dict):
 def render_report(violations) -> str:
     return "\n".join(
         v.render() for v in sorted(violations, key=Violation.sort_key))
+
+
+# --------------------------- perf budgets ---------------------------
+#
+# The perf-audit layer (perf_audit.py, PT4xx) does not gate on a
+# violation baseline: its findings are *quantified costs* (transpose
+# bytes, replicated MiB, host syncs) that are nonzero today by design.
+# Instead each audited program carries a committed budget —
+# tools/perf_budget.json — and the gate fails when any metric EXCEEDS
+# its budget. Lower is always better; a drop is reported as an
+# improvement so the budget ratchets down via --update-budget, the
+# exact analog of the lint baseline's stale-entry note.
+
+BUDGET_VERSION = 1
+
+
+def save_budget(path: str, metrics: dict) -> dict:
+    """Write {program: {metric: value}} deterministically: sorted keys,
+    values already rounded by the auditor, newline-terminated — two
+    audits of the same tree must produce byte-identical files."""
+    data = {
+        "version": BUDGET_VERSION,
+        "comment": "pt_lint static perf budgets — regenerate with "
+                   "`python tools/pt_lint.py --update-budget`. The "
+                   "--perf gate fails only on metrics that EXCEED "
+                   "their budget; lower numbers are improvements "
+                   "(ratchet the budget down).",
+        "budgets": {prog: dict(sorted(vals.items()))
+                    for prog, vals in sorted(metrics.items())},
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def load_budget(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    budgets = data.get("budgets", {})
+    return budgets if isinstance(budgets, dict) else {}
+
+
+def diff_against_budget(metrics: dict, budget: dict):
+    """Compare audited metrics to committed budgets.
+
+    Returns ``(regressions, improvements, unbudgeted)`` — lists of
+    ``(program, metric, value, budgeted)`` tuples. A metric with no
+    budget entry and a nonzero value is a regression (the gate must
+    force a conscious --update-budget, exactly like a NEW lint
+    violation); a zero-valued unbudgeted metric passes (adding a new
+    always-zero metric must not break CI). Only programs present in
+    ``metrics`` are judged: a fast-subset audit does not vouch for the
+    slow-tier programs' budgets."""
+    regressions, improvements, unbudgeted = [], [], []
+    for prog in sorted(metrics):
+        have = metrics[prog]
+        want = budget.get(prog, {})
+        if not isinstance(want, dict):
+            want = {}
+        for name in sorted(have):
+            value = have[name]
+            if name not in want:
+                if value > 0:
+                    regressions.append((prog, name, value, None))
+                else:
+                    unbudgeted.append((prog, name, value, None))
+                continue
+            budgeted = want[name]
+            if value > budgeted + 1e-9:
+                regressions.append((prog, name, value, budgeted))
+            elif value < budgeted - 1e-9:
+                improvements.append((prog, name, value, budgeted))
+    return regressions, improvements, unbudgeted
+
+
+def render_budget_diff(regressions, improvements) -> str:
+    lines = []
+    for prog, name, value, budgeted in regressions:
+        if budgeted is None:
+            lines.append(f"REGRESS  {prog}.{name}: {value} "
+                         f"(no budget entry — run --update-budget "
+                         f"if intended)")
+        else:
+            lines.append(f"REGRESS  {prog}.{name}: {value} exceeds "
+                         f"budget {budgeted}")
+    for prog, name, value, budgeted in improvements:
+        lines.append(f"improved {prog}.{name}: {value} (budget "
+                     f"{budgeted} — ratchet down with --update-budget)")
+    return "\n".join(lines)

@@ -3,8 +3,8 @@ diff against the committed baseline.
 
 The fast path (`layers=("ast", "lock")`) is pure stdlib — no jax, no
 paddle_tpu import — so the tier-1 repo gate costs file IO plus ast
-parses (~1 s for this tree). The `manifest` and `jaxpr` layers import
-the live package and are opt-in.
+parses (~1 s for this tree). The `manifest`, `jaxpr` and `perf` layers
+import the live package and are opt-in.
 
 Determinism contract (tested): two runs over the same tree produce
 byte-identical reports — files walked in sorted order, violations
@@ -86,6 +86,14 @@ def analyze_repo(repo_root: str, roots=DEFAULT_ROOTS,
 
         out.extend(audit_op_table())
         out.extend(audit_train_step())
+    if "perf" in layers:
+        # findings only — the quantified metrics gate through
+        # tools/perf_budget.json, not the violation baseline; use
+        # perf_audit.audit_perf directly when the budget dict is needed
+        from .perf_audit import audit_perf
+
+        perf_v, _metrics = audit_perf(repo_root=repo_root)
+        out.extend(perf_v)
     out.sort(key=Violation.sort_key)
     return out
 

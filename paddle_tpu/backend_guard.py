@@ -113,7 +113,9 @@ def force_cpu_mesh(n_devices: int = 8):
         if isinstance(d, dict):
             d.pop("axon", None)
     except Exception:
-        pass
+        from .observability import metrics as _metrics
+
+        _metrics.inc("backend.guard_swallowed", stage="drop_factory")
 
     import jax
 
@@ -130,7 +132,9 @@ def force_cpu_mesh(n_devices: int = 8):
             jax.clear_caches()
             _xb._clear_backends()
     except Exception:
-        pass
+        from .observability import metrics as _metrics
+
+        _metrics.inc("backend.guard_swallowed", stage="clear_backends")
 
     # sitecustomize imported jax before us, so the config snapshot may
     # already hold JAX_PLATFORMS=axon — override at the config level too.
@@ -139,5 +143,10 @@ def force_cpu_mesh(n_devices: int = 8):
         try:
             jax.config.update(key, val)
         except Exception:
-            pass
+            # expected on older jax (the config key does not exist
+            # there) — counted, not silent, so a genuinely broken
+            # config update is visible in the metrics snapshot
+            from .observability import metrics as _metrics
+
+            _metrics.inc("backend.guard_swallowed", stage="config:" + key)
     return jax

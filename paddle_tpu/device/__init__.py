@@ -147,7 +147,9 @@ def synchronize(device=None):
         try:
             jax.device_put(0, d).block_until_ready()
         except Exception:
-            pass
+            from ..observability import metrics as _metrics
+
+            _metrics.inc("device.sync_errors")
 
 
 class cuda:  # namespace shim: reference exposes paddle.device.cuda

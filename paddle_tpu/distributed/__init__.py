@@ -97,7 +97,9 @@ def wait(tensor, group=None, use_calc_stream=True):
     try:
         v.block_until_ready()
     except Exception:
-        pass
+        from ..observability import metrics as _metrics
+
+        _metrics.inc("collective.wait_errors")
     return tensor
 
 
@@ -266,8 +268,11 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
             import numpy as _np
 
             gb = int(_np.shape(first[0])[0])
-        except Exception:
-            pass
+        except Exception as e:
+            from ..observability import flight as _flight
+
+            _flight.record("fleet.global_batch_probe_failed",
+                           error=repr(e), fallback=gb)
     eng.prepare(global_batch=gb)
     dm = DistModel(eng)
     return (dm, loader) if loader is not None else dm

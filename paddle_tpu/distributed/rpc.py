@@ -85,7 +85,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 _send_msg(self.request, ("err", RuntimeError(
                     f"rpc reply not picklable: {e!r}")))
             except Exception:
-                pass
+                # the connection died under us too — count it so a
+                # flapping peer shows up in the metrics snapshot
+                from ..observability import metrics as _metrics
+
+                _metrics.inc("rpc.reply_errors")
 
 
 class _Server(socketserver.ThreadingTCPServer):

@@ -5,6 +5,7 @@ from __future__ import annotations
 import time
 
 from .. import native
+from ..observability import metrics as _metrics
 
 
 class TCPStore:
@@ -86,4 +87,6 @@ class TCPStore:
             if getattr(self, "_server", None):
                 self.lib.tcp_store_server_stop(self._server)
         except Exception:
-            pass
+            # module-top import on purpose: importing inside a __del__
+            # handler can itself raise at interpreter shutdown
+            _metrics.inc("store.del_errors")

@@ -56,8 +56,14 @@ class Model:
 
                 if not isinstance(self.network, DataParallel):
                     self.network = DataParallel(self.network)
-        except Exception:
-            pass
+        except Exception as e:
+            # auto-wrap is best-effort (the model still runs
+            # un-wrapped) — but a dp>1 topology that fails to wrap is
+            # silent data-parallel loss; leave the evidence
+            from ..observability import flight as _flight
+
+            _flight.record("hapi.data_parallel_wrap_failed",
+                           error=repr(e))
         return self
 
     # --- single steps --------------------------------------------------------

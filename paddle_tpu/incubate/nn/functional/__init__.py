@@ -475,8 +475,13 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                     "the dense flash path, decode here")
         except NotImplementedError:
             raise
-        except Exception:
-            pass
+        except Exception as e:
+            # probe-only: un-inspectable seq_lens_encoder falls through
+            # to the decode path — but not silently
+            from ....observability import flight as _flight
+
+            _flight.record("block_mha.prefill_probe_failed",
+                           error=repr(e))
 
     from ....core.dispatch import apply
     import jax

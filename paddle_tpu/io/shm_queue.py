@@ -9,6 +9,7 @@ import pickle
 import uuid
 
 from .. import native
+from ..observability import metrics as _metrics
 
 
 class ShmQueue:
@@ -71,7 +72,9 @@ class ShmQueue:
                 if self._owner:
                     self.lib.shm_ring_unlink(self.name.encode())
         except Exception:
-            pass
+            # module-top import on purpose: importing inside a __del__
+            # handler can itself raise at interpreter shutdown
+            _metrics.inc("io.shm_del_errors")
 
 
 def _worker_main(dataset, batches, indices, collate_path, queue_name,

@@ -696,6 +696,409 @@ def test_pt301_manifest_drift_fixture(tmp_path):
     assert "definitely_not_an_op_xyz" in drift[0].message
 
 
+# ----------------------- perf layer: PT401 layout tax -----------------------
+
+
+def test_pt401_positive_real_program():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x):
+        return jnp.transpose(x, (0, 2, 1, 3)) * 2.0
+
+    lowered = jax.jit(f).lower(jnp.ones((2, 64, 64, 32), jnp.float32))
+    v, m = perf_audit.audit_program_texts(
+        "fix", stablehlo_text=lowered.as_text(),
+        opt_hlo_text=lowered.compile().as_text())
+    assert m["pt401_transpose_count"] >= 1
+    assert m["pt401_transpose_mbytes"] > 0
+    assert "PT401" in rules_of(v)
+
+
+def test_pt401_negative_real_program():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x):
+        return (x * 2.0).sum()
+
+    lowered = jax.jit(f).lower(jnp.ones((8, 8), jnp.float32))
+    v, m = perf_audit.audit_program_texts(
+        "fix", stablehlo_text=lowered.as_text())
+    assert m["pt401_transpose_count"] == 0
+    assert "PT401" not in rules_of(v)
+
+
+# ----------------------- PT402 recompile hazards -----------------------
+
+
+def test_pt402_weak_input_positive_and_negative():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x, lr):
+        return x * lr
+
+    weak = jax.make_jaxpr(f)(jnp.ones(4), 0.1)          # python scalar
+    strong = jax.make_jaxpr(f)(jnp.ones(4),
+                               jnp.float32(0.1))         # typed scalar
+    assert perf_audit.weak_input_count(weak) == 1
+    assert perf_audit.weak_input_count(strong) == 0
+    v, m = perf_audit.audit_program_texts("fix", closed_jaxpr=weak)
+    assert m["pt402_weak_inputs"] == 1 and "PT402" in rules_of(v)
+
+
+PT402_CALLSITE_POS = """
+    import jax
+
+    def f(x, n):
+        return x * n
+
+    g = jax.jit(f)
+
+    def run(x, batch):
+        return g(x, int(batch.shape[0])), g(x, [1, 2])
+"""
+
+PT402_CALLSITE_NEG = """
+    import jax
+
+    def f(x, n):
+        return x * n
+
+    g = jax.jit(f)
+
+    def run(x, n_arr):
+        return g(x, n_arr)       # array arg: no host scalar, hashable
+
+    def eager(x, batch):
+        return f(x, int(batch.shape[0]))   # not the jitted wrapper
+"""
+
+
+def test_pt402_call_site_positive():
+    from paddle_tpu.analysis import perf_audit
+
+    v = perf_audit.call_site_hazards(
+        textwrap.dedent(PT402_CALLSITE_POS), "fix.py")
+    assert len(v) == 2 and rules_of(v) == {"PT402"}
+    assert any("int(" in x.message for x in v)
+    assert any("mutable literal" in x.message for x in v)
+
+
+def test_pt402_call_site_negative():
+    from paddle_tpu.analysis import perf_audit
+
+    assert perf_audit.call_site_hazards(
+        textwrap.dedent(PT402_CALLSITE_NEG), "fix.py") == []
+
+
+# ----------------------- PT403 replicated state -----------------------
+
+
+def test_pt403_replicated_positive_and_sharded_negative():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as PS
+
+    from paddle_tpu.analysis import perf_audit
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    rep = NamedSharding(mesh, PS())
+    shd = NamedSharding(mesh, PS("dp", None))
+    big = jnp.ones((512, 512), jnp.float32)              # 1 MiB
+
+    def f(p):
+        return p * 2.0
+
+    rep_text = jax.jit(f, in_shardings=(rep,),
+                       out_shardings=rep).lower(big).as_text()
+    shd_text = jax.jit(f, in_shardings=(shd,),
+                       out_shardings=shd).lower(big).as_text()
+    pos = perf_audit.replicated_args(rep_text, min_mbytes=0.5)
+    neg = perf_audit.replicated_args(shd_text, min_mbytes=0.5)
+    assert pos["pt403_replicated_count"] == 1
+    assert pos["pt403_replicated_mbytes"] == 1.0
+    assert neg["pt403_replicated_count"] == 0
+    v, _ = perf_audit.audit_program_texts(
+        "fix", stablehlo_text=rep_text, min_replicated_mbytes=0.5)
+    assert "PT403" in rules_of(v)
+
+
+# ----------------------- PT404 collective patterns -----------------------
+
+
+def _shard_map_jaxpr(fn, n=4):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as PS
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    wrapped = shard_map(fn, mesh=mesh, in_specs=PS("dp"),
+                        out_specs=PS(), check_rep=False)
+    return jax.make_jaxpr(wrapped)(jnp.ones((8, 4), jnp.float32))
+
+
+def test_pt404_allgather_then_reduce_positive():
+    import jax
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x):
+        g = jax.lax.all_gather(x, "dp", tiled=True)
+        return g.sum(axis=0).sum()                   # gather-then-reduce
+
+    m = perf_audit.collective_patterns(_shard_map_jaxpr(f))
+    assert m["pt404_allgather_reduce"] >= 1
+    v, _ = perf_audit.audit_program_texts(
+        "fix", closed_jaxpr=_shard_map_jaxpr(f))
+    assert "PT404" in rules_of(v)
+
+
+def test_pt404_chained_collectives_positive():
+    import jax
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x):
+        s = jax.lax.psum(x.sum(axis=0), "dp")
+        return jax.lax.all_gather(s, "dp", tiled=True).sum()  # chained
+
+    m = perf_audit.collective_patterns(_shard_map_jaxpr(f))
+    assert m["pt404_chained_collectives"] >= 1
+
+
+def test_pt404_lone_collective_negative():
+    import jax
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x):
+        return jax.lax.psum(x.sum(axis=0), "dp").sum()  # one collective,
+        # compute on both sides: nothing chained, nothing gather-reduced
+
+    m = perf_audit.collective_patterns(_shard_map_jaxpr(f))
+    assert m["pt404_allgather_reduce"] == 0
+    assert m["pt404_chained_collectives"] == 0
+
+
+# ----------------------- PT405 hot-loop host syncs -----------------------
+
+
+def _callback_fn(in_loop):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def sync(c):
+        return jax.pure_callback(
+            lambda a: np.asarray(a),
+            jax.ShapeDtypeStruct((), jnp.float32), c)
+
+    if in_loop:
+        def f(x):
+            def body(c, _):
+                return c + sync(c), None
+            out, _ = jax.lax.scan(body, x, None, length=3)
+            return out
+    else:
+        def f(x):
+            return x + sync(x)
+    return f
+
+
+def test_pt405_callback_in_loop_positive():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import perf_audit
+
+    jaxpr = jax.make_jaxpr(_callback_fn(True))(jnp.float32(1.0))
+    m = perf_audit.host_sync_counts(jaxpr)
+    assert m["pt405_loop_host_syncs"] == 1
+    v, _ = perf_audit.audit_program_texts("fix", closed_jaxpr=jaxpr)
+    assert any(x.rule == "PT405" and "loop" in x.message for x in v)
+
+
+def test_pt405_callback_outside_loop_negative():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import perf_audit
+
+    jaxpr = jax.make_jaxpr(_callback_fn(False))(jnp.float32(1.0))
+    m = perf_audit.host_sync_counts(jaxpr)
+    assert m["pt405_loop_host_syncs"] == 0
+    assert m["pt405_host_syncs"] == 1        # still a sync, not in-loop
+
+
+def test_pt405_clean_loop_negative():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis import perf_audit
+
+    def f(x):
+        def body(c, _):
+            return c * 2.0, None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    jaxpr = jax.make_jaxpr(f)(jnp.float32(1.0))
+    m = perf_audit.host_sync_counts(jaxpr)
+    assert m["pt405_host_syncs"] == 0
+    assert m["pt405_loop_host_syncs"] == 0
+
+
+# ----------------------- budget semantics -----------------------
+
+
+def test_budget_diff_regress_improve_unbudgeted():
+    metrics = {"prog": {"a_count": 3, "b_mbytes": 1.5, "new_zero": 0,
+                        "new_hot": 2}}
+    budget = {"prog": {"a_count": 2, "b_mbytes": 2.0}}
+    reg, imp, unb = A.diff_against_budget(metrics, budget)
+    assert ("prog", "a_count", 3, 2) in reg          # over budget
+    assert ("prog", "new_hot", 2, None) in reg       # nonzero, unbudgeted
+    assert ("prog", "b_mbytes", 1.5, 2.0) in imp     # ratchet note
+    assert ("prog", "new_zero", 0, None) in unb      # zero: passes
+    assert len(reg) == 2
+
+
+def test_budget_only_judges_audited_programs():
+    # a fast-subset audit must not vouch for (or trip over) the
+    # slow-tier op_table entry
+    metrics = {"call_sites": {"pt402_call_site_hazards": 0}}
+    budget = {"call_sites": {"pt402_call_site_hazards": 0},
+              "op_table": {"pt401_transpose_count": 0}}
+    reg, imp, _ = A.diff_against_budget(metrics, budget)
+    assert reg == [] and imp == []
+
+
+def test_budget_round_trip_and_determinism(tmp_path):
+    from paddle_tpu.analysis import perf_audit
+
+    _, m1 = perf_audit.audit_perf(programs=("call_sites",),
+                                  repo_root=REPO)
+    _, m2 = perf_audit.audit_perf(programs=("call_sites",),
+                                  repo_root=REPO)
+    p1, p2 = str(tmp_path / "b1.json"), str(tmp_path / "b2.json")
+    A.save_budget(p1, m1)
+    A.save_budget(p2, m2)
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        assert f1.read() == f2.read()        # byte-identical across runs
+    assert A.load_budget(p1) == m1
+
+
+def test_budget_cli_round_trip(tmp_path):
+    """emit -> check ok -> deliberate regress -> exit 2 ->
+    --update-budget -> exit 0 (the acceptance-criteria loop, on the
+    jax-free call_sites program so the subprocesses are cheap)."""
+    budget = str(tmp_path / "budget.json")
+    lint = os.path.join(REPO, "tools", "pt_lint.py")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, lint, "--perf",
+             "--perf-programs", "call_sites", "--budget", budget]
+            + list(extra),
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+
+    p = run("--update-budget")
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = run("--check")
+    assert p.returncode == 0, p.stdout + p.stderr
+    # deliberately regress the committed budget below reality
+    data = json.load(open(budget))
+    data["budgets"]["call_sites"]["pt402_call_site_hazards"] = -1
+    with open(budget, "w") as f:
+        json.dump(data, f)
+    p = run("--check")
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "REGRESS" in p.stdout
+    p = run("--update-budget")
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = run("--check")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_budget_subset_update_merges_not_clobbers(tmp_path):
+    """--perf-programs X --update-budget must keep the OTHER programs'
+    committed ceilings (a subset rewrite that dropped them would let
+    their costs regress silently — dropped-zero metrics pass --check)."""
+    budget = str(tmp_path / "budget.json")
+    A.save_budget(budget, {"op_table": {"pt401_transpose_count": 7}})
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pt_lint.py"),
+         "--perf", "--perf-programs", "call_sites",
+         "--update-budget", "--budget", budget],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    merged = A.load_budget(budget)
+    assert merged["op_table"] == {"pt401_transpose_count": 7}
+    assert "call_sites" in merged
+
+
+def test_perf_gate_merges_static_budget(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    budget = str(tmp_path / "perf_budget.json")
+    A.save_budget(budget, {"prog": {"pt401_transpose_count": 13}})
+    static = pg.load_static_budget(budget)
+    row = static["static.prog.pt401_transpose_count"]
+    assert row["lower_better"] and row["tolerance"] == 0.0
+
+    ok_rows = [{"metric": "static.prog.pt401_transpose_count",
+                "value": 13, "lower_better": True}]
+    bad_rows = [{"metric": "static.prog.pt401_transpose_count",
+                 "value": 14, "lower_better": True}]
+    fails, _ = pg.gate(ok_rows, dict(static))
+    assert fails == []
+    fails, _ = pg.gate(bad_rows, dict(static))
+    assert len(fails) == 1                    # budgets have no slack
+
+
+# ----------------------- perf CI smoke (tier-1) -----------------------
+
+
+def test_perf_smoke_train_step_within_budget():
+    """The tier-1 perf-audit gate: the GPT train step audits under
+    JAX_PLATFORMS=cpu, reports a NONZERO PT401 layout tax for the
+    current transpose-default attention layout, and every metric holds
+    its committed budget. When the flat-layout work (ROADMAP item 2)
+    lands, the transpose numbers drop and --update-budget ratchets the
+    floor down."""
+    from paddle_tpu.analysis import perf_audit
+
+    violations, metrics = perf_audit.audit_perf(
+        programs=("train_step",), repo_root=REPO)
+    assert not [v for v in violations if v.rule == "PT400"], \
+        A.render_report(violations)
+    m = metrics["gpt125m_train_step"]
+    assert m["pt401_transpose_count"] > 0       # today's layout tax,
+    assert m["pt401_transpose_mbytes"] > 0      # statically visible
+    budget = A.load_budget(
+        os.path.join(REPO, "tools", "perf_budget.json"))
+    reg, _imp, _ = A.diff_against_budget(metrics, budget)
+    assert reg == [], A.render_budget_diff(reg, [])
+
+
 # ----------------------- slow tier: whole-program audits -----------------------
 
 
@@ -709,3 +1112,19 @@ def test_op_table_audit_clean():
 def test_train_step_audit_clean():
     v = hlo_audit.audit_train_step()
     assert v == [], A.render_report(v)
+
+
+@pytest.mark.slow
+def test_perf_full_audit_within_budget():
+    """Slow tier: the FULL program set (decode step + op-table sweep
+    included) audits cleanly against tools/perf_budget.json."""
+    from paddle_tpu.analysis import perf_audit
+
+    violations, metrics = perf_audit.audit_perf(
+        programs=perf_audit.FULL_PROGRAMS, repo_root=REPO)
+    assert not [v for v in violations if v.rule == "PT400"], \
+        A.render_report(violations)
+    budget = A.load_budget(
+        os.path.join(REPO, "tools", "perf_budget.json"))
+    reg, _imp, _ = A.diff_against_budget(metrics, budget)
+    assert reg == [], A.render_budget_diff(reg, [])

@@ -30,8 +30,8 @@ try:
     from paddle_tpu.distributed.pipeline_spmd import shard_map as _sm
 
     _partial_manual_ok = "axis_names" in _inspect.signature(_sm).parameters
-except Exception:
-    pass
+except (ImportError, AttributeError, TypeError, ValueError):
+    pass  # no signature to probe: the modern-toolchain path stays off
 _needs_partial_manual = pytest.mark.skipif(
     not _partial_manual_ok,
     reason="jax<0.5 shard_map auto-axes partitioner cannot lower "

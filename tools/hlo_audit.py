@@ -1,100 +1,31 @@
-"""Chip-free MFU forensics: audit the compiled train step's HLO for the
-two program-structure sins that cap MXU utilization —
+"""Chip-free MFU forensics — thin CLI shim.
 
-  * GEMMs running with f32 operands where bf16 was intended (a stray
-    f32 dot runs the MXU at quarter rate; PERF.md round-3 found exactly
-    this inside the flash kernel)
-  * layout transposes in the hot path ([B,S,H,D] <-> [B,H,S,D] around
-    attention — the cost the head-major residuals halved and the mh
-    kernels would eliminate)
+The actual analysis lives in ``paddle_tpu.analysis.perf_audit``
+(``audit_hlo`` / ``train_step_hlo``) so the standalone tool and the
+static-analysis package cannot drift: one regex set decides what "an
+f32-operand dot" or "a big transpose" means for both the CLI table and
+the PT401 budget gate.
 
-Method: lower the GPT train step at a proxy shape (same dtypes/structure
-as the bench shape, smaller batch/depth so CPU lowering is quick), walk
-the PRE-OPTIMIZATION StableHLO, and bucket every dot_general and
-transpose by result dtype and size. Pre-optimization is the honest view
-for dtypes: XLA:CPU's optimized HLO legalizes every bf16 dot to f32
-(no bf16 units on CPU), which says nothing about the TPU program.
-Caveats the other way: the attention dots here are the reference path
-(CPU has no Pallas flash), and StableHLO transposes are an upper bound —
-XLA fuses/elides some of them on TPU.
+What it reports (see perf_audit.audit_hlo for the method):
+  * GEMMs bucketed by OPERAND dtype — a stray f32-operand dot runs the
+    MXU at quarter rate (bf16 operands + f32 accumulation is full rate)
+  * big layout transposes by moved bytes — the PERF.md 66 ms/step
+    (20%) finding, statically
 
 Run: python tools/hlo_audit.py   # table + one JSON line per section
 """
 from __future__ import annotations
 
 import json
-import re
 import sys
 
 sys.path.insert(0, ".")
 
+from paddle_tpu.analysis.perf_audit import (  # noqa: E402
+    audit_hlo, train_step_hlo,
+)
 
-_DOT = re.compile(
-    r"stablehlo\.dot_general[^\n]*:\s*\(tensor<[0-9x]+x(\w+)>,\s*"
-    r"tensor<[0-9x]+x(\w+)>\)\s*-> tensor<([0-9x]+)x(\w+)>")
-_TRANSPOSE = re.compile(
-    r"stablehlo\.transpose[^\n]*?dims = \[([\d, ]+)\][^\n]*"
-    r"-> tensor<([0-9x]+)x(\w+)>")
-
-
-def _numel(dims: str) -> int:
-    n = 1
-    for d in dims.split("x"):
-        if d.strip():
-            n *= int(d)
-    return n
-
-
-def audit_hlo(hlo_text: str, min_numel: int = 1 << 14):
-    """Bucket dots by result dtype and big transposes by moved bytes."""
-    # bucket by OPERAND dtypes: bf16 operands with f32 accumulation
-    # (preferred_element_type) is the full-rate MXU mode — a dot is only
-    # a quarter-rate problem when an OPERAND is f32
-    dots = {"bf16_operands": 0, "f32_operands": 0, "mixed": 0, "other": 0}
-    f32_dot_shapes = []
-    for m in _DOT.finditer(hlo_text):
-        lhs, rhs, dims, _ = m.groups()
-        if lhs == rhs == "bf16":
-            key = "bf16_operands"
-        elif lhs == rhs == "f32":
-            key = "f32_operands"
-        elif {lhs, rhs} <= {"bf16", "f32"}:
-            key = "mixed"
-        else:
-            key = "other"
-        dots[key] += 1
-        if key != "bf16_operands" and _numel(dims) >= min_numel:
-            f32_dot_shapes.append(f"{lhs}x{rhs}->[{dims}]")
-    transposes = []
-    for m in _TRANSPOSE.finditer(hlo_text):
-        perm, dims, dt = m.groups()
-        n = _numel(dims)
-        if n >= min_numel:
-            itemsize = {"bf16": 2, "f16": 2, "f32": 4, "i32": 4,
-                        "ui32": 4, "f64": 8}.get(dt, 4)
-            transposes.append({"dtype": dt, "shape": dims,
-                               "perm": perm.replace(" ", ""),
-                               "mbytes": round(n * itemsize / 2**20, 2)})
-    transposes.sort(key=lambda t: -t["mbytes"])
-    return {"dot_counts": dots,
-            "big_non_bf16_dots": f32_dot_shapes[:20],
-            "big_transposes": transposes[:20],
-            "transpose_mbytes_total": round(
-                sum(t["mbytes"] for t in transposes), 1)}
-
-
-def train_step_hlo(batch=4, seq=1024, layers=2):
-    """Lower the GPT train step (bench dtypes, reduced batch/depth) and
-    return its PRE-OPTIMIZATION StableHLO text (see module docstring for
-    why not the backend-optimized HLO)."""
-    from memory_report import _build_lowered
-
-    lowered, _ = _build_lowered(
-        dict(vocab_size=50304, hidden_size=768, num_layers=layers,
-             num_heads=12, max_seq_len=seq, fused_head_ce=True,
-             dropout=0.0),
-        batch, seq)
-    return lowered.as_text()
+__all__ = ["audit_hlo", "train_step_hlo", "main"]
 
 
 def main():

@@ -48,6 +48,8 @@ import time
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "last_good_bench.jsonl")
+DEFAULT_STATIC_BUDGET = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "perf_budget.json")
 DEFAULT_TOLERANCE = 0.10
 
 # pids for merged-trace source families (span events keep the pid the
@@ -129,6 +131,34 @@ def load_baseline(path):
     return {m: r for m, (_k, r) in best.items()}
 
 
+def load_static_budget(path):
+    """{metric: row} from the pt_lint perf-audit budget file
+    (tools/perf_budget.json): each budgeted program metric becomes a
+    lower-better baseline row named ``static.<program>.<metric>`` with
+    ZERO tolerance — a budget is a hard ceiling, not a floor with
+    slack. Merged next to the measured bench floors so one perf_gate
+    run judges both views; the static rows only gate when the results
+    file actually carries them (``pt_lint --perf --emit-static``)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    budgets = data.get("budgets", {})
+    if not isinstance(budgets, dict):
+        return {}
+    out = {}
+    for prog, vals in budgets.items():
+        if not isinstance(vals, dict):
+            continue
+        for name, v in vals.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                m = f"static.{prog}.{name}"
+                out[m] = {"metric": m, "value": v,
+                          "lower_better": True, "tolerance": 0.0}
+    return out
+
+
 def _lower_better(row, base_row):
     if row.get("lower_better") or (base_row or {}).get("lower_better"):
         return True
@@ -154,7 +184,10 @@ def gate(results, baseline, tolerance=DEFAULT_TOLERANCE,
             report.append(f"NEW   {m}: {r['value']} (no baseline; "
                           "--update to start gating it)")
             continue
-        tol = float(metric_tolerances.get(m, tolerance))
+        # row-level tolerance (static budget rows pin it to 0) loses to
+        # an explicit --metric-tolerance, wins over the global default
+        row_tol = (base or {}).get("tolerance", tolerance)
+        tol = float(metric_tolerances.get(m, row_tol))
         bv, cv = float(base["value"]), float(r["value"])
         if _lower_better(r, base):
             floor = bv * (1.0 + tol)
@@ -184,6 +217,9 @@ def update_baseline(results, path):
         for r in results:
             if r.get("degraded") or r["value"] <= 0:
                 continue
+            if r["metric"].startswith("static."):
+                continue  # owned by tools/perf_budget.json, not the
+                # bench floor (--update must not fork the budget)
             row = {k: v for k, v in r.items() if k != "telemetry"}
             row["captured_at"] = now
             f.write(json.dumps(row) + "\n")
@@ -349,6 +385,10 @@ def _parse_args(argv):
         description="perf-regression gate + trace merge (see module doc)")
     p.add_argument("results", nargs="?", help="bench output to gate")
     p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    p.add_argument("--static-budget", default=DEFAULT_STATIC_BUDGET,
+                   help="pt_lint perf-audit budget file merged into the "
+                        "baseline as zero-tolerance static.* rows "
+                        "(default tools/perf_budget.json; '' disables)")
     p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                    help="allowed fractional drop (default 0.10)")
     p.add_argument("--metric-tolerance", action="append", default=[],
@@ -393,8 +433,22 @@ def main(argv=None) -> int:
                 print(f"  - {e}")
             return 1
         base = load_baseline(args.baseline)
+        n_static = 0
+        if args.static_budget:
+            if os.path.exists(args.static_budget):
+                static = load_static_budget(args.static_budget)
+                if not static:
+                    print(f"perf_gate: static budget "
+                          f"{args.static_budget} INVALID (no gateable "
+                          f"budget entries)")
+                    return 1
+                n_static = len(static)
+            elif args.static_budget != DEFAULT_STATIC_BUDGET:
+                print(f"perf_gate: static budget {args.static_budget} "
+                      f"missing")
+                return 1
         print(f"perf_gate: baseline OK — {len(base)} gateable metrics "
-              f"({args.baseline})")
+              f"({args.baseline}), {n_static} static budget rows")
         return 0
 
     if not args.results:
@@ -429,6 +483,24 @@ def main(argv=None) -> int:
     except OSError as e:
         print(f"perf_gate: cannot read baseline: {e}", file=sys.stderr)
         return 1
+    # static budgets sit next to the measured floors: a results file
+    # carrying `pt_lint --perf --emit-static` rows is judged against the
+    # committed budget in the same run that gates the bench. Same error
+    # discipline as --check-only: a typo'd path or an empty budget must
+    # fail, not silently gate nothing (static rows would all read NEW)
+    if args.static_budget:
+        if os.path.exists(args.static_budget):
+            static = load_static_budget(args.static_budget)
+            if not static:
+                print(f"perf_gate: static budget {args.static_budget} "
+                      f"INVALID (no gateable budget entries)",
+                      file=sys.stderr)
+                return 1
+            baseline.update(static)
+        elif args.static_budget != DEFAULT_STATIC_BUDGET:
+            print(f"perf_gate: static budget {args.static_budget} "
+                  f"missing", file=sys.stderr)
+            return 1
 
     failures, report = gate(results, baseline, tolerance=args.tolerance,
                             metric_tolerances=per_metric)

@@ -9,6 +9,11 @@ Layers (see docs/STATIC_ANALYSIS.md for the rule catalog):
   jaxpr     PT201–PT203  jaxpr/StableHLO audit of the exported op
                          table and the hybrid train step (traces and
                          lowers real programs — slow tier)
+  perf      PT400–PT405  static performance auditor: layout-tax
+                         transposes, recompile hazards, replicated
+                         state, collective anti-patterns, hot-loop
+                         host syncs — gated against committed
+                         per-model budgets (tools/perf_budget.json)
 
 Usage:
   python tools/pt_lint.py                  # report everything (ast+lock)
@@ -18,11 +23,24 @@ Usage:
   python tools/pt_lint.py --update-baseline
   python tools/pt_lint.py --jaxpr --check  # include the slow layer
   python tools/pt_lint.py --layers ast     # pick layers explicitly
+  python tools/pt_lint.py --perf           # perf audit, fast subset
+                                           # (train/decode/call-sites)
+  python tools/pt_lint.py --perf --check   # gate: exit 2 when any
+                                           # audited metric EXCEEDS its
+                                           # committed budget
+  python tools/pt_lint.py --update-budget  # full audit (op table too),
+                                           # rewrite tools/perf_budget.json
 
 The committed baseline (tools/lint_baseline.json) counts pre-existing
 violations by line-free key, so the gate fails only on findings the
 current change introduced. Inline suppression: `# pt-lint: ok[PT005]`
 on the finding's line, the line above, or a def/class header.
+The committed perf budget (tools/perf_budget.json) records each
+representative program's quantified costs; `--perf --check` fails only
+on metrics above budget (improvements print a ratchet-down note), and
+`--emit-static rows.json` exports the audited metrics as
+`static.<program>.<metric>` rows for tools/perf_gate.py to gate next
+to the measured bench numbers.
 
 The ast/lock fast path never imports jax: the analysis package is
 file-loaded standalone, bypassing `paddle_tpu/__init__`.
@@ -36,6 +54,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PATH = os.path.join(REPO, "tools", "lint_baseline.json")
+BUDGET_PATH = os.path.join(REPO, "tools", "perf_budget.json")
 
 # the manifest/jaxpr layers import paddle_tpu lazily; make sure the
 # repo root wins over tools/ in sys.path when invoked as a script
@@ -66,6 +85,97 @@ def load_analysis():
     return mod
 
 
+def run_perf(args) -> int:
+    """The --perf / --update-budget flow: audit representative programs,
+    report quantified PT4xx findings, gate metrics against the
+    committed budget (exit 2 on any metric above budget)."""
+    import importlib
+    import json
+
+    analysis = load_analysis()
+    perf = importlib.import_module(f"{analysis.__name__}.perf_audit")
+
+    if args.perf_programs:
+        programs = tuple(x.strip() for x in args.perf_programs.split(",")
+                         if x.strip())
+    elif args.update_budget or args.perf_full:
+        # the budget file must cover the slow-tier programs too — a
+        # fast-subset rewrite would orphan the op-table entries
+        programs = perf.FULL_PROGRAMS
+    else:
+        programs = perf.DEFAULT_PROGRAMS
+
+    violations, metrics = perf.audit_perf(programs=programs,
+                                          repo_root=REPO)
+    if violations:
+        print(analysis.render_report(violations))
+
+    if args.emit_static:
+        rows = perf.metrics_to_static_rows(metrics)
+        with open(args.emit_static, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row, sort_keys=True) + "\n")
+        print(f"pt_lint: {len(rows)} static metric row(s) -> "
+              f"{args.emit_static}")
+
+    blind = [v for v in violations if v.rule == "PT400"]
+    if args.update_budget:
+        if blind:
+            # a program that failed to build has an EMPTY metrics entry;
+            # committing it would silently erase its budget ceilings
+            print(f"pt_lint: FAIL — {len(blind)} program(s) could not "
+                  f"be audited (PT400); budget NOT updated",
+                  file=sys.stderr)
+            return EXIT_NEW_VIOLATIONS
+        if args.perf_programs:
+            # subset update: merge into the existing budget so the
+            # unaudited programs keep their committed ceilings
+            merged = dict(analysis.load_budget(args.budget))
+            merged.update(metrics)
+        else:
+            merged = metrics  # full run: drop stale/renamed programs
+        analysis.save_budget(args.budget, merged)
+        n = sum(len(v) for v in merged.values())
+        print(f"pt_lint: perf budget updated — {n} metric(s) over "
+              f"{len(merged)} program(s) in "
+              f"{os.path.relpath(args.budget, REPO)}"
+              + (f" ({len(metrics)} re-audited)" if args.perf_programs
+                 else ""))
+        return EXIT_OK
+
+    if args.check:
+        budget = analysis.load_budget(args.budget)
+        if not budget:
+            print(f"pt_lint: FAIL — no perf budget at {args.budget} "
+                  f"(run --update-budget)", file=sys.stderr)
+            return EXIT_NEW_VIOLATIONS
+        regressions, improvements, _unbudgeted = \
+            analysis.diff_against_budget(metrics, budget)
+        diff = analysis.render_budget_diff(regressions, improvements)
+        if diff:
+            print(diff)
+        if blind:
+            # a program the auditor could not see cannot be vouched for
+            print(f"pt_lint: FAIL — {len(blind)} program(s) could not "
+                  f"be audited (PT400)")
+            return EXIT_NEW_VIOLATIONS
+        if regressions:
+            print(f"pt_lint: FAIL — {len(regressions)} perf metric(s) "
+                  f"over budget (programs={','.join(sorted(metrics))})")
+            return EXIT_NEW_VIOLATIONS
+        print(f"pt_lint: OK — all audited perf metrics within budget "
+              f"(programs={','.join(sorted(metrics))}"
+              f"{', %d improvable' % len(improvements) if improvements else ''})")
+        return EXIT_OK
+
+    for prog in sorted(metrics):
+        print(f"pt_lint: perf[{prog}] " + " ".join(
+            f"{k}={v}" for k, v in sorted(metrics[prog].items())))
+    print(f"pt_lint: perf audit done — {len(violations)} finding(s), "
+          f"programs={','.join(sorted(metrics))}")
+    return EXIT_NEW_VIOLATIONS if blind else EXIT_OK
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="pt_lint", description=__doc__.split("\n\n")[0])
@@ -88,7 +198,28 @@ def main(argv=None) -> int:
                     help="include the jaxpr/HLO audit layer (slow)")
     ap.add_argument("--select", default=None,
                     help="only report these rule ids (comma list)")
+    ap.add_argument("--perf", action="store_true",
+                    help="run the static performance auditor "
+                         "(PT400-PT405) instead of the source layers")
+    ap.add_argument("--perf-full", action="store_true",
+                    help="perf audit over the FULL program set "
+                         "(adds the op-table sweep — slow tier)")
+    ap.add_argument("--perf-programs", default=None,
+                    help="comma list among train_step,decode_step,"
+                         "call_sites,op_table (overrides the subset)")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="rewrite tools/perf_budget.json from a full "
+                         "perf audit")
+    ap.add_argument("--budget", default=BUDGET_PATH,
+                    help="budget path (default tools/perf_budget.json)")
+    ap.add_argument("--emit-static", metavar="OUT", default=None,
+                    help="also write the audited metrics as "
+                         "static.<program>.<metric> rows (JSON lines) "
+                         "for tools/perf_gate.py")
     args = ap.parse_args(argv)
+
+    if args.perf or args.update_budget:
+        return run_perf(args)
 
     if args.layers is not None:
         layers = tuple(x.strip() for x in args.layers.split(",")
