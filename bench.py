@@ -192,10 +192,26 @@ def run_bench(degraded: bool = False, note: str = "",
         # MFU rates use the same FLOPs accounting as the headline metric
         timer.flops_per_step = flops_per_token * batch * seq
         timer.peak_flops = peak
+        # goodput partition (ISSUE 7): productive step wall vs lost
+        # (compile/rollback/retry/drain) from the run's own step
+        # records + flight events; gauges land in the metrics snapshot
+        # below, rows are emitted for tools/perf_gate.py
+        goodput_report = None
+        try:
+            goodput_report = obs.goodput.from_live(timer)
+            obs.goodput.publish(goodput_report)
+        except Exception as e:
+            print(f"goodput-accounting-failed: {e}", file=sys.stderr)
         result["telemetry"] = {
             "metrics": obs.metrics.snapshot(),
             "step_stats": timer.summary(),
         }
+        if goodput_report is not None:
+            result["telemetry"]["goodput"] = goodput_report
+            for row in obs.goodput.metric_rows(
+                    goodput_report,
+                    degraded=bool(degraded or not on_tpu)):
+                _emit(row)
         # merged Perfetto timeline: the tracer buffer already correlates
         # compile spans (cost_analysis-annotated), flight instants, and
         # step frames — one export IS the merged trace (ISSUE 2

@@ -80,6 +80,7 @@ class ElasticManager:
             max_delay=max(0.25, heartbeat_interval / 2.0))
         self.missed_beats = 0
         self._done_marked = False
+        self._telemetry_fn = None  # attach_telemetry(): digest provider
 
     # --- registry ------------------------------------------------------------
     def _hb_key(self, rank=None):
@@ -103,6 +104,61 @@ class ElasticManager:
 
         _faults.fire("store.op", op="heartbeat", rank=self.rank)
         self.store.set(self._hb_key(), str(time.time()))
+        if self._telemetry_fn is not None:
+            self._set_telemetry_digest()
+
+    # --- telemetry digests ---------------------------------------------------
+    def _tel_key(self, rank=None):
+        r = self.rank if rank is None else rank
+        return f"elastic/{self.job_id}/telemetry/{r}"
+
+    def attach_telemetry(self, digest_fn):
+        """Ride a small telemetry digest on every heartbeat (ISSUE 7):
+        `digest_fn` is a zero-arg callable returning a JSON-friendly
+        dict — typically `observability.export.TelemetryExporter
+        .digest` — written next to this rank's heartbeat key, so
+        `telemetry_digests()` answers "how is every live rank doing"
+        from the store alone, with the freshness guarantee of the beat
+        itself."""
+        self._telemetry_fn = digest_fn
+        return self
+
+    def _set_telemetry_digest(self):
+        import json as _json
+
+        try:
+            self.store.set(self._tel_key(),
+                           _json.dumps(self._telemetry_fn(),
+                                       default=str))
+        except Exception:
+            # the digest is best-effort cargo on the beat: losing it
+            # must never cost the heartbeat (the retry policy would
+            # re-raise and the rank would age out) — but count it
+            try:
+                from ...observability import metrics as _metrics
+
+                _metrics.inc("fleet.telemetry_digest_errors")
+            except Exception:  # pt-lint: ok[PT005]
+                pass           # (observability fan-out guard: the
+                # beat must go on through interpreter teardown)
+
+    def telemetry_digests(self, scan_up_to=None):
+        """{rank: digest dict} for every rank that published one —
+        the live-fleet rollup view (`tools/telemetry_agg.py` reads the
+        dump DIRECTORY for the full streams; this is the cheap
+        store-side summary)."""
+        import json as _json
+
+        out = {}
+        for r in range(scan_up_to if scan_up_to is not None
+                       else self.max_np):
+            try:
+                raw = self.store.get(self._tel_key(r), timeout=0.5)
+                out[r] = _json.loads(raw)
+            except Exception:  # pt-lint: ok[PT005]
+                continue       # absent key IS the signal: rank never
+                # published (or its beat aged out with it)
+        return out
 
     def _beat(self):
         while not self._stop.is_set():

@@ -17,6 +17,22 @@ Protocol (stdlib-only, zero heavy deps):
                   predictor calls ALL failed (load balancers route on
                   this; liveness keeps the process from being killed
                   mid-drain).
+  GET  /metrics   Prometheus text exposition: every registry counter/
+                  gauge/histogram (cumulative `_bucket{le=...}` series
+                  included) plus the `slo.*` gauges — the scrape plane
+                  (docs/OBSERVABILITY.md).
+  GET  /debug/telemetry   JSON snapshot: metrics, the SLO report
+                  (windowed burn rate, shed reasons), admission stats,
+                  readiness, recent flight events.
+
+Request identity (observability/request_trace.py): every /predict
+response echoes `X-Request-Id`; incoming `X-Request-Id`/`traceparent`
+headers are continued (same id, next hop), bare requests get a minted
+id.  Phases — queue wait, admission, predict, serialize — land as
+spans on the span tracer (args carry the request id) and as
+`serving.phase_ms{phase=...}` histogram observations; the final status
+feeds `serving.requests{status}` / `serving.request_ms{status}` and
+the per-endpoint `SLOTracker` (sheds with their reason labels).
 
 Status mapping (docs/RESILIENCE.md): deterministic request errors
 (wrong dtype/rank/key, undecodable body) → 400; admission sheds and
@@ -37,6 +53,7 @@ from __future__ import annotations
 import io
 import json
 import math
+import os
 import re
 import threading
 import time
@@ -45,6 +62,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from . import Config, create_predictor
+from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
+from ..observability import trace as _trace
+from ..observability.slo import SLOTracker
+from ..resilience.overload import _env_num
 
 __all__ = ["InferenceServer", "InferenceClient", "serve"]
 
@@ -131,6 +153,18 @@ class InferenceServer:
         self.admission = AdmissionController(
             max_inflight=max_inflight, queue_depth=queue_depth,
             name="serving")
+        # SLO ledger behind /debug/telemetry and the slo.* gauges on
+        # /metrics: env knobs so a deployment declares its promise
+        # without code (defaults: 1 s latency target, 99.9% availability
+        # over a 5-minute window)
+        self.slo = SLOTracker(
+            window_s=_env_num("PADDLE_TPU_SLO_WINDOW", 300.0, float))
+        self.slo.objective(
+            "predict",
+            latency_target_ms=_env_num("PADDLE_TPU_SLO_LATENCY_MS",
+                                       1000.0, float),
+            availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY", 0.999,
+                                  float))
         self._drain_timeout = drain_timeout  # None → env/default in drain()
         self._ready_window = max(1, int(ready_window))
         self._recent = []          # last ready_window predictor outcomes
@@ -143,14 +177,21 @@ class InferenceServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            _rt_ctx = None  # the request's RequestContext (POST paths)
+
             def log_message(self, *a):  # quiet
                 pass
 
             def _json(self, code, obj, headers=()):
-                body = json.dumps(obj).encode()
+                body = json.dumps(obj, default=str).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if self._rt_ctx is not None:
+                    # EVERY response of an identified request echoes the
+                    # id — a shed 429 must correlate like a 200 does
+                    self.send_header("X-Request-Id",
+                                     self._rt_ctx.request_id)
                 for k, v in headers:
                     self.send_header(k, v)
                 self.end_headers()
@@ -173,54 +214,106 @@ class InferenceServer:
                             "reason": reason}
                     body.update(server.admission.stats())
                     return self._json(200 if ready else 503, body)
+                if self.path == "/metrics":
+                    try:
+                        text = server.render_metrics()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    body = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path == "/debug/telemetry":
+                    try:
+                        snap = server.telemetry_snapshot()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, snap)
                 return self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
                 if self.path != "/predict":
                     return self._json(404, {"error": "unknown path"})
+                # continue the client's identity (or mint one): id
+                # echoed on every response below, context active for
+                # every span/metric the request touches
+                ctx = _rtrace.continue_from_headers(self.headers)
+                self._rt_ctx = ctx
+                with _rtrace.activate(ctx):
+                    self._predict_traced(ctx)
+
+            def _predict_traced(self, ctx):
+                t_req = time.perf_counter()
+                sp = _trace.begin("serving.request", cat="serving",
+                                  **ctx.trace_args())
+                status, slo_reason = "error", "error"
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(n)
-                    with np.load(io.BytesIO(raw)) as z:
-                        arrays = {k: z[k] for k in z.files}
-                except Exception as e:
-                    # undecodable body: the client's fault, always
-                    return self._json(
-                        400, {"error": f"bad request body: "
-                                       f"{type(e).__name__}: {e}"})
-                try:
-                    outs = server.predict(arrays)
-                except ShedError as e:
-                    return self._json(
-                        e.http_status,
-                        {"error": str(e), "reason": e.reason},
-                        headers=[("Retry-After",
-                                  _retry_after_header(e.retry_after))])
-                except TimeoutError as e:
-                    # DeadlineExceeded is a TimeoutError subclass: the
-                    # server ran out of time, not the client out of
-                    # line — retryable, with a service-time hint
-                    stats = server.admission.stats()
-                    hint = stats.get("ewma_latency") or 1.0
-                    return self._json(
-                        503, {"error": f"{type(e).__name__}: {e}"},
-                        headers=[("Retry-After",
-                                  _retry_after_header(hint))])
-                except _DETERMINISTIC_ERRORS as e:
-                    return self._json(
-                        400, {"error": f"{type(e).__name__}: {e}"})
-                except Exception as e:
-                    return self._json(
-                        500, {"error": f"{type(e).__name__}: {e}"})
-                buf = io.BytesIO()
-                np.savez(buf, **outs)
-                body = buf.getvalue()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "application/octet-stream")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        raw = self.rfile.read(n)
+                        with np.load(io.BytesIO(raw)) as z:
+                            arrays = {k: z[k] for k in z.files}
+                    except Exception as e:
+                        # undecodable body: the client's fault, always
+                        status = "client_error"
+                        return self._json(
+                            400, {"error": f"bad request body: "
+                                           f"{type(e).__name__}: {e}"})
+                    try:
+                        outs = server.predict(arrays)
+                    except ShedError as e:
+                        status, slo_reason = "shed", e.reason
+                        return self._json(
+                            e.http_status,
+                            {"error": str(e), "reason": e.reason},
+                            headers=[("Retry-After",
+                                      _retry_after_header(e.retry_after))])
+                    except TimeoutError as e:
+                        # DeadlineExceeded is a TimeoutError subclass:
+                        # the server ran out of time, not the client out
+                        # of line — retryable, with a service-time hint
+                        status, slo_reason = "timeout", "timeout"
+                        stats = server.admission.stats()
+                        hint = stats.get("ewma_latency") or 1.0
+                        return self._json(
+                            503, {"error": f"{type(e).__name__}: {e}"},
+                            headers=[("Retry-After",
+                                      _retry_after_header(hint))])
+                    except _DETERMINISTIC_ERRORS as e:
+                        status = "client_error"
+                        return self._json(
+                            400, {"error": f"{type(e).__name__}: {e}"})
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    with _rtrace.request_phase("serialize"):
+                        buf = io.BytesIO()
+                        np.savez(buf, **outs)
+                        body = buf.getvalue()
+                    status = "ok"
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("X-Request-Id", ctx.request_id)
+                    self.end_headers()
+                    self.wfile.write(body)
+                finally:
+                    dt_ms = (time.perf_counter() - t_req) * 1e3
+                    if sp is not None:
+                        sp.args["status"] = status
+                    _trace.end(sp)
+                    _metrics.observe("serving.request_ms", dt_ms,
+                                     endpoint="predict", status=status)
+                    _metrics.inc("serving.requests", status=status)
+                    server._slo_record(status, slo_reason, dt_ms)
 
         self._httpd = _ServingHTTPServer((host, port), Handler)
         self._thread = None
@@ -248,6 +341,46 @@ class InferenceServer:
             self._recent.append(bool(ok))
             del self._recent[:-self._ready_window]
 
+    # --- telemetry plane -----------------------------------------------------
+    def _slo_record(self, status, reason, latency_ms):
+        """Feed the SLO ledger with one finished request.  Client-fault
+        400s are excluded — the availability objective is a promise
+        about the SERVER, and one misbehaving client must not page the
+        on-call for it (mirror of the readiness-window rule above)."""
+        if status == "ok":
+            self.slo.observe("predict", latency_ms, ok=True)
+        elif status == "shed":
+            self.slo.record_shed("predict", reason)
+        elif status in ("timeout", "error"):
+            self.slo.observe("predict", latency_ms, ok=False,
+                             reason=reason)
+
+    def render_metrics(self) -> str:
+        """Prometheus text for GET /metrics (refreshes the slo.* gauges
+        first so the scrape carries the current burn rate)."""
+        self.slo.report()
+        return _metrics.to_prometheus()
+
+    def telemetry_snapshot(self) -> dict:
+        """JSON body of GET /debug/telemetry: the one-stop in-process
+        view — metrics snapshot, SLO report, admission stats,
+        readiness, and the recent flight ring."""
+        from ..observability import flight as _flight
+
+        ready, reason = self.readiness()
+        # SLO report first: it publishes the slo.* gauges the metrics
+        # snapshot should carry (same ordering as the exporter)
+        slo_report = self.slo.report()
+        return {
+            "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "pid": os.getpid(),
+            "metrics": _metrics.snapshot(),
+            "slo": slo_report,
+            "admission": self.admission.stats(),
+            "readiness": {"ready": ready, "reason": reason},
+            "flight": _flight.events()[-64:],
+        }
+
     # --- request path --------------------------------------------------------
     def predict(self, arrays: dict) -> dict:
         p = self._predictor
@@ -258,10 +391,21 @@ class InferenceServer:
             inputs = [arrays[k] for k in _positional_order(arrays)]
         deadline = (None if self._request_timeout is None
                     else time.monotonic() + self._request_timeout)
-        ticket = self.admission.admit(deadline=deadline)
+        # phase breakdown (ISSUE 7): "admission" spans the admit call
+        # (decision + queue camp; the camp itself is the controller's
+        # own nested `serving.queue` span), "queue" is observed from
+        # the measured wait, "predict" spans the resilient run
+        with _rtrace.request_phase("admission") as asp:
+            ticket = self.admission.admit(deadline=deadline)
+            if asp is not None:
+                asp.args["queue_wait_ms"] = round(
+                    ticket.queue_wait * 1e3, 3)
+        _metrics.observe("serving.phase_ms", ticket.queue_wait * 1e3,
+                         phase="queue", endpoint="predict")
         ok = None  # None = client-fault outcome: readiness unaffected
         try:
-            outs = self._run_resilient(inputs, _deadline=deadline)
+            with _rtrace.request_phase("predict"):
+                outs = self._run_resilient(inputs, _deadline=deadline)
             ok = True
         except _DETERMINISTIC_ERRORS:
             # the CLIENT's request was wrong (400) — feeding this into
@@ -482,32 +626,75 @@ class InferenceClient:
         else:
             np.savez(buf, *arrays)
         data = buf.getvalue()
+        # ONE identity for the whole request, minted BEFORE the retry
+        # loop: a 429'd request retries under the same X-Request-Id, so
+        # server-side spans/logs correlate every attempt.  An ambient
+        # context (this client called from inside another traced
+        # request) continues as the next hop instead of starting over.
+        amb = _rtrace.current()
+        ctx = amb.child() if amb is not None else _rtrace.new_context()
+        headers = {"Content-Type": "application/octet-stream"}
+        headers.update(ctx.to_headers())
         for attempt in range(self.retries + 1):
             req = urllib.request.Request(
-                self.address + "/predict", data=data,
-                headers={"Content-Type": "application/octet-stream"})
+                self.address + "/predict", data=data, headers=headers)
+            sp = _trace.begin("client.predict", cat="client",
+                              attempt=attempt, **ctx.trace_args())
+            t0 = time.perf_counter()
+            status = "error"
+            payload = None
+            retry_wait = None
             try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout) as r:
-                    with np.load(io.BytesIO(r.read())) as z:
-                        return {k: z[k] for k in z.files}
-            except urllib.error.HTTPError as e:
-                if e.code in (429, 503) and attempt < self.retries:
-                    self.sleep(self._retry_wait(e.headers))
-                    continue
-                raise
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout) as r:
+                        payload = r.read()
+                    status = "ok"
+                except urllib.error.HTTPError as e:
+                    if e.code in (429, 503) and attempt < self.retries:
+                        # the backoff sleep happens AFTER the span and
+                        # latency observation close: client.request_ms
+                        # measures the HTTP attempt, not the deliberate
+                        # wait between attempts
+                        status = "shed_retry"
+                        retry_wait = self._retry_wait(e.headers)
+                    else:
+                        raise
+            finally:
+                if sp is not None:
+                    sp.args["status"] = status
+                _trace.end(sp)
+                _metrics.observe("client.request_ms",
+                                 (time.perf_counter() - t0) * 1e3,
+                                 status=status)
+                _metrics.inc("client.requests", status=status)
+            if retry_wait is not None:
+                self.sleep(retry_wait)
+                continue
+            with np.load(io.BytesIO(payload)) as z:
+                return {k: z[k] for k in z.files}
 
 
 def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866):
     """Blocking entry point: `python -m paddle_tpu.inference.serving`.
     SIGTERM/SIGINT drain gracefully (finish in-flight, close the
-    socket) instead of killing requests mid-predict."""
+    socket) instead of killing requests mid-predict.  With env
+    `PADDLE_TPU_TELEMETRY_DIR` set, a `TelemetryExporter` dumps this
+    replica's telemetry (SLO report included) periodically for
+    `tools/telemetry_agg.py` to merge across the fleet."""
     srv = InferenceServer(model_path, host, port)
     guard = srv.install_preemption()
     srv.start()
+    exporter = None
+    if os.environ.get("PADDLE_TPU_TELEMETRY_DIR"):
+        from ..observability.export import TelemetryExporter
+
+        exporter = TelemetryExporter(slo=srv.slo.report).start()
     print(f"serving {model_path} at {srv.address}")
     guard.wait()           # parked until preemption/Ctrl-C
     srv.shutdown()         # idempotent with the guard's drain thread
+    if exporter is not None:
+        exporter.stop()    # final dump records the drained end state
     print(f"drained and stopped ({guard.reason})")
 
 

@@ -24,10 +24,14 @@ what `bench.py --telemetry` calls.
 """
 from __future__ import annotations
 
-from . import flight, metrics, step_stats, trace, xla_cost  # noqa: F401
+from . import (  # noqa: F401
+    export, flight, goodput, metrics, request_trace, slo, step_stats,
+    trace, xla_cost,
+)
 from .step_stats import StepTimer  # noqa: F401
 
 __all__ = ["metrics", "flight", "step_stats", "trace", "xla_cost",
+           "request_trace", "slo", "export", "goodput",
            "StepTimer", "attach", "detach"]
 
 # The snapshot-schema floor `attach()` guarantees: these counters exist
@@ -76,6 +80,13 @@ _SCHEMA_COUNTERS = tuple(
     + [("preemption.maintenance_events", {}),
        ("preemption.checkpoints", {}), ("preemption.drains", {}),
        ("preemption.callback_errors", {})]
+    # request-level serving telemetry (ISSUE 7): per-status request
+    # counters on both sides of the hop — a fresh server reports zeros
+    # for every status class instead of omitting the keys
+    + [("serving.requests", {"status": s})
+       for s in ("ok", "client_error", "shed", "timeout", "error")]
+    + [("client.requests", {"status": s})
+       for s in ("ok", "shed_retry", "error")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always

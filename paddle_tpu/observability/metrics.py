@@ -30,6 +30,7 @@ never create an import cycle or pay a jax import.
 """
 from __future__ import annotations
 
+import bisect
 import collections
 import json
 import os
@@ -40,7 +41,7 @@ __all__ = [
     "MetricsRegistry", "get_registry", "inc", "set_gauge", "observe",
     "declare", "snapshot", "to_prometheus", "dump_jsonl", "enable",
     "disable", "enabled", "reset", "push_scope", "pop_scope",
-    "current_scope",
+    "current_scope", "DEFAULT_BUCKETS", "quantile",
 ]
 
 # --------------------------- scope stack ---------------------------
@@ -73,19 +74,68 @@ def current_scope():
 
 # --------------------------- histograms ---------------------------
 
+def _log_spaced(lo: float, hi: float, per_decade: int) -> tuple:
+    """Geometric bucket bounds lo..hi, `per_decade` per factor of 10,
+    rounded to 4 significant digits so the `le` labels stay short and
+    byte-stable across processes (the fleet aggregator merges by
+    label)."""
+    out = []
+    i = 0
+    while True:
+        b = float(f"{lo * 10 ** (i / per_decade):.4g}")
+        if b > hi:
+            return tuple(out)
+        out.append(b)
+        i += 1
+
+
+# The fixed bucket ladder every histogram uses: 0.1 .. 1e5 covers
+# sub-ms serving phases through 100 s compile walls at the ms scale the
+# step/request metrics record in.  FIXED (not per-metric) on purpose:
+# cross-process histogram merge (tools/telemetry_agg.py) is a plain
+# per-bucket sum only when every process shares one ladder.
+DEFAULT_BUCKETS = _log_spaced(0.1, 1e5, 4)
+
+
+def quantile(sorted_vals, q: float):
+    """Linear-interpolated quantile of an already-sorted sequence (the
+    numpy 'linear' definition): even-count p50 is the midpoint of the
+    middle pair, and a 3-sample p95 interpolates instead of snapping to
+    the max.  None on empty input."""
+    n = len(sorted_vals)
+    if n == 0:
+        return None
+    if n == 1:
+        return float(sorted_vals[0])
+    pos = max(0.0, min(1.0, float(q))) * (n - 1)
+    i = int(pos)
+    frac = pos - i
+    if frac == 0.0 or i + 1 >= n:
+        return float(sorted_vals[min(i, n - 1)])
+    return float(sorted_vals[i]) + frac * (
+        float(sorted_vals[i + 1]) - float(sorted_vals[i]))
+
+
 class _Hist:
-    """count/sum/min/max plus a bounded reservoir of recent values for
-    rough percentiles (the step-time distributions this serves are
-    hundreds of points, not millions)."""
+    """count/sum/min/max, fixed log-spaced buckets (`le`-style: bucket i
+    counts values <= bounds[i], the last slot is +Inf overflow), and a
+    bounded reservoir of recent values.  Percentiles are exact
+    (interpolated ranks over the reservoir) while every observation
+    still fits it, and bucket-interpolated beyond that — the buckets
+    see ALL observations, so long-running servers report real p99s, not
+    the last 256 samples'."""
 
-    __slots__ = ("count", "total", "min", "max", "recent")
+    __slots__ = ("count", "total", "min", "max", "recent", "bounds",
+                 "buckets")
 
-    def __init__(self):
+    def __init__(self, bounds=DEFAULT_BUCKETS):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = float("-inf")
         self.recent = collections.deque(maxlen=256)
+        self.bounds = bounds
+        self.buckets = [0] * (len(bounds) + 1)  # +1: the +Inf slot
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -96,6 +146,24 @@ class _Hist:
         if v > self.max:
             self.max = v
         self.recent.append(v)
+        self.buckets[bisect.bisect_left(self.bounds, v)] += 1
+
+    def percentile(self, q: float):
+        """Bucket-interpolated percentile over ALL observations (the
+        Prometheus histogram_quantile estimate), clamped to the
+        observed [min, max]."""
+        if not self.count:
+            return None
+        target = max(0.0, min(1.0, float(q))) * self.count
+        cum = 0
+        for i, c in enumerate(self.buckets):
+            if c and cum + c >= target:
+                lo = self.min if i == 0 else self.bounds[i - 1]
+                hi = self.max if i >= len(self.bounds) else self.bounds[i]
+                est = lo + (hi - lo) * ((target - cum) / c)
+                return max(self.min, min(self.max, est))
+            cum += c
+        return self.max
 
     def summary(self) -> dict:
         out = {"count": self.count, "total": round(self.total, 6)}
@@ -103,10 +171,26 @@ class _Hist:
             out["mean"] = round(self.total / self.count, 6)
             out["min"] = round(self.min, 6)
             out["max"] = round(self.max, 6)
-            r = sorted(self.recent)
-            out["p50"] = round(r[len(r) // 2], 6)
-            out["p95"] = round(r[min(len(r) - 1, int(len(r) * 0.95))], 6)
+            if self.count <= len(self.recent):
+                # the reservoir still holds every observation: exact
+                # interpolated-rank percentiles
+                r = sorted(self.recent)
+                p50, p95, p99 = (quantile(r, q)
+                                 for q in (0.5, 0.95, 0.99))
+            else:
+                p50, p95, p99 = (self.percentile(q)
+                                 for q in (0.5, 0.95, 0.99))
+            out["p50"] = round(p50, 6)
+            out["p95"] = round(p95, 6)
+            out["p99"] = round(p99, 6)
             out["last"] = round(self.recent[-1], 6)
+            # sparse non-cumulative bucket counts keyed by upper bound
+            # ("inf" = overflow): what telemetry_agg sums to merge one
+            # fleet-wide distribution
+            out["buckets"] = {
+                ("inf" if i >= len(self.bounds)
+                 else f"{self.bounds[i]:g}"): c
+                for i, c in enumerate(self.buckets) if c}
         return out
 
 
@@ -208,15 +292,23 @@ class MetricsRegistry:
                 "histograms": hists}
 
     def to_prometheus(self, prefix: str = "paddle_tpu") -> str:
-        """Prometheus text exposition format (counters + gauges +
-        histogram sum/count)."""
+        """Prometheus text exposition format: counters, gauges, and full
+        histograms — cumulative ``_bucket{le="..."}`` series (the
+        ``histogram_quantile()`` input), ``_sum``/``_count``, plus a
+        separate ``<name>_quantile{quantile="..."}`` gauge family
+        carrying the registry's own p50/p95/p99 so a bare curl shows
+        the percentiles without a PromQL engine.  (A distinct family on
+        purpose: bare-name ``{quantile=}`` samples inside a ``# TYPE
+        ... histogram`` block are invalid under OpenMetrics/strict
+        parsers and would poison the whole scrape.)"""
         def pname(name):
             return prefix + "_" + name.replace(".", "_").replace("-", "_")
 
-        def plabels(lkey):
-            if not lkey:
+        def plabels(lkey, *extra):
+            items = list(lkey) + list(extra)
+            if not items:
                 return ""
-            return "{" + ",".join(f'{k}="{v}"' for k, v in lkey) + "}"
+            return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
 
         lines = []
         with self._lock:
@@ -233,10 +325,30 @@ class MetricsRegistry:
                 lines.append(f"{pname(n)}{plabels(l)} {v}")
             for (n, l), h in sorted(self._hists.items()):
                 if n not in seen:
-                    lines.append(f"# TYPE {pname(n)} summary")
+                    lines.append(f"# TYPE {pname(n)} histogram")
                     seen.add(n)
-                lines.append(f"{pname(n)}_count{plabels(l)} {h.count}")
+                cum = 0
+                for i, b in enumerate(h.bounds):
+                    cum += h.buckets[i]
+                    lines.append(f"{pname(n)}_bucket"
+                                 f"{plabels(l, ('le', f'{b:g}'))} {cum}")
+                lines.append(f"{pname(n)}_bucket"
+                             f"{plabels(l, ('le', '+Inf'))} {h.count}")
                 lines.append(f"{pname(n)}_sum{plabels(l)} {h.total}")
+                lines.append(f"{pname(n)}_count{plabels(l)} {h.count}")
+                summ = h.summary()
+                qname = pname(n) + "_quantile"
+                if qname not in seen and any(
+                        f"p{int(float(q) * 100)}" in summ
+                        for q in ("0.5", "0.95", "0.99")):
+                    lines.append(f"# TYPE {qname} gauge")
+                    seen.add(qname)
+                for q in ("0.5", "0.95", "0.99"):
+                    key = "p" + str(int(float(q) * 100))
+                    if key in summ:
+                        lines.append(
+                            f"{qname}{plabels(l, ('quantile', q))} "
+                            f"{summ[key]}")
         return "\n".join(lines) + "\n"
 
     def dump_jsonl(self, path: str, extra: dict | None = None) -> str:

@@ -127,6 +127,13 @@ class SpanTracer:
         with self._lock:
             return max(0, self._n_added - self.capacity)
 
+    def added(self) -> int:
+        """Lifetime event count (monotone): the incremental-export
+        cursor — `export.TelemetryExporter` dumps only events appended
+        since its last dump by diffing this against its own cursor."""
+        with self._lock:
+            return self._n_added
+
     # ------------------------------ clock/ids ------------------------------
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._epoch_ns) / 1e3

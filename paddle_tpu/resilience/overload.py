@@ -80,14 +80,17 @@ class ShedError(RuntimeError):
 
 class AdmissionTicket:
     """One admitted request's slot.  Context-manager form releases on
-    exit with ok = no-exception; `release()` is idempotent."""
+    exit with ok = no-exception; `release()` is idempotent.
+    `queue_wait` is the seconds this request spent waiting for a slot —
+    the "queue" phase of the request-trace breakdown (ISSUE 7)."""
 
-    __slots__ = ("_controller", "_start", "_released")
+    __slots__ = ("_controller", "_start", "_released", "queue_wait")
 
-    def __init__(self, controller, start):
+    def __init__(self, controller, start, queue_wait=0.0):
         self._controller = controller
         self._start = start
         self._released = False
+        self.queue_wait = float(queue_wait)
 
     def release(self, ok=True):
         if self._released:
@@ -188,6 +191,8 @@ class AdmissionController:
                     detail=f"estimated completion {est:.3f}s past deadline")
             self._queued += 1
             self._publish_gauges()
+            wait_t0 = self.clock()
+            qspan = None
             try:
                 # queue_timeout bounds the head-of-line wait even when
                 # the request's own deadline is laxer — whichever comes
@@ -196,6 +201,11 @@ class AdmissionController:
                 timeout_at = self.clock() + self.queue_timeout
                 if deadline is not None:
                     timeout_at = min(timeout_at, deadline)
+                if self._inflight >= self._limit:
+                    # this request will actually wait: its queue camp is
+                    # a span on the request trace (request id attached
+                    # via the active RequestContext)
+                    qspan = self._begin_queue_span()
                 while self._inflight >= self._limit:
                     if self._draining:
                         self._shed_locked("draining",
@@ -208,12 +218,14 @@ class AdmissionController:
                     self._cv.wait(remaining)
                 self._inflight += 1
             finally:
+                self._end_queue_span(qspan)
                 self._queued -= 1
                 self._publish_gauges()
                 # a shed waiter leaving the queue can be the drain()
                 # waiter's last blocker — wake it to re-check
                 self._cv.notify_all()
-        return AdmissionTicket(self, self.clock())
+            queue_wait = self.clock() - wait_t0
+        return AdmissionTicket(self, self.clock(), queue_wait=queue_wait)
 
     def _release(self, ok, latency):
         with self._cv:
@@ -303,6 +315,32 @@ class AdmissionController:
         return True
 
     # --- observability (fan-out guarded: shedding must shed, not crash) -----
+    def _begin_queue_span(self):
+        """Open a `serving.queue` span carrying the active request's
+        identity (request_trace contextvar) — the queue-wait phase of
+        the per-request breakdown.  Guarded: a telemetry error must
+        never turn a queue camp into a 500."""
+        try:
+            from ..observability import request_trace as _rtrace
+            from ..observability import trace as _trace
+
+            ctx = _rtrace.current()
+            args = ctx.trace_args() if ctx is not None else {}
+            return _trace.begin("serving.queue", cat="serving", **args)
+        except Exception:  # pt-lint: ok[PT005]
+            return None    # (observability fan-out guard, as below)
+
+    @staticmethod
+    def _end_queue_span(sp):
+        if sp is None:
+            return
+        try:
+            from ..observability import trace as _trace
+
+            _trace.end(sp)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard, as below)
+
     def _shed_locked(self, reason, retry_after, detail=""):  # pt-lint: ok[PT102] (callers hold _cv)
         self._shed[reason] = self._shed.get(reason, 0) + 1
         try:

@@ -1,0 +1,838 @@
+"""Request-level serving telemetry tests (ISSUE 7): bucketed histogram
+math, request-context propagation across a real client→server hop, the
+/metrics + /debug/telemetry scrape plane, SLO burn-rate windows,
+goodput partitioning on synthetic streams, the per-process telemetry
+exporter, and the fleet aggregator (incl. a genuine two-process merge
+driven through a subprocess server).
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+from paddle_tpu.observability import (
+    export, goodput, metrics, request_trace, slo, trace,
+)
+from paddle_tpu.observability.metrics import _Hist
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    """Full stack on, clean registries, everything off again after.
+    Reset BEFORE attach: attach() declares the schema zeros a reset
+    would wipe."""
+    metrics.reset()
+    trace.clear()
+    obs.flight.clear()
+    obs.attach(crash_hook=False)
+    yield
+    obs.detach()
+    metrics.reset()
+    trace.clear()
+    obs.flight.clear()
+
+
+class _StubPredictor:
+    def __init__(self, service_time=0.0):
+        self.service_time = float(service_time)
+
+    def get_input_names(self):
+        return ["x"]
+
+    def get_output_names(self):
+        return ["y"]
+
+    def run(self, inputs):
+        if self.service_time:
+            time.sleep(self.service_time)
+        return [np.asarray(inputs[0])]
+
+
+def _wait_for(pred, timeout=5.0):
+    """Poll until `pred()` is truthy: the handler's final accounting
+    runs AFTER the response body is written, so a scrape immediately
+    following a response can legitimately race it by a few µs."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return bool(pred())
+
+
+def _post_npz(address, arrays, headers=()):
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    hdrs = {"Content-Type": "application/octet-stream"}
+    hdrs.update(dict(headers))
+    req = urllib.request.Request(address + "/predict",
+                                 data=buf.getvalue(), headers=hdrs)
+    return urllib.request.urlopen(req, timeout=30)
+
+
+# --------------------------------------------------------------------------
+# histogram buckets + percentile math (satellite: _Hist.summary fixes)
+# --------------------------------------------------------------------------
+
+def test_hist_even_count_p50_is_midpoint():
+    h = _Hist()
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["p50"] == 2.5  # previously r[n//2] == 3.0
+    assert s["p99"] >= s["p95"] >= s["p50"]
+
+
+def test_hist_small_reservoir_p95_interpolates():
+    h = _Hist()
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    s = h.summary()
+    assert 2.8 <= s["p95"] < 3.0  # previously snapped to an index
+    assert 2.9 <= s["p99"] <= 3.0
+
+
+def test_hist_bucket_percentiles_beyond_reservoir():
+    # 10k uniform values >> 256-slot reservoir: percentiles must come
+    # from the buckets (ALL observations), not the last 256 samples
+    h = _Hist()
+    for v in range(1, 10001):
+        h.observe(float(v))
+    s = h.summary()
+    assert s["count"] == 10000
+    assert 4000 < s["p50"] < 6000
+    assert 8800 < s["p95"] < 10000
+    assert 9400 < s["p99"] <= 10000
+    assert s["buckets"]  # sparse counts present for the fleet merge
+    assert sum(s["buckets"].values()) == 10000
+
+
+def test_hist_known_distribution_bucket_interpolation():
+    # every value in one bucket: percentile clamps into [min, max]
+    h = _Hist()
+    for _ in range(100):
+        h.observe(50.0)
+    assert h.percentile(0.5) == pytest.approx(50.0)
+    assert h.percentile(0.99) == pytest.approx(50.0)
+
+
+def test_prometheus_renders_histogram_buckets_and_quantiles():
+    reg = metrics.MetricsRegistry(enabled=True)
+    for v in (0.5, 5.0, 50.0, 500.0):
+        reg.observe("req.ms", v, endpoint="p")
+    text = reg.to_prometheus()
+    assert "# TYPE paddle_tpu_req_ms histogram" in text
+    # cumulative le-series over the fixed ladder, +Inf closes it
+    assert 'paddle_tpu_req_ms_bucket{endpoint="p",le="1"} 1' in text
+    assert 'paddle_tpu_req_ms_bucket{endpoint="p",le="+Inf"} 4' in text
+    assert 'paddle_tpu_req_ms_count{endpoint="p"} 4' in text
+    assert 'paddle_tpu_req_ms_sum{endpoint="p"} 555.5' in text
+    # percentiles live in a DISTINCT gauge family: bare-name quantile
+    # samples inside a TYPE histogram block are invalid OpenMetrics
+    assert '# TYPE paddle_tpu_req_ms_quantile gauge' in text
+    assert 'paddle_tpu_req_ms_quantile{endpoint="p",quantile="0.95"}' \
+        in text
+    assert 'paddle_tpu_req_ms{endpoint="p",quantile=' not in text
+    # cumulative counts are monotone over the ladder
+    import re
+
+    counts = [int(m.group(1)) for m in re.finditer(
+        r'paddle_tpu_req_ms_bucket\{endpoint="p",le="[^"]+"\} (\d+)',
+        text)]
+    assert counts == sorted(counts)
+
+
+# --------------------------------------------------------------------------
+# request context: identity, headers, hops
+# --------------------------------------------------------------------------
+
+def test_request_context_header_round_trip():
+    ctx = request_trace.new_context()
+    hdrs = ctx.to_headers()
+    assert hdrs["X-Request-Id"] == ctx.request_id
+    got = request_trace.RequestContext.from_headers(hdrs)
+    assert got.request_id == ctx.request_id
+    assert got.trace_id == ctx.trace_id
+    assert got.parent_id == ctx.span_id  # we are the next hop
+    assert got.hop == 1
+
+
+def test_request_context_child_and_malformed_traceparent():
+    ctx = request_trace.new_context(request_id="abc-123")
+    kid = ctx.child()
+    assert kid.request_id == "abc-123"
+    assert kid.trace_id == ctx.trace_id
+    assert kid.parent_id == ctx.span_id
+    assert kid.hop == ctx.hop + 1
+    # bad traceparent, good id: context still continues under the id
+    got = request_trace.RequestContext.from_headers(
+        {"X-Request-Id": "abc-123", "traceparent": "zz-nonsense"})
+    assert got.request_id == "abc-123"
+    # hostile id is replaced, not echoed
+    got2 = request_trace.RequestContext.from_headers(
+        {"X-Request-Id": "bad id\nwith newline",
+         "traceparent": "also-bad"})
+    assert got2 is None
+    assert request_trace.continue_from_headers({}).request_id
+
+
+def test_request_context_activate_scopes():
+    assert request_trace.current() is None
+    ctx = request_trace.new_context()
+    with request_trace.activate(ctx):
+        assert request_trace.current() is ctx
+        inner = request_trace.new_context()
+        with request_trace.activate(inner):
+            assert request_trace.current() is inner
+        assert request_trace.current() is ctx
+    assert request_trace.current() is None
+
+
+# --------------------------------------------------------------------------
+# SLO tracker: availability, burn rate, window expiry, shed reasons
+# --------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_burn_rate_and_window_expiry():
+    clk = _Clock()
+    tr = slo.SLOTracker(window_s=60.0, clock=clk)
+    tr.objective("predict", latency_target_ms=100.0, availability=0.9)
+    for i in range(8):
+        tr.observe("predict", 50.0, ok=True)
+    for _ in range(2):
+        tr.observe("predict", 500.0, ok=False, reason="error")
+    rep = tr.report(publish_gauges=False)["endpoints"]["predict"]
+    assert rep["requests"] == 10
+    assert rep["availability"] == pytest.approx(0.8)
+    # error rate 0.2 against a 0.1 budget: burning 2x
+    assert rep["burn_rate"] == pytest.approx(2.0)
+    assert rep["burn_severity"] == "ok"
+    assert rep["latency_target_met_frac"] == pytest.approx(0.8)
+    assert rep["latency_ms"]["p50"] == pytest.approx(50.0)
+    # the window slides: everything ages out
+    clk.t += 120.0
+    rep2 = tr.report(publish_gauges=False)["endpoints"]["predict"]
+    assert rep2["requests"] == 0
+    assert "burn_rate" not in rep2
+    assert rep2["lifetime_requests"] == 10
+
+
+def test_slo_shed_reasons_and_severity():
+    clk = _Clock()
+    tr = slo.SLOTracker(window_s=60.0, clock=clk)
+    tr.objective("predict", availability=0.999)
+    tr.observe("predict", 10.0, ok=True)
+    for _ in range(3):
+        tr.record_shed("predict", "queue_full")
+    tr.record_shed("predict", "draining")
+    rep = tr.report(publish_gauges=False)["endpoints"]["predict"]
+    assert rep["errors_by_reason"] == {"shed:queue_full": 3,
+                                       "shed:draining": 1}
+    assert rep["burn_rate"] > slo._BURN_FAST
+    assert rep["burn_severity"] == "page"
+
+
+def test_slo_objective_validation():
+    with pytest.raises(ValueError):
+        slo.SLOTracker().objective("p", availability=1.0)
+
+
+def test_slo_publishes_gauges(telemetry):
+    tr = slo.SLOTracker(window_s=60.0)
+    tr.objective("predict")
+    tr.observe("predict", 5.0, ok=True)
+    tr.report()
+    g = metrics.snapshot()["gauges"]
+    assert g["slo.burn_rate{endpoint=predict}"] == 0.0
+    assert g["slo.availability{endpoint=predict}"] == 1.0
+
+
+# --------------------------------------------------------------------------
+# serving e2e: scrape plane, id echo, phase spans, one-id retry
+# --------------------------------------------------------------------------
+
+def test_serving_scrape_plane_and_request_id(telemetry):
+    srv = InferenceServer(predictor=_StubPredictor()).start()
+    try:
+        out = InferenceClient(srv.address).predict(
+            x=np.ones((2, 2), np.float32))
+        assert np.array_equal(out["y"], np.ones((2, 2), np.float32))
+        assert _wait_for(lambda: metrics.snapshot()["counters"].get(
+            "serving.requests{status=ok}") == 1)
+
+        # /metrics: Prometheus text with real bucket series
+        with urllib.request.urlopen(srv.address + "/metrics",
+                                    timeout=10) as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert '_bucket{' in text
+        assert 'paddle_tpu_serving_requests{status="ok"} 1' in text
+        assert 'paddle_tpu_serving_phase_ms_bucket' in text
+        assert 'paddle_tpu_slo_burn_rate{endpoint="predict"}' in text
+
+        # /debug/telemetry: one-stop JSON snapshot
+        with urllib.request.urlopen(srv.address + "/debug/telemetry",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["slo"]["endpoints"]["predict"]["requests"] == 1
+        assert "admission" in snap and "metrics" in snap
+        assert snap["readiness"]["ready"] is True
+
+        # X-Request-Id: echoed when supplied, minted when absent
+        with _post_npz(srv.address, {"x": np.ones((1,), np.float32)},
+                       headers=[("X-Request-Id", "req-42")]) as r:
+            assert r.headers["X-Request-Id"] == "req-42"
+        with _post_npz(srv.address,
+                       {"x": np.ones((1,), np.float32)}) as r:
+            assert r.headers["X-Request-Id"]
+
+        # error responses echo too (bad body -> 400)
+        req = urllib.request.Request(
+            srv.address + "/predict", data=b"not-an-npz",
+            headers={"Content-Type": "application/octet-stream",
+                     "X-Request-Id": "bad-1"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert ei.value.headers["X-Request-Id"] == "bad-1"
+    finally:
+        srv.shutdown()
+
+
+def test_phase_spans_correlate_across_the_hop(telemetry):
+    srv = InferenceServer(predictor=_StubPredictor()).start()
+    try:
+        InferenceClient(srv.address).predict(
+            x=np.ones((2, 2), np.float32))
+        assert _wait_for(lambda: any(
+            e["name"] == "serving.request" for e in trace.events()))
+    finally:
+        srv.shutdown()
+    by_name = {}
+    for e in trace.events():
+        by_name.setdefault(e["name"], []).append(e)
+    for name in ("client.predict", "serving.request",
+                 "serving.admission", "serving.predict",
+                 "serving.serialize"):
+        assert name in by_name, sorted(by_name)
+    rid = by_name["client.predict"][0]["args"]["request_id"]
+    for name in ("serving.request", "serving.admission",
+                 "serving.predict", "serving.serialize"):
+        assert by_name[name][0]["args"]["request_id"] == rid
+    # the server hop continued, not restarted, the trace
+    assert by_name["serving.request"][0]["args"]["hop"] == 1
+    assert by_name["serving.request"][0]["args"]["status"] == "ok"
+    # phase histograms observed under the declared labels
+    hists = metrics.snapshot()["histograms"]
+    for phase in ("queue", "admission", "predict", "serialize"):
+        key = f"serving.phase_ms{{endpoint=predict,phase={phase}}}"
+        assert key in hists, sorted(hists)
+    assert "serving.request_ms{endpoint=predict,status=ok}" in hists
+
+
+def test_client_retry_reuses_one_request_id(telemetry):
+    srv = InferenceServer(predictor=_StubPredictor(), max_inflight=1,
+                          queue_depth=0).start()
+    blocker = srv.admission.admit()  # occupy the only slot
+
+    def release(_secs):
+        blocker.release(ok=True)
+
+    try:
+        client = InferenceClient(srv.address, retries=2, sleep=release)
+        out = client.predict(x=np.ones((1,), np.float32))
+        assert "y" in out
+        assert _wait_for(lambda: sum(
+            1 for e in trace.events()
+            if e["name"] == "serving.request") == 2)
+    finally:
+        srv.shutdown()
+    reqs = [e for e in trace.events() if e["name"] == "serving.request"]
+    assert len(reqs) == 2  # the shed attempt and the successful one
+    assert reqs[0]["args"]["request_id"] == reqs[1]["args"]["request_id"]
+    statuses = sorted(e["args"]["status"] for e in reqs)
+    assert statuses == ["ok", "shed"]
+    counters = metrics.snapshot()["counters"]
+    assert counters["serving.requests{status=shed}"] == 1
+    assert counters["serving.requests{status=ok}"] == 1
+    assert counters["client.requests{status=shed_retry}"] == 1
+    # the shed burned SLO budget under its reason label
+    rep = srv.slo.report(publish_gauges=False)["endpoints"]["predict"]
+    assert rep["errors_by_reason"] == {"shed:queue_full": 1}
+
+
+def test_queue_phase_span_under_contention(telemetry):
+    srv = InferenceServer(predictor=_StubPredictor(service_time=0.05),
+                          max_inflight=1, queue_depth=8).start()
+    try:
+        threads = [threading.Thread(
+            target=lambda: InferenceClient(srv.address).predict(
+                x=np.ones((1,), np.float32))) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        srv.shutdown()
+    queue_spans = [e for e in trace.events()
+                   if e["name"] == "serving.queue"]
+    assert queue_spans  # somebody actually camped the queue
+    assert queue_spans[0]["args"].get("request_id")
+
+
+# --------------------------------------------------------------------------
+# goodput partition on synthetic streams
+# --------------------------------------------------------------------------
+
+def _rec(wall_ms, n=1, compile=False):
+    return {"phase": "step_stats", "wall_ms": wall_ms, "n_steps": n,
+            "compile": compile}
+
+
+def test_goodput_partition_categories():
+    records = [_rec(1000.0, compile=True)] + [_rec(100.0)] * 10
+    flight_events = [
+        {"kind": "resilience.guard_skip", "t": 10.0},
+        {"kind": "resilience.guard_rollback", "t": 11.0},
+        {"kind": "resilience.retry", "t": 12.0, "delay": 0.5},
+        {"kind": "resilience.drain_begin", "t": 20.0},
+        {"kind": "resilience.drain_complete", "t": 20.25},
+    ]
+    rep = goodput.partition(records, flight_events, wall_s=4.0)
+    assert rep["productive_s"] == pytest.approx(1.0)
+    assert rep["lost"]["compile_s"] == pytest.approx(1.0)
+    # 2 guard events x 100 ms median steady step
+    assert rep["lost"]["rollback_s"] == pytest.approx(0.2)
+    assert rep["lost"]["retry_s"] == pytest.approx(0.5)
+    assert rep["lost"]["preemption_s"] == pytest.approx(0.25)
+    assert rep["lost"]["other_s"] == pytest.approx(
+        4.0 - 1.0 - 1.95, abs=1e-6)
+    assert rep["productive_frac"] == pytest.approx(0.25)
+    assert rep["lost_frac"] == pytest.approx(0.75)
+    assert rep["steps"] == 10 and rep["rollback_events"] == 2
+
+
+def test_goodput_without_wall_accounts_exactly():
+    rep = goodput.partition([_rec(200.0), _rec(50.0, compile=True)])
+    assert rep["wall_s"] == pytest.approx(0.25)
+    assert rep["lost"]["other_s"] == 0.0
+    assert rep["productive_frac"] == pytest.approx(0.8)
+
+
+def test_goodput_publish_and_rows(telemetry):
+    rep = goodput.partition([_rec(100.0)] * 4, wall_s=1.0)
+    goodput.publish(rep)
+    g = metrics.snapshot()["gauges"]
+    assert g["goodput.productive_frac"] == pytest.approx(0.4)
+    assert g["goodput.lost_s{category=other}"] == pytest.approx(0.6)
+    rows = goodput.metric_rows(rep, degraded=True)
+    assert [r["metric"] for r in rows] == ["goodput.productive_frac",
+                                           "goodput.lost_frac"]
+    assert all(r["degraded"] for r in rows)
+    assert rows[1]["lower_better"] is True
+
+
+def test_goodput_rows_gate_through_perf_gate(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_pg", os.path.join(REPO, "tools", "perf_gate.py"))
+    pg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pg)
+
+    rep = goodput.partition([_rec(100.0)] * 4, wall_s=1.0)
+    results = tmp_path / "results.json"
+    with open(results, "w") as f:
+        for row in goodput.metric_rows(rep):
+            f.write(json.dumps(row) + "\n")
+        f.write(json.dumps({"metric": "demo_tokens", "value": 10.0,
+                            "unit": "tok/s"}) + "\n")
+    baseline = tmp_path / "base.jsonl"
+    baseline.write_text(json.dumps(
+        {"metric": "demo_tokens", "value": 10.0}) + "\n")
+    # goodput rows are NEW (unbaselined): gate passes
+    rc = pg.main([str(results), "--baseline", str(baseline),
+                  "--static-budget", "", "--update"])
+    assert rc == 0
+    # after --update the baseline carries goodput rows and still
+    # validates (--check-only: the acceptance hook)
+    rc = pg.main(["--check-only", "--baseline", str(baseline),
+                  "--static-budget", ""])
+    assert rc == 0
+    base = pg.load_baseline(str(baseline))
+    assert "goodput.productive_frac" in base
+    assert base["goodput.lost_frac"]["lower_better"] is True
+
+
+# --------------------------------------------------------------------------
+# exporter: schema, incremental shipping, digest
+# --------------------------------------------------------------------------
+
+def test_exporter_dump_schema_and_incremental(tmp_path, telemetry):
+    metrics.inc("serving.requests", status="ok")
+    with trace.span("work.a"):
+        pass
+    ex = export.TelemetryExporter(outdir=str(tmp_path), interval_s=999,
+                                  host="h1", pid=101, rank=3)
+    path = ex.dump_once()
+    assert os.path.basename(path) == "telemetry_h1_101_r3.jsonl"
+    with trace.span("work.b"):
+        pass
+    obs.flight.record("demo.event", detail=1)
+    ex.dump_once(reason="final")
+    entries = [json.loads(l) for l in open(path)]
+    assert export.validate_telemetry_stream(entries) == []
+    assert [e["seq"] for e in entries] == [1, 2]
+    # incremental: the second dump ships only the NEW span + flight
+    names1 = [e["name"] for e in entries[0]["trace_events"]]
+    names2 = [e["name"] for e in entries[1]["trace_events"]]
+    assert "work.a" in names1 and "work.a" not in names2
+    assert "work.b" in names2
+    assert [e["kind"] for e in entries[1]["flight_events"]] \
+        == ["demo.event"]
+    assert entries[0]["metrics"]["counters"][
+        "serving.requests{status=ok}"] == 1
+    d = ex.digest()
+    assert d["requests"] == 1 and d["rank"] == 3
+
+    # validator catches a seq regression
+    bad = entries + [dict(entries[0], seq=1)]
+    assert export.validate_telemetry_stream(bad)
+
+
+def test_exporter_periodic_thread(tmp_path, telemetry):
+    ex = export.TelemetryExporter(outdir=str(tmp_path), interval_s=0.05,
+                                  host="h2", pid=202)
+    ex.start()
+    time.sleep(0.25)
+    ex.stop()
+    entries = [json.loads(l) for l in open(ex.path)]
+    assert len(entries) >= 2  # periodic dumps plus the final one
+    assert entries[-1]["reason"] == "final"
+    assert export.validate_telemetry_stream(entries) == []
+
+
+def test_analyze_chip_log_validates_telemetry_stream(tmp_path,
+                                                     telemetry):
+    ex = export.TelemetryExporter(outdir=str(tmp_path), interval_s=999,
+                                  host="h3", pid=303)
+    ex.dump_once()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "analyze_chip_log.py"), ex.path],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "telemetry_dumps" in out.stdout
+    # a corrupt line (wrong pid type) must fail the CI hook
+    with open(ex.path, "a") as f:
+        entry = json.loads(open(ex.path).readline())
+        entry["pid"] = "not-an-int"
+        entry["seq"] = 99
+        f.write(json.dumps(entry) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "analyze_chip_log.py"), ex.path],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+
+
+# --------------------------------------------------------------------------
+# elastic: rank digests ride the heartbeat store
+# --------------------------------------------------------------------------
+
+class _DictStore:
+    def __init__(self):
+        self.d = {}
+
+    def set(self, k, v):
+        self.d[k] = v
+
+    def get(self, k, timeout=None):
+        return self.d[k]
+
+    def check(self, k):
+        return k in self.d
+
+
+def test_elastic_heartbeat_carries_telemetry_digest():
+    st = _DictStore()
+    m = ElasticManager(store=st, job_id="tele", np_range="2",
+                       heartbeat_interval=60.0)
+    m.attach_telemetry(lambda: {"host": "h", "requests": 7})
+    m._set_heartbeat()
+    assert st.check("elastic/tele/telemetry/0")
+    digs = m.telemetry_digests()
+    assert digs[0]["requests"] == 7
+    # a broken digest fn must not cost the beat
+    m.attach_telemetry(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    m._set_heartbeat()  # no raise
+    assert st.check(m._hb_key())
+
+
+# --------------------------------------------------------------------------
+# aggregator: merge + rollup over synthetic per-process dumps
+# --------------------------------------------------------------------------
+
+def _dump_line(host, pid, seq, wall_epoch, trace_events,
+               counters=None, hists=None, slo_ep=None, rank=None):
+    line = {"phase": "telemetry_dump", "t": "2026-08-04T00:00:00",
+            "schema": "telemetry_dump/v1", "host": host, "pid": pid,
+            "rank": rank, "run_id": f"proc_{pid}", "seq": seq,
+            "reason": "periodic", "wall": wall_epoch + 1.0,
+            "trace_wall_epoch": wall_epoch,
+            "trace_events": trace_events, "flight_events": [],
+            "metrics": {"counters": counters or {}, "gauges": {},
+                        "histograms": hists or {}}}
+    if slo_ep is not None:
+        line["slo"] = {"schema": "slo/v1", "window_s": 300.0,
+                       "endpoints": {"predict": slo_ep}}
+    return line
+
+
+def _agg():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_tagg", os.path.join(REPO, "tools", "telemetry_agg.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_aggregator_merges_two_processes(tmp_path):
+    agg = _agg()
+    span = {"name": "client.predict", "cat": "client", "ph": "X",
+            "ts": 100.0, "dur": 50.0, "pid": 11, "tid": 1,
+            "args": {"request_id": "r-1"}}
+    span2 = {"name": "serving.predict", "cat": "serving", "ph": "X",
+             "ts": 10.0, "dur": 40.0, "pid": 22, "tid": 1,
+             "args": {"request_id": "r-1"}}
+    h1 = {"count": 2, "total": 30.0, "min": 10.0, "max": 20.0,
+          "buckets": {"10": 1, "31.62": 1}}
+    h2 = {"count": 2, "total": 300.0, "min": 100.0, "max": 200.0,
+          "buckets": {"100": 1, "316.2": 1}}
+    with open(tmp_path / "telemetry_a_11.jsonl", "w") as f:
+        f.write(json.dumps(_dump_line(
+            "a", 11, 1, 1000.0, [span],
+            counters={"serving.requests{status=ok}": 2},
+            hists={"serving.request_ms": h1},
+            slo_ep={"requests": 10, "errors": 1,
+                    "errors_by_reason": {"shed:queue_full": 1},
+                    "objective": {"latency_target_ms": 100.0,
+                                  "availability": 0.9,
+                                  "error_budget": 0.1}})) + "\n")
+    with open(tmp_path / "telemetry_b_22.jsonl", "w") as f:
+        f.write(json.dumps(_dump_line(
+            "b", 22, 1, 1002.0, [span2],
+            counters={"serving.requests{status=ok}": 3},
+            hists={"serving.request_ms": h2},
+            slo_ep={"requests": 10, "errors": 3,
+                    "errors_by_reason": {"shed:deadline": 3},
+                    "objective": {"latency_target_ms": 100.0,
+                                  "availability": 0.9,
+                                  "error_budget": 0.1}})) + "\n")
+
+    streams = agg.load_dumps(str(tmp_path))
+    assert len(streams) == 2
+    doc = agg.merge_timeline(streams)
+    procs = doc["otherData"]["processes"]
+    assert sorted(procs.values()) == ["a:11", "b:22"]
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+    # both processes' spans survive, joined by request_id
+    assert by_name["client.predict"]["args"]["request_id"] == "r-1"
+    assert by_name["serving.predict"]["args"]["request_id"] == "r-1"
+    # pids remapped to the merged doc's stable ids (distinct tracks)
+    assert by_name["client.predict"]["pid"] \
+        != by_name["serving.predict"]["pid"]
+    # wall-epoch re-basing: process b's epoch is 2 s later, so its
+    # ts shifted by +2e6 us relative to its own clock
+    assert by_name["serving.predict"]["ts"] == pytest.approx(
+        10.0 + 2e6)
+    assert by_name["client.predict"]["ts"] == pytest.approx(100.0)
+
+    roll = agg.rollup(streams)
+    assert roll["counters"]["serving.requests{status=ok}"] == 5
+    merged_h = roll["histograms"]["serving.request_ms"]
+    assert merged_h["count"] == 4
+    assert merged_h["min"] == 10.0 and merged_h["max"] == 200.0
+    assert sum(merged_h["buckets"].values()) == 4
+    assert "p95" in merged_h
+    ep = roll["slo"]["predict"]
+    assert ep["requests"] == 20 and ep["errors"] == 4
+    assert ep["errors_by_reason"] == {"shed:queue_full": 1,
+                                      "shed:deadline": 3}
+    # fleet error rate 0.2 over a 0.1 budget: burn 2x
+    assert ep["burn_rate"] == pytest.approx(2.0)
+
+
+def test_aggregator_cli_flags_schema_errors(tmp_path):
+    agg = _agg()
+    with open(tmp_path / "telemetry_x_1.jsonl", "w") as f:
+        f.write(json.dumps({"phase": "telemetry_dump", "t": "x",
+                            "schema": "telemetry_dump/v1"}) + "\n")
+    rc = agg.main([str(tmp_path), "--quiet"])
+    assert rc == 2
+
+
+# --------------------------------------------------------------------------
+# the two-process acceptance demo: client process + server subprocess,
+# merged by tools/telemetry_agg.py into one request-correlated timeline
+# --------------------------------------------------------------------------
+
+_CHILD = r"""
+import os, sys, time
+import numpy as np
+from paddle_tpu import observability as obs
+from paddle_tpu.observability.export import TelemetryExporter
+from paddle_tpu.inference.serving import InferenceServer
+
+class Stub:
+    def get_input_names(self): return ["x"]
+    def get_output_names(self): return ["y"]
+    def run(self, inputs):
+        time.sleep(0.05)
+        return [np.asarray(inputs[0])]
+
+obs.attach(crash_hook=False)
+srv = InferenceServer(predictor=Stub(), max_inflight=1,
+                      queue_depth=8).start()
+ex = TelemetryExporter(outdir=sys.argv[1], interval_s=999,
+                       slo=srv.slo.report)
+print(srv.address, flush=True)
+sys.stdin.readline()
+ex.dump_once(reason="final")
+srv.shutdown()
+print("done", flush=True)
+"""
+
+
+def test_two_process_demo_merged_timeline(tmp_path, telemetry):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_METRICS="1", PADDLE_TPU_TRACE="1")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(tmp_path)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env=env, cwd=REPO)
+    try:
+        address = child.stdout.readline().strip()
+        assert address.startswith("http://"), address
+
+        client = InferenceClient(address, timeout=60.0)
+        results = []
+
+        def one(i):
+            out = client.predict(x=np.full((2,), float(i), np.float32))
+            results.append(out)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 4
+
+        with urllib.request.urlopen(address + "/metrics",
+                                    timeout=30) as r:
+            assert '_bucket{' in r.read().decode()
+        with _post_npz(address, {"x": np.ones((1,), np.float32)},
+                       headers=[("X-Request-Id", "demo-req")]) as r:
+            assert r.headers["X-Request-Id"] == "demo-req"
+
+        # wait for the server's final accounting (the handler books a
+        # request AFTER its response bytes go out) before the child
+        # snapshots its telemetry
+        def _server_booked():
+            with urllib.request.urlopen(address + "/debug/telemetry",
+                                        timeout=30) as r:
+                snap = json.loads(r.read())
+            return snap["metrics"]["counters"].get(
+                "serving.requests{status=ok}", 0) >= 5
+
+        assert _wait_for(_server_booked, timeout=10.0)
+
+        # client-side dump next to the server's
+        ex = export.TelemetryExporter(outdir=str(tmp_path),
+                                      interval_s=999, host="client")
+        ex.dump_once(reason="final")
+        child.stdin.write("\n")
+        child.stdin.flush()
+        assert child.stdout.readline().strip() == "done"
+    finally:
+        child.stdin.close()
+        child.wait(timeout=60)
+
+    agg = _agg()
+    streams = agg.load_dumps(str(tmp_path))
+    assert len(streams) == 2
+    for _path, entries in streams:
+        assert export.validate_telemetry_stream(entries) == []
+    out = str(tmp_path / "merged.json")
+    doc = agg.merge_timeline(streams)
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    procs = doc["otherData"]["processes"]
+    assert len(procs) == 2
+
+    # one request's spans appear on BOTH processes' tracks, joined by
+    # request_id: the client attempt and the server-side phases
+    spans_by_pid = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") == "X" and e.get("args", {}).get("request_id"):
+            spans_by_pid.setdefault(e["pid"], {}).setdefault(
+                e["args"]["request_id"], set()).add(e["name"])
+    assert len(spans_by_pid) == 2
+    (pid_a, reqs_a), (pid_b, reqs_b) = sorted(spans_by_pid.items())
+    client_reqs = reqs_a if any("client.predict" in names
+                                for names in reqs_a.values()) else reqs_b
+    server_reqs = reqs_b if client_reqs is reqs_a else reqs_a
+    shared = set(client_reqs) & set(server_reqs)
+    assert shared  # same request ids on both tracks
+    rid = sorted(shared)[0]
+    assert "client.predict" in client_reqs[rid]
+    assert {"serving.request", "serving.admission", "serving.predict",
+            "serving.serialize"} <= server_reqs[rid]
+    # under 4-way contention against max_inflight=1 somebody queued
+    all_server_names = set().union(*server_reqs.values())
+    assert "serving.queue" in all_server_names
+
+    # fleet rollup sees both sides
+    roll = agg.rollup(streams)
+    assert roll["counters"].get(
+        "serving.requests{status=ok}", 0) >= 5
+    assert roll["counters"].get(
+        "client.requests{status=ok}", 0) >= 4
+    assert "predict" in roll["slo"]
+
+
+# --------------------------------------------------------------------------
+# schema: attach() pre-declares the serving/client status counters
+# --------------------------------------------------------------------------
+
+def test_attach_declares_request_status_schema(telemetry):
+    counters = metrics.snapshot()["counters"]
+    for s in ("ok", "client_error", "shed", "timeout", "error"):
+        assert counters[f"serving.requests{{status={s}}}"] == 0
+    for s in ("ok", "shed_retry", "error"):
+        assert counters[f"client.requests{{status={s}}}"] == 0

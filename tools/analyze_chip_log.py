@@ -6,8 +6,11 @@ key, errors listed last.  `step_stats` entries (the observability
 StepTimer stream, docs/OBSERVABILITY.md) get schema validation plus a
 per-run summary (compile ledger vs steady walls, tokens/s, MFU) instead
 of the latest-entry-wins table; `trace_event` entries (span-tracer
-`dump_jsonl` streams) get schema validation plus an event/span digest.
-Exit is non-zero on any schema error in either stream (the CI hook).
+`dump_jsonl` streams) get schema validation plus an event/span digest;
+`telemetry_dump` entries (the per-process exporter streams,
+observability/export.py) get schema validation plus a per-process dump
+digest.  Exit is non-zero on any schema error in any stream (the CI
+hook).
 Run: python tools/analyze_chip_log.py [log.jsonl]
 """
 from __future__ import annotations
@@ -36,6 +39,7 @@ def _load_obs_module(name):
 
 _step_stats = _load_obs_module("step_stats")
 _trace = _load_obs_module("trace")
+_export = _load_obs_module("export")
 
 
 def load(path=LOG):
@@ -55,11 +59,13 @@ def load(path=LOG):
     return entries
 
 
-def digest(entries, schema_errors=None, trace_errors=None):
+def digest(entries, schema_errors=None, trace_errors=None,
+           telemetry_errors=None):
     phases: "OrderedDict[str, OrderedDict]" = OrderedDict()
     errors = []
     step_entries = []
     trace_entries = []
+    telemetry_entries = []
     for e in entries:
         ph = e.get("phase", "?")
         if "error" in e:
@@ -70,6 +76,9 @@ def digest(entries, schema_errors=None, trace_errors=None):
             continue
         if ph == _trace.TRACE_PHASE:
             trace_entries.append(e)
+            continue
+        if ph == _export.TELEMETRY_PHASE:
+            telemetry_entries.append(e)
             continue
         if e.get("done"):
             continue
@@ -107,6 +116,19 @@ def digest(entries, schema_errors=None, trace_errors=None):
                 lines.append(f"- {err}")
         s = _trace.summarize_trace_stream(trace_entries)
         lines.append("- " + json.dumps(s, default=str))
+    if telemetry_entries:
+        lines.append(f"\n## telemetry_dumps  ({len(telemetry_entries)} "
+                     f"dumps)\n")
+        if telemetry_errors is None:
+            telemetry_errors = _export.validate_telemetry_stream(
+                telemetry_entries)
+        if telemetry_errors:
+            lines.append(f"**schema errors ({len(telemetry_errors)}):**")
+            for err in telemetry_errors[:20]:
+                lines.append(f"- {err}")
+        for ident, s in sorted(_export.summarize_telemetry_stream(
+                telemetry_entries).items()):
+            lines.append(f"- **{ident}**: " + json.dumps(s, default=str))
     if errors:
         lines.append(f"\n## errors ({len(errors)})\n")
         for ph, t, err in errors[-30:]:
@@ -121,8 +143,10 @@ def main(argv):
     # makes a corrupt step-stats or trace stream fail loudly in CI
     errors = _step_stats.validate_stream(entries)
     trace_errors = _trace.validate_trace_stream(entries)
-    print(digest(entries, schema_errors=errors, trace_errors=trace_errors))
-    return 1 if (errors or trace_errors) else 0
+    telemetry_errors = _export.validate_telemetry_stream(entries)
+    print(digest(entries, schema_errors=errors, trace_errors=trace_errors,
+                 telemetry_errors=telemetry_errors))
+    return 1 if (errors or trace_errors or telemetry_errors) else 0
 
 
 if __name__ == "__main__":

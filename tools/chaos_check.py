@@ -11,8 +11,11 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
   * `overload`: an `InferenceServer` with a deliberately slow predictor
     takes more concurrent requests than max_inflight + queue_depth —
     every ADMITTED request must complete, the excess must be shed with
-    429/503 + Retry-After, and the shed count must match the
-    `resilience.shed_requests` counters exactly.
+    429/503 + Retry-After, the shed count must match the
+    `resilience.shed_requests` counters exactly, the same sheds must
+    surface in the SLO report under their reason labels (ISSUE 7), and
+    `GET /metrics` must serve histogram `_bucket{le=...}` series under
+    the load.
   * `preemption`: a real SIGTERM lands mid-train-loop — the guarded
     step must write a checkpoint that passes `verify_checkpoint()`,
     exit via `TrainingPreempted`, and a fresh step must resume from it
@@ -201,6 +204,14 @@ def run_overload(requests=24, max_inflight=2, queue_depth=3,
         t.start()
     for t in threads:
         t.join()
+    # the scrape plane under load (ISSUE 7): /metrics must expose real
+    # histogram buckets, and the SLO report must carry the sheds WITH
+    # their reason labels — the router/autoscaler's input signals
+    import urllib.request as _urlreq
+
+    with _urlreq.urlopen(srv.address + "/metrics", timeout=10) as r:
+        metrics_text = r.read().decode()
+    slo_report = srv.slo.report(publish_gauges=False)
     drained = srv.shutdown()
     snap = metrics.snapshot()
     obs.detach()
@@ -209,6 +220,11 @@ def run_overload(requests=24, max_inflight=2, queue_depth=3,
     errors = [r for r in results if r[0] in ("error", "corrupt")]
     shed_counted = sum(v for k, v in snap["counters"].items()
                        if k.startswith("resilience.shed_requests"))
+    slo_ep = slo_report.get("endpoints", {}).get("predict", {})
+    slo_shed_reasons = {
+        k.split(":", 1)[1]: v
+        for k, v in slo_ep.get("errors_by_reason", {}).items()
+        if k.startswith("shed:")}
     report = {
         "scenario": "overload",
         "requests": requests,
@@ -217,16 +233,25 @@ def run_overload(requests=24, max_inflight=2, queue_depth=3,
         "shed": len(shed),
         "shed_with_retry_after": sum(1 for r in shed if r[2] is not None),
         "shed_counter": shed_counted,
+        "slo_shed_reasons": slo_shed_reasons,
+        "slo_burn_rate": slo_ep.get("burn_rate"),
+        "metrics_has_buckets": '_bucket{' in metrics_text,
         "admitted_failures": len(errors),
         "failure_detail": sorted({f"{r[0]}:{r[1]}" for r in errors}),
         "drained": bool(drained),
         "socket_closed": srv._httpd.socket.fileno() == -1,
         # every request either completed or was shed politely; the
         # counter agrees; at least one of each actually happened (an
-        # overload run with no sheds did not exercise overload)
+        # overload run with no sheds did not exercise overload); the
+        # sheds are visible in the SLO report under known reason labels
+        # and the scrape plane serves histogram buckets
         "recovered": (len(errors) == 0 and ok_n > 0 and len(shed) > 0
                       and len(shed) == shed_counted
                       and all(r[2] is not None for r in shed)
+                      and sum(slo_shed_reasons.values()) == shed_counted
+                      and all(k in ("queue_full", "deadline", "draining")
+                              for k in slo_shed_reasons)
+                      and '_bucket{' in metrics_text
                       and bool(drained)),
     }
     return report

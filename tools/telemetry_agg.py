@@ -1,0 +1,318 @@
+"""Fleet telemetry aggregator: merge per-process dumps into one story.
+
+Input: a directory of `telemetry_<host>_<pid>[_rN].jsonl` streams
+written by `observability.export.TelemetryExporter` (serving replicas,
+training ranks, clients — any process that attached the stack).
+
+Outputs:
+  * **merged Perfetto timeline** (`--out merged.json`): every process's
+    span/instant/counter events on its own pid track (process_name =
+    `host:pid[:rN]`), timestamps re-based onto ONE wall clock via each
+    tracer's `trace_wall_epoch`, flight events as instants — so a
+    request that crossed a client→server hop shows its client attempt
+    span and its server queue/admission/predict/serialize phase spans
+    in one view, joined by the `request_id` span arg.
+  * **fleet rollup** (`--rollup rollup.json`, also printed): counters
+    summed across processes, histograms merged bucket-by-bucket (the
+    fixed shared ladder makes this a plain sum) with fleet-wide
+    interpolated p50/p95/p99, gauges kept per process, and SLO reports
+    combined per endpoint (window counts summed, burn rate recomputed
+    against the declared objective).
+
+Exit codes: 0 ok, 1 usage/IO error, 2 schema errors in any stream
+(same discipline as tools/analyze_chip_log.py).
+
+stdlib-only: file-loads the stdlib-by-contract observability modules
+(export, metrics) instead of importing jax-heavy paddle_tpu.
+
+Usage:
+  python tools/telemetry_agg.py DUMP_DIR --out merged.json
+      [--rollup rollup.json] [--quiet]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_module(name):
+    path = os.path.join(REPO, "paddle_tpu", "observability", name + ".py")
+    spec = importlib.util.spec_from_file_location("_" + name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_export = _load_obs_module("export")
+_metrics_mod = _load_obs_module("metrics")
+
+
+# ------------------------------ loading ------------------------------
+
+def load_dumps(dump_dir):
+    """[(path, [entries...])] for every telemetry_*.jsonl in dir."""
+    out = []
+    pattern = os.path.join(dump_dir, "telemetry_*.jsonl")
+    for path in sorted(glob.glob(pattern)):
+        entries = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue
+        out.append((path, entries))
+    return out
+
+
+def _proc_ident(entry):
+    ident = f"{entry.get('host', '?')}:{entry.get('pid', '?')}"
+    if entry.get("rank") is not None:
+        ident += f":r{entry['rank']}"
+    return ident
+
+
+# ------------------------------ merge ------------------------------
+
+def merge_timeline(streams):
+    """One Perfetto document from N dump streams.
+
+    Event `ts` values are µs since each process's own tracer epoch; the
+    dump's `trace_wall_epoch` says where that epoch sits on the wall
+    clock, so shifting by `(wall_epoch - fleet_min_epoch) * 1e6` puts
+    every process on one comparable axis.  Flight events carry wall `t`
+    directly and shift by the fleet epoch alone."""
+    # pass 1: fleet epoch = earliest tracer epoch seen
+    epochs = {}
+    for _path, entries in streams:
+        for e in entries:
+            if e.get("phase") != _export.TELEMETRY_PHASE:
+                continue
+            we = e.get("trace_wall_epoch")
+            if isinstance(we, (int, float)):
+                ident = _proc_ident(e)
+                epochs[ident] = min(epochs.get(ident, we), we)
+    t0 = min(epochs.values()) if epochs else 0.0
+
+    events, meta = [], []
+    pids = {}       # ident -> synthetic stable pid for the merged doc
+    for _path, entries in streams:
+        for e in entries:
+            if e.get("phase") != _export.TELEMETRY_PHASE:
+                continue
+            ident = _proc_ident(e)
+            if ident not in pids:
+                pids[ident] = len(pids) + 1
+                meta.append({"name": "process_name", "ph": "M",
+                             "pid": pids[ident], "tid": 0,
+                             "args": {"name": ident}})
+            pid = pids[ident]
+            shift_us = (epochs.get(ident, t0) - t0) * 1e6
+            for ev in e.get("trace_events") or ():
+                if not isinstance(ev, dict):
+                    continue
+                ev = dict(ev, pid=pid)
+                if ev.get("ph") != "M" and isinstance(
+                        ev.get("ts"), (int, float)):
+                    ev["ts"] = round(ev["ts"] + shift_us, 3)
+                events.append(ev)
+            for fe in e.get("flight_events") or ():
+                if not isinstance(fe, dict) or not fe.get("kind"):
+                    continue
+                args = {k: v for k, v in fe.items()
+                        if k not in ("kind", "t", "seq")}
+                ts = (float(fe.get("t", e.get("wall", t0))) - t0) * 1e6
+                events.append({"name": str(fe["kind"]), "cat": "flight",
+                               "ph": "i", "s": "t",
+                               "ts": round(max(ts, 0.0), 3),
+                               "pid": pid, "tid": 0, "args": args})
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": {"schema": "telemetry_agg/v1",
+                          "processes": {v: k for k, v in pids.items()},
+                          "fleet_epoch": t0}}
+
+
+# ------------------------------ rollup ------------------------------
+
+def _merge_hist(acc, summ):
+    """Accumulate one histogram summary (count/total/min/max + sparse
+    bucket counts) into `acc`."""
+    acc["count"] = acc.get("count", 0) + int(summ.get("count", 0))
+    acc["total"] = acc.get("total", 0.0) + float(summ.get("total", 0.0))
+    if "min" in summ:
+        acc["min"] = min(acc.get("min", summ["min"]), summ["min"])
+    if "max" in summ:
+        acc["max"] = max(acc.get("max", summ["max"]), summ["max"])
+    buckets = acc.setdefault("buckets", {})
+    for le, c in (summ.get("buckets") or {}).items():
+        buckets[le] = buckets.get(le, 0) + int(c)
+    return acc
+
+
+def _hist_percentiles(merged):
+    """Fleet-wide interpolated percentiles from merged sparse buckets,
+    using the shared DEFAULT_BUCKETS ladder."""
+    count = merged.get("count", 0)
+    buckets = merged.get("buckets") or {}
+    if not count or not buckets:
+        return {}
+    bounds = list(_metrics_mod.DEFAULT_BUCKETS)
+    ordered = []
+    for i, b in enumerate(bounds):
+        c = buckets.get(f"{b:g}", 0)
+        if c:
+            lo = merged.get("min", 0.0) if i == 0 else bounds[i - 1]
+            ordered.append((lo, b, c))
+    inf_c = buckets.get("inf", 0)
+    if inf_c:
+        ordered.append((bounds[-1], merged.get("max", bounds[-1]), inf_c))
+    out = {}
+    for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        target = q * count
+        cum = 0
+        val = merged.get("max")
+        for lo, hi, c in ordered:
+            if cum + c >= target:
+                val = lo + (hi - lo) * ((target - cum) / c)
+                break
+            cum += c
+        if val is not None:
+            lo_clamp = merged.get("min", val)
+            hi_clamp = merged.get("max", val)
+            out[name] = round(max(lo_clamp, min(hi_clamp, val)), 6)
+    return out
+
+
+def rollup(streams):
+    """Fleet metrics/SLO rollup from the LAST dump of each process
+    (dumps are cumulative snapshots — summing all of them would
+    multiply-count)."""
+    last = {}
+    for _path, entries in streams:
+        for e in entries:
+            if e.get("phase") != _export.TELEMETRY_PHASE:
+                continue
+            ident = _proc_ident(e)
+            prev = last.get(ident)
+            if prev is None or e.get("seq", 0) >= prev.get("seq", 0):
+                last[ident] = e
+
+    counters: dict = {}
+    hists: dict = {}
+    gauges: dict = {}
+    slo_window: dict = {}
+    slo_objectives: dict = {}
+    for ident, e in sorted(last.items()):
+        m = e.get("metrics") or {}
+        for k, v in (m.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        for k, summ in (m.get("histograms") or {}).items():
+            if isinstance(summ, dict):
+                _merge_hist(hists.setdefault(k, {}), summ)
+        for k, v in (m.get("gauges") or {}).items():
+            gauges.setdefault(k, {})[ident] = v
+        slo = e.get("slo")
+        if isinstance(slo, dict):
+            for ep, rep in (slo.get("endpoints") or {}).items():
+                agg = slo_window.setdefault(ep, {
+                    "requests": 0, "errors": 0, "errors_by_reason": {}})
+                agg["requests"] += int(rep.get("requests", 0))
+                agg["errors"] += int(rep.get("errors", 0))
+                for reason, c in (rep.get("errors_by_reason")
+                                  or {}).items():
+                    br = agg["errors_by_reason"]
+                    br[reason] = br.get(reason, 0) + int(c)
+                if isinstance(rep.get("objective"), dict):
+                    slo_objectives[ep] = rep["objective"]
+
+    for k, h in hists.items():
+        h.update(_hist_percentiles(h))
+        if h.get("count"):
+            h["mean"] = round(h["total"] / h["count"], 6)
+    slo_out = {}
+    for ep, agg in slo_window.items():
+        rep = dict(agg)
+        obj = slo_objectives.get(ep)
+        if agg["requests"]:
+            rep["availability"] = round(
+                1.0 - agg["errors"] / agg["requests"], 6)
+            if obj and obj.get("error_budget"):
+                rep["burn_rate"] = round(
+                    (agg["errors"] / agg["requests"])
+                    / float(obj["error_budget"]), 4)
+        if obj:
+            rep["objective"] = obj
+        slo_out[ep] = rep
+
+    return {"schema": "telemetry_rollup/v1",
+            "processes": sorted(last),
+            "counters": dict(sorted(counters.items())),
+            "histograms": dict(sorted(hists.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "slo": slo_out}
+
+
+# ------------------------------ CLI ------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="telemetry_agg", description=__doc__.splitlines()[0])
+    ap.add_argument("dump_dir", help="directory of telemetry_*.jsonl")
+    ap.add_argument("--out", metavar="MERGED",
+                    help="write the merged Perfetto timeline here")
+    ap.add_argument("--rollup", metavar="OUT",
+                    help="write the fleet rollup JSON here")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the rollup pretty-print")
+    args = ap.parse_args(argv)
+
+    streams = load_dumps(args.dump_dir)
+    if not streams:
+        print(f"telemetry_agg: no telemetry_*.jsonl in {args.dump_dir}",
+              file=sys.stderr)
+        return 1
+
+    errors = []
+    for path, entries in streams:
+        for err in _export.validate_telemetry_stream(entries):
+            errors.append(f"{os.path.basename(path)}: {err}")
+    if errors:
+        print(f"telemetry_agg: {len(errors)} schema error(s):",
+              file=sys.stderr)
+        for err in errors[:20]:
+            print(f"  - {err}", file=sys.stderr)
+
+    if args.out:
+        doc = merge_timeline(streams)
+        d = os.path.dirname(os.path.abspath(args.out))
+        os.makedirs(d, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, default=str)
+        n_proc = len(doc["otherData"]["processes"])
+        print(f"telemetry_agg: merged {len(doc['traceEvents'])} events "
+              f"from {n_proc} process(es) -> {args.out}")
+
+    roll = rollup(streams)
+    if args.rollup:
+        d = os.path.dirname(os.path.abspath(args.rollup))
+        os.makedirs(d, exist_ok=True)
+        with open(args.rollup, "w") as f:
+            json.dump(roll, f, indent=2, sort_keys=True, default=str)
+        print(f"telemetry_agg: rollup -> {args.rollup}")
+    if not args.quiet:
+        print(json.dumps(roll, indent=2, sort_keys=True, default=str))
+    return 2 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
