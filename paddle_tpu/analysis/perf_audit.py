@@ -78,8 +78,10 @@ RULE_IDS = ("PT400", "PT401", "PT402", "PT403", "PT404", "PT405")
 
 # program names: the fast subset runs in the tier-1 smoke; FULL adds the
 # op-table sweep (slow tier — imports + traces the whole exported surface)
-DEFAULT_PROGRAMS = ("train_step", "decode_step", "call_sites")
-FULL_PROGRAMS = ("train_step", "decode_step", "call_sites", "op_table")
+DEFAULT_PROGRAMS = ("train_step", "decode_step", "paged_decode_step",
+                    "call_sites")
+FULL_PROGRAMS = ("train_step", "decode_step", "paged_decode_step",
+                 "call_sites", "op_table")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -465,6 +467,39 @@ def _decode_step_program(batch=2, prompt=8, new_tokens=8):
     return lowered, jaxpr
 
 
+def _paged_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
+                               chunk=4):
+    """The continuous-batching engine's ragged paged decode program
+    (``InferenceEngine._decode_program``) at a tiny proxy shape — the
+    serving hot step (ISSUE 8).  Budgeting its layout/transpose counts
+    means a relayout regression in the paged-attention path fails CI
+    before any hardware run.  Returns ``(lowered, closed_jaxpr)``."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as P
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(0)
+    max_len = page_size * pages_per_seq
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=1,
+                    num_heads=4, max_seq_len=max_len)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = InferenceEngine(model, EngineConfig(
+        page_size=page_size, max_slots=slots, decode_chunk=chunk,
+        max_seq_len=max_len))
+    decode = eng._decode_program(chunk)
+    args = (eng._params, eng._buffers, eng._k_pools, eng._v_pools,
+            jnp.zeros((slots,), jnp.int32),
+            jnp.zeros((slots, eng.max_pages_per_seq), jnp.int32),
+            jnp.zeros((slots,), jnp.int32))
+    lowered = decode.lower(*args)
+    jaxpr = jax.make_jaxpr(decode)(*args)
+    return lowered, jaxpr
+
+
 def _audit_lowered(name: str, lowered, jaxpr=None):
     """All three views of one lowered program -> (violations, metrics).
     A missing view is a PT400 — an absent metric is invisible to the
@@ -621,11 +656,13 @@ def audit_perf(programs=DEFAULT_PROGRAMS, repo_root=None):
     for prog in programs:
         if prog == "call_sites":
             v, m = _audit_call_sites(repo_root)
-        elif prog in ("train_step", "decode_step"):
-            full = ("gpt125m_train_step" if prog == "train_step"
-                    else "gpt_decode_step")
-            build = (_train_step_program if prog == "train_step"
-                     else _decode_step_program)
+        elif prog in ("train_step", "decode_step", "paged_decode_step"):
+            full = {"train_step": "gpt125m_train_step",
+                    "decode_step": "gpt_decode_step",
+                    "paged_decode_step": "gpt_paged_decode_step"}[prog]
+            build = {"train_step": _train_step_program,
+                     "decode_step": _decode_step_program,
+                     "paged_decode_step": _paged_decode_step_program}[prog]
             try:
                 lowered, jaxpr = build()
             except Exception as e:

@@ -1,0 +1,25 @@
+"""paddle_tpu.inference.engine — continuous-batching inference engine
+with a paged KV cache (docs/INFERENCE.md).
+
+  * `paging`     — host page-pool allocator (alloc/free/defrag).
+  * `scheduler`  — slot/admission/eviction policy at one fixed
+                   compiled batch shape (injectable clock).
+  * `engine`     — the `InferenceEngine`: bucketed dense prefill,
+                   pack-to-pages, ragged paged decode steps
+                   (`ops/pallas/paged_attention`), request handles.
+
+Serving wires an engine behind `POST /generate`
+(`inference/serving.py`), fed through the existing
+`AdmissionController` so shedding happens only past true saturation.
+"""
+from __future__ import annotations
+
+from .engine import EngineConfig, InferenceEngine, RequestHandle  # noqa: F401
+from .paging import OutOfPages, PagePool, SCRATCH_PAGE  # noqa: F401
+from .scheduler import Scheduler, SchedulerOutput, Sequence  # noqa: F401
+
+__all__ = [
+    "EngineConfig", "InferenceEngine", "RequestHandle",
+    "PagePool", "OutOfPages", "SCRATCH_PAGE",
+    "Scheduler", "SchedulerOutput", "Sequence",
+]

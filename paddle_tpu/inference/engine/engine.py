@@ -1,0 +1,560 @@
+"""Continuous-batching inference engine over a paged KV cache.
+
+The serving-side answer to ROADMAP item 1: instead of one predictor
+lock serving whole `generate()` calls back-to-back, the engine keeps a
+FIXED compiled batch of sequence slots and advances every in-flight
+sequence one (or `decode_chunk`) token(s) per step, admitting new
+sequences into freed slots between steps — throughput scales with
+batch occupancy, latency with queue position, and no request waits for
+the longest one to finish.
+
+Three compiled programs (per shape signature, cached):
+
+  * **prefill** (one sequence, prompt left-padded to a bucket): the
+    dense static-cache path the model families already compile —
+    returns the first generated token and the dense K/V it produced.
+  * **pack**: scatters the fresh dense K/V into the sequence's
+    allocated pages (pools donated — in-place on TPU).
+  * **decode** (the hot step): `decode_chunk` scanned steps at the
+    fixed `[max_slots]` batch — each step writes every slot's current
+    token into its page at `page_table[slot, len//ps], len%ps` and
+    attends through `ops/pallas/paged_attention` with per-slot ragged
+    lengths.  Pools donated; tokens stay on device across the scan.
+
+Free slots ride along pointing at the reserved scratch page with
+length 0: their output is discarded on the host, and the compiled
+shape never changes as sequences come and go.
+
+Env knobs (read when the matching ctor arg is None):
+  PADDLE_TPU_ENGINE_PAGE_SIZE       tokens per KV page        (16)
+  PADDLE_TPU_ENGINE_MAX_PAGES      pool size incl. scratch    (derived)
+  PADDLE_TPU_ENGINE_MAX_SLOTS      compiled batch slots       (4)
+  PADDLE_TPU_ENGINE_DECODE_CHUNK   decode steps per dispatch  (1)
+  PADDLE_TPU_ENGINE_PREFILL_BUCKET prompt padding granule     (16)
+  PADDLE_TPU_ENGINE_MAX_SEQ_LEN    per-sequence token cap     (model's)
+
+Observability: `engine.schedule/prefill/decode/detokenize` spans on
+the request-trace timeline, `engine.*` gauges (active/waiting
+sequences, page utilization, batch occupancy) and counters
+(`engine.sequences{event}`, `engine.tokens`) in the attach() schema.
+"""
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...observability import metrics as _metrics
+from ...observability import trace as _trace
+from ...resilience.overload import _env_num
+from .paging import PagePool
+from .scheduler import Scheduler, Sequence
+
+__all__ = ["EngineConfig", "InferenceEngine", "RequestHandle"]
+
+
+class EngineConfig:
+    """Engine sizing knobs; every ctor arg falls back to its
+    PADDLE_TPU_ENGINE_* env, then the default."""
+
+    def __init__(self, page_size=None, num_pages=None, max_slots=None,
+                 decode_chunk=None, prefill_bucket=None,
+                 max_seq_len=None):
+        self.page_size = int(page_size if page_size is not None else
+                             _env_num("PADDLE_TPU_ENGINE_PAGE_SIZE", 16,
+                                      int))
+        self.max_slots = int(max_slots if max_slots is not None else
+                             _env_num("PADDLE_TPU_ENGINE_MAX_SLOTS", 4,
+                                      int))
+        self.decode_chunk = int(
+            decode_chunk if decode_chunk is not None else
+            _env_num("PADDLE_TPU_ENGINE_DECODE_CHUNK", 1, int))
+        self.prefill_bucket = int(
+            prefill_bucket if prefill_bucket is not None else
+            _env_num("PADDLE_TPU_ENGINE_PREFILL_BUCKET", 16, int))
+        # 0 = resolve from the model's max_seq_len at engine build
+        self.max_seq_len = int(
+            max_seq_len if max_seq_len is not None else
+            _env_num("PADDLE_TPU_ENGINE_MAX_SEQ_LEN", 0, int))
+        # 0 = derived: every slot can hold a max-length sequence
+        self.num_pages = int(num_pages if num_pages is not None else
+                             _env_num("PADDLE_TPU_ENGINE_MAX_PAGES", 0,
+                                      int))
+        for name in ("page_size", "max_slots", "decode_chunk",
+                     "prefill_bucket"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, got "
+                                 f"{getattr(self, name)}")
+
+
+class RequestHandle:
+    """One submitted request's delivery side: a token stream plus a
+    completion event.  Tokens arrive as the engine accepts them;
+    `result()` blocks for the full prompt+generated ids."""
+
+    def __init__(self, seq: Sequence):
+        self._seq = seq
+        self.request_id = seq.request_id
+        self._q = queue.Queue()
+        self.done = threading.Event()
+        self.finish_reason = None
+
+    def _push(self, tok: int) -> None:
+        self._q.put(int(tok))
+
+    def _finish(self, reason: str) -> None:
+        if self.done.is_set():
+            return
+        self.finish_reason = reason
+        self.done.set()
+        self._q.put(None)          # stream sentinel
+
+    # --- consumer side ------------------------------------------------------
+    def stream(self, timeout: float = 120.0):
+        """Yield generated tokens as they land; returns at completion."""
+        while True:
+            tok = self._q.get(timeout=timeout)
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: float = 120.0) -> np.ndarray:
+        """Blocking: full int32 [s0 + n_generated] ids (prompt
+        included, like `GenerationMixin.generate`)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished in {timeout}s")
+        return self._seq.output_ids()
+
+    @property
+    def tokens(self) -> list:
+        return list(self._seq.tokens)
+
+    @property
+    def cancelled(self) -> bool:
+        return self.finish_reason == "cancelled"
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one `GenerationMixin` model
+    (greedy decoding — the deterministic serving mode; sampling rides
+    ROADMAP item 4)."""
+
+    def __init__(self, model, config: EngineConfig = None,
+                 clock=time.monotonic):
+        import copy
+
+        # own copy: max_seq_len/num_pages resolve against THIS model
+        # below, and mutating the caller's object would poison a config
+        # reused for a second engine over a different model
+        self.config = copy.copy(config) if config is not None \
+            else EngineConfig()
+        self._model = model
+        model.eval()
+        self._params, self._buffers = model.functional_state()
+        cfg = self.config
+        # shape probe: one layer's dense cache tells us layers/heads/dim
+        probe = model.init_kv_caches(1, 1)
+        self._layers = len(probe)
+        _, self._hkv, _, self._hd = probe[0][0].shape
+        self._dtype = probe[0][0].dtype
+        del probe
+        if cfg.max_seq_len <= 0:
+            cfg.max_seq_len = int(getattr(model.cfg, "max_seq_len", 0)) \
+                or 2048
+        self.max_pages_per_seq = -(-cfg.max_seq_len // cfg.page_size)
+        if cfg.num_pages <= 0:
+            cfg.num_pages = cfg.max_slots * self.max_pages_per_seq + 1
+        self.pool = PagePool(cfg.num_pages, cfg.page_size)
+        self.scheduler = Scheduler(cfg.max_slots, self.pool,
+                                   self.max_pages_per_seq, clock=clock)
+        shape = (cfg.num_pages, self._hkv, cfg.page_size, self._hd)
+        self._k_pools = [jnp.zeros(shape, self._dtype)
+                         for _ in range(self._layers)]
+        self._v_pools = [jnp.zeros(shape, self._dtype)
+                         for _ in range(self._layers)]
+        self._programs = {}
+        self._handles = {}         # request_id -> RequestHandle
+        self._lock = threading.RLock()
+        self._work = threading.Condition()
+        self._thread = None
+        self._running = False
+        self.steps = 0
+
+    # --- model invocation (raw jax values; paged or dense caches) -----------
+    def _run_model(self, params, buffers, ids, caches, pos, start):
+        from ...core import flags
+        from ...core.tensor import Tensor
+
+        with flags.no_grad_guard(), flags.trace_guard():
+            with self._model.bind_state(params, buffers):
+                logits, new = self._model(
+                    Tensor(ids),
+                    kv_caches=[tuple(Tensor(x) for x in c)
+                               for c in caches],
+                    cache_pos=Tensor(pos),
+                    attn_start=None if start is None else Tensor(start))
+        return logits._value, [tuple(x._value for x in c) for c in new]
+
+    # --- compiled programs --------------------------------------------------
+    def _prefill_program(self, sb: int):
+        """One left-padded sequence at bucket length sb: greedy first
+        token + the dense K/V (capacity sb+page_size so the pack
+        program's last page slice never clamps)."""
+        key = ("prefill", sb)
+        hit = self._programs.get(key)
+        if hit is not None:
+            return hit
+        run = self._run_model
+        layers, hkv, d = self._layers, self._hkv, self._hd
+        cap = sb + self.config.page_size
+        dtype = self._dtype
+
+        @jax.jit
+        def prefill(params, buffers, ids, start):
+            caches = [(jnp.zeros((1, hkv, cap, d), dtype),
+                       jnp.zeros((1, hkv, cap, d), dtype))
+                      for _ in range(layers)]
+            logits, new = run(params, buffers, ids, caches,
+                              jnp.zeros((), jnp.int32), start)
+            tok = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return tok, [c[0] for c in new], [c[1] for c in new]
+
+        self._programs[key] = prefill
+        return prefill
+
+    def _pack_program(self, sb: int):
+        """Scatter a prefill's dense K/V (real tokens at
+        [start, start+s0)) into the sequence's pages.  Pages beyond the
+        prompt's span point at the scratch page — their writes are
+        discarded by construction."""
+        key = ("pack", sb)
+        hit = self._programs.get(key)
+        if hit is not None:
+            return hit
+        ps = self.config.page_size
+        hkv, d = self._hkv, self._hd
+        npb = -(-sb // ps)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def pack(k_pools, v_pools, kbufs, vbufs, pages, start):
+            def put(pool, buf):
+                def body(i, pool):
+                    chunk = jax.lax.dynamic_slice(
+                        buf, (0, 0, start + i * ps, 0), (1, hkv, ps, d))
+                    return jax.lax.dynamic_update_slice(
+                        pool, chunk, (pages[i], 0, 0, 0))
+                return jax.lax.fori_loop(0, npb, body, pool)
+
+            k_pools = [put(p, b) for p, b in zip(k_pools, kbufs)]
+            v_pools = [put(p, b) for p, b in zip(v_pools, vbufs)]
+            return k_pools, v_pools
+
+        self._programs[key] = pack
+        return pack
+
+    def _decode_program(self, n: int):
+        """`n` ragged decode steps at the fixed [max_slots] batch inside
+        one compiled scan.  Pools donated: each step writes one page
+        slot per sequence per layer, and donation lets XLA update in
+        place instead of copying the whole pool per token."""
+        key = ("decode", n)
+        hit = self._programs.get(key)
+        if hit is not None:
+            return hit
+        run = self._run_model
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def decode(params, buffers, k_pools, v_pools, tok, pt, lengths):
+            def body(carry, _):
+                tok, kps, vps, lengths = carry
+                caches = [(k, v, pt) for k, v in zip(kps, vps)]
+                logits, new = run(params, buffers, tok[:, None], caches,
+                                  lengths, None)
+                kps = [c[0] for c in new]
+                vps = [c[1] for c in new]
+                nxt = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return (nxt, kps, vps, lengths + 1), nxt
+
+            (tok, kps, vps, lengths), toks = jax.lax.scan(
+                body, (tok, k_pools, v_pools, lengths), None, length=n)
+            return jnp.swapaxes(toks, 0, 1), kps, vps
+
+        self._programs[key] = decode
+        return decode
+
+    # --- intake -------------------------------------------------------------
+    def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
+               request_id=None) -> RequestHandle:
+        """Enqueue one sequence; returns its `RequestHandle`.  Raises
+        ValueError when the request can never fit (prompt+max_new over
+        the engine's per-sequence or pool capacity) — feasibility is
+        checked at the door so the scheduler never deadlocks on an
+        unservable request."""
+        seq = Sequence(input_ids, max_new_tokens,
+                       eos_token_id=eos_token_id, request_id=request_id)
+        need = -(-(seq.prompt.size + seq.max_new_tokens)
+                 // self.config.page_size)
+        if need > self.pool.capacity:
+            raise ValueError(
+                f"request needs {need} pages, pool holds "
+                f"{self.pool.capacity}")
+        handle = RequestHandle(seq)
+        seq.handle = handle
+        # register BEFORE the scheduler can see the sequence: with the
+        # loop thread running, a short request can be admitted,
+        # finished, and its handle popped before submit() returns — a
+        # post-hoc insert would leave a stale entry in _handles forever
+        with self._lock:
+            self._handles[seq.request_id] = handle
+        try:
+            self.scheduler.submit(seq)  # validates vs max_pages_per_seq
+        except Exception:
+            with self._lock:
+                self._handles.pop(seq.request_id, None)
+            raise
+        _metrics.inc("engine.sequences", event="submitted")
+        with self._work:
+            self._work.notify_all()
+        return handle
+
+    def cancel(self, request_id) -> bool:
+        """Abandon a sequence (client gone / explicit cancel): its
+        handle completes as cancelled now; slot and pages return to the
+        pool at the next schedule()."""
+        ok = self.scheduler.cancel(request_id)
+        if ok:
+            _metrics.inc("engine.sequences", event="cancelled")
+            with self._lock:
+                handle = self._handles.pop(request_id, None)
+            if handle is not None:
+                handle._finish("cancelled")
+            with self._work:
+                self._work.notify_all()
+        return ok
+
+    # --- the engine step ----------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: schedule -> prefill admissions ->
+        ragged decode chunk -> detokenize/deliver.  Returns True when
+        any work happened."""
+        with self._lock:
+            with _trace.span("engine.schedule", cat="engine"):
+                out = self.scheduler.schedule(self.config.decode_chunk)
+            for seq in out.evicted:
+                _metrics.inc("engine.sequences", event="evicted")
+            for seq in out.finished:
+                # released this schedule (completed earlier, or
+                # cancelled while waiting/running): close the handle
+                # and drop the engine's reference — a long-running
+                # server must not accumulate one handle per cancelled
+                # request
+                self._handles.pop(seq.request_id, None)
+                if seq.handle is not None:
+                    seq.handle._finish(seq.finish_reason or "finished")
+            did = bool(out.finished or out.evicted)
+            for seq in out.prefills:
+                self._prefill(seq)
+                did = True
+            running = [s for s in out.running
+                       if not s.done and s.slot is not None]
+            if running:
+                self._decode(running)
+                did = True
+            # free completed sequences' slots/pages NOW, not at the
+            # next schedule — a drained engine must hold zero pages
+            self.scheduler.release_finished()
+            if did:
+                self.steps += 1
+            self._publish_gauges()
+        return did
+
+    def _bucket(self, s0: int) -> int:
+        b = self.config.prefill_bucket
+        return -(-s0 // b) * b
+
+    def _prefill(self, seq: Sequence) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        prompt = seq.resume_prompt()
+        s0 = prompt.size
+        sb = self._bucket(s0)
+        start = sb - s0
+        with _trace.span("engine.prefill", cat="engine",
+                         request=seq.request_id, tokens=s0, bucket=sb,
+                         pages=len(seq.pages)):
+            ids = np.zeros((1, sb), np.int32)
+            ids[0, start:] = prompt
+            prefill = self._prefill_program(sb)
+            tok, kbufs, vbufs = prefill(
+                self._params, self._buffers, jnp.asarray(ids),
+                jnp.asarray([start], jnp.int32))
+            ps = self.config.page_size
+            npb = -(-sb // ps)
+            pages = np.zeros((npb,), np.int32)
+            n_real = min(len(seq.pages), npb)
+            pages[:n_real] = seq.pages[:n_real]
+            pack = self._pack_program(sb)
+            self._k_pools, self._v_pools = pack(
+                self._k_pools, self._v_pools, kbufs, vbufs,
+                jnp.asarray(pages), jnp.asarray(start, jnp.int32))
+            seq.length = s0
+            t0 = int(np.asarray(jax.device_get(tok))[0])
+            seq.last_token = t0
+        _metrics.inc("engine.sequences", event="admitted")
+        self._accept(seq, t0)
+
+    def _decode(self, running) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        cfg = self.config
+        s_, p_ = cfg.max_slots, self.max_pages_per_seq
+        tok = np.zeros((s_,), np.int32)
+        pt = np.zeros((s_, p_), np.int32)
+        lengths = np.zeros((s_,), np.int32)
+        for seq in running:
+            tok[seq.slot] = seq.last_token
+            pt[seq.slot, :len(seq.pages)] = seq.pages
+            lengths[seq.slot] = seq.length
+        # ALWAYS dispatch the configured chunk: shrinking the scan to
+        # the batch's max remaining would compile one program per
+        # distinct tail length — a compile per shape costs far more
+        # than the few discarded tail tokens, and a single decode
+        # program is the fixed-compiled-shape contract
+        n = cfg.decode_chunk
+        decode = self._decode_program(n)
+        with _trace.span("engine.decode", cat="engine", batch=len(running),
+                         chunk=n, occupancy=len(running) / cfg.max_slots):
+            toks, self._k_pools, self._v_pools = decode(
+                self._params, self._buffers, self._k_pools,
+                self._v_pools, jnp.asarray(tok), jnp.asarray(pt),
+                jnp.asarray(lengths))
+        with _trace.span("engine.detokenize", cat="engine",
+                         batch=len(running), chunk=n):
+            toks = np.asarray(jax.device_get(toks))
+            for seq in running:
+                row = toks[seq.slot]
+                for j in range(n):
+                    if seq.done:
+                        break  # mid-chunk finish: later tokens are the
+                        # frozen-slot continuation, not output
+                    self._accept(seq, int(row[j]))
+                seq.length += n
+                seq.last_token = int(row[n - 1])
+
+    def _accept(self, seq: Sequence, tok: int) -> None:
+        """One generated token passes the host: record, deliver,
+        finish on eos / length (mirrors generate()'s freezing: the eos
+        itself is emitted, nothing after it)."""
+        seq.tokens.append(int(tok))
+        _metrics.inc("engine.tokens")
+        if seq.handle is not None:
+            seq.handle._push(tok)
+        if seq.eos_token_id is not None and int(tok) == seq.eos_token_id:
+            self._finish(seq, "eos")
+        elif len(seq.tokens) >= seq.max_new_tokens:
+            self._finish(seq, "length")
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        self.scheduler.finish(seq, reason)
+        _metrics.inc("engine.sequences", event="completed")
+        if seq.handle is not None:
+            seq.handle._finish(reason)
+        with self._lock:
+            self._handles.pop(seq.request_id, None)
+
+    def _publish_gauges(self) -> None:
+        st = self.scheduler.stats()
+        _metrics.set_gauge("engine.active_sequences", st["running"])
+        _metrics.set_gauge("engine.waiting_sequences", st["waiting"])
+        _metrics.set_gauge("engine.batch_occupancy", st["occupancy"])
+        _metrics.set_gauge("engine.page_utilization",
+                           self.pool.utilization())
+
+    # --- maintenance --------------------------------------------------------
+    def defrag(self) -> int:
+        """Compact live pages to the densest pool prefix: apply the
+        allocator's moves to the device pools and every live page
+        table.  Returns the number of pages moved."""
+        with self._lock:
+            moves = self.pool.defrag()
+            if not moves:
+                return 0
+            # ascending-dst order is overwrite-safe: src > dst always,
+            # and every src exceeds all earlier dsts
+            for src, dst in sorted(moves.items(), key=lambda kv: kv[1]):
+                self._k_pools = [p.at[dst].set(p[src])
+                                 for p in self._k_pools]
+                self._v_pools = [p.at[dst].set(p[src])
+                                 for p in self._v_pools]
+            for seq in self.scheduler.running_seqs():
+                seq.pages = [moves.get(p, p) for p in seq.pages]
+        return len(moves)
+
+    # --- loop / lifecycle ---------------------------------------------------
+    def start(self):
+        """Run the engine loop on a daemon thread (the serving mode);
+        `step()` remains callable inline for tests."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="paddle-tpu-engine")
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        # _running is a stop flag: a stale read costs one extra step;
+        # taking the lock here would serialize the loop against submit()
+        while self._running:  # pt-lint: ok[PT102]
+            if not self.step():
+                with self._work:
+                    if self._running and not self.scheduler.has_work():
+                        self._work.wait(timeout=0.05)
+
+    def stop(self, timeout: float = 10.0):
+        with self._lock:
+            self._running = False
+            thread = self._thread
+            self._thread = None
+        with self._work:
+            self._work.notify_all()
+        if thread is not None:
+            thread.join(timeout=timeout)
+
+    # --- convenience (tests / bench / equivalence) --------------------------
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 timeout: float = 300.0):
+        """Submit every prompt and run the engine to completion
+        (inline when the loop thread is not running).  Returns a list
+        of int32 [s0_i + n_generated_i] arrays — `generate()`-shaped
+        output for direct equivalence checks."""
+        handles = [self.submit(p, max_new_tokens,
+                               eos_token_id=eos_token_id)
+                   for p in prompts]
+        # _thread is set-once before any submit in the loop-thread
+        # mode; inline callers never race it
+        if self._thread is None:  # pt-lint: ok[PT102]
+            idle = 0
+            while any(not h.done.is_set() for h in handles):
+                if self.step():
+                    idle = 0
+                else:
+                    idle += 1
+                    if idle > 1000:
+                        raise RuntimeError(
+                            "engine made no progress (scheduler stuck)")
+        return [h.result(timeout=timeout) for h in handles]
+
+    def stats(self) -> dict:
+        st = self.scheduler.stats()
+        st["pages"] = self.pool.stats()
+        # monotonic int snapshot for telemetry; a stale read is a fine
+        # answer to "how many steps so far"
+        st["steps"] = self.steps  # pt-lint: ok[PT102]
+        return st

@@ -1,0 +1,332 @@
+"""Continuous-batching scheduler: slots, admission, eviction.
+
+The engine decodes at ONE fixed compiled batch shape (`max_slots`
+sequence slots).  This scheduler decides, each engine step, which
+sequence occupies which slot:
+
+  * **admission** — waiting sequences enter freed slots FIFO, as soon
+    as a slot AND enough pages for their prompt exist (no head-of-line
+    blocking on the longest in-flight request: a finished sequence's
+    slot is refilled on the very next step).
+  * **completion** — a sequence that emitted eos / exhausted
+    max_new_tokens (or was cancelled) releases its slot and pages at
+    the next `schedule()`.
+  * **eviction** — when the pool cannot cover every running sequence's
+    next `chunk` tokens, the YOUNGEST running sequence (latest
+    admission) is preempted back to the waiting queue's FRONT: its
+    pages free immediately, and on re-admission it re-prefills from
+    prompt + tokens-generated-so-far, which continues the greedy stream
+    exactly (recompute-style preemption — deterministic, no KV
+    snapshot).  Evicting the youngest keeps the oldest request's
+    latency bound tight.
+
+The clock is injectable and ordering is decided by admission sequence
+numbers, never wall time — the unit tests drive the whole policy
+without sleeping.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .paging import OutOfPages, PagePool, SCRATCH_PAGE
+
+__all__ = ["Sequence", "Scheduler", "SchedulerOutput"]
+
+WAITING, RUNNING, FINISHED, CANCELLED = (
+    "waiting", "running", "finished", "cancelled")
+
+
+class Sequence:
+    """One request's decode state (host view)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, input_ids, max_new_tokens, eos_token_id=None,
+                 request_id=None, arrived_at=0.0):
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        self.prompt = ids
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self.request_id = request_id or f"seq-{next(self._ids)}"
+        self.arrived_at = float(arrived_at)
+        self.state = WAITING
+        self.tokens = []           # accepted generated tokens
+        self.pages = []            # live page ids (engine's pools)
+        self.length = 0            # tokens materialized in the cache
+        self.slot = None
+        self.last_token = None     # next decode step's input token
+        self.admit_seqno = None    # ordering: eviction picks the max
+        self.evictions = 0
+        self.finish_reason = None
+        self.handle = None         # engine-attached delivery sink
+
+    # --- derived ------------------------------------------------------------
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, CANCELLED)
+
+    def resume_prompt(self) -> np.ndarray:
+        """What a (re-)prefill must process: the original prompt plus
+        everything already emitted — recompute preemption replays the
+        stream deterministically."""
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def output_ids(self) -> np.ndarray:
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def __repr__(self):
+        return (f"Sequence({self.request_id}, {self.state}, "
+                f"len={self.length}, gen={len(self.tokens)}/"
+                f"{self.max_new_tokens})")
+
+
+class SchedulerOutput:
+    """One schedule() decision: which sequences need a prefill this
+    step, who is running, and who was preempted."""
+
+    def __init__(self, prefills, running, evicted, finished):
+        self.prefills = prefills   # newly admitted (pages allocated)
+        self.running = running     # every live slot after admission
+        self.evicted = evicted     # preempted back to waiting
+        self.finished = finished   # released this schedule()
+
+
+class Scheduler:
+    def __init__(self, max_slots: int, pool: PagePool,
+                 max_pages_per_seq: int, clock=time.monotonic):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = int(max_slots)
+        self.pool = pool
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._waiting = deque()
+        self._running = {}         # slot -> Sequence
+        self._seqno = itertools.count()
+        self._by_id = {}           # request_id -> Sequence (live only)
+
+    # --- intake -------------------------------------------------------------
+    def submit(self, seq: Sequence) -> None:
+        max_len = self.max_pages_per_seq * self.pool.page_size
+        need = seq.prompt.size + seq.max_new_tokens
+        if need > max_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {need} exceeds the engine's "
+                f"max sequence length {max_len} "
+                f"({self.max_pages_per_seq} pages x "
+                f"{self.pool.page_size})")
+        with self._lock:
+            if seq.request_id in self._by_id:
+                raise ValueError(
+                    f"duplicate request id {seq.request_id!r}")
+            seq.arrived_at = self.clock()
+            self._by_id[seq.request_id] = seq
+            self._waiting.append(seq)
+
+    def cancel(self, request_id) -> bool:
+        """Mark a live sequence cancelled; its slot/pages release at the
+        next schedule().  Returns False for unknown/finished ids."""
+        with self._lock:
+            seq = self._by_id.get(request_id)
+            if seq is None or seq.done:
+                return False
+            seq.state = CANCELLED
+            seq.finish_reason = "cancelled"
+            return True
+
+    def finish(self, seq: Sequence, reason: str) -> None:
+        """Called by the engine when a running sequence completes."""
+        with self._lock:
+            if seq.done:
+                return
+            seq.state = FINISHED
+            seq.finish_reason = reason
+
+    # --- the per-step decision ----------------------------------------------
+    def _release_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        if seq.pages:
+            self.pool.free(seq.pages)
+            seq.pages = []
+        if seq.slot is not None:
+            self._running.pop(seq.slot, None)
+            seq.slot = None
+        self._by_id.pop(seq.request_id, None)
+
+    def _pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.pool.page_size)
+
+    def _target_pages(self, seq, tokens: int) -> int:
+        """Pages a sequence needs to cover `tokens` cache positions,
+        clamped to what it can EVER use: prompt + max_new_tokens (and
+        the table width).  Without the total clamp, a decode_chunk
+        reaching past the sequence's own finish line would demand pages
+        for tokens that only ever land in the scratch page — and could
+        evict (or refuse to admit) a sequence that actually fits."""
+        total = seq.prompt.size + seq.max_new_tokens
+        return min(self._pages_needed(min(tokens, total)),
+                   self.max_pages_per_seq)
+
+    def schedule(self, chunk: int = 1) -> SchedulerOutput:
+        """One step's slot/page plan:
+
+        1. release finished/cancelled sequences (slots + pages back),
+        2. grow every running sequence's page span to cover `chunk`
+           more tokens, evicting the youngest on pool pressure,
+        3. admit waiting sequences FIFO into free slots while pages for
+           prompt + first chunk exist.
+
+        Admission after release in the same call: a completed sequence's
+        slot serves a new request on the very next decode step."""
+        with self._lock:
+            finished = []
+            for slot in list(self._running):
+                seq = self._running[slot]
+                if seq.done:
+                    finished.append(seq)
+                    self._release_locked(seq)
+            # cancelled while still waiting: drop before admission
+            drop = [s for s in self._waiting if s.done]
+            for seq in drop:
+                finished.append(seq)
+                self._by_id.pop(seq.request_id, None)
+            if drop:
+                self._waiting = deque(
+                    s for s in self._waiting if not s.done)
+
+            evicted = []
+            # 2. page headroom for the next `chunk` decode tokens; a
+            # running seq writes positions [length, length+chunk)
+            for slot in sorted(self._running):
+                seq = self._running.get(slot)
+                if seq is None or seq.slot is None:
+                    continue  # evicted earlier in this pass
+                while True:
+                    target = self._target_pages(
+                        seq, seq.length + max(1, int(chunk)))
+                    need = target - len(seq.pages)
+                    if need <= 0:
+                        break
+                    try:
+                        seq.pages.extend(self.pool.alloc(need))
+                        break
+                    except OutOfPages:
+                        # youngest-first preemption INCLUDING the
+                        # growing sequence itself: when it is the
+                        # youngest, it self-preempts rather than
+                        # throwing away an older request's longer KV
+                        victim = self._evict_youngest_locked()
+                        if victim is None:
+                            break  # nothing live to evict (can't happen
+                            # while seq itself is live; belt-and-braces)
+                        evicted.append(victim)
+                        if victim is seq:
+                            break
+
+            # 3. FIFO admission into free slots
+            prefills = []
+            while self._waiting and len(self._running) < self.max_slots:
+                seq = self._waiting[0]
+                prompt = seq.resume_prompt()
+                need = self._target_pages(
+                    seq, prompt.size + max(1, int(chunk)))
+                if not self.pool.can_alloc(need):
+                    break  # strict FIFO: nothing skips the queue head
+                self._waiting.popleft()
+                seq.pages = self.pool.alloc(need)
+                seq.slot = self._free_slot_locked()
+                seq.state = RUNNING
+                seq.admit_seqno = next(self._seqno)
+                self._running[seq.slot] = seq
+                prefills.append(seq)
+
+            running = [self._running[s] for s in sorted(self._running)]
+            return SchedulerOutput(prefills, running, evicted, finished)
+
+    def _free_slot_locked(self):  # pt-lint: ok[PT102] (callers hold _lock)
+        for s in range(self.max_slots):
+            if s not in self._running:
+                return s
+        raise RuntimeError("no free slot (scheduler invariant broken)")
+
+    def _evict_youngest_locked(self):  # pt-lint: ok[PT102] (callers hold _lock)
+        cands = [s for s in self._running.values() if not s.done]
+        if not cands:
+            return None
+        victim = max(cands, key=lambda s: s.admit_seqno)
+        self._evict_locked(victim)
+        return victim
+
+    def _evict_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        self.pool.free(seq.pages)
+        seq.pages = []
+        self._running.pop(seq.slot, None)
+        seq.slot = None
+        seq.length = 0
+        seq.last_token = None
+        seq.state = WAITING
+        seq.evictions += 1
+        # FRONT of the queue: the preempted request resumes before
+        # anything that arrived after it
+        self._waiting.appendleft(seq)
+
+    def release_finished(self) -> list:
+        """Release every done running sequence NOW (slot + pages back to
+        the pool) instead of waiting for the next schedule() — the
+        engine calls this at the end of each step so a drained engine
+        holds zero pages (the chaos scenario's leak assertion)."""
+        with self._lock:
+            released = []
+            for slot in list(self._running):
+                seq = self._running[slot]
+                if seq.done:
+                    released.append(seq)
+                    self._release_locked(seq)
+            return released
+
+    # --- introspection ------------------------------------------------------
+    @property
+    def active_sequences(self) -> int:
+        with self._lock:
+            return len(self._running)
+
+    @property
+    def waiting_sequences(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    def running_seqs(self) -> list:
+        with self._lock:
+            return [self._running[s] for s in sorted(self._running)]
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._running or self._waiting)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "running": len(self._running),
+                "waiting": len(self._waiting),
+                "max_slots": self.max_slots,
+                "occupancy": len(self._running) / self.max_slots,
+            }

@@ -54,6 +54,7 @@ import io
 import json
 import math
 import os
+import queue
 import re
 import threading
 import time
@@ -128,7 +129,7 @@ class InferenceServer:
                  port: int = 0, request_retries: int = 2,
                  request_timeout: float = 30.0, max_inflight=None,
                  queue_depth=None, drain_timeout=None, ready_window=8,
-                 predictor=None):
+                 predictor=None, engine=None):
         from ..resilience.overload import AdmissionController, ShedError
         from ..resilience.retry import RetryPolicy
 
@@ -136,9 +137,22 @@ class InferenceServer:
             self._predictor = predictor
         elif model_path is not None:
             self._predictor = create_predictor(Config(model_path))
+        elif engine is None:
+            raise ValueError("InferenceServer needs a model_path, a "
+                             "predictor, or an engine")
         else:
-            raise ValueError("InferenceServer needs a model_path or a "
-                             "predictor")
+            self._predictor = None  # generate-only deployment
+        # continuous-batching engine behind POST /generate (ISSUE 8):
+        # its OWN AdmissionController, sized to the engine's true
+        # capacity (batch slots concurrently decoding, a queue on top)
+        # — shedding starts only past actual saturation, not at the
+        # predictor lock's conservative default
+        self.engine = engine
+        self.gen_admission = None
+        if engine is not None:
+            self.gen_admission = AdmissionController(
+                max_inflight=engine.config.max_slots,
+                queue_depth=queue_depth, name="generate")
         self._plock = threading.Lock()
         self._request_timeout = (None if request_timeout is None
                                  else float(request_timeout))
@@ -165,6 +179,16 @@ class InferenceServer:
                                        1000.0, float),
             availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY", 0.999,
                                   float))
+        if engine is not None:
+            # generation is a long-poll stream: the latency objective
+            # covers time-to-completion, so default it far laxer than
+            # one-shot predict
+            self.slo.objective(
+                "generate",
+                latency_target_ms=_env_num(
+                    "PADDLE_TPU_SLO_GENERATE_LATENCY_MS", 30000.0, float),
+                availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
+                                      0.999, float))
         self._drain_timeout = drain_timeout  # None → env/default in drain()
         self._ready_window = max(1, int(ready_window))
         self._recent = []          # last ready_window predictor outcomes
@@ -202,12 +226,17 @@ class InferenceServer:
                     # liveness: up — even while draining (killing a
                     # draining process forfeits its in-flight work)
                     p = server._predictor
-                    return self._json(200, {
+                    body = {
                         "status": "ok",
-                        "inputs": p.get_input_names(),
-                        "outputs": p.get_output_names(),
+                        "inputs": (p.get_input_names()
+                                   if p is not None else []),
+                        "outputs": (p.get_output_names()
+                                    if p is not None else []),
                         "draining": server.admission.draining,
-                    })
+                    }
+                    if server.engine is not None:
+                        body["engine"] = server.engine.stats()
+                    return self._json(200, body)
                 if self.path == "/ready":
                     ready, reason = server.readiness()
                     body = {"status": "ready" if ready else "not_ready",
@@ -239,7 +268,7 @@ class InferenceServer:
                 return self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
-                if self.path != "/predict":
+                if self.path not in ("/predict", "/generate"):
                     return self._json(404, {"error": "unknown path"})
                 # continue the client's identity (or mint one): id
                 # echoed on every response below, context active for
@@ -247,7 +276,131 @@ class InferenceServer:
                 ctx = _rtrace.continue_from_headers(self.headers)
                 self._rt_ctx = ctx
                 with _rtrace.activate(ctx):
-                    self._predict_traced(ctx)
+                    if self.path == "/generate":
+                        if server.engine is None:
+                            return self._json(
+                                404, {"error": "no engine attached "
+                                               "(generate disabled)"})
+                        self._generate_traced(ctx)
+                    else:
+                        if server._predictor is None:
+                            return self._json(
+                                404, {"error": "no predictor attached "
+                                               "(predict disabled)"})
+                        self._predict_traced(ctx)
+
+            def _generate_traced(self, ctx):
+                """POST /generate: continuous-batching token streaming.
+
+                Body: JSON ``{"input_ids": [ints] (one sequence),
+                "max_new_tokens": int, "eos_token_id": optional int}``.
+                Response: 200 + newline-delimited JSON — one
+                ``{"token": t}`` line per generated token as the engine
+                emits it, then a final ``{"done": true, "output_ids":
+                [...], "finish_reason": ...}`` line (connection closes;
+                no Content-Length — the stream IS the progress).  Sheds
+                and deadline overruns map exactly like /predict
+                (429/503 + Retry-After), and a client that disconnects
+                mid-stream gets its sequence cancelled so its pages
+                return to the pool."""
+                t_req = time.perf_counter()
+                sp = _trace.begin("serving.generate", cat="serving",
+                                  **ctx.trace_args())
+                status, slo_reason = "error", "error"
+                ticket = None
+                handle = None
+                try:
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n) or b"{}")
+                        ids = np.asarray(req["input_ids"],
+                                         np.int32).reshape(-1)
+                        if ids.size < 1:
+                            raise ValueError("empty input_ids")
+                        max_new = int(req.get("max_new_tokens", 32))
+                        eos = req.get("eos_token_id")
+                    except Exception as e:
+                        status = "client_error"
+                        return self._json(
+                            400, {"error": f"bad request body: "
+                                           f"{type(e).__name__}: {e}"})
+                    deadline = (None if server._request_timeout is None
+                                else time.monotonic()
+                                + server._request_timeout)
+                    try:
+                        with _rtrace.request_phase("admission",
+                                                   endpoint="generate"):
+                            ticket = server.gen_admission.admit(
+                                deadline=deadline)
+                    except ShedError as e:
+                        status, slo_reason = "shed", e.reason
+                        return self._json(
+                            e.http_status,
+                            {"error": str(e), "reason": e.reason},
+                            headers=[("Retry-After",
+                                      _retry_after_header(e.retry_after))])
+                    _metrics.observe("serving.phase_ms",
+                                     ticket.queue_wait * 1e3,
+                                     phase="queue", endpoint="generate")
+                    try:
+                        handle = server.engine.submit(
+                            ids, max_new_tokens=max_new,
+                            eos_token_id=eos,
+                            request_id=ctx.request_id)
+                    except _DETERMINISTIC_ERRORS as e:
+                        status = "client_error"
+                        return self._json(
+                            400, {"error": f"{type(e).__name__}: {e}"})
+                    # headers INSIDE the cancel-on-disconnect guard: a
+                    # client that drops before the stream starts must
+                    # still free its sequence, not decode max_new
+                    # tokens for a dead socket
+                    try:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("X-Request-Id", ctx.request_id)
+                        self.send_header("Connection", "close")
+                        self.end_headers()
+                        for tok in handle.stream(
+                                timeout=server._request_timeout or 120.0):
+                            self.wfile.write(
+                                json.dumps({"token": int(tok)}).encode()
+                                + b"\n")
+                            self.wfile.flush()
+                        final = {
+                            "done": True,
+                            "request_id": handle.request_id,
+                            "finish_reason": handle.finish_reason,
+                            "output_ids":
+                                [int(x) for x in
+                                 handle.result(timeout=5.0)],
+                        }
+                        self.wfile.write(json.dumps(final).encode()
+                                         + b"\n")
+                        self.wfile.flush()
+                        status = ("client_error" if handle.cancelled
+                                  else "ok")
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        # the client went away mid-stream: cancel so
+                        # the sequence's pages return to the pool
+                        server.engine.cancel(handle.request_id)
+                        status = "client_error"
+                    except queue.Empty:
+                        server.engine.cancel(handle.request_id)
+                        status, slo_reason = "timeout", "timeout"
+                finally:
+                    if ticket is not None:
+                        ticket.release(ok=status == "ok")
+                    dt_ms = (time.perf_counter() - t_req) * 1e3
+                    if sp is not None:
+                        sp.args["status"] = status
+                    _trace.end(sp)
+                    _metrics.observe("serving.request_ms", dt_ms,
+                                     endpoint="generate", status=status)
+                    _metrics.inc("serving.requests", status=status)
+                    server._slo_record(status, slo_reason, dt_ms,
+                                       endpoint="generate")
 
             def _predict_traced(self, ctx):
                 t_req = time.perf_counter()
@@ -342,17 +495,19 @@ class InferenceServer:
             del self._recent[:-self._ready_window]
 
     # --- telemetry plane -----------------------------------------------------
-    def _slo_record(self, status, reason, latency_ms):
+    def _slo_record(self, status, reason, latency_ms,
+                    endpoint="predict"):
         """Feed the SLO ledger with one finished request.  Client-fault
-        400s are excluded — the availability objective is a promise
-        about the SERVER, and one misbehaving client must not page the
-        on-call for it (mirror of the readiness-window rule above)."""
+        400s (and mid-stream disconnects) are excluded — the
+        availability objective is a promise about the SERVER, and one
+        misbehaving client must not page the on-call for it (mirror of
+        the readiness-window rule above)."""
         if status == "ok":
-            self.slo.observe("predict", latency_ms, ok=True)
+            self.slo.observe(endpoint, latency_ms, ok=True)
         elif status == "shed":
-            self.slo.record_shed("predict", reason)
+            self.slo.record_shed(endpoint, reason)
         elif status in ("timeout", "error"):
-            self.slo.observe("predict", latency_ms, ok=False,
+            self.slo.observe(endpoint, latency_ms, ok=False,
                              reason=reason)
 
     def render_metrics(self) -> str:
@@ -488,6 +643,8 @@ class InferenceServer:
     def start(self):
         self._serving = True  # before the thread runs: a shutdown()
         # racing start() must wait for the loop, not skip it
+        if self.engine is not None:
+            self.engine.start()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True,
             name="paddle-tpu-serving")
@@ -496,6 +653,8 @@ class InferenceServer:
 
     def serve_forever(self):
         self._serving = True
+        if self.engine is not None:
+            self.engine.start()  # idempotent
         self._httpd.serve_forever()
 
     def install_preemption(self, guard=None, install_signals=True):
@@ -546,7 +705,22 @@ class InferenceServer:
         try:
             if drain_timeout is None:
                 drain_timeout = self._drain_timeout
+            t_drain = time.monotonic()
             drained = self.admission.drain(timeout=drain_timeout)
+            if self.gen_admission is not None:
+                # generate streams drain on the SAME budget, not a
+                # second one: an orchestrator's kill grace period is
+                # sized to one drain_timeout (PR 5 contract), so the
+                # second controller gets whatever is left of it
+                budget = drain_timeout if drain_timeout is not None \
+                    else _env_num("PADDLE_TPU_DRAIN_TIMEOUT", 30.0,
+                                  float)
+                remaining = max(
+                    0.0, float(budget) - (time.monotonic() - t_drain))
+                drained = self.gen_admission.drain(
+                    timeout=remaining) and drained
+            if self.engine is not None:
+                self.engine.stop()
             try:
                 from ..observability import flight as _flight
                 from ..observability import metrics as _metrics
@@ -615,6 +789,89 @@ class InferenceClient:
         except (TypeError, ValueError):
             ra = 0.5
         return min(max(ra, 0.05), self.max_retry_wait)
+
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=None,
+                 on_token=None) -> dict:
+        """Stream one sequence through POST /generate.
+
+        Tokens are consumed INCREMENTALLY off the ndjson stream —
+        `on_token(tok)` (optional) fires for each as it arrives, before
+        the generation finishes.  Returns the final record:
+        ``{"output_ids": np.int32 array, "tokens": [...],
+        "finish_reason": ..., "request_id": ...}``.
+
+        Retry discipline (ISSUE 7): ONE request identity is minted
+        BEFORE the retry loop — a 429/503 shed retries under the same
+        `X-Request-Id` (honoring Retry-After, capped), so server spans
+        and the engine's sequence correlate every attempt.  Sheds can
+        only happen before the stream starts (the status line is the
+        admission decision), so retrying never replays tokens."""
+        import urllib.error
+        import urllib.request
+
+        body = {"input_ids": [int(x) for x in
+                              np.asarray(input_ids).reshape(-1)],
+                "max_new_tokens": int(max_new_tokens)}
+        if eos_token_id is not None:
+            body["eos_token_id"] = int(eos_token_id)
+        data = json.dumps(body).encode()
+        amb = _rtrace.current()
+        ctx = amb.child() if amb is not None else _rtrace.new_context()
+        headers = {"Content-Type": "application/json"}
+        headers.update(ctx.to_headers())
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                self.address + "/generate", data=data, headers=headers)
+            sp = _trace.begin("client.generate", cat="client",
+                             attempt=attempt, **ctx.trace_args())
+            t0 = time.perf_counter()
+            status = "error"
+            retry_wait = None
+            final = None
+            try:
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout) as r:
+                        tokens = []
+                        for line in r:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            evt = json.loads(line)
+                            if evt.get("done"):
+                                final = evt
+                                break
+                            tokens.append(int(evt["token"]))
+                            if on_token is not None:
+                                on_token(int(evt["token"]))
+                    if final is None:
+                        raise RuntimeError(
+                            "generate stream ended without a final "
+                            "record (server cancelled?)")
+                    status = "ok"
+                except urllib.error.HTTPError as e:
+                    if e.code in (429, 503) and attempt < self.retries:
+                        status = "shed_retry"
+                        retry_wait = self._retry_wait(e.headers)
+                    else:
+                        raise
+            finally:
+                if sp is not None:
+                    sp.args["status"] = status
+                _trace.end(sp)
+                _metrics.observe("client.request_ms",
+                                 (time.perf_counter() - t0) * 1e3,
+                                 status=status)
+                _metrics.inc("client.requests", status=status)
+            if retry_wait is not None:
+                self.sleep(retry_wait)
+                continue
+            return {
+                "output_ids": np.asarray(final["output_ids"], np.int32),
+                "tokens": tokens,
+                "finish_reason": final.get("finish_reason"),
+                "request_id": final.get("request_id"),
+            }
 
     def predict(self, *arrays, **named) -> dict:
         import urllib.error

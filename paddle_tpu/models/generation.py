@@ -46,12 +46,21 @@ def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
     fresh K/V (with pad columns masked); decode (S == 1) reads the cache
     through the Pallas `decode_attention` kernel (grouped queries per KV
     head), masked to attn_start <= j <= cache_pos.
-    Returns (out [B, S, Hq, D], (k_buf, v_buf))."""
+    Returns (out [B, S, Hq, D], (k_buf, v_buf)).
+
+    Paged tier (inference/engine): a 3-tuple kv_cache
+    ``(k_pages, v_pages, page_table)`` with a per-row [B] cache_pos
+    vector routes to `_paged_cache_attention` — per-sequence ragged
+    positions over a shared page pool instead of the lockstep dense
+    buffers."""
     import importlib
 
     from .. import ops
     from ..core.dispatch import apply
     from ..nn import functional as F
+
+    if isinstance(kv_cache, (tuple, list)) and len(kv_cache) == 3:
+        return _paged_cache_attention(q, k, v, kv_cache, cache_pos)
 
     DA = importlib.import_module("paddle_tpu.ops.pallas.decode_attention")
 
@@ -96,6 +105,72 @@ def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True, dropout_p=0.0, training=False)
     return out, (kb, vb)
+
+
+def _paged_cache_attention(q, k, v, kv_cache, cache_pos):
+    """Paged decode attention (inference/engine tier).
+
+    q: [B, 1, Hq, D]; k/v: [B, 1, Hkv, D]; kv_cache:
+    ``(k_pages, v_pages, page_table)`` Tensors — pools
+    [num_pages, Hkv, page_size, D] shared across sequences, page_table
+    [B, P] int32 (unused tail entries point at the reserved scratch
+    page 0); cache_pos: [B] int32 Tensor — each row's write index (==
+    its current length).  The current token's K/V scatters into the
+    row's live page at (page_table[b, pos//ps], pos % ps), then the
+    ragged paged-attention kernel attends 0..pos[b] per row.  Free/dead
+    batch slots ride along with pos=0 and an all-scratch page table —
+    their writes land in page 0 and their outputs are discarded by the
+    engine, so the compiled shape never changes with occupancy.
+    Returns (out [B, 1, Hq, D], (k_pages, v_pages, page_table))."""
+    import importlib
+
+    from ..core.dispatch import apply
+
+    PA = importlib.import_module("paddle_tpu.ops.pallas.paged_attention")
+
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    if s != 1:
+        raise ValueError(
+            "paged KV cache serves single-token decode steps; prefill "
+            "runs the dense path and packs into pages afterwards")
+    kp, vp, pt = kv_cache
+    ps = kp.shape[2]
+
+    def write(pool, new, pt_, pos_):
+        page_ids = pt_[jnp.arange(b), pos_ // ps]       # [B]
+        slots = pos_ % ps
+        return pool.at[page_ids, :, slots, :].set(new.astype(pool.dtype))
+
+    k1 = k.reshape([b, hkv, d])
+    v1 = v.reshape([b, hkv, d])
+    kp = apply("paged_kv_update", write, kp, k1, pt, cache_pos)
+    vp = apply("paged_kv_update", write, vp, v1, pt, cache_pos)
+
+    def attend(q1, kp_, vp_, pt_, pos_):
+        return PA.paged_attention_dispatch(q1, kp_, vp_, pt_, pos_)
+
+    out = apply("paged_attention", attend, q.reshape([b, hq, d]), kp, vp,
+                pt, cache_pos)
+    return out.reshape([b, 1, hq, d]), (kp, vp, pt)
+
+
+def decode_position_ids(cache_pos, b, s, attn_start=None):
+    """[B, S] position ids for a cached forward.  cache_pos is a scalar
+    Tensor (dense lockstep cache: every row at the same offset) or a
+    per-row [B] vector (paged ragged cache: each sequence at its own
+    length).  Applies the left-pad `shift_positions` when attn_start is
+    given.  Shared by the model families' rope/learned-position
+    branches."""
+    from .. import ops
+
+    pos = ops.arange(0, s, dtype="int32")
+    if len(cache_pos.shape) == 1:
+        position_ids = cache_pos.unsqueeze(1) + pos.unsqueeze(0)
+    else:
+        row = pos + cache_pos
+        position_ids = ops.broadcast_to(row.unsqueeze(0), [b, s])
+    return shift_positions(position_ids, attn_start)
 
 
 def shift_positions(position_ids, attn_start):

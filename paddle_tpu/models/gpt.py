@@ -97,13 +97,10 @@ class GPTAttention(nn.Layer):
                 # static-cache path: phases continue from the traced
                 # offset; left-padded rows shift so their first REAL
                 # token sits at rotary position 0
-                from .. import ops
+                from .generation import decode_position_ids
 
-                from .generation import shift_positions
-
-                row = ops.arange(0, s, dtype="int32") + cache_pos
-                position_ids = shift_positions(
-                    ops.broadcast_to(row.unsqueeze(0), [b, s]), attn_start)
+                position_ids = decode_position_ids(cache_pos, b, s,
+                                                   attn_start)
             elif cache is not None:
                 # legacy concat cache: offset is a host int
                 import numpy as _np
@@ -216,17 +213,14 @@ class GPTModel(nn.Layer):
 
         x = self.wte(input_ids)
         if not self.cfg.use_rope:
-            pos = ops.arange(0, input_ids.shape[1], dtype="int32")
             if kv_caches is not None:
-                from .generation import shift_positions
+                from .generation import decode_position_ids
 
-                pos = pos + cache_pos
-                if attn_start is not None:
-                    pos = shift_positions(
-                        ops.broadcast_to(
-                            pos.unsqueeze(0),
-                            [input_ids.shape[0], input_ids.shape[1]]),
-                        attn_start)
+                pos = decode_position_ids(
+                    cache_pos, input_ids.shape[0], input_ids.shape[1],
+                    attn_start)
+            else:
+                pos = ops.arange(0, input_ids.shape[1], dtype="int32")
             x = x + self.wpe(pos)
         x = self.drop(x)
         if kv_caches is not None:

@@ -83,11 +83,10 @@ class LlamaAttention(nn.Layer):
             # static-cache decode: phases continue from the traced offset;
             # left-padded rows start rotary position 0 at their first
             # real token
-            from .generation import shift_positions
+            from .generation import decode_position_ids
 
-            row = ops.arange(0, s, dtype="int32") + cache_pos
-            position_ids = shift_positions(
-                ops.broadcast_to(row.unsqueeze(0), [b, s]), attn_start)
+            position_ids = decode_position_ids(cache_pos, b, s,
+                                               attn_start)
         elif cache is not None:
             # legacy concat cache: offset is a host int
             import numpy as _np

@@ -87,13 +87,24 @@ _SCHEMA_COUNTERS = tuple(
        for s in ("ok", "client_error", "shed", "timeout", "error")]
     + [("client.requests", {"status": s})
        for s in ("ok", "shed_retry", "error")]
+    # continuous-batching engine (ISSUE 8): sequence lifecycle events,
+    # accepted tokens, and the paged-attention dispatch tier — a fresh
+    # engine reports zeros instead of omitting the keys
+    + [("engine.sequences", {"event": e})
+       for e in ("submitted", "admitted", "completed", "cancelled",
+                 "evicted")]
+    + [("engine.tokens", {})]
+    + [("paged.dispatch", {"tier": t}) for t in ("pallas", "fallback")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
 # present in a snapshot (a server that never saw traffic still reports
 # inflight=0 rather than omitting the key).
 _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
-                  "serving.admission_limit")
+                  "serving.admission_limit",
+                  # engine state (ISSUE 8): live batch + page pool
+                  "engine.active_sequences", "engine.waiting_sequences",
+                  "engine.batch_occupancy", "engine.page_utilization")
 
 
 def attach(crash_hook: bool = True):

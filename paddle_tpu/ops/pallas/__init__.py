@@ -20,3 +20,9 @@ from .decode_attention import (  # noqa: F401
     decode_attention,
     decode_attention_available,
 )
+from .paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_available,
+    paged_attention_dispatch,
+    paged_attention_reference,
+)

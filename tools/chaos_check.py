@@ -20,6 +20,12 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
     step must write a checkpoint that passes `verify_checkpoint()`,
     exit via `TrainingPreempted`, and a fresh step must resume from it
     and train on to a finite loss.
+  * `engine`: the continuous-batching engine under abandonment —
+    sequences cancelled mid-decode, a client killed mid-stream, and a
+    burst past admission capacity.  Every freed page must return to
+    the pool (no leak), surviving sequences' outputs must be
+    bit-identical to an uninterrupted run, and the sheds must surface
+    in the SLO report under their reason labels.
 
 Exit 0 = recovered; exit 1 = a reflex failed.  CI runs this alongside
 the `chaos`-marked pytest matrix (kept out of tier-1 — see pytest.ini).
@@ -350,10 +356,184 @@ def run_preemption(steps=12, seed=0, preempt_at=5, root=None):
     return report
 
 
+def _build_engine_model(seed=0):
+    import paddle_tpu as P
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(seed)
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=96)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10):
+    """Engine chaos: cancel/abandon sequences mid-decode, kill a client
+    mid-stream, and shed past saturation.  `recovered` means: zero page
+    leak after every scenario, survivors bit-identical to an
+    uninterrupted run, the mid-stream kill actually cancelled its
+    sequence, and the sheds are visible in the SLO report under known
+    reason labels."""
+    import http.client
+    import threading
+    import time
+    import urllib.error
+
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+    from paddle_tpu.observability import metrics
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    model = _build_engine_model(seed)
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(0, 256, (3 + (i * 5) % 17,)).astype(np.int32)
+               for i in range(n_seqs)]
+    ecfg = dict(page_size=8, max_slots=4, decode_chunk=2, max_seq_len=96)
+
+    # 1. uninterrupted reference run
+    ref_engine = InferenceEngine(model, EngineConfig(**ecfg))
+    refs = ref_engine.generate(prompts, max_new_tokens=new_tokens)
+    ref_leak = ref_engine.pool.used_pages
+
+    # 2. cancel/abandon mid-decode: same prompts, fresh engine; after a
+    # few steps cancel three — two running, one (usually) still waiting
+    eng = InferenceEngine(model, EngineConfig(**ecfg))
+    handles = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+    for _ in range(3):
+        eng.step()
+    cancel_ids = [handles[1].request_id, handles[2].request_id,
+                  handles[n_seqs - 1].request_id]
+    for rid in cancel_ids:
+        eng.cancel(rid)
+    idle = 0
+    while any(not h.done.is_set() for h in handles) and idle < 2000:
+        idle = idle if eng.step() else idle + 1
+    survivors_ok = all(
+        np.array_equal(h.result(timeout=1.0), refs[i])
+        for i, h in enumerate(handles)
+        if h.request_id not in cancel_ids)
+    cancelled_ok = all(handles[i].cancelled or
+                       handles[i].done.is_set()
+                       for i in (1, 2, n_seqs - 1))
+    cancel_leak = eng.pool.used_pages
+
+    # 3. kill a client mid-stream over HTTP: the server must cancel the
+    # sequence and reclaim its pages while a polite client completes
+    srv_engine = InferenceEngine(model, EngineConfig(**ecfg))
+    srv = InferenceServer(engine=srv_engine, request_timeout=60.0,
+                          queue_depth=0).start()
+    host, port = srv._httpd.server_address[:2]
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    body = json.dumps({"input_ids": [int(x) for x in prompts[0]],
+                       "max_new_tokens": 80})
+    # baseline BEFORE the kill: scenario 2's explicit cancels already
+    # incremented the global counter, and the assertion below must see
+    # a NEW cancellation, not theirs
+    cancelled_before = metrics.snapshot()["counters"].get(
+        "engine.sequences{event=cancelled}", 0)
+    conn.request("POST", "/generate", body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    first_line = resp.fp.readline()           # stream is live
+    resp.close()                              # client dies mid-stream
+    conn.close()
+    # a well-behaved client rides alongside and must be unaffected
+    cli = InferenceClient(srv.address, timeout=60.0, retries=0)
+    polite = cli.generate(prompts[1], max_new_tokens=new_tokens)
+    polite_ok = np.array_equal(polite["output_ids"], refs[1])
+    # wait for the server to notice the dead socket and cancel
+    deadline = time.time() + 30.0
+    kill_cancelled = False
+    while time.time() < deadline:
+        snap = metrics.snapshot()["counters"]
+        if snap.get("engine.sequences{event=cancelled}",
+                    0) > cancelled_before and \
+                srv_engine.pool.used_pages == 0:
+            kill_cancelled = True
+            break
+        time.sleep(0.1)
+    stream_leak = srv_engine.pool.used_pages
+
+    # 4. shed past true saturation: more concurrent streams than
+    # slots + queue — the excess must shed 429 and land in the SLO
+    # report under its reason label
+    results = []
+    lock = threading.Lock()
+
+    def one(i):
+        c = InferenceClient(srv.address, timeout=60.0, retries=0)
+        try:
+            r = c.generate(prompts[i % len(prompts)],
+                           max_new_tokens=new_tokens)
+            row = ("ok", r["finish_reason"])
+        except urllib.error.HTTPError as e:
+            row = ("shed" if e.code in (429, 503) else "error", e.code)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            row = ("error", type(e).__name__)
+        with lock:
+            results.append(row)
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    slo_report = srv.slo.report(publish_gauges=False)
+    drained = srv.shutdown()
+    final_leak = srv_engine.pool.used_pages
+    snap = metrics.snapshot()["counters"]
+    obs.detach()
+
+    ok_n = sum(1 for r in results if r[0] == "ok")
+    shed_n = sum(1 for r in results if r[0] == "shed")
+    err_n = sum(1 for r in results if r[0] == "error")
+    slo_ep = slo_report.get("endpoints", {}).get("generate", {})
+    slo_shed_reasons = {
+        k.split(":", 1)[1]: v
+        for k, v in slo_ep.get("errors_by_reason", {}).items()
+        if k.startswith("shed:")}
+    report = {
+        "scenario": "engine",
+        "sequences": n_seqs,
+        "ref_page_leak": ref_leak,
+        "survivors_bit_identical": bool(survivors_ok),
+        "cancelled_resolved": bool(cancelled_ok),
+        "cancel_page_leak": cancel_leak,
+        "stream_kill_cancelled": bool(kill_cancelled),
+        "stream_kill_first_line": bool(first_line),
+        "stream_page_leak": stream_leak,
+        "polite_client_ok": bool(polite_ok),
+        "burst_ok": ok_n,
+        "burst_shed": shed_n,
+        "burst_errors": err_n,
+        "slo_shed_reasons": slo_shed_reasons,
+        "cancelled_counter": snap.get(
+            "engine.sequences{event=cancelled}", 0),
+        "drained": bool(drained),
+        "final_page_leak": final_leak,
+        "recovered": (
+            ref_leak == 0 and cancel_leak == 0 and stream_leak == 0
+            and final_leak == 0 and bool(survivors_ok)
+            and bool(cancelled_ok) and bool(kill_cancelled)
+            and bool(first_line) and bool(polite_ok)
+            and err_n == 0 and ok_n > 0 and shed_n > 0
+            and sum(slo_shed_reasons.values()) >= shed_n
+            and all(k in ("queue_full", "deadline", "draining")
+                    for k in slo_shed_reasons)),
+    }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario",
-                    choices=("train", "overload", "preemption"),
+                    choices=("train", "overload", "preemption", "engine"),
                     default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
@@ -363,6 +543,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.scenario == "overload":
         report = run_overload(seed=args.seed)
+    elif args.scenario == "engine":
+        report = run_engine_chaos(seed=args.seed)
     elif args.scenario == "preemption":
         report = run_preemption(steps=min(args.steps, 12), seed=args.seed)
     else:

@@ -206,7 +206,8 @@ def main(argv=None) -> int:
                          "(adds the op-table sweep — slow tier)")
     ap.add_argument("--perf-programs", default=None,
                     help="comma list among train_step,decode_step,"
-                         "call_sites,op_table (overrides the subset)")
+                         "paged_decode_step,call_sites,op_table "
+                         "(overrides the subset)")
     ap.add_argument("--update-budget", action="store_true",
                     help="rewrite tools/perf_budget.json from a full "
                          "perf audit")
