@@ -1,0 +1,1074 @@
+"""Admission-aware HTTP router over a fleet of InferenceServer replicas.
+
+One process cannot serve millions of users: PR 8's continuous-batching
+engine still sat behind a single `InferenceServer`, so one preemption
+took the whole serving plane down.  The `Router` is the deployment
+story (ROADMAP item 5): N replicas — typically launched and supervised
+by `inference.fleet.ReplicaFleet`, one per chip slice — behind a thin
+stdlib HTTP proxy that routes on the *admission signals the replicas
+already export* and survives replicas dying under it.
+
+Routing (docs/SERVING.md):
+  * **least-loaded pick** — a probe loop polls every replica's
+    `GET /ready` (which now carries `inflight`/`queued`/
+    `admission_limit` and the engine's `batch_occupancy`/
+    `waiting_sequences`, ISSUE 9 satellite); `/predict` goes to the
+    replica with the lowest (inflight+queued)/limit, `/generate` to the
+    emptiest decode engine.  Router-side in-flight counts are added so
+    bursts between probes don't herd onto one replica.
+  * **failover** — a replica that dies mid-request (connection error),
+    trips its `CircuitBreaker` (resilience.retry reuse), or misses
+    `heartbeat_miss_k` heartbeats is skipped/ejected; in-flight
+    non-streamed requests transparently retry on a healthy replica
+    under the SAME `X-Request-Id` (ISSUE 7 discipline).  Streamed
+    `/generate` requests fail over only while ZERO tokens have been
+    delivered; after that the client gets one clean `interrupted`
+    record carrying the resumable `output_ids` prefix — never replayed
+    tokens (`InferenceClient` raises `StreamInterrupted`).
+  * **drain-aware** — `mark_draining()` stops routing BEFORE the
+    replica's own drain begins (the fleet calls it ahead of SIGTERM, so
+    clients never see a thundering herd of 503s); a replica whose
+    readiness reports `draining` is likewise taken out of rotation.
+  * **edge admission** — ONE fleet-level `AdmissionController` (its
+    capacity tracks the live sum of routable replica limits via
+    `set_capacity`) sheds once, at the edge, with an honest
+    `Retry-After`; `no_replicas` sheds map to 503.
+
+Telemetry: `router.replicas{state=up|draining|ejected|down}` gauges,
+`router.failovers` / `router.ejections` / `router.readmissions` and
+`router.requests{endpoint,status}` counters (attach() schema), and
+`router.request`/`router.forward` spans carrying request identity.
+Fault point `router.forward` fires per forward attempt (chaos).
+
+Env knobs (read when the matching ctor arg is None):
+  PADDLE_TPU_HEARTBEAT_MISS_K   probes/beats missed before ejection (3)
+  PADDLE_TPU_FAILOVER_RETRIES   extra replicas tried per request    (2)
+
+Transport and clock are injectable — unit tests drive the whole state
+machine with fake replicas and no sockets (tests/test_router.py).
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..observability import metrics as _metrics
+from ..observability import request_trace as _rtrace
+from ..observability import trace as _trace
+from ..resilience.overload import AdmissionController, ShedError, _env_num
+from ..resilience.retry import CircuitBreaker, CircuitOpenError
+from .serving import _retry_after_header
+
+__all__ = ["Router", "HTTPTransport", "ReplicaUnreachable"]
+
+_REPLICA_STATES = ("up", "draining", "ejected", "down")
+
+
+class ReplicaUnreachable(ConnectionError):
+    """Transport-level failure talking to a replica (refused, reset,
+    premature EOF): the failover trigger, as opposed to an HTTP status
+    the replica deliberately sent."""
+
+
+class _HTTPStream:
+    """One open streamed response off a replica: status + headers up
+    front, then an ndjson line iterator.  `close()` is idempotent and
+    tears the TCP connection down (a client abandoning the proxy stream
+    propagates as a dead socket the replica can notice)."""
+
+    def __init__(self, conn, resp):
+        self._conn = conn
+        self._resp = resp
+        self.status = resp.status
+        self.headers = dict(resp.headers)
+
+    def lines(self):
+        for line in self._resp:
+            yield line
+
+    def read_body(self):
+        return self._resp.read()
+
+    def close(self):
+        try:
+            self._conn.close()
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (teardown best-effort: the socket may
+            # already be gone — that is often WHY we are closing)
+
+
+class HTTPTransport:
+    """Default transport: stdlib http.client.  Connection-level
+    failures (refused/reset/timeout on connect, dead socket mid-read)
+    raise `ReplicaUnreachable`; HTTP statuses — including 4xx/5xx — are
+    returned, not raised (the router decides what they mean)."""
+
+    def _connect(self, address, timeout):
+        u = urllib.parse.urlparse(address)
+        return http.client.HTTPConnection(u.hostname, u.port,
+                                          timeout=timeout)
+
+    def request(self, address, method, path, body=None, headers=None,
+                timeout=30.0):
+        """Buffered exchange: returns (status, headers dict, body bytes)."""
+        conn = self._connect(address, timeout)
+        try:
+            conn.request(method, path, body=body,
+                         headers=dict(headers or {}))
+            resp = conn.getresponse()
+            return resp.status, dict(resp.headers), resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise ReplicaUnreachable(
+                f"{address}{path}: {type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+
+    def stream(self, address, path, body, headers=None, timeout=30.0):
+        """Open a streamed POST; returns an `_HTTPStream` (caller owns
+        `close()`).  Only the CONNECT + status-line phase raises
+        `ReplicaUnreachable` here — mid-stream failures surface from
+        the line iterator as OSError/HTTPException for the caller to
+        classify against how much was already delivered."""
+        conn = self._connect(address, timeout)
+        try:
+            conn.request("POST", path, body=body,
+                         headers=dict(headers or {}))
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise ReplicaUnreachable(
+                f"{address}{path}: {type(e).__name__}: {e}") from e
+        return _HTTPStream(conn, resp)
+
+
+class _Replica:
+    """Router-side view of one replica.  All mutable fields are guarded
+    by the Router's `_lock` (single coarse lock: the table is small and
+    every transition must be atomic against the probe loop)."""
+
+    __slots__ = ("id", "address", "breaker", "state", "signals",
+                 "missed_heartbeats", "probe_failures", "inflight",
+                 "generation", "draining_requested", "ever_up",
+                 "ever_beat")
+
+    def __init__(self, rid, address, breaker):
+        self.id = str(rid)
+        self.address = str(address)
+        self.breaker = breaker
+        self.state = "down"          # probe promotes to "up"
+        self.signals = {}            # last /ready payload
+        self.missed_heartbeats = 0
+        self.probe_failures = 0
+        self.inflight = {"predict": 0, "generate": 0}
+        self.generation = 0
+        self.draining_requested = False
+        self.ever_up = False         # first admission ≠ re-admission
+        self.ever_beat = False       # heartbeats govern only after one
+
+    def view(self):  # pt-lint: ok[PT102] (caller holds Router._lock)
+        sig = self.signals
+        return {
+            "id": self.id, "address": self.address, "state": self.state,
+            "breaker": self.breaker.state,
+            "missed_heartbeats": self.missed_heartbeats,
+            "probe_failures": self.probe_failures,
+            "inflight": dict(self.inflight),
+            "generation": self.generation,
+            "signals": {k: sig.get(k) for k in
+                        ("inflight", "queued", "admission_limit",
+                         "engine") if k in sig},
+        }
+
+
+class Router:
+    """Admission-aware reverse proxy over a replica fleet.  See the
+    module docstring for semantics; `start()` returns immediately
+    (daemon threads: HTTP accept loop + readiness/heartbeat probe
+    loop), `shutdown()` drains the edge controller and closes the
+    socket — replica lifecycle belongs to `ReplicaFleet`, not here."""
+
+    def __init__(self, host="127.0.0.1", port=0, replicas=None,
+                 heartbeat_miss_k=None, failover_retries=None,
+                 probe_interval=0.25, request_timeout=30.0,
+                 max_inflight=None, queue_depth=None, transport=None,
+                 heartbeats=None, clock=time.monotonic,
+                 breaker_threshold=3, breaker_reset=2.0):
+        if heartbeat_miss_k is None:
+            heartbeat_miss_k = _env_num("PADDLE_TPU_HEARTBEAT_MISS_K",
+                                        3, int)
+        if failover_retries is None:
+            failover_retries = _env_num("PADDLE_TPU_FAILOVER_RETRIES",
+                                        2, int)
+        self.heartbeat_miss_k = max(1, int(heartbeat_miss_k))
+        self.failover_retries = max(0, int(failover_retries))
+        self.probe_interval = float(probe_interval)
+        self.request_timeout = (None if request_timeout is None
+                                else float(request_timeout))
+        self.transport = transport or HTTPTransport()
+        self.heartbeats = heartbeats  # callable -> iterable of live ids
+        self.clock = clock
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_reset = float(breaker_reset)
+        self._lock = threading.Lock()
+        self._replicas: dict = {}     # rid -> _Replica (under _lock)
+        # ONE fleet-level edge controller per endpoint class: shedding
+        # happens once, here, with an honest Retry-After — capacities
+        # re-track the live routable fleet on every probe pass
+        self.admission = AdmissionController(
+            max_inflight=max_inflight, queue_depth=queue_depth,
+            name="router")
+        self.gen_admission = AdmissionController(
+            max_inflight=max_inflight, queue_depth=queue_depth,
+            name="router.generate")
+        for rid, address in dict(replicas or {}).items():
+            self.add_replica(rid, address)
+        self._probe_stop = threading.Event()
+        self._probe_thread = None
+        self._serving = False
+        self._shutdown_lock = threading.Lock()
+        self._shutdown_done = False
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            _rt_ctx = None
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code, obj, headers=()):
+                body = json.dumps(obj, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if self._rt_ctx is not None:
+                    self.send_header("X-Request-Id",
+                                     self._rt_ctx.request_id)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._json(200, {
+                        "status": "ok", "role": "router",
+                        "replicas": router.replica_summary()})
+                if self.path == "/ready":
+                    ready, reason = router.readiness()
+                    body = {"status": "ready" if ready else "not_ready",
+                            "reason": reason,
+                            "routable": router.routable_count()}
+                    body.update(router.admission.stats())
+                    return self._json(200 if ready else 503, body)
+                if self.path == "/replicas":
+                    return self._json(200, {
+                        "replicas": router.replica_views()})
+                if self.path == "/metrics":
+                    try:
+                        text = _metrics.to_prometheus()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if self.path == "/debug/telemetry":
+                    try:
+                        snap = router.telemetry_snapshot()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, snap)
+                return self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path not in ("/predict", "/generate"):
+                    return self._json(404, {"error": "unknown path"})
+                ctx = _rtrace.continue_from_headers(self.headers)
+                self._rt_ctx = ctx
+                with _rtrace.activate(ctx):
+                    if self.path == "/predict":
+                        self._route_predict(ctx)
+                    else:
+                        self._route_generate(ctx)
+
+            # --- /predict: buffered forward with transparent failover --
+            def _route_predict(self, ctx):
+                t_req = time.perf_counter()
+                sp = _trace.begin("router.request", cat="router",
+                                  endpoint="predict", **ctx.trace_args())
+                status = "error"
+                ticket = None
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    deadline = router._deadline()
+                    try:
+                        ticket = router.admission.admit(deadline=deadline)
+                    except ShedError as e:
+                        status = "shed"
+                        return self._json(
+                            e.http_status,
+                            {"error": str(e), "reason": e.reason},
+                            headers=[("Retry-After",
+                                      _retry_after_header(e.retry_after))])
+                    try:
+                        code, hdrs, data, rid = router.forward_predict(
+                            body, ctx,
+                            content_type=self.headers.get(
+                                "Content-Type",
+                                "application/octet-stream"))
+                    except ShedError as e:
+                        status = "shed"
+                        return self._json(
+                            e.http_status,
+                            {"error": str(e), "reason": e.reason},
+                            headers=[("Retry-After",
+                                      _retry_after_header(e.retry_after))])
+                    except Exception as e:
+                        # a router bug must still answer the client
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    if sp is not None:
+                        sp.args["replica"] = rid
+                    status = ("ok" if code == 200 else
+                              "client_error" if code == 400 else
+                              "shed" if code in (429, 503) else "error")
+                    self.send_response(code)
+                    self.send_header(
+                        "Content-Type",
+                        hdrs.get("Content-Type",
+                                 "application/octet-stream"))
+                    self.send_header("Content-Length", str(len(data)))
+                    self.send_header("X-Request-Id", ctx.request_id)
+                    if "Retry-After" in hdrs:
+                        self.send_header("Retry-After",
+                                         hdrs["Retry-After"])
+                    self.end_headers()
+                    self.wfile.write(data)
+                finally:
+                    if ticket is not None:
+                        ticket.release(ok=status == "ok")
+                    router._finish_request("predict", status, sp, t_req)
+
+            # --- /generate: streamed forward -------------------------
+            def _route_generate(self, ctx):
+                t_req = time.perf_counter()
+                sp = _trace.begin("router.request", cat="router",
+                                  endpoint="generate", **ctx.trace_args())
+                status = "error"
+                ticket = None
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n)
+                    try:
+                        prompt = [int(x) for x in
+                                  json.loads(body or b"{}")
+                                  .get("input_ids", [])]
+                    except Exception:
+                        prompt = []  # replica will 400 it; no prefix
+                    deadline = router._deadline()
+                    try:
+                        ticket = router.gen_admission.admit(
+                            deadline=deadline)
+                    except ShedError as e:
+                        status = "shed"
+                        return self._json(
+                            e.http_status,
+                            {"error": str(e), "reason": e.reason},
+                            headers=[("Retry-After",
+                                      _retry_after_header(e.retry_after))])
+                    try:
+                        status = router.forward_generate(
+                            body, prompt, ctx, self)
+                    except Exception as e:
+                        # best effort: before any stream bytes this is
+                        # a clean 500; afterwards the socket just
+                        # closes (the client's parser notices the
+                        # missing final record)
+                        status = "error"
+                        try:
+                            self._json(500, {"error":
+                                             f"{type(e).__name__}: {e}"})
+                        except Exception:  # pt-lint: ok[PT005]
+                            pass  # headers already sent mid-stream
+                finally:
+                    if ticket is not None:
+                        ticket.release(ok=status == "ok")
+                    router._finish_request("generate", status, sp, t_req)
+
+        self._httpd = _RouterHTTPServer((host, port), Handler)
+        self._thread = None
+
+    # ------------------------------------------------------------------
+    # membership (the fleet drives these; also usable standalone)
+    # ------------------------------------------------------------------
+    def add_replica(self, rid, address):
+        """Register a replica.  It starts `down` and enters rotation
+        when the probe loop sees it ready (a just-launched replica must
+        pass readiness before traffic, ISSUE 9 (c))."""
+        breaker = CircuitBreaker(
+            failure_threshold=self._breaker_threshold,
+            reset_timeout=self._breaker_reset, clock=self.clock,
+            name=f"router.{rid}")
+        with self._lock:
+            self._replicas[str(rid)] = _Replica(rid, address, breaker)
+        self._note("router.replica_added", replica=str(rid),
+                   address=str(address))
+        self._publish_state_gauges()
+        return self
+
+    def update_replica(self, rid, address):
+        """Point `rid` at a relaunched process (new address).  State
+        resets to `down`; the probe loop re-admits it after readiness
+        passes, counting a `router.readmissions`."""
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+        if rep is None:
+            return self.add_replica(rid, address)
+        with self._lock:
+            rep.address = str(address)
+            rep.state = "down"
+            rep.signals = {}
+            rep.missed_heartbeats = 0
+            rep.probe_failures = 0
+            rep.generation += 1
+            rep.draining_requested = False
+            rep.ever_beat = False  # the new process must beat before
+            # heartbeat absence can count against it again
+            rep.breaker.record_success()  # fresh process, fresh slate
+        self._note("router.replica_relaunched", replica=str(rid),
+                   address=str(address))
+        self._publish_state_gauges()
+        return self
+
+    def remove_replica(self, rid):
+        with self._lock:
+            self._replicas.pop(str(rid), None)
+        self._publish_state_gauges()
+
+    def mark_draining(self, rid):
+        """Take `rid` out of rotation NOW — the fleet calls this BEFORE
+        delivering SIGTERM, so by the time the replica's own
+        `PreemptionGuard` flips it to draining no new traffic is headed
+        there (no thundering 503s, ISSUE 9 (c))."""
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is None:
+                return False
+            rep.draining_requested = True
+            if rep.state == "up":
+                rep.state = "draining"
+        self._note("router.replica_draining", replica=str(rid))
+        self._publish_state_gauges()
+        return True
+
+    def note_replica_down(self, rid):
+        """Immediate death notice (the fleet saw the process exit):
+        faster than waiting out K missed heartbeats."""
+        ejected = False
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            if rep is None:
+                return False
+            if rep.state not in ("down", "ejected"):
+                ejected = rep.state != "draining"
+                rep.state = "down"
+        if ejected:
+            _metrics.inc("router.ejections")
+            self._note("router.replica_down", replica=str(rid))
+        self._publish_state_gauges()
+        return True
+
+    def inflight_to(self, rid):
+        """Router-side in-flight request count toward one replica (the
+        fleet waits for this to hit 0 before SIGTERMing a drained
+        replica)."""
+        with self._lock:
+            rep = self._replicas.get(str(rid))
+            return sum(rep.inflight.values()) if rep is not None else 0
+
+    def replica_views(self):
+        with self._lock:
+            return [r.view() for r in self._replicas.values()]
+
+    def replica_summary(self):
+        with self._lock:
+            return {r.id: r.state for r in self._replicas.values()}
+
+    def routable_count(self):
+        with self._lock:
+            return len(self._routable_locked())
+
+    def readiness(self):
+        if self.admission.draining:
+            return False, "draining"
+        if self.routable_count() == 0:
+            return False, "no_replicas"
+        return True, "ok"
+
+    # ------------------------------------------------------------------
+    # probe loop: readiness signals, heartbeats, state transitions
+    # ------------------------------------------------------------------
+    def probe_once(self):
+        """One probe pass (the loop body; tests call it directly with a
+        fake transport/heartbeat source).  Readiness probes every
+        replica, folds in the heartbeat view, applies state
+        transitions, republishes gauges, and re-tracks the edge
+        admission capacities."""
+        alive = None
+        if self.heartbeats is not None:
+            try:
+                alive = {str(r) for r in self.heartbeats()}
+            except Exception as e:  # pt-lint: ok[PT005]
+                alive = None  # a broken heartbeat source must not
+                # eject the whole fleet — fall back to probe-only
+                # liveness for this pass (and leave a trace of it)
+                self._note("router.heartbeat_source_error",
+                           error=f"{type(e).__name__}: {e}")
+        with self._lock:
+            targets = [(r.id, r.address, r.generation)
+                       for r in self._replicas.values()]
+        for rid, address, gen in targets:
+            ok, payload = self._probe_replica(address)
+            self._apply_probe(rid, gen, ok, payload, alive)
+        self._publish_state_gauges()
+        self._retrack_capacity()
+
+    def _probe_replica(self, address):
+        try:
+            code, _hdrs, body = self.transport.request(
+                address, "GET", "/ready", timeout=max(
+                    1.0, self.probe_interval * 4))
+            try:
+                payload = json.loads(body or b"{}")
+            except ValueError:
+                payload = {}
+            payload["_ready"] = code == 200
+            return True, payload
+        except Exception:
+            return False, None
+
+    def _apply_probe(self, rid, gen, ok, payload, alive):
+        readmitted = ejected = None
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or rep.generation != gen:
+                return  # relaunched mid-probe: stale result
+            if ok:
+                rep.probe_failures = 0
+                rep.signals = payload
+            else:
+                rep.probe_failures += 1
+            if alive is not None:
+                if rid in alive:
+                    rep.ever_beat = True
+                    rep.missed_heartbeats = 0
+                elif rep.ever_beat:
+                    rep.missed_heartbeats += 1
+                # never beat: this replica's heartbeat plane never came
+                # up (fleet degrades it to probe-only liveness) — its
+                # absence from `alive` is not evidence of death, and
+                # counting it would brick a perfectly ready replica
+            misses = max(rep.missed_heartbeats, rep.probe_failures)
+            if rep.state in ("up", "draining"):
+                if misses >= self.heartbeat_miss_k:
+                    # deliberate drains exit quietly; anything else
+                    # is an ejection (it held traffic until now)
+                    ejected = not rep.draining_requested
+                    rep.state = "ejected" if ejected else "down"
+                elif ok and not payload.get("_ready") and \
+                        str(payload.get("reason")) == "draining":
+                    rep.state = "draining"
+                elif rep.state == "draining" and ok \
+                        and payload.get("_ready") \
+                        and not rep.draining_requested:
+                    # the replica's drain was observed, not requested
+                    # by the fleet, and its readiness recovered: back
+                    # into rotation (a fleet-requested drain sticks
+                    # until SIGTERM/exit — flipping back would race
+                    # the drain ordering)
+                    rep.state = "up"
+            elif rep.state in ("down", "ejected")  \
+                    and ok and payload.get("_ready") and misses == 0:
+                # first-ever admission is just startup; anything after
+                # the replica has held traffic (or been relaunched) is
+                # a re-admission worth counting
+                if rep.ever_up:
+                    readmitted = rep.state
+                rep.state = "up"
+                rep.ever_up = True
+                rep.draining_requested = False
+                rep.breaker.record_success()
+        if ejected:
+            _metrics.inc("router.ejections")
+            self._note("router.replica_ejected", replica=rid)
+        elif ejected is False:
+            self._note("router.replica_drained_out", replica=rid)
+        if readmitted is not None:
+            _metrics.inc("router.readmissions")
+            self._note("router.replica_readmitted", replica=rid,
+                       was=readmitted)
+
+    def _probe_loop(self):
+        while not self._probe_stop.wait(self.probe_interval):
+            try:
+                self.probe_once()
+            except Exception as e:  # pt-lint: ok[PT005]
+                # the probe loop is the router's heart — one bad pass
+                # (a replica racing teardown, a malformed payload) must
+                # not stop all future probing.  Leave evidence.
+                self._note("router.probe_error",
+                           error=f"{type(e).__name__}: {e}")
+
+    def _retrack_capacity(self):
+        """Edge admission capacity = what the routable fleet can
+        actually run concurrently right now."""
+        predict_cap = 0
+        gen_cap = 0
+        with self._lock:
+            for rid in self._routable_locked():
+                sig = self._replicas[rid].signals
+                predict_cap += int(sig.get("admission_limit")
+                                   or sig.get("limit") or 1)
+                eng = sig.get("engine") or {}
+                gen_cap += int(eng.get("max_slots") or 0)
+        if predict_cap > 0:
+            self.admission.set_capacity(predict_cap)
+        if gen_cap > 0:
+            self.gen_admission.set_capacity(gen_cap)
+
+    def _routable_locked(self):  # pt-lint: ok[PT102] (callers hold _lock)
+        return [rid for rid, rep in self._replicas.items()
+                if rep.state == "up"
+                and rep.signals.get("_ready", False)
+                and rep.breaker.state != "open"]
+
+    # ------------------------------------------------------------------
+    # pick + forward
+    # ------------------------------------------------------------------
+    def _pick(self, endpoint, exclude=()):
+        """Least-loaded routable replica for `endpoint`, or None.
+        Load = the replica's own admission view (stale by at most one
+        probe) plus the router's live in-flight count toward it."""
+        best, best_score = None, None
+        with self._lock:
+            for rid in self._routable_locked():
+                if rid in exclude:
+                    continue
+                rep = self._replicas[rid]
+                sig = rep.signals
+                if endpoint == "generate":
+                    eng = sig.get("engine") or {}
+                    slots = max(1, int(eng.get("max_slots") or 1))
+                    load = (float(eng.get("active_sequences") or 0)
+                            + float(eng.get("waiting_sequences") or 0)
+                            + rep.inflight["generate"]) / slots
+                else:
+                    limit = max(1, int(sig.get("admission_limit")
+                                       or sig.get("limit") or 1))
+                    load = (float(sig.get("inflight") or 0)
+                            + float(sig.get("queued") or 0)
+                            + rep.inflight["predict"]) / limit
+                if best_score is None or load < best_score:
+                    best, best_score = rid, load
+        return best
+
+    def _begin_forward(self, rid, endpoint):
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return None
+            rep.inflight[endpoint] += 1
+            return rep.address
+
+    def _end_forward(self, rid, endpoint):
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.inflight[endpoint] = max(
+                    0, rep.inflight[endpoint] - 1)
+
+    def _forward_failed(self, rid, err):
+        """Book a transport-level forward failure: feeds the breaker
+        (pick skips open breakers) and leaves a flight event.  The
+        probe loop does the actual ejection — one failed forward is a
+        failover, not a funeral."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            breaker = rep.breaker if rep is not None else None
+        if breaker is not None:
+            breaker.record_failure()
+        self._note("router.forward_failed", replica=rid,
+                   error=f"{type(err).__name__}: {err}")
+
+    def _no_replica_shed(self, last_shed):
+        """End of the failover loop with nothing served: prefer the
+        honest replica-provided shed (its Retry-After reflects real
+        queue depth); otherwise the fleet is gone — 503 no_replicas."""
+        if last_shed is not None:
+            code, hdrs, data = last_shed
+            return code, hdrs, data
+        _metrics.inc("resilience.shed_requests", reason="no_replicas")
+        self._note("router.no_replicas")
+        raise ShedError("no_replicas",
+                        retry_after=self.probe_interval
+                        * self.heartbeat_miss_k + 1.0,
+                        detail="no routable replica")
+
+    def forward_predict(self, body, ctx, content_type=None):
+        """Forward one buffered /predict: returns (status, headers,
+        body, replica_id).  Transparent failover on transport failure
+        or replica shed, always under the SAME X-Request-Id (`ctx` is
+        this hop's context; every attempt reuses its headers).  Raises
+        ShedError("no_replicas") when nothing routable remains."""
+        from ..resilience import faults as _faults
+
+        hop = ctx.child()
+        headers = {"Content-Type": content_type
+                   or "application/octet-stream"}
+        headers.update(hop.to_headers())
+        tried: set = set()
+        last_shed = None
+        attempts = self.failover_retries + 1
+        for attempt in range(attempts):
+            rid = self._pick("predict", exclude=tried)
+            if rid is None:
+                break
+            tried.add(rid)
+            address = self._begin_forward(rid, "predict")
+            if address is None:
+                continue
+            sp = _trace.begin("router.forward", cat="router",
+                              replica=rid, endpoint="predict",
+                              attempt=attempt, **ctx.trace_args())
+            try:
+                _faults.fire("router.forward", replica=rid,
+                             endpoint="predict")
+                self._breaker_allow(rid)
+                code, hdrs, data = self.transport.request(
+                    address, "POST", "/predict", body=body,
+                    headers=headers, timeout=self.request_timeout)
+            except CircuitOpenError:
+                continue
+            except Exception as e:
+                self._forward_failed(rid, e)
+                if attempt < attempts - 1:
+                    _metrics.inc("router.failovers")
+                continue
+            finally:
+                self._end_forward(rid, "predict")
+                _trace.end(sp)
+            self._breaker_success(rid)
+            if code in (429, 503):
+                # the replica is alive but shedding — its estimate was
+                # fresher than our probe; try a less-loaded one, and
+                # keep ITS Retry-After as the honest fallback answer
+                self._maybe_mark_draining(rid, data)
+                last_shed = (code, hdrs, data)
+                continue
+            return code, hdrs, data, rid
+        code, hdrs, data = self._no_replica_shed(last_shed)
+        return code, hdrs, data, None
+
+    def _maybe_mark_draining(self, rid, data):
+        try:
+            if json.loads(data or b"{}").get("reason") == "draining":
+                self.mark_draining(rid)
+        except ValueError:  # pt-lint: ok[PT005]
+            pass  # non-JSON shed body: the probe loop will notice
+
+    def _breaker_allow(self, rid):
+        with self._lock:
+            rep = self._replicas.get(rid)
+            breaker = rep.breaker if rep is not None else None
+        if breaker is not None:
+            breaker.allow()
+
+    def _breaker_success(self, rid):
+        with self._lock:
+            rep = self._replicas.get(rid)
+            breaker = rep.breaker if rep is not None else None
+        if breaker is not None:
+            breaker.record_success()
+
+    def forward_generate(self, body, prompt_ids, ctx, handler):
+        """Proxy one /generate stream to the client behind `handler`.
+
+        Failover contract (ISSUE 9 (b)): attempts rotate replicas
+        under ONE request id while ZERO token lines have been written
+        to the client; the moment one token is delivered, a replica
+        failure turns into a single clean `interrupted` record carrying
+        `output_ids` = prompt + delivered tokens (the resumable
+        prefix) — the stream NEVER replays a token.  Returns the
+        request's status label."""
+        from ..resilience import faults as _faults
+
+        hop = ctx.child()
+        headers = {"Content-Type": "application/json"}
+        headers.update(hop.to_headers())
+        tried: set = set()
+        last_shed = None
+        started = False          # client response headers sent?
+        delivered: list = []     # token values already written out
+        attempts = self.failover_retries + 1
+        for attempt in range(attempts):
+            rid = self._pick("generate", exclude=tried)
+            if rid is None:
+                break
+            tried.add(rid)
+            address = self._begin_forward(rid, "generate")
+            if address is None:
+                continue
+            sp = _trace.begin("router.forward", cat="router",
+                              replica=rid, endpoint="generate",
+                              attempt=attempt, **ctx.trace_args())
+            stream = None
+            try:
+                _faults.fire("router.forward", replica=rid,
+                             endpoint="generate")
+                self._breaker_allow(rid)
+                stream = self.transport.stream(
+                    address, "/generate", body, headers=headers,
+                    timeout=self.request_timeout)
+            except CircuitOpenError:
+                self._end_forward(rid, "generate")
+                _trace.end(sp)
+                continue
+            except Exception as e:
+                self._forward_failed(rid, e)
+                self._end_forward(rid, "generate")
+                _trace.end(sp)
+                if attempt < attempts - 1:
+                    _metrics.inc("router.failovers")
+                continue
+            try:
+                self._breaker_success(rid)  # status line arrived
+                if stream.status in (429, 503):
+                    data = stream.read_body()
+                    self._maybe_mark_draining(rid, data)
+                    last_shed = (stream.status, dict(stream.headers),
+                                 data)
+                    continue
+                if stream.status != 200:
+                    # deterministic replica answer (400 etc.): pass
+                    # through — it would fail identically anywhere
+                    data = stream.read_body()
+                    handler._json(stream.status, _safe_json(data))
+                    return ("client_error" if stream.status == 400
+                            else "error")
+                done_seen = False
+                lines = stream.lines()
+                while True:
+                    # replica-read and client-write failures MUST be
+                    # told apart (both raise OSError subclasses): a
+                    # dead replica fails over / interrupts cleanly, a
+                    # dead client cancels upstream — so the two I/O
+                    # directions get separate try blocks
+                    try:
+                        line = next(lines)
+                    except StopIteration:
+                        break
+                    except (OSError, http.client.HTTPException) as e:
+                        raise ReplicaUnreachable(
+                            f"{rid}: {type(e).__name__}: {e}") from e
+                    if not line.strip():
+                        continue
+                    try:
+                        if not started:
+                            started = True
+                            handler.send_response(200)
+                            handler.send_header(
+                                "Content-Type", "application/x-ndjson")
+                            handler.send_header("X-Request-Id",
+                                                ctx.request_id)
+                            handler.send_header("Connection", "close")
+                            handler.end_headers()
+                        handler.wfile.write(line)
+                        handler.wfile.flush()
+                    except (BrokenPipeError, ConnectionError,
+                            OSError) as e:
+                        # the CLIENT went away: closing the replica
+                        # stream (finally below) cancels the sequence
+                        self._note("router.client_disconnect",
+                                   replica=rid,
+                                   error=f"{type(e).__name__}: {e}")
+                        return "client_error"
+                    evt = _safe_json(line)
+                    if "token" in evt:
+                        delivered.append(int(evt["token"]))
+                    if evt.get("done"):
+                        done_seen = True
+                        break
+                if done_seen:
+                    return "ok"
+                # replica stream ended without a final record: the
+                # process died mid-generation (kill -9 chaos path)
+                raise ReplicaUnreachable(
+                    f"{rid}: stream ended without final record")
+            except (ReplicaUnreachable, OSError,
+                    http.client.HTTPException) as e:
+                self._forward_failed(rid, e)
+                if not delivered and not started:
+                    if attempt < attempts - 1:
+                        _metrics.inc("router.failovers")
+                    continue  # zero tokens delivered: safe to fail over
+                # tokens already delivered: one clean interrupted
+                # record with the resumable prefix, never a replay
+                final = {
+                    "interrupted": True,
+                    "error": f"replica failed mid-stream: "
+                             f"{type(e).__name__}",
+                    "finish_reason": "replica_lost",
+                    "request_id": ctx.request_id,
+                    "tokens_delivered": len(delivered),
+                    "output_ids": list(prompt_ids) + delivered,
+                }
+                try:
+                    handler.wfile.write(
+                        json.dumps(final).encode() + b"\n")
+                    handler.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):  # pt-lint: ok[PT005]
+                    pass  # client gone too: nothing left to tell it
+                self._note("router.stream_interrupted", replica=rid,
+                           delivered=len(delivered))
+                return "interrupted"
+            finally:
+                self._end_forward(rid, "generate")
+                _trace.end(sp)
+                if stream is not None:
+                    stream.close()
+        # nothing started: we can still answer with a clean status
+        try:
+            code, hdrs, data = self._no_replica_shed(last_shed)
+        except ShedError as e:
+            handler._json(e.http_status,
+                          {"error": str(e), "reason": e.reason},
+                          headers=[("Retry-After",
+                                    _retry_after_header(e.retry_after))])
+            return "shed"
+        handler._json(code, _safe_json(data),
+                      headers=[("Retry-After", hdrs["Retry-After"])]
+                      if "Retry-After" in hdrs else ())
+        return "shed"
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _deadline(self):
+        return (None if self.request_timeout is None
+                else self.clock() + self.request_timeout)
+
+    def _finish_request(self, endpoint, status, sp, t_req):
+        dt_ms = (time.perf_counter() - t_req) * 1e3
+        if sp is not None:
+            sp.args["status"] = status
+        _trace.end(sp)
+        _metrics.observe("router.request_ms", dt_ms,
+                         endpoint=endpoint, status=status)
+        _metrics.inc("router.requests", endpoint=endpoint,
+                     status=status)
+
+    def _publish_state_gauges(self):
+        counts = dict.fromkeys(_REPLICA_STATES, 0)
+        with self._lock:
+            for rep in self._replicas.values():
+                counts[rep.state] = counts.get(rep.state, 0) + 1
+        for state, n in counts.items():
+            _metrics.set_gauge("router.replicas", n, state=state)
+
+    @staticmethod
+    def _note(kind, **data):
+        try:
+            from ..observability import flight as _flight
+
+            _flight.record(kind, **data)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard: routing must
+            # route even when telemetry is broken)
+
+    def telemetry_snapshot(self):
+        import os as _os
+
+        ready, reason = self.readiness()
+        return {
+            "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "pid": _os.getpid(),
+            "role": "router",
+            "metrics": _metrics.snapshot(),
+            "admission": self.admission.stats(),
+            "gen_admission": self.gen_admission.stats(),
+            "readiness": {"ready": ready, "reason": reason},
+            "replicas": self.replica_views(),
+        }
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    @property
+    def address(self):
+        h, p = self._httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self, probe=True):
+        self._serving = True
+        if probe:
+            # one synchronous pass so capacities and readiness reflect
+            # the fleet BEFORE the first request can race the loop
+            self.probe_once()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, daemon=True,
+                name="paddle-tpu-router-probe")
+            self._probe_thread.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="paddle-tpu-router")
+        self._thread.start()
+        return self
+
+    def shutdown(self, drain_timeout=None):
+        with self._shutdown_lock:
+            first = not self._shutdown_done
+            self._shutdown_done = True
+        if not first:
+            return True
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=2)
+        drained = self.admission.drain(timeout=drain_timeout)
+        drained = self.gen_admission.drain(timeout=drain_timeout) \
+            and drained
+        if self._serving:
+            self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+        return drained
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    """Same rationale as serving._ServingHTTPServer: the stdlib backlog
+    of 5 sheds with raw TCP RSTs under bursts; shedding is the edge
+    AdmissionController's decision."""
+
+    request_queue_size = 128
+    daemon_threads = True
+
+
+def _safe_json(data):
+    try:
+        obj = json.loads(data if isinstance(data, (str, bytes))
+                         else b"{}")
+        return obj if isinstance(obj, dict) else {"body": obj}
+    except ValueError:
+        return {"body": repr(data[:200] if data else b"")}

@@ -69,7 +69,8 @@ from ..observability import trace as _trace
 from ..observability.slo import SLOTracker
 from ..resilience.overload import _env_num
 
-__all__ = ["InferenceServer", "InferenceClient", "serve"]
+__all__ = ["InferenceServer", "InferenceClient", "StreamInterrupted",
+           "serve"]
 
 # error classes that cannot be transient: no retry, no batch bisection
 _DETERMINISTIC_ERRORS = (TypeError, ValueError, KeyError, IndexError,
@@ -242,6 +243,24 @@ class InferenceServer:
                     body = {"status": "ready" if ready else "not_ready",
                             "reason": reason}
                     body.update(server.admission.stats())
+                    # router-relevant signals, first-class in the
+                    # readiness JSON (ISSUE 9): before this they were
+                    # only recoverable by parsing /metrics text.  The
+                    # HTTP status semantics are unchanged — only the
+                    # payload grew.
+                    body["admission_limit"] = body.get("limit")
+                    if server.engine is not None:
+                        st = server.engine.stats()
+                        body["engine"] = {
+                            "batch_occupancy": st.get("occupancy"),
+                            "waiting_sequences": st.get("waiting"),
+                            "active_sequences": st.get("running"),
+                            "max_slots": st.get("max_slots"),
+                        }
+                        if server.gen_admission is not None:
+                            gs = server.gen_admission.stats()
+                            body["engine"]["inflight"] = gs["inflight"]
+                            body["engine"]["queued"] = gs["queued"]
                     return self._json(200 if ready else 503, body)
                 if self.path == "/metrics":
                     try:
@@ -745,6 +764,25 @@ class InferenceServer:
         return self._shutdown_result
 
 
+class StreamInterrupted(RuntimeError):
+    """A /generate stream was cleanly cut after tokens were already
+    delivered (the serving replica died mid-stream behind a router, or
+    the engine cancelled the sequence).  Carries the resumable state:
+    `output_ids` is the prompt + every token delivered so far — resubmit
+    it as the next request's `input_ids` to continue the generation
+    without replaying a single token.  `tokens` is just the delivered
+    generated tokens; `finish_reason` names the cut."""
+
+    def __init__(self, message, output_ids=None, tokens=(),
+                 finish_reason="interrupted", request_id=None):
+        super().__init__(message)
+        self.output_ids = (None if output_ids is None
+                           else np.asarray(output_ids, np.int32))
+        self.tokens = list(tokens)
+        self.finish_reason = finish_reason
+        self.request_id = request_id
+
+
 class InferenceClient:
     """Protocol client with a configurable timeout and bounded retry on
     429/503 honoring the server's Retry-After header (capped at
@@ -784,9 +822,19 @@ class InferenceClient:
         return body
 
     def _retry_wait(self, headers):
+        """Defensive Retry-After parse (ISSUE 9 satellite): the header
+        is server-controlled input that feeds straight into sleep
+        math — a non-numeric value, a negative, a NaN (which poisons
+        min/max comparisons and would crash time.sleep), or an absurd
+        1e9 must all collapse into a bounded wait, never an exception
+        and never an unbounded park.  The parsed value is clamped into
+        [0, max_retry_wait]; the final wait keeps the 50 ms floor so a
+        Retry-After of 0 backs off instead of busy-spinning."""
         try:
             ra = float(headers.get("Retry-After", 0.5))
         except (TypeError, ValueError):
+            ra = 0.5
+        if not math.isfinite(ra):
             ra = 0.5
         return min(max(ra, 0.05), self.max_retry_wait)
 
@@ -841,6 +889,21 @@ class InferenceClient:
                             if evt.get("done"):
                                 final = evt
                                 break
+                            if evt.get("interrupted"):
+                                # a router cut the stream cleanly after
+                                # tokens were delivered: surface the
+                                # resumable prefix — NEVER silently
+                                # retry (a replay would duplicate the
+                                # delivered tokens)
+                                status = "interrupted"
+                                raise StreamInterrupted(
+                                    evt.get("error",
+                                            "stream interrupted"),
+                                    output_ids=evt.get("output_ids"),
+                                    tokens=tokens,
+                                    finish_reason=evt.get(
+                                        "finish_reason", "interrupted"),
+                                    request_id=evt.get("request_id"))
                             tokens.append(int(evt["token"]))
                             if on_token is not None:
                                 on_token(int(evt["token"]))

@@ -58,7 +58,7 @@ _SCHEMA_COUNTERS = tuple(
     + [("resilience.faults", {"point": p})
        for p in ("checkpoint.write", "collective.call", "dataloader.batch",
                  "jit.compile", "train.step", "serving.request",
-                 "store.op")]
+                 "store.op", "router.forward", "replica.crash")]
     + [("resilience.retries", {"policy": p})
        for p in ("collective", "elastic.heartbeat", "serving",
                  "dataloader", "jit.compile")]
@@ -74,7 +74,7 @@ _SCHEMA_COUNTERS = tuple(
     # overload/preemption runtime (ISSUE 5): admission sheds by reason,
     # preemption signals by name, emergency checkpoints, serving drains
     + [("resilience.shed_requests", {"reason": r})
-       for r in ("queue_full", "deadline", "draining")]
+       for r in ("queue_full", "deadline", "draining", "no_replicas")]
     + [("preemption.signals", {"signal": s})
        for s in ("SIGTERM", "SIGINT")]
     + [("preemption.maintenance_events", {}),
@@ -95,16 +95,27 @@ _SCHEMA_COUNTERS = tuple(
                  "evicted")]
     + [("engine.tokens", {})]
     + [("paged.dispatch", {"tier": t}) for t in ("pallas", "fallback")]
+    # fleet router (ISSUE 9): failure-triggered failovers, replica
+    # ejections/re-admissions, and per-endpoint routed-request outcomes
+    # — a fresh router reports zeros instead of omitting the keys
+    + [("router.failovers", {}), ("router.ejections", {}),
+       ("router.readmissions", {})]
+    + [("router.requests", {"endpoint": ep, "status": s})
+       for ep in ("predict", "generate")
+       for s in ("ok", "client_error", "shed", "interrupted", "error")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
 # present in a snapshot (a server that never saw traffic still reports
-# inflight=0 rather than omitting the key).
+# inflight=0 rather than omitting the key).  Entries are either a bare
+# name or a (name, labels) pair for labeled gauge series.
 _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
                   "serving.admission_limit",
                   # engine state (ISSUE 8): live batch + page pool
                   "engine.active_sequences", "engine.waiting_sequences",
-                  "engine.batch_occupancy", "engine.page_utilization")
+                  "engine.batch_occupancy", "engine.page_utilization") \
+    + tuple(("router.replicas", {"state": s})
+            for s in ("up", "draining", "ejected", "down"))
 
 
 def attach(crash_hook: bool = True):
@@ -115,8 +126,11 @@ def attach(crash_hook: bool = True):
     metrics.enable()
     for name, labels in _SCHEMA_COUNTERS:
         metrics.declare(name, **labels)
-    for name in _SCHEMA_GAUGES:
-        metrics.set_gauge(name, 0)
+    for entry in _SCHEMA_GAUGES:
+        if isinstance(entry, tuple):
+            metrics.set_gauge(entry[0], 0, **entry[1])
+        else:
+            metrics.set_gauge(entry, 0)
     flight.get_recorder().enabled = True
     trace.enable()
     if crash_hook:

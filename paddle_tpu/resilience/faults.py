@@ -15,6 +15,11 @@ Named fault points (instrumented call sites `fire()` these):
   train.step         distributed/train_step.py      per host dispatch
   serving.request    inference/serving.py           per predict call
   store.op           distributed/fleet/elastic.py   heartbeat store traffic
+  router.forward     inference/router.py            per forward attempt
+  replica.crash      inference/fleet.py             replica main loop tick
+                     (kind="error" → the replica exits non-zero; any
+                     other kind → immediate os._exit, a simulated
+                     kill -9)
 
 Activation is programmatic (`inject(...)` — usually as a context
 manager in tests) or via env:
@@ -44,6 +49,7 @@ __all__ = [
 FAULT_POINTS = (
     "checkpoint.write", "collective.call", "dataloader.batch",
     "jit.compile", "train.step", "serving.request", "store.op",
+    "router.forward", "replica.crash",
 )
 
 _ENV_SPEC = "PADDLE_TPU_FAULTS"

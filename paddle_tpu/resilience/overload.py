@@ -61,11 +61,12 @@ def _env_num(var, default, cast):
 
 class ShedError(RuntimeError):
     """A request was refused at admission.  `reason` is one of
-    `queue_full` / `deadline` / `draining`; `retry_after` is the
-    server's estimate (seconds) of when retrying could succeed —
-    serving surfaces it as an HTTP `Retry-After` header.  Overload
-    sheds map to 429 (back off and retry), draining to 503 (this
-    instance is going away — retry elsewhere)."""
+    `queue_full` / `deadline` / `draining` (plus `no_replicas` at the
+    fleet router's edge); `retry_after` is the server's estimate
+    (seconds) of when retrying could succeed — serving surfaces it as
+    an HTTP `Retry-After` header.  Overload sheds map to 429 (back off
+    and retry), draining / no_replicas to 503 (this instance cannot
+    serve you — retry elsewhere / later)."""
 
     def __init__(self, reason, retry_after=1.0, detail=""):
         super().__init__(
@@ -75,7 +76,7 @@ class ShedError(RuntimeError):
 
     @property
     def http_status(self):
-        return 503 if self.reason == "draining" else 429
+        return 503 if self.reason in ("draining", "no_replicas") else 429
 
 
 class AdmissionTicket:
@@ -149,10 +150,34 @@ class AdmissionController:
             return self._draining
 
     @property
-    def limit(self):
+    def limit(self):  # pt-lint: ok[PT102] (monitoring read: a stale
+        # limit is a fine answer to "what is the limit right now")
         """The LIVE concurrency limit (AIMD moves it within
         [min_limit, max_inflight]; fixed at max_inflight otherwise)."""
         return self._limit
+
+    def set_capacity(self, max_inflight):
+        """Re-size the concurrency limit at runtime — the fleet router
+        uses this to track live backend capacity (replicas ejected or
+        re-admitted change how much work the edge may admit).  Without
+        a `latency_target` the live limit follows the new capacity
+        exactly; with AIMD active, the adjusted limit is clamped into
+        the new [min_limit, max_inflight] band but otherwise keeps its
+        learned value.  Waiters are woken: a capacity increase can
+        admit a queued request immediately."""
+        with self._cv:
+            self.max_inflight = max(1, int(max_inflight))
+            # keep the AIMD band non-empty: a shrink below min_limit
+            # drags min_limit down with it (mirror of __init__), or
+            # the clamp below would hold _limit ABOVE the new capacity
+            self.min_limit = min(self.min_limit, self.max_inflight)
+            if self.latency_target is None:
+                self._limit = self.max_inflight
+            else:
+                self._limit = max(self.min_limit,
+                                  min(self._limit, self.max_inflight))
+            self._publish_gauges()
+            self._cv.notify_all()
 
     def stats(self):
         with self._cv:
@@ -255,7 +280,7 @@ class AdmissionController:
         # another replica after roughly one service time
         return self._ewma if self._ewma else 1.0
 
-    def _observe_locked(self, latency):
+    def _observe_locked(self, latency):  # pt-lint: ok[PT101,PT102] (callers hold _cv)
         if latency is None or latency < 0:
             return
         self._ewma = (latency if self._ewma is None else

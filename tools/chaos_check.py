@@ -26,6 +26,20 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
     the pool (no leak), surviving sequences' outputs must be
     bit-identical to an uninterrupted run, and the sheds must surface
     in the SLO report under their reason labels.
+  * `fleet`: a 3-replica `ReplicaFleet` behind the admission-aware
+    `Router` under a concurrent mixed /predict + /generate burst;
+    one replica is killed -9 and another SIGTERM-drained MID-BURST.
+    Zero admitted-request failures (failover under the same
+    X-Request-Id), zero replayed stream tokens (every delivered
+    stream is an exact prefix of the deterministic expected
+    sequence), every killed replica's sequence accounted (failed
+    over, cleanly interrupted with a resumable prefix, or politely
+    shed), and the fleet must RECOVER to full capacity after the
+    supervisor relaunches both replicas — proven by a final all-ok
+    burst.  Router failover/ejection counters and the
+    `router.replicas{state}` gauges must be visible in the telemetry
+    snapshot AND in a `tools/telemetry_agg.py` rollup of the fleet's
+    dumps.
 
 Exit 0 = recovered; exit 1 = a reflex failed.  CI runs this alongside
 the `chaos`-marked pytest matrix (kept out of tier-1 — see pytest.ini).
@@ -530,10 +544,194 @@ def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10):
     return report
 
 
+def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
+                    new_tokens=40, token_time=0.02, service_time=0.02):
+    """Fleet chaos (ISSUE 9): mixed concurrent burst over a 3-replica
+    fleet; kill -9 one replica and SIGTERM-drain another mid-burst.
+    `recovered` means zero admitted-request failures, zero replayed
+    stream tokens, every stream accounted, and full capacity restored
+    (final burst all-ok) — with the router's failover/ejection story
+    visible in the telemetry snapshot and the telemetry_agg rollup."""
+    import tempfile as _tempfile
+    import threading
+    import time as _time
+    import urllib.error
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.fleet import ReplicaFleet, toy_token
+    from paddle_tpu.inference.serving import (
+        InferenceClient, StreamInterrupted,
+    )
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.observability.export import TelemetryExporter
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)  # re-declare the schema post-reset
+    tel_dir = _tempfile.mkdtemp(prefix="chaos_fleet_tel_")
+    fleet = ReplicaFleet(
+        num_replicas=n_replicas, kind="toy", token_time=token_time,
+        service_time=service_time, launch_timeout=60,
+        telemetry_dir=tel_dir)
+    fleet.start()
+    results = []
+    lock = threading.Lock()
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(0, 200, (3 + i % 5,)).tolist()
+               for i in range(n_generate)]
+
+    def one_predict(i):
+        cli = InferenceClient(fleet.router.address, timeout=30,
+                              retries=1)
+        x = np.full((2, 2), float(i), np.float32)
+        try:
+            out = cli.predict(x=x)
+            ok = bool(np.array_equal(out["y"], x))
+            row = ("predict", "ok" if ok else "corrupt", None)
+        except urllib.error.HTTPError as e:
+            row = ("predict",
+                   "shed" if e.code in (429, 503) else "error",
+                   e.headers.get("Retry-After"))
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            row = ("predict", "error", type(e).__name__)
+        with lock:
+            results.append(row)
+
+    def one_generate(i):
+        cli = InferenceClient(fleet.router.address, timeout=30,
+                              retries=1)
+        prompt = prompts[i]
+        expected = [toy_token(prompt, k) for k in range(new_tokens)]
+        try:
+            r = cli.generate(prompt, max_new_tokens=new_tokens)
+            exact = r["tokens"] == expected
+            row = ("generate", "ok" if exact else "replayed", None)
+        except StreamInterrupted as e:
+            # the clean mid-stream cut: a strict prefix, resumable
+            prefix_ok = (e.tokens == expected[:len(e.tokens)]
+                         and list(e.output_ids)
+                         == list(prompt) + e.tokens)
+            row = ("generate",
+                   "interrupted" if prefix_ok else "replayed",
+                   len(e.tokens))
+        except urllib.error.HTTPError as e:
+            row = ("generate",
+                   "shed" if e.code in (429, 503) else "error",
+                   e.code)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            row = ("generate", "error", type(e).__name__)
+        with lock:
+            results.append(row)
+
+    threads = [threading.Thread(target=one_predict, args=(i,))
+               for i in range(n_predict)]
+    threads += [threading.Thread(target=one_generate, args=(i,))
+                for i in range(n_generate)]
+    rs.shuffle(threads)
+    for i, t in enumerate(threads):
+        t.start()
+        _time.sleep(0.01)
+        if i == len(threads) // 3:
+            fleet.kill_replica(0)          # kill -9 mid-burst
+        if i == len(threads) // 2:
+            fleet.drain_replica(1)         # SIGTERM (drain-first)
+    for t in threads:
+        t.join(timeout=60)
+    # recovery: the supervisor relaunches both; full capacity returns
+    recovered_capacity = fleet.wait_ready(n=n_replicas, timeout=30)
+    final = []
+
+    def final_one(i):
+        cli = InferenceClient(fleet.router.address, timeout=30,
+                              retries=1)
+        prompt = prompts[i % len(prompts)]
+        try:
+            r = cli.generate(prompt, max_new_tokens=5)
+            final.append(r["tokens"]
+                         == [toy_token(prompt, k) for k in range(5)])
+        except Exception:  # noqa: BLE001 — report, don't crash
+            final.append(False)
+
+    fthreads = [threading.Thread(target=final_one, args=(i,))
+                for i in range(n_replicas * 2)]
+    for t in fthreads:
+        t.start()
+    for t in fthreads:
+        t.join(timeout=30)
+    # the router process's own dump joins the replicas' in tel_dir
+    TelemetryExporter(outdir=tel_dir, run_id="router").dump_once(
+        reason="chaos_final")
+    snap = metrics.snapshot()
+    fleet.stop()
+    obs.detach()
+
+    counters = snap["counters"]
+    gauges = snap["gauges"]
+    by = {}
+    for kind, status, _extra in results:
+        by.setdefault(kind, {}).setdefault(status, 0)
+        by[kind][status] += 1
+    pred = by.get("predict", {})
+    gen = by.get("generate", {})
+    errors = (pred.get("error", 0) + pred.get("corrupt", 0)
+              + gen.get("error", 0) + gen.get("replayed", 0))
+    accounted = sum(gen.values()) == n_generate and \
+        sum(pred.values()) == n_predict
+
+    # per-replica rollup through tools/telemetry_agg.py (ISSUE 9
+    # acceptance: router counters/gauges merged across the fleet dumps)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import telemetry_agg
+    finally:
+        sys.path.pop(0)
+    roll = telemetry_agg.rollup(telemetry_agg.load_dumps(tel_dir))
+    roll_has_router = any(k.startswith("router.replicas")
+                          for k in roll.get("gauges", {})) and \
+        "router.ejections" in roll.get("counters", {})
+
+    report = {
+        "scenario": "fleet",
+        "replicas": n_replicas,
+        "predict": pred,
+        "generate": gen,
+        "admitted_failures": errors,
+        "streams_accounted": accounted,
+        "ejections": counters.get("router.ejections", 0),
+        "failovers": counters.get("router.failovers", 0),
+        "readmissions": counters.get("router.readmissions", 0),
+        "router_gauges": {k: v for k, v in gauges.items()
+                          if k.startswith("router.replicas")},
+        "recovered_capacity": bool(recovered_capacity),
+        "final_burst_ok": sum(bool(x) for x in final),
+        "rollup_processes": roll.get("processes", []),
+        "rollup_has_router": bool(roll_has_router),
+        "fleet_events": [e["kind"] for e in fleet.events],
+        "recovered": (
+            errors == 0 and accounted
+            and pred.get("ok", 0) > 0 and gen.get("ok", 0) > 0
+            and counters.get("router.ejections", 0) >= 1
+            and counters.get("router.readmissions", 0) >= 2
+            and bool(recovered_capacity)
+            and len(final) == n_replicas * 2 and all(final)
+            and gauges.get("router.replicas{state=up}") == n_replicas
+            and bool(roll_has_router)
+            # the drain-first ordering actually held for the SIGTERM
+            and fleet.events.index(
+                next(e for e in fleet.events
+                     if e["kind"] == "drain_mark"))
+            < fleet.events.index(
+                next(e for e in fleet.events
+                     if e["kind"] == "drain_sigterm"))),
+    }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario",
-                    choices=("train", "overload", "preemption", "engine"),
+                    choices=("train", "overload", "preemption", "engine",
+                             "fleet"),
                     default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
@@ -545,6 +743,8 @@ def main(argv=None):
         report = run_overload(seed=args.seed)
     elif args.scenario == "engine":
         report = run_engine_chaos(seed=args.seed)
+    elif args.scenario == "fleet":
+        report = run_fleet_chaos(seed=args.seed)
     elif args.scenario == "preemption":
         report = run_preemption(steps=min(args.steps, 12), seed=args.seed)
     else:

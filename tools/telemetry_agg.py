@@ -17,7 +17,12 @@ Outputs:
     fixed shared ladder makes this a plain sum) with fleet-wide
     interpolated p50/p95/p99, gauges kept per process, and SLO reports
     combined per endpoint (window counts summed, burn rate recomputed
-    against the declared objective).
+    against the declared objective).  A `per_process` section groups
+    each process's serving/engine/router gauges under its
+    `host:pid[:rN]` ident — the per-replica serving view (ISSUE 9:
+    replica ranks ride the dump filename, so a fleet's rollup shows
+    each replica's admission and engine state side by side with the
+    router's `router.replicas{state}` gauges).
 
 Exit codes: 0 ok, 1 usage/IO error, 2 schema errors in any stream
 (same discipline as tools/analyze_chip_log.py).
@@ -209,6 +214,7 @@ def rollup(streams):
     counters: dict = {}
     hists: dict = {}
     gauges: dict = {}
+    per_process: dict = {}
     slo_window: dict = {}
     slo_objectives: dict = {}
     for ident, e in sorted(last.items()):
@@ -221,6 +227,13 @@ def rollup(streams):
                 _merge_hist(hists.setdefault(k, {}), summ)
         for k, v in (m.get("gauges") or {}).items():
             gauges.setdefault(k, {})[ident] = v
+        # the per-replica serving view: this process's fleet-relevant
+        # gauges under one key (rank rides the ident suffix)
+        serving_view = {
+            k: v for k, v in (m.get("gauges") or {}).items()
+            if k.startswith(("serving.", "engine.", "router."))}
+        if serving_view:
+            per_process[ident] = dict(sorted(serving_view.items()))
         slo = e.get("slo")
         if isinstance(slo, dict):
             for ep, rep in (slo.get("endpoints") or {}).items():
@@ -259,6 +272,7 @@ def rollup(streams):
             "counters": dict(sorted(counters.items())),
             "histograms": dict(sorted(hists.items())),
             "gauges": dict(sorted(gauges.items())),
+            "per_process": dict(sorted(per_process.items())),
             "slo": slo_out}
 
 
