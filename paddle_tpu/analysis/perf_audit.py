@@ -36,6 +36,10 @@ silently on a CPU-only CI box:
 Representative programs (all built under ``JAX_PLATFORMS=cpu``):
   * ``train_step``  — the hybrid GPT train step at a small proxy shape
                       (same structure/dtypes as the bench shape)
+  * ``swin_train_step`` — the Swin train step at a tiny proxy shape
+                      (pins the windowed-attention layout tax: roll /
+                      window-partition transposes, rel-pos-bias
+                      plumbing — ISSUE 10)
   * ``decode_step`` — the scanned KV-cache decode program
                       (``GenerationMixin._decode_chunk_program``)
   * ``call_sites``  — AST scan of the repo for PT402 call-site hazards
@@ -78,10 +82,10 @@ RULE_IDS = ("PT400", "PT401", "PT402", "PT403", "PT404", "PT405")
 
 # program names: the fast subset runs in the tier-1 smoke; FULL adds the
 # op-table sweep (slow tier — imports + traces the whole exported surface)
-DEFAULT_PROGRAMS = ("train_step", "decode_step", "paged_decode_step",
-                    "call_sites")
-FULL_PROGRAMS = ("train_step", "decode_step", "paged_decode_step",
-                 "call_sites", "op_table")
+DEFAULT_PROGRAMS = ("train_step", "swin_train_step", "decode_step",
+                    "paged_decode_step", "call_sites")
+FULL_PROGRAMS = ("train_step", "swin_train_step", "decode_step",
+                 "paged_decode_step", "call_sites", "op_table")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -467,6 +471,53 @@ def _decode_step_program(batch=2, prompt=8, new_tokens=8):
     return lowered, jaxpr
 
 
+def _swin_train_step_program(batch=2, img=32):
+    """The Swin train step at a tiny proxy shape (one shifted block in
+    stage 1, bf16 AMP, Momentum) — the vision twin of ``train_step``.
+    Its PT401 numbers pin the windowed-attention layout tax (roll /
+    window-partition 6-D transposes, rel-pos-bias plumbing) statically,
+    the same way the GPT step's budget pins the flash layout tax
+    (ISSUE 10; PERF.md Swin ablation: that machinery alone costs ~43%
+    of achievable step rate on-chip).  Returns ``(lowered, jaxpr)``."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.vision.models import SwinTransformer
+
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    inner = SwinTransformer(img_size=img, patch_size=4, embed_dim=32,
+                            depths=(2, 2), num_heads=(2, 4),
+                            window_size=4, num_classes=8)
+    model = fleet.distributed_model(inner)
+    opt = fleet.distributed_optimizer(P.optimizer.Momentum(
+        parameters=model.parameters(), learning_rate=1e-3, momentum=0.9))
+    step = model.build_train_step(opt, P.nn.CrossEntropyLoss(),
+                                  amp_dtype="bfloat16")
+    rs = np.random.RandomState(0)
+    imgs = P.to_tensor(rs.rand(batch, 3, img, img).astype(np.float32))
+    labels = P.to_tensor(rs.randint(0, 8, (batch,)), "int32")
+    lowered = step.lower(imgs, labels)
+    jaxpr = None
+    if getattr(step, "_step_fn", None) is not None:
+        import jax.numpy as jnp
+
+        placed, _ = step._place_batch((imgs, labels), batch_axis=0)
+        s = step._state
+        lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
+        jaxpr = jax.make_jaxpr(step._step_fn)(
+            s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
+    return lowered, jaxpr
+
+
 def _paged_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
                                chunk=4):
     """The continuous-batching engine's ragged paged decode program
@@ -656,11 +707,14 @@ def audit_perf(programs=DEFAULT_PROGRAMS, repo_root=None):
     for prog in programs:
         if prog == "call_sites":
             v, m = _audit_call_sites(repo_root)
-        elif prog in ("train_step", "decode_step", "paged_decode_step"):
+        elif prog in ("train_step", "swin_train_step", "decode_step",
+                      "paged_decode_step"):
             full = {"train_step": "gpt125m_train_step",
+                    "swin_train_step": "swin_train_step",
                     "decode_step": "gpt_decode_step",
                     "paged_decode_step": "gpt_paged_decode_step"}[prog]
             build = {"train_step": _train_step_program,
+                     "swin_train_step": _swin_train_step_program,
                      "decode_step": _decode_step_program,
                      "paged_decode_step": _paged_decode_step_program}[prog]
             try:

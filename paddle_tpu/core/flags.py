@@ -88,6 +88,12 @@ _flags = {
     # (ring attention is jnp/lax collectives, not pallas_call — no flag)
     "FLAGS_disable_pallas_rope": _env_bool("FLAGS_disable_pallas_rope"),
     "FLAGS_disable_pallas_decode": _env_bool("FLAGS_disable_pallas_decode"),
+    # fused vision kernels (ISSUE 10): Swin window attention and the
+    # conv+norm+act inference fusion
+    "FLAGS_disable_pallas_window_attn": _env_bool(
+        "FLAGS_disable_pallas_window_attn"),
+    "FLAGS_disable_pallas_conv_norm": _env_bool(
+        "FLAGS_disable_pallas_conv_norm"),
     "FLAGS_use_autotune": _env_bool("FLAGS_use_autotune", "1"),
     # force the expanded-KV MHA kernels for GQA attention (grouped is
     # the default: less KV HBM traffic; the round-5 on-chip A/B showed
@@ -117,7 +123,7 @@ def jit_compiler_options():
 
 def pallas_enabled(kernel: str) -> bool:
     """Dispatch-site gate for one Pallas kernel ('flash', 'fused_norm',
-    'rope', 'ring', 'decode')."""
+    'rope', 'ring', 'decode', 'window_attn', 'conv_norm')."""
     return not (_flags.get("FLAGS_disable_pallas")
                 or _flags.get(f"FLAGS_disable_pallas_{kernel}"))
 

@@ -66,8 +66,16 @@ def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
 
     b, s, hq, d = q.shape
     hkv = k.shape[2]
-    kt = ops.transpose(k, [0, 2, 1, 3])
-    vt = ops.transpose(v, [0, 2, 1, 3])
+    if s == 1:
+        # decode step: [B,1,Hkv,D] -> [B,Hkv,1,D] is a pure reshape
+        # (identical element order) — the cache write stays
+        # transpose-free on the per-token hot path (PT401 budget on
+        # the scanned decode program holds this at zero new relayouts)
+        kt = ops.reshape(k, [b, hkv, 1, d])
+        vt = ops.reshape(v, [b, hkv, 1, d])
+    else:
+        kt = ops.transpose(k, [0, 2, 1, 3])
+        vt = ops.transpose(v, [0, 2, 1, 3])
     kb, vb = kv_cache
 
     def upd(buf, new, p):

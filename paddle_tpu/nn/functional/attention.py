@@ -50,16 +50,19 @@ def sdp_kernel(enable_math=False, enable_flash=True,
 
 def _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale):
     # q,k,v: [B, S, H, D] (paddle flash-attention layout); GQA inputs
-    # (fewer KV heads) expand here — the Pallas path reads them grouped
+    # (fewer KV heads) expand here — the Pallas path reads them grouped.
+    # Flat-layout spelling: the einsums contract on the native [B,S,H,D]
+    # operands directly (dot_general batches over non-leading (b, h)),
+    # so only the [B,H,Sq,D] -> [B,Sq,H,D] output reorder remains as an
+    # explicit transpose. Same contraction order as the old swapaxes
+    # form — bit-identical values; this is what the PT401 budget for
+    # the CPU-audited train step measures (tools/perf_budget.json).
     from ...ops.pallas.flash_attention import _expand_gqa_kv
 
     q, k, v = _expand_gqa_kv(q, k, v)
     d = q.shape[-1]
     scale = scale or (1.0 / math.sqrt(d))
-    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
@@ -71,8 +74,7 @@ def _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale):
         else:
             logits = logits + attn_mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
-    return jnp.swapaxes(out, 1, 2)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -26,3 +26,13 @@ from .paged_attention import (  # noqa: F401
     paged_attention_dispatch,
     paged_attention_reference,
 )
+from .window_attention import (  # noqa: F401
+    swin_window_attention,
+    window_attention_available,
+    window_attention_ref,
+)
+from .conv_norm import (  # noqa: F401
+    conv_bn_act_available,
+    conv_bn_act_ref,
+    fused_conv_bn_act,
+)

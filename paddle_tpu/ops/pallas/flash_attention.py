@@ -70,14 +70,20 @@ NEG_INF = -1e30
 
 _DIMSEM = (_PLL, _PLL, _ARB)
 
-# Flash layout default: "transpose" (per-head kernels over [B,H,S,D]
-# with layout transposes around the call), "kv" (mixed: K/V/dK/dV stay
-# native [B,S,H,D] — round-5 kernels, see the kv-native section),
-# "flat" (everything on unpadded [B,S,H*D] views — round-5 kernels),
-# "mh" (all-native all-heads blocks — rejected by the deployed server
-# Mosaic, kept for newer toolchains), "auto" (FLAT when it fits VMEM,
-# else transpose). Overridable via env FLAGS_flash_layout.
-_DEFAULT_LAYOUT = "transpose"
+# Flash layout default: "auto" — the transpose-free FLAT tier
+# (everything on unpadded [B,S,H*D] views, zero relayouts — round-5
+# kernels, gradients bit-identical to the transpose core) wherever the
+# static lane/VMEM gates admit it, the transpose core everywhere else.
+# Flipped from "transpose" after the round-5 parity tests + compile
+# ladder proved flat correct and lowerable (docs/ATTENTION.md "The
+# layout story"); tools/step_ab.py re-measures the full-step win each
+# hardware window. Other tiers stay reachable via env
+# FLAGS_flash_layout: "transpose" (per-head kernels over [B,H,S,D]
+# with layout transposes around the call — the pre-flip default), "kv"
+# (mixed: K/V/dK/dV stay native [B,S,H,D]), "flat" (force flat), "mh"
+# (all-native all-heads blocks — rejected by the deployed server
+# Mosaic, kept for newer toolchains).
+_DEFAULT_LAYOUT = "auto"
 
 
 _FORCE_COMPILED = False  # see force_tpu_lowering()
@@ -1647,13 +1653,17 @@ def _expand_gqa_kv(q, k, v):
 
 
 def _ref_attention(q, k, v, mask, is_causal):
+    # flat-layout reference: the einsums contract directly on the native
+    # [B,S,H,D] operands (dot_general batches over non-leading (b, h) —
+    # no operand relayout), so the only explicit transpose left is the
+    # [B,H,Sq,D] -> [B,Sq,H,D] output reorder. Same contraction order as
+    # the old swapaxes spelling — bit-identical values, 4x fewer
+    # stablehlo.transpose ops (PT401; measured on the audit proxy).
     d = q.shape[-1]
     q, k, v = _expand_gqa_kv(q, k, v)
     scale = 1.0 / math.sqrt(d)
-    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
-    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
@@ -1664,8 +1674,8 @@ def _ref_attention(q, k, v, mask, is_causal):
         else:
             logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def _tuned_blocks(b, sq, sk, h, d, dtype, causal, h_kv=None,

@@ -1,0 +1,91 @@
+"""Fused conv+norm+act dispatch for the vision models (ISSUE 10).
+
+One helper owns the `act(bn(conv(x)))` pattern that dominates the
+ResNet/MobileNet stem and blocks:
+
+  * Inference (eval mode), dense or depthwise groups, dilation 1, int
+    padding: the Pallas `fused_conv_bn_act` kernel runs the conv, the
+    FOLDED batch-norm affine (`scale = gamma*rsqrt(var+eps)`,
+    `shift = beta + (conv_bias - mean)*scale`) and the activation in
+    one VMEM pass — the pre-activation conv output never reaches HBM.
+    (On CPU the same entry runs its lax.conv reference — one code
+    path, two tiers, `conv_norm.dispatch` counters tell them apart.)
+  * Training-mode BN / unsupported shapes: the composed ops run exactly
+    as before — batch norm needs live batch stats in training mode, so
+    the fused tier requires frozen (eval) norm stats. Gradients DO flow
+    through the fused tier (custom VJP = reference composed backward),
+    so frozen-BN fine-tuning works either way.
+
+The helper takes the MODULES (conv, bn), not raw arrays, so the models
+keep their parameter/state_dict layout byte-for-byte.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...nn import functional as F
+
+__all__ = ["conv_bn_act"]
+
+
+def _int2(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v) if len(v) == 2 else None
+    if isinstance(v, int):
+        return (v, v)
+    return None
+
+
+def _fusable(conv, bn, act):
+    # frozen norm stats are the only mode constraint: the fused tier is
+    # differentiable (custom VJP replays the reference backward), so
+    # frozen-BN fine-tuning and input-gradient probes route fused too
+    if bn.training:
+        return False
+    if act not in ("relu", "relu6", None):
+        return False
+    if _int2(conv.stride) is None or _int2(conv.padding) is None:
+        return False
+    if _int2(conv.dilation) != (1, 1):
+        return False
+    groups = conv.groups
+    cin = conv.weight.shape[1] * groups
+    cout = conv.weight.shape[0]
+    return groups == 1 or (groups == cin and cout == cin)
+
+
+def conv_bn_act(x, conv, bn, act="relu"):
+    """`act(bn(conv(x)))` with the fused inference tier when eligible.
+
+    x: Tensor [B, Cin, H, W]; conv: nn.Conv2D; bn: nn.BatchNorm2D;
+    act: 'relu' | 'relu6' | None."""
+    if not _fusable(conv, bn, act):
+        out = bn(conv(x))
+        if act == "relu":
+            out = F.relu(out)
+        elif act == "relu6":
+            out = F.relu6(out)
+        return out
+
+    from ...ops.pallas.conv_norm import fused_conv_bn_act
+
+    stride = _int2(conv.stride)
+    padding = _int2(conv.padding)
+    eps = bn.epsilon
+
+    def f(xv, wv, gamma, beta, mean, var, cbias):
+        scale = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+        if gamma is not None:
+            scale = scale * gamma.astype(jnp.float32)
+        shift = -mean.astype(jnp.float32) * scale
+        if beta is not None:
+            shift = shift + beta.astype(jnp.float32)
+        if cbias is not None:
+            shift = shift + cbias.astype(jnp.float32) * scale
+        return fused_conv_bn_act(xv, wv, scale, shift, stride=stride,
+                                 padding=padding, act=act)
+
+    return apply("fused_conv_bn_act", f, x, conv.weight, bn.weight,
+                 bn.bias, bn._mean, bn._variance, conv.bias)

@@ -13,6 +13,10 @@ def _make_divisible(v, divisor=8, min_value=None):
 
 
 class ConvBNReLU(nn.Sequential):
+    """conv+bn+relu6 block; inference routes through the fused Pallas
+    conv+norm+act kernel (ISSUE 10) — dense convs and the depthwise
+    (groups == channels) blocks both qualify. Module layout unchanged."""
+
     def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1):
         super().__init__(
             nn.Conv2D(in_c, out_c, kernel, stride,
@@ -20,6 +24,12 @@ class ConvBNReLU(nn.Sequential):
                       bias_attr=False),
             nn.BatchNorm2D(out_c),
             nn.ReLU6())
+
+    def forward(self, x):
+        from ._fused import conv_bn_act
+
+        conv, bn = self[0], self[1]
+        return conv_bn_act(x, conv, bn, "relu6")
 
 
 class InvertedResidual(nn.Layer):

@@ -1,7 +1,17 @@
-"""ResNet family (parity: `python/paddle/vision/models/resnet.py`)."""
+"""ResNet family (parity: `python/paddle/vision/models/resnet.py`).
+
+TPU-first (ISSUE 10): every `relu(bn(conv(x)))` in the stem and blocks
+routes through `_fused.conv_bn_act` — in inference it dispatches the
+Pallas fused conv+norm+act kernel (`ops/pallas/conv_norm.py`: the conv
+as kh*kw shifted MXU matmuls, the folded batch-norm affine and the relu
+applied in VMEM, no pre-activation HBM materialization); in training
+and on CPU the composed ops run exactly as before. Parameter layout is
+unchanged — the helper reads the existing conv/bn modules.
+"""
 from __future__ import annotations
 
 from ... import nn
+from ._fused import conv_bn_act
 
 
 class BasicBlock(nn.Layer):
@@ -22,8 +32,8 @@ class BasicBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
+        out = conv_bn_act(x, self.conv1, self.bn1, "relu")
+        out = conv_bn_act(out, self.conv2, self.bn2, None)
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
@@ -51,9 +61,9 @@ class BottleneckBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
+        out = conv_bn_act(x, self.conv1, self.bn1, "relu")
+        out = conv_bn_act(out, self.conv2, self.bn2, "relu")
+        out = conv_bn_act(out, self.conv3, self.bn3, None)
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
@@ -102,7 +112,7 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = conv_bn_act(x, self.conv1, self.bn1, "relu")
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

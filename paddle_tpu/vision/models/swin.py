@@ -1,16 +1,34 @@
 """Swin Transformer (BASELINE config 5 companion to ViT).
 
 Role parity: the Swin family the reference ecosystem trains through its
-fused attention stack. TPU-first notes: window partition/reverse are pure
-reshape+transpose (free under XLA); the shifted-window roll is `jnp.roll`
-(a static rotate XLA lowers to two slices+concat); window attention runs
-as one batched matmul over [num_windows*B, tokens, C] — MXU-shaped.
+fused attention stack. TPU-first notes (ISSUE 10 — the PERF.md round-5
+Swin ablation put the windowed-attention machinery at ~43% of
+achievable step rate, Swin-T at 7.5% of baseline):
+
+  * Windowed attention runs through ONE fused entry
+    (`ops.pallas.window_attention.swin_window_attention`): on TPU a
+    Pallas kernel owns cyclic shift + window partition + per-head
+    attention with the dense rel-pos bias + reverse over image-layout
+    blocks — the 6-D partition/reverse transposes and the roll never
+    reach XLA. Off-TPU (and on gate rejects) the jnp reference runs the
+    identical math, so CPU tests and TPU serve the same numerics.
+  * The relative-position bias is densified WITHOUT a per-forward
+    gather: `__init__` precomputes a constant one-hot scatter matrix
+    [ws⁴, (2w-1)²]; the dense [num_heads, ws², ws²] table is then one
+    MXU matmul from the trainable table (gradients flow — the old
+    gather/reshape/transpose chain per forward was pure overhead per
+    the ablation). Both the fused kernel and the fallback consume the
+    same dense buffer.
+  * The qkv projection is applied in image layout BEFORE partitioning
+    (a per-token matmul commutes with the partition permutation), which
+    is what lets the kernel read q/k/v as lane slices of one block.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from ... import nn
@@ -22,27 +40,53 @@ __all__ = ["SwinTransformer", "swin_t", "swin_s", "swin_b"]
 
 
 def _window_partition(x, ws):
-    # x: [B, H, W, C] → [B*nH*nW, ws*ws, C]
-    def f(v):
-        B, H, W, C = v.shape
-        v = v.reshape(B, H // ws, ws, W // ws, ws, C)
-        v = v.transpose(0, 1, 3, 2, 4, 5)
-        return v.reshape(-1, ws * ws, C)
+    # x: [B, H, W, C] → [B*nH*nW, ws*ws, C]  (kept for callers/tests;
+    # the attention path itself goes through the fused entry)
+    from ...ops.pallas.window_attention import window_partition
 
-    return apply("window_partition", f, x)
+    return apply("window_partition", lambda v: window_partition(v, ws), x)
 
 
 def _window_reverse(windows, ws, H, W):
-    def f(v):
-        B = v.shape[0] // ((H // ws) * (W // ws))
-        v = v.reshape(B, H // ws, W // ws, ws, ws, -1)
-        v = v.transpose(0, 1, 3, 2, 4, 5)
-        return v.reshape(B, H, W, -1)
+    from ...ops.pallas.window_attention import window_reverse
 
-    return apply("window_reverse", f, windows)
+    return apply("window_reverse",
+                 lambda v: window_reverse(v, ws, H, W), windows)
+
+
+@functools.lru_cache(maxsize=None)
+def _rel_bias_constants(window_size):
+    """(rel_index [n,n] int, onehot [ws^4,(2w-1)^2] f32) for one window
+    size — the dense-bias scatter matrix that turns the per-forward
+    gather chain into a single MXU matmul (differentiable — the table
+    still trains; PERF.md ablation: the gather was pure overhead).
+    Module-level cached: the constants depend only on window_size, so
+    every WindowAttention instance (12 blocks in Swin-T, ~1.6 MB each
+    at ws=7) shares ONE copy instead of baking a fresh one into each
+    block's closure and traced program."""
+    coords = np.stack(np.meshgrid(np.arange(window_size),
+                                  np.arange(window_size),
+                                  indexing="ij"))      # [2, w, w]
+    flat = coords.reshape(2, -1)
+    rel = flat[:, :, None] - flat[:, None, :]          # [2, n, n]
+    rel = rel.transpose(1, 2, 0) + window_size - 1
+    rel_index = (rel[..., 0] * (2 * window_size - 1)
+                 + rel[..., 1])                        # [n, n]
+    n_tok = window_size * window_size
+    n_tab = (2 * window_size - 1) ** 2
+    onehot = np.zeros((n_tok * n_tok, n_tab), np.float32)
+    onehot[np.arange(n_tok * n_tok), rel_index.reshape(-1)] = 1.0
+    return rel_index, onehot
 
 
 class WindowAttention(nn.Layer):
+    """Window multi-head self-attention over image-layout inputs.
+
+    `forward(x_img, mask, shift)` takes the NORMED features in
+    [B, H, W, C] image layout and returns [B, H, W, C]: qkv projection,
+    then the fused windowed-attention entry (shift/partition/bias/
+    reverse all inside), then the output projection."""
+
     def __init__(self, dim, window_size, num_heads, attn_drop=0.0,
                  proj_drop=0.0):
         super().__init__()
@@ -54,50 +98,45 @@ class WindowAttention(nn.Layer):
         self.proj = nn.Linear(dim, dim)
         self.attn_drop = attn_drop
         self.proj_drop = proj_drop
-        # relative position bias table [(2w-1)^2, heads]
+        # relative position bias table [(2w-1)^2, heads] (trainable,
+        # tied across window positions — reference parameterization)
         self.rel_bias = self.create_parameter(
             [(2 * window_size - 1) ** 2, num_heads])
-        coords = np.stack(np.meshgrid(np.arange(window_size),
-                                      np.arange(window_size),
-                                      indexing="ij"))  # [2, w, w]
-        flat = coords.reshape(2, -1)
-        rel = flat[:, :, None] - flat[:, None, :]       # [2, n, n]
-        rel = rel.transpose(1, 2, 0) + window_size - 1
-        self._rel_index = (rel[..., 0] * (2 * window_size - 1)
-                           + rel[..., 1])               # [n, n]
+        self._rel_index, self._bias_onehot = _rel_bias_constants(
+            window_size)
 
-    def forward(self, x, mask=None):
+    def dense_bias(self):
+        """Dense [num_heads, ws², ws²] rel-pos bias from the trainable
+        table — one matmul against the precomputed one-hot, no gather."""
         n_tok = self.ws * self.ws
-        heads = self.num_heads
-        hd = self.dim // heads
-        rel_idx = self._rel_index
+        onehot = self._bias_onehot
 
-        qkv = self.qkv(x)
+        def f(tab):
+            # lhs [T, h] x rhs one-hot [P, T] contract T -> [h, P]
+            # (natural dot order: no output transpose)
+            dense = jnp.einsum("th,pt->hp", tab.astype(jnp.float32),
+                               onehot)
+            return dense.reshape(self.num_heads, n_tok, n_tok)
 
-        def f(qkv_v, bias_tab, mask_v):
-            Bw = qkv_v.shape[0]
-            qkv_ = qkv_v.reshape(Bw, n_tok, 3, heads, hd)
-            q, k, v = (qkv_[:, :, i].transpose(0, 2, 1, 3)
-                       for i in range(3))               # [Bw, h, n, hd]
-            attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
-            bias = bias_tab[rel_idx.reshape(-1)].reshape(
-                n_tok, n_tok, heads).transpose(2, 0, 1)
-            attn = attn + bias[None]
-            if mask_v is not None:
-                nw = mask_v.shape[0]
-                attn = attn.reshape(Bw // nw, nw, heads, n_tok, n_tok) \
-                    + mask_v[None, :, None]
-                attn = attn.reshape(Bw, heads, n_tok, n_tok)
-            attn = jax.nn.softmax(attn, axis=-1)
-            out = (attn @ v).transpose(0, 2, 1, 3).reshape(Bw, n_tok,
-                                                           self.dim)
-            return out
+        return apply("swin_rel_bias_dense", f, self.rel_bias)
 
-        out = apply("window_attention", f, qkv, self.rel_bias, mask)
+    def forward(self, x_img, mask=None, shift=0):
+        from ...ops.pallas.window_attention import swin_window_attention
+
+        qkv = self.qkv(x_img)                       # [B, H, W, 3C]
+        bias = self.dense_bias()
+        fn = functools.partial(swin_window_attention,
+                               window_size=self.ws, shift=int(shift),
+                               num_heads=self.num_heads)
+        if mask is None:
+            out = apply("swin_window_attention",
+                        lambda qv, bv: fn(qv, bv, None), qkv, bias)
+        else:
+            out = apply("swin_window_attention", fn, qkv, bias, mask)
         if self.attn_drop and self.training:
             # post-softmax dropout folded onto the attention output (the
-            # per-prob variant needs the mask inside f; output dropout is
-            # the common simplification)
+            # per-prob variant needs the mask inside the kernel; output
+            # dropout is the common simplification)
             out = F.dropout(out, self.attn_drop, training=True)
         out = self.proj(out)
         if self.proj_drop and self.training:
@@ -149,17 +188,9 @@ class SwinBlock(nn.Layer):
         shortcut = x
         x = self.norm1(x)
         x = ops.reshape(x, [b, H, W, c])
-        if self.shift > 0:
-            x = apply("swin_roll",
-                      lambda v: jnp.roll(v, (-self.shift, -self.shift),
-                                         axis=(1, 2)), x)
-        windows = _window_partition(x, self.ws)
-        attn_out = self.attn(windows, self._attn_mask)
-        x = _window_reverse(attn_out, self.ws, H, W)
-        if self.shift > 0:
-            x = apply("swin_unroll",
-                      lambda v: jnp.roll(v, (self.shift, self.shift),
-                                         axis=(1, 2)), x)
+        # shift + partition + attention + bias + reverse all live behind
+        # the fused entry (Pallas on TPU, jnp reference elsewhere)
+        x = self.attn(x, self._attn_mask, shift=self.shift)
         x = ops.reshape(x, [b, L, c])
         x = ops.add(shortcut, x)
         return ops.add(x, self.mlp(self.norm2(x)))

@@ -1,0 +1,216 @@
+"""Layout-parity suite (ISSUE 10): the transpose-free FLAT attention
+layout is the default — these tests hold it bit-identical to the
+transpose core at the kernel level AND at the real model call sites
+(GPT causal MHA, LLaMA GQA+RoPE, ERNIE bidirectional + additive mask),
+so the default flip can never silently change training numerics.
+
+All kernels run through the Pallas interpreter on CPU (the fake-backend
+strategy, SURVEY §4.5): every layout executes the same shared
+recurrences (_online_softmax/_dq_loop/_dkv_loop) on the same block
+shapes, so equality is exact — asserted with array_equal, not
+allclose."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as P
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape),
+                       jnp.float32)
+
+
+def _loss(core, q, k, v, causal, bq, bk):
+    return core(q, k, v, causal, bq, bk).astype(jnp.float32).sum()
+
+
+@pytest.mark.parametrize("hq,hkv", [(2, 2), (4, 2)])
+def test_flat_vs_transpose_core_bit_identical(hq, hkv):
+    """Forward AND all three gradients of the flat core are bit-equal to
+    the transpose core (MHA and GQA) at shared block sizes — the
+    acceptance bar for making flat the default layout."""
+    B, S, D = 2, 64, 64
+    q = _rand((B, S, hq, D), 0)
+    k = _rand((B, S, hkv, D), 1)
+    v = _rand((B, S, hkv, D), 2)
+    for causal in (False, True):
+        out_t = fa._flash_core(q, k, v, causal, 32, 32)
+        out_f = fa._flash_core_flat(q, k, v, causal, 32, 32)
+        assert np.array_equal(np.asarray(out_t), np.asarray(out_f)), \
+            f"flat fwd differs from transpose (causal={causal})"
+        g_t = jax.grad(lambda *a: _loss(fa._flash_core, *a, causal,
+                                        32, 32),
+                       argnums=(0, 1, 2))(q, k, v)
+        g_f = jax.grad(lambda *a: _loss(fa._flash_core_flat, *a, causal,
+                                        32, 32),
+                       argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", g_t, g_f):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                f"d{name} differs between layouts (causal={causal})"
+
+
+def test_default_layout_is_flat(monkeypatch):
+    """With no FLAGS_flash_layout set, eligible shapes route to the
+    flat core (the ISSUE-10 default flip: _DEFAULT_LAYOUT='auto'
+    prefers flat wherever the static gates admit it)."""
+    monkeypatch.delenv("FLAGS_flash_layout", raising=False)
+    assert fa._DEFAULT_LAYOUT == "auto"
+    monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
+    B, S, H, D = 2, 64, 2, 64
+    q = _rand((B, S, H, D))
+    called = {}
+    orig = fa._flash_core_flat
+
+    def spy(*a, **kw):
+        called["flat"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_core_flat", spy)
+    out = fa.flash_attention_fwd(q, q, q, is_causal=True)
+    assert called.get("flat"), \
+        "default layout did not route an eligible shape to the flat core"
+    ref = fa._ref_attention(q, q, q, None, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # ineligible head width (d % 64 != 0) still lands on transpose
+    q2 = _rand((2, 64, 4, 32))
+    called2 = {}
+    orig_t = fa._flash_core
+
+    def spy_t(*a, **kw):
+        called2["transpose"] = True
+        return orig_t(*a, **kw)
+
+    monkeypatch.setattr(fa, "_flash_core", spy_t)
+    fa.flash_attention_fwd(q2, q2, q2, is_causal=True)
+    assert called2.get("transpose"), \
+        "gate-rejected shape did not fall back to the transpose core"
+
+
+def _llama_attention_grads(monkeypatch, layout):
+    """One LLaMA attention call site (GQA + RoPE + row/col projections)
+    forward + backward under the given layout; returns (out, dx, dw)."""
+    import paddle_tpu.ops.pallas as _pl
+    from paddle_tpu.models.llama import LlamaAttention, LlamaConfig
+
+    monkeypatch.setenv("FLAGS_flash_layout", layout)
+    monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
+    monkeypatch.setattr(_pl, "flash_attention_available",
+                        lambda q_: True)
+    P.seed(7)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128, num_layers=1,
+                      num_heads=2, num_kv_heads=1, max_seq_len=32,
+                      ffn_hidden=128)
+    attn = LlamaAttention(cfg)
+    x = P.to_tensor(np.random.RandomState(5)
+                    .randn(2, 32, 128).astype(np.float32))
+    x.stop_gradient = False
+    out = attn(x)
+    P.sum(out).backward()
+    return (out.numpy(), x.grad.numpy(),
+            attn.qkv_proj.weight.grad.numpy())
+
+
+def test_llama_call_site_flat_bit_identical(monkeypatch):
+    """The REAL LLaMA attention call site (fused qkv split, RoPE, GQA
+    with Hkv < Hq, out projection): forward, input grad, and qkv weight
+    grad are bit-identical between the transpose and flat layouts."""
+    out_t, dx_t, dw_t = _llama_attention_grads(monkeypatch, "transpose")
+    out_f, dx_f, dw_f = _llama_attention_grads(monkeypatch, "flat")
+    assert np.array_equal(out_t, out_f)
+    assert np.array_equal(dx_t, dx_f)
+    assert np.array_equal(dw_t, dw_f)
+
+
+def _gpt_attention_grads(monkeypatch, layout):
+    import paddle_tpu.ops.pallas as _pl
+    from paddle_tpu.models.gpt import GPTAttention, GPTConfig
+
+    monkeypatch.setenv("FLAGS_flash_layout", layout)
+    monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
+    monkeypatch.setattr(_pl, "flash_attention_available",
+                        lambda q_: True)
+    P.seed(9)
+    cfg = GPTConfig(vocab_size=128, hidden_size=128, num_layers=1,
+                    num_heads=2, max_seq_len=32)
+    attn = GPTAttention(cfg)
+    x = P.to_tensor(np.random.RandomState(6)
+                    .randn(2, 32, 128).astype(np.float32))
+    x.stop_gradient = False
+    out = attn(x)
+    P.sum(out).backward()
+    return (out.numpy(), x.grad.numpy(),
+            attn.qkv_proj.weight.grad.numpy())
+
+
+def test_gpt_call_site_flat_bit_identical(monkeypatch):
+    """The REAL GPT attention call site (fused qkv unbind, causal MHA,
+    out projection): forward + grads bit-identical across layouts."""
+    out_t, dx_t, dw_t = _gpt_attention_grads(monkeypatch, "transpose")
+    out_f, dx_f, dw_f = _gpt_attention_grads(monkeypatch, "flat")
+    assert np.array_equal(out_t, out_f)
+    assert np.array_equal(dx_t, dx_f)
+    assert np.array_equal(dw_t, dw_f)
+
+
+def _ernie_encoder_grads(monkeypatch, layout):
+    """One ERNIE encoder forward + backward (bidirectional attention
+    with an additive padding-mask bias — the biased, NON-causal flash
+    path) under the given layout; returns (seq_out, d_word_emb)."""
+    import paddle_tpu.ops.pallas as _pl
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieModel
+
+    monkeypatch.setenv("FLAGS_flash_layout", layout)
+    monkeypatch.setattr(fa, "flash_attention_available", lambda q_: True)
+    monkeypatch.setattr(_pl, "flash_attention_available",
+                        lambda q_: True)
+    P.seed(11)
+    cfg = ErnieConfig(vocab_size=128, hidden_size=128, num_layers=1,
+                      num_heads=2, ffn_hidden=128, dropout=0.0)
+    model = ErnieModel(cfg)
+    rs = np.random.RandomState(3)
+    ids = P.to_tensor(rs.randint(1, 128, (2, 32)), "int32")
+    mask = np.ones((2, 32), np.float32)
+    mask[:, 24:] = 0.0  # padded tail: the additive bias band is live
+    seq, pooled = model(ids, attention_mask=P.to_tensor(mask))
+    (P.sum(seq) + P.sum(pooled)).backward()
+    return (seq.numpy(),
+            model.embeddings.word_embeddings.weight.grad.numpy())
+
+
+def test_ernie_call_site_flat_bit_identical(monkeypatch):
+    """The REAL ERNIE call site (bidirectional attention + additive
+    stop-gradient padding mask through the biased flash tier): forward
+    and embedding grads bit-identical between layouts — the third
+    attention family (after causal-MHA GPT and GQA+RoPE LLaMA) the
+    default flip must not perturb."""
+    out_t, demb_t = _ernie_encoder_grads(monkeypatch, "transpose")
+    out_f, demb_f = _ernie_encoder_grads(monkeypatch, "flat")
+    assert np.array_equal(out_t, out_f)
+    assert np.array_equal(demb_t, demb_f)
+
+
+def test_window_partition_reverse_roundtrip():
+    """window_reverse(window_partition(x)) == x for every (H, W, ws)
+    tiling — the property the fused Swin kernel's in-kernel partition
+    rests on — and partition produces row-major window order."""
+    from paddle_tpu.ops.pallas.window_attention import (
+        window_partition, window_reverse,
+    )
+
+    rs = np.random.RandomState(0)
+    for (H, W, ws, C) in ((8, 8, 4, 6), (12, 8, 4, 3), (14, 14, 7, 5),
+                          (4, 4, 4, 2)):
+        x = jnp.asarray(rs.randn(2, H, W, C), jnp.float32)
+        wins = window_partition(x, ws)
+        assert wins.shape == (2 * (H // ws) * (W // ws), ws * ws, C)
+        back = window_reverse(wins, ws, H, W)
+        assert np.array_equal(np.asarray(back), np.asarray(x))
+        # first window is the top-left tile, row-major
+        assert np.array_equal(
+            np.asarray(wins[0].reshape(ws, ws, C)),
+            np.asarray(x[0, :ws, :ws, :]))

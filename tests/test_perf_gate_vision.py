@@ -1,0 +1,74 @@
+"""Vision throughput metrics gate like GPT's (ISSUE 10 satellite):
+`swin_t_train_images_per_sec_per_chip` / `resnet50_...` rows from
+bench.py round-trip through tools/perf_gate.py --update and then gate
+regressions — vision can no longer regress silently while only the GPT
+headline is floored."""
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VISION_METRICS = ("swin_t_train_images_per_sec_per_chip",
+                  "resnet50_train_images_per_sec_per_chip")
+
+
+def _pg():
+    spec = importlib.util.spec_from_file_location(
+        "_perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_emits_vision_metrics():
+    """bench.py's secondary-bench source carries both vision metrics
+    (the strings are what chip_session/perf_gate key on — a rename
+    would orphan every baseline row)."""
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    for m in VISION_METRICS:
+        assert f'"{m}"' in src, m
+
+
+def test_vision_rows_update_round_trip(tmp_path):
+    """--update appends the vision rows to the baseline; a later run
+    gates them: within tolerance passes, a regression beyond tolerance
+    fails — the full acceptance loop on both vision metrics."""
+    pg = _pg()
+    baseline = tmp_path / "baseline.jsonl"
+    baseline.write_text("")  # start empty
+
+    results = [{"metric": m, "value": 100.0, "unit": "images/s"}
+               for m in VISION_METRICS]
+    n = pg.update_baseline(results, str(baseline))
+    assert n == 2
+    base = pg.load_baseline(str(baseline))
+    assert set(base) == set(VISION_METRICS)
+
+    ok_rows = [{"metric": m, "value": 95.0, "unit": "images/s"}
+               for m in VISION_METRICS]
+    failures, _ = pg.gate(ok_rows, base, tolerance=0.10)
+    assert failures == []
+
+    bad_rows = [{"metric": VISION_METRICS[0], "value": 50.0,
+                 "unit": "images/s"}]
+    failures, report = pg.gate(bad_rows, base, tolerance=0.10)
+    assert len(failures) == 1 and VISION_METRICS[0] in failures[0], \
+        report
+
+
+def test_degraded_vision_rows_never_update_or_gate(tmp_path):
+    """CPU-proxy (degraded) vision rows are excluded from --update and
+    skipped by the gate — a proxy number must never become or be judged
+    against an on-chip floor."""
+    pg = _pg()
+    baseline = tmp_path / "baseline.jsonl"
+    baseline.write_text(json.dumps(
+        {"metric": VISION_METRICS[0], "value": 100.0,
+         "unit": "images/s"}) + "\n")
+    degraded = [{"metric": VISION_METRICS[0], "value": 1.0,
+                 "unit": "images/s", "degraded": True}]
+    assert pg.update_baseline(degraded, str(baseline)) == 0
+    failures, report = pg.gate(degraded, pg.load_baseline(str(baseline)))
+    assert failures == [] and any("SKIP" in l for l in report)

@@ -925,44 +925,66 @@ def phase_mh_bisect():
 
 def _swin_attention_variant(kind):
     """Ablated WindowAttention.forward bodies for phase_vision_breakdown
-    (module-level so the CPU suite can exercise them without hardware)."""
+    (module-level so the CPU suite can exercise them without hardware).
+
+    Matches the CURRENT WindowAttention contract (ISSUE 10): image-layout
+    input ``forward(self, x_img, mask=None, shift=0)`` with roll/window
+    partition handled inside — the ablated bodies therefore perform the
+    roll + partition/reverse themselves via the reference helpers, so
+    the ``identity`` rung still measures exactly the GEMMs + norms +
+    partition/roll transposes the PERF.md ablation table is built on."""
     import jax
+    import jax.numpy as jnp
 
     from paddle_tpu.core.dispatch import apply as _apply
+    from paddle_tpu.ops.pallas.window_attention import (
+        window_partition, window_reverse)
 
-    def forward(self, x, mask=None):
+    def forward(self, x_img, mask=None, shift=0):
         n_tok = self.ws * self.ws
         heads = self.num_heads
         hd = self.dim // heads
-        qkv = self.qkv(x)
-        if kind == "identity":
-            # keep BOTH projection GEMMs (qkv + proj) so the
-            # mm_only-identity delta isolates the attention math alone.
-            # All three qkv slices are consumed (summed) — a lone
-            # [..., :dim] slice would let XLA's slice-of-dot rewrite
-            # shrink the qkv GEMM to a third and skew the ablation
-            return self.proj(_apply(
-                "window_attention",
-                lambda v: (v[..., :self.dim] + v[..., self.dim:2 * self.dim]
-                           + v[..., 2 * self.dim:]), qkv))
+        dim = self.dim
+        ws = self.ws
+        B, H, W, _ = x_img.shape
+        shift = int(shift)
+        qkv = self.qkv(x_img)                      # [B, H, W, 3C]
 
-        def f(qkv_v, bias_tab, mask_v):
-            Bw = qkv_v.shape[0]
-            qkv_ = qkv_v.reshape(Bw, n_tok, 3, heads, hd)
-            q, k, v = (qkv_[:, :, i].transpose(0, 2, 1, 3)
-                       for i in range(3))
-            attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
-            if kind != "mm_only":
-                if mask_v is not None:
-                    nw = mask_v.shape[0]
-                    attn = attn.reshape(Bw // nw, nw, heads, n_tok,
-                                        n_tok) + mask_v[None, :, None]
-                    attn = attn.reshape(Bw, heads, n_tok, n_tok)
-                attn = jax.nn.softmax(attn, axis=-1)
-            return (attn @ v).transpose(0, 2, 1, 3).reshape(
-                Bw, n_tok, self.dim)
+        def body(qkv_img, bias_tab, mask_v):
+            x = qkv_img
+            if shift:
+                x = jnp.roll(x, (-shift, -shift), axis=(1, 2))
+            wins = window_partition(x, ws)         # [B*nW, n_tok, 3C]
+            if kind == "identity":
+                # keep BOTH projection GEMMs (qkv + proj) AND the
+                # roll/partition machinery so the mm_only-identity delta
+                # isolates the attention math alone. All three qkv
+                # slices are consumed (summed) — a lone [..., :dim]
+                # slice would let XLA's slice-of-dot rewrite shrink the
+                # qkv GEMM to a third and skew the ablation
+                out = (wins[..., :dim] + wins[..., dim:2 * dim]
+                       + wins[..., 2 * dim:])
+            else:
+                Bw = wins.shape[0]
+                qkv_ = wins.reshape(Bw, n_tok, 3, heads, hd)
+                q, k, v = (qkv_[:, :, i].transpose(0, 2, 1, 3)
+                           for i in range(3))
+                attn = (q * self.scale) @ k.transpose(0, 1, 3, 2)
+                if kind != "mm_only":
+                    if mask_v is not None:
+                        nw = mask_v.shape[0]
+                        attn = attn.reshape(Bw // nw, nw, heads, n_tok,
+                                            n_tok) + mask_v[None, :, None]
+                        attn = attn.reshape(Bw, heads, n_tok, n_tok)
+                    attn = jax.nn.softmax(attn, axis=-1)
+                out = (attn @ v).transpose(0, 2, 1, 3).reshape(
+                    Bw, n_tok, dim)
+            img = window_reverse(out, ws, H, W)    # [B, H, W, C]
+            if shift:
+                img = jnp.roll(img, (shift, shift), axis=(1, 2))
+            return img
 
-        return self.proj(_apply("window_attention", f, qkv,
+        return self.proj(_apply("window_attention", body, qkv,
                                 self.rel_bias, mask))
 
     return forward

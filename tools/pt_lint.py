@@ -205,9 +205,9 @@ def main(argv=None) -> int:
                     help="perf audit over the FULL program set "
                          "(adds the op-table sweep — slow tier)")
     ap.add_argument("--perf-programs", default=None,
-                    help="comma list among train_step,decode_step,"
-                         "paged_decode_step,call_sites,op_table "
-                         "(overrides the subset)")
+                    help="comma list among train_step,swin_train_step,"
+                         "decode_step,paged_decode_step,call_sites,"
+                         "op_table (overrides the subset)")
     ap.add_argument("--update-budget", action="store_true",
                     help="rewrite tools/perf_budget.json from a full "
                          "perf audit")
