@@ -36,6 +36,15 @@ silently on a CPU-only CI box:
 Representative programs (all built under ``JAX_PLATFORMS=cpu``):
   * ``train_step``  — the hybrid GPT train step at a small proxy shape
                       (same structure/dtypes as the bench shape)
+  * ``sharded_train_step`` — the SAME GPT proxy under the default
+                      multi-chip configuration (dp=8 over the audit
+                      env's virtual devices → auto ZeRO-1, ISSUE 11):
+                      its committed budget pins the sharded weight
+                      update — ``pt403_replicated_*`` ≈ 0 (params AND
+                      optimizer state live dp-sharded) and the
+                      ``pt404_opt_*`` collective counts hold the wire
+                      shape, so a reintroduced replicated update fails
+                      CI before a TPU ever runs
   * ``swin_train_step`` — the Swin train step at a tiny proxy shape
                       (pins the windowed-attention layout tax: roll /
                       window-partition transposes, rel-pos-bias
@@ -73,6 +82,7 @@ from .trace_safety import _dotted, _is_jit_callee, _jit_decorator
 __all__ = [
     "RULE_IDS", "DEFAULT_PROGRAMS", "FULL_PROGRAMS",
     "layout_tax", "weak_input_count", "replicated_args",
+    "replicated_arg_details", "collective_hlo_counts",
     "collective_patterns", "host_sync_counts", "call_site_hazards",
     "audit_program_texts", "audit_perf", "metrics_to_static_rows",
     "audit_hlo", "train_step_hlo",
@@ -82,10 +92,11 @@ RULE_IDS = ("PT400", "PT401", "PT402", "PT403", "PT404", "PT405")
 
 # program names: the fast subset runs in the tier-1 smoke; FULL adds the
 # op-table sweep (slow tier — imports + traces the whole exported surface)
-DEFAULT_PROGRAMS = ("train_step", "swin_train_step", "decode_step",
-                    "paged_decode_step", "call_sites")
-FULL_PROGRAMS = ("train_step", "swin_train_step", "decode_step",
-                 "paged_decode_step", "call_sites", "op_table")
+DEFAULT_PROGRAMS = ("train_step", "sharded_train_step", "swin_train_step",
+                    "decode_step", "paged_decode_step", "call_sites")
+FULL_PROGRAMS = ("train_step", "sharded_train_step", "swin_train_step",
+                 "decode_step", "paged_decode_step", "call_sites",
+                 "op_table")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -250,18 +261,17 @@ _SHARDED_ATTR = re.compile(r'mhlo\.sharding\s*=\s*"\{devices=')
 _DONATED = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
 
 
-def replicated_args(stablehlo_text: str, min_mbytes: float = 0.05) -> dict:
-    """PT403: ``@main`` arguments at least ``min_mbytes`` big whose
-    sharding attr is absent or ``{replicated}`` — the state a
-    cross-replica weight-update sharding pass (ZeRO-1) should shard.
-    Donated-but-replicated still counts: donation halves peak memory,
-    sharding divides it by the replica count."""
+def _iter_replicated_args(stablehlo_text: str, min_mbytes: float):
+    """Yield ``(arg_index, mbytes)`` for every ``@main`` argument at
+    least ``min_mbytes`` big whose sharding attr is absent or
+    ``{replicated}``."""
     main = stablehlo_text.split("func.func public @main", 1)
     if len(main) < 2:
-        return {"pt403_replicated_count": 0, "pt403_replicated_mbytes": 0.0}
+        return
     header = main[1].split("->", 1)[0]
-    count, mbytes = 0, 0.0
-    for chunk in re.split(r"%arg\d+:", header)[1:]:
+    parts = re.split(r"%arg(\d+):", header)[1:]
+    for i in range(0, len(parts) - 1, 2):
+        idx, chunk = int(parts[i]), parts[i + 1]
         m = _ARG_TENSOR.search(chunk)
         if m is None:
             continue
@@ -270,10 +280,79 @@ def replicated_args(stablehlo_text: str, min_mbytes: float = 0.05) -> dict:
         if mb < min_mbytes:
             continue
         if not _SHARDED_ATTR.search(chunk):
-            count += 1
-            mbytes += mb
+            yield idx, mb
+
+
+def replicated_args(stablehlo_text: str, min_mbytes: float = 0.05) -> dict:
+    """PT403: ``@main`` arguments at least ``min_mbytes`` big whose
+    sharding attr is absent or ``{replicated}`` — the state a
+    cross-replica weight-update sharding pass (ZeRO-1) should shard.
+    Donated-but-replicated still counts: donation halves peak memory,
+    sharding divides it by the replica count."""
+    count, mbytes = 0, 0.0
+    for _idx, mb in _iter_replicated_args(stablehlo_text, min_mbytes):
+        count += 1
+        mbytes += mb
     return {"pt403_replicated_count": count,
             "pt403_replicated_mbytes": _r2(mbytes)}
+
+
+def replicated_arg_details(stablehlo_text: str, min_mbytes: float = 0.05,
+                           arg_names=None) -> list:
+    """PT403 offenders as ``[(owner, mbytes)]``, biggest first.  With
+    ``arg_names`` (flattened jit-argument names, index-aligned with the
+    ``@main`` args) the owner is the PARAMETER the replicated buffer
+    belongs to — budget regressions become actionable from the lint
+    output alone (ISSUE 11 satellite)."""
+    out = []
+    for idx, mb in _iter_replicated_args(stablehlo_text, min_mbytes):
+        name = None
+        if arg_names is not None and 0 <= idx < len(arg_names):
+            name = arg_names[idx]
+        out.append((name or f"arg{idx}", _r2(mb)))
+    out.sort(key=lambda t: (-t[1], t[0]))
+    return out
+
+
+# ---------------- PT404: compiled collective shape ----------------
+
+# optimized-HLO collective ops (async forms count once via `-start`;
+# `-done` is the same op completing).  The result-type run between `=`
+# and the op name must admit parentheses: async collectives carry TUPLE
+# result types (`= (f32[64]{0}, f32[64]{0}) all-reduce-start(`).  `%`
+# stays excluded so operand references to collective-named values
+# (`fusion(f32[] %all-reduce.3)`) never count.
+_OPT_COLLECTIVE = re.compile(
+    r"=\s*[a-z0-9_\[\](),{}:\s]*?\b"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute)"
+    r"(?:-start)?\(")
+
+
+def collective_hlo_counts(opt_hlo_text: str) -> dict:
+    """PT404 metrics from the COMPILED (partitioned) program: how many
+    of each collective the executable actually schedules.  For the
+    sharded train step these pin the ZeRO-1 wire shape from both
+    directions: the committed count ceilings catch growth-class
+    regressions (per-layer param gathers), and the derived
+    ``pt404_grad_sync_deficit`` (params minus scheduled additive
+    collectives, budget 0 — computed in ``audit_perf``) catches the
+    opposite one, grad syncs fused into an end-of-backward barrier,
+    which LOWERS the raw counts and would otherwise read as an
+    "improvement".  Note the CPU
+    partitioner realizes reduce-scatter as all-reduce+dynamic-slice
+    (the fused op is the TPU pipeline's rewrite — the *Automatic
+    Cross-Replica Sharding* pass), so ``reduce_scatter`` may read 0 on
+    the CPU-audited view while the same program scatters on TPU."""
+    counts = {"all-reduce": 0, "all-gather": 0, "reduce-scatter": 0,
+              "collective-permute": 0}
+    for m in _OPT_COLLECTIVE.finditer(opt_hlo_text):
+        counts[m.group(1)] += 1
+    return {
+        "pt404_opt_all_reduce_count": counts["all-reduce"],
+        "pt404_opt_all_gather_count": counts["all-gather"],
+        "pt404_opt_reduce_scatter_count": counts["reduce-scatter"],
+        "pt404_opt_collective_permute_count": counts["collective-permute"],
+    }
 
 
 # -------------------- PT404 / PT405: jaxpr walks --------------------
@@ -340,15 +419,18 @@ def host_sync_counts(closed_jaxpr) -> dict:
 def audit_program_texts(where: str, closed_jaxpr=None,
                         stablehlo_text: str = "",
                         opt_hlo_text: str = "",
-                        min_replicated_mbytes: float = 0.05):
+                        min_replicated_mbytes: float = 0.05,
+                        arg_names=None):
     """(violations, metrics) for one program given whichever of its
     three views (jaxpr / StableHLO / optimized HLO) the caller has.
     Pure aggregation — no jax imports, so text fixtures test it
-    directly."""
+    directly.  ``arg_names`` (flattened jit-argument names) lets the
+    PT403 finding name the owning parameters."""
     metrics = {}
     metrics.update(layout_tax(stablehlo_text, opt_hlo_text))
     metrics.update(replicated_args(stablehlo_text,
                                    min_replicated_mbytes))
+    metrics.update(collective_hlo_counts(opt_hlo_text))
     if closed_jaxpr is not None:
         metrics["pt402_weak_inputs"] = weak_input_count(closed_jaxpr)
         metrics.update(collective_patterns(closed_jaxpr))
@@ -372,12 +454,17 @@ def audit_program_texts(where: str, closed_jaxpr=None,
             f"input(s) — each is a jit cache-key split (Python scalar "
             f"vs array argument compile twice)"))
     if metrics.get("pt403_replicated_count"):
+        owners = replicated_arg_details(
+            stablehlo_text, min_replicated_mbytes, arg_names)
+        top = ", ".join(f"{n} {mb} MiB" for n, mb in owners[:4])
+        if len(owners) > 4:
+            top += f", +{len(owners) - 4} more"
         out.append(Violation(
             w, 0, "PT403",
             f"{metrics['pt403_replicated_count']} argument(s) "
             f"≥{min_replicated_mbytes} MiB left replicated "
             f"({metrics['pt403_replicated_mbytes']} MiB — ZeRO-1 "
-            f"weight-update sharding opportunity)"))
+            f"weight-update sharding opportunity; top: {top})"))
     if metrics.get("pt404_allgather_reduce"):
         out.append(Violation(
             w, 0, "PT404",
@@ -405,6 +492,36 @@ def audit_program_texts(where: str, closed_jaxpr=None,
 # ---------------------- representative programs ----------------------
 
 
+def _flat_arg_names(step, placed):
+    """Flattened jit-argument names for a ``DistributedTrainStep``'s
+    compiled step, index-aligned with the lowered ``@main`` arguments
+    (jit flattens positional args in order; dict leaves flatten in
+    sorted-key order).  Lets PT403 findings name the owning parameter
+    instead of a bare arg index."""
+    import jax
+
+    def walk(label, tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        out = []
+        for path, _leaf in flat:
+            suffix = ""
+            for k in path:
+                part = getattr(k, "key", None)
+                if part is None:
+                    part = getattr(k, "idx", None)
+                if part is None:
+                    part = getattr(k, "name", k)
+                suffix += f".{part}"
+            out.append(label + suffix)
+        return out
+
+    s = step._state
+    names = walk("param", s["params"]) + walk("opt", s["opt"]) + \
+        walk("buffer", s["buffers"]) + ["key", "lr"]
+    names += [f"batch.{i}" for i in range(len(placed))]
+    return names
+
+
 def _train_step_program(batch=2, seq=128, layers=1):
     """The hybrid GPT train step at the proxy shape the Layer-3 audit
     uses (same structure/dtypes as the bench shape, small enough that
@@ -426,7 +543,7 @@ def _train_step_program(batch=2, seq=128, layers=1):
                   dropout=0.0)
     lowered, model = _build_lowered(rs_cfg, batch, seq)
     step = model._train_step
-    jaxpr = None
+    jaxpr = names = None
     if step is not None and getattr(step, "_step_fn", None) is not None:
         import numpy as np
 
@@ -440,7 +557,91 @@ def _train_step_program(batch=2, seq=128, layers=1):
         lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
         jaxpr = jax.make_jaxpr(step._step_fn)(
             s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
-    return lowered, jaxpr
+        names = _flat_arg_names(step, placed)
+    return lowered, jaxpr, names
+
+
+def build_default_multichip_step(model_cfg=None, dp=8, seq=128, layers=1):
+    """ONE definition of "the default multi-chip training
+    configuration" (docs/SHARDING.md): dp=``dp`` with
+    ``sharding_degree=dp`` and NO explicit stage, so the fleet wiring
+    must auto-resolve ZeRO-1.  Shared by the static audit below and
+    bench.py's ``--multichip-sharded-probe`` — the CI gate and the
+    bench placement proof audit the SAME configuration by
+    construction.  Returns ``(step, cfg)``; raises if the wiring does
+    not resolve ZeRO-1."""
+    import paddle_tpu as P
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    topology.reset_topology()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "sharding_degree": dp}
+    fleet.init(is_collective=True, strategy=strategy)
+    P.seed(0)
+    cfg = model_cfg or GPTConfig(
+        vocab_size=1024, hidden_size=64, num_layers=layers,
+        num_heads=4, max_seq_len=seq, fused_head_ce=True, dropout=0.0)
+    inner = GPTForCausalLM(cfg)
+    model = fleet.distributed_model(inner)
+    opt = fleet.distributed_optimizer(P.optimizer.AdamW(
+        parameters=model.parameters(), learning_rate=1e-4))
+    step = model.build_train_step(
+        opt, GPTPretrainingCriterion(model=inner),
+        amp_dtype="bfloat16")
+    if step.sharding_stage != 1:
+        raise RuntimeError(
+            f"expected auto ZeRO-1 under sharding_degree={dp}, got "
+            f"stage {step.sharding_stage} — fleet sharding_degree "
+            f"wiring broken")
+    return step, cfg
+
+
+def _sharded_train_step_program(batch=8, seq=128, layers=1):
+    """The SAME GPT proxy as ``train_step``, built under the default
+    multi-chip configuration (``build_default_multichip_step``) — this
+    program audits the path users actually get, not a hand-assembled
+    one.  The global fleet/topology state it installs is RESTORED
+    afterwards: audit results must not depend on program order (the
+    later programs re-audit under their own configs, and in-process
+    callers like pytest keep their fleet).  Returns
+    ``(lowered, closed_jaxpr, arg_names)``."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as P
+    from paddle_tpu.distributed import fleet, topology
+
+    prev_topo = topology._topology
+    prev_strategy = fleet._state.strategy
+    prev_fleet_topo = fleet._state.topo
+    prev_init = fleet._state.initialized
+    try:
+        step, cfg = build_default_multichip_step(
+            dp=8, seq=seq, layers=layers)
+        rs = np.random.RandomState(0)
+        ids = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                          "int32")
+        labels = P.to_tensor(rs.randint(0, cfg.vocab_size, (batch, seq)),
+                             "int32")
+        lowered = step.lower(ids, labels)
+        placed, _ = step._place_batch((ids, labels), batch_axis=0)
+        s = step._state
+        lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
+        jaxpr = jax.make_jaxpr(step._step_fn)(
+            s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
+        return lowered, jaxpr, _flat_arg_names(step, placed)
+    finally:
+        topology.set_topology(prev_topo)
+        fleet._state.strategy = prev_strategy
+        fleet._state.topo = prev_fleet_topo
+        fleet._state.initialized = prev_init
 
 
 def _decode_step_program(batch=2, prompt=8, new_tokens=8):
@@ -506,7 +707,7 @@ def _swin_train_step_program(batch=2, img=32):
     imgs = P.to_tensor(rs.rand(batch, 3, img, img).astype(np.float32))
     labels = P.to_tensor(rs.randint(0, 8, (batch,)), "int32")
     lowered = step.lower(imgs, labels)
-    jaxpr = None
+    jaxpr = names = None
     if getattr(step, "_step_fn", None) is not None:
         import jax.numpy as jnp
 
@@ -515,7 +716,8 @@ def _swin_train_step_program(batch=2, img=32):
         lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
         jaxpr = jax.make_jaxpr(step._step_fn)(
             s["params"], s["opt"], s["buffers"], s["key"], lr, *placed)
-    return lowered, jaxpr
+        names = _flat_arg_names(step, placed)
+    return lowered, jaxpr, names
 
 
 def _paged_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
@@ -551,7 +753,7 @@ def _paged_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
     return lowered, jaxpr
 
 
-def _audit_lowered(name: str, lowered, jaxpr=None):
+def _audit_lowered(name: str, lowered, jaxpr=None, arg_names=None):
     """All three views of one lowered program -> (violations, metrics).
     A missing view is a PT400 — an absent metric is invisible to the
     budget diff (only present metrics are judged), so partial blindness
@@ -574,7 +776,8 @@ def _audit_lowered(name: str, lowered, jaxpr=None):
                              f"compile failed ({type(e).__name__}) — "
                              f"optimized-HLO view unavailable"))
     v, m = audit_program_texts(name, closed_jaxpr=jaxpr,
-                               stablehlo_text=text, opt_hlo_text=opt)
+                               stablehlo_text=text, opt_hlo_text=opt,
+                               arg_names=arg_names)
     return pre + v, m
 
 
@@ -707,25 +910,51 @@ def audit_perf(programs=DEFAULT_PROGRAMS, repo_root=None):
     for prog in programs:
         if prog == "call_sites":
             v, m = _audit_call_sites(repo_root)
-        elif prog in ("train_step", "swin_train_step", "decode_step",
+        elif prog in ("train_step", "sharded_train_step",
+                      "swin_train_step", "decode_step",
                       "paged_decode_step"):
             full = {"train_step": "gpt125m_train_step",
+                    "sharded_train_step": "gpt_sharded_train_step",
                     "swin_train_step": "swin_train_step",
                     "decode_step": "gpt_decode_step",
                     "paged_decode_step": "gpt_paged_decode_step"}[prog]
             build = {"train_step": _train_step_program,
+                     "sharded_train_step": _sharded_train_step_program,
                      "swin_train_step": _swin_train_step_program,
                      "decode_step": _decode_step_program,
                      "paged_decode_step": _paged_decode_step_program}[prog]
             try:
-                lowered, jaxpr = build()
+                out = build()
             except Exception as e:
                 v, m = [Violation(f"perf:{full}", 0, "PT400",
                                   f"{prog} failed to build/lower "
                                   f"({type(e).__name__}: "
                                   f"{str(e)[:80]})")], {}
             else:
-                v, m = _audit_lowered(full, lowered, jaxpr)
+                lowered, jaxpr = out[0], out[1]
+                names = out[2] if len(out) > 2 else None
+                v, m = _audit_lowered(full, lowered, jaxpr,
+                                      arg_names=names)
+                if prog == "sharded_train_step" and m and names:
+                    # per-parameter grad sync or bust: the raw counts
+                    # only gate INCREASES (budget = ceiling), but the
+                    # fused-barrier regression LOWERS them — this
+                    # derived deficit (params minus scheduled additive
+                    # collectives, floored at 0) rises instead, and its
+                    # committed budget of 0 makes `--perf --check` fail
+                    n_params = sum(1 for x in names
+                                   if x.startswith("param."))
+                    sync = m.get("pt404_opt_all_reduce_count", 0) + \
+                        m.get("pt404_opt_reduce_scatter_count", 0)
+                    m["pt404_grad_sync_deficit"] = max(
+                        0, n_params - sync)
+                    if m["pt404_grad_sync_deficit"]:
+                        v.append(Violation(
+                            f"perf:{full}", 0, "PT404",
+                            f"only {sync} additive collective(s) for "
+                            f"{n_params} parameters — grad sync has "
+                            f"been fused toward a barrier (overlap "
+                            f"lost)"))
             metrics[full] = m
             violations.extend(v)
             continue

@@ -387,11 +387,11 @@ def load_state_dict(state_dict, path, process_group=None,
                 and not tgt_sharding.is_fully_replicated
                 and gshape != ())
             if is_sharded:
-                t._value = jax.make_array_from_callback(
+                t._value = _owned_copy(jax.make_array_from_callback(
                     gshape, tgt_sharding,
                     lambda idx, _k=key, _i=info: np.ascontiguousarray(
                         _assemble_block(_k, _i, reader, idx)).astype(
-                            dt, copy=False))
+                            dt, copy=False)))
                 continue
             # replicated / unsharded target: the full array IS the target
             full = _assemble_block(
@@ -416,10 +416,32 @@ def load_state_dict(state_dict, path, process_group=None,
                             error=f"{type(e).__name__}: {e}")
                     except Exception:  # pt-lint: ok[PT005]
                         pass           # (observability fan-out guard)
-            t._value = val
+            t._value = _owned_copy(val)
     finally:
         reader.close()
     return state_dict
+
+
+# one jit object: executables cache per (shape, dtype, sharding) inside
+_owned_copy_jit = jax.jit(lambda a: jax.lax.optimization_barrier(a))
+
+
+def _owned_copy(val):
+    """An XLA-owned, bit-exact copy of `val`, preserving its sharding.
+
+    jax/jaxlib 0.4.3x on CPU zero-copy *adopts* suitably-aligned host
+    numpy buffers in `device_put`/`make_array_from_callback`.  DONATING
+    such an adopted buffer into a compiled program makes XLA free/reuse
+    memory it does not own — glibc heap corruption (`corrupted
+    double-linked list`, random segfaults) in exactly the restore flow:
+    load a checkpoint, then dispatch the already-compiled donated train
+    step.  (The init path never hits it: its state is built from jax
+    arrays, which device_put copies on device.)  Routing every loaded
+    leaf through a real computation forces an XLA-allocated result
+    buffer; `optimization_barrier` is the one identity the algebraic
+    simplifier will not fold away into a pass-through alias, and it is
+    bit-exact for every dtype."""
+    return _owned_copy_jit(val)
 
 
 def verify_checkpoint(path, deep=True):

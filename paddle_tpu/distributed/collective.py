@@ -193,14 +193,52 @@ def _infer_spec(val, mesh, axis):
     return P()
 
 
-def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+def _resolve_precision(op, precision):
+    """The EQuARX tier applies to additive reductions only (sum/avg —
+    the gradient-sync ops); max/min/prod stay exact.  Resolution
+    happens per call so the env knob can flip between eager steps.
+    Validation runs for EVERY op — a typo'd tier on a max/min sync must
+    fail loudly, not silently run exact."""
+    from . import quantized as _quantized
+
+    prec = _quantized.collective_precision(precision)
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        return None
+    return prec
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               precision=None):
+    """All-reduce over the group axis.  ``precision`` (or the
+    ``PADDLE_TPU_COLLECTIVE_PRECISION`` env knob) selects the quantized
+    wire tier for sum/avg: per-chunk-scaled int8 (int32-accumulated) or
+    bf16 payloads — docs/SHARDING.md "Precision knob"."""
     _metrics.inc("collective.calls", kind="all_reduce")
     g = _default_group(group)
     axis = g.axis
+    prec = _resolve_precision(op, precision)
+    if prec is not None:
+        from . import quantized as _quantized
+
+        val = tensor._value if isinstance(tensor, Tensor) else tensor
+        if _quantized._quantizable(val):
+            # count only payloads that actually ride the lossy codec —
+            # integer syncs reduce exactly (quantized._quantizable)
+            _metrics.inc("collective.quantized", kind="all_reduce",
+                         precision=prec)
+
+        def red_q(v, a):
+            out = _quantized.psum(v, a, prec)
+            if op == ReduceOp.AVG:
+                out = out / g.get_world_size()
+            return out
+
     if flags.in_trace():
         # SPMD path: lower directly to the named-axis collective
         red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
                "avg": lambda v, a: jax.lax.pmean(v, a)}[op]
+        if prec is not None:
+            red = red_q
         out = apply("all_reduce", lambda v: red(v, axis), tensor)
         tensor._rebind(out) if isinstance(tensor, Tensor) else None
         return tensor
@@ -209,6 +247,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin,
                "avg": lambda t, a: jax.lax.pmean(t, a),
                "prod": lambda t, a: jnp.exp(jax.lax.psum(jnp.log(t), a))}[op]
+        if prec is not None:
+            red = red_q
         return red(v, axis)
 
     out = _eager_collective("all_reduce", tensor, g, body)
@@ -252,10 +292,32 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
-                   sync_op=True):
+                   sync_op=True, precision=None):
+    """Reduce-scatter over the group axis (the ZeRO-1 grad-sync shape:
+    every replica receives its 1/N summed slice, moving 1/N the bytes an
+    all-reduce would).  ``precision`` / the env knob select the
+    quantized wire tier — chunks are laid out per destination slice so
+    each replica dequantizes its slice with pmax-shared scales."""
     _metrics.inc("collective.calls", kind="reduce_scatter")
     g = _default_group(group)
     ax = g.axis
+    # the quantized tier applies to SUM only here: this function's
+    # non-sum ops have always reduced as SUM (pre-existing psum_scatter
+    # semantics), and the knob must never make AVG/MAX behave
+    # differently from the exact path
+    prec = _resolve_precision(op, precision)
+    if op != ReduceOp.SUM:
+        prec = None
+    if prec is not None:
+        from . import quantized as _quantized
+
+        src0 = tensor_or_tensor_list
+        if isinstance(src0, (list, tuple)):
+            src0 = src0[0]
+        if _quantized._quantizable(
+                src0._value if isinstance(src0, Tensor) else src0):
+            _metrics.inc("collective.quantized", kind="reduce_scatter",
+                         precision=prec)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         from .. import ops
@@ -263,6 +325,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         src = ops.concat(list(src), axis=0)
 
     def body(v):
+        if prec is not None:
+            return _quantized.psum_scatter(v, ax, g.get_world_size(), prec)
         return jax.lax.psum_scatter(v, ax, scatter_dimension=0, tiled=True)
 
     if flags.in_trace():

@@ -19,7 +19,7 @@ from ...optimizer.optimizer import Optimizer
 __all__ = ["DistributedStrategy", "init", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "init_parallel_env", "worker_num", "worker_index",
-           "is_first_worker", "barrier_worker"]
+           "is_first_worker", "barrier_worker", "resolve_sharding_stage"]
 
 
 class DistributedStrategy:
@@ -60,6 +60,36 @@ class _FleetState:
 
 
 _state = _FleetState()
+
+
+def resolve_sharding_stage(strategy):
+    """The ZeRO stage a strategy asks for (ISSUE 11 wiring: the
+    ``sharding_degree`` / ``sharding_configs["stage"]`` stubs now reach
+    ``DistributedTrainStep(sharding_stage=...)``):
+
+      * ``strategy.sharding`` set      → ``sharding_configs["stage"]``
+        (the explicit GroupSharded request, parity with the reference's
+        DygraphShardingOptimizer selection)
+      * ``sharding_degree > 1``        → ZeRO-1 — sharded weight update
+        is the DEFAULT multi-chip training configuration (ROADMAP item
+        1); the update is bit-identical to the replicated one (pinned by
+        tests/test_sharding_zero.py), so opting in costs nothing
+      * otherwise                      → stage 0: a strategy that says
+        ``sharding_degree=1`` asked for a replicated update, even when
+        the topology auto-expands its device axis (reference
+        DistributedStrategy parity: sharding is off unless configured).
+        A bare ``DistributedTrainStep(sharding_stage=None)`` with no
+        strategy resolves from the MESH instead (dp>1 → ZeRO-1) — set
+        ``sharding_degree`` to the dp degree to get the same through
+        fleet.
+    """
+    if strategy is None:
+        return None  # DistributedTrainStep resolves from the mesh
+    if strategy.sharding:
+        return int(strategy.sharding_configs.get("stage", 1))
+    if int(strategy.hybrid_configs.get("sharding_degree", 1)) > 1:
+        return 1
+    return 0
 
 
 def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
@@ -126,13 +156,10 @@ class DistributedModelProxy:
 
     def build_train_step(self, optimizer, loss_fn, **kw):
         strategy = self._strategy or DistributedStrategy()
-        stage = 0
-        if strategy.sharding:
-            stage = int(strategy.sharding_configs.get("stage", 1))
         inner = optimizer._inner_opt if isinstance(
             optimizer, HybridParallelOptimizer) else optimizer
         kw.setdefault("amp_dtype", "bfloat16" if strategy.amp else None)
-        kw.setdefault("sharding_stage", stage)
+        kw.setdefault("sharding_stage", resolve_sharding_stage(strategy))
         kw.setdefault("topo", _state.topo)
         self._train_step = DistributedTrainStep(
             self._layers, inner, loss_fn, **kw)

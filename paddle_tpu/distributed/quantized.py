@@ -1,0 +1,202 @@
+"""EQuARX-style quantized collective tier (ISSUE 11, PAPERS.md: *EQuARX:
+Efficient Quantized AllReduce in XLA*).
+
+Gradient collectives dominate the wire time of data-parallel training at
+pod scale; EQuARX shows the allreduce payload can ride ICI in int8 (with
+per-block scales) or bf16 at a small, bounded accuracy cost.  This module
+is the repo's single source of truth for that tier:
+
+* ``collective_precision()``   — the ``PADDLE_TPU_COLLECTIVE_PRECISION``
+  knob (``f32``/``full``/unset → None = exact collectives; ``bf16``;
+  ``int8``).  Invalid values fail loudly at build time, not mid-train.
+* ``quantize_chunked`` / ``dequantize_chunked`` — the chunked int8 codec:
+  per-chunk absmax scales (CHUNK=256 elements), symmetric round-to-nearest
+  into [-127, 127].  A zero chunk quantizes to zeros (scale clamped to 1),
+  never NaN.
+* ``qdq(x, precision)``        — in-jit payload emulation for the
+  GSPMD-partitioned train step: quantize→dequantize the gradient payload
+  the compiler-scheduled reduce-scatter will move.  (Inside one jit
+  program the partitioner owns the wire, so the codec is applied to the
+  gradient value; the true quantize→REDUCE→dequantize wire recipe lives
+  in the shard_map tier below and is what a hand-scheduled TPU collective
+  runs.  docs/SHARDING.md "Precision knob" states the distinction.)
+* ``psum`` / ``psum_scatter``  — the wire-honest shard_map tier used by
+  ``distributed.collective`` (eager collectives): per-chunk scales are
+  SHARED across replicas first (one small pmax), each replica quantizes
+  its local partial, the reduction runs over int32 (no int8 overflow up
+  to dp·127 per element), and the result dequantizes with the shared
+  scales — the EQuARX recipe, minus the XLA-internal fusion.
+
+Everything here is pure jax-traceable math (usable inside jit/shard_map)
+with no framework deps, so the train step, the collective API, and the
+tests share one codec.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "CHUNK", "collective_precision", "quantize_chunked",
+    "dequantize_chunked", "qdq", "psum", "psum_scatter",
+]
+
+# EQuARX uses hardware-convenient blocks; 256 keeps the scale sidecar
+# under 0.4% of the payload while tracking local dynamic range.
+CHUNK = 256
+
+_VALID = {"": None, "f32": None, "full": None, "fp32": None,
+          "bf16": "bf16", "int8": "int8"}
+
+ENV_KNOB = "PADDLE_TPU_COLLECTIVE_PRECISION"
+
+
+def collective_precision(explicit=None):
+    """Resolve the collective-precision tier: an explicit argument wins,
+    else the ``PADDLE_TPU_COLLECTIVE_PRECISION`` env knob.  Returns
+    ``None`` (exact), ``"bf16"`` or ``"int8"``."""
+    raw = explicit if explicit is not None else os.environ.get(ENV_KNOB, "")
+    key = str(raw).strip().lower()
+    if key not in _VALID:
+        raise ValueError(
+            f"{ENV_KNOB}={raw!r}: expected one of "
+            f"{sorted(k for k in _VALID if k)} (or unset for exact "
+            f"f32 collectives)")
+    return _VALID[key]
+
+
+def _as_chunks(x, chunk):
+    """Flatten ``x`` to ``[n_chunks, chunk]`` (zero-padded tail);
+    returns (chunks, pad)."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % chunk
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, chunk), pad
+
+
+def _scales_of(absmax):
+    """Per-chunk scales from per-chunk absmax: a silent chunk (all
+    zeros) must not divide by 0 — scale 1 keeps quantized zeros exactly
+    zero.  ONE definition: the local codec (qdq) and the wire tier
+    (psum/psum_scatter, where absmax has been pmax-shared first) must
+    never drift."""
+    return jnp.where(absmax > 0, absmax / 127.0, 1.0)
+
+
+def _encode(ch, scales):
+    """Symmetric round-to-nearest int8 encode of chunks ``ch`` under
+    broadcastable ``scales`` (counterpart of :func:`_scales_of`)."""
+    return jnp.clip(jnp.round(ch / scales), -127, 127)
+
+
+def quantize_chunked(x, chunk=CHUNK):
+    """Symmetric per-chunk int8 quantization.  Returns
+    ``(q_int8 [n_chunks, chunk], scales_f32 [n_chunks], pad)``."""
+    ch, pad = _as_chunks(x.astype(jnp.float32), chunk)
+    absmax = jnp.max(jnp.abs(ch), axis=1)
+    scales = _scales_of(absmax)
+    q = _encode(ch, scales[:, None]).astype(jnp.int8)
+    return q, scales, pad
+
+
+def dequantize_chunked(q, scales, shape, pad):
+    """Inverse of :func:`quantize_chunked` back to f32 ``shape``."""
+    out = q.astype(jnp.float32) * scales[:, None]
+    flat = out.reshape(-1)
+    if pad:
+        flat = flat[:flat.size - pad]
+    return flat.reshape(shape)
+
+
+def _quantizable(x):
+    """Only floating payloads ride the lossy codec: an int32 sum (a
+    token/sample count, a step counter) must stay EXACT — quantizing it
+    would silently corrupt values the caller believes are integers."""
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def qdq(x, precision, chunk=CHUNK):
+    """Quantize→dequantize ``x`` through the tier's payload codec
+    (identity for ``None`` and for non-floating payloads).  Output
+    dtype matches the input."""
+    if precision is None or not _quantizable(x):
+        return x
+    if precision == "bf16":
+        return x.astype(jnp.bfloat16).astype(x.dtype)
+    if precision == "int8":
+        q, scales, pad = quantize_chunked(x, chunk)
+        return dequantize_chunked(q, scales, jnp.shape(x), pad) \
+            .astype(x.dtype)
+    raise ValueError(f"unknown collective precision {precision!r}")
+
+
+# ----------------------- shard_map wire tier -----------------------
+
+
+def psum(x, axis, precision, chunk=CHUNK):
+    """Quantized all-reduce body (call inside shard_map with ``axis``
+    bound): shared per-chunk scales (pmax), int32-accumulated psum of
+    int8 payloads, dequantize.  ``precision=None`` → plain psum;
+    non-floating payloads always reduce exactly."""
+    if precision is None or not _quantizable(x):
+        return jax.lax.psum(x, axis)
+    if precision == "bf16":
+        return jax.lax.psum(x.astype(jnp.bfloat16), axis) \
+            .astype(jnp.float32 if x.dtype == jnp.float32 else x.dtype)
+    ch, pad = _as_chunks(x.astype(jnp.float32), chunk)
+    absmax = jnp.max(jnp.abs(ch), axis=1)
+    absmax = jax.lax.pmax(absmax, axis)  # one shared scale per chunk
+    scales = _scales_of(absmax)
+    q = _encode(ch, scales[:, None]).astype(jnp.int32)
+    s = jax.lax.psum(q, axis)
+    out = s.astype(jnp.float32) * scales[:, None]
+    flat = out.reshape(-1)
+    if pad:
+        flat = flat[:flat.size - pad]
+    return flat.reshape(jnp.shape(x)).astype(x.dtype)
+
+
+def psum_scatter(x, axis, axis_size, precision, chunk=CHUNK):
+    """Quantized reduce-scatter body (inside shard_map): ``x`` is this
+    replica's ``[D0, ...]`` partial with ``D0 % axis_size == 0``; returns
+    the summed ``[D0/axis_size, ...]`` slice owned by this replica.
+    Chunks are laid out per destination slice so every replica
+    dequantizes its own slice with the shared scales.  Non-floating
+    payloads always reduce exactly."""
+    if precision is None or not _quantizable(x):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=0,
+                                    tiled=True)
+    d0 = x.shape[0]
+    if d0 % axis_size:
+        raise ValueError(
+            f"reduce_scatter dim0 {d0} not divisible by axis size "
+            f"{axis_size}")
+    per = d0 // axis_size
+    out_shape = (per,) + tuple(x.shape[1:])
+    if precision == "bf16":
+        s = jax.lax.psum_scatter(x.astype(jnp.bfloat16), axis,
+                                 scatter_dimension=0, tiled=True)
+        return s.astype(jnp.float32 if x.dtype == jnp.float32
+                        else x.dtype)
+    slice_elems = x.size // axis_size
+    sl = x.astype(jnp.float32).reshape(axis_size, slice_elems)
+    pad = (-slice_elems) % chunk
+    if pad:
+        sl = jnp.concatenate([sl, jnp.zeros((axis_size, pad), sl.dtype)],
+                             axis=1)
+    ch = sl.reshape(axis_size, -1, chunk)
+    absmax = jnp.max(jnp.abs(ch), axis=2)
+    absmax = jax.lax.pmax(absmax, axis)  # shared [axis_size, cps]
+    scales = _scales_of(absmax)
+    q = _encode(ch, scales[:, :, None]).astype(jnp.int32)
+    s = jax.lax.psum_scatter(q, axis, scatter_dimension=0, tiled=True)
+    idx = jax.lax.axis_index(axis)
+    my_scales = jax.lax.dynamic_slice_in_dim(scales, idx, 1, axis=0)
+    out = s.astype(jnp.float32) * my_scales[:, :, None]
+    flat = out.reshape(-1)
+    if pad:
+        flat = flat[:slice_elems]
+    return flat.reshape(out_shape).astype(x.dtype)

@@ -52,6 +52,13 @@ _SCHEMA_COUNTERS = tuple(
     + [("collective.calls", {"kind": k})
        for k in ("all_reduce", "all_gather", "reduce_scatter", "alltoall",
                  "alltoall_single", "broadcast", "send", "barrier")]
+    # EQuARX quantized-collective tier (ISSUE 11, docs/SHARDING.md):
+    # which additive syncs rode the wire quantized, by payload codec
+    + [("collective.quantized", {"kind": k, "precision": p})
+       for k in ("all_reduce", "reduce_scatter")
+       for p in ("bf16", "int8")]
+    + [("collective.quantized_tier", {"precision": p})
+       for p in ("bf16", "int8")]
     # resilience subsystem (ISSUE 3): fault injections, retry traffic,
     # guard skips, checkpoint/guard rollbacks, watchdog trips — declared
     # so a clean run reports zeros instead of omitting the keys

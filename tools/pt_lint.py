@@ -24,7 +24,8 @@ Usage:
   python tools/pt_lint.py --jaxpr --check  # include the slow layer
   python tools/pt_lint.py --layers ast     # pick layers explicitly
   python tools/pt_lint.py --perf           # perf audit, fast subset
-                                           # (train/decode/call-sites)
+                                           # (train/sharded-train/
+                                           #  decode/call-sites)
   python tools/pt_lint.py --perf --check   # gate: exit 2 when any
                                            # audited metric EXCEEDS its
                                            # committed budget
