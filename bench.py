@@ -428,6 +428,146 @@ def _bench_serving_decode(degraded: bool) -> dict:
     return result
 
 
+def _bench_quantized_decode(degraded: bool) -> list:
+    """Quantized-decode tier rows (ISSUE 12): the SAME staggered
+    multi-client burst through four engines over one model family —
+    bf16 baseline, int8 weight-only, int8 KV pool, and draft-model
+    speculative decoding — plus the single-stream sequential reference,
+    all measured in the same run.  Emits one gateable row per tier
+    carrying the same-run baselines, so every speedup claim ships with
+    its own evidence.
+
+    The spec-decode draft here is SYNTHETIC-AGREEING (upper bound): the
+    draft is the target's first layer(s) and the target's extra layers
+    have their residual projections zeroed, so target ≡ draft bit-exactly
+    and every proposal is accepted — the row measures the MECHANICAL
+    ceiling of the spec pipeline (pass overhead at acceptance 1.0), with
+    `tokens_per_pass` reported so nothing hides.  Real-model acceptance
+    depends on the trained draft and is a hardware-window measurement.
+    """
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    on_tpu = jax.devices()[0].platform in _ACCEL_PLATFORMS
+    if on_tpu:
+        dims = dict(vocab_size=50304, hidden_size=768, num_heads=12,
+                    max_seq_len=512)
+        layers, draft_layers = 12, 2
+        n_clients, new_tokens, spec_k = 16, 96, 4
+        lens = (32, 64, 96, 128)
+        ecfg = dict(page_size=32, max_slots=8, decode_chunk=8,
+                    max_seq_len=512)
+        stagger = 0.01
+    else:
+        dims = dict(vocab_size=1024, hidden_size=128, num_heads=4,
+                    max_seq_len=128)
+        layers, draft_layers = 2, 1
+        n_clients, new_tokens, spec_k = 8, 24, 4
+        lens = (4, 8, 12, 20)
+        ecfg = dict(page_size=8, max_slots=4, decode_chunk=4,
+                    max_seq_len=128)
+        stagger = 0.002
+    P.seed(0)
+    model = GPTForCausalLM(GPTConfig(num_layers=layers, **dims))
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    # synthetic fully-agreeing draft: copy the shared prefix of the
+    # target's weights, zero the target's EXTRA layers' residual
+    # projections (out_proj/down_proj weight+bias) — those blocks become
+    # exact identities, so target logits == draft logits bit-for-bit
+    P.seed(0)
+    draft = GPTForCausalLM(GPTConfig(num_layers=draft_layers, **dims))
+    if on_tpu:
+        draft.to(dtype="bfloat16")
+    draft.eval()
+    tstate = {n: p for n, p in model.named_parameters()}
+    for name, p in draft.named_parameters():
+        p.set_value(tstate[name]._value)
+    for li in range(draft_layers, layers):
+        blk = model.gpt.h[li]
+        for lin in (blk.attn.out_proj, blk.mlp.down_proj):
+            lin.weight.set_value(np.zeros(lin.weight.shape, np.float32))
+            if lin.bias is not None:
+                lin.bias.set_value(np.zeros(lin.bias.shape, np.float32))
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, dims["vocab_size"],
+                          (lens[i % len(lens)],)).astype(np.int32)
+               for i in range(n_clients)]
+
+    # single-stream sequential reference (the predictor-lock serving
+    # model), warmed per prompt shape
+    for s0 in sorted({p.size for p in prompts}):
+        out = model.generate(P.to_tensor(
+            prompts[[p.size for p in prompts].index(s0)][None, :],
+            "int32"), max_new_tokens=new_tokens)
+        np.asarray(out._value)
+    t0 = time.perf_counter()
+    seq_tokens = 0
+    for p in prompts:
+        out = model.generate(P.to_tensor(p[None, :], "int32"),
+                             max_new_tokens=new_tokens)
+        seq_tokens += np.asarray(out._value).shape[1] - p.size
+    seq_tps = seq_tokens / (time.perf_counter() - t0)
+
+    def engine_tps(tier_kw, draft_model=None):
+        engine = InferenceEngine(
+            model, EngineConfig(**ecfg, **tier_kw),
+            draft_model=draft_model)
+        engine.generate(prompts[:len(lens)], max_new_tokens=2)  # warm
+        steps0 = engine.steps   # warm-up steps stay out of the ratio
+        engine.start()
+        handles = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            handles.append(engine.submit(p, max_new_tokens=new_tokens))
+            time.sleep(stagger)
+        for h in handles:
+            h.result(timeout=600.0)
+        dt = time.perf_counter() - t0
+        engine.stop()
+        toks = sum(len(h.tokens) for h in handles)
+        return toks / dt, toks / max(1, engine.steps - steps0)
+
+    bf16_tps, _ = engine_tps({})
+    int8w_tps, _ = engine_tps({"weight_precision": "int8"})
+    kv_tps, _ = engine_tps({"kv_precision": "int8"})
+    spec_tps, tokens_per_pass = engine_tps(
+        {"spec_tokens": spec_k}, draft_model=draft)
+
+    rows = []
+    for metric, tps, extra in (
+            ("serving_decode_int8w_tokens_per_sec", int8w_tps, {}),
+            ("serving_decode_kvint8_tokens_per_sec", kv_tps, {}),
+            ("serving_decode_spec_tokens_per_sec", spec_tps, {
+                "spec_tokens": spec_k,
+                "tokens_per_pass": round(tokens_per_pass, 2),
+                "draft_layers": draft_layers,
+                "note": "synthetic fully-agreeing draft (acceptance "
+                        "1.0 upper bound; pass overhead is what is "
+                        "measured)"})):
+        row = {
+            "metric": metric,
+            "value": round(tps, 1), "unit": "tokens/s",
+            "bf16_engine_tokens_per_sec": round(bf16_tps, 1),
+            "sequential_tokens_per_sec": round(seq_tps, 1),
+            "speedup_vs_bf16_engine": round(tps / bf16_tps, 2)
+            if bf16_tps > 0 else 0.0,
+            "speedup_vs_sequential": round(tps / seq_tps, 2)
+            if seq_tps > 0 else 0.0,
+            "vs_baseline": 0.0,
+        }
+        row.update(extra)
+        if degraded or not on_tpu:
+            row["degraded"] = True
+        rows.append(row)
+    return rows
+
+
 def _bench_fleet_decode(degraded: bool) -> dict:
     """Horizontal serving scale-out (ISSUE 9): N streaming clients run
     /generate through the admission-aware `Router` over a TWO-replica
@@ -676,6 +816,17 @@ def run_secondary_benches(degraded: bool = False) -> None:
         _emit({"metric": "serving_decode_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "vs_baseline": 0.0, "degraded": True,
                "note": f"failed: {type(e).__name__}: {e}"})
+    try:
+        for row in _bench_quantized_decode(degraded):
+            _emit(row)
+    except Exception as e:
+        print(f"quantized-decode-bench-failed: {e}", file=sys.stderr)
+        for metric in ("serving_decode_int8w_tokens_per_sec",
+                       "serving_decode_kvint8_tokens_per_sec",
+                       "serving_decode_spec_tokens_per_sec"):
+            _emit({"metric": metric, "value": 0.0, "unit": "tokens/s",
+                   "vs_baseline": 0.0, "degraded": True,
+                   "note": f"failed: {type(e).__name__}: {e}"})
     try:
         _emit(_bench_fleet_decode(degraded))
     except Exception as e:

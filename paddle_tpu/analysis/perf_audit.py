@@ -32,6 +32,19 @@ silently on a CPU-only CI box:
                             *inside a compiled loop body* — PT201 with
                             loop context: once per step is bad, once per
                             scan iteration caps decode throughput
+  PT406  dequant placement  int8→float dequantize ops traced OUTSIDE the
+                            decode scan body (weight-only tier, ISSUE
+                            12): a dequant hoisted out of the loop
+                            materializes a full-precision weight copy
+                            and the per-step HBM stream is no longer
+                            int8 — the measured 1.33×/1.91× win
+                            evaporates.  Audited at the JAXPR level
+                            (the view WE control): the XLA:CPU proxy's
+                            LICM hoists loop-invariant dequant fusions
+                            regardless (observed, documented in
+                            PERF.md), while the TPU pipeline does not
+                            hoist size-inflating ops — so the
+                            source-placement pin is the honest gate.
 
 Representative programs (all built under ``JAX_PLATFORMS=cpu``):
   * ``train_step``  — the hybrid GPT train step at a small proxy shape
@@ -83,20 +96,23 @@ __all__ = [
     "RULE_IDS", "DEFAULT_PROGRAMS", "FULL_PROGRAMS",
     "layout_tax", "weak_input_count", "replicated_args",
     "replicated_arg_details", "collective_hlo_counts",
-    "collective_patterns", "host_sync_counts", "call_site_hazards",
+    "collective_patterns", "host_sync_counts", "dequant_placement",
+    "call_site_hazards",
     "audit_program_texts", "audit_perf", "metrics_to_static_rows",
     "audit_hlo", "train_step_hlo",
 ]
 
-RULE_IDS = ("PT400", "PT401", "PT402", "PT403", "PT404", "PT405")
+RULE_IDS = ("PT400", "PT401", "PT402", "PT403", "PT404", "PT405",
+            "PT406")
 
 # program names: the fast subset runs in the tier-1 smoke; FULL adds the
 # op-table sweep (slow tier — imports + traces the whole exported surface)
 DEFAULT_PROGRAMS = ("train_step", "sharded_train_step", "swin_train_step",
-                    "decode_step", "paged_decode_step", "call_sites")
+                    "decode_step", "paged_decode_step",
+                    "quantized_decode_step", "call_sites")
 FULL_PROGRAMS = ("train_step", "sharded_train_step", "swin_train_step",
-                 "decode_step", "paged_decode_step", "call_sites",
-                 "op_table")
+                 "decode_step", "paged_decode_step",
+                 "quantized_decode_step", "call_sites", "op_table")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -411,6 +427,34 @@ def host_sync_counts(closed_jaxpr) -> dict:
             if loop:
                 in_loop += 1
     return {"pt405_host_syncs": total, "pt405_loop_host_syncs": in_loop}
+
+
+def dequant_placement(closed_jaxpr) -> dict:
+    """PT406 metrics: int8→float ``convert_element_type`` eqns inside
+    vs outside compiled loop bodies.  In the quantized decode program
+    every dequant (weights AND KV pages) must be traced INSIDE the scan
+    body — a count appearing outside means someone moved
+    `_dequant_params` (or the page dequant) out of the loop, and the
+    weights would stream full-precision per step on every backend."""
+    import jax.numpy as jnp
+
+    in_loop, hoisted = 0, 0
+    for eqn, loop in _walk_eqns_ctx(closed_jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        src = getattr(getattr(eqn.invars[0], "aval", None), "dtype",
+                      None)
+        dst = getattr(getattr(eqn.outvars[0], "aval", None), "dtype",
+                      None)
+        if src is None or dst is None:
+            continue
+        if src == jnp.int8 and jnp.issubdtype(dst, jnp.floating):
+            if loop:
+                in_loop += 1
+            else:
+                hoisted += 1
+    return {"pt406_dequant_in_loop_count": in_loop,
+            "pt406_dequant_hoisted_count": hoisted}
 
 
 # ---------------------- per-program aggregation ----------------------
@@ -745,12 +789,54 @@ def _paged_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
         max_seq_len=max_len))
     decode = eng._decode_program(chunk)
     args = (eng._params, eng._buffers, eng._k_pools, eng._v_pools,
+            [], [],
             jnp.zeros((slots,), jnp.int32),
             jnp.zeros((slots, eng.max_pages_per_seq), jnp.int32),
             jnp.zeros((slots,), jnp.int32))
     lowered = decode.lower(*args)
     jaxpr = jax.make_jaxpr(decode)(*args)
     return lowered, jaxpr
+
+
+def _quantized_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
+                                   chunk=4):
+    """The SAME paged decode proxy under BOTH quantized tiers
+    (``weight_precision='int8'`` + ``kv_precision='int8'`` — ISSUE 12):
+    its budget pins the quantized hot step's layout counts AND the
+    PT406 dequant placement (every int8→float dequant traced inside the
+    scan body, none hoisted).  Returns
+    ``(lowered, closed_jaxpr, None, meta)`` where meta carries the
+    expected dequant count (quantized weights + K/V page dequants per
+    layer) for the derived ``pt406_dequant_deficit``."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as P
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(0)
+    max_len = page_size * pages_per_seq
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=1,
+                    num_heads=4, max_seq_len=max_len)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = InferenceEngine(model, EngineConfig(
+        page_size=page_size, max_slots=slots, decode_chunk=chunk,
+        max_seq_len=max_len, weight_precision="int8",
+        kv_precision="int8"))
+    decode = eng._decode_program(chunk)
+    args = (eng._params, eng._buffers, eng._k_pools, eng._v_pools,
+            eng._k_scales, eng._v_scales,
+            jnp.zeros((slots,), jnp.int32),
+            jnp.zeros((slots, eng.max_pages_per_seq), jnp.int32),
+            jnp.zeros((slots,), jnp.int32))
+    lowered = decode.lower(*args)
+    jaxpr = jax.make_jaxpr(decode)(*args)
+    # per scan step: one dequant per quantized weight + one per K and V
+    # page gather per layer
+    meta = {"expected_s8_dequants": len(eng._wq_meta) + 2 * eng._layers}
+    return lowered, jaxpr, None, meta
 
 
 def _audit_lowered(name: str, lowered, jaxpr=None, arg_names=None):
@@ -912,17 +998,21 @@ def audit_perf(programs=DEFAULT_PROGRAMS, repo_root=None):
             v, m = _audit_call_sites(repo_root)
         elif prog in ("train_step", "sharded_train_step",
                       "swin_train_step", "decode_step",
-                      "paged_decode_step"):
+                      "paged_decode_step", "quantized_decode_step"):
             full = {"train_step": "gpt125m_train_step",
                     "sharded_train_step": "gpt_sharded_train_step",
                     "swin_train_step": "swin_train_step",
                     "decode_step": "gpt_decode_step",
-                    "paged_decode_step": "gpt_paged_decode_step"}[prog]
+                    "paged_decode_step": "gpt_paged_decode_step",
+                    "quantized_decode_step":
+                        "gpt_quantized_decode_step"}[prog]
             build = {"train_step": _train_step_program,
                      "sharded_train_step": _sharded_train_step_program,
                      "swin_train_step": _swin_train_step_program,
                      "decode_step": _decode_step_program,
-                     "paged_decode_step": _paged_decode_step_program}[prog]
+                     "paged_decode_step": _paged_decode_step_program,
+                     "quantized_decode_step":
+                         _quantized_decode_step_program}[prog]
             try:
                 out = build()
             except Exception as e:
@@ -933,8 +1023,37 @@ def audit_perf(programs=DEFAULT_PROGRAMS, repo_root=None):
             else:
                 lowered, jaxpr = out[0], out[1]
                 names = out[2] if len(out) > 2 else None
+                prog_meta = out[3] if len(out) > 3 else {}
                 v, m = _audit_lowered(full, lowered, jaxpr,
                                       arg_names=names)
+                if prog == "quantized_decode_step" and m \
+                        and jaxpr is not None:
+                    # PT406: every int8 dequant must be TRACED inside
+                    # the scan body — hoisted > 0 means the weights
+                    # stream full-precision per step; the deficit
+                    # (expected minus in-loop, floored at 0) catches
+                    # the opposite failure, the tier silently not
+                    # quantizing at all (fewer dequants would read as
+                    # an "improvement" under a plain ceiling)
+                    m.update(dequant_placement(jaxpr))
+                    expected = prog_meta.get("expected_s8_dequants", 0)
+                    m["pt406_dequant_deficit"] = max(
+                        0, expected - m["pt406_dequant_in_loop_count"])
+                    if m["pt406_dequant_hoisted_count"]:
+                        v.append(Violation(
+                            f"perf:{full}", 0, "PT406",
+                            f"{m['pt406_dequant_hoisted_count']} "
+                            f"int8 dequant(s) traced OUTSIDE the "
+                            f"decode scan body — the weight stream "
+                            f"is full-precision per step"))
+                    if m["pt406_dequant_deficit"]:
+                        v.append(Violation(
+                            f"perf:{full}", 0, "PT406",
+                            f"only "
+                            f"{m['pt406_dequant_in_loop_count']} of "
+                            f"{expected} expected int8 dequants in "
+                            f"the scan body — a quantized tier is "
+                            f"silently inactive"))
                 if prog == "sharded_train_step" and m and names:
                     # per-parameter grad sync or bust: the raw counts
                     # only gate INCREASES (budget = ceiling), but the

@@ -12,7 +12,10 @@ is the repo's single source of truth for that tier:
 * ``quantize_chunked`` / ``dequantize_chunked`` — the chunked int8 codec:
   per-chunk absmax scales (CHUNK=256 elements), symmetric round-to-nearest
   into [-127, 127].  A zero chunk quantizes to zeros (scale clamped to 1),
-  never NaN.
+  never NaN.  Since ISSUE 12 the codec itself lives in
+  ``paddle_tpu/ops/quant.py`` (re-exported here): the engine's int8
+  weight tier and the quantized KV page pool share the same
+  scale/encode definitions, pinned by a bit-equivalence test.
 * ``qdq(x, precision)``        — in-jit payload emulation for the
   GSPMD-partitioned train step: quantize→dequantize the gradient payload
   the compiler-scheduled reduce-scatter will move.  (Inside one jit
@@ -38,14 +41,18 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ..ops.quant import (  # noqa: F401  (re-exported: this module was
+    # the codec's original home; the engine's weight/KV tiers and the
+    # wire tier now share ops/quant.py as the ONE definition — ISSUE 12)
+    CHUNK, _as_chunks, dequantize_chunked, quantize_chunked,
+)
+from ..ops.quant import encode_int8 as _encode
+from ..ops.quant import scales_from_absmax as _scales_of
+
 __all__ = [
     "CHUNK", "collective_precision", "quantize_chunked",
     "dequantize_chunked", "qdq", "psum", "psum_scatter",
 ]
-
-# EQuARX uses hardware-convenient blocks; 256 keeps the scale sidecar
-# under 0.4% of the payload while tracking local dynamic range.
-CHUNK = 256
 
 _VALID = {"": None, "f32": None, "full": None, "fp32": None,
           "bf16": "bf16", "int8": "int8"}
@@ -65,50 +72,6 @@ def collective_precision(explicit=None):
             f"{sorted(k for k in _VALID if k)} (or unset for exact "
             f"f32 collectives)")
     return _VALID[key]
-
-
-def _as_chunks(x, chunk):
-    """Flatten ``x`` to ``[n_chunks, chunk]`` (zero-padded tail);
-    returns (chunks, pad)."""
-    flat = x.reshape(-1)
-    pad = (-flat.size) % chunk
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    return flat.reshape(-1, chunk), pad
-
-
-def _scales_of(absmax):
-    """Per-chunk scales from per-chunk absmax: a silent chunk (all
-    zeros) must not divide by 0 — scale 1 keeps quantized zeros exactly
-    zero.  ONE definition: the local codec (qdq) and the wire tier
-    (psum/psum_scatter, where absmax has been pmax-shared first) must
-    never drift."""
-    return jnp.where(absmax > 0, absmax / 127.0, 1.0)
-
-
-def _encode(ch, scales):
-    """Symmetric round-to-nearest int8 encode of chunks ``ch`` under
-    broadcastable ``scales`` (counterpart of :func:`_scales_of`)."""
-    return jnp.clip(jnp.round(ch / scales), -127, 127)
-
-
-def quantize_chunked(x, chunk=CHUNK):
-    """Symmetric per-chunk int8 quantization.  Returns
-    ``(q_int8 [n_chunks, chunk], scales_f32 [n_chunks], pad)``."""
-    ch, pad = _as_chunks(x.astype(jnp.float32), chunk)
-    absmax = jnp.max(jnp.abs(ch), axis=1)
-    scales = _scales_of(absmax)
-    q = _encode(ch, scales[:, None]).astype(jnp.int8)
-    return q, scales, pad
-
-
-def dequantize_chunked(q, scales, shape, pad):
-    """Inverse of :func:`quantize_chunked` back to f32 ``shape``."""
-    out = q.astype(jnp.float32) * scales[:, None]
-    flat = out.reshape(-1)
-    if pad:
-        flat = flat[:flat.size - pad]
-    return flat.reshape(shape)
 
 
 def _quantizable(x):

@@ -41,6 +41,7 @@ sequences, page utilization, batch occupancy) and counters
 from __future__ import annotations
 
 import functools
+import os
 import queue
 import threading
 import time
@@ -59,13 +60,28 @@ from .scheduler import Scheduler, Sequence
 __all__ = ["EngineConfig", "InferenceEngine", "RequestHandle"]
 
 
+def _precision_knob(explicit, env, valid):
+    """Resolve a precision-tier knob (explicit arg wins, else env).
+    Invalid values fail LOUDLY at engine build, not mid-decode —
+    the same discipline as `distributed.quantized.collective_precision`."""
+    raw = explicit if explicit is not None else os.environ.get(env, "")
+    key = str(raw).strip().lower()
+    if key not in valid:
+        raise ValueError(
+            f"{env}={raw!r}: expected one of "
+            f"{sorted(k for k in valid if k)} (or unset for the exact "
+            f"tier)")
+    return valid[key]
+
+
 class EngineConfig:
     """Engine sizing knobs; every ctor arg falls back to its
     PADDLE_TPU_ENGINE_* env, then the default."""
 
     def __init__(self, page_size=None, num_pages=None, max_slots=None,
                  decode_chunk=None, prefill_bucket=None,
-                 max_seq_len=None):
+                 max_seq_len=None, weight_precision=None,
+                 kv_precision=None, spec_tokens=None, pool_hbm_mb=None):
         self.page_size = int(page_size if page_size is not None else
                              _env_num("PADDLE_TPU_ENGINE_PAGE_SIZE", 16,
                                       int))
@@ -86,11 +102,41 @@ class EngineConfig:
         self.num_pages = int(num_pages if num_pages is not None else
                              _env_num("PADDLE_TPU_ENGINE_MAX_PAGES", 0,
                                       int))
+        # quantized decode tiers (ISSUE 12, docs/INFERENCE.md):
+        #   weight_precision: int8 = per-output-channel weight-only
+        #     quantization of every matmul weight at engine build,
+        #     dequant fused inside the decode GEMVs; bf16 = plain cast.
+        #   kv_precision: int8 = the page pools store int8 with
+        #     per-token-per-head scales next to the page table.
+        self.weight_precision = _precision_knob(
+            weight_precision, "PADDLE_TPU_ENGINE_WEIGHT_PRECISION",
+            {"": None, "f32": None, "full": None, "fp32": None,
+             "bf16": "bf16", "int8": "int8"})
+        self.kv_precision = _precision_knob(
+            kv_precision, "PADDLE_TPU_ENGINE_KV_PRECISION",
+            {"": None, "f32": None, "full": None, "fp32": None,
+             "int8": "int8"})
+        # draft-model speculative decoding: tokens proposed per pass
+        # (0 = off; needs a draft_model at engine construction)
+        self.spec_tokens = int(
+            spec_tokens if spec_tokens is not None else
+            _env_num("PADDLE_TPU_ENGINE_SPEC_TOKENS", 0, int))
+        # fixed page-pool HBM budget in MiB (0 = unset): when num_pages
+        # is not given explicitly, the pool is sized to FIT this budget
+        # under the active kv tier — so int8 pages buy ~2x the pages
+        # (and in-flight sequences) of bf16 for the same bytes, which
+        # is the capacity claim the scheduler test asserts
+        self.pool_hbm_mb = float(
+            pool_hbm_mb if pool_hbm_mb is not None else
+            _env_num("PADDLE_TPU_ENGINE_POOL_HBM_MB", 0.0, float))
         for name in ("page_size", "max_slots", "decode_chunk",
                      "prefill_bucket"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, got "
                                  f"{getattr(self, name)}")
+        if self.spec_tokens < 0:
+            raise ValueError(
+                f"spec_tokens must be >= 0, got {self.spec_tokens}")
 
 
 class RequestHandle:
@@ -141,13 +187,66 @@ class RequestHandle:
         return self.finish_reason == "cancelled"
 
 
+def _matmul_weight_names(model):
+    """Param names of the model's matmul weights — the HBM stream the
+    weight-only tier halves: every Linear-family 2-D weight, plus the
+    tied-embedding LM head (contracted on its hidden axis).  Returns
+    ``{name: contraction_axis}``."""
+    from ...distributed import mpu
+    from ...nn.layers_common import Embedding, Linear
+
+    linear_types = (Linear, mpu.ColumnParallelLinear,
+                    mpu.RowParallelLinear)
+    emb_types = (Embedding, mpu.VocabParallelEmbedding)
+    names = {}
+    vocab = int(getattr(getattr(model, "cfg", None), "vocab_size", 0))
+    tied = bool(getattr(getattr(model, "cfg", None), "tie_embeddings",
+                        False))
+    for prefix, layer in model.named_sublayers(include_self=True):
+        w = getattr(layer, "weight", None)
+        if w is None or w._value.ndim != 2:
+            continue
+        if not jnp.issubdtype(w._value.dtype, jnp.floating):
+            continue
+        name = f"{prefix}.weight" if prefix else "weight"
+        if isinstance(layer, linear_types):
+            names[name] = 0          # [in, out]: contract over in
+        elif tied and isinstance(layer, emb_types) \
+                and w._value.shape[0] == vocab:
+            # the tied embedding doubles as the LM head
+            # (`x.matmul(w, transpose_y=True)`): output channels are
+            # vocab ROWS, so the scale is per row (absmax over hidden)
+            # — and the embedding lookup dequantizes the same rows with
+            # the same scales, so both uses stay consistent
+            names[name] = 1
+    return names
+
+
 class InferenceEngine:
     """Continuous-batching engine over one `GenerationMixin` model
     (greedy decoding — the deterministic serving mode; sampling rides
-    ROADMAP item 4)."""
+    ROADMAP item 4).
+
+    Quantized decode tiers (ISSUE 12):
+      * ``config.weight_precision='int8'`` quantizes every matmul
+        weight ONCE at construction (per-output-channel absmax scales,
+        `ops/quant.py` codec); the dequant runs inside the compiled
+        decode scan body so the weights stream from HBM as int8.
+      * ``config.kv_precision='int8'`` stores the KV page pools as int8
+        with per-token-per-head scale tables riding next to the page
+        table — half the page HBM, ~2x the in-flight sequences per
+        fixed ``pool_hbm_mb`` budget.
+      * ``draft_model=`` + ``config.spec_tokens=k`` turns on greedy
+        speculative decoding: the draft proposes k tokens per slot per
+        pass, the target scores all k+1 positions in ONE batched ragged
+        paged-attention pass (positions spread over the batch axis so
+        each row computes exactly what a sequential step would), and
+        the accepted prefix commits on device — the committed stream is
+        bit-identical to sequential greedy by construction.
+    """
 
     def __init__(self, model, config: EngineConfig = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, draft_model=None):
         import copy
 
         # own copy: max_seq_len/num_pages resolve against THIS model
@@ -168,17 +267,55 @@ class InferenceEngine:
         if cfg.max_seq_len <= 0:
             cfg.max_seq_len = int(getattr(model.cfg, "max_seq_len", 0)) \
                 or 2048
+        # --- weight-only quantization (once, at build) -------------------
+        self._wq_meta = {}
+        if cfg.weight_precision is not None:
+            self._quantize_weights()
+        # --- draft model (speculative decoding) --------------------------
+        self._draft = None
+        if cfg.spec_tokens > 0:
+            if draft_model is None:
+                raise ValueError(
+                    "spec_tokens > 0 needs a draft_model at engine "
+                    "construction")
+            self._init_draft(draft_model)
+        elif draft_model is not None:
+            raise ValueError(
+                "draft_model given but config.spec_tokens == 0 — set "
+                "spec_tokens (or PADDLE_TPU_ENGINE_SPEC_TOKENS) to the "
+                "draft proposal length")
         self.max_pages_per_seq = -(-cfg.max_seq_len // cfg.page_size)
         if cfg.num_pages <= 0:
-            cfg.num_pages = cfg.max_slots * self.max_pages_per_seq + 1
+            if cfg.pool_hbm_mb > 0:
+                # size the pool to FIT the byte budget under the active
+                # kv tier: int8 pages cost ~half of bf16 (+ the f32
+                # scale sidecar), so the same budget admits ~2x pages
+                per_page = self._page_bytes()
+                cfg.num_pages = max(
+                    2, int(cfg.pool_hbm_mb * 2**20) // per_page)
+            else:
+                cfg.num_pages = cfg.max_slots * self.max_pages_per_seq + 1
         self.pool = PagePool(cfg.num_pages, cfg.page_size)
         self.scheduler = Scheduler(cfg.max_slots, self.pool,
                                    self.max_pages_per_seq, clock=clock)
         shape = (cfg.num_pages, self._hkv, cfg.page_size, self._hd)
-        self._k_pools = [jnp.zeros(shape, self._dtype)
+        pool_dtype = jnp.int8 if cfg.kv_precision == "int8" \
+            else self._dtype
+        self._k_pools = [jnp.zeros(shape, pool_dtype)
                          for _ in range(self._layers)]
-        self._v_pools = [jnp.zeros(shape, self._dtype)
+        self._v_pools = [jnp.zeros(shape, pool_dtype)
                          for _ in range(self._layers)]
+        self._k_scales = self._v_scales = None
+        if cfg.kv_precision == "int8":
+            # scale 1 everywhere: a never-written (scratch) slot
+            # decodes to exact zeros, like the bf16 pool's zeros
+            sshape = shape[:3]
+            self._k_scales = [jnp.ones(sshape, jnp.float32)
+                              for _ in range(self._layers)]
+            self._v_scales = [jnp.ones(sshape, jnp.float32)
+                              for _ in range(self._layers)]
+        if self._draft is not None:
+            self._init_draft_pools()
         self._programs = {}
         self._handles = {}         # request_id -> RequestHandle
         self._lock = threading.RLock()
@@ -186,12 +323,129 @@ class InferenceEngine:
         self._thread = None
         self._running = False
         self.steps = 0
+        self._publish_tier_gauges()
+
+    # --- quantized-tier construction ----------------------------------------
+    def _page_bytes(self) -> int:
+        """HBM bytes ONE page costs across all layers (K+V pools plus
+        the scale sidecar under the int8 kv tier, plus the draft
+        model's pools when speculative decoding shares the page table)
+        — the unit the ``pool_hbm_mb`` budget divides."""
+        cfg = self.config
+        if cfg.kv_precision == "int8":
+            item, scale_item = 1, 4
+        else:
+            item = jnp.dtype(self._dtype).itemsize
+            scale_item = 0
+        per_pool = self._hkv * cfg.page_size * self._hd * item \
+            + self._hkv * cfg.page_size * scale_item
+        total = self._layers * 2 * per_pool
+        if self._draft is not None:
+            d = self._draft
+            total += d["layers"] * 2 * (
+                d["hkv"] * cfg.page_size * d["hd"]
+                * jnp.dtype(d["dtype"]).itemsize)
+        return max(1, total)
+
+    def _quantize_weights(self) -> None:
+        """Swap every matmul weight in the params pytree for its
+        quantized form ({"q": int8, "s": f32 broadcastable} leaves for
+        int8; a plain bf16 cast for bf16).  `_dequant_params` is the
+        traced inverse — running INSIDE the compiled programs, so the
+        stored (and HBM-streamed) representation stays narrow."""
+        from ...ops import quant as QT
+
+        prec = self.config.weight_precision
+        names = _matmul_weight_names(self._model)
+        for name, axis in names.items():
+            w = self._params.get(name)
+            if w is None:
+                continue
+            if prec == "int8":
+                q, s = QT.quantize_channels(w, axis=axis)
+                self._params[name] = {"q": q, "s": s}
+            else:
+                self._params[name] = {"q": w.astype(jnp.bfloat16)}
+            self._wq_meta[name] = str(w.dtype)
+
+    def _dequant_params(self, params):
+        """Traced: rebuild full-precision weights from the quantized
+        leaves.  Called INSIDE every compiled program (for the decode
+        scan: inside the scan body), so XLA keeps the int8->float
+        convert next to the GEMV instead of materializing a
+        full-precision weight copy in HBM — `perf_audit`'s
+        ``gpt_quantized_decode_step`` program pins this placement."""
+        if not self._wq_meta:
+            return params
+        from ...ops import quant as QT
+
+        out = dict(params)
+        for name, dt in self._wq_meta.items():
+            leaf = params[name]
+            if "s" in leaf:
+                out[name] = QT.dequantize_channels(leaf["q"], leaf["s"],
+                                                   dtype=dt)
+            else:
+                out[name] = leaf["q"].astype(dt)
+        return out
+
+    def effective_params(self):
+        """The de-quantized params the engine's programs actually
+        compute with (identity when no weight tier is active) — bind
+        these into the model to reproduce engine streams with plain
+        `generate()` (the per-tier equivalence tests do exactly that)."""
+        return self._dequant_params(self._params)
+
+    def _init_draft(self, draft_model) -> None:
+        draft_model.eval()
+        dparams, dbuffers = draft_model.functional_state()
+        probe = draft_model.init_kv_caches(1, 1)
+        tv = int(getattr(getattr(self._model, "cfg", None),
+                         "vocab_size", 0))
+        dv = int(getattr(getattr(draft_model, "cfg", None),
+                         "vocab_size", 0))
+        if tv and dv and tv != dv:
+            raise ValueError(
+                f"draft vocab_size {dv} != target vocab_size {tv} — "
+                f"proposals would index a different token space")
+        self._draft = {
+            "model": draft_model,
+            "params": dparams,
+            "buffers": dbuffers,
+            "layers": len(probe),
+            "hkv": probe[0][0].shape[1],
+            "hd": probe[0][0].shape[3],
+            "dtype": probe[0][0].dtype,
+        }
+        del probe
+
+    def _init_draft_pools(self) -> None:
+        """Draft KV pools share the page table/allocator with the
+        target's (same page ids, own geometry) — allocation bookkeeping
+        stays single.  The draft is small, so its pools stay full
+        precision."""
+        d = self._draft
+        cfg = self.config
+        shape = (cfg.num_pages, d["hkv"], cfg.page_size, d["hd"])
+        d["k_pools"] = [jnp.zeros(shape, d["dtype"])
+                        for _ in range(d["layers"])]
+        d["v_pools"] = [jnp.zeros(shape, d["dtype"])
+                        for _ in range(d["layers"])]
+
+    def _publish_tier_gauges(self) -> None:
+        cfg = self.config
+        _metrics.set_gauge("engine.weight_precision", 1,
+                           precision=cfg.weight_precision or "full")
+        _metrics.set_gauge("paged.pool_precision", 1,
+                           precision=cfg.kv_precision or "full")
+        _metrics.set_gauge("engine.spec_tokens", cfg.spec_tokens)
 
     # --- model invocation (raw jax values; paged or dense caches) -----------
     def _run_model(self, params, buffers, ids, caches, pos, start):
         from ...core import flags
         from ...core.tensor import Tensor
 
+        params = self._dequant_params(params)
         with flags.no_grad_guard(), flags.trace_guard():
             with self._model.bind_state(params, buffers):
                 logits, new = self._model(
@@ -202,19 +456,49 @@ class InferenceEngine:
                     attn_start=None if start is None else Tensor(start))
         return logits._value, [tuple(x._value for x in c) for c in new]
 
+    def _run_draft(self, params, buffers, ids, caches, pos, start):
+        from ...core import flags
+        from ...core.tensor import Tensor
+
+        model = self._draft["model"]
+        with flags.no_grad_guard(), flags.trace_guard():
+            with model.bind_state(params, buffers):
+                logits, new = model(
+                    Tensor(ids),
+                    kv_caches=[tuple(Tensor(x) for x in c)
+                               for c in caches],
+                    cache_pos=Tensor(pos),
+                    attn_start=None if start is None else Tensor(start))
+        return logits._value, [tuple(x._value for x in c) for c in new]
+
     # --- compiled programs --------------------------------------------------
-    def _prefill_program(self, sb: int):
+    def _which(self, which):
+        """(run_fn, layers, hkv, hd, dtype) for "target"/"draft"."""
+        if which == "draft":
+            d = self._draft
+            return (self._run_draft, d["layers"], d["hkv"], d["hd"],
+                    d["dtype"])
+        return (self._run_model, self._layers, self._hkv, self._hd,
+                self._dtype)
+
+    def _caches_of(self, kps, vps, pt, kss=None, vss=None):
+        """Per-layer cache tuples for the paged model path: 5-tuples
+        (with scale tables) under the int8 kv tier, 3-tuples otherwise."""
+        if kss:
+            return [(k, v, pt, ks, vs) for k, v, ks, vs
+                    in zip(kps, vps, kss, vss)]
+        return [(k, v, pt) for k, v in zip(kps, vps)]
+
+    def _prefill_program(self, sb: int, which="target"):
         """One left-padded sequence at bucket length sb: greedy first
         token + the dense K/V (capacity sb+page_size so the pack
         program's last page slice never clamps)."""
-        key = ("prefill", sb)
+        key = ("prefill", sb, which)
         hit = self._programs.get(key)
         if hit is not None:
             return hit
-        run = self._run_model
-        layers, hkv, d = self._layers, self._hkv, self._hd
+        run, layers, hkv, d, dtype = self._which(which)
         cap = sb + self.config.page_size
-        dtype = self._dtype
 
         @jax.jit
         def prefill(params, buffers, ids, start):
@@ -230,66 +514,210 @@ class InferenceEngine:
         self._programs[key] = prefill
         return prefill
 
-    def _pack_program(self, sb: int):
+    def _pack_program(self, sb: int, which="target"):
         """Scatter a prefill's dense K/V (real tokens at
         [start, start+s0)) into the sequence's pages.  Pages beyond the
         prompt's span point at the scratch page — their writes are
-        discarded by construction."""
-        key = ("pack", sb)
+        discarded by construction.  Under the int8 kv tier each token's
+        head-vector quantizes independently (`quantize_vectors` — the
+        SAME per-vector codec the decode write applies), so the packed
+        page content is bit-identical to what token-by-token writes
+        would have produced."""
+        quant = which == "target" and self.config.kv_precision == "int8"
+        key = ("pack", sb, which, quant)
         hit = self._programs.get(key)
         if hit is not None:
             return hit
         ps = self.config.page_size
-        hkv, d = self._hkv, self._hd
+        _, _, hkv, d, _ = self._which(which)
         npb = -(-sb // ps)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def pack(k_pools, v_pools, kbufs, vbufs, pages, start):
-            def put(pool, buf):
-                def body(i, pool):
+        if not quant:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def pack(k_pools, v_pools, kbufs, vbufs, pages, start):
+                def put(pool, buf):
+                    def body(i, pool):
+                        chunk = jax.lax.dynamic_slice(
+                            buf, (0, 0, start + i * ps, 0),
+                            (1, hkv, ps, d))
+                        return jax.lax.dynamic_update_slice(
+                            pool, chunk.astype(pool.dtype),
+                            (pages[i], 0, 0, 0))
+                    return jax.lax.fori_loop(0, npb, body, pool)
+
+                k_pools = [put(p, b) for p, b in zip(k_pools, kbufs)]
+                v_pools = [put(p, b) for p, b in zip(v_pools, vbufs)]
+                return k_pools, v_pools
+
+            self._programs[key] = pack
+            return pack
+
+        from ...ops.quant import quantize_vectors
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def pack_q(k_pools, v_pools, k_scales, v_scales, kbufs, vbufs,
+                   pages, start):
+            def put(pool, scales, buf):
+                def body(i, carry):
+                    pool, scales = carry
                     chunk = jax.lax.dynamic_slice(
-                        buf, (0, 0, start + i * ps, 0), (1, hkv, ps, d))
-                    return jax.lax.dynamic_update_slice(
-                        pool, chunk, (pages[i], 0, 0, 0))
-                return jax.lax.fori_loop(0, npb, body, pool)
+                        buf, (0, 0, start + i * ps, 0),
+                        (1, hkv, ps, d))[0]          # [hkv, ps, d]
+                    # per-(head, token) vector scales — one absmax per
+                    # d-vector, independent of neighbours
+                    qv, sv = quantize_vectors(chunk)
+                    pool = jax.lax.dynamic_update_slice(
+                        pool, qv[None], (pages[i], 0, 0, 0))
+                    scales = jax.lax.dynamic_update_slice(
+                        scales, sv[None], (pages[i], 0, 0))
+                    return pool, scales
+                return jax.lax.fori_loop(0, npb, body, (pool, scales))
 
-            k_pools = [put(p, b) for p, b in zip(k_pools, kbufs)]
-            v_pools = [put(p, b) for p, b in zip(v_pools, vbufs)]
-            return k_pools, v_pools
+            ks, vs = list(k_scales), list(v_scales)
+            kp = list(k_pools)
+            vp = list(v_pools)
+            for li in range(len(kp)):
+                kp[li], ks[li] = put(kp[li], ks[li], kbufs[li])
+                vp[li], vs[li] = put(vp[li], vs[li], vbufs[li])
+            return kp, vp, ks, vs
 
-        self._programs[key] = pack
-        return pack
+        self._programs[key] = pack_q
+        return pack_q
 
     def _decode_program(self, n: int):
         """`n` ragged decode steps at the fixed [max_slots] batch inside
         one compiled scan.  Pools donated: each step writes one page
         slot per sequence per layer, and donation lets XLA update in
-        place instead of copying the whole pool per token."""
-        key = ("decode", n)
+        place instead of copying the whole pool per token.  The
+        weight-dequant (int8 tier) runs INSIDE the scan body via
+        `_run_model`, so the int8->float convert stays fused next to
+        each GEMV instead of materializing full-precision weights."""
+        quant = self.config.kv_precision == "int8"
+        key = ("decode", n, quant)
         hit = self._programs.get(key)
         if hit is not None:
             return hit
         run = self._run_model
+        caches_of = self._caches_of
 
-        @functools.partial(jax.jit, donate_argnums=(2, 3))
-        def decode(params, buffers, k_pools, v_pools, tok, pt, lengths):
+        @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+        def decode(params, buffers, k_pools, v_pools, k_scales,
+                   v_scales, tok, pt, lengths):
             def body(carry, _):
-                tok, kps, vps, lengths = carry
-                caches = [(k, v, pt) for k, v in zip(kps, vps)]
+                tok, kps, vps, kss, vss, lengths = carry
+                caches = caches_of(kps, vps, pt, kss, vss)
                 logits, new = run(params, buffers, tok[:, None], caches,
                                   lengths, None)
                 kps = [c[0] for c in new]
                 vps = [c[1] for c in new]
+                if quant:
+                    kss = [c[3] for c in new]
+                    vss = [c[4] for c in new]
                 nxt = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
                                  axis=-1).astype(jnp.int32)
-                return (nxt, kps, vps, lengths + 1), nxt
+                return (nxt, kps, vps, kss, vss, lengths + 1), nxt
 
-            (tok, kps, vps, lengths), toks = jax.lax.scan(
-                body, (tok, k_pools, v_pools, lengths), None, length=n)
-            return jnp.swapaxes(toks, 0, 1), kps, vps
+            (tok, kps, vps, kss, vss, lengths), toks = jax.lax.scan(
+                body, (tok, k_pools, v_pools, k_scales, v_scales,
+                       lengths), None, length=n)
+            return jnp.swapaxes(toks, 0, 1), kps, vps, kss, vss
 
         self._programs[key] = decode
         return decode
+
+    def _spec_program(self, k: int):
+        """One speculative-decoding pass at the fixed [max_slots]
+        batch: the draft proposes ``k`` tokens (k+1 scanned single-token
+        steps — the extra feed writes the last proposal's K/V so a
+        fully-accepted pass leaves the draft cache complete), then the
+        TARGET scores all k+1 positions in ONE batched ragged
+        paged-attention pass with the positions spread over the batch
+        axis — row (s, i) carries its own cache position L_s+i and
+        slot s's page table, so each row computes EXACTLY what the
+        sequential decode step at that position computes (same shapes,
+        same masks), which is what makes the accepted stream
+        bit-identical to sequential greedy.  Accept/reject runs on
+        device; the host reads (g, counts) and commits g[:, :counts].
+        """
+        quant = self.config.kv_precision == "int8"
+        key = ("spec", k, quant)
+        hit = self._programs.get(key)
+        if hit is not None:
+            return hit
+        run = self._run_model
+        run_d = self._run_draft
+        caches_of = self._caches_of
+        s_ = self.config.max_slots
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(4, 5, 6, 7, 8, 9))
+        def spec(params, buffers, dparams, dbuffers, k_pools, v_pools,
+                 k_scales, v_scales, dk_pools, dv_pools, tok, pt,
+                 lengths, limits):
+            # rows past a sequence's LIFETIME end (pos >= limits[s] =
+            # prompt+max_new) are masked onto the scratch page at pos 0:
+            # an unmasked overflow row's page-table gather would CLAMP
+            # onto the row's last real page and its scatter would
+            # overwrite a live committed position — which the same
+            # pass's valid rows then attend (the batched pass writes
+            # ALL rows before any row attends), silently breaking the
+            # bit-identical-to-greedy contract on the final pass of a
+            # table-filling sequence.  Masked rows' outputs are never
+            # committed (a committed row always has pos < limit), so
+            # scratch garbage is fine — the same contract free slots
+            # already ride on.
+            def mask_row(pos, table):
+                ok = pos < limits
+                return (jnp.where(ok, pos, 0),
+                        jnp.where(ok[:, None], table, 0))
+
+            # --- draft proposes (sequential tiny steps, one scan) ----
+            def dbody(carry, _):
+                cur, dkp, dvp, pos = carry
+                pos_eff, pt_eff = mask_row(pos, pt)
+                caches = caches_of(dkp, dvp, pt_eff)
+                logits, new = run_d(dparams, dbuffers, cur[:, None],
+                                    caches, pos_eff, None)
+                dkp = [c[0] for c in new]
+                dvp = [c[1] for c in new]
+                nxt = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return (nxt, dkp, dvp, pos + 1), nxt
+
+            (_, dkp, dvp, _), d_all = jax.lax.scan(
+                dbody, (tok, dk_pools, dv_pools, lengths), None,
+                length=k + 1)
+            props = jnp.swapaxes(d_all[:k], 0, 1)        # [S, k]
+            # --- target scores k+1 positions in one ragged pass ------
+            ids = jnp.concatenate([tok[:, None], props], axis=1)
+            posm = lengths[:, None] + \
+                jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            bp = s_ * (k + 1)
+            lim_f = jnp.repeat(limits, k + 1)
+            pos_f = posm.reshape(bp)
+            ok_f = pos_f < lim_f
+            pos_f = jnp.where(ok_f, pos_f, 0)
+            pt_f = jnp.where(ok_f[:, None],
+                             jnp.repeat(pt, k + 1, axis=0), 0)
+            caches = caches_of(k_pools, v_pools, pt_f, k_scales,
+                               v_scales)
+            logits, new = run(params, buffers,
+                              ids.reshape(bp)[:, None], caches,
+                              pos_f, None)
+            kps = [c[0] for c in new]
+            vps = [c[1] for c in new]
+            kss = [c[3] for c in new] if quant else k_scales
+            vss = [c[4] for c in new] if quant else v_scales
+            g = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
+                           axis=-1).astype(jnp.int32).reshape(s_, k + 1)
+            # --- greedy accept: longest prefix with d_{i+1} == g_i ---
+            match = (props == g[:, :k]).astype(jnp.int32)
+            acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            counts = acc + 1       # committed tokens = g[:, :acc+1]
+            return g, counts, kps, vps, kss, vss, dkp, dvp
+
+        self._programs[key] = spec
+        return spec
 
     # --- intake -------------------------------------------------------------
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
@@ -347,8 +775,13 @@ class InferenceEngine:
         ragged decode chunk -> detokenize/deliver.  Returns True when
         any work happened."""
         with self._lock:
+            # spec mode writes up to spec_tokens+1 cache positions per
+            # pass — the scheduler must provision pages for the whole
+            # pass, not just the committed prefix
+            chunk = (self.config.spec_tokens + 1 if self._draft
+                     else self.config.decode_chunk)
             with _trace.span("engine.schedule", cat="engine"):
-                out = self.scheduler.schedule(self.config.decode_chunk)
+                out = self.scheduler.schedule(chunk)
             for seq in out.evicted:
                 _metrics.inc("engine.sequences", event="evicted")
             for seq in out.finished:
@@ -367,7 +800,10 @@ class InferenceEngine:
             running = [s for s in out.running
                        if not s.done and s.slot is not None]
             if running:
-                self._decode(running)
+                if self._draft is not None:
+                    self._spec_decode(running)
+                else:
+                    self._decode(running)
                 did = True
             # free completed sequences' slots/pages NOW, not at the
             # next schedule — a drained engine must hold zero pages
@@ -386,6 +822,7 @@ class InferenceEngine:
         s0 = prompt.size
         sb = self._bucket(s0)
         start = sb - s0
+        quant = self.config.kv_precision == "int8"
         with _trace.span("engine.prefill", cat="engine",
                          request=seq.request_id, tokens=s0, bucket=sb,
                          pages=len(seq.pages)):
@@ -400,19 +837,38 @@ class InferenceEngine:
             pages = np.zeros((npb,), np.int32)
             n_real = min(len(seq.pages), npb)
             pages[:n_real] = seq.pages[:n_real]
+            pages_j = jnp.asarray(pages)
+            start_j = jnp.asarray(start, jnp.int32)
             pack = self._pack_program(sb)
-            self._k_pools, self._v_pools = pack(
-                self._k_pools, self._v_pools, kbufs, vbufs,
-                jnp.asarray(pages), jnp.asarray(start, jnp.int32))
+            if quant:
+                (self._k_pools, self._v_pools, self._k_scales,
+                 self._v_scales) = pack(
+                    self._k_pools, self._v_pools, self._k_scales,
+                    self._v_scales, kbufs, vbufs, pages_j, start_j)
+            else:
+                self._k_pools, self._v_pools = pack(
+                    self._k_pools, self._v_pools, kbufs, vbufs,
+                    pages_j, start_j)
+            if self._draft is not None:
+                # the draft re-prefills the same bucket into its own
+                # pools (same page ids) so proposals continue from the
+                # full prompt context
+                dprefill = self._prefill_program(sb, "draft")
+                _, dkb, dvb = dprefill(
+                    self._draft["params"], self._draft["buffers"],
+                    jnp.asarray(ids), jnp.asarray([start], jnp.int32))
+                dpack = self._pack_program(sb, "draft")
+                self._draft["k_pools"], self._draft["v_pools"] = dpack(
+                    self._draft["k_pools"], self._draft["v_pools"],
+                    dkb, dvb, pages_j, start_j)
             seq.length = s0
             t0 = int(np.asarray(jax.device_get(tok))[0])
             seq.last_token = t0
         _metrics.inc("engine.sequences", event="admitted")
         self._accept(seq, t0)
 
-    def _decode(self, running) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
-        cfg = self.config
-        s_, p_ = cfg.max_slots, self.max_pages_per_seq
+    def _batch_arrays(self, running):  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        s_, p_ = self.config.max_slots, self.max_pages_per_seq
         tok = np.zeros((s_,), np.int32)
         pt = np.zeros((s_, p_), np.int32)
         lengths = np.zeros((s_,), np.int32)
@@ -420,6 +876,16 @@ class InferenceEngine:
             tok[seq.slot] = seq.last_token
             pt[seq.slot, :len(seq.pages)] = seq.pages
             lengths[seq.slot] = seq.length
+        return jnp.asarray(tok), jnp.asarray(pt), jnp.asarray(lengths)
+
+    def _scales_args(self):  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        if self._k_scales is None:
+            return [], []
+        return self._k_scales, self._v_scales
+
+    def _decode(self, running) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        cfg = self.config
+        tok, pt, lengths = self._batch_arrays(running)
         # ALWAYS dispatch the configured chunk: shrinking the scan to
         # the batch's max remaining would compile one program per
         # distinct tail length — a compile per shape costs far more
@@ -427,12 +893,14 @@ class InferenceEngine:
         # program is the fixed-compiled-shape contract
         n = cfg.decode_chunk
         decode = self._decode_program(n)
+        ks, vs = self._scales_args()
         with _trace.span("engine.decode", cat="engine", batch=len(running),
                          chunk=n, occupancy=len(running) / cfg.max_slots):
-            toks, self._k_pools, self._v_pools = decode(
+            toks, self._k_pools, self._v_pools, ks, vs = decode(
                 self._params, self._buffers, self._k_pools,
-                self._v_pools, jnp.asarray(tok), jnp.asarray(pt),
-                jnp.asarray(lengths))
+                self._v_pools, ks, vs, tok, pt, lengths)
+            if self._k_scales is not None:
+                self._k_scales, self._v_scales = ks, vs
         with _trace.span("engine.detokenize", cat="engine",
                          batch=len(running), chunk=n):
             toks = np.asarray(jax.device_get(toks))
@@ -445,6 +913,52 @@ class InferenceEngine:
                     self._accept(seq, int(row[j]))
                 seq.length += n
                 seq.last_token = int(row[n - 1])
+
+    def _spec_decode(self, running) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        cfg = self.config
+        k = cfg.spec_tokens
+        tok, pt, lengths = self._batch_arrays(running)
+        # per-slot lifetime cap (prompt+max_new cache positions): rows
+        # of the pass at or past it are masked to the scratch page
+        # inside the program (free slots stay at 0 = fully masked)
+        limits = np.zeros((cfg.max_slots,), np.int32)
+        for seq in running:
+            limits[seq.slot] = seq.prompt.size + seq.max_new_tokens
+        spec = self._spec_program(k)
+        ks, vs = self._scales_args()
+        d = self._draft
+        with _trace.span("engine.decode", cat="engine",
+                         batch=len(running), chunk=k + 1, spec=True,
+                         occupancy=len(running) / cfg.max_slots):
+            (g, counts, self._k_pools, self._v_pools, ks, vs,
+             d["k_pools"], d["v_pools"]) = spec(
+                self._params, self._buffers, d["params"], d["buffers"],
+                self._k_pools, self._v_pools, ks, vs,
+                d["k_pools"], d["v_pools"], tok, pt, lengths,
+                jnp.asarray(limits))
+            if self._k_scales is not None:
+                self._k_scales, self._v_scales = ks, vs
+        with _trace.span("engine.detokenize", cat="engine",
+                         batch=len(running), chunk=k + 1):
+            g = np.asarray(jax.device_get(g))
+            counts = np.asarray(jax.device_get(counts))
+            for seq in running:
+                row = g[seq.slot]
+                cnt = int(counts[seq.slot])
+                # cnt-1 draft proposals were accepted; the rest of the
+                # pass's k proposals were rejected (their cache slots
+                # get overwritten before any later step attends them)
+                _metrics.inc("engine.spec_decode", cnt - 1,
+                             result="accepted")
+                _metrics.inc("engine.spec_decode", k - (cnt - 1),
+                             result="rejected")
+                for j in range(cnt):
+                    if seq.done:
+                        break  # mid-pass finish (eos): later tokens are
+                        # the frozen continuation, not output
+                    self._accept(seq, int(row[j]))
+                seq.length += cnt
+                seq.last_token = int(row[cnt - 1])
 
     def _accept(self, seq: Sequence, tok: int) -> None:
         """One generated token passes the host: record, deliver,
@@ -461,6 +975,12 @@ class InferenceEngine:
 
     def _finish(self, seq: Sequence, reason: str) -> None:
         self.scheduler.finish(seq, reason)
+        # release the slot/pages BEFORE the handle signals completion:
+        # a client (or test) that observes the finished stream must
+        # never find the sequence's pages still held — the end-of-step
+        # release would otherwise race the handler thread by however
+        # long the GIL delays the step's tail
+        self.scheduler.release_finished()
         _metrics.inc("engine.sequences", event="completed")
         if seq.handle is not None:
             seq.handle._finish(reason)
@@ -491,6 +1011,17 @@ class InferenceEngine:
                                  for p in self._k_pools]
                 self._v_pools = [p.at[dst].set(p[src])
                                  for p in self._v_pools]
+                if self._k_scales is not None:
+                    self._k_scales = [s.at[dst].set(s[src])
+                                      for s in self._k_scales]
+                    self._v_scales = [s.at[dst].set(s[src])
+                                      for s in self._v_scales]
+                if self._draft is not None:
+                    d = self._draft
+                    d["k_pools"] = [p.at[dst].set(p[src])
+                                    for p in d["k_pools"]]
+                    d["v_pools"] = [p.at[dst].set(p[src])
+                                    for p in d["v_pools"]]
             for seq in self.scheduler.running_seqs():
                 seq.pages = [moves.get(p, p) for p in seq.pages]
         return len(moves)
@@ -554,6 +1085,13 @@ class InferenceEngine:
     def stats(self) -> dict:
         st = self.scheduler.stats()
         st["pages"] = self.pool.stats()
+        cfg = self.config
+        # the active quantized-decode tiers ride the stats dict into
+        # /health and /ready (serving.py embeds engine.stats() there)
+        st["weight_precision"] = cfg.weight_precision or "full"
+        st["kv_precision"] = cfg.kv_precision or "full"
+        st["spec_tokens"] = cfg.spec_tokens if self._draft else 0
+        st["page_bytes"] = self._page_bytes()
         # monotonic int snapshot for telemetry; a stale read is a fine
         # answer to "how many steps so far"
         st["steps"] = self.steps  # pt-lint: ok[PT102]

@@ -256,6 +256,14 @@ class InferenceServer:
                             "waiting_sequences": st.get("waiting"),
                             "active_sequences": st.get("running"),
                             "max_slots": st.get("max_slots"),
+                            # quantized-decode tiers (ISSUE 12): a
+                            # router/operator can see which precision
+                            # this replica decodes at without parsing
+                            # /metrics text
+                            "weight_precision":
+                                st.get("weight_precision"),
+                            "kv_precision": st.get("kv_precision"),
+                            "spec_tokens": st.get("spec_tokens"),
                         }
                         if server.gen_admission is not None:
                             gs = server.gen_admission.stats()

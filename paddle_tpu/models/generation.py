@@ -59,7 +59,7 @@ def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
     from ..core.dispatch import apply
     from ..nn import functional as F
 
-    if isinstance(kv_cache, (tuple, list)) and len(kv_cache) == 3:
+    if isinstance(kv_cache, (tuple, list)) and len(kv_cache) in (3, 5):
         return _paged_cache_attention(q, k, v, kv_cache, cache_pos)
 
     DA = importlib.import_module("paddle_tpu.ops.pallas.decode_attention")
@@ -129,7 +129,16 @@ def _paged_cache_attention(q, k, v, kv_cache, cache_pos):
     batch slots ride along with pos=0 and an all-scratch page table —
     their writes land in page 0 and their outputs are discarded by the
     engine, so the compiled shape never changes with occupancy.
-    Returns (out [B, 1, Hq, D], (k_pages, v_pages, page_table))."""
+
+    Quantized KV tier (ISSUE 12): a 5-tuple
+    ``(k_pages, v_pages, page_table, k_scales, v_scales)`` with int8
+    pools and per-token-per-head scale tables
+    [num_pages, Hkv, page_size].  The write path quantizes each fresh
+    K/V head-vector independently (`ops.quant.quantize_vectors` — no
+    neighbour requantization, so page writes stay single-slot
+    scatters), stores int8 + scale, and the attention dequantizes in
+    VMEM.  Returns (out [B, 1, Hq, D], new kv_cache of the same
+    arity)."""
     import importlib
 
     from ..core.dispatch import apply
@@ -142,7 +151,12 @@ def _paged_cache_attention(q, k, v, kv_cache, cache_pos):
         raise ValueError(
             "paged KV cache serves single-token decode steps; prefill "
             "runs the dense path and packs into pages afterwards")
-    kp, vp, pt = kv_cache
+    quantized = len(kv_cache) == 5
+    if quantized:
+        kp, vp, pt, ks, vs = kv_cache
+    else:
+        kp, vp, pt = kv_cache
+        ks = vs = None
     ps = kp.shape[2]
 
     def write(pool, new, pt_, pos_):
@@ -150,17 +164,35 @@ def _paged_cache_attention(q, k, v, kv_cache, cache_pos):
         slots = pos_ % ps
         return pool.at[page_ids, :, slots, :].set(new.astype(pool.dtype))
 
+    def write_q(pool, scales, new, pt_, pos_):
+        from ..ops.quant import quantize_vectors
+
+        page_ids = pt_[jnp.arange(b), pos_ // ps]       # [B]
+        slots = pos_ % ps
+        qv, sv = quantize_vectors(new)                  # [B,Hkv,D]/[B,Hkv]
+        pool = pool.at[page_ids, :, slots, :].set(qv)
+        scales = scales.at[page_ids, :, slots].set(sv)
+        return pool, scales
+
     k1 = k.reshape([b, hkv, d])
     v1 = v.reshape([b, hkv, d])
-    kp = apply("paged_kv_update", write, kp, k1, pt, cache_pos)
-    vp = apply("paged_kv_update", write, vp, v1, pt, cache_pos)
+    if quantized:
+        kp, ks = apply("paged_kv_update", write_q, kp, ks, k1, pt,
+                       cache_pos)
+        vp, vs = apply("paged_kv_update", write_q, vp, vs, v1, pt,
+                       cache_pos)
+    else:
+        kp = apply("paged_kv_update", write, kp, k1, pt, cache_pos)
+        vp = apply("paged_kv_update", write, vp, v1, pt, cache_pos)
 
-    def attend(q1, kp_, vp_, pt_, pos_):
-        return PA.paged_attention_dispatch(q1, kp_, vp_, pt_, pos_)
+    def attend(q1, kp_, vp_, pt_, pos_, ks_, vs_):
+        return PA.paged_attention_dispatch(q1, kp_, vp_, pt_, pos_,
+                                           k_scales=ks_, v_scales=vs_)
 
     out = apply("paged_attention", attend, q.reshape([b, hq, d]), kp, vp,
-                pt, cache_pos)
-    return out.reshape([b, 1, hq, d]), (kp, vp, pt)
+                pt, cache_pos, ks, vs)
+    new_cache = (kp, vp, pt, ks, vs) if quantized else (kp, vp, pt)
+    return out.reshape([b, 1, hq, d]), new_cache
 
 
 def decode_position_ids(cache_pos, b, s, attn_start=None):

@@ -102,6 +102,11 @@ _SCHEMA_COUNTERS = tuple(
                  "evicted")]
     + [("engine.tokens", {})]
     + [("paged.dispatch", {"tier": t}) for t in ("pallas", "fallback")]
+    # speculative decoding (ISSUE 12): per-pass draft-token outcomes —
+    # accepted counts committed draft proposals, rejected the discarded
+    # tail (the acceptance rate is accepted/(accepted+rejected))
+    + [("engine.spec_decode", {"result": r})
+       for r in ("accepted", "rejected")]
     # fleet router (ISSUE 9): failure-triggered failovers, replica
     # ejections/re-admissions, and per-endpoint routed-request outcomes
     # — a fresh router reports zeros instead of omitting the keys
@@ -120,9 +125,15 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
                   "serving.admission_limit",
                   # engine state (ISSUE 8): live batch + page pool
                   "engine.active_sequences", "engine.waiting_sequences",
-                  "engine.batch_occupancy", "engine.page_utilization") \
+                  "engine.batch_occupancy", "engine.page_utilization",
+                  # quantized decode (ISSUE 12): draft proposal length
+                  "engine.spec_tokens") \
     + tuple(("router.replicas", {"state": s})
-            for s in ("up", "draining", "ejected", "down"))
+            for s in ("up", "draining", "ejected", "down")) \
+    + tuple(("engine.weight_precision", {"precision": p})
+            for p in ("full", "bf16", "int8")) \
+    + tuple(("paged.pool_precision", {"precision": p})
+            for p in ("full", "int8"))
 
 
 def attach(crash_hook: bool = True):

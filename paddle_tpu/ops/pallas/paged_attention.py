@@ -46,9 +46,12 @@ __all__ = [
 ]
 
 
-def paged_attention_available(pool_shape) -> bool:
+def paged_attention_available(pool_shape, pool_dtype=None) -> bool:
     """Can the Pallas kernel serve this pool shape on this backend?
-    pool_shape: [num_pages, kv_heads, page_size, head_dim]."""
+    pool_shape: [num_pages, kv_heads, page_size, head_dim].  An int8
+    pool (the quantized KV tier) additionally needs page_size to cover
+    the int8 sublane tile (32) — smaller pages fall back to the jnp
+    reference rather than fight the Mosaic layout."""
     from ...core import flags
 
     if not flags.pallas_enabled("paged"):
@@ -56,11 +59,23 @@ def paged_attention_available(pool_shape) -> bool:
     _, _, ps, d = pool_shape
     if d % 8 != 0 or d > 256 or ps % 8 != 0:
         return False
+    if pool_dtype is not None and jnp.dtype(pool_dtype) == jnp.int8 \
+            and ps % 32 != 0:
+        return False
     return not _interpret()
 
 
-def _paged_kernel(sp_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                  acc_ref, *, page_size, block_k, scale):
+def _paged_kernel(sp_ref, q_ref, k_ref, v_ref, *refs, page_size,
+                  block_k, scale, quantized):
+    # quantized pools carry two extra inputs: the per-token-per-head
+    # scale rows of this page (ks_ref/vs_ref, [page_size] each) —
+    # dequantization happens HERE, on the VMEM-resident block, inside
+    # the online-softmax accumulation (the pool stays int8 in HBM)
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     bi = pl.program_id(0)
     p = pl.program_id(2)
     npages = pl.num_programs(2)
@@ -86,6 +101,9 @@ def _paged_kernel(sp_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
             m, l, acc = carry
             k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
             v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+            if quantized:
+                k = k * ks_ref[pl.ds(j * block_k, block_k)][:, None]
+                v = v * vs_ref[pl.ds(j * block_k, block_k)][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [G, bk]
@@ -114,19 +132,30 @@ def _paged_kernel(sp_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
 
 
 def paged_attention(q, k_pages, v_pages, page_table, pos, block_k=None,
-                    interpret=None):
+                    interpret=None, k_scales=None, v_scales=None):
     """q: [B, Hq, D] current-token queries; k_pages/v_pages:
     [num_pages, Hkv, page_size, D] shared page pools (already containing
     each sequence's current token); page_table: [B, P] int32 page ids
     (unused tail entries must point at a reserved scratch page, e.g. 0);
     pos: [B] int32 — index of the current token per sequence (valid
     keys are exactly 0..pos[b]).  Hq may be a multiple of Hkv (GQA).
+
+    Quantized KV tier (ISSUE 12): int8 pools with
+    ``k_scales``/``v_scales`` [num_pages, Hkv, page_size] f32 — one
+    scale per token vector per head, carried alongside the page table.
+    The kernel interface is otherwise UNCHANGED (the Ragged Paged
+    Attention design point): the same grid/BlockSpec gather also DMAs
+    each page's scale row, and dequantization happens in VMEM inside
+    the online-softmax accumulation, so page HBM traffic stays int8.
     Returns [B, Hq, D]."""
     b, hq, d = q.shape
     npool, hkv, ps, _ = k_pages.shape
     if hq % hkv != 0:
         raise ValueError(f"query heads {hq} not a multiple of KV heads "
                          f"{hkv}")
+    quantized = k_scales is not None
+    if quantized != (v_scales is not None):
+        raise ValueError("k_scales and v_scales must be given together")
     g = hq // hkv
     p = page_table.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -139,21 +168,33 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, block_k=None,
     sp = jnp.concatenate(
         [pos.astype(jnp.int32)[:, None],
          page_table.astype(jnp.int32)], axis=1)         # [B, 1+P]
+
+    def page_spec(bs3=None):
+        # the ragged gather: this sequence's pi-th page, straight
+        # from the pool (scratch page 0 for unused tail entries)
+        if bs3 is None:
+            return pl.BlockSpec((None, None, ps),
+                                lambda bi, hi, pi, sp_ref:
+                                (sp_ref[bi, pi + 1], hi, 0))
+        return pl.BlockSpec((None, None, ps, bs3),
+                            lambda bi, hi, pi, sp_ref:
+                            (sp_ref[bi, pi + 1], hi, 0, 0))
+
+    in_specs = [
+        pl.BlockSpec((None, None, g, d),
+                     lambda bi, hi, pi, sp_ref: (bi, hi, 0, 0)),
+        page_spec(d),
+        page_spec(d),
+    ]
+    inputs = [sp, q4, k_pages, v_pages]
+    if quantized:
+        in_specs += [page_spec(), page_spec()]
+        inputs += [k_scales.astype(jnp.float32),
+                   v_scales.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv, p),
-        in_specs=[
-            pl.BlockSpec((None, None, g, d),
-                         lambda bi, hi, pi, sp_ref: (bi, hi, 0, 0)),
-            # the ragged gather: this sequence's pi-th page, straight
-            # from the pool (scratch page 0 for unused tail entries)
-            pl.BlockSpec((None, None, ps, d),
-                         lambda bi, hi, pi, sp_ref:
-                         (sp_ref[bi, pi + 1], hi, 0, 0)),
-            pl.BlockSpec((None, None, ps, d),
-                         lambda bi, hi, pi, sp_ref:
-                         (sp_ref[bi, pi + 1], hi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, g, d),
                                lambda bi, hi, pi, sp_ref: (bi, hi, 0, 0)),
         scratch_shapes=[
@@ -162,29 +203,43 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, block_k=None,
             pltpu.VMEM((g, d), jnp.float32),
         ],
     )
+    out_dtype = q.dtype
     out = pl.pallas_call(
         functools.partial(_paged_kernel, page_size=ps, block_k=block_k,
-                          scale=scale),
+                          scale=scale, quantized=quantized),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), out_dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(sp, q4, k_pages, v_pages)
+    )(*inputs)
     return out.reshape(b, hq, d)
 
 
-def paged_attention_reference(q, k_pages, v_pages, page_table, pos):
+def paged_attention_reference(q, k_pages, v_pages, page_table, pos,
+                              k_scales=None, v_scales=None):
     """Dense jnp reference (and the CPU execution path): gather each
     sequence's pages into a contiguous view and attend with a masked
     softmax.  Numerically the plain-softmax twin of the kernel's online
-    accumulation."""
+    accumulation.  With scale tables (quantized int8 pools) each token
+    vector dequantizes with its own per-head scale before the gather
+    view — the same f32 multiply the kernel applies in VMEM."""
+    from ..quant import dequantize_vectors
+
     b, hq, d = q.shape
     _, hkv, ps, _ = k_pages.shape
     p = page_table.shape[1]
     g = hq // hkv
     scale = 1.0 / (d ** 0.5)
+    # gather FIRST, dequantize the gathered [B, P, ...] view: expanding
+    # the whole pool to f32 before the gather would materialize 4x the
+    # int8 pool bytes per decode step for pages nobody reads (same
+    # values either way — dequant is an elementwise multiply)
+    kg, vg = k_pages[page_table], v_pages[page_table]
+    if k_scales is not None:
+        kg = dequantize_vectors(kg, k_scales[page_table])
+        vg = dequantize_vectors(vg, v_scales[page_table])
     # [B, P, Hkv, PS, D] -> [B, Hkv, P*PS, D]
-    k = jnp.moveaxis(k_pages[page_table], 2, 1).reshape(b, hkv, p * ps, d)
-    v = jnp.moveaxis(v_pages[page_table], 2, 1).reshape(b, hkv, p * ps, d)
+    k = jnp.moveaxis(kg, 2, 1).reshape(b, hkv, p * ps, d)
+    v = jnp.moveaxis(vg, 2, 1).reshape(b, hkv, p * ps, d)
     q4 = q.reshape(b, hkv, g, d).astype(jnp.float32) * scale
     s = jnp.einsum("bhgd,bhsd->bhgs", q4, k.astype(jnp.float32))
     ids = jnp.arange(p * ps, dtype=jnp.int32)
@@ -195,7 +250,8 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, pos):
     return out.reshape(b, hq, d).astype(q.dtype)
 
 
-def _tuned_block_k(b, hq, d, dtype, pool_shape, n_tables):
+def _tuned_block_k(b, hq, d, dtype, pool_shape, n_tables,
+                   pool_dtype="float32"):
     """Autotuned intra-page block_k for this paged-decode signature
     (cached per device kind on disk, like the flash/decode tiers).
     Candidates are page_size divisors ≥ 128 lanes-worth of rows — a
@@ -204,6 +260,7 @@ def _tuned_block_k(b, hq, d, dtype, pool_shape, n_tables):
     from . import autotune
 
     npool, hkv, ps, _ = pool_shape
+    quantized = jnp.dtype(pool_dtype) == jnp.int8
     cands = []
     for c in (ps, 256, 128):
         c = min(c, ps)
@@ -211,37 +268,55 @@ def _tuned_block_k(b, hq, d, dtype, pool_shape, n_tables):
             cands.append(c)
     if len(cands) <= 1:
         return ps
-    sig = f"b{b}h{hq}d{d}{dtype}|pool{npool}x{hkv}x{ps}|pt{n_tables}"
+    sig = (f"b{b}h{hq}d{d}{dtype}|pool{npool}x{hkv}x{ps}"
+           f"{pool_dtype}|pt{n_tables}")
 
     def run(cfg):
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
         q = jax.random.normal(kq, (b, hq, d), jnp.dtype(dtype))
-        kp = jax.random.normal(kk, pool_shape, jnp.dtype(dtype))
-        vp = jax.random.normal(kv, pool_shape, jnp.dtype(dtype))
+        ks = vs = None
+        if quantized:
+            kp = jax.random.randint(kk, pool_shape, -127, 128,
+                                    jnp.int8)
+            vp = jax.random.randint(kv, pool_shape, -127, 128,
+                                    jnp.int8)
+            ks = jnp.ones(pool_shape[:3], jnp.float32)
+            vs = jnp.ones(pool_shape[:3], jnp.float32)
+        else:
+            kp = jax.random.normal(kk, pool_shape, jnp.dtype(dtype))
+            vp = jax.random.normal(kv, pool_shape, jnp.dtype(dtype))
         pt = jnp.tile(jnp.arange(n_tables, dtype=jnp.int32)[None, :],
                       (b, 1))
         pos = jnp.full((b,), n_tables * ps - 1, jnp.int32)
 
         def f(qq):
-            return paged_attention(qq, kp, vp, pt, pos, block_k=cfg)
+            return paged_attention(qq, kp, vp, pt, pos, block_k=cfg,
+                                   k_scales=ks, v_scales=vs)
 
         return f, q
 
     return autotune.pick("paged_attention", sig, cands, run, default=ps)
 
 
-def paged_attention_dispatch(q, k_pages, v_pages, page_table, pos):
+def paged_attention_dispatch(q, k_pages, v_pages, page_table, pos,
+                             k_scales=None, v_scales=None):
     """Dispatch-tier entry (the one the engine's decode program calls):
     the Pallas kernel when available (block_k autotuned per signature),
-    the jnp reference otherwise.  Counts `paged.dispatch{tier=...}`."""
+    the jnp reference otherwise.  Counts `paged.dispatch{tier=...}`.
+    Scale tables route the quantized int8-pool tier through the SAME
+    kernel (dequant in VMEM) or the same reference."""
     from ...observability import metrics as _metrics
 
-    if paged_attention_available(k_pages.shape):
+    if paged_attention_available(k_pages.shape, k_pages.dtype):
         _metrics.inc("paged.dispatch", tier="pallas")
         block_k = _tuned_block_k(
             q.shape[0], q.shape[1], q.shape[2], str(q.dtype),
-            tuple(k_pages.shape), page_table.shape[1])
+            tuple(k_pages.shape), page_table.shape[1],
+            pool_dtype=str(k_pages.dtype))
         return paged_attention(q, k_pages, v_pages, page_table, pos,
-                               block_k=block_k)
+                               block_k=block_k, k_scales=k_scales,
+                               v_scales=v_scales)
     _metrics.inc("paged.dispatch", tier="fallback")
-    return paged_attention_reference(q, k_pages, v_pages, page_table, pos)
+    return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     pos, k_scales=k_scales,
+                                     v_scales=v_scales)

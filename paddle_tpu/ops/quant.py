@@ -1,0 +1,135 @@
+"""Shared symmetric-int8 codec — ONE definition for every int8 tier.
+
+Three subsystems ride the same absmax→scale→round-to-nearest recipe
+(ISSUE 12): the EQuARX quantized-collective wire tier
+(`distributed/quantized.py`), the engine's weight-only decode
+(`inference/engine`, per-output-channel scales), and the quantized KV
+page pool (per-token-per-head vector scales carried next to the page
+table).  Before this module each would have grown its own copy of the
+scale/encode math, and a drift between any two silently changes either
+the wire payload or the decode numerics — so the codec lives here once,
+as pure jax-traceable functions with no framework deps, and everything
+else imports it.
+
+Codec contract (pinned by tests/test_quantized_decode.py):
+
+* ``scales_from_absmax``: scale = absmax / 127, except an all-zero
+  block clamps to scale 1 so quantized zeros stay exactly zero (never
+  a 0/0 NaN).
+* ``encode_int8``: symmetric round-to-nearest into [-127, 127]
+  (jnp.round = round-half-to-even, the IEEE default).
+* round-trip error per element is bounded by absmax/127 of its block —
+  half a quantization step from rounding, and the bound the KV-pool
+  error tests assert.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "CHUNK", "scales_from_absmax", "encode_int8", "decode_int8",
+    "quantize_chunked", "dequantize_chunked", "quantize_channels",
+    "dequantize_channels", "quantize_vectors", "dequantize_vectors",
+]
+
+# EQuARX uses hardware-convenient blocks; 256 keeps the scale sidecar
+# under 0.4% of the payload while tracking local dynamic range.
+CHUNK = 256
+
+
+def scales_from_absmax(absmax):
+    """Per-block scales from per-block absmax: a silent block (all
+    zeros) must not divide by 0 — scale 1 keeps quantized zeros exactly
+    zero.  THE one definition: the collective wire tier, the weight
+    quantizer, and the KV pool must never drift."""
+    return jnp.where(absmax > 0, absmax / 127.0, 1.0)
+
+
+def encode_int8(x, scales):
+    """Symmetric round-to-nearest int8 encode of ``x`` under
+    broadcastable ``scales`` (counterpart of
+    :func:`scales_from_absmax`).  Returns the clipped values still in
+    the input float dtype — callers cast to int8 (or int32 for
+    overflow-free accumulation) themselves."""
+    return jnp.clip(jnp.round(x / scales), -127, 127)
+
+
+def decode_int8(q, scales):
+    """Inverse of :func:`encode_int8` back to f32 under broadcastable
+    ``scales``."""
+    return q.astype(jnp.float32) * scales
+
+
+# ----------------------- chunked (wire payloads) -----------------------
+
+
+def _as_chunks(x, chunk):
+    """Flatten ``x`` to ``[n_chunks, chunk]`` (zero-padded tail);
+    returns (chunks, pad)."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % chunk
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(-1, chunk), pad
+
+
+def quantize_chunked(x, chunk=CHUNK):
+    """Symmetric per-chunk int8 quantization.  Returns
+    ``(q_int8 [n_chunks, chunk], scales_f32 [n_chunks], pad)``."""
+    ch, pad = _as_chunks(x.astype(jnp.float32), chunk)
+    absmax = jnp.max(jnp.abs(ch), axis=1)
+    scales = scales_from_absmax(absmax)
+    q = encode_int8(ch, scales[:, None]).astype(jnp.int8)
+    return q, scales, pad
+
+
+def dequantize_chunked(q, scales, shape, pad):
+    """Inverse of :func:`quantize_chunked` back to f32 ``shape``."""
+    out = decode_int8(q, scales[:, None])
+    flat = out.reshape(-1)
+    if pad:
+        flat = flat[:flat.size - pad]
+    return flat.reshape(shape)
+
+
+# ----------------------- per-channel (weights) -----------------------
+
+
+def quantize_channels(w, axis=0):
+    """Per-channel weight quantization: absmax reduced over ``axis``
+    (the contraction dim), one scale per remaining channel.  Returns
+    ``(q int8 (w.shape), scales f32 broadcastable to w.shape)`` — the
+    scales keep a size-1 dim where the reduction happened, so
+    ``decode_int8(q, scales)`` needs no axis bookkeeping."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scales = scales_from_absmax(absmax)
+    q = encode_int8(w32, scales).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_channels(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_channels` into ``dtype``.  The
+    multiply runs in f32 and casts once — the same value every tier
+    produces for the same (q, scale)."""
+    return decode_int8(q, scales).astype(dtype)
+
+
+# ----------------------- per-vector (KV pages) -----------------------
+
+
+def quantize_vectors(x):
+    """Quantize the trailing dim of ``x`` as independent vectors: one
+    scale per leading index (a KV head vector per token gets its own
+    absmax, so page writes never require requantizing neighbours).
+    Returns ``(q int8 (x.shape), scales f32 x.shape[:-1])``."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scales = scales_from_absmax(absmax)
+    q = encode_int8(x32, scales[..., None]).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize_vectors(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_vectors` into ``dtype``."""
+    return decode_int8(q, scales[..., None]).astype(dtype)

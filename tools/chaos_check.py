@@ -382,13 +382,21 @@ def _build_engine_model(seed=0):
     return model
 
 
-def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10):
+def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10,
+                     kv_precision=None):
     """Engine chaos: cancel/abandon sequences mid-decode, kill a client
     mid-stream, and shed past saturation.  `recovered` means: zero page
     leak after every scenario, survivors bit-identical to an
     uninterrupted run, the mid-stream kill actually cancelled its
     sequence, and the sheds are visible in the SLO report under known
-    reason labels."""
+    reason labels.
+
+    ``kv_precision='int8'`` (ISSUE 12) reruns the whole scenario with
+    the quantized page pool: the uninterrupted reference is then a
+    quantized engine too, so "survivors bit-identical" asserts the
+    quantized tier's run-to-run determinism under cancels/kills — the
+    tier's documented contract (tokens within rtol of bf16, bit-stable
+    per run)."""
     import http.client
     import threading
     import time
@@ -407,7 +415,8 @@ def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10):
     rs = np.random.RandomState(seed)
     prompts = [rs.randint(0, 256, (3 + (i * 5) % 17,)).astype(np.int32)
                for i in range(n_seqs)]
-    ecfg = dict(page_size=8, max_slots=4, decode_chunk=2, max_seq_len=96)
+    ecfg = dict(page_size=8, max_slots=4, decode_chunk=2, max_seq_len=96,
+                kv_precision=kv_precision)
 
     # 1. uninterrupted reference run
     ref_engine = InferenceEngine(model, EngineConfig(**ecfg))
@@ -513,7 +522,9 @@ def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10):
         for k, v in slo_ep.get("errors_by_reason", {}).items()
         if k.startswith("shed:")}
     report = {
-        "scenario": "engine",
+        "scenario": "engine" if kv_precision is None
+        else f"engine[kv={kv_precision}]",
+        "kv_precision": kv_precision or "full",
         "sequences": n_seqs,
         "ref_page_leak": ref_leak,
         "survivors_bit_identical": bool(survivors_ok),
@@ -743,6 +754,13 @@ def main(argv=None):
         report = run_overload(seed=args.seed)
     elif args.scenario == "engine":
         report = run_engine_chaos(seed=args.seed)
+        # the quantized page pool rides the SAME chaos (ISSUE 12):
+        # zero page leak and survivors bit-identical (run-to-run
+        # determinism of the int8 tier) must hold under cancels/kills
+        q = run_engine_chaos(seed=args.seed, kv_precision="int8")
+        report["quantized_pool"] = q
+        report["recovered"] = bool(report["recovered"]
+                                   and q["recovered"])
     elif args.scenario == "fleet":
         report = run_fleet_chaos(seed=args.seed)
     elif args.scenario == "preemption":
