@@ -353,8 +353,11 @@ def _bench_serving_decode(degraded: bool) -> dict:
                         num_heads=12, max_seq_len=512)
         n_clients, new_tokens = 16, 96
         lens = (32, 64, 96, 128)
+        # prefix_cache off: this row measures DECODE throughput; warm
+        # -prefill compiles inside the timed burst would skew it (the
+        # cache has its own serving_prefix_* rows)
         ecfg = EngineConfig(page_size=32, max_slots=8, decode_chunk=8,
-                            max_seq_len=512)
+                            max_seq_len=512, prefix_cache=False)
         stagger = 0.01
     else:
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
@@ -362,7 +365,7 @@ def _bench_serving_decode(degraded: bool) -> dict:
         n_clients, new_tokens = 8, 24
         lens = (4, 8, 12, 20)
         ecfg = EngineConfig(page_size=8, max_slots=4, decode_chunk=4,
-                            max_seq_len=128)
+                            max_seq_len=128, prefix_cache=False)
         stagger = 0.002
     P.seed(0)
     model = GPTForCausalLM(cfg)
@@ -458,8 +461,10 @@ def _bench_quantized_decode(degraded: bool) -> list:
         layers, draft_layers = 12, 2
         n_clients, new_tokens, spec_k = 16, 96, 4
         lens = (32, 64, 96, 128)
+        # prefix_cache off: decode-tier rows, same rationale as
+        # _bench_serving_decode
         ecfg = dict(page_size=32, max_slots=8, decode_chunk=8,
-                    max_seq_len=512)
+                    max_seq_len=512, prefix_cache=False)
         stagger = 0.01
     else:
         dims = dict(vocab_size=1024, hidden_size=128, num_heads=4,
@@ -468,7 +473,7 @@ def _bench_quantized_decode(degraded: bool) -> list:
         n_clients, new_tokens, spec_k = 8, 24, 4
         lens = (4, 8, 12, 20)
         ecfg = dict(page_size=8, max_slots=4, decode_chunk=4,
-                    max_seq_len=128)
+                    max_seq_len=128, prefix_cache=False)
         stagger = 0.002
     P.seed(0)
     model = GPTForCausalLM(GPTConfig(num_layers=layers, **dims))
@@ -562,6 +567,128 @@ def _bench_quantized_decode(degraded: bool) -> list:
             "vs_baseline": 0.0,
         }
         row.update(extra)
+        if degraded or not on_tpu:
+            row["degraded"] = True
+        rows.append(row)
+    return rows
+
+
+def _bench_prefix_cache(degraded: bool) -> list:
+    """Shared-prefix serving workload (ISSUE 13): N requests over a
+    small TENANT population — every tenant has a common system prompt,
+    each request appends a unique user suffix — first through a
+    prefix-cache-enabled engine, then the SAME requests through a
+    cache-disabled engine built from the same model in the same run.
+    Three gateable rows ship with their own evidence:
+
+      * serving_prefix_cache_hit_rate        — admission hits / total
+      * serving_ttft_warm_vs_cold_speedup    — mean cold TTFT / mean
+        warm-HIT TTFT (per-request time to FIRST token, measured at the
+        handle; compiles warmed out of both sides)
+      * serving_prefill_tokens_saved_frac    — prompt tokens NOT
+        re-prefilled / total prompt tokens
+
+    CPU proxy numbers are degraded-marked; the RATIOS are the claim
+    (the cache removes prefill compute on both platforms)."""
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    on_tpu = jax.devices()[0].platform in _ACCEL_PLATFORMS
+    if on_tpu:
+        dims = dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=512)
+        page, sys_pages, n_tenants, n_reqs = 32, 8, 4, 24
+        sfx_len, new_tokens = 17, 8
+        ecfg = dict(page_size=page, max_slots=4, max_seq_len=512,
+                    prefill_bucket=page)
+    else:
+        dims = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                    num_heads=4, max_seq_len=128)
+        page, sys_pages, n_tenants, n_reqs = 8, 6, 4, 16
+        sfx_len, new_tokens = 5, 4
+        ecfg = dict(page_size=page, max_slots=4, max_seq_len=128,
+                    prefill_bucket=page)
+    P.seed(0)
+    model = GPTForCausalLM(GPTConfig(**dims))
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    rs = np.random.RandomState(0)
+    sys_len = page * sys_pages
+    tenants = [rs.randint(0, dims["vocab_size"],
+                          (sys_len,)).astype(np.int32)
+               for _ in range(n_tenants)]
+    reqs = [np.concatenate([
+        tenants[i % n_tenants],
+        rs.randint(0, dims["vocab_size"], (sfx_len,)).astype(np.int32)])
+        for i in range(n_reqs)]
+    # warmup tenant (same shapes, never measured): compiles the cold
+    # prefill bucket, the warm (sb, npp) program, pack, and decode on
+    # BOTH engines so no timed request pays a compile
+    wt = rs.randint(0, dims["vocab_size"], (sys_len,)).astype(np.int32)
+    warm_reqs = [np.concatenate([
+        wt, rs.randint(0, dims["vocab_size"],
+                       (sfx_len,)).astype(np.int32)])
+        for _ in range(2)]
+
+    def run(prefix_cache):
+        eng = InferenceEngine(model, EngineConfig(
+            **ecfg, prefix_cache=prefix_cache))
+        for w in warm_reqs:
+            eng.generate([w], max_new_tokens=new_tokens)
+        eng.clear_prefix_cache()
+        base = eng.prefix_cache_stats()
+        eng.start()
+        ttfts = []
+        try:
+            for p in reqs:
+                t0 = time.perf_counter()
+                h = eng.submit(p, max_new_tokens=new_tokens)
+                it = h.stream(timeout=600.0)
+                next(it)                     # block for the FIRST token
+                ttfts.append((time.perf_counter() - t0,
+                              h.cache_state))
+                for _ in it:                 # drain the rest
+                    pass
+        finally:
+            eng.stop()
+        st = eng.prefix_cache_stats()
+        eng.clear_prefix_cache()
+        # delta vs the post-warmup ledger: only the measured burst
+        st = {k: st[k] - base[k] if isinstance(st.get(k), (int, float))
+              and isinstance(base.get(k), (int, float)) else st.get(k)
+              for k in st}
+        return ttfts, st
+
+    warm_ttfts, wstats = run(True)
+    cold_ttfts, _ = run(False)
+    hits = sum(1 for _, c in warm_ttfts if c in ("hit", "partial"))
+    hit_rate = hits / max(1, len(warm_ttfts))
+    warm_hit_mean = float(np.mean([t for t, c in warm_ttfts
+                                   if c in ("hit", "partial")] or [0.0]))
+    cold_mean = float(np.mean([t for t, _ in cold_ttfts] or [0.0]))
+    speedup = (cold_mean / warm_hit_mean) if warm_hit_mean > 0 else 0.0
+    saved_frac = (wstats.get("prefill_tokens_saved", 0)
+                  / max(1, wstats.get("prefill_tokens_total", 0)))
+    shared = dict(
+        tenants=n_tenants, requests=n_reqs, system_prompt_tokens=sys_len,
+        suffix_tokens=sfx_len,
+        cold_ttft_ms=round(float(cold_mean) * 1e3, 2),
+        warm_hit_ttft_ms=round(float(warm_hit_mean) * 1e3, 2))
+    rows = []
+    for metric, value, unit in (
+            ("serving_prefix_cache_hit_rate", round(hit_rate, 4),
+             "frac"),
+            ("serving_ttft_warm_vs_cold_speedup", round(speedup, 2),
+             "x"),
+            ("serving_prefill_tokens_saved_frac", round(saved_frac, 4),
+             "frac")):
+        row = {"metric": metric, "value": value, "unit": unit,
+               "vs_baseline": 0.0}
+        row.update(shared)
         if degraded or not on_tpu:
             row["degraded"] = True
         rows.append(row)
@@ -825,6 +952,19 @@ def run_secondary_benches(degraded: bool = False) -> None:
                        "serving_decode_kvint8_tokens_per_sec",
                        "serving_decode_spec_tokens_per_sec"):
             _emit({"metric": metric, "value": 0.0, "unit": "tokens/s",
+                   "vs_baseline": 0.0, "degraded": True,
+                   "note": f"failed: {type(e).__name__}: {e}"})
+    try:
+        for row in _bench_prefix_cache(degraded):
+            _emit(row)
+    except Exception as e:
+        print(f"prefix-cache-bench-failed: {e}", file=sys.stderr)
+        # failure emits degraded 0-rows, never absence (a vanished row
+        # reads as "nothing regressed" to the gate)
+        for metric in ("serving_prefix_cache_hit_rate",
+                       "serving_ttft_warm_vs_cold_speedup",
+                       "serving_prefill_tokens_saved_frac"):
+            _emit({"metric": metric, "value": 0.0, "unit": "frac",
                    "vs_baseline": 0.0, "degraded": True,
                    "note": f"failed: {type(e).__name__}: {e}"})
     try:

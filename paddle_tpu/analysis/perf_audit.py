@@ -109,10 +109,12 @@ RULE_IDS = ("PT400", "PT401", "PT402", "PT403", "PT404", "PT405",
 # op-table sweep (slow tier — imports + traces the whole exported surface)
 DEFAULT_PROGRAMS = ("train_step", "sharded_train_step", "swin_train_step",
                     "decode_step", "paged_decode_step",
-                    "quantized_decode_step", "call_sites")
+                    "quantized_decode_step", "cached_prefill_step",
+                    "call_sites")
 FULL_PROGRAMS = ("train_step", "sharded_train_step", "swin_train_step",
                  "decode_step", "paged_decode_step",
-                 "quantized_decode_step", "call_sites", "op_table")
+                 "quantized_decode_step", "cached_prefill_step",
+                 "call_sites", "op_table")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -839,6 +841,44 @@ def _quantized_decode_step_program(slots=2, pages_per_seq=4, page_size=8,
     return lowered, jaxpr, None, meta
 
 
+def _cached_prefill_step_program(slots=2, pages_per_seq=8, page_size=8,
+                                 tail_bucket=8, prefix_pages=2):
+    """The prefix cache's WARM tail-prefill program
+    (``InferenceEngine._cached_prefill_program``, ISSUE 13) at a tiny
+    proxy shape: prefix capacity bucketed to `prefix_pages` (power of
+    two), tail bucketed to `tail_bucket`.  Budgeting it pins the warm
+    path's layout counts AND its PT402 surface — a per-cached-length
+    recompile hazard (shapes leaking the actual shared length instead
+    of the bucket) is exactly the regression this program exists to
+    catch.  Returns ``(lowered, closed_jaxpr)``."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as P
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(0)
+    max_len = page_size * pages_per_seq
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=1,
+                    num_heads=4, max_seq_len=max_len)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = InferenceEngine(model, EngineConfig(
+        page_size=page_size, max_slots=slots,
+        prefill_bucket=tail_bucket, max_seq_len=max_len))
+    cpre = eng._cached_prefill_program(tail_bucket, prefix_pages)
+    args = (eng._params, eng._buffers,
+            jnp.zeros((1, tail_bucket), jnp.int32),
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((prefix_pages,), jnp.int32),
+            jnp.asarray(page_size * prefix_pages, jnp.int32),
+            eng._k_pools, eng._v_pools)
+    lowered = cpre.lower(*args)
+    jaxpr = jax.make_jaxpr(cpre)(*args)
+    return lowered, jaxpr
+
+
 def _audit_lowered(name: str, lowered, jaxpr=None, arg_names=None):
     """All three views of one lowered program -> (violations, metrics).
     A missing view is a PT400 — an absent metric is invisible to the
@@ -998,21 +1038,26 @@ def audit_perf(programs=DEFAULT_PROGRAMS, repo_root=None):
             v, m = _audit_call_sites(repo_root)
         elif prog in ("train_step", "sharded_train_step",
                       "swin_train_step", "decode_step",
-                      "paged_decode_step", "quantized_decode_step"):
+                      "paged_decode_step", "quantized_decode_step",
+                      "cached_prefill_step"):
             full = {"train_step": "gpt125m_train_step",
                     "sharded_train_step": "gpt_sharded_train_step",
                     "swin_train_step": "swin_train_step",
                     "decode_step": "gpt_decode_step",
                     "paged_decode_step": "gpt_paged_decode_step",
                     "quantized_decode_step":
-                        "gpt_quantized_decode_step"}[prog]
+                        "gpt_quantized_decode_step",
+                    "cached_prefill_step":
+                        "gpt_cached_prefill_step"}[prog]
             build = {"train_step": _train_step_program,
                      "sharded_train_step": _sharded_train_step_program,
                      "swin_train_step": _swin_train_step_program,
                      "decode_step": _decode_step_program,
                      "paged_decode_step": _paged_decode_step_program,
                      "quantized_decode_step":
-                         _quantized_decode_step_program}[prog]
+                         _quantized_decode_step_program,
+                     "cached_prefill_step":
+                         _cached_prefill_step_program}[prog]
             try:
                 out = build()
             except Exception as e:

@@ -1,12 +1,17 @@
 """paddle_tpu.inference.engine — continuous-batching inference engine
 with a paged KV cache (docs/INFERENCE.md).
 
-  * `paging`     — host page-pool allocator (alloc/free/defrag).
+  * `paging`     — host page-pool allocator (refcounted alloc/share/
+                   free, defrag; prefix sharing rides the refcounts).
+  * `prefix`     — radix prefix index: page-aligned committed prompt
+                   prefixes -> physical pages (LRU idle eviction).
   * `scheduler`  — slot/admission/eviction policy at one fixed
-                   compiled batch shape (injectable clock).
-  * `engine`     — the `InferenceEngine`: bucketed dense prefill,
-                   pack-to-pages, ragged paged decode steps
-                   (`ops/pallas/paged_attention`), request handles.
+                   compiled batch shape (injectable clock); admission
+                   shares the longest cached prefix into the table.
+  * `engine`     — the `InferenceEngine`: bucketed dense prefill (cold)
+                   or cached tail prefill (warm), pack-to-pages, ragged
+                   paged decode steps (`ops/pallas/paged_attention`),
+                   request handles.
 
 Serving wires an engine behind `POST /generate`
 (`inference/serving.py`), fed through the existing
@@ -16,10 +21,11 @@ from __future__ import annotations
 
 from .engine import EngineConfig, InferenceEngine, RequestHandle  # noqa: F401
 from .paging import OutOfPages, PagePool, SCRATCH_PAGE  # noqa: F401
+from .prefix import PrefixIndex  # noqa: F401
 from .scheduler import Scheduler, SchedulerOutput, Sequence  # noqa: F401
 
 __all__ = [
     "EngineConfig", "InferenceEngine", "RequestHandle",
-    "PagePool", "OutOfPages", "SCRATCH_PAGE",
+    "PagePool", "OutOfPages", "SCRATCH_PAGE", "PrefixIndex",
     "Scheduler", "SchedulerOutput", "Sequence",
 ]

@@ -32,6 +32,8 @@ Env knobs (read when the matching ctor arg is None):
   PADDLE_TPU_ENGINE_DECODE_CHUNK   decode steps per dispatch  (1)
   PADDLE_TPU_ENGINE_PREFILL_BUCKET prompt padding granule     (16)
   PADDLE_TPU_ENGINE_MAX_SEQ_LEN    per-sequence token cap     (model's)
+  PADDLE_TPU_ENGINE_PREFIX_CACHE   prefix caching on/off      (1)
+  PADDLE_TPU_ENGINE_PREFIX_CACHE_MAX_TOKENS  cache bound      (0=pool)
 
 Observability: `engine.schedule/prefill/decode/detokenize` spans on
 the request-trace timeline, `engine.*` gauges (active/waiting
@@ -55,6 +57,7 @@ from ...observability import metrics as _metrics
 from ...observability import trace as _trace
 from ...resilience.overload import _env_num
 from .paging import PagePool
+from .prefix import PrefixIndex
 from .scheduler import Scheduler, Sequence
 
 __all__ = ["EngineConfig", "InferenceEngine", "RequestHandle"]
@@ -81,7 +84,8 @@ class EngineConfig:
     def __init__(self, page_size=None, num_pages=None, max_slots=None,
                  decode_chunk=None, prefill_bucket=None,
                  max_seq_len=None, weight_precision=None,
-                 kv_precision=None, spec_tokens=None, pool_hbm_mb=None):
+                 kv_precision=None, spec_tokens=None, pool_hbm_mb=None,
+                 prefix_cache=None, prefix_cache_max_tokens=None):
         self.page_size = int(page_size if page_size is not None else
                              _env_num("PADDLE_TPU_ENGINE_PAGE_SIZE", 16,
                                       int))
@@ -129,6 +133,21 @@ class EngineConfig:
         self.pool_hbm_mb = float(
             pool_hbm_mb if pool_hbm_mb is not None else
             _env_num("PADDLE_TPU_ENGINE_POOL_HBM_MB", 0.0, float))
+        # prefix caching (ISSUE 13, docs/INFERENCE.md "Prefix caching"):
+        # committed page-aligned prompt prefixes are indexed and shared
+        # into later sequences' page tables (refcounted), so prefill
+        # compute and page capacity scale with UNIQUE prompt tokens.
+        # ON by default — streams are proven bit-identical warm vs
+        # cold; 0 disables.  The token bound caps what the radix index
+        # may pin (0 = bounded only by pool pressure's LRU reclaim).
+        self.prefix_cache = bool(int(
+            prefix_cache if prefix_cache is not None else
+            _env_num("PADDLE_TPU_ENGINE_PREFIX_CACHE", 1, int)))
+        self.prefix_cache_max_tokens = int(
+            prefix_cache_max_tokens
+            if prefix_cache_max_tokens is not None else
+            _env_num("PADDLE_TPU_ENGINE_PREFIX_CACHE_MAX_TOKENS", 0,
+                     int))
         for name in ("page_size", "max_slots", "decode_chunk",
                      "prefill_bucket"):
             if getattr(self, name) < 1:
@@ -137,6 +156,10 @@ class EngineConfig:
         if self.spec_tokens < 0:
             raise ValueError(
                 f"spec_tokens must be >= 0, got {self.spec_tokens}")
+        if self.prefix_cache_max_tokens < 0:
+            raise ValueError(
+                f"prefix_cache_max_tokens must be >= 0, got "
+                f"{self.prefix_cache_max_tokens}")
 
 
 class RequestHandle:
@@ -185,6 +208,14 @@ class RequestHandle:
     @property
     def cancelled(self) -> bool:
         return self.finish_reason == "cancelled"
+
+    @property
+    def cache_state(self) -> str:
+        """Prefix-cache outcome at admission: ``hit`` (longest sharable
+        prefix fully cached), ``partial``, or ``miss`` (also the answer
+        while still waiting / when caching is off) — the TTFT
+        histogram's `cache` label (serving.py)."""
+        return self._seq.cache_state or "miss"
 
 
 def _matmul_weight_names(model):
@@ -296,8 +327,20 @@ class InferenceEngine:
             else:
                 cfg.num_pages = cfg.max_slots * self.max_pages_per_seq + 1
         self.pool = PagePool(cfg.num_pages, cfg.page_size)
+        self._prefix = None
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_tokens_saved = 0
+        self._prefix_tokens_total = 0
+        if cfg.prefix_cache:
+            self._prefix = PrefixIndex(
+                self.pool, max_tokens=cfg.prefix_cache_max_tokens,
+                clock=clock,
+                on_evict=lambda n: _metrics.inc(
+                    "engine.prefix_cache", n, event="evict"))
         self.scheduler = Scheduler(cfg.max_slots, self.pool,
-                                   self.max_pages_per_seq, clock=clock)
+                                   self.max_pages_per_seq, clock=clock,
+                                   prefix_index=self._prefix)
         shape = (cfg.num_pages, self._hkv, cfg.page_size, self._hd)
         pool_dtype = jnp.int8 if cfg.kv_precision == "int8" \
             else self._dtype
@@ -584,6 +627,84 @@ class InferenceEngine:
         self._programs[key] = pack_q
         return pack_q
 
+    def _cached_prefill_program(self, sb: int, npp: int,
+                                which="target"):
+        """WARM tail prefill (prefix caching, ISSUE 13): one sequence
+        whose first `plen` tokens (page-aligned, `<= npp` pages) are
+        already committed in the pools — only the tail (left-padded to
+        bucket `sb`) runs through the model.  The cached prefix is
+        gathered into a dense buffer at [0, plen) and the forward runs
+        under `generation.warm_prefill_guard`, so every tail query
+        attends prefix + causal tail; `cache_pos` starts at the shared
+        length and the compiled shape depends only on (sb, npp) — npp
+        is bucketed to a power of two by the caller, which is what the
+        committed PT402 budget on `gpt_cached_prefill_step` pins.
+
+        Exact tier (and the draft model): the prefix is gathered from
+        the pools in-program — pools store full precision, so the
+        gather IS the exact prefix.  int8-KV tier: the program instead
+        takes per-layer EXACT prefix buffers (the radix index's commit
+        -time sidecar) — a warm first token must attend the prefix at
+        the same precision a cold prefill would, or warm and cold
+        streams diverge beyond reduction-order noise."""
+        quant = which == "target" and self.config.kv_precision == "int8"
+        key = ("cprefill", sb, npp, which, quant)
+        hit = self._programs.get(key)
+        if hit is not None:
+            return hit
+        from ...models import generation as GEN
+
+        run, layers, hkv, d, dtype = self._which(which)
+        ps = self.config.page_size
+        pcap = npp * ps
+
+        def finish(logits, new):
+            tok = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return tok, [c[0] for c in new], [c[1] for c in new]
+
+        if quant:
+            @jax.jit
+            def cprefill_q(params, buffers, ids, start, plen,
+                           prefix_k, prefix_v):
+                def dense(buf):        # [npp, hkv, ps, d] exact sidecar
+                    g = jnp.swapaxes(buf, 0, 1).reshape(hkv, pcap,
+                                                        d)[None]
+                    return jnp.concatenate(
+                        [g.astype(dtype),
+                         jnp.zeros((1, hkv, sb + ps, d), dtype)],
+                        axis=2)
+
+                caches = [(dense(prefix_k[li]), dense(prefix_v[li]))
+                          for li in range(layers)]
+                with GEN.warm_prefill_guard(plen):
+                    logits, new = run(params, buffers, ids, caches,
+                                      plen, start)
+                return finish(logits, new)
+
+            self._programs[key] = cprefill_q
+            return cprefill_q
+
+        @jax.jit
+        def cprefill(params, buffers, ids, start, pages, plen,
+                     k_pools, v_pools):
+            def dense(pool):
+                g = pool[pages]                    # [npp, hkv, ps, d]
+                g = jnp.swapaxes(g, 0, 1).reshape(hkv, pcap, d)[None]
+                return jnp.concatenate(
+                    [g.astype(dtype),
+                     jnp.zeros((1, hkv, sb + ps, d), dtype)], axis=2)
+
+            caches = [(dense(k_pools[li]), dense(v_pools[li]))
+                      for li in range(layers)]
+            with GEN.warm_prefill_guard(plen):
+                logits, new = run(params, buffers, ids, caches, plen,
+                                  start)
+            return finish(logits, new)
+
+        self._programs[key] = cprefill
+        return cprefill
+
     def _decode_program(self, n: int):
         """`n` ragged decode steps at the fixed [max_slots] batch inside
         one compiled scan.  Pools donated: each step writes one page
@@ -820,52 +941,217 @@ class InferenceEngine:
     def _prefill(self, seq: Sequence) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
         prompt = seq.resume_prompt()
         s0 = prompt.size
+        shared = int(seq.shared_len or 0)
+        with _trace.span("engine.prefill", cat="engine",
+                         request=seq.request_id, tokens=s0,
+                         shared=shared, pages=len(seq.pages)):
+            if shared > 0:
+                t0, kbufs, vbufs, start = self._warm_prefill(
+                    seq, prompt, shared)
+            else:
+                t0, kbufs, vbufs, start = self._cold_prefill(
+                    seq, prompt)
+            self._commit_prefix(seq, kbufs, vbufs, start)
+            seq.length = s0
+            seq.last_token = t0
+        if self._prefix is not None:
+            if seq.cache_state in ("hit", "partial"):
+                self._prefix_hits += 1
+                _metrics.inc("engine.prefix_cache", event="hit")
+            else:
+                self._prefix_misses += 1
+                _metrics.inc("engine.prefix_cache", event="miss")
+            self._prefix_tokens_saved += shared
+            self._prefix_tokens_total += s0
+        _metrics.inc("engine.sequences", event="admitted")
+        self._accept(seq, t0)
+
+    def _cold_prefill(self, seq, prompt):  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        """Dense prefill from token 0 (no cached prefix): the PR 8
+        path.  Returns (first_token, k_bufs, v_bufs, pad_start) — the
+        dense buffers feed `_commit_prefix` (prompt token t sits at
+        buffer offset pad_start + t)."""
+        s0 = prompt.size
         sb = self._bucket(s0)
         start = sb - s0
         quant = self.config.kv_precision == "int8"
-        with _trace.span("engine.prefill", cat="engine",
-                         request=seq.request_id, tokens=s0, bucket=sb,
-                         pages=len(seq.pages)):
-            ids = np.zeros((1, sb), np.int32)
-            ids[0, start:] = prompt
-            prefill = self._prefill_program(sb)
-            tok, kbufs, vbufs = prefill(
-                self._params, self._buffers, jnp.asarray(ids),
-                jnp.asarray([start], jnp.int32))
-            ps = self.config.page_size
-            npb = -(-sb // ps)
-            pages = np.zeros((npb,), np.int32)
-            n_real = min(len(seq.pages), npb)
-            pages[:n_real] = seq.pages[:n_real]
-            pages_j = jnp.asarray(pages)
-            start_j = jnp.asarray(start, jnp.int32)
-            pack = self._pack_program(sb)
-            if quant:
-                (self._k_pools, self._v_pools, self._k_scales,
-                 self._v_scales) = pack(
-                    self._k_pools, self._v_pools, self._k_scales,
-                    self._v_scales, kbufs, vbufs, pages_j, start_j)
-            else:
-                self._k_pools, self._v_pools = pack(
-                    self._k_pools, self._v_pools, kbufs, vbufs,
-                    pages_j, start_j)
-            if self._draft is not None:
-                # the draft re-prefills the same bucket into its own
-                # pools (same page ids) so proposals continue from the
-                # full prompt context
-                dprefill = self._prefill_program(sb, "draft")
-                _, dkb, dvb = dprefill(
-                    self._draft["params"], self._draft["buffers"],
-                    jnp.asarray(ids), jnp.asarray([start], jnp.int32))
-                dpack = self._pack_program(sb, "draft")
-                self._draft["k_pools"], self._draft["v_pools"] = dpack(
-                    self._draft["k_pools"], self._draft["v_pools"],
-                    dkb, dvb, pages_j, start_j)
-            seq.length = s0
-            t0 = int(np.asarray(jax.device_get(tok))[0])
-            seq.last_token = t0
-        _metrics.inc("engine.sequences", event="admitted")
-        self._accept(seq, t0)
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, start:] = prompt
+        prefill = self._prefill_program(sb)
+        tok, kbufs, vbufs = prefill(
+            self._params, self._buffers, jnp.asarray(ids),
+            jnp.asarray([start], jnp.int32))
+        ps = self.config.page_size
+        npb = -(-sb // ps)
+        pages = np.zeros((npb,), np.int32)
+        n_real = min(len(seq.pages), npb)
+        pages[:n_real] = seq.pages[:n_real]
+        pages_j = jnp.asarray(pages)
+        start_j = jnp.asarray(start, jnp.int32)
+        pack = self._pack_program(sb)
+        if quant:
+            (self._k_pools, self._v_pools, self._k_scales,
+             self._v_scales) = pack(
+                self._k_pools, self._v_pools, self._k_scales,
+                self._v_scales, kbufs, vbufs, pages_j, start_j)
+        else:
+            self._k_pools, self._v_pools = pack(
+                self._k_pools, self._v_pools, kbufs, vbufs,
+                pages_j, start_j)
+        if self._draft is not None:
+            # the draft re-prefills the same bucket into its own
+            # pools (same page ids) so proposals continue from the
+            # full prompt context
+            dprefill = self._prefill_program(sb, "draft")
+            _, dkb, dvb = dprefill(
+                self._draft["params"], self._draft["buffers"],
+                jnp.asarray(ids), jnp.asarray([start], jnp.int32))
+            dpack = self._pack_program(sb, "draft")
+            self._draft["k_pools"], self._draft["v_pools"] = dpack(
+                self._draft["k_pools"], self._draft["v_pools"],
+                dkb, dvb, pages_j, start_j)
+        return int(np.asarray(jax.device_get(tok))[0]), kbufs, vbufs, \
+            start
+
+    @staticmethod
+    def _prefix_bucket(n_pages: int) -> int:
+        """Prefix page capacity bucket: next power of two.  Cached
+        prefix lengths vary per hit; bucketing bounds the compiled
+        (sb, npp) shape set — the PT402 recompile-hazard budget on
+        `gpt_cached_prefill_step` exists to catch a per-length shape
+        leak here."""
+        npp = 1
+        while npp < n_pages:
+            npp *= 2
+        return npp
+
+    def _warm_prefill(self, seq, prompt, shared):  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        """Prefill ONLY the tail [shared, s0): the cached prefix pages
+        are already in the sequence's table (refcounted shares), so the
+        model processes s0 - shared tokens instead of s0 — the TTFT win
+        the bench gates.  The tail's K/V packs into the sequence's
+        PRIVATE tail pages (the boundary page is never shared: the
+        scheduler caps sharing at the last full page before s0), so no
+        shared page is ever written."""
+        cfg = self.config
+        ps = cfg.page_size
+        tail = prompt[shared:]
+        sb = self._bucket(tail.size)
+        start = sb - tail.size
+        npa = shared // ps
+        npp = self._prefix_bucket(npa)
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, start:] = tail
+        ids_j = jnp.asarray(ids)
+        start_j = jnp.asarray([start], jnp.int32)
+        plen = jnp.asarray(shared, jnp.int32)
+        quant = cfg.kv_precision == "int8"
+        pages = np.zeros((npp,), np.int32)
+        pages[:npa] = seq.pages[:npa]
+        pages_j = jnp.asarray(pages)
+        cpre = self._cached_prefill_program(sb, npp)
+        if quant:
+            ek, ev = self._sidecar_prefix(seq, npa, npp)
+            tok, kbufs, vbufs = cpre(self._params, self._buffers,
+                                     ids_j, start_j, plen, ek, ev)
+        else:
+            tok, kbufs, vbufs = cpre(self._params, self._buffers,
+                                     ids_j, start_j, pages_j, plen,
+                                     self._k_pools, self._v_pools)
+        # pack the tail into the PRIVATE tail pages; in the returned
+        # buffers prompt token t sits at offset start + t (the write
+        # landed at [shared, shared+sb), tail token j at shared+start+j)
+        npb = -(-sb // ps)
+        tpages = np.zeros((npb,), np.int32)
+        n_tail = max(0, min(len(seq.pages) - npa, npb))
+        tpages[:n_tail] = seq.pages[npa:npa + n_tail]
+        tpages_j = jnp.asarray(tpages)
+        pk_start = jnp.asarray(shared + start, jnp.int32)
+        pack = self._pack_program(sb)
+        if quant:
+            (self._k_pools, self._v_pools, self._k_scales,
+             self._v_scales) = pack(
+                self._k_pools, self._v_pools, self._k_scales,
+                self._v_scales, kbufs, vbufs, tpages_j, pk_start)
+        else:
+            self._k_pools, self._v_pools = pack(
+                self._k_pools, self._v_pools, kbufs, vbufs,
+                tpages_j, pk_start)
+        if self._draft is not None:
+            # warm-prefill the draft's tail over ITS pools (exact
+            # precision, same page ids): the cached prefix pages hold
+            # the donor's draft K/V — a pure function of the prefix
+            # tokens, so they are this prompt's draft prefix too
+            dcpre = self._cached_prefill_program(sb, npp, "draft")
+            _, dkb, dvb = dcpre(
+                self._draft["params"], self._draft["buffers"], ids_j,
+                start_j, pages_j, plen, self._draft["k_pools"],
+                self._draft["v_pools"])
+            dpack = self._pack_program(sb, "draft")
+            self._draft["k_pools"], self._draft["v_pools"] = dpack(
+                self._draft["k_pools"], self._draft["v_pools"],
+                dkb, dvb, tpages_j, pk_start)
+        # commit offset contract (_commit_prefix): prompt token t sits
+        # at buffer offset start + t — the fresh span landed at
+        # [shared, shared+sb), so tail token j (= prompt token
+        # shared+j) is at shared + start + j = start + (shared+j).
+        # Returning shared+start here would shift every sidecar slice
+        # one whole prefix past the real tokens.
+        return int(np.asarray(jax.device_get(tok))[0]), kbufs, vbufs, \
+            start
+
+    def _sidecar_prefix(self, seq, npa, npp):  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        """int8-KV tier: stack the matched radix nodes' commit-time
+        EXACT page copies into the warm program's per-layer prefix
+        buffers ([npp, hkv, ps, d], zero-padded past npa)."""
+        zero = jnp.zeros((self._hkv, self.config.page_size, self._hd),
+                         self._dtype)
+        ek, ev = [], []
+        for li in range(self._layers):
+            ks, vs = [], []
+            for i in range(npa):
+                ex = seq.shared_nodes[i].exact
+                if ex is None:
+                    raise RuntimeError(
+                        "prefix-cache node without an exact sidecar "
+                        "under kv_precision=int8 (commit-path bug)")
+                ks.append(ex[li][0])
+                vs.append(ex[li][1])
+            pad = [zero] * (npp - npa)
+            ek.append(jnp.stack(ks + pad))
+            ev.append(jnp.stack(vs + pad))
+        return ek, ev
+
+    def _commit_prefix(self, seq, kbufs, vbufs, start):  # pt-lint: ok[PT101,PT102] (step holds _lock)
+        """Register the ORIGINAL prompt's full pages in the radix index
+        (the partial tail page stays private — it is still written by
+        decode).  `start` is the buffer offset of prompt token 0 in the
+        just-returned dense buffers: in BOTH the cold and warm cases
+        prompt token t sits at `start + t`, which is where the int8
+        sidecar's exact page copies are sliced from."""
+        if self._prefix is None:
+            return
+        ps = self.config.page_size
+        n_full = min(int(seq.prompt.size) // ps, len(seq.pages))
+        if n_full <= 0:
+            return
+        exact = None
+        if self.config.kv_precision == "int8":
+            shared_chunks = int(seq.shared_len or 0) // ps
+            exact = []
+            for i in range(n_full):
+                if i < shared_chunks:
+                    # node already exists (matched at admission);
+                    # insert never reads this slot
+                    exact.append(None)
+                    continue
+                lo = start + i * ps
+                exact.append([
+                    (kbufs[li][0, :, lo:lo + ps, :],
+                     vbufs[li][0, :, lo:lo + ps, :])
+                    for li in range(self._layers)])
+        self._prefix.insert(seq.prompt[:n_full * ps],
+                            seq.pages[:n_full], exact=exact)
 
     def _batch_arrays(self, running):  # pt-lint: ok[PT101,PT102] (step holds _lock)
         s_, p_ = self.config.max_slots, self.max_pages_per_seq
@@ -987,13 +1273,20 @@ class InferenceEngine:
         with self._lock:
             self._handles.pop(seq.request_id, None)
 
-    def _publish_gauges(self) -> None:
+    def _publish_gauges(self) -> None:  # pt-lint: ok[PT102] (_prefix set once at construction, never rebound)
         st = self.scheduler.stats()
         _metrics.set_gauge("engine.active_sequences", st["running"])
         _metrics.set_gauge("engine.waiting_sequences", st["waiting"])
         _metrics.set_gauge("engine.batch_occupancy", st["occupancy"])
         _metrics.set_gauge("engine.page_utilization",
                            self.pool.utilization())
+        if self._prefix is not None:
+            total = self._prefix_hits + self._prefix_misses
+            _metrics.set_gauge("engine.prefix_cached_tokens",
+                               self._prefix.cached_tokens)
+            _metrics.set_gauge("engine.prefix_cache_hit_rate",
+                               (self._prefix_hits / total) if total
+                               else 0.0)
 
     # --- maintenance --------------------------------------------------------
     def defrag(self) -> int:
@@ -1024,7 +1317,40 @@ class InferenceEngine:
                                     for p in d["v_pools"]]
             for seq in self.scheduler.running_seqs():
                 seq.pages = [moves.get(p, p) for p in seq.pages]
+            if self._prefix is not None:
+                self._prefix.apply_moves(moves)
         return len(moves)
+
+    def clear_prefix_cache(self) -> int:
+        """Drop every prefix-cache reference (pages shared with live
+        sequences stay live under the sequences' own refs).  Returns
+        the number of cache pages released — after a full drain plus a
+        clear, `pool.used_pages` must be exactly 0 (the chaos leak
+        assertion)."""
+        with self._lock:
+            if self._prefix is None:
+                return 0
+            return self._prefix.clear()
+
+    def prefix_cache_stats(self) -> dict:  # pt-lint: ok[PT102] (_prefix set once at construction; counters are monotonic snapshots)
+        """Hit/miss/saved-token ledger + radix index size — rides
+        `engine.stats()` into /ready and /debug/telemetry."""
+        hits, misses = self._prefix_hits, self._prefix_misses
+        total = hits + misses
+        st = {
+            "enabled": self._prefix is not None,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": (hits / total) if total else 0.0,
+            "prefill_tokens_saved": self._prefix_tokens_saved,
+            "prefill_tokens_total": self._prefix_tokens_total,
+            "tokens_saved_frac":
+                (self._prefix_tokens_saved
+                 / max(1, self._prefix_tokens_total)),
+        }
+        if self._prefix is not None:
+            st.update(self._prefix.stats())
+        return st
 
     # --- loop / lifecycle ---------------------------------------------------
     def start(self):
@@ -1092,6 +1418,7 @@ class InferenceEngine:
         st["kv_precision"] = cfg.kv_precision or "full"
         st["spec_tokens"] = cfg.spec_tokens if self._draft else 0
         st["page_bytes"] = self._page_bytes()
+        st["prefix_cache"] = self.prefix_cache_stats()
         # monotonic int snapshot for telemetry; a stale read is a fine
         # answer to "how many steps so far"
         st["steps"] = self.steps  # pt-lint: ok[PT102]

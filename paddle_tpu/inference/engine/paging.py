@@ -12,6 +12,21 @@ Page 0 is RESERVED as the scratch page: free batch slots point their
 whole page-table row at it, masked/dead writes land in it, and it is
 never allocated to a sequence — so a stale table entry can corrupt at
 worst the page nobody reads.
+
+Prefix caching (ISSUE 13): pages carry REFCOUNTS.  `alloc()` grants a
+page at refcount 1; `share()` lets a second holder (another sequence,
+or the scheduler's radix prefix index) take a reference to the same
+physical page, and `free()` only returns a page to the free list when
+its last reference drops.  Full committed-prefix pages are immutable
+by contract — the engine never writes a page whose content is shared
+(copy-on-write happens at the boundary: the partial tail page is
+always a private fresh allocation) — so two page tables pointing at
+one physical page is safe for the kernel by construction.  The int8
+KV tier's scale tables ride next to the pools indexed by the same page
+ids, so sharing a page shares its scale rows under the same refcount.
+`stats()` reports the physical/logical split (`shared_pages`,
+`logical_pages`) so `engine.page_utilization` counts a shared page
+ONCE — capacity scales with unique tokens, and the telemetry says so.
 """
 from __future__ import annotations
 
@@ -50,6 +65,7 @@ class PagePool:
         # the bottom, which keeps the untouched tail contiguous
         self._free = list(range(self.num_pages - 1, 0, -1))
         self._live = set()
+        self._refs = {}            # page -> refcount (live pages only)
         self._peak = 0
 
     # --- allocation ---------------------------------------------------------
@@ -84,14 +100,36 @@ class PagePool:
                     f"{self.capacity}")
             pages = [self._free.pop() for _ in range(n)]
             self._live.update(pages)
+            for p in pages:
+                self._refs[p] = 1
             self._peak = max(self._peak, len(self._live))
         return pages
 
+    def share(self, pages) -> list:
+        """Take one MORE reference on each live page (prefix-cache page
+        sharing): the page now has two holders, and `free()` from either
+        leaves it live for the other.  Sharing a dead or scratch page is
+        loud — handing out a reference to a page the free list could
+        re-grant would alias two sequences onto one page.  Returns the
+        pages (int-normalized) for chaining into a page-table list."""
+        out = []
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                if p == SCRATCH_PAGE:
+                    raise ValueError("cannot share the scratch page")
+                if p not in self._live:
+                    raise ValueError(f"share of dead page {p}")
+                self._refs[p] += 1
+                out.append(p)
+        return out
+
     def free(self, pages) -> None:
-        """Return pages to the pool.  Double-frees and scratch-page
-        frees are errors — both mean the caller's bookkeeping is
-        corrupt, and silently absorbing them would hand one page to two
-        sequences later."""
+        """Drop one reference per page; a page returns to the pool when
+        its LAST reference drops.  Over-frees (a page freed more times
+        than it was alloc'd+shared) and scratch-page frees are errors —
+        both mean the caller's bookkeeping is corrupt, and silently
+        absorbing them would hand one page to two sequences later."""
         with self._lock:
             for p in pages:
                 p = int(p)
@@ -99,8 +137,22 @@ class PagePool:
                     raise ValueError("cannot free the scratch page")
                 if p not in self._live:
                     raise ValueError(f"double free of page {p}")
-                self._live.discard(p)
-                self._free.append(p)
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._live.discard(p)
+                    self._free.append(p)
+
+    def refcount(self, page) -> int:
+        """Live reference count for one page (0 when free/dead)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    def ref_counts(self) -> dict:
+        """Snapshot of every live page's refcount — the chaos leak
+        assertion ("zero refcount leak") diffs this against empty."""
+        with self._lock:
+            return dict(self._refs)
 
     # --- defrag -------------------------------------------------------------
     def defrag(self) -> dict:
@@ -124,6 +176,11 @@ class PagePool:
                 n = len(live)
                 self._live = set(range(1, n + 1))
                 self._free = list(range(self.num_pages - 1, n, -1))
+                # refcounts travel with the page: a SHARED page moves
+                # exactly once (one physical copy), and every holder's
+                # table is rewritten to the same destination
+                self._refs = {moves.get(p, p): r
+                              for p, r in self._refs.items()}
         return moves
 
     # --- telemetry ----------------------------------------------------------
@@ -133,12 +190,21 @@ class PagePool:
 
     def stats(self) -> dict:
         with self._lock:
+            shared = sum(1 for r in self._refs.values() if r > 1)
+            logical = sum(self._refs.values())
             return {
                 "page_size": self.page_size,
                 "num_pages": self.num_pages,
                 "capacity": self.capacity,
+                # `used` counts each physical page ONCE regardless of
+                # how many holders reference it (the ISSUE 13 satellite
+                # fix: sharing must not inflate utilization/peak); the
+                # shared/logical split makes the dedup visible — saved
+                # pages = logical_pages - used
                 "used": len(self._live),
                 "free": len(self._free),
+                "shared_pages": shared,
+                "logical_pages": logical,
                 "peak_used": self._peak,
                 "utilization": len(self._live) / max(1, self.capacity),
             }

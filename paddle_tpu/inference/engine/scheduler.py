@@ -19,6 +19,15 @@ sequence occupies which slot:
     exactly (recompute-style preemption — deterministic, no KV
     snapshot).  Evicting the youngest keeps the oldest request's
     latency bound tight.
+  * **prefix sharing** (ISSUE 13) — with a `PrefixIndex` attached,
+    admission looks up the longest cached page-aligned prefix of the
+    prompt, takes pool references on the matched pages
+    (`PagePool.share`), and allocates private pages only for the tail
+    — the engine then prefills only `[shared_len, s0)`.  Under page
+    pressure an LRU tier of refcount-IDLE cached prefixes is reclaimed
+    FIRST (`PrefixIndex.evict_idle`), sitting between FIFO admission
+    and youngest-first recompute eviction: cold cache always dies
+    before live work.
 
 The clock is injectable and ordering is decided by admission sequence
 numbers, never wall time — the unit tests drive the whole policy
@@ -64,6 +73,9 @@ class Sequence:
         self.tokens = []           # accepted generated tokens
         self.pages = []            # live page ids (engine's pools)
         self.length = 0            # tokens materialized in the cache
+        self.shared_len = 0        # cached-prefix tokens (page-aligned)
+        self.shared_nodes = []     # matched PrefixIndex nodes (opaque)
+        self.cache_state = None    # hit | partial | miss (at admission)
         self.slot = None
         self.last_token = None     # next decode step's input token
         self.admit_seqno = None    # ordering: eviction picks the max
@@ -112,13 +124,15 @@ class SchedulerOutput:
 
 class Scheduler:
     def __init__(self, max_slots: int, pool: PagePool,
-                 max_pages_per_seq: int, clock=time.monotonic):
+                 max_pages_per_seq: int, clock=time.monotonic,
+                 prefix_index=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = int(max_slots)
         self.pool = pool
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.clock = clock
+        self.prefix_index = prefix_index  # optional PrefixIndex
         self._lock = threading.RLock()
         self._waiting = deque()
         self._running = {}         # slot -> Sequence
@@ -230,6 +244,11 @@ class Scheduler:
                         seq.pages.extend(self.pool.alloc(need))
                         break
                     except OutOfPages:
+                        # LRU tier first: reclaim refcount-idle cached
+                        # prefixes before touching any live sequence
+                        if self.prefix_index is not None and \
+                                self.prefix_index.evict_idle(need) > 0:
+                            continue
                         # youngest-first preemption INCLUDING the
                         # growing sequence itself: when it is the
                         # youngest, it self-preempts rather than
@@ -247,12 +266,25 @@ class Scheduler:
             while self._waiting and len(self._running) < self.max_slots:
                 seq = self._waiting[0]
                 prompt = seq.resume_prompt()
+                shared_pages = self._lookup_prefix_locked(seq, prompt)
                 need = self._target_pages(
-                    seq, prompt.size + max(1, int(chunk)))
-                if not self.pool.can_alloc(need):
-                    break  # strict FIFO: nothing skips the queue head
+                    seq, prompt.size + max(1, int(chunk))) \
+                    - len(shared_pages)
+                if not self.pool.can_alloc(need) and (
+                        self.prefix_index is None
+                        or self.prefix_index.evict_idle(
+                            need - self.pool.free_pages) == 0
+                        or not self.pool.can_alloc(need)):
+                    # release the just-pinned prefix refs before
+                    # refusing — strict FIFO: nothing skips the head
+                    if shared_pages:
+                        self.pool.free(shared_pages)
+                        seq.shared_len = 0
+                        seq.shared_nodes = []
+                        seq.cache_state = None
+                    break
                 self._waiting.popleft()
-                seq.pages = self.pool.alloc(need)
+                seq.pages = shared_pages + self.pool.alloc(need)
                 seq.slot = self._free_slot_locked()
                 seq.state = RUNNING
                 seq.admit_seqno = next(self._seqno)
@@ -261,6 +293,34 @@ class Scheduler:
 
             running = [self._running[s] for s in sorted(self._running)]
             return SchedulerOutput(prefills, running, evicted, finished)
+
+    def _lookup_prefix_locked(self, seq, prompt):  # pt-lint: ok[PT101,PT102] (schedule holds _lock)
+        """Cached-prefix lookup for one admission candidate: pins the
+        matched pages with `PagePool.share` IMMEDIATELY (so a following
+        `evict_idle` pressure reclaim can never free what this admission
+        is about to use) and records the share on the sequence.  The
+        share cap leaves at least one prompt token for the tail — the
+        prefill must still produce the first generated token."""
+        seq.shared_len = 0
+        seq.shared_nodes = []
+        seq.cache_state = None
+        if self.prefix_index is None:
+            return []
+        max_share = min((int(prompt.size) - 1) // self.pool.page_size,
+                        self.max_pages_per_seq)
+        if max_share <= 0:
+            seq.cache_state = "miss"
+            return []
+        shared_tokens, pages, nodes = self.prefix_index.lookup(
+            prompt, max_share)
+        if not pages:
+            seq.cache_state = "miss"
+            return []
+        pages = self.pool.share(pages)
+        seq.shared_len = int(shared_tokens)
+        seq.shared_nodes = nodes
+        seq.cache_state = "hit" if len(pages) == max_share else "partial"
+        return pages
 
     def _free_slot_locked(self):  # pt-lint: ok[PT102] (callers hold _lock)
         for s in range(self.max_slots):
@@ -277,11 +337,14 @@ class Scheduler:
         return victim
 
     def _evict_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
-        self.pool.free(seq.pages)
-        seq.pages = []
+        self.pool.free(seq.pages)   # shared refs decrement; cache keeps
+        seq.pages = []              # its own — re-admission re-shares
         self._running.pop(seq.slot, None)
         seq.slot = None
         seq.length = 0
+        seq.shared_len = 0
+        seq.shared_nodes = []
+        seq.cache_state = None
         seq.last_token = None
         seq.state = WAITING
         seq.evictions += 1

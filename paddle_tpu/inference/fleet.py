@@ -105,6 +105,7 @@ class _ToyHandle:
         self.done = threading.Event()
         self.finish_reason = None
         self.cancelled = False
+        self.cache_state = "miss"   # no prefix cache in the toy engine
         self.tokens = []
         self._prompt = []
         self._q = queue.Queue()

@@ -40,9 +40,24 @@ Telemetry: `router.replicas{state=up|draining|ejected|down}` gauges,
 `router.request`/`router.forward` spans carrying request identity.
 Fault point `router.forward` fires per forward attempt (chaos).
 
+Prefix-affinity routing (ISSUE 13, docs/SERVING.md): /generate
+requests may carry an `X-Prefix-Fingerprint` header (the client's
+cheap hash of the first N page-aligned prompt tokens; the router
+computes its own from the parsed prompt when absent).  A bounded LRU
+fingerprint->replica map remembers where each prefix last landed, and
+the pick PREFERS the affine replica when its load is within
+`affinity_slack` of the least-loaded candidate — repeat tenants land
+where their prefix cache lives, without ever overriding drain/eject
+state (affine picks are drawn from the routable set only) and without
+letting affinity pile load on one replica (the slack bound).  The
+fingerprint is routing metadata ONLY — the engine's radix index
+matches real token values, so a poisoned header degrades to a cache
+miss, never a wrong-token stream.
+
 Env knobs (read when the matching ctor arg is None):
   PADDLE_TPU_HEARTBEAT_MISS_K   probes/beats missed before ejection (3)
   PADDLE_TPU_FAILOVER_RETRIES   extra replicas tried per request    (2)
+  PADDLE_TPU_ROUTER_AFFINITY_SLACK  affine-pick load slack       (0.25)
 
 Transport and clock are injectable — unit tests drive the whole state
 machine with fake replicas and no sockets (tests/test_router.py).
@@ -54,6 +69,7 @@ import json
 import threading
 import time
 import urllib.parse
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability import metrics as _metrics
@@ -191,20 +207,31 @@ class Router:
     loop), `shutdown()` drains the edge controller and closes the
     socket — replica lifecycle belongs to `ReplicaFleet`, not here."""
 
+    # bounded fingerprint->replica map: enough for a large tenant
+    # population, small enough that a hostile client cannot balloon
+    # router memory by spraying fingerprints
+    AFFINITY_CAP = 4096
+
     def __init__(self, host="127.0.0.1", port=0, replicas=None,
                  heartbeat_miss_k=None, failover_retries=None,
                  probe_interval=0.25, request_timeout=30.0,
                  max_inflight=None, queue_depth=None, transport=None,
                  heartbeats=None, clock=time.monotonic,
-                 breaker_threshold=3, breaker_reset=2.0):
+                 breaker_threshold=3, breaker_reset=2.0,
+                 affinity_slack=None):
         if heartbeat_miss_k is None:
             heartbeat_miss_k = _env_num("PADDLE_TPU_HEARTBEAT_MISS_K",
                                         3, int)
         if failover_retries is None:
             failover_retries = _env_num("PADDLE_TPU_FAILOVER_RETRIES",
                                         2, int)
+        if affinity_slack is None:
+            affinity_slack = _env_num(
+                "PADDLE_TPU_ROUTER_AFFINITY_SLACK", 0.25, float)
         self.heartbeat_miss_k = max(1, int(heartbeat_miss_k))
         self.failover_retries = max(0, int(failover_retries))
+        self.affinity_slack = max(0.0, float(affinity_slack))
+        self._affinity = OrderedDict()  # fingerprint -> rid (LRU)
         self.probe_interval = float(probe_interval)
         self.request_timeout = (None if request_timeout is None
                                 else float(request_timeout))
@@ -377,6 +404,19 @@ class Router:
                                   .get("input_ids", [])]
                     except Exception:
                         prompt = []  # replica will 400 it; no prefix
+                    # prefix-affinity fingerprint: the client's header
+                    # wins; otherwise derive one from the parsed prompt
+                    # so plain clients still get affinity.  Either way
+                    # it is ONLY a routing hint — the engine matches
+                    # real tokens, so a poisoned header cannot change
+                    # the stream, only the replica it lands on.
+                    fingerprint = self.headers.get(
+                        "X-Prefix-Fingerprint")
+                    if fingerprint is None and prompt:
+                        from .serving import InferenceClient
+
+                        fingerprint = InferenceClient.prefix_fingerprint(
+                            prompt)
                     deadline = router._deadline()
                     try:
                         ticket = router.gen_admission.admit(
@@ -390,7 +430,8 @@ class Router:
                                       _retry_after_header(e.retry_after))])
                     try:
                         status = router.forward_generate(
-                            body, prompt, ctx, self)
+                            body, prompt, ctx, self,
+                            fingerprint=fingerprint)
                     except Exception as e:
                         # best effort: before any stream bytes this is
                         # a clean 500; afterwards the socket just
@@ -657,11 +698,21 @@ class Router:
     # ------------------------------------------------------------------
     # pick + forward
     # ------------------------------------------------------------------
-    def _pick(self, endpoint, exclude=()):
+    def _pick(self, endpoint, exclude=(), fingerprint=None):
         """Least-loaded routable replica for `endpoint`, or None.
         Load = the replica's own admission view (stale by at most one
-        probe) plus the router's live in-flight count toward it."""
-        best, best_score = None, None
+        probe) plus the router's live in-flight count toward it.
+
+        With a `fingerprint` (ISSUE 13): prefer the replica this
+        prefix last landed on — but ONLY while its load stays within
+        `affinity_slack` of the least-loaded candidate (affinity must
+        never become a hot spot), and only when it is currently
+        routable (never a drained/ejected/breaker-open replica: those
+        never enter the candidate set).  Every pick refreshes the
+        bounded LRU fingerprint map, so the affinity self-corrects as
+        the fleet changes."""
+        loads = {}
+        outcome = None
         with self._lock:
             for rid in self._routable_locked():
                 if rid in exclude:
@@ -680,9 +731,25 @@ class Router:
                     load = (float(sig.get("inflight") or 0)
                             + float(sig.get("queued") or 0)
                             + rep.inflight["predict"]) / limit
-                if best_score is None or load < best_score:
-                    best, best_score = rid, load
-        return best
+                loads[rid] = load
+            if not loads:
+                return None
+            pick = min(loads, key=lambda r: (loads[r], r))
+            if fingerprint is not None:
+                affine = self._affinity.get(fingerprint)
+                if affine in loads and loads[affine] <= \
+                        loads[pick] + self.affinity_slack:
+                    pick = affine
+                    outcome = "affine"
+                else:
+                    outcome = "least_loaded"
+                self._affinity[fingerprint] = pick
+                self._affinity.move_to_end(fingerprint)
+                while len(self._affinity) > self.AFFINITY_CAP:
+                    self._affinity.popitem(last=False)
+        if outcome is not None:
+            _metrics.inc("router.affinity", outcome=outcome)
+        return pick
 
     def _begin_forward(self, rid, endpoint):
         with self._lock:
@@ -802,7 +869,8 @@ class Router:
         if breaker is not None:
             breaker.record_success()
 
-    def forward_generate(self, body, prompt_ids, ctx, handler):
+    def forward_generate(self, body, prompt_ids, ctx, handler,
+                         fingerprint=None):
         """Proxy one /generate stream to the client behind `handler`.
 
         Failover contract (ISSUE 9 (b)): attempts rotate replicas
@@ -811,19 +879,24 @@ class Router:
         failure turns into a single clean `interrupted` record carrying
         `output_ids` = prompt + delivered tokens (the resumable
         prefix) — the stream NEVER replays a token.  Returns the
-        request's status label."""
+        request's status label.  `fingerprint` biases the pick toward
+        the prefix-affine replica (see `_pick`); the header rides
+        through to the replica untouched."""
         from ..resilience import faults as _faults
 
         hop = ctx.child()
         headers = {"Content-Type": "application/json"}
         headers.update(hop.to_headers())
+        if fingerprint is not None:
+            headers["X-Prefix-Fingerprint"] = str(fingerprint)
         tried: set = set()
         last_shed = None
         started = False          # client response headers sent?
         delivered: list = []     # token values already written out
         attempts = self.failover_retries + 1
         for attempt in range(attempts):
-            rid = self._pick("generate", exclude=tried)
+            rid = self._pick("generate", exclude=tried,
+                             fingerprint=fingerprint)
             if rid is None:
                 break
             tried.add(rid)

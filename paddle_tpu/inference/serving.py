@@ -190,6 +190,16 @@ class InferenceServer:
                     "PADDLE_TPU_SLO_GENERATE_LATENCY_MS", 30000.0, float),
                 availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
                                       0.999, float))
+            # time-to-first-token is its own SLO phase (ISSUE 13): at a
+            # shared-prefix workload TTFT — not completion time — is
+            # what the prefix cache buys, so it gets its own target and
+            # burn accounting next to the stream-completion objective
+            self.slo.objective(
+                "ttft",
+                latency_target_ms=_env_num(
+                    "PADDLE_TPU_SLO_TTFT_MS", 5000.0, float),
+                availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
+                                      0.999, float))
         self._drain_timeout = drain_timeout  # None → env/default in drain()
         self._ready_window = max(1, int(ready_window))
         self._recent = []          # last ready_window predictor outcomes
@@ -264,6 +274,22 @@ class InferenceServer:
                                 st.get("weight_precision"),
                             "kv_precision": st.get("kv_precision"),
                             "spec_tokens": st.get("spec_tokens"),
+                        }
+                        # prefix-cache view (ISSUE 13): hit rate and
+                        # cached tokens first-class in readiness, plus
+                        # the physical/logical page split so a router
+                        # or operator sees sharing without /metrics
+                        # text parsing
+                        pc = st.get("prefix_cache") or {}
+                        pages = st.get("pages") or {}
+                        body["engine"]["prefix_cache"] = {
+                            "enabled": pc.get("enabled"),
+                            "hit_rate": pc.get("hit_rate"),
+                            "cached_tokens": pc.get("cached_tokens"),
+                            "tokens_saved_frac":
+                                pc.get("tokens_saved_frac"),
+                            "shared_pages": pages.get("shared_pages"),
+                            "logical_pages": pages.get("logical_pages"),
                         }
                         if server.gen_admission is not None:
                             gs = server.gen_admission.stats()
@@ -389,8 +415,31 @@ class InferenceServer:
                         self.send_header("X-Request-Id", ctx.request_id)
                         self.send_header("Connection", "close")
                         self.end_headers()
+                        first_at = None
                         for tok in handle.stream(
                                 timeout=server._request_timeout or 120.0):
+                            if first_at is None:
+                                # time-to-first-token, labeled by the
+                                # prefix-cache outcome: the histogram
+                                # that shows what a warm cache buys
+                                # (docs/OBSERVABILITY.md, ISSUE 13)
+                                first_at = time.perf_counter()
+                                ttft_ms = (first_at - t_req) * 1e3
+                                _metrics.observe(
+                                    "serving.ttft_ms", ttft_ms,
+                                    endpoint="generate",
+                                    # getattr: engine duck-types
+                                    # (ToyEngine) may predate the
+                                    # prefix cache — label them miss
+                                    cache=getattr(handle,
+                                                  "cache_state",
+                                                  "miss") or "miss")
+                                _metrics.observe(
+                                    "serving.phase_ms", ttft_ms,
+                                    phase="first_token",
+                                    endpoint="generate")
+                                server.slo.observe("ttft", ttft_ms,
+                                                   ok=True)
                             self.wfile.write(
                                 json.dumps({"token": int(tok)}).encode()
                                 + b"\n")
@@ -416,6 +465,14 @@ class InferenceServer:
                     except queue.Empty:
                         server.engine.cancel(handle.request_id)
                         status, slo_reason = "timeout", "timeout"
+                        if first_at is None:
+                            # never produced a first token: that is a
+                            # TTFT objective failure, not just a
+                            # completion failure
+                            server.slo.observe(
+                                "ttft",
+                                (time.perf_counter() - t_req) * 1e3,
+                                ok=False, reason="timeout")
                 finally:
                     if ticket is not None:
                         ticket.release(ok=status == "ok")
@@ -553,7 +610,7 @@ class InferenceServer:
         # SLO report first: it publishes the slo.* gauges the metrics
         # snapshot should carry (same ordering as the exporter)
         slo_report = self.slo.report()
-        return {
+        snap = {
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "pid": os.getpid(),
             "metrics": _metrics.snapshot(),
@@ -562,6 +619,12 @@ class InferenceServer:
             "readiness": {"ready": ready, "reason": reason},
             "flight": _flight.events()[-64:],
         }
+        if self.engine is not None:
+            # the engine's full view — including the prefix-cache
+            # ledger and the shared/logical page split (ISSUE 13
+            # satellite: page accounting stays honest under sharing)
+            snap["engine"] = self.engine.stats()
+        return snap
 
     # --- request path --------------------------------------------------------
     def predict(self, arrays: dict) -> dict:
@@ -798,12 +861,36 @@ class InferenceClient:
 
     def __init__(self, address: str, timeout: float = 120.0,
                  retries: int = 2, max_retry_wait: float = 5.0,
-                 sleep=time.sleep):
+                 sleep=time.sleep, fingerprint_tokens: int = 64):
         self.address = address.rstrip("/")
         self.timeout = float(timeout)
         self.retries = max(0, int(retries))
         self.max_retry_wait = float(max_retry_wait)
         self.sleep = sleep
+        # prefix-affinity fingerprint length (ISSUE 13): generate()
+        # sends a cheap hash of the first N page-aligned prompt tokens
+        # so a router can keep repeat tenants where their prefix cache
+        # lives.  0 disables the header.
+        self.fingerprint_tokens = max(0, int(fingerprint_tokens))
+
+    @staticmethod
+    def prefix_fingerprint(input_ids, tokens: int = 64,
+                           granule: int = 16):
+        """Hex fingerprint of the first `tokens` PAGE-ALIGNED prompt
+        ids (floored to a `granule` multiple — the default engine page
+        size — so two prompts sharing a cacheable prefix fingerprint
+        alike).  Purely a ROUTING hint: the engine's radix index
+        matches real token values, so a poisoned/mismatched
+        fingerprint can at worst cost a cache miss, never a
+        wrong-token stream.  Returns None for prompts too short to
+        share a page."""
+        import hashlib
+
+        ids = np.asarray(input_ids, np.int64).reshape(-1)
+        n = min(int(tokens), (ids.size // granule) * granule)
+        if n <= 0:
+            return None
+        return hashlib.sha1(ids[:n].tobytes()).hexdigest()[:16]
 
     def health(self) -> dict:
         import urllib.request
@@ -875,6 +962,11 @@ class InferenceClient:
         ctx = amb.child() if amb is not None else _rtrace.new_context()
         headers = {"Content-Type": "application/json"}
         headers.update(ctx.to_headers())
+        if self.fingerprint_tokens:
+            fp = self.prefix_fingerprint(body["input_ids"],
+                                         self.fingerprint_tokens)
+            if fp is not None:
+                headers["X-Prefix-Fingerprint"] = fp
         for attempt in range(self.retries + 1):
             req = urllib.request.Request(
                 self.address + "/generate", data=data, headers=headers)

@@ -18,7 +18,9 @@ asynchronously and fetches once at the end (or per step only when
 """
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 
 import numpy as np
 
@@ -31,6 +33,31 @@ from ..core.tensor import Tensor
 # decode steps per compiled lax.scan dispatch (generate's fast path): the
 # host leaves the token loop for this many steps at a time
 DECODE_CHUNK = 32
+
+# --- warm (cached-prefix) tail prefill -------------------------------------
+# Trace-time switch for prefix caching (inference/engine, ISSUE 13): a
+# multi-token dense forward normally assumes cache_pos == 0 and attends
+# only its own fresh K/V (cold prefill).  Inside `warm_prefill_guard(P)`
+# the same forward is a WARM TAIL PREFILL: the dense cache buffers
+# arrive pre-loaded with a cached prefix at [0, P) (P is a TRACED
+# page-aligned scalar), the fresh tokens write at [P, P+S), and every
+# query attends the prefix plus the causal fresh span.  A thread-local
+# rather than a model kwarg: the flag is static PER TRACE (the engine
+# enters the guard inside its jitted cached-prefill program), so no
+# model-family forward signature has to grow a parameter.
+_WARM_PREFILL = threading.local()
+
+
+@contextlib.contextmanager
+def warm_prefill_guard(prefix_len):
+    """`prefix_len`: traced int32 scalar — the number of cached prefix
+    tokens already sitting in the dense cache buffers at [0, P)."""
+    prev = getattr(_WARM_PREFILL, "value", None)
+    _WARM_PREFILL.value = prefix_len
+    try:
+        yield
+    finally:
+        _WARM_PREFILL.value = prev
 
 
 def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
@@ -94,6 +121,42 @@ def _static_cache_attention(q, k, v, kv_cache, cache_pos, attn_start=None):
                     attn_start)
         out = out.reshape([b, 1, hq, d])
     else:
+        wp = getattr(_WARM_PREFILL, "value", None)
+        if wp is not None:
+            # WARM tail prefill (prefix caching): keys/values come from
+            # the CACHE BUFFER — cached prefix at [0, P) plus the fresh
+            # tail this call just wrote at [P, P+S) — not from the
+            # fresh K/V alone.  Query row i (real iff i >= attn_start)
+            # holds absolute position P + i - start; it attends every
+            # prefix key (j < P, all real: committed pages carry no
+            # padding) and the causal fresh span (start <= j-P <= i).
+            # Keys in [P_real, buffer_cap) beyond the written span stay
+            # masked, so a bucketed prefix capacity never leaks
+            # garbage into the softmax.
+            cap = kb.shape[2]
+            kk = ops.transpose(kb, [0, 2, 1, 3])      # [B, cap, Hkv, D]
+            vv = ops.transpose(vb, [0, 2, 1, 3])
+            if hkv != hq:
+                rep = hq // hkv
+                kk = ops.repeat_interleave(kk, rep, axis=2)
+                vv = ops.repeat_interleave(vv, rep, axis=2)
+            st = attn_start if attn_start is not None \
+                else ops.zeros([b], dtype="int32")
+
+            def build_warm_mask(st_, p_):
+                j = jnp.arange(cap)[None, None, :]    # key column
+                i = jnp.arange(s)[None, :, None]      # query row
+                jj = j - p_                           # fresh-span index
+                valid = (j < p_) | ((jj >= st_[:, None, None])
+                                    & (jj <= i))
+                return jnp.where(valid[:, None], 0.0, -1e30)
+
+            mask = apply("warm_prefill_mask", build_warm_mask, st,
+                         wp if isinstance(wp, Tensor) else Tensor(wp))
+            out = F.scaled_dot_product_attention(
+                q, kk, vv, attn_mask=mask, dropout_p=0.0,
+                training=False)
+            return out, (kb, vb)
         if hkv != hq:
             rep = hq // hkv
             k = ops.repeat_interleave(k, rep, axis=2)

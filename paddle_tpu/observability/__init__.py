@@ -115,6 +115,13 @@ _SCHEMA_COUNTERS = tuple(
     + [("router.requests", {"endpoint": ep, "status": s})
        for ep in ("predict", "generate")
        for s in ("ok", "client_error", "shed", "interrupted", "error")]
+    # prefix caching (ISSUE 13): admission-time cache outcomes and LRU
+    # reclaims on the engine side, affinity pick outcomes on the router
+    # side (counted only for fingerprinted /generate requests)
+    + [("engine.prefix_cache", {"event": e})
+       for e in ("hit", "miss", "evict")]
+    + [("router.affinity", {"outcome": o})
+       for o in ("affine", "least_loaded")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
@@ -127,7 +134,11 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
                   "engine.active_sequences", "engine.waiting_sequences",
                   "engine.batch_occupancy", "engine.page_utilization",
                   # quantized decode (ISSUE 12): draft proposal length
-                  "engine.spec_tokens") \
+                  "engine.spec_tokens",
+                  # prefix cache (ISSUE 13): radix-index size + lifetime
+                  # hit rate — the /ready payload's gauge pair
+                  "engine.prefix_cached_tokens",
+                  "engine.prefix_cache_hit_rate") \
     + tuple(("router.replicas", {"state": s})
             for s in ("up", "draining", "ejected", "down")) \
     + tuple(("engine.weight_precision", {"precision": p})

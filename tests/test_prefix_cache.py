@@ -1,0 +1,679 @@
+"""Prefix caching (ISSUE 13): refcounted page sharing, the radix
+prefix index, warm-vs-cold bit parity, the LRU idle-prefix eviction
+tier, router prefix affinity, and the TTFT/observability surface.
+
+Layers:
+
+  * pool units — share/refcount/free semantics, double-free-of-shared
+    loud, defrag moves a shared page exactly once, the shared/logical
+    stats split;
+  * index units — insert/lookup/evict incl. page-boundary off-by-one
+    lengths, LRU order, the max-tokens bound, defrag remap;
+  * engine — warm streams BIT-IDENTICAL to cold-cache streams and to
+    sequential greedy `generate()` across precision tiers, GQA llama,
+    spec decoding, and eviction/recompute;
+  * router — affinity pick vs slack vs drain with fake replicas, the
+    fingerprint round-trip;
+  * schema zeros, the TTFT histogram, the perf-audit budget smoke, and
+    the perf_gate --update round-trip for the bench rows.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference.engine import (
+    EngineConfig, InferenceEngine, PagePool, PrefixIndex,
+)
+from test_engine import assert_drained
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gpt(layers=2, seed=0, max_len=64):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(seed)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=layers,
+                    num_heads=4, max_seq_len=max_len)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    return _gpt()
+
+
+@pytest.fixture(scope="module")
+def draft_model():
+    return _gpt(layers=1, seed=7)
+
+
+PS = 4          # page size every engine test uses
+SYS_LEN = 12    # 3 full pages of shared system prompt
+
+
+@pytest.fixture(scope="module")
+def tenant_prompts():
+    """Two tenants with 3-page system prompts; suffix lengths include
+    the page-boundary off-by-ones (total lengths k*ps-1, k*ps, k*ps+1)."""
+    rs = np.random.RandomState(0)
+    sysp = [rs.randint(0, 128, (SYS_LEN,)).astype(np.int32)
+            for _ in range(2)]
+    sfx = (3, 4, 5, 1, 7, 4)   # 12+4=16 (exact page), 15, 17 covered
+    return [np.concatenate([
+        sysp[i % 2], rs.randint(0, 128, (n,)).astype(np.int32)])
+        for i, n in enumerate(sfx)]
+
+
+@pytest.fixture(scope="module")
+def refs(gpt_model, tenant_prompts):
+    return [np.asarray(gpt_model.generate(
+        P.to_tensor(p[None, :], "int32"), max_new_tokens=8)._value)[0]
+        for p in tenant_prompts]
+
+
+def _ecfg(**kw):
+    base = dict(page_size=PS, max_slots=2, prefill_bucket=PS,
+                max_seq_len=64)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# ------------------------------ pool units ------------------------------
+
+def test_pool_share_refcount_and_free():
+    pool = PagePool(num_pages=8, page_size=4)
+    a = pool.alloc(3)
+    assert all(pool.refcount(p) == 1 for p in a)
+    shared = pool.share(a[:2])
+    assert shared == [int(x) for x in a[:2]]
+    assert pool.refcount(a[0]) == 2
+    st = pool.stats()
+    assert st["used"] == 3                 # physical: shared counted ONCE
+    assert st["shared_pages"] == 2
+    assert st["logical_pages"] == 5
+    pool.free(a)                           # one holder gone
+    assert pool.used_pages == 2            # shared pair still live
+    assert pool.refcount(a[0]) == 1
+    pool.free(a[:2])                       # last refs drop
+    assert pool.used_pages == 0
+    assert pool.ref_counts() == {}
+
+
+def test_pool_double_free_of_shared_loud():
+    pool = PagePool(num_pages=6, page_size=4)
+    a = pool.alloc(1)
+    pool.share(a)
+    pool.free(a)
+    pool.free(a)                           # second holder's legit free
+    with pytest.raises(ValueError):        # now it IS a double free
+        pool.free(a)
+    with pytest.raises(ValueError):        # dead pages cannot be shared
+        pool.share(a)
+    with pytest.raises(ValueError):
+        pool.share([0])                    # nor the scratch page
+
+
+def test_pool_defrag_moves_shared_page_once_and_remaps_refs():
+    pool = PagePool(num_pages=10, page_size=4)
+    a = pool.alloc(2)
+    b = pool.alloc(1)
+    pool.share(b)
+    pool.free(a)                           # holes below b's page
+    moves = pool.defrag()
+    assert list(moves.keys()) == [b[0]]    # ONE physical move
+    new = moves[b[0]]
+    assert pool.refcount(new) == 2         # both holders repointed
+    assert pool.refcount(b[0]) == 0
+    pool.free([new])
+    pool.free([new])
+    assert pool.used_pages == 0
+
+
+def test_pool_peak_counts_shared_once():
+    pool = PagePool(num_pages=8, page_size=4)
+    a = pool.alloc(2)
+    pool.share(a)
+    assert pool.stats()["peak_used"] == 2  # sharing is not allocation
+    pool.free(a)
+    pool.free(a)
+
+
+# ------------------------------ index units ------------------------------
+
+def _toks(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 99, (n,)).astype(
+        np.int32)
+
+
+def test_index_insert_lookup_page_boundaries():
+    pool = PagePool(num_pages=16, page_size=4)
+    idx = PrefixIndex(pool)
+    toks = _toks(12)                       # 3 full pages
+    pages = pool.alloc(3)
+    assert idx.insert(toks, pages) == 3
+    assert all(pool.refcount(p) == 2 for p in pages)
+    # off-by-one lengths around each boundary: matched pages must be
+    # the longest FULL-page prefix the cap allows
+    for n, max_pages, want in ((11, 2, 2), (12, 2, 2), (12, 3, 3),
+                               (13, 3, 3), (4, 1, 1), (3, 0, 0),
+                               (5, 1, 1)):
+        got_tokens, got_pages, nodes = idx.lookup(toks[:n], max_pages)
+        assert got_tokens == want * 4, (n, max_pages)
+        assert got_pages == [int(p) for p in pages[:want]]
+        assert len(nodes) == want
+    # a diverging second page matches only the first
+    other = toks.copy()
+    other[5] = (other[5] + 1) % 99
+    t, pgs, _ = idx.lookup(other, 3)
+    assert t == 4 and pgs == [int(pages[0])]
+
+
+def test_index_lru_eviction_and_busy_pages_skipped():
+    pool = PagePool(num_pages=16, page_size=4)
+    clock = [0.0]
+    idx = PrefixIndex(pool, clock=lambda: clock[0])
+    a_pages, b_pages = pool.alloc(2), pool.alloc(2)
+    idx.insert(_toks(8, seed=1), a_pages)
+    clock[0] = 1.0
+    idx.insert(_toks(8, seed=2), b_pages)
+    pool.free(a_pages)                     # cache is now sole holder
+    pool.free(b_pages)
+    clock[0] = 2.0
+    idx.lookup(_toks(8, seed=1), 2)        # touch chain A -> B is LRU
+    assert idx.evict_idle(1) == 1
+    t, _, _ = idx.lookup(_toks(8, seed=2), 2)
+    assert t == 4                          # B's LEAF died first (LRU)
+    t, _, _ = idx.lookup(_toks(8, seed=1), 2)
+    assert t == 8                          # A untouched
+    # a page shared with a live holder is never reclaimed for pressure
+    t, pgs, _ = idx.lookup(_toks(8, seed=1), 2)
+    pool.share(pgs)                        # live sequence pins them
+    assert idx.evict_idle(8) == 1          # only B's remaining idle page
+    assert idx.nodes == 2
+    pool.free(pgs)
+    assert idx.clear() == 2
+    assert pool.used_pages == 0
+
+
+def test_index_max_tokens_bound():
+    pool = PagePool(num_pages=32, page_size=4)
+    clock = [0.0]
+    idx = PrefixIndex(pool, max_tokens=8, clock=lambda: clock[0])
+    a = pool.alloc(2)
+    idx.insert(_toks(8, seed=1), a)
+    pool.free(a)                           # idx is sole holder
+    clock[0] = 1.0
+    b = pool.alloc(2)
+    idx.insert(_toks(8, seed=2), b)
+    pool.free(b)
+    # bound is 8 tokens = 2 pages: the older chain was reclaimed
+    assert idx.cached_tokens <= 8
+    assert idx.lookup(_toks(8, seed=2), 2)[0] == 8
+    assert idx.lookup(_toks(8, seed=1), 2)[0] == 0
+    idx.clear()
+    assert pool.used_pages == 0
+
+
+def test_index_apply_moves():
+    pool = PagePool(num_pages=8, page_size=4)
+    idx = PrefixIndex(pool)
+    filler = pool.alloc(1)
+    pages = pool.alloc(2)
+    idx.insert(_toks(8), pages)
+    pool.free(pages)
+    pool.free(filler)                      # hole at the bottom
+    moves = pool.defrag()
+    idx.apply_moves(moves)
+    t, pgs, _ = idx.lookup(_toks(8), 2)
+    assert t == 8 and pgs == [moves.get(p, p) for p in pages]
+    idx.clear()
+    assert pool.used_pages == 0
+
+
+# ------------------------------ engine parity ------------------------------
+
+def _run_engine(model, prompts, draft=None, **cfg_kw):
+    eng = InferenceEngine(model, _ecfg(**cfg_kw), draft_model=draft)
+    outs = [eng.generate([p], max_new_tokens=8)[0] for p in prompts]
+    return outs, eng
+
+
+def test_warm_equals_cold_and_sequential(gpt_model, tenant_prompts,
+                                         refs):
+    warm, eng = _run_engine(gpt_model, tenant_prompts)
+    cold, _ = _run_engine(gpt_model, tenant_prompts, prefix_cache=False)
+    for w, c, r in zip(warm, cold, refs):
+        assert np.array_equal(w, r)
+        assert np.array_equal(w, c)
+    st = eng.prefix_cache_stats()
+    assert st["hits"] >= 4 and st["misses"] >= 2
+    assert st["prefill_tokens_saved"] > 0
+    assert_drained(eng)
+
+
+def test_warm_repeat_prompt_full_hit_and_states(gpt_model,
+                                                tenant_prompts, refs):
+    eng = InferenceEngine(gpt_model, _ecfg())
+    h1 = eng.submit(tenant_prompts[0], max_new_tokens=8)
+    while not h1.done.is_set():
+        eng.step()
+    assert h1.cache_state == "miss"
+    h2 = eng.submit(tenant_prompts[0], max_new_tokens=8)
+    while not h2.done.is_set():
+        eng.step()
+    # the full sharable prefix (all but the last page-aligned token
+    # span) was cached by the first request
+    assert h2.cache_state == "hit"
+    assert np.array_equal(h2.result(), refs[0])
+    # deeper prefixes commit over time: the repeat run re-prefilled
+    # only the tail
+    assert eng.prefix_cache_stats()["prefill_tokens_saved"] > 0
+    assert_drained(eng)
+
+
+def test_warm_exact_page_aligned_prompt_keeps_one_tail_token(gpt_model):
+    """A prompt of EXACTLY k pages may share at most k-1 pages — the
+    prefill must still produce the first token from a real tail."""
+    rs = np.random.RandomState(3)
+    p = rs.randint(0, 128, (16,)).astype(np.int32)   # 4 full pages
+    ref = np.asarray(gpt_model.generate(
+        P.to_tensor(p[None, :], "int32"), max_new_tokens=6)._value)[0]
+    eng = InferenceEngine(gpt_model, _ecfg())
+    a = eng.generate([p], max_new_tokens=6)[0]
+    b = eng.generate([p], max_new_tokens=6)[0]
+    assert np.array_equal(a, ref) and np.array_equal(b, ref)
+    assert eng.prefix_cache_stats()["hits"] == 1
+    assert_drained(eng)
+
+
+@pytest.mark.parametrize("tier", [
+    {"kv_precision": "int8"},
+    {"weight_precision": "int8"},
+    {"weight_precision": "int8", "kv_precision": "int8"},
+])
+def test_warm_equals_cold_quantized_tiers(gpt_model, tenant_prompts,
+                                          tier):
+    """Warm streams bit-identical to cold-cache streams at every
+    precision tier — under kv int8 the warm prefill attends the EXACT
+    sidecar, so the first token is computed from the same values a
+    cold dense prefill sees."""
+    warm, eng = _run_engine(gpt_model, tenant_prompts, **tier)
+    cold, _ = _run_engine(gpt_model, tenant_prompts,
+                          prefix_cache=False, **tier)
+    for w, c in zip(warm, cold):
+        assert np.array_equal(w, c), tier
+    assert eng.prefix_cache_stats()["hits"] > 0
+    assert_drained(eng)
+
+
+def test_warm_committed_chunks_rematch_int8(gpt_model):
+    """Chunks committed FROM a warm prefill (a prompt that extends an
+    already-cached prefix) must carry CORRECT exact sidecars: a third
+    prompt matching the deepened prefix streams bit-identically to
+    cold.  Regression: the warm commit offset once sliced the sidecar
+    a whole prefix past the real tokens — re-matching the warm-
+    committed chunk then crashed on ragged sidecar shapes or silently
+    attended garbage prefix K/V."""
+    rs = np.random.RandomState(9)
+    sysp = rs.randint(0, 128, (12,)).astype(np.int32)    # 3 pages
+    common = rs.randint(0, 128, (4,)).astype(np.int32)   # page 4
+    prompts = [
+        np.concatenate([sysp,
+                        rs.randint(0, 128, (2,)).astype(np.int32)]),
+        # extends the cached 3-page prefix: page 4 commits WARM
+        np.concatenate([sysp, common,
+                        rs.randint(0, 128, (1,)).astype(np.int32)]),
+        # matches all 4 pages incl. the warm-committed one
+        np.concatenate([sysp, common,
+                        rs.randint(0, 128, (3,)).astype(np.int32)]),
+    ]
+    warm, eng = _run_engine(gpt_model, prompts, kv_precision="int8")
+    cold, _ = _run_engine(gpt_model, prompts, prefix_cache=False,
+                          kv_precision="int8")
+    for w, c in zip(warm, cold):
+        assert np.array_equal(w, c)
+    assert eng.prefix_cache_stats()["hits"] == 2
+    assert_drained(eng)
+
+
+def test_warm_equals_cold_spec_decoding(gpt_model, draft_model,
+                                        tenant_prompts):
+    warm, eng = _run_engine(gpt_model, tenant_prompts,
+                            draft=draft_model, spec_tokens=2)
+    cold, _ = _run_engine(gpt_model, tenant_prompts, draft=draft_model,
+                          spec_tokens=2, prefix_cache=False)
+    for w, c in zip(warm, cold):
+        assert np.array_equal(w, c)
+    assert eng.prefix_cache_stats()["hits"] > 0
+    assert_drained(eng)
+
+
+def test_warm_llama_gqa_matches_generate():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    P.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(0)
+    sysp = rs.randint(0, 128, (SYS_LEN,)).astype(np.int32)
+    prompts = [np.concatenate([
+        sysp, rs.randint(0, 128, (n,)).astype(np.int32)])
+        for n in (3, 4, 6)]
+    refs = [np.asarray(model.generate(
+        P.to_tensor(p[None, :], "int32"), max_new_tokens=6)._value)[0]
+        for p in prompts]
+    eng = InferenceEngine(model, _ecfg())
+    outs = [eng.generate([p], max_new_tokens=6)[0] for p in prompts]
+    for o, r in zip(outs, refs):
+        assert np.array_equal(o, r)
+    assert eng.prefix_cache_stats()["hits"] == 2
+    assert_drained(eng)
+
+
+def test_eviction_recompute_with_cache_and_pressure(gpt_model,
+                                                    tenant_prompts,
+                                                    refs):
+    """A deliberately tight pool: the LRU idle-prefix tier reclaims
+    cold cache first, recompute eviction handles the rest, and every
+    stream still matches the sequential reference bit-for-bit."""
+    eng = InferenceEngine(gpt_model, _ecfg(num_pages=14, max_slots=3))
+    handles = [eng.submit(p, max_new_tokens=8) for p in tenant_prompts]
+    idle = 0
+    while any(not h.done.is_set() for h in handles) and idle < 3000:
+        idle = idle if eng.step() else idle + 1
+    for h, r in zip(handles, refs):
+        assert np.array_equal(h.result(timeout=1.0), r)
+    assert_drained(eng)
+
+
+def test_cache_disabled_engine_has_no_index(gpt_model, tenant_prompts):
+    outs, eng = _run_engine(gpt_model, tenant_prompts[:2],
+                            prefix_cache=False)
+    st = eng.prefix_cache_stats()
+    assert st["enabled"] is False and st["hits"] == 0
+    assert eng.clear_prefix_cache() == 0
+    assert eng.pool.used_pages == 0        # nothing retained at all
+
+
+def test_config_knob_validation():
+    assert _ecfg(prefix_cache=0).prefix_cache is False
+    assert _ecfg(prefix_cache=1).prefix_cache is True
+    with pytest.raises(ValueError):
+        _ecfg(prefix_cache_max_tokens=-1)
+
+
+# ------------------------------ router affinity ------------------------------
+
+def _affinity_router(loads, slack=0.25):
+    """Fake-transport router with N /generate replicas at given engine
+    loads (active sequences out of 4 slots)."""
+    from test_router import _FakeReplica, _FakeTransport
+
+    from paddle_tpu.inference.router import Router
+
+    reps = {}
+    addrs = {}
+    for i, act in enumerate(loads):
+        rep = _FakeReplica(engine={"max_slots": 4,
+                                   "active_sequences": act,
+                                   "waiting_sequences": 0})
+        reps[f"r{i}"] = rep
+        addrs[f"http://fake-{i}"] = rep
+    router = Router(replicas={rid: f"http://fake-{i}"
+                              for i, rid in enumerate(reps)},
+                    transport=_FakeTransport(addrs), probe_interval=0.05,
+                    affinity_slack=slack)
+    router.probe_once()
+    return router, reps
+
+
+def test_router_affinity_within_slack_sticks():
+    router, reps = _affinity_router([0, 0])
+    # first fingerprinted pick: least-loaded (r0 on tie), recorded
+    assert router._pick("generate", fingerprint="fp1") == "r0"
+    # r0 slightly more loaded but within slack -> affinity sticks
+    reps["r0"].engine["active_sequences"] = 1   # load 0.25 vs 0.0
+    router.probe_once()
+    assert router._pick("generate", fingerprint="fp1") == "r0"
+    # beyond slack -> least-loaded wins and the map re-learns
+    reps["r0"].engine["active_sequences"] = 3   # load 0.75
+    router.probe_once()
+    assert router._pick("generate", fingerprint="fp1") == "r1"
+    reps["r0"].engine["active_sequences"] = 0
+    router.probe_once()
+    # re-learned affinity now points at r1; equal loads keep it there
+    assert router._pick("generate", fingerprint="fp1") == "r1"
+    router.shutdown()
+
+
+def test_router_affinity_never_picks_drained_and_no_fp_is_plain():
+    router, reps = _affinity_router([0, 1])
+    assert router._pick("generate", fingerprint="fpX") == "r0"
+    router.mark_draining("r0")
+    assert router._pick("generate", fingerprint="fpX") == "r1"
+    # un-fingerprinted picks never touch the affinity map
+    before = dict(router._affinity)
+    assert router._pick("generate") == "r1"
+    assert router._affinity == before
+    router.shutdown()
+
+
+def test_router_affinity_bounded_map():
+    router, _ = _affinity_router([0, 0])
+    router.AFFINITY_CAP = 8
+    for i in range(20):
+        router._pick("generate", fingerprint=f"fp{i}")
+    assert len(router._affinity) == 8
+    assert "fp19" in router._affinity and "fp0" not in router._affinity
+    router.shutdown()
+
+
+def test_fingerprint_helper_and_header_roundtrip():
+    from test_router import _FakeReplica, _FakeTransport
+
+    from paddle_tpu.inference.router import Router
+    from paddle_tpu.inference.serving import InferenceClient
+
+    fp = InferenceClient.prefix_fingerprint
+    ids = list(range(40))
+    # floored to the granule: extending within the same page keeps the
+    # fingerprint; crossing the cap does not change it either (first N)
+    assert fp(ids) == fp(ids + [1, 2, 3])
+    assert fp(ids, tokens=16) == fp(ids[:16] + [99] * 24, tokens=16)
+    assert fp(list(range(8))) is None          # shorter than one granule
+    assert fp(ids) != fp([7] + ids[1:])        # content-sensitive
+    # the router forwards the client's header to the replica
+    rep = _FakeReplica(engine={"max_slots": 4, "active_sequences": 0,
+                               "waiting_sequences": 0})
+    router = Router(replicas={"r0": "http://fake-0"},
+                    transport=_FakeTransport({"http://fake-0": rep}),
+                    probe_interval=0.05)
+    router.probe_once()
+    from test_router import _FakeHandler
+
+    from paddle_tpu.observability import request_trace as rtrace
+
+    ctx = rtrace.new_context()
+    router.forward_generate(
+        json.dumps({"input_ids": ids, "max_new_tokens": 2}).encode(),
+        ids, ctx, _FakeHandler(), fingerprint=fp(ids))
+    gen_headers = [h for p, h in rep.requests if p == "/generate"]
+    assert gen_headers and gen_headers[0].get(
+        "X-Prefix-Fingerprint") == fp(ids)
+    router.shutdown()
+
+
+# ------------------------------ observability ------------------------------
+
+def test_schema_zeros_and_counters(gpt_model, tenant_prompts):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    try:
+        snap = metrics.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        for ev in ("hit", "miss", "evict"):
+            assert c.get(f"engine.prefix_cache{{event={ev}}}") == 0
+        for oc in ("affine", "least_loaded"):
+            assert c.get(f"router.affinity{{outcome={oc}}}") == 0
+        assert g.get("engine.prefix_cached_tokens") == 0
+        assert g.get("engine.prefix_cache_hit_rate") == 0
+        eng = InferenceEngine(gpt_model, _ecfg())
+        for p in tenant_prompts[:4]:
+            eng.generate([p], max_new_tokens=4)
+        snap = metrics.snapshot()
+        c, g = snap["counters"], snap["gauges"]
+        assert c.get("engine.prefix_cache{event=hit}") == 2
+        assert c.get("engine.prefix_cache{event=miss}") == 2
+        assert g.get("engine.prefix_cached_tokens") > 0
+        assert g.get("engine.prefix_cache_hit_rate") == 0.5
+        eng.clear_prefix_cache()
+    finally:
+        obs.detach()
+
+
+def test_ttft_histogram_and_ready_payload(gpt_model, tenant_prompts):
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.serving import (
+        InferenceClient, InferenceServer,
+    )
+    from paddle_tpu.observability import metrics
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    eng = InferenceEngine(gpt_model, _ecfg())
+    srv = InferenceServer(engine=eng, request_timeout=60.0,
+                          queue_depth=0).start()
+    try:
+        cli = InferenceClient(srv.address, timeout=60.0)
+        cli.generate(tenant_prompts[0], max_new_tokens=4)
+        cli.generate(tenant_prompts[0], max_new_tokens=4)
+        hists = metrics.snapshot()["histograms"]
+        assert "serving.ttft_ms{cache=miss,endpoint=generate}" in hists
+        assert "serving.ttft_ms{cache=hit,endpoint=generate}" in hists
+        ready = cli.ready()
+        pc = ready["engine"]["prefix_cache"]
+        assert pc["enabled"] is True
+        assert pc["hit_rate"] == 0.5
+        assert pc["cached_tokens"] > 0
+        # the ttft SLO objective exists and saw both streams
+        rep = srv.slo.report()
+        assert rep["endpoints"]["ttft"]["requests"] == 2
+        assert rep["endpoints"]["ttft"]["errors"] == 0
+        # /debug/telemetry carries the engine section with the split
+        snap = srv.telemetry_snapshot()
+        assert "shared_pages" in snap["engine"]["pages"]
+        assert "prefix_cache" in snap["engine"]
+    finally:
+        srv.shutdown()
+        eng.clear_prefix_cache()
+        obs.detach()
+
+
+# ------------------------------ perf audit + gate ------------------------------
+
+def test_perf_smoke_cached_prefill_within_budget():
+    """The warm tail-prefill program audits cleanly and holds its
+    committed budget — a shape leak of the actual shared length (the
+    PT402 recompile hazard this program exists to pin) or a layout
+    regression fails here before any hardware run."""
+    from paddle_tpu import analysis as A
+    from paddle_tpu.analysis import perf_audit
+
+    violations, m = perf_audit.audit_perf(
+        programs=("cached_prefill_step",), repo_root=REPO)
+    assert not [v for v in violations if v.rule == "PT400"], \
+        A.render_report(violations)
+    prog = m["gpt_cached_prefill_step"]
+    assert prog["pt402_weak_inputs"] == 0
+    assert prog["pt405_host_syncs"] == 0
+    budget = A.load_budget(
+        os.path.join(REPO, "tools", "perf_budget.json"))
+    reg, _imp, _ = A.diff_against_budget(m, budget)
+    assert reg == [], A.render_budget_diff(reg, [])
+
+
+def test_perf_gate_prefix_rows_round_trip(tmp_path):
+    """The shared-prefix bench rows are gateable: --update registers
+    them, an equal rerun passes, a hit-rate collapse exits 2."""
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    base = tmp_path / "baseline.jsonl"
+    res = tmp_path / "results.json"
+    rows = [
+        {"metric": "serving_prefix_cache_hit_rate", "value": 0.75,
+         "unit": "frac"},
+        {"metric": "serving_ttft_warm_vs_cold_speedup", "value": 1.8,
+         "unit": "x"},
+        {"metric": "serving_prefill_tokens_saved_frac", "value": 0.62,
+         "unit": "frac"},
+    ]
+    base.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    def run(hit_rate):
+        out = [dict(rows[0], value=hit_rate)] + rows[1:]
+        res.write_text("".join(json.dumps(r) + "\n" for r in out))
+        return subprocess.run(
+            [sys.executable, gate, str(res), "--baseline", str(base),
+             "--static-budget", ""],
+            capture_output=True, text=True)
+
+    assert run(0.75).returncode == 0
+    assert run(0.74).returncode == 0       # within tolerance
+    p = run(0.2)
+    assert p.returncode == 2 and "regression" in p.stderr
+    # --update rolls the floor forward after a win
+    res.write_text("".join(
+        json.dumps(dict(r, value=r["value"] * 1.2)) + "\n"
+        for r in rows))
+    p = subprocess.run(
+        [sys.executable, gate, str(res), "--baseline", str(base),
+         "--static-budget", "", "--update"],
+        capture_output=True, text=True)
+    assert p.returncode == 0 and "updated" in p.stdout
+
+
+def test_bench_prefix_cache_rows():
+    """The bench emits all three rows with the acceptance floors met
+    on the CPU proxy (degraded-marked): hit rate > 0.5 and saved
+    fraction > 0.4 on the shared-prefix tenant workload."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    rows = bench._bench_prefix_cache(True)
+    by = {r["metric"]: r for r in rows}
+    assert set(by) == {"serving_prefix_cache_hit_rate",
+                       "serving_ttft_warm_vs_cold_speedup",
+                       "serving_prefill_tokens_saved_frac"}
+    assert all(r["degraded"] for r in rows)
+    assert by["serving_prefix_cache_hit_rate"]["value"] > 0.5
+    assert by["serving_prefill_tokens_saved_frac"]["value"] > 0.4
+    assert by["serving_ttft_warm_vs_cold_speedup"]["value"] > 0
+
+
+@pytest.mark.chaos
+def test_prefix_chaos_scenario():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check
+    finally:
+        sys.path.pop(0)
+    report = chaos_check.run_prefix_chaos(seed=0)
+    assert report["recovered"], report

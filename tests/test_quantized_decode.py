@@ -32,6 +32,7 @@ import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as P
+from test_engine import assert_drained  # noqa: E402
 from paddle_tpu.inference.engine import (
     EngineConfig, InferenceEngine, Scheduler, Sequence,
 )
@@ -253,7 +254,7 @@ def test_engine_int8_weights_bit_equal_to_dequantized_greedy(
             max_new_tokens=10)._value)[0] for p in prompts]
     for w, o in zip(want, outs):
         assert np.array_equal(w, o), (w.tolist(), o.tolist())
-    assert eng.pool.used_pages == 0
+    assert_drained(eng)
     # every matmul weight (4 Linears x 2 layers + the tied lm head)
     # rides int8: the stored leaves are {"q": int8, "s": f32} dicts
     assert len(eng._wq_meta) == 9
@@ -296,7 +297,7 @@ def test_engine_kv_int8_bit_stable_and_close_to_exact(gpt_model,
             page_size=8, max_slots=3, decode_chunk=2, max_seq_len=64,
             kv_precision="int8"))
         outs = eng.generate(prompts, max_new_tokens=10)
-        assert eng.pool.used_pages == 0
+        assert_drained(eng)
         return outs
 
     o1, o2 = run(), run()
@@ -319,7 +320,7 @@ def test_engine_kv_int8_eviction_recompute_deterministic(gpt_model,
             page_size=4, max_slots=2, num_pages=10, max_seq_len=64,
             kv_precision="int8"))
         outs = eng.generate(prompts, max_new_tokens=10)
-        assert eng.pool.used_pages == 0
+        assert_drained(eng)
         return outs
 
     o1, o2 = run(), run()
@@ -348,7 +349,7 @@ def test_engine_kv_int8_llama_gqa():
             page_size=8, max_slots=2, max_seq_len=64,
             kv_precision="int8"))
         outs = eng.generate(prompts, max_new_tokens=8)
-        assert eng.pool.used_pages == 0
+        assert_drained(eng)
         return outs
 
     o1, o2 = run(), run()
@@ -370,7 +371,7 @@ def test_spec_decode_bit_equal_to_greedy_random_draft(
     outs = eng.generate(prompts, max_new_tokens=10)
     for r, o in zip(refs, outs):
         assert np.array_equal(r, o), (r.tolist(), o.tolist())
-    assert eng.pool.used_pages == 0
+    assert_drained(eng)
 
 
 def test_spec_decode_bit_equal_with_agreeing_draft(prompts):
@@ -426,7 +427,7 @@ def test_spec_decode_eos_and_slot_reuse(gpt_model, draft_model,
     outs = eng.generate(prompts, max_new_tokens=10, eos_token_id=eos)
     for r, o in zip(refs, outs):
         assert np.array_equal(r, o)
-    assert eng.pool.used_pages == 0
+    assert_drained(eng)
 
 
 def test_spec_decode_eviction_recompute(gpt_model, draft_model,
@@ -440,7 +441,7 @@ def test_spec_decode_eviction_recompute(gpt_model, draft_model,
     outs = eng.generate(prompts, max_new_tokens=10)
     for r, o in zip(refs, outs):
         assert np.array_equal(r, o)
-    assert eng.pool.used_pages == 0
+    assert_drained(eng)
 
 
 def test_spec_decode_table_filling_sequence_exact(gpt_model,
@@ -466,7 +467,7 @@ def test_spec_decode_table_filling_sequence_exact(gpt_model,
             for p in prompts]
     for r, o in zip(refs, outs):
         assert np.array_equal(r, o), (r.tolist(), o.tolist())
-    assert eng.pool.used_pages == 0
+    assert_drained(eng)
 
 
 def test_spec_requires_draft_and_vocab_match(gpt_model, draft_model):
@@ -499,7 +500,7 @@ def test_all_tiers_compose_bit_stable(gpt_model, draft_model, prompts):
             weight_precision="int8", kv_precision="int8"),
             draft_model=draft_model)
         outs = eng.generate(prompts, max_new_tokens=10)
-        assert eng.pool.used_pages == 0
+        assert_drained(eng)
         return outs
 
     o1, o2 = run(), run()

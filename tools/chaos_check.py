@@ -26,6 +26,16 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
     the pool (no leak), surviving sequences' outputs must be
     bit-identical to an uninterrupted run, and the sheds must surface
     in the SLO report under their reason labels.
+  * `prefix`: the engine with PREFIX CACHING on, under a shared-prefix
+    tenant workload on a deliberately tight pool — cancels mid-decode,
+    a client killed mid-stream over HTTP, and enough page pressure to
+    force the LRU idle-prefix reclaim tier.  Zero page leak AND zero
+    refcount leak (after drain + cache clear the pool is EMPTY and the
+    refcount table is empty), survivors bit-identical to a cold-cache
+    (caching-disabled) replay, and a POISONED `X-Prefix-Fingerprint`
+    header through a 2-replica router degrades to at worst a cache
+    miss — never a wrong-token stream (the radix index matches real
+    token values; the fingerprint is routing metadata only).
   * `fleet`: a 3-replica `ReplicaFleet` behind the admission-aware
     `Router` under a concurrent mixed /predict + /generate burst;
     one replica is killed -9 and another SIGTERM-drained MID-BURST.
@@ -415,8 +425,12 @@ def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10,
     rs = np.random.RandomState(seed)
     prompts = [rs.randint(0, 256, (3 + (i * 5) % 17,)).astype(np.int32)
                for i in range(n_seqs)]
+    # prefix_cache off: this scenario's leak assertions are the PR 8
+    # zero-pages-after-drain contract WITHOUT the cache layer (the
+    # cache deliberately retains committed pages); --scenario prefix
+    # asserts the cache-aware version
     ecfg = dict(page_size=8, max_slots=4, decode_chunk=2, max_seq_len=96,
-                kv_precision=kv_precision)
+                kv_precision=kv_precision, prefix_cache=False)
 
     # 1. uninterrupted reference run
     ref_engine = InferenceEngine(model, EngineConfig(**ecfg))
@@ -551,6 +565,177 @@ def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10,
             and sum(slo_shed_reasons.values()) >= shed_n
             and all(k in ("queue_full", "deadline", "draining")
                     for k in slo_shed_reasons)),
+    }
+    return report
+
+
+def run_prefix_chaos(seed=0, new_tokens=8):
+    """Prefix-cache chaos (ISSUE 13): shared-prefix tenants on a TIGHT
+    pool with cancels, a mid-stream client kill, and cache-pressure
+    eviction — then a poisoned-fingerprint pass through a 2-replica
+    router.  `recovered` asserts zero page AND refcount leak (pool
+    EMPTY after drain + cache clear), survivors bit-identical to a
+    cold-cache replay, real cache hits during the burst, pressure
+    actually exercised (idle-prefix reclaim or recompute eviction),
+    and that a wrong fingerprint never changes a single token."""
+    import http.client
+    import time
+
+    import numpy as np
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.inference.router import Router
+    from paddle_tpu.inference.serving import InferenceServer
+    from paddle_tpu.observability import metrics
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    model = _build_engine_model(seed)
+    rs = np.random.RandomState(seed)
+    # two tenants, 2-page (16-token) system prompts, unique suffixes
+    sysp = [rs.randint(0, 256, (16,)).astype(np.int32)
+            for _ in range(2)]
+    prompts = [np.concatenate([
+        sysp[i % 2],
+        rs.randint(0, 256, (3 + i % 5,)).astype(np.int32)])
+        for i in range(8)]
+    base = dict(page_size=8, max_slots=4, decode_chunk=2,
+                max_seq_len=96)
+
+    # cold-cache reference: the SAME engine configuration with caching
+    # disabled — the contract is "the cache may change WHEN tokens
+    # appear, never WHICH"
+    ref_eng = InferenceEngine(model, EngineConfig(
+        **base, prefix_cache=False))
+    refs = ref_eng.generate(prompts, max_new_tokens=new_tokens)
+    ref_leak = ref_eng.pool.used_pages
+
+    # 1. shared-prefix burst under pressure + cancels mid-decode
+    eng = InferenceEngine(model, EngineConfig(**base, num_pages=15))
+    handles = [eng.submit(p, max_new_tokens=new_tokens)
+               for p in prompts]
+    for _ in range(3):
+        eng.step()
+    cancel_ids = [handles[2].request_id, handles[5].request_id]
+    for rid in cancel_ids:
+        eng.cancel(rid)
+    idle = 0
+    while any(not h.done.is_set() for h in handles) and idle < 2000:
+        idle = idle if eng.step() else idle + 1
+    survivors_ok = all(
+        np.array_equal(h.result(timeout=1.0), refs[i])
+        for i, h in enumerate(handles)
+        if h.request_id not in cancel_ids)
+    cache_stats = eng.prefix_cache_stats()
+    pool_stats = eng.pool.stats()
+    # after drain every live page belongs to the cache alone (one ref
+    # each); clearing it must empty the pool AND the refcount table
+    no_live_refs = pool_stats["logical_pages"] == pool_stats["used"]
+    eng.clear_prefix_cache()
+    drain_leak = eng.pool.used_pages
+    ref_leak_count = len(eng.pool.ref_counts())
+    seq_evictions = metrics.snapshot()["counters"].get(
+        "engine.sequences{event=evicted}", 0)
+    pressure_ok = (cache_stats.get("evicted_pages", 0) > 0
+                   or seq_evictions > 0)
+
+    # 2. poisoned fingerprint through a 2-replica router + a client
+    # killed mid-stream: the wrong header may cost cache locality,
+    # never a token
+    servers = []
+    replicas = {}
+    for i in range(2):
+        e = InferenceEngine(model, EngineConfig(**base))
+        s = InferenceServer(engine=e, request_timeout=60.0,
+                            queue_depth=0).start()
+        servers.append(s)
+        replicas[f"r{i}"] = s.address
+    router = Router(replicas=replicas, probe_interval=0.1,
+                    request_timeout=60.0).start()
+    rhost, rport = router._httpd.server_address[:2]
+    poisoned_ok = True
+    for i, p in enumerate(prompts[:4]):
+        conn = http.client.HTTPConnection(rhost, rport, timeout=30)
+        body = json.dumps({"input_ids": [int(x) for x in p],
+                           "max_new_tokens": new_tokens})
+        conn.request("POST", "/generate", body=body, headers={
+            "Content-Type": "application/json",
+            # fingerprint of NOTHING this prompt shares: must route
+            # somewhere and still stream the exact reference tokens
+            "X-Prefix-Fingerprint": "feedfacefeedface"})
+        resp = conn.getresponse()
+        out = None
+        for line in resp:
+            line = line.strip()
+            if not line:
+                continue
+            evt = json.loads(line)
+            if evt.get("done"):
+                out = evt.get("output_ids")
+                break
+        conn.close()
+        if out is None or not np.array_equal(
+                np.asarray(out, np.int32), refs[i]):
+            poisoned_ok = False
+    # kill a client mid-stream through the router: the replica must
+    # cancel the sequence and reclaim its (non-cache) pages
+    cancelled_before = metrics.snapshot()["counters"].get(
+        "engine.sequences{event=cancelled}", 0)
+    conn = http.client.HTTPConnection(rhost, rport, timeout=30)
+    conn.request("POST", "/generate", body=json.dumps({
+        "input_ids": [int(x) for x in prompts[0]],
+        # long enough to be mid-stream at the kill, small enough to
+        # fit prompt+new under max_seq_len (an oversized request would
+        # 400 at the door and nothing would ever need cancelling)
+        "max_new_tokens": 60}),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    first_line = resp.fp.readline()
+    resp.close()
+    conn.close()
+    deadline = time.time() + 30.0
+    kill_cancelled = False
+    while time.time() < deadline:
+        snap = metrics.snapshot()["counters"]
+        if snap.get("engine.sequences{event=cancelled}",
+                    0) > cancelled_before and all(
+                s.engine.pool.stats()["logical_pages"]
+                == s.engine.pool.stats()["used"] for s in servers):
+            kill_cancelled = True
+            break
+        time.sleep(0.1)
+    router.shutdown()
+    replica_leaks = []
+    for s in servers:
+        s.shutdown()
+        s.engine.clear_prefix_cache()
+        replica_leaks.append(s.engine.pool.used_pages)
+    obs.detach()
+
+    report = {
+        "scenario": "prefix",
+        "sequences": len(prompts),
+        "ref_page_leak": ref_leak,
+        "survivors_bit_identical": bool(survivors_ok),
+        "cache_hits": cache_stats.get("hits", 0),
+        "cache_evicted_pages": cache_stats.get("evicted_pages", 0),
+        "sequence_evictions": seq_evictions,
+        "pressure_exercised": bool(pressure_ok),
+        "no_live_refs_after_drain": bool(no_live_refs),
+        "drain_page_leak": drain_leak,
+        "refcount_leak": ref_leak_count,
+        "poisoned_fingerprint_ok": bool(poisoned_ok),
+        "stream_kill_first_line": bool(first_line),
+        "stream_kill_cancelled": bool(kill_cancelled),
+        "replica_page_leaks": replica_leaks,
+        "recovered": (
+            ref_leak == 0 and bool(survivors_ok)
+            and cache_stats.get("hits", 0) > 0 and bool(pressure_ok)
+            and bool(no_live_refs) and drain_leak == 0
+            and ref_leak_count == 0 and bool(poisoned_ok)
+            and bool(first_line) and bool(kill_cancelled)
+            and all(n == 0 for n in replica_leaks)),
     }
     return report
 
@@ -742,7 +927,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario",
                     choices=("train", "overload", "preemption", "engine",
-                             "fleet"),
+                             "fleet", "prefix"),
                     default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
@@ -763,6 +948,8 @@ def main(argv=None):
                                    and q["recovered"])
     elif args.scenario == "fleet":
         report = run_fleet_chaos(seed=args.seed)
+    elif args.scenario == "prefix":
+        report = run_prefix_chaos(seed=args.seed)
     elif args.scenario == "preemption":
         report = run_preemption(steps=min(args.steps, 12), seed=args.seed)
     else:
