@@ -696,26 +696,38 @@ def _bench_prefix_cache(degraded: bool) -> list:
 
 
 def _bench_fleet_decode(degraded: bool) -> dict:
-    """Horizontal serving scale-out (ISSUE 9): N streaming clients run
-    /generate through the admission-aware `Router` over a TWO-replica
-    `ReplicaFleet` (each replica a real paged-KV `InferenceEngine` in
-    its own process); value = total generated tokens / wall.  The same
-    run measures the same client burst against ONE replica directly —
-    the line carries that number and the fleet speedup, so the claim
-    "a second replica buys real aggregate decode throughput" ships
-    with its own evidence.  Replica processes run the CPU proxy until
-    per-replica chip-slice assignment lands, so the line is
-    degraded-marked off-TPU either way."""
-    import threading
-
+    """Horizontal serving scale-out (ISSUE 9, reworked under ISSUE 14):
+    the `tools/loadgen.py` SHARED-PREFIX tenant workload — the same
+    definition the surge chaos scenario drives — runs as an open-loop
+    burst of /generate streams through the admission-aware `Router`
+    over a TWO-replica `ReplicaFleet` (each replica a real paged-KV
+    `InferenceEngine` with its prefix cache on, requests carrying
+    `X-Prefix-Fingerprint` so prefix-AFFINITY routing is active);
+    value = total generated tokens / wall.  The same run measures the
+    same workload against ONE replica directly — the line carries that
+    number and the fleet speedup, so the claim "a second replica buys
+    real aggregate decode throughput" ships with its own evidence.
+    Replica processes run the CPU proxy until per-replica chip-slice
+    assignment lands, so the line is degraded-marked off-TPU either
+    way."""
     from paddle_tpu.inference.fleet import ReplicaFleet
     from paddle_tpu.inference.serving import InferenceClient
 
-    n_clients, new_tokens = 6, 24
-    lens = (4, 8, 12)
-    rs = np.random.RandomState(0)
-    prompts = [rs.randint(0, 256, (lens[i % len(lens)],))
-               .astype(np.int32) for i in range(n_clients)]
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    n_reqs, new_tokens = 12, 24
+    # 16-token system prompts = 2 full engine pages (page_size=8):
+    # page-aligned by construction, so tenants share committed prefix
+    # pages AND fingerprint alike (granule 16 — affinity active)
+    workload = loadgen.SharedPrefixWorkload(
+        seed=0, tenants=3, system_prompt_tokens=16,
+        suffix_tokens=(3, 8), vocab=256, generate_frac=1.0,
+        max_new_tokens=new_tokens)
     fleet = ReplicaFleet(num_replicas=2, kind="gpt",
                          launch_timeout=300, request_timeout=120.0)
     fleet.start()
@@ -724,39 +736,39 @@ def _bench_fleet_decode(degraded: bool) -> dict:
                  fleet.describe()["replicas"].values()]
 
         def burst(address):
-            done = []
-            lock = threading.Lock()
+            # a FRESH workload per burst: same seed → bit-identical
+            # request specs against the single replica and the fleet
+            # (the comparison is apples-to-apples by construction)
+            wl = loadgen.SharedPrefixWorkload(
+                seed=0, tenants=3, system_prompt_tokens=16,
+                suffix_tokens=(3, 8), vocab=256, generate_frac=1.0,
+                max_new_tokens=new_tokens)
+            runner = loadgen.OpenLoopRunner(
+                address, wl, timeout=300.0, max_retries=2,
+                max_retry_wait=1.0)
+            report = runner.run(
+                schedule=wl.schedule_burst(n_reqs, window_s=0.25))
+            return report.summary()
 
-            def one(i):
-                cli = InferenceClient(address, timeout=300.0,
-                                      retries=1)
-                r = cli.generate(prompts[i],
-                                 max_new_tokens=new_tokens)
-                with lock:
-                    done.append(len(r["tokens"]))
-
-            t0 = time.perf_counter()
-            threads = [threading.Thread(target=one, args=(i,))
-                       for i in range(n_clients)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            dt = time.perf_counter() - t0
-            return sum(done) / dt, len(done)
-
-        # warm every replica's prefill buckets + decode program so
-        # compiles stay out of both timings
+        # warm EVERY replica with EVERY request the schedule will send
+        # (2 tokens each): compiles (all prefill buckets + the decode
+        # program) stay out of both timings AND every tenant's prefix
+        # pages are committed in every replica's cache BEFORE either
+        # burst — without this the run ORDER biases the comparison
+        # (the single burst would warm r0's prefix cache for the fleet
+        # burst's bit-identical prompts).  Both bursts measure fully
+        # warm serving.
+        probe = [s for _, s in workload.schedule_burst(n_reqs, 0.25)]
         for addr in addrs:
             cli = InferenceClient(addr, timeout=300.0, retries=1)
-            for s0 in sorted({p.size for p in prompts}):
-                cli.generate(prompts[[p.size for p in
-                                      prompts].index(s0)],
-                             max_new_tokens=2)
-        single_tps, n1 = burst(addrs[0])         # one replica, direct
-        fleet_tps, n2 = burst(fleet.router.address)  # via the router
+            for s in probe:
+                cli.generate(s["prompt"], max_new_tokens=2)
+        single = burst(addrs[0])                 # one replica, direct
+        via_fleet = burst(fleet.router.address)  # via the router
     finally:
         fleet.stop()
+    single_tps = single["tokens_per_sec"]
+    fleet_tps = via_fleet["tokens_per_sec"]
     result = {
         "metric": "fleet_decode_tokens_per_sec",
         "value": round(fleet_tps, 1), "unit": "tokens/s",
@@ -767,8 +779,11 @@ def _bench_fleet_decode(degraded: bool) -> dict:
         "single_replica_tokens_per_sec": round(single_tps, 1),
         "fleet_speedup": round(fleet_tps / single_tps, 2)
         if single_tps > 0 else 0.0,
-        "clients": n_clients, "replicas": 2,
-        "completed": [n1, n2],
+        "clients": n_reqs, "replicas": 2,
+        "completed": [single["ok"], via_fleet["ok"]],
+        "admitted_failures": [single["admitted_failures"],
+                              via_fleet["admitted_failures"]],
+        "workload": "loadgen shared-prefix (3 tenants, affinity on)",
     }
     result["degraded"] = True  # CPU-proxy replicas (see docstring)
     result["note"] = ("replicas share one CPU host on the proxy, so "

@@ -22,6 +22,15 @@ routing half is `inference/router.py`).  A `ReplicaFleet`:
     in-flight traffic toward it to reach zero, and only then delivers
     SIGTERM — the replica's own `PreemptionGuard` finishes in-flight
     work and exits 0.  No thundering 503s, no severed requests.
+  * **resizes at runtime** (ISSUE 14): `add_replica()` grows the fleet
+    by one (fresh rank, spawned + announced + registered with the
+    router, readiness-gated into rotation like any launch) and
+    `remove_replica(rank)` shrinks it through the zero-loss drain
+    protocol above, then retires the rank — the monitor never
+    relaunches a removed rank, and `stop()` sweeps whatever membership
+    exists at stop time, not the `__init__` roster.  The
+    `inference.autoscaler.Autoscaler` drives both off the SLO burn
+    rate and edge-admission occupancy.
 
 Replica kinds (`--kind`): `echo` (stdlib+numpy predict-only stub —
 fast startup, the unit/chaos workhorse), `toy` (echo predict + the
@@ -225,7 +234,7 @@ class _ReplicaHandle:
     """One supervised replica slot (rank is stable across relaunches)."""
 
     __slots__ = ("rank", "rid", "proc", "address", "announce",
-                 "restarts", "drain_requested", "log_path")
+                 "restarts", "drain_requested", "log_path", "removed")
 
     def __init__(self, rank):
         self.rank = int(rank)
@@ -236,6 +245,7 @@ class _ReplicaHandle:
         self.restarts = 0
         self.drain_requested = False
         self.log_path = None
+        self.removed = False   # retired rank: exit is final, no relaunch
 
 
 class ReplicaFleet:
@@ -277,6 +287,7 @@ class ReplicaFleet:
         self.job_id = f"fleet-{os.getpid()}-{uuid.uuid4().hex[:6]}"
         self._handles = {r: _ReplicaHandle(r)
                          for r in range(self.num_replicas)}
+        self._next_rank = self.num_replicas  # dynamic growth cursor
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._monitor_thread = None
@@ -339,7 +350,11 @@ class ReplicaFleet:
         if self.store is None or self._elastic is None:
             return alive
         now = time.time()
-        for r in range(self.num_replicas):
+        with self._lock:
+            ranks = list(self._handles)  # live membership, not the
+            # __init__ roster: a dynamically-added rank must be able to
+            # beat, a removed rank must stop being asked after
+        for r in ranks:
             key = self._elastic._hb_key(r)
             try:
                 if not self.store.check(key):
@@ -407,8 +422,10 @@ class ReplicaFleet:
         cmd = self._replica_cmd(handle)
         env = self._replica_environ(handle)
         with self._lock:
-            if self._stopping.is_set():
-                return False
+            if self._stopping.is_set() or handle.removed:
+                return False  # stopping, or the rank was retired while
+                # a relaunch was in flight — spawning now would orphan
+                # a process no sweep ever kills
             handle.proc = self._spawner(handle, cmd, env)
         self._event("replica_spawned", rank=handle.rank,
                     restarts=handle.restarts)
@@ -443,9 +460,11 @@ class ReplicaFleet:
             prefix="paddle_tpu_fleet_")
         os.makedirs(self.workdir, exist_ok=True)
         self._start_store()
-        for handle in self._handles.values():
+        with self._lock:
+            handles = list(self._handles.values())
+        for handle in handles:
             self._launch(handle)
-        for handle in self._handles.values():
+        for handle in handles:
             addr = self._await_announce(handle)
             if addr is None:
                 raise RuntimeError(
@@ -480,7 +499,15 @@ class ReplicaFleet:
         death."""
         relaunching: set = set()
         while not self._stopping.wait(self.monitor_interval):
-            for handle in list(self._handles.values()):
+            with self._lock:
+                sweep = list(self._handles.values())
+            # the sweep runs over a snapshot: membership may change
+            # under it (autoscaler add/remove).  A handle popped
+            # mid-sweep has proc=None (skip); a handle added mid-sweep
+            # is picked up next tick; a REMOVED rank's exit is final —
+            # relaunching it would resurrect what the autoscaler
+            # deliberately retired.
+            for handle in sweep:
                 proc = handle.proc
                 if proc is None or handle.rank in relaunching:
                     continue
@@ -493,10 +520,18 @@ class ReplicaFleet:
                             drained=handle.drain_requested)
                 self.router.note_replica_down(handle.rid)
                 handle.proc = None
-                if self._stopping.is_set():
+                if self._stopping.is_set() or handle.removed:
                     continue
                 if handle.restarts >= self.max_restarts:
+                    # out of restarts: RETIRE the rank instead of
+                    # keeping a corpse on the roster — a dead handle
+                    # would inflate replica_count() forever, blocking
+                    # the autoscaler's max bound with capacity that
+                    # does not exist (it can now add a fresh rank)
                     self._event("replica_abandoned", rank=handle.rank)
+                    with self._lock:
+                        self._handles.pop(handle.rank, None)
+                    self.router.remove_replica(handle.rid)
                     continue
                 handle.restarts += 1
                 relaunching.add(handle.rank)
@@ -520,13 +555,106 @@ class ReplicaFleet:
         finally:
             done_cb(handle.rank)
 
+    # --- dynamic membership (ISSUE 14: the autoscaler's two verbs) ------
+    def replica_count(self):
+        """Live fleet size (supervised ranks, whatever their state)."""
+        with self._lock:
+            return len(self._handles)
+
+    def replica_ranks(self):
+        with self._lock:
+            return sorted(self._handles)
+
+    def add_replica(self, timeout=None):
+        """Grow the fleet by one replica: fresh rank, spawn, await the
+        announce file, register with the router (readiness-gated into
+        rotation by the probe loop, like any launch).  Returns the new
+        rank, or None when stopping or the launch failed — the failed
+        handle leaves the table either way, so a flaky spawn cannot
+        leave a rank the monitor supervises but the router never saw."""
+        with self._lock:
+            if self._stopping.is_set():
+                return None
+            rank = self._next_rank
+            self._next_rank += 1
+            handle = _ReplicaHandle(rank)
+            self._handles[rank] = handle
+        if not self._launch(handle):
+            with self._lock:
+                self._handles.pop(rank, None)
+            return None
+        addr = self._await_announce(handle, timeout=timeout)
+        if addr is None:
+            self._event("replica_add_failed", rank=rank)
+            with self._lock:
+                # removed BEFORE the pop: a monitor sweep holding this
+                # handle in its snapshot must see the retirement, or it
+                # would relaunch the dead rank into a process no sweep
+                # ever kills and a router entry no handle supervises
+                handle.removed = True
+                proc = handle.proc
+                self._handles.pop(rank, None)
+            if proc is not None:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=2.0)
+                except Exception:  # pt-lint: ok[PT005]
+                    pass  # already gone — which is all we needed
+            return None
+        self.router.add_replica(handle.rid, addr)
+        self._event("replica_added", rank=rank, address=addr)
+        return rank
+
+    def remove_replica(self, rank, grace=5.0, exit_timeout=10.0):
+        """Shrink the fleet by one replica through the zero-loss drain
+        protocol (rotation out → router in-flight to zero → SIGTERM →
+        PreemptionGuard drain → exit 0), then retire the rank: the
+        monitor never relaunches it and the router forgets it.  Returns
+        the replica's exit code (0 for a clean drain), True when the
+        rank retired but its process was already gone (nothing to
+        reap), or None when the rank is unknown — callers branch on
+        `is None` to tell "removed nothing" from "removed".  A process
+        that outlives `exit_timeout` is killed — the rank retires
+        either way."""
+        with self._lock:
+            handle = self._handles.get(int(rank))
+            if handle is None:
+                return None
+            handle.removed = True  # from here the exit is final
+            # capture the process HERE, in the same critical section:
+            # after the drain below the monitor may have reaped the
+            # exit and nulled handle.proc, and reading it then would
+            # lose the exit code a clean drain must report (rc=0)
+            proc = handle.proc
+        self.drain_replica(rank, grace=grace)
+        rc = None
+        if proc is not None:
+            try:
+                rc = proc.wait(timeout=exit_timeout)
+            except Exception:  # pt-lint: ok[PT005]
+                try:           # (drain overran its grace: hard stop —
+                    proc.kill()      # the rank is leaving regardless)
+                    rc = proc.wait(timeout=2.0)
+                except Exception:  # pt-lint: ok[PT005]
+                    pass           # unkillable == already a zombie
+        with self._lock:
+            handle.proc = None
+            self._handles.pop(int(rank), None)
+        self.router.remove_replica(handle.rid)
+        self._event("replica_removed", rank=handle.rank, rc=rc)
+        return rc if proc is not None else True
+
     def drain_replica(self, rank, grace=5.0):
         """Deliberate drain of one replica, in the safe order: router
         rotation OUT first, router-side in-flight toward it to zero
         (bounded by `grace`), THEN SIGTERM — the replica's
         PreemptionGuard handles the rest (finish in-flight, exit 0).
         The monitor relaunches it afterward (capacity heals)."""
-        handle = self._handles[int(rank)]
+        with self._lock:
+            handle = self._handles.get(int(rank))
+        if handle is None:
+            return False  # retired/unknown rank: a drain is a no-op,
+            # not a KeyError (ranks can now leave the table at runtime)
         self._event("drain_mark", rank=handle.rank)
         self.router.mark_draining(handle.rid)
         deadline = time.monotonic() + float(grace)
@@ -545,7 +673,11 @@ class ReplicaFleet:
     def kill_replica(self, rank):
         """Hard kill (SIGKILL) — the chaos path.  No drain, no mercy;
         the router's failover owns the consequences."""
-        handle = self._handles[int(rank)]
+        with self._lock:
+            handle = self._handles.get(int(rank))
+        if handle is None:
+            return False  # already retired: as dead as kill would
+            # have made it
         self._event("kill", rank=handle.rank)
         if handle.proc is not None:
             try:
@@ -557,18 +689,25 @@ class ReplicaFleet:
     def stop(self, timeout=10.0):
         self._stopping.set()
         with self._lock:
-            pass  # barrier: an in-flight _launch finishes its spawn
-        # before the sweep below runs; later ones refuse (see _launch)
+            # barrier: an in-flight _launch finishes its spawn before
+            # the sweep below runs; later ones refuse (see _launch).
+            # The sweep itself runs over a SNAPSHOT: membership can
+            # shrink concurrently (an autoscaler remove_replica mid
+            # stop pops its handle), and iterating the live dict would
+            # either skip a replica or die on the mutation — either way
+            # an orphan.  The snapshot covers every rank alive at the
+            # barrier, including dynamically-added ones.
+            handles = list(self._handles.values())
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=2.0)
-        for handle in self._handles.values():
+        for handle in handles:
             if handle.proc is not None and handle.proc.poll() is None:
                 try:
                     handle.proc.send_signal(signal.SIGTERM)
                 except (ProcessLookupError, OSError):  # pt-lint: ok[PT005]
                     pass  # raced its own exit
         deadline = time.monotonic() + float(timeout)
-        for handle in self._handles.values():
+        for handle in handles:
             if handle.proc is None:
                 continue
             remaining = max(0.1, deadline - time.monotonic())

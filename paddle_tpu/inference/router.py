@@ -34,10 +34,15 @@ Routing (docs/SERVING.md):
     `set_capacity`) sheds once, at the edge, with an honest
     `Retry-After`; `no_replicas` sheds map to 503.
 
-Telemetry: `router.replicas{state=up|draining|ejected|down}` gauges,
+Telemetry: `router.replicas{state=up|draining|ejected|down}` and
+`router.capacity{endpoint}` gauges (live routable capacity, ISSUE 14),
 `router.failovers` / `router.ejections` / `router.readmissions` and
 `router.requests{endpoint,status}` counters (attach() schema), and
 `router.request`/`router.forward` spans carrying request identity.
+The router also keeps a fleet-level `SLOTracker` (`router.slo`) fed
+from every finished edge request — sheds and unsaved failures burn
+budget here even when each replica's own ledger is clean; its burn
+rate is the `inference.autoscaler.Autoscaler`'s primary scale signal.
 Fault point `router.forward` fires per forward attempt (chaos).
 
 Prefix-affinity routing (ISSUE 13, docs/SERVING.md): /generate
@@ -75,6 +80,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
 from ..observability import trace as _trace
+from ..observability.slo import SLOTracker
 from ..resilience.overload import AdmissionController, ShedError, _env_num
 from ..resilience.retry import CircuitBreaker, CircuitOpenError
 from .serving import _retry_after_header
@@ -251,6 +257,22 @@ class Router:
         self.gen_admission = AdmissionController(
             max_inflight=max_inflight, queue_depth=queue_depth,
             name="router.generate")
+        # fleet-level SLO ledger (ISSUE 14): what the CLIENT-FACING
+        # edge delivered — sheds and failed-over-into-errors consume
+        # budget here even when every replica's own ledger is clean.
+        # Its windowed burn rate is the autoscaler's primary signal.
+        self.slo = SLOTracker(
+            window_s=_env_num("PADDLE_TPU_SLO_WINDOW", 300.0, float),
+            clock=clock)
+        for ep, target in (("predict", 1000.0), ("generate", 30000.0)):
+            self.slo.objective(
+                ep,
+                latency_target_ms=_env_num(
+                    "PADDLE_TPU_SLO_LATENCY_MS" if ep == "predict"
+                    else "PADDLE_TPU_SLO_GENERATE_LATENCY_MS",
+                    target, float),
+                availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
+                                      0.999, float))
         for rid, address in dict(replicas or {}).items():
             self.add_replica(rid, address)
         self._probe_stop = threading.Event()
@@ -674,7 +696,12 @@ class Router:
 
     def _retrack_capacity(self):
         """Edge admission capacity = what the routable fleet can
-        actually run concurrently right now."""
+        actually run concurrently right now.  Published as
+        `router.capacity{endpoint}` gauges (ISSUE 14) so the fleet's
+        routable headroom is scrapeable next to the autoscaler's
+        replica gauges — zero IS a meaningful reading (nothing
+        routable), so the gauges publish unconditionally even though
+        the controllers only re-track positive capacity."""
         predict_cap = 0
         gen_cap = 0
         with self._lock:
@@ -684,6 +711,10 @@ class Router:
                                    or sig.get("limit") or 1)
                 eng = sig.get("engine") or {}
                 gen_cap += int(eng.get("max_slots") or 0)
+        _metrics.set_gauge("router.capacity", predict_cap,
+                           endpoint="predict")
+        _metrics.set_gauge("router.capacity", gen_cap,
+                           endpoint="generate")
         if predict_cap > 0:
             self.admission.set_capacity(predict_cap)
         if gen_cap > 0:
@@ -694,6 +725,27 @@ class Router:
                 if rep.state == "up"
                 and rep.signals.get("_ready", False)
                 and rep.breaker.state != "open"]
+
+    def routable_ids(self):
+        """Replica ids currently in rotation — the autoscaler's
+        scale-down candidate set (a drain must target a replica that
+        is actually carrying traffic state, never one already
+        draining/ejected/down)."""
+        with self._lock:
+            return list(self._routable_locked())
+
+    def affinity_counts(self):
+        """Live prefix-affinity population per replica id: how many
+        fingerprints in the bounded LRU map currently point at each
+        replica.  The autoscaler uses this to pick the LEAST
+        affinity-hot routable replica for scale-down — draining the
+        replica most prefixes are warm on would trade every one of
+        those tenants' TTFT for nothing."""
+        with self._lock:
+            counts: dict = {}
+            for rid in self._affinity.values():
+                counts[rid] = counts.get(rid, 0) + 1
+            return counts
 
     # ------------------------------------------------------------------
     # pick + forward
@@ -1051,6 +1103,18 @@ class Router:
                          endpoint=endpoint, status=status)
         _metrics.inc("router.requests", endpoint=endpoint,
                      status=status)
+        # fleet-level SLO ledger (ISSUE 14): every edge shed and every
+        # request the failover machinery could NOT save burns budget —
+        # the burn rate over this ledger is what the autoscaler scales
+        # on.  Client-fault 400s are excluded (same rule as serving:
+        # the availability promise is about the fleet, and a
+        # misbehaving client must not buy itself more replicas).
+        if status == "ok":
+            self.slo.observe(endpoint, dt_ms, ok=True)
+        elif status == "shed":
+            self.slo.record_shed(endpoint, "edge")
+        elif status in ("error", "interrupted", "timeout"):
+            self.slo.observe(endpoint, dt_ms, ok=False, reason=status)
 
     def _publish_state_gauges(self):
         counts = dict.fromkeys(_REPLICA_STATES, 0)
@@ -1074,11 +1138,15 @@ class Router:
         import os as _os
 
         ready, reason = self.readiness()
+        # SLO report first: it publishes the slo.* gauges the metrics
+        # snapshot should carry (same ordering as serving's snapshot)
+        slo_report = self.slo.report()
         return {
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "pid": _os.getpid(),
             "role": "router",
             "metrics": _metrics.snapshot(),
+            "slo": slo_report,
             "admission": self.admission.stats(),
             "gen_admission": self.gen_admission.stats(),
             "readiness": {"ready": ready, "reason": reason},

@@ -122,6 +122,11 @@ _SCHEMA_COUNTERS = tuple(
        for e in ("hit", "miss", "evict")]
     + [("router.affinity", {"outcome": o})
        for o in ("affine", "least_loaded")]
+    # autoscaler (ISSUE 14): one decision per control tick — a healthy
+    # steady-state fleet shows a growing `hold` count next to zero
+    # up/down, which is itself the signal the loop is alive
+    + [("autoscaler.decisions", {"action": a})
+       for a in ("up", "down", "hold")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
@@ -141,6 +146,10 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
                   "engine.prefix_cache_hit_rate") \
     + tuple(("router.replicas", {"state": s})
             for s in ("up", "draining", "ejected", "down")) \
+    + tuple(("router.capacity", {"endpoint": ep})
+            for ep in ("predict", "generate")) \
+    + tuple(("autoscaler.replicas", {"state": s})
+            for s in ("target", "actual")) \
     + tuple(("engine.weight_precision", {"precision": p})
             for p in ("full", "bf16", "int8")) \
     + tuple(("paged.pool_precision", {"precision": p})

@@ -1,0 +1,383 @@
+"""Autoscaler tests (ISSUE 14): decision logic under a fake clock
+(sustained-burn scale-up, idle scale-down, cooldown hysteresis, min/max
+bounds, the affinity-aware scale-down victim pick and its drain
+ordering), the fleet's dynamic-membership fixes (removed ranks never
+relaunched, stop() sweeps dynamically-added replicas), the router's
+fleet-level SLO feed, and the new capacity/autoscaler telemetry.  Unit
+tests drive the whole loop with fake replicas, a fake transport, fake
+processes and an injectable clock — the only real sockets are the
+routers' unstarted/ephemeral listeners.  The seeded 10× surge lives
+under the `chaos` marker (tools/chaos_check.py --scenario surge).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.inference.autoscaler import Autoscaler
+from paddle_tpu.inference.fleet import ReplicaFleet
+from paddle_tpu.inference.router import ReplicaUnreachable, Router
+from paddle_tpu.observability import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    obs.attach(crash_hook=False)
+    yield
+    obs.detach()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------------------
+# fake replica plane (same idiom as test_router: no replica sockets)
+# --------------------------------------------------------------------------
+
+class _FakeReplica:
+    def __init__(self, limit=4, engine=None, ready=True):
+        self.limit = limit
+        self.engine = engine
+        self.ready = ready
+
+    def ready_payload(self):
+        body = {"status": "ready" if self.ready else "not_ready",
+                "reason": "ok", "inflight": 0, "queued": 0,
+                "limit": self.limit, "admission_limit": self.limit}
+        if self.engine is not None:
+            body["engine"] = dict(self.engine)
+        return ((200 if self.ready else 503), {},
+                json.dumps(body).encode())
+
+
+class _FakeTransport:
+    def __init__(self, replicas):
+        self.replicas = dict(replicas)  # address -> _FakeReplica
+
+    def request(self, address, method, path, body=None, headers=None,
+                timeout=30.0):
+        rep = self.replicas.get(address)
+        if rep is None:
+            raise ReplicaUnreachable(f"no fake replica at {address}")
+        if path == "/ready":
+            return rep.ready_payload()
+        raise AssertionError(f"unexpected path {path}")
+
+    def stream(self, address, path, body, headers=None, timeout=30.0):
+        raise AssertionError("no streams in these tests")
+
+
+class _FakeProc:
+    def __init__(self, record, rank):
+        self.record = record
+        self.rank = rank
+        self.rc = None
+        self.pid = 91000 + rank
+
+    def poll(self):
+        return self.rc
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.record.append(("signal", self.rank, int(sig)))
+        self.rc = 0
+
+    def kill(self):
+        self.record.append(("kill", self.rank))
+        self.rc = -9
+
+
+def _scaled_fleet(tmp_path, n=2, pool=6, clock=None, fleet_kw=None,
+                  **scaler_kw):
+    """A ReplicaFleet over fake processes behind a Router over a fake
+    transport, plus an Autoscaler on a fake clock.  The transport
+    pre-registers `pool` addresses so dynamic growth has somewhere to
+    land."""
+    record = []
+    transport = _FakeTransport(
+        {f"fake://r{i}": _FakeReplica() for i in range(pool)})
+    router = Router(transport=transport, probe_interval=0.05,
+                    clock=clock or time.monotonic)
+
+    def spawner(handle, cmd, env):
+        with open(handle.announce + ".tmp", "w") as f:
+            json.dump({"address": f"fake://{handle.rid}",
+                       "pid": 91000 + handle.rank}, f)
+        os.replace(handle.announce + ".tmp", handle.announce)
+        return _FakeProc(record, handle.rank)
+
+    fleet = ReplicaFleet(num_replicas=n, router=router,
+                         heartbeat=False, spawner=spawner,
+                         workdir=str(tmp_path), monitor_interval=0.05,
+                         **dict(fleet_kw or {}, ))
+    fleet.start()
+    scaler = Autoscaler(fleet, clock=clock or time.monotonic,
+                        **scaler_kw)
+    return fleet, scaler, record
+
+
+def _wait_routable(router, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if router.routable_count() >= n:
+            return True
+        time.sleep(0.02)
+    return router.routable_count() >= n
+
+
+# --------------------------------------------------------------------------
+# scale-up: sustained burn, cooldown, max bound
+# --------------------------------------------------------------------------
+
+def test_sustained_burn_scales_up_cooldown_suppresses_flapping(tmp_path):
+    clk = _Clock()
+    fleet, scaler, _rec = _scaled_fleet(
+        tmp_path, n=1, clock=clk, min_replicas=1, max_replicas=3,
+        up_sustain=2, down_sustain=99, cooldown_s=5.0, burn_up=3.0)
+    try:
+        # a sustained error-budget burn on the router's OWN ledger
+        for _ in range(4):
+            fleet.router.slo.record_shed("generate", "edge")
+        assert scaler.tick() == "hold"      # one tick is noise...
+        assert scaler.tick() == "up"        # ...two is sustained
+        assert fleet.replica_count() == 2
+        assert "r1" in fleet.router.replica_summary()
+        # still burning, but inside the cooldown: no flap
+        assert scaler.tick() == "hold"
+        assert scaler.tick() == "hold"
+        assert fleet.replica_count() == 2
+        clk.advance(6.0)                    # cooldown elapsed — the
+        # evidence kept accumulating through the holds, so the next
+        # tick acts immediately
+        assert scaler.tick() == "up"
+        assert fleet.replica_count() == 3
+        # max bound holds no matter how hard the budget burns
+        clk.advance(6.0)
+        assert scaler.tick() == "hold"
+        assert scaler.tick() == "hold"
+        assert fleet.replica_count() == 3
+        snap = metrics.snapshot()
+        assert snap["counters"].get(
+            "autoscaler.decisions{action=up}") == 2
+        assert snap["gauges"].get(
+            "autoscaler.replicas{state=actual}") == 3
+        assert snap["gauges"].get(
+            "autoscaler.replicas{state=target}") == 3
+    finally:
+        fleet.stop()
+
+
+def test_occupancy_high_water_also_scales_up(tmp_path):
+    clk = _Clock()
+    fleet, scaler, _rec = _scaled_fleet(
+        tmp_path, n=1, clock=clk, min_replicas=1, max_replicas=2,
+        up_sustain=2, down_sustain=99, cooldown_s=0.0, occ_up=0.5)
+    try:
+        assert _wait_routable(fleet.router, 1)
+        # park tickets in the edge controller: occupancy, no burn
+        tickets = [fleet.router.admission.admit() for _ in range(3)]
+        assert scaler.signals()["occupancy"] >= 0.5
+        assert scaler.tick() == "hold"
+        assert scaler.tick() == "up"
+        assert fleet.replica_count() == 2
+        for t in tickets:
+            t.release(ok=True)
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------------
+# scale-down: sustained idle, drain ordering, affinity-aware victim
+# --------------------------------------------------------------------------
+
+def test_idle_scales_down_through_drain_never_affinity_hot(tmp_path):
+    clk = _Clock()
+    fleet, scaler, record = _scaled_fleet(
+        tmp_path, n=3, clock=clk, min_replicas=1, max_replicas=4,
+        up_sustain=99, down_sustain=2, cooldown_s=0.0)
+    try:
+        assert _wait_routable(fleet.router, 3)
+        # r0 is affinity-hot (three warm tenants), r1 warm, r2 cold
+        with fleet.router._lock:
+            for i in range(3):
+                fleet.router._affinity[f"fp{i}"] = "r0"
+            fleet.router._affinity["fp3"] = "r1"
+        assert fleet.router.affinity_counts() == {"r0": 3, "r1": 1}
+        assert scaler.tick() == "hold"
+        assert scaler.tick() == "down"
+        # the COLD replica went, not the affinity-hot one
+        assert fleet.replica_ranks() == [0, 1]
+        assert "r2" not in fleet.router.replica_summary()
+        kinds = [(e["kind"], e.get("rank")) for e in fleet.events]
+        assert kinds.index(("drain_mark", 2)) \
+            < kinds.index(("drain_sigterm", 2))
+        assert ("signal", 2, 15) in record          # SIGTERM, not kill
+        removed = [e for e in fleet.events
+                   if e["kind"] == "replica_removed"]
+        assert removed and removed[0]["rank"] == 2 \
+            and removed[0]["rc"] == 0               # clean drain exit
+        # next idle round retires r1 (warm beats hot)
+        assert scaler.tick() == "hold"
+        assert scaler.tick() == "down"
+        assert fleet.replica_ranks() == [0]
+        # min bound: idle forever, the last replica stays
+        assert scaler.tick() == "hold"
+        assert scaler.tick() == "hold"
+        assert fleet.replica_count() == 1
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("autoscaler.decisions{action=down}") == 2
+        assert snap.get("autoscaler.decisions{action=hold}", 0) >= 3
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------------
+# fleet dynamic membership (the ISSUE 14 satellite fix)
+# --------------------------------------------------------------------------
+
+def test_membership_changes_safe_against_monitor_and_stop(tmp_path):
+    fleet, _scaler, record = _scaled_fleet(
+        tmp_path, n=1, fleet_kw={"max_restarts": 3})
+    try:
+        rank = fleet.add_replica()
+        assert rank == 1
+        assert fleet.replica_ranks() == [0, 1]
+        spawned_before = [e for e in fleet.events
+                         if e["kind"] == "replica_spawned"
+                         and e["rank"] == 1]
+        assert len(spawned_before) == 1
+        # remove it: the monitor must NOT relaunch the retired rank
+        # even though max_restarts allows it
+        assert fleet.remove_replica(1) == 0
+        assert fleet.replica_ranks() == [0]
+        assert "r1" not in fleet.router.replica_summary()
+        # retired/unknown ranks are graceful no-ops, never KeyErrors
+        assert fleet.remove_replica(1) is None
+        assert fleet.drain_replica(1) is False
+        assert fleet.kill_replica(1) is False
+        time.sleep(0.3)  # several monitor sweeps
+        spawned_after = [e for e in fleet.events
+                         if e["kind"] == "replica_spawned"
+                         and e["rank"] == 1]
+        assert len(spawned_after) == 1  # no double-relaunch
+        # a replica added later is swept by stop() (no orphans)
+        rank2 = fleet.add_replica()
+        assert rank2 == 2
+    finally:
+        fleet.stop()
+    assert ("signal", 2, 15) in record  # stop() SIGTERMed the late add
+    assert all(e[0] != "kill" or e[1] != 2 for e in record)
+
+
+# --------------------------------------------------------------------------
+# router: fleet-level SLO feed + capacity gauges
+# --------------------------------------------------------------------------
+
+def _bare_router(replicas, **kw):
+    transport = _FakeTransport(
+        {f"fake://{rid}": rep for rid, rep in replicas.items()})
+    r = Router(replicas={rid: f"fake://{rid}" for rid in replicas},
+               transport=transport, **kw)
+    r.probe_once()
+    return r
+
+
+def test_router_slo_burns_on_sheds_not_client_errors():
+    r = _bare_router({"r0": _FakeReplica()})
+    try:
+        t0 = time.perf_counter()
+        r._finish_request("generate", "shed", None, t0)
+        r._finish_request("generate", "ok", None, t0)
+        r._finish_request("generate", "interrupted", None, t0)
+        r._finish_request("predict", "client_error", None, t0)
+        rep = r.slo.report(publish_gauges=False)
+        gen = rep["endpoints"]["generate"]
+        assert gen["requests"] == 3 and gen["errors"] == 2
+        assert gen["burn_rate"] > 100  # 2/3 error rate vs 0.1% budget
+        assert gen["errors_by_reason"] == {"shed:edge": 1,
+                                           "interrupted": 1}
+        # a misbehaving client buys itself nothing
+        assert rep["endpoints"]["predict"]["requests"] == 0
+        # the snapshot plane carries the ledger (ISSUE 14 satellite)
+        assert "slo" in r.telemetry_snapshot()
+    finally:
+        r._httpd.server_close()
+
+
+def test_router_capacity_gauges_track_routable_fleet():
+    r = _bare_router({"r0": _FakeReplica(limit=4,
+                                         engine={"max_slots": 2}),
+                      "r1": _FakeReplica(limit=3)})
+    try:
+        snap = metrics.snapshot()["gauges"]
+        assert snap.get("router.capacity{endpoint=predict}") == 7
+        assert snap.get("router.capacity{endpoint=generate}") == 2
+    finally:
+        r._httpd.server_close()
+
+
+def test_autoscaler_schema_zeros_present_in_snapshot():
+    snap = metrics.snapshot()
+    for action in ("up", "down", "hold"):
+        assert f"autoscaler.decisions{{action={action}}}" \
+            in snap["counters"]
+    for state in ("target", "actual"):
+        assert f"autoscaler.replicas{{state={state}}}" in snap["gauges"]
+    for ep in ("predict", "generate"):
+        assert f"router.capacity{{endpoint={ep}}}" in snap["gauges"]
+
+
+def test_autoscaler_gauges_ride_the_telemetry_rollup(tmp_path):
+    """The new gauges are first-class in the fleet aggregation plane
+    (ISSUE 14 satellite): a process dump rolls them up next to the
+    router's replica-state gauges."""
+    from paddle_tpu.observability.export import TelemetryExporter
+
+    metrics.set_gauge("autoscaler.replicas", 2, state="actual")
+    metrics.set_gauge("router.capacity", 8, endpoint="generate")
+    tel_dir = tmp_path / "tel"
+    tel_dir.mkdir()
+    TelemetryExporter(outdir=str(tel_dir),
+                      run_id="scaler").dump_once(reason="test")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_agg
+    finally:
+        sys.path.pop(0)
+    roll = telemetry_agg.rollup(telemetry_agg.load_dumps(str(tel_dir)))
+    keys = set(roll.get("gauges", {}))
+    assert any(k.startswith("autoscaler.replicas") for k in keys)
+    assert any(k.startswith("autoscaler.decisions")
+               for k in roll.get("counters", {}))
+    assert any(k.startswith("router.capacity") for k in keys)
+
+
+# --------------------------------------------------------------------------
+# the 10x surge (chaos tier)
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_surge_chaos_scenario():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check
+    finally:
+        sys.path.pop(0)
+    report = chaos_check.run_surge_chaos(seed=0)
+    assert report["recovered"], report

@@ -1,0 +1,193 @@
+"""tools/loadgen.py tests (ISSUE 14): the open-loop property (arrival
+times are a function of phases+seed only), schedule determinism,
+fingerprint parity with InferenceClient, misbehavior assignment,
+report accounting, and one small real-socket e2e against a toy
+InferenceServer (well-behaved + disconnecting + oversized clients,
+with token-replay verification active)."""
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.inference.fleet import EchoPredictor, ToyEngine, toy_token
+from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+try:
+    import loadgen
+finally:
+    sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    obs.attach(crash_hook=False)
+    yield
+    obs.detach()
+
+
+# --------------------------------------------------------------------------
+# the workload definition (transport-free)
+# --------------------------------------------------------------------------
+
+def test_arrivals_are_open_loop_and_deterministic():
+    wl = loadgen.SharedPrefixWorkload(seed=7, tenants=2)
+    phases = loadgen.surge_phases(base_rps=20.0, surge_mult=10.0,
+                                  warm_s=1.0, surge_s=1.0, cool_s=1.0)
+    a1 = list(loadgen.SharedPrefixWorkload(seed=7, tenants=2)
+              .arrivals(phases, random.Random(7)))
+    a2 = list(wl.arrivals(phases, random.Random(7)))
+    # same seed → identical schedule: times AND specs (minus the id
+    # counter, which is per-workload-instance)
+    assert [t for t, _ in a1] == [t for t, _ in a2]
+    assert [s["prompt"] for _, s in a1] == [s["prompt"] for _, s in a2]
+    # the 10x step is visible in the arrival density, phase by phase
+    warm = [t for t, _ in a1 if t < 1.0]
+    surge = [t for t, _ in a1 if 1.0 <= t < 2.0]
+    assert len(surge) > 4 * len(warm) > 0
+    # open loop: times are monotonically increasing offsets that never
+    # depend on anything but the schedule
+    assert all(b > a for a, b in zip([t for t, _ in a1],
+                                     [t for t, _ in a1][1:]))
+
+
+def test_diurnal_phases_swing_between_base_and_peak():
+    phases = loadgen.diurnal_phases(base_rps=4.0, peak_mult=3.0,
+                                    period_s=10.0, steps=10)
+    rates = [p.rps for p in phases]
+    assert len(phases) == 10
+    assert min(rates) == pytest.approx(4.0)
+    assert max(rates) == pytest.approx(12.0, rel=0.1)
+    assert sum(p.duration_s for p in phases) == pytest.approx(10.0)
+
+
+def test_shared_prefix_tenants_and_misbehavior_split():
+    wl = loadgen.SharedPrefixWorkload(
+        seed=0, tenants=3, system_prompt_tokens=16,
+        misbehave_disconnect=0.2, misbehave_ignore_retry=0.2,
+        misbehave_oversize=0.2)
+    rng = random.Random(0)
+    specs = [wl.sample(rng) for _ in range(400)]
+    by_behavior: dict = {}
+    for s in specs:
+        by_behavior[s["behavior"]] = by_behavior.get(s["behavior"], 0) + 1
+        # every prompt starts with its tenant's full shared prefix
+        assert s["prompt"][:16] == wl.tenant_prompts[s["tenant"]]
+    assert set(by_behavior) == {"well_behaved", "disconnect",
+                                "ignore_retry_after", "oversize"}
+    for k in ("disconnect", "ignore_retry_after", "oversize"):
+        assert 0.1 < by_behavior[k] / len(specs) < 0.3
+    # tenants sharing a prefix fingerprint alike → affinity exercised
+    fp = {t: loadgen.prefix_fingerprint(wl.tenant_prompts[t] + [1, 2])
+          for t in range(3)}
+    assert len(set(fp.values())) == 3
+
+
+def test_prefix_fingerprint_matches_inference_client():
+    ids = list(range(40))
+    assert loadgen.prefix_fingerprint(ids) == \
+        InferenceClient.prefix_fingerprint(np.asarray(ids, np.int64))
+    assert loadgen.prefix_fingerprint([1, 2, 3]) is None  # < 1 page
+
+
+def test_schedule_burst_fixed_count_spread():
+    wl = loadgen.SharedPrefixWorkload(seed=1)
+    sched = wl.schedule_burst(8, window_s=0.4)
+    assert len(sched) == 8
+    assert sched[0][0] == 0.0 and sched[-1][0] < 0.4
+
+
+def test_report_accounting():
+    rows = [
+        {"kind": "generate", "behavior": "well_behaved", "status": "ok",
+         "latency_s": 0.01 * (i + 1), "tokens": 5, "detail": None,
+         "id": i, "tenant": 0} for i in range(4)]
+    rows += [
+        {"kind": "generate", "behavior": "well_behaved",
+         "status": "replayed", "latency_s": 0.1, "tokens": 2,
+         "detail": "token 1 wrong", "id": 9, "tenant": 0},
+        {"kind": "predict", "behavior": "well_behaved", "status": "shed",
+         "latency_s": 0.1, "tokens": 0, "detail": None, "id": 10,
+         "tenant": 0},
+        {"kind": "generate", "behavior": "disconnect",
+         "status": "abandoned", "latency_s": 0.05, "tokens": 1,
+         "detail": None, "id": 11, "tenant": 1},
+    ]
+    s = loadgen.LoadReport(rows, wall_s=2.0).summary()
+    assert s["requests"] == 7 and s["ok"] == 4 and s["shed"] == 1
+    assert s["replayed"] == 1 and s["abandoned"] == 1
+    assert s["admitted_failures"] == 1           # only the replay
+    assert s["tokens"] == 4 * 5 + 2 + 1
+    assert s["tokens_per_sec"] == pytest.approx(23 / 2.0)
+    assert s["latency_ms"]["generate"]["n"] == 4  # ok rows only
+    assert "generate:replayed:token 1 wrong" in s["failure_detail"]
+
+
+# --------------------------------------------------------------------------
+# e2e against a real toy server (sockets, no jax)
+# --------------------------------------------------------------------------
+
+def test_open_loop_runner_e2e_toy_server():
+    srv = InferenceServer(predictor=EchoPredictor(),
+                          engine=ToyEngine(max_slots=4,
+                                           token_time=0.005),
+                          request_timeout=20.0).start()
+    try:
+        wl = loadgen.SharedPrefixWorkload(
+            seed=3, tenants=2, generate_frac=0.6, max_new_tokens=6)
+        runner = loadgen.OpenLoopRunner(
+            srv.address, wl, seed=3, expected_token=toy_token,
+            timeout=20.0)
+        report = runner.run(schedule=wl.schedule_burst(10,
+                                                       window_s=0.2))
+        s = report.summary()
+        assert s["requests"] == 10
+        assert s["admitted_failures"] == 0, s["failure_detail"]
+        assert s["ok"] == 10                 # all well-behaved, verified
+        assert s["tokens"] > 0 and "generate" in s["latency_ms"]
+
+        # misbehaving clients: the deliberate disconnect is abandoned
+        # (and verified up to the cut), the oversized body 400s — and
+        # neither counts as a fleet failure
+        bad = loadgen.SharedPrefixWorkload(
+            seed=4, tenants=2, generate_frac=1.0, max_new_tokens=6,
+            misbehave_disconnect=1.0)
+        r2 = loadgen.OpenLoopRunner(
+            srv.address, bad, seed=4, expected_token=toy_token,
+            timeout=20.0)
+        s2 = r2.run(schedule=bad.schedule_burst(3, 0.1)).summary()
+        assert s2["abandoned"] == 3 and s2["admitted_failures"] == 0
+
+        ugly = loadgen.SharedPrefixWorkload(
+            seed=5, tenants=2, misbehave_oversize=1.0)
+        r3 = loadgen.OpenLoopRunner(
+            srv.address, ugly, seed=5, timeout=20.0,
+            oversize_bytes=64 * 1024)
+        s3 = r3.run(schedule=ugly.schedule_burst(2, 0.1)).summary()
+        assert s3["client_errors"] == 2 and s3["admitted_failures"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_replay_detector_catches_a_wrong_token():
+    srv = InferenceServer(engine=ToyEngine(max_slots=2,
+                                           token_time=0.005),
+                          request_timeout=20.0).start()
+    try:
+        wl = loadgen.SharedPrefixWorkload(seed=6, generate_frac=1.0,
+                                          max_new_tokens=4)
+
+        def wrong(prompt, i):  # an expectation the server can't meet
+            return toy_token(prompt, i) + (1 if i == 2 else 0)
+
+        runner = loadgen.OpenLoopRunner(srv.address, wl, seed=6,
+                                        expected_token=wrong,
+                                        timeout=20.0)
+        s = runner.run(schedule=wl.schedule_burst(2, 0.05)).summary()
+        assert s["replayed"] == 2 and s["admitted_failures"] == 2
+    finally:
+        srv.shutdown()

@@ -133,8 +133,13 @@ def test_span_nesting_under_threads():
     assert evts["t0.outer"]["tid"] != evts["t1.outer"]["tid"]
     assert evts["t0.inner"]["args"]["parent"] == "t0.outer"
     assert evts["t1.inner"]["args"]["parent"] == "t1.outer"
-    # tids are small and stable, not raw thread idents
-    assert all(e["tid"] < 100 for e in evts.values())
+    # tids are small and stable, not raw thread idents (~1e14): the
+    # GLOBAL tracer numbers every span-emitting thread the test
+    # session ever had, so the bound is the design constraint — real
+    # threads must sort BELOW the synthetic-track base — not an
+    # arbitrary small count that suite growth can tip over
+    assert all(e["tid"] < trace._VIRTUAL_SORT_BASE
+               for e in evts.values())
 
 
 def test_bounded_buffer_reports_drops():

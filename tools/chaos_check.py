@@ -36,6 +36,14 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
     header through a 2-replica router degrades to at worst a cache
     miss — never a wrong-token stream (the radix index matches real
     token values; the fingerprint is routing metadata only).
+  * `surge`: a 10× OPEN-LOOP traffic step (tools/loadgen.py: Poisson
+    arrivals, shared-prefix tenants, misbehaving clients) against a
+    1-replica toy fleet with the SLO-driven `Autoscaler` attached —
+    the surge must be absorbed with ZERO admitted-request failures
+    and bounded p99, ≥1 scale-up must land mid-surge, and the
+    ramp-down must drain replicas back to min size strictly through
+    the zero-loss protocol (drain_mark before drain_sigterm, exit 0,
+    zero replayed tokens — position-dependent toy tokens assert it).
   * `fleet`: a 3-replica `ReplicaFleet` behind the admission-aware
     `Router` under a concurrent mixed /predict + /generate burst;
     one replica is killed -9 and another SIGTERM-drained MID-BURST.
@@ -923,11 +931,162 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     return report
 
 
+def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
+                    surge_s=10.0, cool_s=6.0, max_replicas=3,
+                    p99_bound_ms=15000.0):
+    """Surge chaos (ISSUE 14): a 10× OPEN-LOOP traffic step against an
+    autoscaled 1-replica toy fleet.  The loadgen workload is
+    shared-prefix tenants, mixed predict/generate, with misbehaving
+    clients (mid-stream disconnects, Retry-After ignorers, oversized
+    bodies) riding along.  `recovered` means: ZERO admitted-request
+    failures (sheds are polite, never failures; every delivered stream
+    an exact prefix of the deterministic toy sequence — one replayed
+    token anywhere fails the run), bounded ok-request p99, ≥1 scale-up
+    observed mid-surge, and the ramp-down drains replicas strictly
+    through the zero-loss protocol (drain_mark before drain_sigterm,
+    exit 0) back to min size — with the autoscaler/capacity telemetry
+    visible on the router's /debug/telemetry plane."""
+    import time as _time
+    import urllib.request as _urlreq
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.autoscaler import Autoscaler
+    from paddle_tpu.inference.fleet import ReplicaFleet, toy_token
+    from paddle_tpu.observability import metrics
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)  # re-declare the schema post-reset
+    # a short SLO window so the scenario's ramp-down is observable in
+    # seconds: with the 5-minute default the surge's (correct, polite)
+    # sheds would keep the burn rate above the scale-down bar long
+    # after the traffic left — production wants exactly that caution,
+    # a chaos run wants to SEE the drain
+    prev_window = os.environ.get("PADDLE_TPU_SLO_WINDOW")
+    os.environ["PADDLE_TPU_SLO_WINDOW"] = "10.0"
+    fleet = scaler = None
+    try:
+        fleet = ReplicaFleet(num_replicas=1, kind="toy",
+                             token_time=0.02, service_time=0.02,
+                             max_slots=4, launch_timeout=60,
+                             monitor_interval=0.1)
+        fleet.start()
+        scaler = Autoscaler(
+            fleet, min_replicas=1, max_replicas=max_replicas,
+            burn_up=2.0, occ_up=0.7, occ_down=0.15,
+            up_sustain=2, down_sustain=8, cooldown_s=2.0,
+            interval=0.2, drain_grace=5.0)
+        scaler.start()
+        workload = loadgen.SharedPrefixWorkload(
+            seed=seed, tenants=3, system_prompt_tokens=16,
+            suffix_tokens=(3, 6), generate_frac=0.7,
+            max_new_tokens=20, misbehave_disconnect=0.04,
+            misbehave_ignore_retry=0.04, misbehave_oversize=0.02)
+        phases = loadgen.surge_phases(
+            base_rps=base_rps, surge_mult=surge_mult, warm_s=warm_s,
+            surge_s=surge_s, cool_s=cool_s)
+        runner = loadgen.OpenLoopRunner(
+            fleet.router.address, workload, phases, seed=seed,
+            expected_token=toy_token, timeout=30.0, max_retries=2)
+        load_report = runner.run()
+        # ramp-down: idle traffic → the autoscaler must drain back to
+        # min size through the zero-loss protocol, on its own
+        deadline = _time.monotonic() + 45.0
+        while _time.monotonic() < deadline and \
+                fleet.replica_count() > 1:
+            _time.sleep(0.2)
+        returned_to_min = fleet.replica_count() == 1
+        # the telemetry plane (ISSUE 14 satellite): autoscaler +
+        # capacity gauges must be visible on /debug/telemetry
+        with _urlreq.urlopen(fleet.router.address + "/debug/telemetry",
+                             timeout=10) as r:
+            debug_snap = json.loads(r.read())
+        scaler.stop()
+        snap = metrics.snapshot()
+    finally:
+        if prev_window is None:
+            os.environ.pop("PADDLE_TPU_SLO_WINDOW", None)
+        else:
+            os.environ["PADDLE_TPU_SLO_WINDOW"] = prev_window
+        if scaler is not None:
+            scaler.stop()
+        if fleet is not None:
+            fleet.stop()
+        obs.detach()
+
+    s = load_report.summary()
+    counters, gauges = snap["counters"], snap["gauges"]
+    scale_ups = [e for e in scaler.events if e["kind"] == "scale_up"]
+    scale_downs = [e for e in scaler.events
+                   if e["kind"] == "scale_down"]
+    # every removed rank drained in the load-bearing order: rotation
+    # out (drain_mark) strictly before SIGTERM, exit 0 — the zero-loss
+    # contract the autoscaler must never violate
+    kinds = [(e["kind"], e.get("rank")) for e in fleet.events]
+    removed = [e for e in fleet.events if e["kind"] == "replica_removed"]
+    drain_order_ok = bool(removed) and all(
+        ("drain_mark", e["rank"]) in kinds
+        and ("drain_sigterm", e["rank"]) in kinds
+        and kinds.index(("drain_mark", e["rank"]))
+        < kinds.index(("drain_sigterm", e["rank"]))
+        and e.get("rc") == 0
+        for e in removed)
+    gen_p99 = (s["latency_ms"].get("generate") or {}).get("p99")
+    debug_gauges = debug_snap.get("metrics", {}).get("gauges", {})
+    telemetry_ok = (
+        "autoscaler.replicas{state=actual}" in debug_gauges
+        and "router.capacity{endpoint=generate}" in debug_gauges
+        and "slo" in debug_snap)
+    report = {
+        "scenario": "surge",
+        "phases": [f"{p.name}:{p.duration_s}s@{p.rps}rps"
+                   for p in phases],
+        "requests": s["requests"],
+        "ok": s["ok"],
+        "shed": s["shed"],
+        "interrupted": s["interrupted"],
+        "abandoned": s["abandoned"],
+        "client_errors": s["client_errors"],
+        "replayed": s["replayed"],
+        "admitted_failures": s["admitted_failures"],
+        "failure_detail": s["failure_detail"],
+        "tokens": s["tokens"],
+        "latency_ms": s["latency_ms"],
+        "scale_ups": len(scale_ups),
+        "scale_downs": len(scale_downs),
+        "peak_replicas": scaler.peak_replicas,
+        "returned_to_min": bool(returned_to_min),
+        "drain_order_ok": bool(drain_order_ok),
+        "decisions": {a: counters.get(
+            f"autoscaler.decisions{{action={a}}}", 0)
+            for a in ("up", "down", "hold")},
+        "telemetry_ok": bool(telemetry_ok),
+        "recovered": (
+            s["admitted_failures"] == 0 and s["replayed"] == 0
+            and s["ok"] > 0 and s["shed"] + s["ok"] > 0
+            and len(scale_ups) >= 1 and scaler.peak_replicas >= 2
+            and gen_p99 is not None and gen_p99 <= p99_bound_ms
+            and len(scale_downs) >= 1 and bool(returned_to_min)
+            and bool(drain_order_ok)
+            and counters.get("autoscaler.decisions{action=up}", 0) >= 1
+            and counters.get("autoscaler.decisions{action=down}", 0) >= 1
+            and gauges.get("autoscaler.replicas{state=actual}") == 1
+            and bool(telemetry_ok)),
+    }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario",
                     choices=("train", "overload", "preemption", "engine",
-                             "fleet", "prefix"),
+                             "fleet", "prefix", "surge"),
                     default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
@@ -948,6 +1107,8 @@ def main(argv=None):
                                    and q["recovered"])
     elif args.scenario == "fleet":
         report = run_fleet_chaos(seed=args.seed)
+    elif args.scenario == "surge":
+        report = run_surge_chaos(seed=args.seed)
     elif args.scenario == "prefix":
         report = run_prefix_chaos(seed=args.seed)
     elif args.scenario == "preemption":

@@ -1,0 +1,574 @@
+#!/usr/bin/env python
+"""Open-loop traffic generator: the adversary an autoscaler must survive.
+
+Every hand-rolled burst loop in bench/chaos before this was CLOSED
+loop: N threads each fire, wait for the response, fire again — so the
+moment the server saturates, the *offered load falls to match* and the
+overload the test meant to produce quietly disappears.  An autoscaler
+tested that way passes while idle capacity burns and real surges shed
+forever.  This generator is OPEN loop (ISSUE 14): arrivals follow a
+Poisson process whose rate is a function of time ONLY — a saturated
+server slows completions, never arrivals — which is the only honest
+way to produce the failure modes elasticity must absorb.
+
+Pieces (all importable — tests, bench, and chaos share ONE workload
+definition instead of three burst loops):
+
+  * `Phase(name, duration_s, rps)` — a flat-rate segment.
+    `surge_phases()` builds the warm → 10× step → cool-down shape the
+    surge chaos scenario gates on; `diurnal_phases()` builds a
+    sampled sinusoid (the boring-day shape).
+  * `SharedPrefixWorkload` — a seeded tenant population: each tenant
+    owns a page-aligned shared system prompt (exercises the prefix
+    cache + affinity routing of ISSUE 13 under churn), requests are a
+    predict/generate mix, and a configurable fraction MISBEHAVE:
+    disconnect mid-stream, ignore Retry-After (hammer straight back),
+    or send oversized garbage bodies.  `arrivals(phases, rng)` yields
+    the open-loop Poisson schedule; `schedule_burst(n, window_s)`
+    yields a fixed-count arrival spread for capacity benches.
+  * `OpenLoopRunner` — fires a schedule at an address (router or bare
+    replica), one thread per arrival AT its arrival time, well-behaved
+    clients honoring Retry-After with bounded retries; classifies
+    every outcome, and — given `expected_token` (e.g. the fleet's
+    deterministic `toy_token`) — verifies each delivered stream is an
+    EXACT PREFIX of the true sequence, so one replayed or skipped
+    token during a drain/failover is caught as `replayed`.
+  * `LoadReport.summary()` — counts by kind/status, latency
+    percentiles, tokens/s, and `admitted_failures` (errors + corrupt
+    responses + replays; sheds and deliberate client misbehavior are
+    NOT failures — shedding politely is correct behavior).
+
+The client side is stdlib-only (http.client + json); numpy is imported
+lazily only to build/parse /predict npz bodies, and nothing here
+imports paddle_tpu — the generator drives a fleet from outside, like
+traffic does.
+
+Usage:
+  python tools/loadgen.py http://127.0.0.1:8866 \
+      [--base-rps 5] [--surge-mult 10] [--warm-s 3] [--surge-s 10]
+      [--cool-s 6] [--diurnal] [--seed 0] [--generate-frac 0.7]
+      [--tenants 4] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import http.client
+import json
+import math
+import random
+import struct
+import threading
+import time
+import urllib.parse
+
+__all__ = ["Phase", "surge_phases", "diurnal_phases",
+           "SharedPrefixWorkload", "OpenLoopRunner", "LoadReport",
+           "prefix_fingerprint"]
+
+
+class Phase:
+    """One flat-rate segment of the arrival schedule."""
+
+    __slots__ = ("name", "duration_s", "rps")
+
+    def __init__(self, name, duration_s, rps):
+        self.name = str(name)
+        self.duration_s = float(duration_s)
+        self.rps = float(rps)
+
+    def __repr__(self):
+        return f"Phase({self.name!r}, {self.duration_s}s, {self.rps}rps)"
+
+
+def surge_phases(base_rps=5.0, surge_mult=10.0, warm_s=3.0,
+                 surge_s=10.0, cool_s=6.0, cool_rps=None):
+    """warm → STEP to surge_mult× → cool: the shape
+    `chaos_check --scenario surge` gates on.  The step is deliberately
+    instantaneous (no ramp): a ramp gives the autoscaler early warning
+    a real traffic step does not."""
+    if cool_rps is None:
+        cool_rps = base_rps / 2.0
+    return [Phase("warm", warm_s, base_rps),
+            Phase("surge", surge_s, base_rps * surge_mult),
+            Phase("cool", cool_s, cool_rps)]
+
+
+def diurnal_phases(base_rps=4.0, peak_mult=2.5, period_s=20.0,
+                   steps=10):
+    """A sampled sinusoid over one period: rate swings between
+    base_rps and base_rps*peak_mult — the boring-day shape that a
+    scale-down path has to ride without flapping."""
+    out = []
+    for i in range(int(steps)):
+        frac = 0.5 - 0.5 * math.cos(2.0 * math.pi * i / steps)
+        rate = base_rps * (1.0 + (peak_mult - 1.0) * frac)
+        out.append(Phase(f"diurnal{i}", period_s / steps, rate))
+    return out
+
+
+def prefix_fingerprint(ids, tokens=64, granule=16):
+    """stdlib twin of `InferenceClient.prefix_fingerprint` (same sha1
+    over little-endian int64 tokens, same page-granule floor), so
+    loadgen traffic exercises the router's prefix-affinity path exactly
+    as real clients do.  Returns None for prompts too short to share a
+    page."""
+    ids = [int(x) for x in ids]
+    n = min(int(tokens), (len(ids) // int(granule)) * int(granule))
+    if n <= 0:
+        return None
+    return hashlib.sha1(
+        struct.pack(f"<{n}q", *ids[:n])).hexdigest()[:16]
+
+
+class SharedPrefixWorkload:
+    """Seeded request population over shared-prefix tenants.
+
+    Each tenant owns a `system_prompt_tokens`-long shared prefix
+    (page-aligned by construction when the engine page size divides
+    it); every request appends a unique suffix — the PR 13 cache gets
+    real hits and the router's affinity map gets real tenants.
+    `generate_frac` of requests stream /generate, the rest are
+    /predict echoes.  Misbehavior fractions are cumulative slices of
+    [0,1): a request is assigned exactly one behavior."""
+
+    def __init__(self, seed=0, tenants=4, system_prompt_tokens=16,
+                 suffix_tokens=(3, 8), vocab=200, generate_frac=0.75,
+                 max_new_tokens=12, predict_shape=(2, 2),
+                 misbehave_disconnect=0.0, misbehave_ignore_retry=0.0,
+                 misbehave_oversize=0.0):
+        self.seed = int(seed)
+        self.vocab = int(vocab)
+        self.generate_frac = float(generate_frac)
+        self.max_new_tokens = int(max_new_tokens)
+        self.predict_shape = tuple(predict_shape)
+        self.suffix_tokens = (int(suffix_tokens[0]),
+                              int(suffix_tokens[1]))
+        self.misbehave_disconnect = float(misbehave_disconnect)
+        self.misbehave_ignore_retry = float(misbehave_ignore_retry)
+        self.misbehave_oversize = float(misbehave_oversize)
+        rng = random.Random(self.seed)
+        self.tenant_prompts = [
+            [rng.randrange(self.vocab)
+             for _ in range(int(system_prompt_tokens))]
+            for _ in range(int(tenants))]
+        self._counter = 0
+
+    def sample(self, rng):
+        """One request spec (plain dict — JSON-able, transport-free)."""
+        self._counter += 1
+        r = rng.random()
+        behavior = "well_behaved"
+        edge = self.misbehave_disconnect
+        if r < edge:
+            behavior = "disconnect"
+        elif r < (edge := edge + self.misbehave_ignore_retry):
+            behavior = "ignore_retry_after"
+        elif r < edge + self.misbehave_oversize:
+            behavior = "oversize"
+        kind = ("generate" if rng.random() < self.generate_frac
+                else "predict")
+        tenant = rng.randrange(len(self.tenant_prompts))
+        suffix = [rng.randrange(self.vocab) for _ in range(
+            rng.randint(*self.suffix_tokens))]
+        return {
+            "id": self._counter,
+            "kind": kind,
+            "behavior": behavior,
+            "tenant": tenant,
+            "prompt": list(self.tenant_prompts[tenant]) + suffix,
+            "max_new_tokens": self.max_new_tokens,
+            "value": float(self._counter % 97),
+            "shape": self.predict_shape,
+        }
+
+    def arrivals(self, phases, rng=None):
+        """The open-loop Poisson schedule: yields (t_offset_s, spec)
+        with exponential inter-arrival times at each phase's rate.
+        Arrival times are a function of the phases and the seed ONLY —
+        never of how the server is coping."""
+        rng = rng or random.Random(self.seed)
+        base = 0.0
+        for ph in phases:
+            end = base + ph.duration_s
+            if ph.rps <= 0.0:
+                base = end
+                continue
+            t = base
+            while True:
+                t += rng.expovariate(ph.rps)
+                if t >= end:
+                    break
+                yield t, self.sample(rng)
+            base = end
+
+    def schedule_burst(self, n, window_s=0.25, rng=None):
+        """Fixed-count arrival spread: `n` requests uniformly inside
+        `window_s` — the capacity-bench shape (deterministic request
+        COUNT, still open-loop: the spread never waits on completions)."""
+        rng = rng or random.Random(self.seed)
+        return [(i * window_s / max(1, n), self.sample(rng))
+                for i in range(int(n))]
+
+
+class LoadReport:
+    """Everything the runner observed, with the accounting the chaos
+    gate and the bench both read."""
+
+    def __init__(self, rows, wall_s):
+        self.rows = list(rows)
+        self.wall_s = float(wall_s)
+
+    _FAILURES = ("error", "corrupt", "replayed")
+
+    def summary(self):
+        by_kind: dict = {}
+        status: dict = {}
+        lat: dict = {"predict": [], "generate": []}
+        tokens = 0
+        for row in self.rows:
+            k, s = row["kind"], row["status"]
+            by_kind.setdefault(k, {}).setdefault(s, 0)
+            by_kind[k][s] += 1
+            status[s] = status.get(s, 0) + 1
+            tokens += row.get("tokens", 0) or 0
+            if s == "ok" and row.get("latency_s") is not None:
+                lat.setdefault(k, []).append(row["latency_s"] * 1e3)
+        latency = {}
+        for k, vals in lat.items():
+            if vals:
+                vals.sort()
+                latency[k] = {
+                    "p50": round(_quantile(vals, 0.50), 2),
+                    "p95": round(_quantile(vals, 0.95), 2),
+                    "p99": round(_quantile(vals, 0.99), 2),
+                    "max": round(vals[-1], 2), "n": len(vals)}
+        return {
+            "requests": len(self.rows),
+            "wall_s": round(self.wall_s, 3),
+            "by_kind": by_kind,
+            "status": status,
+            "ok": status.get("ok", 0),
+            "shed": status.get("shed", 0),
+            "interrupted": status.get("interrupted", 0),
+            "abandoned": status.get("abandoned", 0),
+            "client_errors": status.get("client_error", 0),
+            "replayed": status.get("replayed", 0),
+            "admitted_failures": sum(status.get(s, 0)
+                                     for s in self._FAILURES),
+            "failure_detail": sorted(
+                {f"{r['kind']}:{r['status']}:{r.get('detail')}"
+                 for r in self.rows if r["status"] in self._FAILURES}),
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / self.wall_s, 1)
+            if self.wall_s > 0 else 0.0,
+            "latency_ms": latency,
+        }
+
+
+def _quantile(sorted_vals, q):
+    n = len(sorted_vals)
+    pos = q * (n - 1)
+    i, frac = int(pos), pos - int(pos)
+    if frac == 0.0 or i + 1 >= n:
+        return float(sorted_vals[min(i, n - 1)])
+    return float(sorted_vals[i]) + frac * (
+        float(sorted_vals[i + 1]) - float(sorted_vals[i]))
+
+
+class OpenLoopRunner:
+    """Fire a schedule at `address`, one thread per arrival at its
+    scheduled time.  Well-behaved clients retry 429/503 up to
+    `max_retries` times honoring (a clamped) Retry-After;
+    `ignore_retry_after` clients retry instantly — the misbehavior the
+    edge admission has to absorb.  `expected_token(prompt, i)`
+    (optional) turns every stream into a replay detector."""
+
+    def __init__(self, address, workload, phases=None, seed=None,
+                 expected_token=None, timeout=30.0, max_retries=2,
+                 max_retry_wait=2.0, oversize_bytes=1 << 20):
+        u = urllib.parse.urlparse(address if "//" in address
+                                  else "http://" + address)
+        self.host, self.port = u.hostname, u.port
+        self.workload = workload
+        self.phases = phases
+        self.seed = workload.seed if seed is None else int(seed)
+        self.expected_token = expected_token
+        self.timeout = float(timeout)
+        self.max_retries = max(0, int(max_retries))
+        self.max_retry_wait = float(max_retry_wait)
+        self.oversize_bytes = int(oversize_bytes)
+        self._rows = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def run(self, schedule=None):
+        """Execute the schedule (default: the workload's Poisson
+        arrivals over `phases`).  Returns a LoadReport once every fired
+        request resolved (bounded by per-request timeouts)."""
+        if schedule is None:
+            rng = random.Random(self.seed)
+            schedule = list(self.workload.arrivals(self.phases, rng))
+        with self._lock:
+            self._rows = []
+        threads = []
+        t0 = time.monotonic()
+        for t_at, spec in schedule:
+            delay = (t0 + t_at) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=self._fire, args=(spec,),
+                                  daemon=True,
+                                  name=f"loadgen-{spec['id']}")
+            th.start()
+            threads.append(th)
+        # every request resolves within timeout + retries; the join
+        # budget covers the worst chain with slack
+        deadline = time.monotonic() + self.timeout * (
+            self.max_retries + 1) + self.max_retry_wait * (
+            self.max_retries + 1) + 30.0
+        for th in threads:
+            th.join(timeout=max(0.1, deadline - time.monotonic()))
+        wall = time.monotonic() - t0
+        with self._lock:
+            rows = list(self._rows)
+        return LoadReport(rows, wall)
+
+    # ------------------------------------------------------------------
+    def _record(self, spec, status, latency_s=None, tokens=0,
+                detail=None):
+        with self._lock:
+            self._rows.append({
+                "id": spec["id"], "kind": spec["kind"],
+                "behavior": spec["behavior"], "tenant": spec["tenant"],
+                "status": status, "latency_s": latency_s,
+                "tokens": tokens, "detail": detail})
+
+    def _fire(self, spec):
+        t0 = time.monotonic()
+        try:
+            if spec["behavior"] == "oversize":
+                status, tokens, detail = self._oversize(spec), 0, None
+            elif spec["kind"] == "generate":
+                status, tokens, detail = self._generate(spec)
+            else:
+                status, detail = self._predict(spec)
+                tokens = 0
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            status, tokens = "error", 0
+            detail = f"{type(e).__name__}: {e}"
+        self._record(spec, status, latency_s=time.monotonic() - t0,
+                     tokens=tokens, detail=detail)
+
+    def _retry_wait(self, headers):
+        """Defensive Retry-After parse, clamped into
+        [0.05, max_retry_wait] (same discipline as InferenceClient)."""
+        try:
+            ra = float(headers.get("Retry-After", 0.5))
+        except (TypeError, ValueError):
+            ra = 0.5
+        if not math.isfinite(ra):
+            ra = 0.5
+        return min(max(ra, 0.05), self.max_retry_wait)
+
+    def _connect(self):
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+
+    # --- /generate (ndjson stream, stdlib parse) ----------------------
+    def _generate(self, spec):
+        body = json.dumps({
+            "input_ids": spec["prompt"],
+            "max_new_tokens": spec["max_new_tokens"]}).encode()
+        headers = {"Content-Type": "application/json"}
+        fp = prefix_fingerprint(spec["prompt"])
+        if fp is not None:
+            headers["X-Prefix-Fingerprint"] = fp
+        attempts = self.max_retries + 1
+        last = ("error", 0, "no attempt ran")
+        for attempt in range(attempts):
+            conn = self._connect()
+            try:
+                conn.request("POST", "/generate", body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                if resp.status in (429, 503):
+                    wait = self._retry_wait(dict(resp.headers))
+                    resp.read()
+                    last = ("shed", 0, f"http {resp.status}")
+                    if attempt < attempts - 1:
+                        if spec["behavior"] != "ignore_retry_after":
+                            time.sleep(wait)
+                        continue
+                    return last
+                if resp.status != 200:
+                    return (("client_error" if resp.status == 400
+                             else "error"), 0, f"http {resp.status}")
+                return self._consume_stream(spec, resp, conn)
+            except OSError as e:
+                last = ("error", 0, f"{type(e).__name__}: {e}")
+            finally:
+                conn.close()
+        return last
+
+    def _consume_stream(self, spec, resp, conn):
+        """Read the ndjson stream; verify tokens against
+        `expected_token` as they arrive.  Disconnect clients bail after
+        the first token — the server must notice the dead socket and
+        cancel the sequence (its pages return to the pool)."""
+        prompt, tokens = spec["prompt"], []
+        for line in resp:
+            line = line.strip()
+            if not line:
+                continue
+            evt = json.loads(line)
+            if "token" in evt:
+                tok = int(evt["token"])
+                tokens.append(tok)
+                # incremental: each token is checked ONCE as it
+                # arrives (earlier ones already passed), so a stream
+                # costs O(n) expected_token calls, not O(n^2)
+                if self.expected_token is not None and \
+                        tok != self.expected_token(prompt,
+                                                   len(tokens) - 1):
+                    return "replayed", len(tokens), \
+                        f"token {len(tokens) - 1} wrong"
+                if spec["behavior"] == "disconnect":
+                    conn.close()   # die mid-stream, deliberately
+                    return "abandoned", len(tokens), None
+            elif evt.get("interrupted"):
+                # the clean mid-stream cut: every delivered token
+                # already verified above; the record must carry the
+                # resumable prefix exactly
+                prefix_ok = list(evt.get("output_ids") or []) \
+                    == list(prompt) + tokens
+                return (("interrupted" if prefix_ok else "replayed"),
+                        len(tokens),
+                        None if prefix_ok else "bad resumable prefix")
+            elif evt.get("done"):
+                out_ok = list(evt.get("output_ids") or []) \
+                    == list(prompt) + tokens
+                return (("ok" if out_ok else "replayed"), len(tokens),
+                        None if out_ok else "final record mismatch")
+        return "error", len(tokens), "stream ended without final record"
+
+    # --- /predict (npz body; numpy is the one lazy non-stdlib need) ---
+    def _predict(self, spec):
+        import io
+
+        import numpy as np  # lazy: only the npz codec needs it
+
+        x = np.full(spec["shape"], spec["value"], np.float32)
+        buf = io.BytesIO()
+        np.savez(buf, x=x)
+        data = buf.getvalue()
+        attempts = self.max_retries + 1
+        last = ("error", "no attempt ran")
+        for attempt in range(attempts):
+            conn = self._connect()
+            try:
+                conn.request(
+                    "POST", "/predict", body=data,
+                    headers={"Content-Type":
+                             "application/octet-stream"})
+                resp = conn.getresponse()
+                if resp.status in (429, 503):
+                    wait = self._retry_wait(dict(resp.headers))
+                    resp.read()
+                    last = ("shed", f"http {resp.status}")
+                    if attempt < attempts - 1:
+                        if spec["behavior"] != "ignore_retry_after":
+                            time.sleep(wait)
+                        continue
+                    return last
+                if resp.status != 200:
+                    return (("client_error" if resp.status == 400
+                             else "error"), f"http {resp.status}")
+                payload = resp.read()
+                with np.load(io.BytesIO(payload)) as z:
+                    y = z[z.files[0]]
+                if np.array_equal(y, x):
+                    return "ok", None
+                return "corrupt", "echo mismatch"
+            except OSError as e:
+                last = ("error", f"{type(e).__name__}: {e}")
+            finally:
+                conn.close()
+        return last
+
+    # --- deliberate garbage -------------------------------------------
+    def _oversize(self, spec):
+        """A deliberately oversized non-JSON body: the fleet must
+        answer a deterministic 400 (client_error), never crash a
+        replica or burn error budget for it."""
+        conn = self._connect()
+        try:
+            conn.request("POST", "/generate",
+                         body=b"\xff" * self.oversize_bytes,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            return "client_error" if resp.status == 400 \
+                else ("shed" if resp.status in (429, 503) else "error")
+        except OSError:
+            # the server refusing to swallow a megabyte of garbage
+            # (connection torn mid-send) is the garbage-sender's
+            # problem — deliberate misbehavior never counts as a
+            # fleet failure
+            return "client_error"
+        finally:
+            conn.close()
+
+
+# ----------------------------------------------------------------------
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("target", help="router or replica address "
+                                   "(http://host:port)")
+    ap.add_argument("--base-rps", type=float, default=5.0)
+    ap.add_argument("--surge-mult", type=float, default=10.0)
+    ap.add_argument("--warm-s", type=float, default=3.0)
+    ap.add_argument("--surge-s", type=float, default=10.0)
+    ap.add_argument("--cool-s", type=float, default=6.0)
+    ap.add_argument("--diurnal", action="store_true",
+                    help="sampled sinusoid instead of the surge step")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--generate-frac", type=float, default=0.7)
+    ap.add_argument("--max-new-tokens", type=int, default=12)
+    ap.add_argument("--misbehave", type=float, default=0.05,
+                    help="total misbehaving-client fraction, split "
+                         "across disconnect/ignore-retry/oversize")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    third = args.misbehave / 3.0
+    wl = SharedPrefixWorkload(
+        seed=args.seed, tenants=args.tenants,
+        generate_frac=args.generate_frac,
+        max_new_tokens=args.max_new_tokens,
+        misbehave_disconnect=third, misbehave_ignore_retry=third,
+        misbehave_oversize=third)
+    phases = (diurnal_phases(args.base_rps,
+                             period_s=args.warm_s + args.surge_s
+                             + args.cool_s)
+              if args.diurnal else
+              surge_phases(args.base_rps, args.surge_mult,
+                           args.warm_s, args.surge_s, args.cool_s))
+    runner = OpenLoopRunner(args.target, wl, phases, seed=args.seed,
+                            timeout=args.timeout)
+    report = runner.run()
+    s = report.summary()
+    if args.json:
+        print(json.dumps(s, indent=2))
+    else:
+        for k, v in s.items():
+            print(f"{k:>20}: {v}")
+    return 0 if s["admitted_failures"] == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
