@@ -911,6 +911,120 @@ def _bench_multichip_sharded(degraded: bool) -> dict | None:
     return row
 
 
+def _bench_telemetry_overhead(degraded: bool) -> dict:
+    """Telemetry-overhead honesty row (ISSUE 15): decode tokens/s with
+    the FULL observability plane on (metrics registry + schema, flight,
+    timeseries sampler at a fast interval, per-request timelines) vs
+    the same engine shape with `PADDLE_TPU_METRICS=off` semantics
+    (registry disabled, timelines off) — measured SAME-RUN on the same
+    model and prompts.  Value = (off - on)/off, LOWER better, ~0 when
+    the plane is free.  The observability stack must prove it is not
+    the perf regression; this row makes a telemetry-induced decode tax
+    fail `perf_gate` like any other regression."""
+    import jax
+
+    import paddle_tpu as P
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.observability import timeseries as _tsmod
+
+    on_tpu = jax.devices()[0].platform in _ACCEL_PLATFORMS
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=512)
+        n_clients, new_tokens = 8, 64
+        ecfg_kw = dict(page_size=32, max_slots=8, decode_chunk=8,
+                       max_seq_len=512, prefix_cache=False)
+    else:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128)
+        n_clients, new_tokens = 6, 24
+        ecfg_kw = dict(page_size=8, max_slots=4, decode_chunk=4,
+                       max_seq_len=128, prefix_cache=False)
+    P.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+               for _ in range(n_clients)]
+
+    def measure(telemetry_on: bool) -> float:
+        prev_cap = os.environ.get("PADDLE_TPU_ITL_TIMELINE_CAP")
+        sampler = None
+        engine = None
+        try:
+            if telemetry_on:
+                obs.attach(crash_hook=False)
+            else:
+                # the PADDLE_TPU_METRICS=off shape: registry AND span
+                # tracer disabled (detach — a tracer left buffering
+                # would depress the off baseline and underreport the
+                # tax), timelines off — what a telemetry-averse
+                # deployment would run
+                obs.detach()
+                os.environ["PADDLE_TPU_ITL_TIMELINE_CAP"] = "0"
+            engine = InferenceEngine(model, EngineConfig(**ecfg_kw))
+            engine.generate(prompts[:1], max_new_tokens=2)  # warm
+            if telemetry_on:
+                sampler = _tsmod.TimeSeriesSampler(
+                    names=("engine.tokens", "engine.batch_occupancy",
+                           "engine.page_utilization"),
+                    interval_s=0.05)
+                sampler.start()
+            engine.start()
+            t0 = time.perf_counter()
+            handles = [engine.submit(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+            for h in handles:
+                h.result(timeout=600.0)
+            dt = time.perf_counter() - t0
+            return sum(len(h.tokens) for h in handles) / dt
+        finally:
+            if engine is not None:
+                engine.stop()  # a leaked loop thread would compete
+                # with every later measurement
+            if sampler is not None:
+                sampler.stop()
+            if prev_cap is None:
+                os.environ.pop("PADDLE_TPU_ITL_TIMELINE_CAP", None)
+            else:
+                os.environ["PADDLE_TPU_ITL_TIMELINE_CAP"] = prev_cap
+
+    was_enabled = _metrics.enabled()
+    try:
+        tps_on = measure(True)
+        tps_off = measure(False)
+    finally:
+        # leave the stack as this bench found it even when a measure
+        # raises (run_secondary_benches catches and keeps going — the
+        # later benches must not inherit a flipped registry)
+        if was_enabled:
+            obs.attach(crash_hook=False)
+        else:
+            obs.detach()
+    frac = (tps_off - tps_on) / tps_off if tps_off > 0 else 0.0
+    result = {
+        "metric": "serving_telemetry_overhead_frac",
+        "value": round(max(frac, 1e-4), 4),  # >0 so --update keeps it
+        "unit": "frac",
+        "lower_better": True,
+        # relative tolerance vs a small baseline fraction is noisy by
+        # nature: a generous row-level tolerance keeps the gate about
+        # real regressions (2x the baseline tax), not jitter
+        "tolerance": 1.0,
+        "tokens_per_sec_on": round(tps_on, 1),
+        "tokens_per_sec_off": round(tps_off, 1),
+        "vs_baseline": 0.0,
+    }
+    if degraded or not on_tpu:
+        result["degraded"] = True
+    return result
+
+
 def run_secondary_benches(degraded: bool = False) -> None:
     """BASELINE configs 1 (ResNet50) and 5 (ViT attention shapes) plus
     the serving decode metric: emit one JSON line each BEFORE the primary
@@ -988,6 +1102,17 @@ def run_secondary_benches(degraded: bool = False) -> None:
         print(f"fleet-decode-bench-failed: {e}", file=sys.stderr)
         _emit({"metric": "fleet_decode_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "vs_baseline": 0.0, "degraded": True,
+               "note": f"failed: {type(e).__name__}: {e}"})
+    try:
+        _emit(_bench_telemetry_overhead(degraded))
+    except Exception as e:
+        print(f"telemetry-overhead-bench-failed: {e}", file=sys.stderr)
+        # a failed measurement must not read as "telemetry is free":
+        # the honesty row goes out degraded with a loud note, never
+        # silently absent
+        _emit({"metric": "serving_telemetry_overhead_frac",
+               "value": 0.0, "unit": "frac", "lower_better": True,
+               "vs_baseline": 0.0, "degraded": True,
                "note": f"failed: {type(e).__name__}: {e}"})
     try:
         _bench_multichip_sharded(degraded)

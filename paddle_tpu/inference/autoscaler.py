@@ -30,11 +30,26 @@ loop:
     [`min_replicas`, `max_replicas`] always.
 
 Telemetry (attach() schema): `autoscaler.replicas{state=target|actual}`
-gauges and `autoscaler.decisions{action=up|down|hold}` counters, both
-visible in `/debug/telemetry` and the `telemetry_agg` rollup next to
-`router.capacity{endpoint}`.  Every decision lands in `self.events`
+gauges and `autoscaler.decisions{action=up|up_predictive|down|hold}`
+counters, both visible in `/debug/telemetry` and the `telemetry_agg`
+rollup next to `router.capacity{endpoint}`.  Every decision lands in `self.events`
 (ordered, like `ReplicaFleet.events`) and as `autoscaler.*` flight
 events.
+
+  * **predictive scale-up** (ISSUE 15, ROADMAP item 5's last gap) —
+    burn is LAGGING by one SLO window: by the time the budget burns,
+    the queue already ate the latency.  Every tick records occupancy
+    and queue depth into a bounded `timeseries.TimeSeries` (the same
+    injectable clock), and a SUSTAINED positive least-squares slope —
+    occupancy growing ≥ `deriv_up`/s (or queue depth ≥
+    `queue_deriv_up`/s) while occupancy is already past `deriv_floor`
+    — fires a scale-up BEFORE the burn/occupancy thresholds cross,
+    through the SAME sustain/cooldown machinery, counted as
+    `autoscaler.decisions{action=up_predictive}` and logged as a
+    `scale_up_predictive` event.  The first time burn crosses
+    `burn_up` a `burn_threshold_crossed` event lands in the log, so
+    the surge chaos can assert the predictive scale-up strictly
+    preceded the burn-only trigger within one run.
 
 Env knobs (read when the matching ctor arg is None):
   PADDLE_TPU_AUTOSCALE_MIN         lower replica bound           (1)
@@ -43,6 +58,12 @@ Env knobs (read when the matching ctor arg is None):
   PADDLE_TPU_AUTOSCALE_BURN_UP     burn rate that demands growth (3.0)
   PADDLE_TPU_AUTOSCALE_OCC_UP      occupancy high-water mark     (0.8)
   PADDLE_TPU_AUTOSCALE_OCC_DOWN    occupancy idle mark           (0.2)
+  PADDLE_TPU_AUTOSCALE_DERIV_UP    occupancy slope (1/s) that
+                                   predicts saturation           (0.05)
+  PADDLE_TPU_AUTOSCALE_QUEUE_DERIV_UP  queue-depth slope (req/s) (1.5)
+  PADDLE_TPU_AUTOSCALE_DERIV_WINDOW_S  slope fit window          (5.0)
+  PADDLE_TPU_AUTOSCALE_DERIV_FLOOR occupancy below which slopes
+                                   are noise, never a signal     (0.3)
 
 `burn_up` defaults to the SLO "ticket" rung (slo._BURN_SLOW): spending
 a 30-day budget in ~10 days is the point where capacity — not a human
@@ -60,6 +81,7 @@ import threading
 import time
 
 from ..observability import metrics as _metrics
+from ..observability.timeseries import TimeSeries
 from ..resilience.overload import _env_num
 
 __all__ = ["Autoscaler"]
@@ -73,7 +95,9 @@ class Autoscaler:
     def __init__(self, fleet, min_replicas=None, max_replicas=None,
                  burn_up=None, occ_up=None, occ_down=None,
                  up_sustain=2, down_sustain=6, cooldown_s=None,
-                 interval=0.5, drain_grace=5.0, clock=time.monotonic):
+                 interval=0.5, drain_grace=5.0, clock=time.monotonic,
+                 deriv_up=None, queue_deriv_up=None,
+                 deriv_window_s=None, deriv_floor=None):
         if min_replicas is None:
             min_replicas = _env_num("PADDLE_TPU_AUTOSCALE_MIN", 1, int)
         if max_replicas is None:
@@ -89,6 +113,18 @@ class Autoscaler:
         if occ_down is None:
             occ_down = _env_num("PADDLE_TPU_AUTOSCALE_OCC_DOWN", 0.2,
                                 float)
+        if deriv_up is None:
+            deriv_up = _env_num("PADDLE_TPU_AUTOSCALE_DERIV_UP", 0.05,
+                                float)
+        if queue_deriv_up is None:
+            queue_deriv_up = _env_num(
+                "PADDLE_TPU_AUTOSCALE_QUEUE_DERIV_UP", 1.5, float)
+        if deriv_window_s is None:
+            deriv_window_s = _env_num(
+                "PADDLE_TPU_AUTOSCALE_DERIV_WINDOW_S", 5.0, float)
+        if deriv_floor is None:
+            deriv_floor = _env_num("PADDLE_TPU_AUTOSCALE_DERIV_FLOOR",
+                                   0.3, float)
         self.fleet = fleet
         self.min_replicas = max(1, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
@@ -101,11 +137,20 @@ class Autoscaler:
         self.interval = float(interval)
         self.drain_grace = float(drain_grace)
         self.clock = clock
+        self.deriv_up = float(deriv_up)
+        self.queue_deriv_up = float(queue_deriv_up)
+        self.deriv_window_s = max(self.interval, float(deriv_window_s))
+        self.deriv_floor = float(deriv_floor)
+        # the predictive signal's memory: one frame per tick, bounded —
+        # the timeseries plane under the same injectable clock
+        self.timeseries = TimeSeries(capacity=256, clock=clock)
         self.events = []           # ordered decision log (tests assert)
         self.peak_replicas = 0     # high-water mark the surge gate reads
         self._target = None        # lazily initialised from the fleet
         self._up_streak = 0
+        self._pred_streak = 0
         self._down_streak = 0
+        self._burn_crossed = False
         self._last_action_t = None
         self._lock = threading.Lock()      # guards self.events only
         self._tick_lock = threading.Lock()  # serializes decisions
@@ -128,14 +173,17 @@ class Autoscaler:
             if ep.get("requests"):
                 burn = max(burn, float(ep.get("burn_rate") or 0.0))
         occupancy = 0.0
+        queued = 0
         for ctl in (router.admission, router.gen_admission):
             st = ctl.stats()
+            queued += int(st["queued"])
             occupancy = max(
                 occupancy,
                 (st["inflight"] + st["queued"]) / max(1, st["limit"]))
         return {
             "burn_rate": round(burn, 4),
             "occupancy": round(occupancy, 4),
+            "queue_depth": queued,
             "actual": self.fleet.replica_count(),
             "routable": router.routable_count(),
         }
@@ -158,25 +206,65 @@ class Autoscaler:
         if self._target is None:
             self._target = min(self.max_replicas,
                                max(self.min_replicas, actual))
+        # feed the timeseries plane FIRST: the slopes below read the
+        # frame this tick just recorded
+        self.timeseries.record(
+            {"occupancy": sig["occupancy"],
+             "queue_depth": sig["queue_depth"],
+             "burn_rate": sig["burn_rate"],
+             "replicas": actual})
+        d_occ = self.timeseries.derivative("occupancy",
+                                           self.deriv_window_s)
+        d_queue = self.timeseries.derivative("queue_depth",
+                                             self.deriv_window_s)
+        sig["d_occupancy"] = None if d_occ is None else round(d_occ, 4)
+        sig["d_queue_depth"] = (None if d_queue is None
+                                else round(d_queue, 4))
+        if sig["burn_rate"] >= self.burn_up and not self._burn_crossed:
+            # the ordering witness the surge chaos asserts against: a
+            # predictive scale-up logged BEFORE this event beat the
+            # burn-only trigger within the same run
+            self._burn_crossed = True
+            self._event("burn_threshold_crossed", **sig)
         wants_up = (sig["burn_rate"] >= self.burn_up
                     or sig["occupancy"] >= self.occ_up)
+        # the LEADING signal: pressure not yet over the bar, but
+        # growing fast enough that it will be — fire while the launch
+        # still lands ahead of the saturation, not one SLO window after
+        wants_pred = (sig["occupancy"] >= self.deriv_floor
+                      and ((d_occ is not None
+                            and d_occ >= self.deriv_up)
+                           or (d_queue is not None
+                               and d_queue >= self.queue_deriv_up)))
         wants_down = (sig["burn_rate"] < self.burn_up
                       and sig["occupancy"] <= self.occ_down)
         self._up_streak = self._up_streak + 1 if wants_up else 0
+        # threshold evidence counts toward the predictive streak too:
+        # pressure crossing the bar is the strongest growth evidence
+        self._pred_streak = (self._pred_streak + 1
+                             if (wants_pred or wants_up) else 0)
         self._down_streak = self._down_streak + 1 if wants_down else 0
         now = self.clock()
         cooled = (self._last_action_t is None
                   or now - self._last_action_t >= self.cooldown_s)
         action = "hold"
-        if (wants_up and self._up_streak >= self.up_sustain
-                and actual < self.max_replicas and cooled):
+        grow = None
+        if actual < self.max_replicas and cooled:
+            if wants_up and self._up_streak >= self.up_sustain:
+                grow = "up"
+            elif wants_pred and self._pred_streak >= self.up_sustain:
+                grow = "up_predictive"
+        if grow is not None:
             rank = self.fleet.add_replica()
             if rank is not None:
-                action = "up"
+                action = grow
                 self._target = min(self.max_replicas, actual + 1)
                 self._last_action_t = self.clock()  # launch took time
                 self._up_streak = 0
-                self._event("scale_up", rank=rank, **sig)
+                self._pred_streak = 0
+                self._event("scale_up" if grow == "up"
+                            else "scale_up_predictive", rank=rank,
+                            **sig)
             else:
                 # the spawn/announce failed: back off for a cooldown
                 # anyway — without this, sustained burn retries a full
@@ -268,6 +356,14 @@ class Autoscaler:
             "burn_up": self.burn_up, "occ_up": self.occ_up,
             "occ_down": self.occ_down,
             "cooldown_s": self.cooldown_s,
+            "deriv_up": self.deriv_up,
+            "queue_deriv_up": self.queue_deriv_up,
+            "deriv_window_s": self.deriv_window_s,
+            "deriv_floor": self.deriv_floor,
+            "d_occupancy": self.timeseries.derivative(
+                "occupancy", self.deriv_window_s),
+            "d_queue_depth": self.timeseries.derivative(
+                "queue_depth", self.deriv_window_s),
             "events": events,
         }
 

@@ -55,12 +55,16 @@ import jax.numpy as jnp
 
 from ...observability import metrics as _metrics
 from ...observability import trace as _trace
+from ...observability.timeseries import DecisionRing, RequestTimeline
 from ...resilience.overload import _env_num
 from .paging import PagePool
 from .prefix import PrefixIndex
 from .scheduler import Scheduler, Sequence
 
 __all__ = ["EngineConfig", "InferenceEngine", "RequestHandle"]
+
+# completed-request timelines retained for GET /debug/requests/<id>
+_TIMELINE_LRU = 128
 
 
 def _precision_knob(explicit, env, valid):
@@ -338,9 +342,19 @@ class InferenceEngine:
                 clock=clock,
                 on_evict=lambda n: _metrics.inc(
                     "engine.prefix_cache", n, event="evict"))
+        self._clock = clock
+        # per-token latency attribution (ISSUE 15): the scheduler's
+        # bounded decision ring + a bounded LRU of per-request
+        # timelines — what GET /debug/requests/<id> correlates.
+        # PADDLE_TPU_ITL_TIMELINE_CAP=0 disables timeline stamping.
+        self.decisions = DecisionRing(capacity=512, clock=clock)
+        self._timeline_cap = int(_env_num(
+            "PADDLE_TPU_ITL_TIMELINE_CAP", 256, int))
+        self._timelines = {}       # request_id -> RequestTimeline (LRU)
         self.scheduler = Scheduler(cfg.max_slots, self.pool,
                                    self.max_pages_per_seq, clock=clock,
-                                   prefix_index=self._prefix)
+                                   prefix_index=self._prefix,
+                                   decision_ring=self.decisions)
         shape = (cfg.num_pages, self._hkv, cfg.page_size, self._hd)
         pool_dtype = jnp.int8 if cfg.kv_precision == "int8" \
             else self._dtype
@@ -858,17 +872,44 @@ class InferenceEngine:
                 f"{self.pool.capacity}")
         handle = RequestHandle(seq)
         seq.handle = handle
+        if self._timeline_cap > 0:
+            tl = RequestTimeline(seq.request_id, clock=self._clock,
+                                 token_cap=self._timeline_cap)
+            tl.event("submitted", prompt_tokens=int(seq.prompt.size),
+                     max_new_tokens=int(seq.max_new_tokens))
+            seq.timeline = tl
         # register BEFORE the scheduler can see the sequence: with the
         # loop thread running, a short request can be admitted,
         # finished, and its handle popped before submit() returns — a
         # post-hoc insert would leave a stale entry in _handles forever
         with self._lock:
             self._handles[seq.request_id] = handle
+            if seq.timeline is not None:
+                # the timeline map is a bounded LRU that OUTLIVES the
+                # handle: /debug/requests/<id> answers for completed
+                # requests too, until _TIMELINE_LRU newer ones arrive
+                self._timelines.pop(seq.request_id, None)
+                self._timelines[seq.request_id] = seq.timeline
+                while len(self._timelines) > _TIMELINE_LRU:
+                    # evict the oldest COMPLETED request first: a
+                    # still-streaming request must stay debuggable
+                    # exactly while its stall is happening (surge can
+                    # push >128 submissions past a live stream).  All
+                    # live (pathological) → the bound still wins.
+                    victim = next(
+                        (rid for rid in self._timelines
+                         if rid not in self._handles), None)
+                    if victim is None:
+                        victim = next(iter(self._timelines))
+                    self._timelines.pop(victim)
         try:
             self.scheduler.submit(seq)  # validates vs max_pages_per_seq
         except Exception:
             with self._lock:
                 self._handles.pop(seq.request_id, None)
+                # a refused request must not occupy a timeline slot (or
+                # answer /debug/requests with a ghost 'submitted' row)
+                self._timelines.pop(seq.request_id, None)
             raise
         _metrics.inc("engine.sequences", event="submitted")
         with self._work:
@@ -942,6 +983,10 @@ class InferenceEngine:
         prompt = seq.resume_prompt()
         s0 = prompt.size
         shared = int(seq.shared_len or 0)
+        if seq.timeline is not None:
+            seq.timeline.event("prefill_start", tokens=s0,
+                               shared=shared,
+                               resumed=bool(seq.evictions))
         with _trace.span("engine.prefill", cat="engine",
                          request=seq.request_id, tokens=s0,
                          shared=shared, pages=len(seq.pages)):
@@ -954,6 +999,8 @@ class InferenceEngine:
             self._commit_prefix(seq, kbufs, vbufs, start)
             seq.length = s0
             seq.last_token = t0
+        if seq.timeline is not None:
+            seq.timeline.event("prefill_end", tokens=s0)
         if self._prefix is not None:
             if seq.cache_state in ("hit", "partial"):
                 self._prefix_hits += 1
@@ -1251,6 +1298,8 @@ class InferenceEngine:
         finish on eos / length (mirrors generate()'s freezing: the eos
         itself is emitted, nothing after it)."""
         seq.tokens.append(int(tok))
+        if seq.timeline is not None:
+            seq.timeline.token()
         _metrics.inc("engine.tokens")
         if seq.handle is not None:
             seq.handle._push(tok)
@@ -1260,6 +1309,9 @@ class InferenceEngine:
             self._finish(seq, "length")
 
     def _finish(self, seq: Sequence, reason: str) -> None:
+        if seq.timeline is not None:
+            seq.timeline.event("finished", reason=reason,
+                               generated=len(seq.tokens))
         self.scheduler.finish(seq, reason)
         # release the slot/pages BEFORE the handle signals completion:
         # a client (or test) that observes the finished stream must
@@ -1297,6 +1349,9 @@ class InferenceEngine:
             moves = self.pool.defrag()
             if not moves:
                 return 0
+            self.decisions.record(
+                "defrag", moves=len(moves),
+                pressure=round(self.pool.utilization(), 4))
             # ascending-dst order is overwrite-safe: src > dst always,
             # and every src exceeds all earlier dsts
             for src, dst in sorted(moves.items(), key=lambda kv: kv[1]):
@@ -1351,6 +1406,61 @@ class InferenceEngine:
         if self._prefix is not None:
             st.update(self._prefix.stats())
         return st
+
+    # --- per-token latency attribution (ISSUE 15) ---------------------------
+    def request_debug(self, request_id):
+        """The answer to "why was this token slow": the request's
+        timeline (events, decimated token stamps, top inter-token
+        gaps), each gap annotated with the scheduler decisions that
+        landed INSIDE it (admits of other sequences, recompute
+        evictions, prefix reclaims, defrags — with seq ids and the
+        page pressure at decision time) plus a human-readable `cause`
+        line.  None for unknown / aged-out ids.  Works for completed
+        requests until `_TIMELINE_LRU` newer submissions age them
+        out."""
+        with self._lock:
+            tl = self._timelines.get(request_id)
+        if tl is None:
+            return None
+        d = tl.describe()
+        for gap in d["gaps"]:
+            evs = self.decisions.window(gap["t_start"], gap["t_end"],
+                                        pad=0.005)
+            gap["events"] = evs
+            causes = []
+            for ev in evs:
+                who = ev.get("request_id") or ev.get("for_request")
+                if ev["kind"] == "evict_recompute" \
+                        and ev.get("request_id") == request_id:
+                    causes.append(
+                        f"evicted (recompute) for "
+                        f"{ev.get('for_request')}, pool at "
+                        f"{ev.get('pressure', 0):.0%}")
+                elif ev["kind"] == "admit" and who != request_id:
+                    causes.append(
+                        f"co-scheduled {ev.get('cache_state', 'cold')} "
+                        f"prefill of {who}, pool at "
+                        f"{ev.get('pressure', 0):.0%}")
+                elif who != request_id:
+                    causes.append(
+                        f"co-scheduled {ev['kind']} "
+                        f"({who or 'pool'}), pool at "
+                        f"{ev.get('pressure', 0):.0%}")
+                else:
+                    causes.append(
+                        f"{ev['kind']} of this request, pool at "
+                        f"{ev.get('pressure', 0):.0%}")
+            gap["cause"] = "; ".join(causes) if causes else None
+        d["decision_ring_tail"] = self.decisions.events(limit=32)
+        return d
+
+    def recent_timelines(self, n=8) -> list:
+        """Bounded per-request timeline summaries, newest last — what
+        /debug/telemetry and the exporter dumps embed (full detail
+        stays behind /debug/requests/<id>)."""
+        with self._lock:
+            tls = list(self._timelines.values())[-int(n):]
+        return [tl.summary() for tl in tls]
 
     # --- loop / lifecycle ---------------------------------------------------
     def start(self):

@@ -69,6 +69,7 @@ class Sequence:
                              else int(eos_token_id))
         self.request_id = request_id or f"seq-{next(self._ids)}"
         self.arrived_at = float(arrived_at)
+        self.timeline = None       # optional RequestTimeline (ISSUE 15)
         self.state = WAITING
         self.tokens = []           # accepted generated tokens
         self.pages = []            # live page ids (engine's pools)
@@ -125,7 +126,7 @@ class SchedulerOutput:
 class Scheduler:
     def __init__(self, max_slots: int, pool: PagePool,
                  max_pages_per_seq: int, clock=time.monotonic,
-                 prefix_index=None):
+                 prefix_index=None, decision_ring=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = int(max_slots)
@@ -133,6 +134,11 @@ class Scheduler:
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.clock = clock
         self.prefix_index = prefix_index  # optional PrefixIndex
+        # optional timeseries.DecisionRing (ISSUE 15): every admit /
+        # evict-recompute / prefix-reclaim decision lands there with
+        # the page pressure AT DECISION TIME, so a request's token gap
+        # can be attributed to the co-scheduled work that caused it
+        self.decisions = decision_ring
         self._lock = threading.RLock()
         self._waiting = deque()
         self._running = {}         # slot -> Sequence
@@ -177,6 +183,19 @@ class Scheduler:
             seq.finish_reason = reason
 
     # --- the per-step decision ----------------------------------------------
+    def _decide(self, kind, **data):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        """One decision-ring entry, stamped with the pool pressure at
+        decision time.  Guarded: the scheduler must schedule even when
+        the observability plane is broken."""
+        if self.decisions is None:
+            return
+        try:
+            self.decisions.record(
+                kind, pressure=round(self.pool.utilization(), 4),
+                **data)
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard)
+
     def _release_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
         if seq.pages:
             self.pool.free(seq.pages)
@@ -246,9 +265,14 @@ class Scheduler:
                     except OutOfPages:
                         # LRU tier first: reclaim refcount-idle cached
                         # prefixes before touching any live sequence
-                        if self.prefix_index is not None and \
-                                self.prefix_index.evict_idle(need) > 0:
-                            continue
+                        if self.prefix_index is not None:
+                            got = self.prefix_index.evict_idle(need)
+                            if got > 0:
+                                self._decide(
+                                    "prefix_reclaim", pages=got,
+                                    requested=need,
+                                    for_request=seq.request_id)
+                                continue
                         # youngest-first preemption INCLUDING the
                         # growing sequence itself: when it is the
                         # youngest, it self-preempts rather than
@@ -257,6 +281,11 @@ class Scheduler:
                         if victim is None:
                             break  # nothing live to evict (can't happen
                             # while seq itself is live; belt-and-braces)
+                        self._decide(
+                            "evict_recompute",
+                            request_id=victim.request_id,
+                            for_request=seq.request_id,
+                            generated=len(victim.tokens))
                         evicted.append(victim)
                         if victim is seq:
                             break
@@ -270,19 +299,24 @@ class Scheduler:
                 need = self._target_pages(
                     seq, prompt.size + max(1, int(chunk))) \
                     - len(shared_pages)
-                if not self.pool.can_alloc(need) and (
-                        self.prefix_index is None
-                        or self.prefix_index.evict_idle(
-                            need - self.pool.free_pages) == 0
-                        or not self.pool.can_alloc(need)):
-                    # release the just-pinned prefix refs before
-                    # refusing — strict FIFO: nothing skips the head
-                    if shared_pages:
-                        self.pool.free(shared_pages)
-                        seq.shared_len = 0
-                        seq.shared_nodes = []
-                        seq.cache_state = None
-                    break
+                if not self.pool.can_alloc(need):
+                    got = 0
+                    if self.prefix_index is not None:
+                        got = self.prefix_index.evict_idle(
+                            need - self.pool.free_pages)
+                        if got > 0:
+                            self._decide("prefix_reclaim", pages=got,
+                                         requested=need,
+                                         for_request=seq.request_id)
+                    if got == 0 or not self.pool.can_alloc(need):
+                        # release the just-pinned prefix refs before
+                        # refusing — strict FIFO: nothing skips the head
+                        if shared_pages:
+                            self.pool.free(shared_pages)
+                            seq.shared_len = 0
+                            seq.shared_nodes = []
+                            seq.cache_state = None
+                        break
                 self._waiting.popleft()
                 seq.pages = shared_pages + self.pool.alloc(need)
                 seq.slot = self._free_slot_locked()
@@ -290,6 +324,17 @@ class Scheduler:
                 seq.admit_seqno = next(self._seqno)
                 self._running[seq.slot] = seq
                 prefills.append(seq)
+                self._decide("admit", request_id=seq.request_id,
+                             cache_state=seq.cache_state or "miss",
+                             shared_tokens=int(seq.shared_len or 0),
+                             pages=len(seq.pages),
+                             prompt_tokens=int(prompt.size),
+                             evictions=seq.evictions)
+                if seq.timeline is not None:
+                    seq.timeline.event(
+                        "admitted", slot=seq.slot,
+                        pages=len(seq.pages),
+                        cache_state=seq.cache_state or "miss")
 
             running = [self._running[s] for s in sorted(self._running)]
             return SchedulerOutput(prefills, running, evicted, finished)
@@ -348,6 +393,8 @@ class Scheduler:
         seq.last_token = None
         seq.state = WAITING
         seq.evictions += 1
+        if seq.timeline is not None:
+            seq.timeline.event("evicted", generated=len(seq.tokens))
         # FRONT of the queue: the preempted request resumes before
         # anything that arrived after it
         self._waiting.appendleft(seq)

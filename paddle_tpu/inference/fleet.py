@@ -840,8 +840,12 @@ def _replica_main(argv=None):
     if os.environ.get("PADDLE_TPU_TELEMETRY_DIR"):
         from ..observability.export import TelemetryExporter
 
-        exporter = TelemetryExporter(slo=srv.slo.report,
-                                     rank=args.rank).start()
+        exporter = TelemetryExporter(
+            slo=srv.slo.report, rank=args.rank,
+            # per-request timelines (ISSUE 15): real engines expose
+            # them; toy duck-types simply don't ship the key
+            timelines=getattr(srv.engine, "recent_timelines",
+                              None)).start()
 
     srv.start()
     tmp = args.announce + ".tmp"

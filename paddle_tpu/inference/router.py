@@ -79,6 +79,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
+from ..observability import timeseries as _ts
 from ..observability import trace as _trace
 from ..observability.slo import SLOTracker
 from ..resilience.overload import AdmissionController, ShedError, _env_num
@@ -88,6 +89,19 @@ from .serving import _retry_after_header
 __all__ = ["Router", "HTTPTransport", "ReplicaUnreachable"]
 
 _REPLICA_STATES = ("up", "draining", "ejected", "down")
+
+# the router's declared timeseries set (ISSUE 15): edge pressure and
+# fleet capacity — the queue-growth derivatives the autoscaler's
+# predictive signal is made of, visible on GET /debug/timeseries.
+# Bare names sum their label variants (right for counters and for
+# capacity); replica-count gauges are watched at their EXACT labeled
+# keys — summing target+actual or up+down would double-count.
+ROUTER_SERIES = (
+    "router.requests", "router.capacity",
+    "router.replicas{state=up}", "router.failovers",
+    "serving.inflight", "serving.queue_depth",
+    "autoscaler.replicas{state=actual}",
+)
 
 
 class ReplicaUnreachable(ConnectionError):
@@ -273,6 +287,11 @@ class Router:
                     target, float),
                 availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
                                       0.999, float))
+        # time-dimension telemetry (ISSUE 15): sampled edge/capacity
+        # series behind GET /debug/timeseries (rates + derivatives)
+        self.timeseries = _ts.TimeSeriesSampler(names=ROUTER_SERIES,
+                                                name="router")
+        _ts.set_default_sampler(self.timeseries)
         for rid, address in dict(replicas or {}).items():
             self.add_replica(rid, address)
         self._probe_stop = threading.Event()
@@ -338,6 +357,13 @@ class Router:
                         return self._json(
                             500, {"error": f"{type(e).__name__}: {e}"})
                     return self._json(200, snap)
+                if self.path == "/debug/timeseries":
+                    try:
+                        body = router.timeseries.describe()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, body)
                 return self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
@@ -1151,6 +1177,7 @@ class Router:
             "gen_admission": self.gen_admission.stats(),
             "readiness": {"ready": ready, "reason": reason},
             "replicas": self.replica_views(),
+            "timeseries": self.timeseries.stats(),
         }
 
     # ------------------------------------------------------------------
@@ -1163,6 +1190,7 @@ class Router:
 
     def start(self, probe=True):
         self._serving = True
+        self.timeseries.start()
         if probe:
             # one synchronous pass so capacities and readiness reflect
             # the fleet BEFORE the first request can race the loop
@@ -1186,6 +1214,7 @@ class Router:
         self._probe_stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=2)
+        self.timeseries.stop()
         drained = self.admission.drain(timeout=drain_timeout)
         drained = self.gen_admission.drain(timeout=drain_timeout) \
             and drained

@@ -65,6 +65,7 @@ import numpy as np
 from . import Config, create_predictor
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
+from ..observability import timeseries as _ts
 from ..observability import trace as _trace
 from ..observability.slo import SLOTracker
 from ..resilience.overload import _env_num
@@ -77,6 +78,18 @@ _DETERMINISTIC_ERRORS = (TypeError, ValueError, KeyError, IndexError,
                          AttributeError)
 
 _ARR_KEY = re.compile(r"arr_(\d+)$")
+
+
+# the serving replica's declared timeseries set (ISSUE 15): the queue /
+# batch / token signals whose rates and derivatives answer "how fast is
+# pressure growing" — served on GET /debug/timeseries and shipped
+# incrementally in exporter dumps.  Bare names sum their label variants.
+SERVING_SERIES = (
+    "serving.inflight", "serving.queue_depth", "serving.admission_limit",
+    "serving.requests", "resilience.shed_requests",
+    "engine.active_sequences", "engine.waiting_sequences",
+    "engine.batch_occupancy", "engine.page_utilization", "engine.tokens",
+)
 
 
 class _ServingHTTPServer(ThreadingHTTPServer):
@@ -200,6 +213,14 @@ class InferenceServer:
                     "PADDLE_TPU_SLO_TTFT_MS", 5000.0, float),
                 availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
                                       0.999, float))
+        # time-dimension telemetry (ISSUE 15): a registry sampler for
+        # /debug/timeseries (+ exporter dumps), and — for engines — an
+        # online ITL/TTFT anomaly watchdog fed at the stream edge
+        self.timeseries = _ts.TimeSeriesSampler(names=SERVING_SERIES,
+                                                name="serving")
+        _ts.set_default_sampler(self.timeseries)
+        self.anomalies = _ts.AnomalyDetector() if engine is not None \
+            else None
         self._drain_timeout = drain_timeout  # None → env/default in drain()
         self._ready_window = max(1, int(ready_window))
         self._recent = []          # last ready_window predictor outcomes
@@ -318,6 +339,32 @@ class InferenceServer:
                         return self._json(
                             500, {"error": f"{type(e).__name__}: {e}"})
                     return self._json(200, snap)
+                if self.path == "/debug/timeseries":
+                    try:
+                        body = server.timeseries.describe()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, body)
+                if self.path.startswith("/debug/requests/"):
+                    rid = self.path[len("/debug/requests/"):]
+                    dbg = getattr(server.engine, "request_debug",
+                                  None) if server.engine is not None \
+                        else None
+                    if dbg is None:
+                        return self._json(
+                            404, {"error": "no engine request "
+                                           "timelines on this server"})
+                    try:
+                        body = dbg(rid)
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    if body is None:
+                        return self._json(
+                            404, {"error": f"unknown or aged-out "
+                                           f"request id {rid!r}"})
+                    return self._json(200, body)
                 return self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
@@ -416,8 +463,24 @@ class InferenceServer:
                         self.send_header("Connection", "close")
                         self.end_headers()
                         first_at = None
+                        last_at = None
                         for tok in handle.stream(
                                 timeout=server._request_timeout or 120.0):
+                            now = time.perf_counter()
+                            if last_at is not None:
+                                # inter-token latency at the STREAM
+                                # EDGE (ISSUE 15): what the client
+                                # actually waited between tokens —
+                                # queue + decode + co-scheduled work,
+                                # not just the decode kernel
+                                gap_ms = (now - last_at) * 1e3
+                                _metrics.observe("serving.itl_ms",
+                                                 gap_ms,
+                                                 endpoint="generate")
+                                if server.anomalies is not None:
+                                    server.anomalies.observe("itl",
+                                                             gap_ms)
+                            last_at = now
                             if first_at is None:
                                 # time-to-first-token, labeled by the
                                 # prefix-cache outcome: the histogram
@@ -440,6 +503,9 @@ class InferenceServer:
                                     endpoint="generate")
                                 server.slo.observe("ttft", ttft_ms,
                                                    ok=True)
+                                if server.anomalies is not None:
+                                    server.anomalies.observe("ttft",
+                                                             ttft_ms)
                             self.wfile.write(
                                 json.dumps({"token": int(tok)}).encode()
                                 + b"\n")
@@ -619,11 +685,20 @@ class InferenceServer:
             "readiness": {"ready": ready, "reason": reason},
             "flight": _flight.events()[-64:],
         }
+        snap["timeseries"] = self.timeseries.stats()
+        if self.anomalies is not None:
+            snap["anomalies"] = self.anomalies.report()
         if self.engine is not None:
             # the engine's full view — including the prefix-cache
             # ledger and the shared/logical page split (ISSUE 13
             # satellite: page accounting stays honest under sharing)
             snap["engine"] = self.engine.stats()
+            # recent per-request latency timelines (ISSUE 15): the
+            # summary rows; full gap attribution lives behind
+            # GET /debug/requests/<id>
+            tls = getattr(self.engine, "recent_timelines", None)
+            if tls is not None:
+                snap["request_timelines"] = tls()
         return snap
 
     # --- request path --------------------------------------------------------
@@ -735,6 +810,7 @@ class InferenceServer:
         # racing start() must wait for the loop, not skip it
         if self.engine is not None:
             self.engine.start()
+        self.timeseries.start()
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True,
             name="paddle-tpu-serving")
@@ -745,6 +821,7 @@ class InferenceServer:
         self._serving = True
         if self.engine is not None:
             self.engine.start()  # idempotent
+        self.timeseries.start()  # idempotent
         self._httpd.serve_forever()
 
     def install_preemption(self, guard=None, install_signals=True):
@@ -811,6 +888,14 @@ class InferenceServer:
                     timeout=remaining) and drained
             if self.engine is not None:
                 self.engine.stop()
+            # one last sample so the final exporter dump carries the
+            # drained end state, then stop the sampling thread
+            try:
+                self.timeseries.sample()
+            except Exception:  # pt-lint: ok[PT005]
+                pass           # (observability fan-out guard: shutdown
+                # must never raise)
+            self.timeseries.stop()
             try:
                 from ..observability import flight as _flight
                 from ..observability import metrics as _metrics
@@ -1109,7 +1194,10 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866):
     if os.environ.get("PADDLE_TPU_TELEMETRY_DIR"):
         from ..observability.export import TelemetryExporter
 
-        exporter = TelemetryExporter(slo=srv.slo.report).start()
+        exporter = TelemetryExporter(
+            slo=srv.slo.report,
+            timelines=getattr(srv.engine, "recent_timelines",
+                              None)).start()
     print(f"serving {model_path} at {srv.address}")
     guard.wait()           # parked until preemption/Ctrl-C
     srv.shutdown()         # idempotent with the guard's drain thread

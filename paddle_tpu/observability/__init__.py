@@ -26,12 +26,12 @@ from __future__ import annotations
 
 from . import (  # noqa: F401
     export, flight, goodput, metrics, request_trace, slo, step_stats,
-    trace, xla_cost,
+    timeseries, trace, xla_cost,
 )
 from .step_stats import StepTimer  # noqa: F401
 
 __all__ = ["metrics", "flight", "step_stats", "trace", "xla_cost",
-           "request_trace", "slo", "export", "goodput",
+           "request_trace", "slo", "export", "goodput", "timeseries",
            "StepTimer", "attach", "detach"]
 
 # The snapshot-schema floor `attach()` guarantees: these counters exist
@@ -124,9 +124,16 @@ _SCHEMA_COUNTERS = tuple(
        for o in ("affine", "least_loaded")]
     # autoscaler (ISSUE 14): one decision per control tick — a healthy
     # steady-state fleet shows a growing `hold` count next to zero
-    # up/down, which is itself the signal the loop is alive
+    # up/down, which is itself the signal the loop is alive.
+    # `up_predictive` (ISSUE 15) is a scale-up fired by the timeseries
+    # plane's queue-growth derivative BEFORE burn/occupancy thresholds
+    # crossed — the leading-vs-lagging split is first-class telemetry
     + [("autoscaler.decisions", {"action": a})
-       for a in ("up", "down", "hold")]
+       for a in ("up", "down", "hold", "up_predictive")]
+    # anomaly watchdog (ISSUE 15): rolling-baseline latency-regression
+    # detections by kind — zero on a healthy server, never absent
+    + [("telemetry.anomalies", {"kind": k})
+       for k in ("ttft", "itl")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
@@ -144,6 +151,12 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
                   # hit rate — the /ready payload's gauge pair
                   "engine.prefix_cached_tokens",
                   "engine.prefix_cache_hit_rate") \
+    + tuple(("telemetry.timeseries_samples", {"sampler": s})
+            # timeseries sampler health (ISSUE 15): total samples per
+            # sampler — a flat-lined value is that sampler's own
+            # outage alarm (labeled: a router and a server in one
+            # process must not hide behind each other's count)
+            for s in ("serving", "router")) \
     + tuple(("router.replicas", {"state": s})
             for s in ("up", "draining", "ejected", "down")) \
     + tuple(("router.capacity", {"endpoint": ep})
@@ -154,6 +167,14 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
             for p in ("full", "bf16", "int8")) \
     + tuple(("paged.pool_precision", {"precision": p})
             for p in ("full", "int8"))
+
+
+# Histograms attach() pre-registers EMPTY (full bucket ladder, count 0)
+# so a fresh server's /metrics and snapshot expose the series before
+# the first observation — the ITL acceptance surface (ISSUE 15).
+_SCHEMA_HISTS = (
+    ("serving.itl_ms", {"endpoint": "generate"}),
+)
 
 
 def attach(crash_hook: bool = True):
@@ -169,6 +190,8 @@ def attach(crash_hook: bool = True):
             metrics.set_gauge(entry[0], 0, **entry[1])
         else:
             metrics.set_gauge(entry, 0)
+    for name, labels in _SCHEMA_HISTS:
+        metrics.declare_hist(name, **labels)
     flight.get_recorder().enabled = True
     trace.enable()
     if crash_hook:

@@ -21,7 +21,12 @@ Schema (`telemetry_dump/v1`) — one line per dump:
      "slo": {...} | null,                # slo.SLOTracker.report()
      "trace_events": [...],              # NEW tracer events since the
                                          # last dump (incremental)
-     "flight_events": [...]}             # NEW flight events (by seq)
+     "flight_events": [...],             # NEW flight events (by seq)
+     "timeseries": {"interval_s": f,     # OPTIONAL (ISSUE 15): NEW
+                    "frames": [...]},    # sampler frames since the last
+                                         # dump (incremental by seq)
+     "request_timelines": [...]}         # OPTIONAL: recent per-request
+                                         # timeline summaries
 
 Incremental on purpose: the tracer buffer holds 64k events — a
 per-interval full snapshot would quadratically re-ship history.  Both
@@ -70,6 +75,16 @@ def _obs_modules():
         return None, None, None
 
 
+def _timeseries_module():
+    """The timeseries sibling, or None when file-loaded standalone."""
+    try:
+        from . import timeseries  # type: ignore
+
+        return timeseries
+    except ImportError:
+        return None
+
+
 def _iso_now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S")
 
@@ -84,7 +99,8 @@ class TelemetryExporter:
     into every line (deployment labels: replica name, zone...)."""
 
     def __init__(self, outdir=None, interval_s=None, run_id=None,
-                 rank=None, host=None, pid=None, slo=None, extra=None):
+                 rank=None, host=None, pid=None, slo=None, extra=None,
+                 timelines=None):
         outdir = outdir or os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
         if not outdir:
             raise ValueError(
@@ -102,6 +118,10 @@ class TelemetryExporter:
         self.rank = None if rank is None else int(rank)
         self.run_id = str(run_id) if run_id else f"proc_{self.pid}"
         self.slo = slo
+        # optional zero-arg callable returning recent RequestTimeline
+        # summaries (ISSUE 15): a replica's exporter embeds the engine's
+        # per-request latency story next to its metrics
+        self.timelines = timelines
         self.extra = dict(extra or {})
         name = f"telemetry_{self.host}_{self.pid}"
         if self.rank is not None:
@@ -111,6 +131,7 @@ class TelemetryExporter:
         self._seq = 0
         self._trace_seen = 0
         self._flight_seen = 0
+        self._ts_seen = 0
         self._stop = threading.Event()
         self._thread = None
 
@@ -155,6 +176,28 @@ class TelemetryExporter:
                     self._flight_seen = max(e.get("seq", 0)
                                             for e in fevts)
                 line["flight_events"] = fevts
+            # the time dimension (ISSUE 15): frames the process-default
+            # sampler collected since the last dump — incremental like
+            # the trace/flight cursors, so concatenating one file's
+            # lines replays the process's whole retained series
+            tsmod = _timeseries_module()
+            if tsmod is not None:
+                sampler = tsmod.get_default_sampler()
+                if sampler is not None:
+                    frames = sampler.frames_since(self._ts_seen)
+                    if frames:
+                        self._ts_seen = frames[-1]["seq"]
+                    line["timeseries"] = {
+                        "interval_s": sampler.interval_s,
+                        "frames": frames}
+            if self.timelines is not None:
+                try:
+                    line["request_timelines"] = self.timelines()
+                except Exception as e:
+                    # same contract as the slo callback: a broken
+                    # provider never sinks the dump, but stays VISIBLE
+                    line["request_timelines_error"] = \
+                        f"{type(e).__name__}: {e}"
             os.makedirs(self.outdir, exist_ok=True)
             with open(self.path, "a") as f:
                 f.write(json.dumps(line, default=str) + "\n")
@@ -254,13 +297,18 @@ def validate_telemetry_stream(entries) -> list:
                     f"{type(e[key]).__name__}, expected {typ}")
         if e.get("schema") not in (None, SCHEMA_VERSION):
             errors.append(f"entry {i}: unknown schema {e.get('schema')!r}")
-        for key in ("metrics", "slo"):
+        for key in ("metrics", "slo", "timeseries"):
             if key in e and e[key] is not None \
                     and not isinstance(e[key], dict):
                 errors.append(f"entry {i}: key {key!r} not an object")
-        for key in ("trace_events", "flight_events"):
+        for key in ("trace_events", "flight_events",
+                    "request_timelines"):
             if key in e and not isinstance(e[key], list):
                 errors.append(f"entry {i}: key {key!r} not a list")
+        ts = e.get("timeseries")
+        if isinstance(ts, dict) and not isinstance(
+                ts.get("frames", []), list):
+            errors.append(f"entry {i}: timeseries.frames not a list")
         if isinstance(e.get("seq"), int) and isinstance(e.get("pid"), int):
             ident = (e.get("host"), e["pid"], e.get("rank"))
             prev = seqs.get(ident)

@@ -39,9 +39,9 @@ import time
 
 __all__ = [
     "MetricsRegistry", "get_registry", "inc", "set_gauge", "observe",
-    "declare", "snapshot", "to_prometheus", "dump_jsonl", "enable",
-    "disable", "enabled", "reset", "push_scope", "pop_scope",
-    "current_scope", "DEFAULT_BUCKETS", "quantile",
+    "declare", "declare_hist", "snapshot", "to_prometheus",
+    "dump_jsonl", "enable", "disable", "enabled", "reset", "push_scope",
+    "pop_scope", "current_scope", "DEFAULT_BUCKETS", "quantile",
 ]
 
 # --------------------------- scope stack ---------------------------
@@ -260,6 +260,16 @@ class MetricsRegistry:
         with self._lock:
             self._counters.setdefault(key, 0)
 
+    def declare_hist(self, name: str, **labels) -> None:
+        """Pre-register an EMPTY histogram (count 0, full bucket ladder)
+        so snapshots and /metrics render the series before the first
+        observation — a fresh server exposes `serving.itl_ms` at zero
+        instead of omitting it (ISSUE 15 schema discipline).  Works
+        regardless of the enabled flag, like declare()."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._hists.setdefault(key, _Hist())
+
     def set_gauge(self, name: str, value, **labels) -> None:
         if not self._enabled:
             return
@@ -382,6 +392,10 @@ def inc(name, value=1, **labels):
 
 def declare(name, **labels):
     _default.declare(name, **labels)
+
+
+def declare_hist(name, **labels):
+    _default.declare_hist(name, **labels)
 
 
 def set_gauge(name, value, **labels):

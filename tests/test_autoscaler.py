@@ -248,6 +248,87 @@ def test_idle_scales_down_through_drain_never_affinity_hot(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# predictive scale-up (ISSUE 15): the timeseries-derivative signal
+# --------------------------------------------------------------------------
+
+_PRED_KW = dict(min_replicas=1, max_replicas=3, up_sustain=2,
+                down_sustain=99, cooldown_s=0.0,
+                # thresholds parked out of reach: only the derivative
+                # can fire — the unit isolates the predictive path
+                burn_up=1e9, occ_up=0.99,
+                deriv_up=0.05, queue_deriv_up=1e9,
+                deriv_window_s=10.0, deriv_floor=0.3)
+
+
+def test_predictive_scale_up_fires_on_occupancy_slope(tmp_path):
+    """Fake-clock unit for the ISSUE 15 signal: occupancy RAMPS while
+    burn and the occupancy threshold stay quiet — the sustained
+    positive derivative alone must scale up, under the normal sustain
+    hysteresis, counted as up_predictive."""
+    clk = _Clock()
+    fleet, scaler, _rec = _scaled_fleet(tmp_path, n=1, clock=clk,
+                                        **_PRED_KW)
+    try:
+        assert _wait_routable(fleet.router, 1)
+        tickets = []
+
+        def occupy(n):
+            for _ in range(n):
+                tickets.append(fleet.router.admission.admit())
+
+        # occ 0 → .25 → .5 → .75 over 3 s: slope ≈ 0.25/s ≥ 0.05, but
+        # the floor (0.3) holds fire until occupancy is real
+        assert scaler.tick() == "hold"
+        clk.advance(1.0)
+        occupy(1)
+        assert scaler.tick() == "hold"          # occ .25 < floor
+        clk.advance(1.0)
+        occupy(1)
+        assert scaler.tick() == "hold"          # streak 1 of 2
+        clk.advance(1.0)
+        occupy(1)
+        assert scaler.tick() == "up_predictive"  # sustained slope
+        assert fleet.replica_count() == 2
+        assert scaler.events[-1]["kind"] == "scale_up_predictive"
+        assert scaler.events[-1]["d_occupancy"] >= 0.05
+        # burn never crossed: no burn_threshold_crossed event logged
+        assert all(e["kind"] != "burn_threshold_crossed"
+                   for e in scaler.events)
+        snap = metrics.snapshot()["counters"]
+        assert snap.get(
+            "autoscaler.decisions{action=up_predictive}") == 1
+        # no threshold-triggered scale-up happened in THIS scaler (the
+        # registry counter is process-global, so assert on the events)
+        assert all(e["kind"] != "scale_up" for e in scaler.events)
+        for t in tickets:
+            t.release(ok=True)
+    finally:
+        fleet.stop()
+
+
+def test_predictive_stays_silent_on_flat_occupancy(tmp_path):
+    """HIGH but FLAT occupancy (above the floor, below the threshold)
+    must never fire the predictive path: the derivative is the signal,
+    not the level."""
+    clk = _Clock()
+    fleet, scaler, _rec = _scaled_fleet(tmp_path, n=1, clock=clk,
+                                        **_PRED_KW)
+    try:
+        assert _wait_routable(fleet.router, 1)
+        tickets = [fleet.router.admission.admit() for _ in range(2)]
+        for _ in range(8):                      # occ pinned at .5
+            clk.advance(1.0)
+            assert scaler.tick() == "hold"
+        assert fleet.replica_count() == 1
+        assert scaler.describe()["d_occupancy"] == pytest.approx(
+            0.0, abs=1e-6)
+        for t in tickets:
+            t.release(ok=True)
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------------
 # fleet dynamic membership (the ISSUE 14 satellite fix)
 # --------------------------------------------------------------------------
 
@@ -334,7 +415,7 @@ def test_router_capacity_gauges_track_routable_fleet():
 
 def test_autoscaler_schema_zeros_present_in_snapshot():
     snap = metrics.snapshot()
-    for action in ("up", "down", "hold"):
+    for action in ("up", "down", "hold", "up_predictive"):
         assert f"autoscaler.decisions{{action={action}}}" \
             in snap["counters"]
     for state in ("target", "actual"):

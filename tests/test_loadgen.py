@@ -34,6 +34,14 @@ def _telemetry():
 # the workload definition (transport-free)
 # --------------------------------------------------------------------------
 
+def test_arrival_specs_carry_their_phase():
+    wl = loadgen.SharedPrefixWorkload(seed=0)
+    phases = loadgen.surge_phases(base_rps=30, warm_s=1, surge_s=1,
+                                  cool_s=1)
+    names = {spec["phase"] for _, spec in wl.arrivals(phases)}
+    assert names == {"warm", "surge", "cool"}
+
+
 def test_arrivals_are_open_loop_and_deterministic():
     wl = loadgen.SharedPrefixWorkload(seed=7, tenants=2)
     phases = loadgen.surge_phases(base_rps=20.0, surge_mult=10.0,
@@ -149,6 +157,16 @@ def test_open_loop_runner_e2e_toy_server():
         assert s["admitted_failures"] == 0, s["failure_detail"]
         assert s["ok"] == 10                 # all well-behaved, verified
         assert s["tokens"] > 0 and "generate" in s["latency_ms"]
+        # client-side ITL/TPOT (ISSUE 15): every generate stream with
+        # ≥2 tokens contributed gaps; the toy engine paces tokens at
+        # token_time, so the median gap sits near it
+        assert s["itl_ms"] is not None and s["itl_ms"]["n"] > 0
+        assert 1.0 <= s["itl_ms"]["p50"] <= 200.0
+        assert s["tpot_ms"] is not None
+        # per-phase breakdown: schedule_burst stamps phase="burst"
+        assert s["phases"]["burst"]["requests"] == 10
+        assert s["phases"]["burst"]["admitted_failures"] == 0
+        assert "latency_ms" in s["phases"]["burst"]
 
         # misbehaving clients: the deliberate disconnect is abandoned
         # (and verified up to the cut), the oversized body 400s — and

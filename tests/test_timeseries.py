@@ -1,0 +1,530 @@
+"""Time-series telemetry plane + per-token latency attribution
+(ISSUE 15).
+
+Coverage map:
+  * math: counter-aware reset-safe rate(), derivative sign (least
+    squares), EWMA recency weighting, windowing;
+  * bounded memory: the frame ring, the decision ring, and the
+    timeline's token-stamp decimation are all provably capacity-bound;
+  * sampler: declared-name resolution (exact + label-variant sum),
+    health gauge, /debug/timeseries payload;
+  * schema: attach() declares the new names at zero (`serving.itl_ms`
+    empty histogram rendered by to_prometheus, `telemetry.anomalies`,
+    `autoscaler.decisions{action=up_predictive}`,
+    `telemetry.timeseries_samples`);
+  * anomaly watchdog: fires on an injected latency cliff, stays silent
+    on steady noise, honors the cooldown;
+  * export/aggregation: incremental frames in TelemetryExporter dumps,
+    per-process + fleet-sum series and Perfetto counter tracks in
+    tools/telemetry_agg.py;
+  * engine attribution (jax tier): a pressure-forced eviction plants a
+    stall, and GET /debug/requests/<id> reconstructs it — the token
+    gap's events name the co-scheduled cause — both inline and over a
+    LIVE serving HTTP plane, with `serving.itl_ms` percentiles on
+    /metrics and /debug/telemetry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability import timeseries as ts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    obs.attach(crash_hook=False)
+    yield
+    obs.detach()
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# series math
+# ---------------------------------------------------------------------------
+
+def test_rate_is_counter_aware_across_reset():
+    clk = _Clock()
+    s = ts.TimeSeries(capacity=32, clock=clk)
+    # 10→20→30, process restart (reset to 5), →15: deltas 10+10+5+10
+    for t, v in ((0, 10), (1, 20), (2, 30), (3, 5), (4, 15)):
+        clk.t = float(t)
+        s.record({"c": v})
+    assert s.rate("c", 10) == pytest.approx(35 / 4)
+    # a naive last-first over the reset would be (15-10)/4 = 1.25 —
+    # the reset-safe rate must NOT be that
+    assert s.rate("c", 10) != pytest.approx((15 - 10) / 4)
+    # windows with <2 samples answer None, not garbage
+    assert s.rate("missing", 10) is None
+    assert ts.TimeSeries(capacity=8).rate("c", 10) is None
+
+
+def test_derivative_sign_and_least_squares():
+    clk = _Clock()
+    up, down = ts.TimeSeries(clock=clk), ts.TimeSeries(clock=clk)
+    for i in range(6):
+        clk.t = float(i)
+        up.record({"g": 2.0 * i})
+        down.record({"g": 10.0 - 3.0 * i})
+    assert up.derivative("g", 10) == pytest.approx(2.0)
+    assert down.derivative("g", 10) == pytest.approx(-3.0)
+    # one outlier cannot own the sign (least squares, not last-first)
+    clk.t = 6.0
+    up.record({"g": 0.0})
+    assert up.derivative("g", 3.0) < 0  # trailing window does turn
+    assert up.derivative("g", 100.0) > 0  # long window holds the trend
+
+
+def test_ewma_weights_recent_samples():
+    clk = _Clock()
+    s = ts.TimeSeries(clock=clk)
+    for i in range(10):
+        clk.t = float(i)
+        s.record({"g": 0.0 if i < 9 else 100.0})
+    e = s.ewma("g", 10.0)
+    assert 0.0 < e < 100.0
+    # a shorter halflife leans harder on the last sample
+    assert s.ewma("g", 10.0, halflife=0.5) > e
+
+
+def test_ring_and_decision_ring_memory_is_bounded():
+    s = ts.TimeSeries(capacity=16, clock=_Clock())
+    for i in range(1000):
+        s.record({"x": i}, t=float(i))
+    assert len(s) == 16
+    assert [v for _, v in s.window("x", None)][0] == 984.0
+    ring = ts.DecisionRing(capacity=32, clock=_Clock())
+    for i in range(1000):
+        ring.record("admit", request_id=f"r{i}")
+    assert len(ring) == 32
+    tail = ring.events()
+    assert tail[0]["request_id"] == "r968"
+    # window() answers only the asked interval
+    clk = _Clock()
+    ring2 = ts.DecisionRing(capacity=64, clock=clk)
+    for i in range(10):
+        clk.t = float(i)
+        ring2.record("evict_recompute", request_id=f"v{i}")
+    got = ring2.window(3.0, 5.0)
+    assert [e["request_id"] for e in got] == ["v3", "v4", "v5"]
+
+
+def test_timeline_token_stamps_decimate_and_keep_top_gaps():
+    clk = _Clock()
+    tl = ts.RequestTimeline("req", clock=clk, token_cap=8)
+    tl.event("submitted")
+    for i in range(200):
+        clk.advance(0.5 if i == 120 else 0.01)  # one planted stall
+        tl.token()
+    d = tl.describe()
+    assert d["tokens"] == 200
+    assert len(d["token_stamps"]) <= 8          # bounded, provably
+    assert d["token_stamps"][0]["token"] == 0   # coverage spans start
+    assert d["gaps"][0]["token"] == 120         # the stall is kept EXACT
+    assert d["gaps"][0]["gap_ms"] == pytest.approx(500.0)
+    assert d["itl_max_ms"] == pytest.approx(500.0)
+    # event list is bounded too
+    for i in range(200):
+        tl.event("noise", i=i)
+    d2 = tl.describe()
+    assert len(d2["events"]) <= ts.RequestTimeline._EVENT_CAP + 1
+    assert d2["events"][-1]["kind"] == "events_truncated"
+
+
+# ---------------------------------------------------------------------------
+# sampler + schema
+# ---------------------------------------------------------------------------
+
+def test_sampler_resolves_names_and_publishes_health():
+    # a PRIVATE registry: the process-global one accumulates counters
+    # from every other test in the suite — this test is about the
+    # sampler's resolution rules, not that shared state
+    reg = metrics.MetricsRegistry(enabled=True)
+    reg.inc("engine.tokens", 42)
+    reg.set_gauge("serving.inflight", 3)
+    reg.inc("serving.requests", 5, status="ok")
+    reg.inc("serving.requests", 2, status="shed")
+    sam = ts.TimeSeriesSampler(
+        names=("engine.tokens", "serving.inflight", "serving.requests",
+               "never.seen"),
+        registry=reg, interval_s=0.1, capacity=64)
+    vals = sam.sample()
+    assert vals["engine.tokens"] == 42.0          # exact counter
+    assert vals["serving.inflight"] == 3.0        # exact gauge
+    assert vals["serving.requests"] == 7.0        # label-variant sum
+    assert "never.seen" not in vals               # absent, not zero
+    # health gauge is labeled per sampler (a router + server in one
+    # process must not hide behind each other's count)
+    snap = reg.snapshot()
+    assert snap["gauges"][
+        "telemetry.timeseries_samples{sampler=sampler}"] == 1
+    assert sam.stats()["samples"] == 1
+    assert sam.stats()["kinds"]["engine.tokens"] == "counter"
+    assert sam.stats()["kinds"]["serving.inflight"] == "gauge"
+    d = sam.describe()
+    # rate only for counters, derivative only for gauges — a falling
+    # gauge must never fabricate a positive reset-safe "rate"
+    assert "serving.inflight" not in d["rate_30s"]
+    assert "engine.tokens" not in d["derivative_30s"]
+    assert d["samples"] == 1 and d["capacity"] == 64
+    assert "engine.tokens" in d["series"]
+    # two more samples with a moving counter → a live rate
+    reg.inc("engine.tokens", 10)
+    sam.sample()
+    assert sam.latest("engine.tokens") == 52.0
+
+
+def test_attach_declares_new_schema_names_at_zero():
+    # fresh registry state: this test is about what attach() declares,
+    # not what earlier tests in the process accumulated
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    snap = metrics.snapshot()
+    assert snap["counters"][
+        "autoscaler.decisions{action=up_predictive}"] == 0
+    for kind in ("ttft", "itl"):
+        assert snap["counters"][f"telemetry.anomalies{{kind={kind}}}"] \
+            == 0
+    for role in ("serving", "router"):
+        assert snap["gauges"][
+            f"telemetry.timeseries_samples{{sampler={role}}}"] == 0
+    # the ITL histogram renders EMPTY — full bucket ladder at zero —
+    # before any observation (declare_hist)
+    h = snap["histograms"]["serving.itl_ms{endpoint=generate}"]
+    assert h["count"] == 0
+    prom = metrics.to_prometheus()
+    assert "paddle_tpu_serving_itl_ms_bucket" in prom
+    assert 'le="+Inf"} 0' in prom
+    # one observation flips the same series live with the standard
+    # bucket ladder and the quantile family
+    metrics.observe("serving.itl_ms", 12.5, endpoint="generate")
+    prom = metrics.to_prometheus()
+    assert 'paddle_tpu_serving_itl_ms_quantile{endpoint="generate"' \
+        in prom
+
+
+# ---------------------------------------------------------------------------
+# anomaly watchdog
+# ---------------------------------------------------------------------------
+
+def test_anomaly_fires_on_cliff_not_on_noise():
+    metrics.reset()
+    obs.attach(crash_hook=False)  # re-declare the schema post-reset
+    clk = _Clock()
+    det = ts.AnomalyDetector(ratio=3.0, window=8, baseline=64,
+                             min_baseline=8, cooldown_s=5.0, clock=clk)
+    rs = np.random.RandomState(0)
+    fired = []
+    for _ in range(200):                       # steady noisy 10±2 ms
+        clk.advance(0.01)
+        fired.append(det.observe("itl", 10.0 + rs.uniform(-2, 2)))
+    assert not any(fired), "steady noise must stay silent"
+    before = metrics.snapshot()["counters"][
+        "telemetry.anomalies{kind=itl}"]
+    assert before == 0
+    for _ in range(12):                        # the cliff: 10 → 200 ms
+        clk.advance(0.01)
+        fired.append(det.observe("itl", 200.0))
+    assert any(fired)
+    snap = metrics.snapshot()["counters"]
+    assert snap["telemetry.anomalies{kind=itl}"] == 1  # cooldown: ONCE
+    rep = det.report()["itl"]
+    assert rep["fired"] == 1 and rep["baseline_n"] >= 8
+    # after the cooldown the still-degraded window may fire again
+    clk.advance(10.0)
+    again = [det.observe("itl", 220.0) for _ in range(4)]
+    assert any(again)
+    assert metrics.snapshot()["counters"][
+        "telemetry.anomalies{kind=itl}"] == 2
+
+
+# ---------------------------------------------------------------------------
+# export + fleet aggregation
+# ---------------------------------------------------------------------------
+
+def _load_agg():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_agg
+    finally:
+        sys.path.pop(0)
+    return telemetry_agg
+
+
+def test_exporter_ships_frames_incrementally_and_agg_merges(tmp_path):
+    from paddle_tpu.observability.export import (
+        TelemetryExporter, validate_telemetry_stream,
+    )
+
+    sam = ts.TimeSeriesSampler(names=("engine.tokens",), interval_s=1.0)
+    prev = ts.get_default_sampler()
+    ts.set_default_sampler(sam, force=True)
+    try:
+        exp = TelemetryExporter(outdir=str(tmp_path), run_id="t",
+                                timelines=lambda: [
+                                    {"request_id": "req-1",
+                                     "tokens": 3}])
+        metrics.inc("engine.tokens", 5)
+        sam.sample()
+        sam.sample()
+        exp.dump_once()
+        metrics.inc("engine.tokens", 7)
+        sam.sample()
+        exp.dump_once()
+        entries = [json.loads(line) for line in
+                   open(exp.path).read().splitlines()]
+        assert validate_telemetry_stream(entries) == []
+        # incremental: 2 frames in the first dump, 1 in the second
+        assert len(entries[0]["timeseries"]["frames"]) == 2
+        assert len(entries[1]["timeseries"]["frames"]) == 1
+        assert entries[1]["timeseries"]["frames"][0]["values"][
+            "engine.tokens"] == 12.0
+        assert entries[0]["request_timelines"][0]["request_id"] \
+            == "req-1"
+        agg = _load_agg()
+        streams = agg.load_dumps(str(tmp_path))
+        roll = agg.rollup(streams)
+        ident = next(iter(roll["timeseries"]["per_process"]))
+        series = roll["timeseries"]["per_process"][ident][
+            "engine.tokens"]
+        assert series["v"] == [5.0, 5.0, 12.0]   # full series rebuilt
+        assert roll["timeseries"]["fleet"]["engine.tokens"]["v"][-1] \
+            == 12.0
+        assert roll["request_timelines"][ident][0]["request_id"] \
+            == "req-1"
+        merged = agg.merge_timeline(streams)
+        counters = [e for e in merged["traceEvents"]
+                    if e.get("ph") == "C"]
+        assert len(counters) == 3                # one per frame
+        assert counters[0]["name"] == "engine.tokens"
+        assert counters[0]["args"]["value"] == 5.0
+    finally:
+        ts.set_default_sampler(None)
+        ts.set_default_sampler(prev)
+
+
+def test_fleet_sum_is_a_step_function_over_processes():
+    agg = _load_agg()
+    per_proc = {
+        "a:1": {"q": [(10.0, 2.0), (12.0, 4.0)]},
+        "b:2": {"q": [(11.0, 1.0), (13.0, 5.0)]},
+    }
+    fleet = agg.fleet_timeseries(per_proc)["q"]
+    # t=10: a=2; t=11: a=2+b=1; t=12: a=4+b=1; t=13: a=4+b=5
+    assert fleet["wall"] == [10.0, 11.0, 12.0, 13.0]
+    assert fleet["v"] == [2.0, 3.0, 5.0, 9.0]
+
+
+# ---------------------------------------------------------------------------
+# bench: the telemetry-overhead honesty row
+# ---------------------------------------------------------------------------
+
+def test_perf_gate_telemetry_overhead_round_trip(tmp_path):
+    """serving_telemetry_overhead_frac is gateable as LOWER-better:
+    --update registers it, an equal rerun passes, an overhead spike
+    beyond the row tolerance exits 2."""
+    import subprocess
+
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    base = tmp_path / "baseline.jsonl"
+    res = tmp_path / "results.json"
+    row = {"metric": "serving_telemetry_overhead_frac", "value": 0.05,
+           "unit": "frac", "lower_better": True, "tolerance": 1.0,
+           "tokens_per_sec_on": 900.0, "tokens_per_sec_off": 950.0}
+
+    def run(value, extra=()):
+        res.write_text(json.dumps(dict(row, value=value)) + "\n")
+        return subprocess.run(
+            [sys.executable, gate, str(res), "--baseline", str(base),
+             "--static-budget", "", *extra],
+            capture_output=True, text=True)
+
+    base.write_text(json.dumps(row) + "\n")
+    assert run(0.05).returncode == 0
+    assert run(0.09).returncode == 0          # inside the 100% row tol
+    p = run(0.25)                             # a real telemetry tax
+    assert p.returncode == 2 and "regression" in p.stderr
+    # --update ratchets the ceiling DOWN after a win (lower-better)
+    p = run(0.02, extra=("--update",))
+    assert p.returncode == 0 and "updated" in p.stdout, p.stdout
+    assert run(0.03).returncode == 0          # inside tol vs 0.02
+    assert run(0.05).returncode == 2          # old value now a tax
+    # degraded rows (the CPU proxy) are reported but never gated
+    res.write_text(json.dumps(dict(row, value=0.9,
+                                   degraded=True)) + "\n")
+    p = subprocess.run(
+        [sys.executable, gate, str(res), "--baseline", str(base),
+         "--static-budget", ""], capture_output=True, text=True)
+    assert p.returncode == 0 and "SKIP" in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# engine attribution (jax tier): the planted stall
+# ---------------------------------------------------------------------------
+
+def _tiny_gpt():
+    import paddle_tpu as P
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    return _tiny_gpt()
+
+
+def _tight_engine(model):
+    """A pool sized so two long-running sequences CANNOT coexist at
+    full length: the younger one must be recompute-evicted when the
+    pool fills — the planted stall."""
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+
+    ecfg = EngineConfig(page_size=4, max_slots=2, decode_chunk=1,
+                        prefill_bucket=4, max_seq_len=64, num_pages=11,
+                        prefix_cache=False)
+    return InferenceEngine(model, ecfg)
+
+
+def test_request_debug_reconstructs_pressure_forced_stall(gpt_model):
+    eng = _tight_engine(gpt_model)
+    rs = np.random.RandomState(0)
+    B = rs.randint(0, 128, (8,)).astype(np.int32)   # 8+24 → 8 pages
+    A = rs.randint(0, 128, (4,)).astype(np.int32)   # 4+24 → 7 pages
+    hb = eng.submit(B, max_new_tokens=24, request_id="req-B")
+    eng.step()                                      # B admitted first
+    ha = eng.submit(A, max_new_tokens=24, request_id="req-A")
+    idle = 0
+    while not (hb.done.is_set() and ha.done.is_set()):
+        idle = 0 if eng.step() else idle + 1
+        assert idle < 2000, "engine stuck"
+    dbg = eng.request_debug("req-A")
+    kinds = [e["kind"] for e in dbg["events"]]
+    assert "evicted" in kinds, kinds                # the stall happened
+    assert kinds.count("prefill_start") == 2        # recompute resume
+    assert dbg["tokens"] == 24                      # stream still exact
+    top = dbg["gaps"][0]
+    gap_kinds = [e["kind"] for e in top["events"]]
+    # the gap NAMES its cause: the recompute eviction (with the pool
+    # pressure at decision time) and the re-admission land inside it
+    assert "evict_recompute" in gap_kinds, top
+    evict = next(e for e in top["events"]
+                 if e["kind"] == "evict_recompute")
+    assert evict["request_id"] == "req-A"
+    assert 0.0 < evict["pressure"] <= 1.0
+    assert "pool at" in top["cause"]
+    assert dbg["decision_ring_tail"]
+    # unknown ids answer None, not a crash
+    assert eng.request_debug("nope") is None
+    # the timeline survives completion (bounded LRU)
+    assert eng.request_debug("req-B")["tokens"] == 24
+    assert eng.recent_timelines()
+    # PADDLE_TPU_ITL_TIMELINE_CAP=0 disables stamping entirely
+    os.environ["PADDLE_TPU_ITL_TIMELINE_CAP"] = "0"
+    try:
+        eng2 = _tight_engine(gpt_model)
+        h = eng2.submit(A, max_new_tokens=2, request_id="req-off")
+        while not h.done.is_set():
+            eng2.step()
+        assert eng2.request_debug("req-off") is None
+    finally:
+        os.environ.pop("PADDLE_TPU_ITL_TIMELINE_CAP", None)
+
+
+def test_live_serving_stall_attribution_and_itl_plane(gpt_model):
+    """The acceptance surface, end to end over HTTP: a live engine, a
+    deliberately induced pressure stall, GET /debug/requests/<id>
+    naming the co-scheduled cause, and serving.itl_ms percentiles on
+    /metrics + /debug/telemetry + /debug/timeseries present."""
+    from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+
+    eng = _tight_engine(gpt_model)
+    srv = InferenceServer(engine=eng, request_timeout=60).start()
+    try:
+        cli = InferenceClient(srv.address, timeout=60)
+        rs = np.random.RandomState(0)
+        B = rs.randint(0, 128, (8,)).astype(np.int32)
+        A = rs.randint(0, 128, (4,)).astype(np.int32)
+        cli.generate(A, max_new_tokens=2)  # warm both prefill buckets
+        cli.generate(B, max_new_tokens=2)
+
+        results = {}
+        b_started = threading.Event()
+
+        def run(name, prompt, wait=None):
+            c = InferenceClient(srv.address, timeout=60)
+            on_token = (lambda t: b_started.set()) if name == "B" \
+                else None
+            if wait is not None:
+                wait.wait(timeout=30)
+            results[name] = c.generate(prompt, max_new_tokens=24,
+                                       on_token=on_token)
+
+        tb = threading.Thread(target=run, args=("B", B))
+        ta = threading.Thread(target=run, args=("A", A, b_started))
+        tb.start()
+        ta.start()
+        tb.join(timeout=120)
+        ta.join(timeout=120)
+        assert "A" in results and "B" in results
+        rid = results["A"]["request_id"]
+
+        def get(path):
+            with urllib.request.urlopen(srv.address + path,
+                                        timeout=10) as r:
+                return json.loads(r.read())
+
+        dbg = get(f"/debug/requests/{rid}")
+        kinds = [e["kind"] for e in dbg["events"]]
+        assert "evicted" in kinds, kinds
+        top_with_cause = [g for g in dbg["gaps"] if g["events"]]
+        assert top_with_cause, dbg["gaps"]
+        assert any("pool at" in (g["cause"] or "")
+                   for g in top_with_cause)
+        # the ITL surface: histogram live on all three planes
+        with urllib.request.urlopen(srv.address + "/metrics",
+                                    timeout=10) as r:
+            prom = r.read().decode()
+        assert "paddle_tpu_serving_itl_ms_bucket" in prom
+        assert 'paddle_tpu_serving_itl_ms_quantile{' \
+            'endpoint="generate",quantile="0.99"}' in prom
+        snap = get("/debug/telemetry")
+        h = snap["metrics"]["histograms"][
+            "serving.itl_ms{endpoint=generate}"]
+        assert h["count"] >= 20 and "p95" in h
+        assert snap["request_timelines"]
+        assert "anomalies" in snap
+        tsd = get("/debug/timeseries")
+        assert "engine.tokens" in tsd["series"] or tsd["samples"] == 0
+        # unknown request id → 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/debug/requests/definitely-not-a-request")
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()

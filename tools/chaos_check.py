@@ -756,6 +756,8 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     stream tokens, every stream accounted, and full capacity restored
     (final burst all-ok) — with the router's failover/ejection story
     visible in the telemetry snapshot and the telemetry_agg rollup."""
+    import glob as _glob
+    import subprocess as _subprocess
     import tempfile as _tempfile
     import threading
     import time as _time
@@ -773,10 +775,20 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     metrics.reset()
     obs.attach(crash_hook=False)  # re-declare the schema post-reset
     tel_dir = _tempfile.mkdtemp(prefix="chaos_fleet_tel_")
+    # fast exporter dumps + sampler frames (ISSUE 15): the continuity
+    # gate below asserts the aggregated fleet timeseries has no gap
+    # longer than 2 sampling intervals for surviving replicas — a
+    # replica kill must not blind the telemetry plane of the others.
+    # Via replica_env (not os.environ): no process-global mutation to
+    # restore, and RELAUNCHED replicas inherit the fast intervals too
+    ts_interval = 0.4
     fleet = ReplicaFleet(
         num_replicas=n_replicas, kind="toy", token_time=token_time,
         service_time=service_time, launch_timeout=60,
-        telemetry_dir=tel_dir)
+        telemetry_dir=tel_dir,
+        replica_env={"PADDLE_TPU_TELEMETRY_INTERVAL": "0.5",
+                     "PADDLE_TPU_TIMESERIES_INTERVAL_S":
+                         str(ts_interval)})
     fleet.start()
     results = []
     lock = threading.Lock()
@@ -893,6 +905,44 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     roll_has_router = any(k.startswith("router.replicas")
                           for k in roll.get("gauges", {})) and \
         "router.ejections" in roll.get("counters", {})
+    # ISSUE 15: the per-token latency histogram made it through the
+    # fleet rollup with percentiles
+    itl_roll = roll.get("histograms", {}).get(
+        "serving.itl_ms{endpoint=generate}") or {}
+    itl_in_rollup = itl_roll.get("count", 0) > 0 and "p50" in itl_roll
+    # telemetry CONTINUITY under the replica kill (ISSUE 15 satellite):
+    # every replica process's aggregated timeseries must be internally
+    # gap-free (no gap > 2 sampling intervals) — the kill ends the
+    # victim's series but must not hole anyone's
+    gap_bound = 2.0 * ts_interval + 0.05  # scheduling jitter slack
+    ts_procs = roll.get("timeseries", {}).get("per_process", {})
+    replica_series = {ident: series for ident, series in ts_procs.items()
+                      if ":r" in ident and series}
+    continuity = {}
+    for ident, series in replica_series.items():
+        walls = sorted(next(iter(series.values()))["wall"])
+        worst = max((b - a for a, b in zip(walls, walls[1:])),
+                    default=0.0)
+        continuity[ident] = {"frames": len(walls),
+                             "worst_gap_s": round(worst, 3)}
+    survivors = [ident for ident, c in continuity.items()
+                 if c["frames"] >= 3]
+    continuity_ok = bool(survivors) and all(
+        continuity[ident]["worst_gap_s"] <= gap_bound
+        for ident in survivors)
+    # the killed replica's dump stream still validates schema-clean
+    # through tools/analyze_chip_log.py (exit 0 = no schema errors)
+    analyze = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "analyze_chip_log.py")
+    dumps_clean = True
+    for path in sorted(_glob.glob(os.path.join(tel_dir,
+                                               "telemetry_*.jsonl"))):
+        rc = _subprocess.run(
+            [sys.executable, analyze, path],
+            stdout=_subprocess.DEVNULL,
+            stderr=_subprocess.DEVNULL).returncode
+        if rc != 0:
+            dumps_clean = False
 
     report = {
         "scenario": "fleet",
@@ -910,6 +960,10 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
         "final_burst_ok": sum(bool(x) for x in final),
         "rollup_processes": roll.get("processes", []),
         "rollup_has_router": bool(roll_has_router),
+        "itl_in_rollup": bool(itl_in_rollup),
+        "timeseries_continuity": continuity,
+        "continuity_ok": bool(continuity_ok),
+        "dumps_schema_clean": bool(dumps_clean),
         "fleet_events": [e["kind"] for e in fleet.events],
         "recovered": (
             errors == 0 and accounted
@@ -920,6 +974,8 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
             and len(final) == n_replicas * 2 and all(final)
             and gauges.get("router.replicas{state=up}") == n_replicas
             and bool(roll_has_router)
+            and bool(itl_in_rollup) and bool(continuity_ok)
+            and bool(dumps_clean)
             # the drain-first ordering actually held for the SIGTERM
             and fleet.events.index(
                 next(e for e in fleet.events
@@ -977,11 +1033,24 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
                              max_slots=4, launch_timeout=60,
                              monitor_interval=0.1)
         fleet.start()
+        # occ_up raised 0.7 → 0.9 vs PR 14: the PREDICTIVE signal
+        # (ISSUE 15 — sustained positive occupancy/queue derivative
+        # from the timeseries plane) is now the intended early
+        # trigger; the threshold rules stay as the safety net.  The
+        # gate below asserts the first scale-up is predictive and
+        # strictly precedes the burn-threshold crossing in the event
+        # log — the "earlier than burn-only" proof inside ONE run.
         scaler = Autoscaler(
             fleet, min_replicas=1, max_replicas=max_replicas,
-            burn_up=2.0, occ_up=0.7, occ_down=0.15,
+            burn_up=2.0, occ_up=0.9, occ_down=0.15,
             up_sustain=2, down_sustain=8, cooldown_s=2.0,
-            interval=0.2, drain_grace=5.0)
+            interval=0.2, drain_grace=5.0,
+            deriv_up=0.08, queue_deriv_up=1.5,
+            # floor 0.1 (not the 0.3 default): the predictive streak
+            # must start building the moment the surge slope appears,
+            # ticks before occupancy can reach the 0.9 threshold —
+            # otherwise a steep leap could let the threshold rule win
+            deriv_window_s=3.0, deriv_floor=0.1)
         scaler.start()
         workload = loadgen.SharedPrefixWorkload(
             seed=seed, tenants=3, system_prompt_tokens=16,
@@ -1022,9 +1091,32 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
 
     s = load_report.summary()
     counters, gauges = snap["counters"], snap["gauges"]
-    scale_ups = [e for e in scaler.events if e["kind"] == "scale_up"]
+    scale_ups = [e for e in scaler.events
+                 if e["kind"] in ("scale_up", "scale_up_predictive")]
     scale_downs = [e for e in scaler.events
                    if e["kind"] == "scale_down"]
+    # the leading-vs-lagging proof (ISSUE 15): the FIRST scale-up must
+    # land with burn still under the bar AND strictly precede the
+    # burn-threshold crossing in the ordered event log (if burn never
+    # crossed, it beat the burn-only baseline by definition — that
+    # baseline would not have scaled at all), and the predictive
+    # signal must have actually fired this run (≥1 up_predictive).
+    # The first up is normally the predictive one (reported below),
+    # but a steep-enough occupancy leap can legitimately let the
+    # threshold rule win the same tick — the gate pins the ordering
+    # CLAIM, not which growth rule's label won a tie.
+    event_kinds = [e["kind"] for e in scaler.events]
+    first_up_idx = next(
+        (i for i, k in enumerate(event_kinds)
+         if k in ("scale_up", "scale_up_predictive")), None)
+    burn_cross_idx = next(
+        (i for i, k in enumerate(event_kinds)
+         if k == "burn_threshold_crossed"), None)
+    predictive_first = (
+        first_up_idx is not None
+        and scaler.events[first_up_idx].get("burn_rate", 0.0)
+        < scaler.burn_up
+        and (burn_cross_idx is None or first_up_idx < burn_cross_idx))
     # every removed rank drained in the load-bearing order: rotation
     # out (drain_mark) strictly before SIGTERM, exit 0 — the zero-loss
     # contract the autoscaler must never violate
@@ -1042,7 +1134,15 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
     telemetry_ok = (
         "autoscaler.replicas{state=actual}" in debug_gauges
         and "router.capacity{endpoint=generate}" in debug_gauges
-        and "slo" in debug_snap)
+        and "slo" in debug_snap
+        # the time dimension is live on the router's debug plane
+        and debug_snap.get("timeseries", {}).get("samples", 0) > 0)
+    # cross-check surface (ISSUE 15 satellite): the client-side ITL
+    # percentiles next to the surge phase breakdown — the server-side
+    # serving.itl_ms histograms live in the replicas' own /metrics
+    client_itl = s.get("itl_ms")
+    phases_ok = all(ph in s.get("phases", {})
+                    for ph in ("warm", "surge", "cool"))
     report = {
         "scenario": "surge",
         "phases": [f"{p.name}:{p.duration_s}s@{p.rps}rps"
@@ -1065,7 +1165,15 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
         "drain_order_ok": bool(drain_order_ok),
         "decisions": {a: counters.get(
             f"autoscaler.decisions{{action={a}}}", 0)
-            for a in ("up", "down", "hold")},
+            for a in ("up", "up_predictive", "down", "hold")},
+        "first_scale_up": (None if first_up_idx is None
+                           else event_kinds[first_up_idx]),
+        "first_scale_up_idx": first_up_idx,
+        "burn_crossed_idx": burn_cross_idx,
+        "predictive_first": bool(predictive_first),
+        "client_itl_ms": client_itl,
+        "client_tpot_ms": s.get("tpot_ms"),
+        "phase_breakdown": s.get("phases"),
         "telemetry_ok": bool(telemetry_ok),
         "recovered": (
             s["admitted_failures"] == 0 and s["replayed"] == 0
@@ -1074,9 +1182,12 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
             and gen_p99 is not None and gen_p99 <= p99_bound_ms
             and len(scale_downs) >= 1 and bool(returned_to_min)
             and bool(drain_order_ok)
-            and counters.get("autoscaler.decisions{action=up}", 0) >= 1
+            and bool(predictive_first)
+            and counters.get(
+                "autoscaler.decisions{action=up_predictive}", 0) >= 1
             and counters.get("autoscaler.decisions{action=down}", 0) >= 1
             and gauges.get("autoscaler.replicas{state=actual}") == 1
+            and client_itl is not None and bool(phases_ok)
             and bool(telemetry_ok)),
     }
     return report

@@ -36,7 +36,12 @@ definition instead of three burst loops):
   * `LoadReport.summary()` — counts by kind/status, latency
     percentiles, tokens/s, and `admitted_failures` (errors + corrupt
     responses + replays; sheds and deliberate client misbehavior are
-    NOT failures — shedding politely is correct behavior).
+    NOT failures — shedding politely is correct behavior).  ISSUE 15:
+    client-side `itl_ms` (p50/p95/p99 over every inter-token gap) and
+    `tpot_ms` (per-stream mean time/output token) percentiles — the
+    cross-check for the server's `serving.itl_ms` histogram — plus a
+    per-phase (warm/surge/cool) `phases` breakdown with each phase's
+    status counts and ok-latency percentiles.
 
 The client side is stdlib-only (http.client + json); numpy is imported
 lazily only to build/parse /predict npz bodies, and nothing here
@@ -199,7 +204,9 @@ class SharedPrefixWorkload:
                 t += rng.expovariate(ph.rps)
                 if t >= end:
                     break
-                yield t, self.sample(rng)
+                spec = self.sample(rng)
+                spec["phase"] = ph.name  # per-phase latency breakdown
+                yield t, spec
             base = end
 
     def schedule_burst(self, n, window_s=0.25, rng=None):
@@ -207,8 +214,12 @@ class SharedPrefixWorkload:
         `window_s` — the capacity-bench shape (deterministic request
         COUNT, still open-loop: the spread never waits on completions)."""
         rng = rng or random.Random(self.seed)
-        return [(i * window_s / max(1, n), self.sample(rng))
-                for i in range(int(n))]
+        out = []
+        for i in range(int(n)):
+            spec = self.sample(rng)
+            spec["phase"] = "burst"
+            out.append((i * window_s / max(1, n), spec))
+        return out
 
 
 class LoadReport:
@@ -221,11 +232,22 @@ class LoadReport:
 
     _FAILURES = ("error", "corrupt", "replayed")
 
+    @staticmethod
+    def _pcts(vals):
+        vals = sorted(vals)
+        return {"p50": round(_quantile(vals, 0.50), 2),
+                "p95": round(_quantile(vals, 0.95), 2),
+                "p99": round(_quantile(vals, 0.99), 2),
+                "max": round(vals[-1], 2), "n": len(vals)}
+
     def summary(self):
         by_kind: dict = {}
         status: dict = {}
         lat: dict = {"predict": [], "generate": []}
         tokens = 0
+        all_gaps = []              # every inter-token gap, all streams
+        tpot = []                  # per-stream mean time/output token
+        phases: dict = {}
         for row in self.rows:
             k, s = row["kind"], row["status"]
             by_kind.setdefault(k, {}).setdefault(s, 0)
@@ -234,15 +256,34 @@ class LoadReport:
             tokens += row.get("tokens", 0) or 0
             if s == "ok" and row.get("latency_s") is not None:
                 lat.setdefault(k, []).append(row["latency_s"] * 1e3)
+            # client-side ITL/TPOT (ISSUE 15): gaps from every stream
+            # that delivered ≥2 tokens — including interrupted ones
+            # (their delivered prefix waited like any other); the
+            # cross-check for the server's serving.itl_ms histogram
+            gaps = row.get("itl_ms")
+            if gaps and s in ("ok", "interrupted", "abandoned"):
+                all_gaps.extend(gaps)
+                tpot.append(sum(gaps) / len(gaps))
+            ph = row.get("phase") or "unphased"
+            pstat = phases.setdefault(ph, {
+                "requests": 0, "status": {}, "tokens": 0, "_lat": []})
+            pstat["requests"] += 1
+            pstat["status"][s] = pstat["status"].get(s, 0) + 1
+            pstat["tokens"] += row.get("tokens", 0) or 0
+            if s == "ok" and row.get("latency_s") is not None:
+                pstat["_lat"].append(row["latency_s"] * 1e3)
         latency = {}
         for k, vals in lat.items():
             if vals:
-                vals.sort()
-                latency[k] = {
-                    "p50": round(_quantile(vals, 0.50), 2),
-                    "p95": round(_quantile(vals, 0.95), 2),
-                    "p99": round(_quantile(vals, 0.99), 2),
-                    "max": round(vals[-1], 2), "n": len(vals)}
+                latency[k] = self._pcts(vals)
+        phase_out = {}
+        for ph, pstat in phases.items():
+            row = {k: v for k, v in pstat.items() if k != "_lat"}
+            if pstat["_lat"]:
+                row["latency_ms"] = self._pcts(pstat["_lat"])
+            row["admitted_failures"] = sum(
+                pstat["status"].get(s, 0) for s in self._FAILURES)
+            phase_out[ph] = row
         return {
             "requests": len(self.rows),
             "wall_s": round(self.wall_s, 3),
@@ -263,6 +304,11 @@ class LoadReport:
             "tokens_per_sec": round(tokens / self.wall_s, 1)
             if self.wall_s > 0 else 0.0,
             "latency_ms": latency,
+            # client-observed per-token latency: every inter-token gap
+            # pooled (itl_ms) and the per-stream mean (tpot_ms)
+            "itl_ms": self._pcts(all_gaps) if all_gaps else None,
+            "tpot_ms": self._pcts(tpot) if tpot else None,
+            "phases": phase_out,
         }
 
 
@@ -336,21 +382,24 @@ class OpenLoopRunner:
 
     # ------------------------------------------------------------------
     def _record(self, spec, status, latency_s=None, tokens=0,
-                detail=None):
+                detail=None, itl_ms=None):
         with self._lock:
             self._rows.append({
                 "id": spec["id"], "kind": spec["kind"],
                 "behavior": spec["behavior"], "tenant": spec["tenant"],
+                "phase": spec.get("phase"),
                 "status": status, "latency_s": latency_s,
-                "tokens": tokens, "detail": detail})
+                "tokens": tokens, "detail": detail,
+                "itl_ms": itl_ms})
 
     def _fire(self, spec):
         t0 = time.monotonic()
+        itl = None
         try:
             if spec["behavior"] == "oversize":
                 status, tokens, detail = self._oversize(spec), 0, None
             elif spec["kind"] == "generate":
-                status, tokens, detail = self._generate(spec)
+                status, tokens, detail, itl = self._generate(spec)
             else:
                 status, detail = self._predict(spec)
                 tokens = 0
@@ -358,7 +407,7 @@ class OpenLoopRunner:
             status, tokens = "error", 0
             detail = f"{type(e).__name__}: {e}"
         self._record(spec, status, latency_s=time.monotonic() - t0,
-                     tokens=tokens, detail=detail)
+                     tokens=tokens, detail=detail, itl_ms=itl)
 
     def _retry_wait(self, headers):
         """Defensive Retry-After parse, clamped into
@@ -385,7 +434,7 @@ class OpenLoopRunner:
         if fp is not None:
             headers["X-Prefix-Fingerprint"] = fp
         attempts = self.max_retries + 1
-        last = ("error", 0, "no attempt ran")
+        last = ("error", 0, "no attempt ran", None)
         for attempt in range(attempts):
             conn = self._connect()
             try:
@@ -395,7 +444,7 @@ class OpenLoopRunner:
                 if resp.status in (429, 503):
                     wait = self._retry_wait(dict(resp.headers))
                     resp.read()
-                    last = ("shed", 0, f"http {resp.status}")
+                    last = ("shed", 0, f"http {resp.status}", None)
                     if attempt < attempts - 1:
                         if spec["behavior"] != "ignore_retry_after":
                             time.sleep(wait)
@@ -403,26 +452,37 @@ class OpenLoopRunner:
                     return last
                 if resp.status != 200:
                     return (("client_error" if resp.status == 400
-                             else "error"), 0, f"http {resp.status}")
+                             else "error"), 0, f"http {resp.status}",
+                            None)
                 return self._consume_stream(spec, resp, conn)
             except OSError as e:
-                last = ("error", 0, f"{type(e).__name__}: {e}")
+                last = ("error", 0, f"{type(e).__name__}: {e}", None)
             finally:
                 conn.close()
         return last
 
     def _consume_stream(self, spec, resp, conn):
         """Read the ndjson stream; verify tokens against
-        `expected_token` as they arrive.  Disconnect clients bail after
-        the first token — the server must notice the dead socket and
-        cancel the sequence (its pages return to the pool)."""
+        `expected_token` as they arrive and stamp every arrival — the
+        CLIENT-side inter-token gaps (ISSUE 15) that cross-check the
+        server's `serving.itl_ms` histogram in the surge scenario.
+        Disconnect clients bail after the first token — the server
+        must notice the dead socket and cancel the sequence (its pages
+        return to the pool).  Returns (status, n_tokens, detail,
+        itl_ms_list)."""
         prompt, tokens = spec["prompt"], []
+        gaps = []
+        last_t = None
         for line in resp:
             line = line.strip()
             if not line:
                 continue
             evt = json.loads(line)
             if "token" in evt:
+                now = time.monotonic()
+                if last_t is not None:
+                    gaps.append((now - last_t) * 1e3)
+                last_t = now
                 tok = int(evt["token"])
                 tokens.append(tok)
                 # incremental: each token is checked ONCE as it
@@ -432,10 +492,10 @@ class OpenLoopRunner:
                         tok != self.expected_token(prompt,
                                                    len(tokens) - 1):
                     return "replayed", len(tokens), \
-                        f"token {len(tokens) - 1} wrong"
+                        f"token {len(tokens) - 1} wrong", gaps
                 if spec["behavior"] == "disconnect":
                     conn.close()   # die mid-stream, deliberately
-                    return "abandoned", len(tokens), None
+                    return "abandoned", len(tokens), None, gaps
             elif evt.get("interrupted"):
                 # the clean mid-stream cut: every delivered token
                 # already verified above; the record must carry the
@@ -444,13 +504,16 @@ class OpenLoopRunner:
                     == list(prompt) + tokens
                 return (("interrupted" if prefix_ok else "replayed"),
                         len(tokens),
-                        None if prefix_ok else "bad resumable prefix")
+                        None if prefix_ok else "bad resumable prefix",
+                        gaps)
             elif evt.get("done"):
                 out_ok = list(evt.get("output_ids") or []) \
                     == list(prompt) + tokens
                 return (("ok" if out_ok else "replayed"), len(tokens),
-                        None if out_ok else "final record mismatch")
-        return "error", len(tokens), "stream ended without final record"
+                        None if out_ok else "final record mismatch",
+                        gaps)
+        return ("error", len(tokens),
+                "stream ended without final record", gaps)
 
     # --- /predict (npz body; numpy is the one lazy non-stdlib need) ---
     def _predict(self, spec):

@@ -17,7 +17,12 @@ Outputs:
     fixed shared ladder makes this a plain sum) with fleet-wide
     interpolated p50/p95/p99, gauges kept per process, and SLO reports
     combined per endpoint (window counts summed, burn rate recomputed
-    against the declared objective).  A `per_process` section groups
+    against the declared objective).  Timeseries frames (ISSUE 15)
+    merge twice: per-process series re-assembled from the incremental
+    dumps (`timeseries.per_process`), and a fleet-SUM step function
+    per name (`timeseries.fleet`); in the merged timeline they render
+    as Perfetto counter tracks (`"ph": "C"`).  Each process's newest
+    `request_timelines` summaries ride along under their ident.  A `per_process` section groups
     each process's serving/engine/router gauges under its
     `host:pid[:rN]` ident — the per-replica serving view (ISSUE 9:
     replica ranks ride the dump filename, so a fleet's rollup shows
@@ -140,6 +145,22 @@ def merge_timeline(streams):
                                "ph": "i", "s": "t",
                                "ts": round(max(ts, 0.0), 3),
                                "pid": pid, "tid": 0, "args": args})
+            # timeseries frames (ISSUE 15) → Perfetto COUNTER tracks:
+            # each watched name becomes a per-process counter series
+            # Perfetto renders as a little area chart above the spans
+            ts_block = e.get("timeseries")
+            frames = (ts_block.get("frames")
+                      if isinstance(ts_block, dict) else None) or ()
+            for fr in frames:
+                if not isinstance(fr, dict):
+                    continue
+                wall = fr.get("wall", e.get("wall", t0))
+                fts = (float(wall) - t0) * 1e6
+                for name, v in (fr.get("values") or {}).items():
+                    events.append({"name": str(name), "ph": "C",
+                                   "ts": round(max(fts, 0.0), 3),
+                                   "pid": pid, "tid": 0,
+                                   "args": {"value": v}})
     return {"traceEvents": meta + events, "displayTimeUnit": "ms",
             "otherData": {"schema": "telemetry_agg/v1",
                           "processes": {v: k for k, v in pids.items()},
@@ -147,6 +168,71 @@ def merge_timeline(streams):
 
 
 # ------------------------------ rollup ------------------------------
+
+def collect_timeseries(streams):
+    """{ident: {name: [(wall, v), ...]}} — every process's shipped
+    sampler frames, concatenated across its dumps (frames are
+    incremental by seq, so concatenation replays the whole retained
+    series), deduped by seq and sorted by time."""
+    out: dict = {}
+    for _path, entries in streams:
+        for e in entries:
+            if e.get("phase") != _export.TELEMETRY_PHASE:
+                continue
+            ts_block = e.get("timeseries")
+            if not isinstance(ts_block, dict):
+                continue
+            ident = _proc_ident(e)
+            proc = out.setdefault(ident, {"_seqs": set(), "series": {}})
+            for fr in ts_block.get("frames") or ():
+                if not isinstance(fr, dict):
+                    continue
+                seq = fr.get("seq")
+                if seq in proc["_seqs"]:
+                    continue  # a re-read dump line must not duplicate
+                proc["_seqs"].add(seq)
+                wall = float(fr.get("wall", 0.0))
+                for name, v in (fr.get("values") or {}).items():
+                    proc["series"].setdefault(str(name), []).append(
+                        (wall, float(v)))
+    series = {}
+    for ident, proc in out.items():
+        series[ident] = {n: sorted(pts)
+                         for n, pts in proc["series"].items()}
+    return series
+
+
+def fleet_timeseries(per_proc, max_points=2048):
+    """Fleet-SUM series: for every name, the step-function sum of each
+    process's most recent value at each observed wall time (a process
+    contributes 0 before its first sample and holds its last value
+    after its last).  The queue-depth/token-rate view of the WHOLE
+    fleet, bounded to the trailing `max_points` instants."""
+    by_name: dict = {}
+    for ident, series in per_proc.items():
+        for name, pts in series.items():
+            by_name.setdefault(name, {})[ident] = pts
+    out = {}
+    for name, procs in sorted(by_name.items()):
+        walls = sorted({w for pts in procs.values() for w, _ in pts})
+        walls = walls[-int(max_points):]
+        cursors = {ident: 0 for ident in procs}
+        latest = {ident: None for ident in procs}
+        summed = []
+        for w in walls:
+            total = 0.0
+            for ident, pts in procs.items():
+                i = cursors[ident]
+                while i < len(pts) and pts[i][0] <= w:
+                    latest[ident] = pts[i][1]
+                    i += 1
+                cursors[ident] = i
+                if latest[ident] is not None:
+                    total += latest[ident]
+            summed.append((round(w, 6), round(total, 6)))
+        out[name] = {"wall": [w for w, _ in summed],
+                     "v": [v for _, v in summed]}
+    return out
 
 def _merge_hist(acc, summ):
     """Accumulate one histogram summary (count/total/min/max + sparse
@@ -267,12 +353,36 @@ def rollup(streams):
             rep["objective"] = obj
         slo_out[ep] = rep
 
+    # the time dimension (ISSUE 15): per-process series re-assembled
+    # from the incremental frames, plus the fleet-sum step function —
+    # counters appear in `timeseries.fleet` only via their sampled
+    # values, so the rollup stays a pure function of the dumps
+    per_proc_ts = collect_timeseries(streams)
+    ts_out = {
+        "per_process": {
+            ident: {n: {"wall": [round(w, 6) for w, _ in pts],
+                        "v": [v for _, v in pts]}
+                    for n, pts in sorted(series.items())}
+            for ident, series in sorted(per_proc_ts.items())},
+        "fleet": fleet_timeseries(per_proc_ts),
+    }
+
+    # per-request timelines (ISSUE 15): the newest summaries per
+    # process, straight off each process's last dump
+    timelines = {}
+    for ident, e in sorted(last.items()):
+        tls = e.get("request_timelines")
+        if isinstance(tls, list) and tls:
+            timelines[ident] = tls
+
     return {"schema": "telemetry_rollup/v1",
             "processes": sorted(last),
             "counters": dict(sorted(counters.items())),
             "histograms": dict(sorted(hists.items())),
             "gauges": dict(sorted(gauges.items())),
             "per_process": dict(sorted(per_process.items())),
+            "timeseries": ts_out,
+            "request_timelines": timelines,
             "slo": slo_out}
 
 
