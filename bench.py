@@ -914,7 +914,10 @@ def _bench_multichip_sharded(degraded: bool) -> dict | None:
 def _bench_telemetry_overhead(degraded: bool) -> dict:
     """Telemetry-overhead honesty row (ISSUE 15): decode tokens/s with
     the FULL observability plane on (metrics registry + schema, flight,
-    timeseries sampler at a fast interval, per-request timelines) vs
+    timeseries sampler at a fast interval, per-request timelines, and —
+    ISSUE 16 — the per-tenant ledger, which the engine constructs
+    whenever the registry is live, billing every decode token, slot-ms
+    and page-second on this arm) vs
     the same engine shape with `PADDLE_TPU_METRICS=off` semantics
     (registry disabled, timelines off) — measured SAME-RUN on the same
     model and prompts.  Value = (off - on)/off, LOWER better, ~0 when
@@ -952,6 +955,8 @@ def _bench_telemetry_overhead(degraded: bool) -> dict:
     prompts = [rs.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
                for _ in range(n_clients)]
 
+    ledger_armed = []  # the on-arm engine's ledger must actually exist
+
     def measure(telemetry_on: bool) -> float:
         prev_cap = os.environ.get("PADDLE_TPU_ITL_TIMELINE_CAP")
         sampler = None
@@ -968,6 +973,8 @@ def _bench_telemetry_overhead(degraded: bool) -> dict:
                 obs.detach()
                 os.environ["PADDLE_TPU_ITL_TIMELINE_CAP"] = "0"
             engine = InferenceEngine(model, EngineConfig(**ecfg_kw))
+            if telemetry_on:
+                ledger_armed.append(engine.tenant_ledger is not None)
             engine.generate(prompts[:1], max_new_tokens=2)  # warm
             if telemetry_on:
                 sampler = _tsmod.TimeSeriesSampler(
@@ -1018,6 +1025,9 @@ def _bench_telemetry_overhead(degraded: bool) -> dict:
         "tolerance": 1.0,
         "tokens_per_sec_on": round(tps_on, 1),
         "tokens_per_sec_off": round(tps_off, 1),
+        # honesty flag: the "on" arm really carried the tenant ledger
+        # (False would mean this row measures less plane than deployed)
+        "tenant_ledger_on": bool(ledger_armed and all(ledger_armed)),
         "vs_baseline": 0.0,
     }
     if degraded or not on_tpu:

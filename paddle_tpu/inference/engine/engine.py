@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 
 from ...observability import metrics as _metrics
+from ...observability import tenant_ledger as _tledger
 from ...observability import trace as _trace
 from ...observability.timeseries import DecisionRing, RequestTimeline
 from ...resilience.overload import _env_num
@@ -174,6 +175,7 @@ class RequestHandle:
     def __init__(self, seq: Sequence):
         self._seq = seq
         self.request_id = seq.request_id
+        self.tenant_id = getattr(seq, "tenant_id", None)
         self._q = queue.Queue()
         self.done = threading.Event()
         self.finish_reason = None
@@ -351,10 +353,21 @@ class InferenceEngine:
         self._timeline_cap = int(_env_num(
             "PADDLE_TPU_ITL_TIMELINE_CAP", 256, int))
         self._timelines = {}       # request_id -> RequestTimeline (LRU)
+        # per-tenant metering (ISSUE 16): the engine owns the process's
+        # book — decode tokens bill here (`record_decode` also owns the
+        # engine.tokens increment, see tenant_ledger docstring), the
+        # scheduler integrates KV page-seconds against it, and serving
+        # ADOPTS it so edge request billing shares the same book (the
+        # conservation invariant is per-book).  None when the plane is
+        # off: a detached process pays nothing, not even O(K).
+        self.tenant_ledger = None
+        if _tledger.enabled() and _metrics.enabled():
+            self.tenant_ledger = _tledger.TenantLedger()
         self.scheduler = Scheduler(cfg.max_slots, self.pool,
                                    self.max_pages_per_seq, clock=clock,
                                    prefix_index=self._prefix,
-                                   decision_ring=self.decisions)
+                                   decision_ring=self.decisions,
+                                   tenant_ledger=self.tenant_ledger)
         shape = (cfg.num_pages, self._hkv, cfg.page_size, self._hd)
         pool_dtype = jnp.int8 if cfg.kv_precision == "int8" \
             else self._dtype
@@ -856,14 +869,17 @@ class InferenceEngine:
 
     # --- intake -------------------------------------------------------------
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
-               request_id=None) -> RequestHandle:
+               request_id=None, tenant_id=None) -> RequestHandle:
         """Enqueue one sequence; returns its `RequestHandle`.  Raises
         ValueError when the request can never fit (prompt+max_new over
         the engine's per-sequence or pool capacity) — feasibility is
         checked at the door so the scheduler never deadlocks on an
-        unservable request."""
+        unservable request.  `tenant_id` names who the tenant ledger
+        bills for this sequence's tokens/slot-time/page-seconds
+        (ISSUE 16; None books under `anon`)."""
         seq = Sequence(input_ids, max_new_tokens,
-                       eos_token_id=eos_token_id, request_id=request_id)
+                       eos_token_id=eos_token_id, request_id=request_id,
+                       tenant_id=tenant_id)
         need = -(-(seq.prompt.size + seq.max_new_tokens)
                  // self.config.page_size)
         if need > self.pool.capacity:
@@ -1010,6 +1026,13 @@ class InferenceEngine:
                 _metrics.inc("engine.prefix_cache", event="miss")
             self._prefix_tokens_saved += shared
             self._prefix_tokens_total += s0
+        if self.tenant_ledger is not None:
+            # attribute prefill work — and the prefix cache's savings —
+            # to the tenant (ISSUE 16): `shared` tokens came off cached
+            # pages instead of running the model.  A recompute resume
+            # bills its replayed tail honestly as computed work.
+            self.tenant_ledger.record_prefill(
+                seq.tenant_id, s0 - shared, saved=shared)
         _metrics.inc("engine.sequences", event="admitted")
         self._accept(seq, t0)
 
@@ -1218,6 +1241,7 @@ class InferenceEngine:
 
     def _decode(self, running) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
         cfg = self.config
+        t_step = time.perf_counter()
         tok, pt, lengths = self._batch_arrays(running)
         # ALWAYS dispatch the configured chunk: shrinking the scan to
         # the batch's max remaining would compile one program per
@@ -1246,10 +1270,12 @@ class InferenceEngine:
                     self._accept(seq, int(row[j]))
                 seq.length += n
                 seq.last_token = int(row[n - 1])
+        self._bill_decode_slots(running, t_step)
 
     def _spec_decode(self, running) -> None:  # pt-lint: ok[PT101,PT102] (step holds _lock)
         cfg = self.config
         k = cfg.spec_tokens
+        t_step = time.perf_counter()
         tok, pt, lengths = self._batch_arrays(running)
         # per-slot lifetime cap (prompt+max_new cache positions): rows
         # of the pass at or past it are masked to the scratch page
@@ -1292,6 +1318,19 @@ class InferenceEngine:
                     self._accept(seq, int(row[j]))
                 seq.length += cnt
                 seq.last_token = int(row[cnt - 1])
+        self._bill_decode_slots(running, t_step)
+
+    def _bill_decode_slots(self, running, t_step) -> None:
+        """Decode-slot occupancy billing (ISSUE 16): every sequence in
+        the pass occupied one batch slot for the step's wall time —
+        THE contended capacity unit (max_slots), so a tenant holding
+        slots with long sequences shows up even at a low token rate."""
+        if self.tenant_ledger is None or not running:
+            return
+        step_ms = (time.perf_counter() - t_step) * 1e3
+        for seq in running:
+            self.tenant_ledger.record_decode_slot_ms(
+                seq.tenant_id, step_ms)
 
     def _accept(self, seq: Sequence, tok: int) -> None:
         """One generated token passes the host: record, deliver,
@@ -1300,7 +1339,13 @@ class InferenceEngine:
         seq.tokens.append(int(tok))
         if seq.timeline is not None:
             seq.timeline.token()
-        _metrics.inc("engine.tokens")
+        if self.tenant_ledger is not None:
+            # the ledger incs engine.tokens INSIDE its lock so the
+            # counter and per-tenant decode totals move atomically (a
+            # concurrent snapshot can never see them skewed)
+            self.tenant_ledger.record_decode(seq.tenant_id)
+        else:
+            _metrics.inc("engine.tokens")
         if seq.handle is not None:
             seq.handle._push(tok)
         if seq.eos_token_id is not None and int(tok) == seq.eos_token_id:

@@ -56,7 +56,7 @@ class Sequence:
     _ids = itertools.count()
 
     def __init__(self, input_ids, max_new_tokens, eos_token_id=None,
-                 request_id=None, arrived_at=0.0):
+                 request_id=None, arrived_at=0.0, tenant_id=None):
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         if ids.size < 1:
             raise ValueError("empty prompt")
@@ -68,7 +68,9 @@ class Sequence:
         self.eos_token_id = (None if eos_token_id is None
                              else int(eos_token_id))
         self.request_id = request_id or f"seq-{next(self._ids)}"
+        self.tenant_id = tenant_id   # who the ledger bills (ISSUE 16)
         self.arrived_at = float(arrived_at)
+        self._page_mark = None       # last page-seconds charge instant
         self.timeline = None       # optional RequestTimeline (ISSUE 15)
         self.state = WAITING
         self.tokens = []           # accepted generated tokens
@@ -126,7 +128,8 @@ class SchedulerOutput:
 class Scheduler:
     def __init__(self, max_slots: int, pool: PagePool,
                  max_pages_per_seq: int, clock=time.monotonic,
-                 prefix_index=None, decision_ring=None):
+                 prefix_index=None, decision_ring=None,
+                 tenant_ledger=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = int(max_slots)
@@ -139,6 +142,10 @@ class Scheduler:
         # the page pressure AT DECISION TIME, so a request's token gap
         # can be attributed to the co-scheduled work that caused it
         self.decisions = decision_ring
+        # optional TenantLedger (ISSUE 16): the scheduler owns every
+        # page-residency edge (admit / grow / evict / release), so it
+        # is THE place KV page-seconds — ∫ page_count dt — integrate
+        self.tenant_ledger = tenant_ledger
         self._lock = threading.RLock()
         self._waiting = deque()
         self._running = {}         # slot -> Sequence
@@ -196,7 +203,28 @@ class Scheduler:
         except Exception:  # pt-lint: ok[PT005]
             pass           # (observability fan-out guard)
 
+    def _charge_pages_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        """Integrate page-seconds since the last charge at the CURRENT
+        page count, and restart the integration window.  Called before
+        any page-count change (grow/evict/release) and once per
+        schedule() for every running sequence, so occupancy accrues
+        continuously instead of materializing only at terminal edges.
+        Guarded: metering must never fail a scheduling decision."""
+        if self.tenant_ledger is None:
+            return
+        try:
+            now = self.clock()
+            if seq._page_mark is not None and seq.pages:
+                self.tenant_ledger.record_page_seconds(
+                    seq.tenant_id,
+                    len(seq.pages) * (now - seq._page_mark))
+            seq._page_mark = now
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard)
+
     def _release_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        self._charge_pages_locked(seq)
+        seq._page_mark = None
         if seq.pages:
             self.pool.free(seq.pages)
             seq.pages = []
@@ -253,6 +281,10 @@ class Scheduler:
                 seq = self._running.get(slot)
                 if seq is None or seq.slot is None:
                     continue  # evicted earlier in this pass
+                # settle page-seconds at the OLD page count before any
+                # growth this step (and once per step regardless — the
+                # integral accrues continuously)
+                self._charge_pages_locked(seq)
                 while True:
                     target = self._target_pages(
                         seq, seq.length + max(1, int(chunk)))
@@ -319,6 +351,7 @@ class Scheduler:
                         break
                 self._waiting.popleft()
                 seq.pages = shared_pages + self.pool.alloc(need)
+                seq._page_mark = self.clock()  # residency starts NOW
                 seq.slot = self._free_slot_locked()
                 seq.state = RUNNING
                 seq.admit_seqno = next(self._seqno)
@@ -382,6 +415,8 @@ class Scheduler:
         return victim
 
     def _evict_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        self._charge_pages_locked(seq)
+        seq._page_mark = None       # residency ends until re-admission
         self.pool.free(seq.pages)   # shared refs decrement; cache keeps
         seq.pages = []              # its own — re-admission re-shares
         self._running.pop(seq.slot, None)

@@ -111,6 +111,7 @@ class _ToyHandle:
 
     def __init__(self, request_id):
         self.request_id = request_id
+        self.tenant_id = None
         self.done = threading.Event()
         self.finish_reason = None
         self.cancelled = False
@@ -160,6 +161,15 @@ class ToyEngine:
         self._handles = {}
         self._active = 0
         self._stopped = False
+        # tenant metering parity with the real engine (ISSUE 16): the
+        # toy fleet's chaos runs gate the conservation invariant, so
+        # the toy engine must keep the same per-tenant decode books
+        # (record_decode owns the engine.tokens increment)
+        from ..observability import metrics as _metrics
+        from ..observability import tenant_ledger as _tledger
+
+        self.tenant_ledger = _tledger.TenantLedger() \
+            if _tledger.enabled() and _metrics.enabled() else None
 
     def start(self):
         return self
@@ -173,11 +183,12 @@ class ToyEngine:
             h._finish("cancelled")
 
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
-               request_id=None):
+               request_id=None, tenant_id=None):
         ids = [int(x) for x in np.asarray(input_ids).reshape(-1)]
         if not ids:
             raise ValueError("empty input_ids")
         h = _ToyHandle(request_id or uuid.uuid4().hex[:16])
+        h.tenant_id = tenant_id
         h._prompt = ids
         with self._lock:
             if self._stopped:
@@ -195,6 +206,8 @@ class ToyEngine:
                         time.sleep(self.token_time)
                     tok = toy_token(ids, i)
                     h.tokens.append(tok)
+                    if self.tenant_ledger is not None:
+                        self.tenant_ledger.record_decode(tenant_id)
                     h._q.put(tok)
                     if eos_token_id is not None and tok == eos_token_id:
                         h._finish("eos")
@@ -842,6 +855,10 @@ def _replica_main(argv=None):
 
         exporter = TelemetryExporter(
             slo=srv.slo.report, rank=args.rank,
+            # per-tenant ledger (ISSUE 16): each replica dumps its own
+            # book; telemetry_agg merges them into the fleet rollup
+            tenants=(srv.tenant_ledger.snapshot
+                     if srv.tenant_ledger is not None else None),
             # per-request timelines (ISSUE 15): real engines expose
             # them; toy duck-types simply don't ship the key
             timelines=getattr(srv.engine, "recent_timelines",

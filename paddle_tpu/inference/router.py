@@ -79,6 +79,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
+from ..observability import tenant_ledger as _tledger
 from ..observability import timeseries as _ts
 from ..observability import trace as _trace
 from ..observability.slo import SLOTracker
@@ -287,6 +288,15 @@ class Router:
                     target, float),
                 availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
                                       0.999, float))
+        # per-tenant metering at the EDGE (ISSUE 16): the router's own
+        # book bills every request it answers — including sheds and
+        # failed failovers a replica never saw, which is exactly what
+        # replica-side books cannot capture.  Request counts here and
+        # on replicas are per-HOP tallies (like router.requests vs
+        # serving.requests); token/page fields bill engine-side only,
+        # so the fleet merge of REPLICA books still conserves.
+        self.tenant_ledger = _tledger.TenantLedger() \
+            if _tledger.enabled() and _metrics.enabled() else None
         # time-dimension telemetry (ISSUE 15): sampled edge/capacity
         # series behind GET /debug/timeseries (rates + derivatives)
         self.timeseries = _ts.TimeSeriesSampler(names=ROUTER_SERIES,
@@ -364,12 +374,32 @@ class Router:
                         return self._json(
                             500, {"error": f"{type(e).__name__}: {e}"})
                     return self._json(200, body)
+                if self.path == "/debug/tenants":
+                    # the fleet tenant view (ISSUE 16): the router's
+                    # edge book + every routable replica's table +
+                    # their Space-Saving merge
+                    try:
+                        body = router.tenant_debug()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, body)
                 return self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
                 if self.path not in ("/predict", "/generate"):
                     return self._json(404, {"error": "unknown path"})
                 ctx = _rtrace.continue_from_headers(self.headers)
+                if ctx.tenant_id is None:
+                    # the router resolves the SAME billing fallback as
+                    # the serving edge (fp:<fingerprint>, else anon) so
+                    # a shed here and a decode on the replica land in
+                    # one ledger row; _route_generate refines anon to a
+                    # derived fingerprint before forwarding
+                    fp = self.headers.get("X-Prefix-Fingerprint")
+                    tid = _tledger.sanitize_tenant(f"fp:{fp}") \
+                        if fp else None
+                    ctx.tenant_id = tid or _tledger.ANON_TENANT
                 self._rt_ctx = ctx
                 with _rtrace.activate(ctx):
                     if self.path == "/predict":
@@ -434,7 +464,8 @@ class Router:
                 finally:
                     if ticket is not None:
                         ticket.release(ok=status == "ok")
-                    router._finish_request("predict", status, sp, t_req)
+                    router._finish_request("predict", status, sp, t_req,
+                                           tenant_id=ctx.tenant_id)
 
             # --- /generate: streamed forward -------------------------
             def _route_generate(self, ctx):
@@ -465,6 +496,15 @@ class Router:
 
                         fingerprint = InferenceClient.prefix_fingerprint(
                             prompt)
+                        if ctx.tenant_id == _tledger.ANON_TENANT \
+                                and fingerprint:
+                            # refine the billing fallback with the
+                            # derived fingerprint BEFORE forwarding, so
+                            # router and replica book the same cohort
+                            # key (the forwarded hop carries it)
+                            ctx.tenant_id = _tledger.sanitize_tenant(
+                                f"fp:{fingerprint}") \
+                                or _tledger.ANON_TENANT
                     deadline = router._deadline()
                     try:
                         ticket = router.gen_admission.admit(
@@ -494,7 +534,8 @@ class Router:
                 finally:
                     if ticket is not None:
                         ticket.release(ok=status == "ok")
-                    router._finish_request("generate", status, sp, t_req)
+                    router._finish_request("generate", status, sp, t_req,
+                                           tenant_id=ctx.tenant_id)
 
         self._httpd = _RouterHTTPServer((host, port), Handler)
         self._thread = None
@@ -1120,7 +1161,8 @@ class Router:
         return (None if self.request_timeout is None
                 else self.clock() + self.request_timeout)
 
-    def _finish_request(self, endpoint, status, sp, t_req):
+    def _finish_request(self, endpoint, status, sp, t_req,
+                        tenant_id=None):
         dt_ms = (time.perf_counter() - t_req) * 1e3
         if sp is not None:
             sp.args["status"] = status
@@ -1129,6 +1171,11 @@ class Router:
                          endpoint=endpoint, status=status)
         _metrics.inc("router.requests", endpoint=endpoint,
                      status=status)
+        if self.tenant_ledger is not None:
+            # edge billing (ISSUE 16): sheds and failovers the fleet
+            # never served still bill the right tenant (`interrupted`
+            # books as error — the bounded-status discipline)
+            self.tenant_ledger.record_request(tenant_id, status)
         # fleet-level SLO ledger (ISSUE 14): every edge shed and every
         # request the failover machinery could NOT save burns budget —
         # the burn rate over this ledger is what the autoscaler scales
@@ -1167,7 +1214,7 @@ class Router:
         # SLO report first: it publishes the slo.* gauges the metrics
         # snapshot should carry (same ordering as serving's snapshot)
         slo_report = self.slo.report()
-        return {
+        snap = {
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "pid": _os.getpid(),
             "role": "router",
@@ -1179,6 +1226,45 @@ class Router:
             "replicas": self.replica_views(),
             "timeseries": self.timeseries.stats(),
         }
+        if self.tenant_ledger is not None:
+            snap["tenants"] = self.tenant_ledger.snapshot()
+        return snap
+
+    def tenant_debug(self):
+        """GET /debug/tenants body: the live-fleet tenant view.
+
+        `router` is this edge's own book (every answered request,
+        including sheds no replica saw); `replicas` holds each routable
+        replica's ledger snapshot fetched over HTTP; `fleet` is their
+        Space-Saving merge — REPLICA books only, because router and
+        replica both bill requests at their own hop and summing the two
+        would double-count (`tools/telemetry_agg.py` applies the same
+        rule to exporter dumps).  An unreachable replica is skipped and
+        named in `unreachable` — a partial fleet view says so."""
+        with self._lock:
+            targets = [(rep.id, rep.address)
+                       for rep in self._replicas.values()
+                       if rep.state in ("up", "draining")]
+        per, unreachable = {}, []
+        for rid, address in sorted(targets):
+            try:
+                code, _hdrs, body = self.transport.request(
+                    address, "GET", "/debug/tenants",
+                    timeout=max(1.0, self.probe_interval * 4))
+                snap = json.loads(body or b"{}")
+                if code == 200 and isinstance(snap, dict):
+                    per[rid] = snap
+                else:
+                    unreachable.append(rid)
+            except Exception:
+                unreachable.append(rid)
+        out = {"role": "router", "replicas": per,
+               "fleet": _tledger.merge_snapshots(list(per.values()))}
+        if self.tenant_ledger is not None:
+            out["router"] = self.tenant_ledger.snapshot()
+        if unreachable:
+            out["unreachable"] = unreachable
+        return out
 
     # ------------------------------------------------------------------
     # lifecycle

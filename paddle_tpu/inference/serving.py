@@ -24,6 +24,19 @@ Protocol (stdlib-only, zero heavy deps):
   GET  /debug/telemetry   JSON snapshot: metrics, the SLO report
                   (windowed burn rate, shed reasons), admission stats,
                   readiness, recent flight events.
+  GET  /debug/tenants     per-tenant metering (ISSUE 16): the bounded
+                  top-K tenant table + `~other` overflow bucket from the
+                  `TenantLedger` — requests by status, prefill tokens
+                  computed/saved, decode tokens, decode-slot-ms, KV
+                  page-seconds, TTFT/ITL summaries.  This JSON surface
+                  is DELIBERATELY not rendered on /metrics (cardinality
+                  discipline — docs/OBSERVABILITY.md).
+
+Tenant identity (ISSUE 16): `X-Tenant-Id` names who to BILL.  Parsed at
+the edge next to `X-Request-Id`; a request without one falls back to
+`fp:<prefix-fingerprint>` (the X-Prefix-Fingerprint routing hint — the
+natural cohort key for a shared-prefix population) and finally to
+`anon`, so EVERY request lands in exactly one ledger row.
 
 Request identity (observability/request_trace.py): every /predict
 response echoes `X-Request-Id`; incoming `X-Request-Id`/`traceparent`
@@ -65,6 +78,7 @@ import numpy as np
 from . import Config, create_predictor
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
+from ..observability import tenant_ledger as _tledger
 from ..observability import timeseries as _ts
 from ..observability import trace as _trace
 from ..observability.slo import SLOTracker
@@ -162,6 +176,15 @@ class InferenceServer:
         # — shedding starts only past actual saturation, not at the
         # predictor lock's conservative default
         self.engine = engine
+        # per-tenant metering (ISSUE 16): adopt the engine's ledger so
+        # serving-edge request billing and engine-side token billing
+        # share ONE book (conservation is per-book); predict-only
+        # deployments get their own.  None when the plane is off —
+        # every call site guards, so detached telemetry pays nothing.
+        self.tenant_ledger = getattr(engine, "tenant_ledger", None)
+        if self.tenant_ledger is None and _tledger.enabled() \
+                and _metrics.enabled():
+            self.tenant_ledger = _tledger.TenantLedger()
         self.gen_admission = None
         if engine is not None:
             self.gen_admission = AdmissionController(
@@ -339,6 +362,21 @@ class InferenceServer:
                         return self._json(
                             500, {"error": f"{type(e).__name__}: {e}"})
                     return self._json(200, snap)
+                if self.path == "/debug/tenants":
+                    # the per-tenant table's ONLY HTTP surface: JSON
+                    # here, never /metrics (a tenant id must not mint
+                    # a Prometheus series — docs/OBSERVABILITY.md)
+                    if server.tenant_ledger is None:
+                        return self._json(
+                            404, {"error": "tenant ledger disabled "
+                                           "(PADDLE_TPU_TENANT_LEDGER"
+                                           "=0 or metrics detached)"})
+                    try:
+                        body = server.tenant_ledger.snapshot()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, body)
                 if self.path == "/debug/timeseries":
                     try:
                         body = server.timeseries.describe()
@@ -374,6 +412,16 @@ class InferenceServer:
                 # echoed on every response below, context active for
                 # every span/metric the request touches
                 ctx = _rtrace.continue_from_headers(self.headers)
+                if ctx.tenant_id is None:
+                    # billing fallback chain (ISSUE 16): no X-Tenant-Id
+                    # → derive a cohort key from the prefix-fingerprint
+                    # routing hint (tenants sharing a prompt prefix
+                    # share a bill), else `anon` — the ledger never
+                    # sees an unattributed request
+                    fp = self.headers.get("X-Prefix-Fingerprint")
+                    tid = _tledger.sanitize_tenant(f"fp:{fp}") \
+                        if fp else None
+                    ctx.tenant_id = tid or _tledger.ANON_TENANT
                 self._rt_ctx = ctx
                 with _rtrace.activate(ctx):
                     if self.path == "/generate":
@@ -446,7 +494,8 @@ class InferenceServer:
                         handle = server.engine.submit(
                             ids, max_new_tokens=max_new,
                             eos_token_id=eos,
-                            request_id=ctx.request_id)
+                            request_id=ctx.request_id,
+                            tenant_id=ctx.tenant_id)
                     except _DETERMINISTIC_ERRORS as e:
                         status = "client_error"
                         return self._json(
@@ -480,6 +529,9 @@ class InferenceServer:
                                 if server.anomalies is not None:
                                     server.anomalies.observe("itl",
                                                              gap_ms)
+                                if server.tenant_ledger is not None:
+                                    server.tenant_ledger.observe_itl(
+                                        ctx.tenant_id, gap_ms)
                             last_at = now
                             if first_at is None:
                                 # time-to-first-token, labeled by the
@@ -506,6 +558,9 @@ class InferenceServer:
                                 if server.anomalies is not None:
                                     server.anomalies.observe("ttft",
                                                              ttft_ms)
+                                if server.tenant_ledger is not None:
+                                    server.tenant_ledger.observe_ttft(
+                                        ctx.tenant_id, ttft_ms)
                             self.wfile.write(
                                 json.dumps({"token": int(tok)}).encode()
                                 + b"\n")
@@ -549,6 +604,9 @@ class InferenceServer:
                     _metrics.observe("serving.request_ms", dt_ms,
                                      endpoint="generate", status=status)
                     _metrics.inc("serving.requests", status=status)
+                    if server.tenant_ledger is not None:
+                        server.tenant_ledger.record_request(
+                            ctx.tenant_id, status)
                     server._slo_record(status, slo_reason, dt_ms,
                                        endpoint="generate")
 
@@ -616,6 +674,9 @@ class InferenceServer:
                     _metrics.observe("serving.request_ms", dt_ms,
                                      endpoint="predict", status=status)
                     _metrics.inc("serving.requests", status=status)
+                    if server.tenant_ledger is not None:
+                        server.tenant_ledger.record_request(
+                            ctx.tenant_id, status)
                     server._slo_record(status, slo_reason, dt_ms)
 
         self._httpd = _ServingHTTPServer((host, port), Handler)
@@ -686,6 +747,8 @@ class InferenceServer:
             "flight": _flight.events()[-64:],
         }
         snap["timeseries"] = self.timeseries.stats()
+        if self.tenant_ledger is not None:
+            snap["tenants"] = self.tenant_ledger.snapshot()
         if self.anomalies is not None:
             snap["anomalies"] = self.anomalies.report()
         if self.engine is not None:
@@ -930,13 +993,18 @@ class StreamInterrupted(RuntimeError):
     generated tokens; `finish_reason` names the cut."""
 
     def __init__(self, message, output_ids=None, tokens=(),
-                 finish_reason="interrupted", request_id=None):
+                 finish_reason="interrupted", request_id=None,
+                 tenant_id=None):
         super().__init__(message)
         self.output_ids = (None if output_ids is None
                            else np.asarray(output_ids, np.int32))
         self.tokens = list(tokens)
         self.finish_reason = finish_reason
         self.request_id = request_id
+        # who was being billed when the stream cut (ISSUE 16): the
+        # caller resubmitting the resumable prefix keeps ONE tenant
+        # identity across the interruption
+        self.tenant_id = tenant_id
 
 
 class InferenceClient:
@@ -946,12 +1014,23 @@ class InferenceClient:
 
     def __init__(self, address: str, timeout: float = 120.0,
                  retries: int = 2, max_retry_wait: float = 5.0,
-                 sleep=time.sleep, fingerprint_tokens: int = 64):
+                 sleep=time.sleep, fingerprint_tokens: int = 64,
+                 tenant_id=None):
         self.address = address.rstrip("/")
         self.timeout = float(timeout)
         self.retries = max(0, int(retries))
         self.max_retry_wait = float(max_retry_wait)
         self.sleep = sleep
+        # billing identity (ISSUE 16): stamped as X-Tenant-Id on every
+        # request this client sends.  Validated HERE, loudly — a typo'd
+        # tenant silently degrading to `anon` would misbill forever.
+        if tenant_id is not None \
+                and _tledger.sanitize_tenant(tenant_id) is None:
+            raise ValueError(
+                f"invalid tenant_id {tenant_id!r}: want 1-64 chars of "
+                f"[A-Za-z0-9._:-]")
+        self.tenant_id = (None if tenant_id is None
+                          else str(tenant_id))
         # prefix-affinity fingerprint length (ISSUE 13): generate()
         # sends a cheap hash of the first N page-aligned prompt tokens
         # so a router can keep repeat tenants where their prefix cache
@@ -1045,6 +1124,12 @@ class InferenceClient:
         data = json.dumps(body).encode()
         amb = _rtrace.current()
         ctx = amb.child() if amb is not None else _rtrace.new_context()
+        if ctx.tenant_id is None and self.tenant_id is not None:
+            # one tenant identity minted BEFORE the retry loop (same
+            # discipline as X-Request-Id): every attempt of one request
+            # bills the same ledger row.  An ambient hop's tenant wins —
+            # re-stamping mid-chain would split one request's bill.
+            ctx.tenant_id = self.tenant_id
         headers = {"Content-Type": "application/json"}
         headers.update(ctx.to_headers())
         if self.fingerprint_tokens:
@@ -1088,7 +1173,8 @@ class InferenceClient:
                                     tokens=tokens,
                                     finish_reason=evt.get(
                                         "finish_reason", "interrupted"),
-                                    request_id=evt.get("request_id"))
+                                    request_id=evt.get("request_id"),
+                                    tenant_id=ctx.tenant_id)
                             tokens.append(int(evt["token"]))
                             if on_token is not None:
                                 on_token(int(evt["token"]))
@@ -1119,6 +1205,7 @@ class InferenceClient:
                 "tokens": tokens,
                 "finish_reason": final.get("finish_reason"),
                 "request_id": final.get("request_id"),
+                "tenant_id": ctx.tenant_id,
             }
 
     def predict(self, *arrays, **named) -> dict:
@@ -1138,6 +1225,8 @@ class InferenceClient:
         # request) continues as the next hop instead of starting over.
         amb = _rtrace.current()
         ctx = amb.child() if amb is not None else _rtrace.new_context()
+        if ctx.tenant_id is None and self.tenant_id is not None:
+            ctx.tenant_id = self.tenant_id  # one identity, all attempts
         headers = {"Content-Type": "application/octet-stream"}
         headers.update(ctx.to_headers())
         for attempt in range(self.retries + 1):
@@ -1196,6 +1285,8 @@ def serve(model_path: str, host: str = "127.0.0.1", port: int = 8866):
 
         exporter = TelemetryExporter(
             slo=srv.slo.report,
+            tenants=(srv.tenant_ledger.snapshot
+                     if srv.tenant_ledger is not None else None),
             timelines=getattr(srv.engine, "recent_timelines",
                               None)).start()
     print(f"serving {model_path} at {srv.address}")

@@ -26,13 +26,13 @@ from __future__ import annotations
 
 from . import (  # noqa: F401
     export, flight, goodput, metrics, request_trace, slo, step_stats,
-    timeseries, trace, xla_cost,
+    tenant_ledger, timeseries, trace, xla_cost,
 )
 from .step_stats import StepTimer  # noqa: F401
 
 __all__ = ["metrics", "flight", "step_stats", "trace", "xla_cost",
-           "request_trace", "slo", "export", "goodput", "timeseries",
-           "StepTimer", "attach", "detach"]
+           "request_trace", "slo", "export", "goodput", "tenant_ledger",
+           "timeseries", "StepTimer", "attach", "detach"]
 
 # The snapshot-schema floor `attach()` guarantees: these counters exist
 # (at 0) in every telemetry snapshot even when the path never fired in
@@ -134,6 +134,11 @@ _SCHEMA_COUNTERS = tuple(
     # detections by kind — zero on a healthy server, never absent
     + [("telemetry.anomalies", {"kind": k})
        for k in ("ttft", "itl")]
+    # tenant metering (ISSUE 16): bounded-cardinality aggregate mirror
+    # of the ledger — the per-tenant top-K table itself lives ONLY in
+    # /debug/tenants and telemetry dumps, never the metrics registry
+    + [("tenant.requests", {"status": s})
+       for s in ("ok", "shed", "client_error", "error")]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
@@ -150,7 +155,10 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
                   # prefix cache (ISSUE 13): radix-index size + lifetime
                   # hit rate — the /ready payload's gauge pair
                   "engine.prefix_cached_tokens",
-                  "engine.prefix_cache_hit_rate") \
+                  "engine.prefix_cache_hit_rate",
+                  # tenant ledger (ISSUE 16): sketch occupancy + overflow
+                  # mass — the only per-registry trace of the top-K table
+                  "tenant.tracked", "tenant.other_tokens") \
     + tuple(("telemetry.timeseries_samples", {"sampler": s})
             # timeseries sampler health (ISSUE 15): total samples per
             # sampler — a flat-lined value is that sampler's own

@@ -25,8 +25,14 @@ Schema (`telemetry_dump/v1`) — one line per dump:
      "timeseries": {"interval_s": f,     # OPTIONAL (ISSUE 15): NEW
                     "frames": [...]},    # sampler frames since the last
                                          # dump (incremental by seq)
-     "request_timelines": [...]}         # OPTIONAL: recent per-request
+     "request_timelines": [...],         # OPTIONAL: recent per-request
                                          # timeline summaries
+     "tenants": {...}}                   # OPTIONAL (ISSUE 16): the
+                                         # process's TenantLedger
+                                         # snapshot (full state, not
+                                         # incremental — the aggregator
+                                         # merges each process's LAST
+                                         # dump)
 
 Incremental on purpose: the tracer buffer holds 64k events — a
 per-interval full snapshot would quadratically re-ship history.  Both
@@ -100,7 +106,7 @@ class TelemetryExporter:
 
     def __init__(self, outdir=None, interval_s=None, run_id=None,
                  rank=None, host=None, pid=None, slo=None, extra=None,
-                 timelines=None):
+                 timelines=None, tenants=None):
         outdir = outdir or os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
         if not outdir:
             raise ValueError(
@@ -122,6 +128,10 @@ class TelemetryExporter:
         # summaries (ISSUE 15): a replica's exporter embeds the engine's
         # per-request latency story next to its metrics
         self.timelines = timelines
+        # optional zero-arg callable returning a TenantLedger snapshot
+        # (ISSUE 16): each dump carries the process's CURRENT tenant
+        # book; telemetry_agg merges the fleet's last dumps
+        self.tenants = tenants
         self.extra = dict(extra or {})
         name = f"telemetry_{self.host}_{self.pid}"
         if self.rank is not None:
@@ -198,6 +208,11 @@ class TelemetryExporter:
                     # provider never sinks the dump, but stays VISIBLE
                     line["request_timelines_error"] = \
                         f"{type(e).__name__}: {e}"
+            if self.tenants is not None:
+                try:
+                    line["tenants"] = self.tenants()
+                except Exception as e:
+                    line["tenants_error"] = f"{type(e).__name__}: {e}"
             os.makedirs(self.outdir, exist_ok=True)
             with open(self.path, "a") as f:
                 f.write(json.dumps(line, default=str) + "\n")
@@ -297,7 +312,7 @@ def validate_telemetry_stream(entries) -> list:
                     f"{type(e[key]).__name__}, expected {typ}")
         if e.get("schema") not in (None, SCHEMA_VERSION):
             errors.append(f"entry {i}: unknown schema {e.get('schema')!r}")
-        for key in ("metrics", "slo", "timeseries"):
+        for key in ("metrics", "slo", "timeseries", "tenants"):
             if key in e and e[key] is not None \
                     and not isinstance(e[key], dict):
                 errors.append(f"entry {i}: key {key!r} not an object")

@@ -41,17 +41,24 @@ import uuid
 __all__ = [
     "RequestContext", "new_context", "current", "activate",
     "continue_from_headers", "request_phase", "HEADER_REQUEST_ID",
-    "HEADER_TRACEPARENT",
+    "HEADER_TRACEPARENT", "HEADER_TENANT_ID",
 ]
 
 HEADER_REQUEST_ID = "X-Request-Id"
 HEADER_TRACEPARENT = "traceparent"
+# tenant identity (ISSUE 16): who to BILL, carried hop-to-hop next to
+# who to TRACE — the router's shed for a tenant and the replica's
+# decode for the same tenant land in one ledger row
+HEADER_TENANT_ID = "X-Tenant-Id"
 
 # 00-<32 hex trace id>-<16 hex span id>-<2 hex flags>
 _TRACEPARENT = re.compile(
     r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
 # request ids are echoed into headers and filenames: keep them tame
 _REQUEST_ID = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+# tenant ids are ledger keys and debug-table rows: same discipline
+# (mirrors tenant_ledger._TENANT_ID — this module stays standalone)
+_TENANT_ID = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
 
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "paddle_tpu_request", default=None)
@@ -72,29 +79,39 @@ class RequestContext:
     """One request's identity at one hop.  Immutable by convention —
     `child()` derives the next hop instead of mutating this one."""
 
-    __slots__ = ("request_id", "trace_id", "span_id", "parent_id", "hop")
+    __slots__ = ("request_id", "trace_id", "span_id", "parent_id",
+                 "hop", "tenant_id")
 
     def __init__(self, request_id=None, trace_id=None, span_id=None,
-                 parent_id=None, hop=0):
+                 parent_id=None, hop=0, tenant_id=None):
         self.request_id = str(request_id) if request_id \
             else uuid.uuid4().hex[:16]
         self.trace_id = str(trace_id) if trace_id else uuid.uuid4().hex
         self.span_id = str(span_id) if span_id else uuid.uuid4().hex[:16]
         self.parent_id = parent_id
         self.hop = int(hop)
+        # billing identity (ISSUE 16): None means "not established at
+        # this hop yet" — the serving edge resolves a fallback (prefix
+        # fingerprint, else anon) and every hop below inherits it
+        tid = str(tenant_id) if tenant_id is not None else None
+        self.tenant_id = tid if tid and _TENANT_ID.match(tid) else None
 
     def child(self) -> "RequestContext":
-        """The next hop: same request/trace identity, fresh span id,
-        this hop's span recorded as the parent."""
+        """The next hop: same request/trace/tenant identity, fresh
+        span id, this hop's span recorded as the parent."""
         return RequestContext(request_id=self.request_id,
                               trace_id=self.trace_id,
-                              parent_id=self.span_id, hop=self.hop + 1)
+                              parent_id=self.span_id, hop=self.hop + 1,
+                              tenant_id=self.tenant_id)
 
     def to_headers(self) -> dict:
-        return {
+        h = {
             HEADER_REQUEST_ID: self.request_id,
             HEADER_TRACEPARENT: f"00-{self.trace_id}-{self.span_id}-01",
         }
+        if self.tenant_id:
+            h[HEADER_TENANT_ID] = self.tenant_id
+        return h
 
     def trace_args(self) -> dict:
         """Span args carrying the identity (what every phase span and
@@ -103,6 +120,8 @@ class RequestContext:
                 "span_id": self.span_id, "hop": self.hop}
         if self.parent_id:
             args["parent_span_id"] = self.parent_id
+        if self.tenant_id:
+            args["tenant_id"] = self.tenant_id
         return args
 
     def to_dict(self) -> dict:
@@ -129,21 +148,27 @@ class RequestContext:
         rid = get(HEADER_REQUEST_ID)
         if rid is not None and not _REQUEST_ID.match(str(rid)):
             rid = None  # hostile/garbage id: mint our own
+        tid = get(HEADER_TENANT_ID)
+        if tid is not None and not _TENANT_ID.match(str(tid)):
+            tid = None  # hostile/garbage tenant: treat as unset — the
+            # edge's fallback derivation owns it from here (a garbage
+            # header must not mint a garbage ledger key)
         tp = get(HEADER_TRACEPARENT)
         m = _TRACEPARENT.match(str(tp).strip().lower()) if tp else None
-        if rid is None and m is None:
+        if rid is None and m is None and tid is None:
             return None
         if m is not None:
             # the sender's span becomes our parent; we are a new hop
             return cls(request_id=rid, trace_id=m.group(1),
-                       parent_id=m.group(2), hop=1)
-        return cls(request_id=rid)
+                       parent_id=m.group(2), hop=1, tenant_id=tid)
+        return cls(request_id=rid, tenant_id=tid)
 
 
-def new_context(request_id=None) -> RequestContext:
+def new_context(request_id=None, tenant_id=None) -> RequestContext:
     """Fresh hop-0 context (what a client mints once per request, BEFORE
-    its retry loop — all attempts of one request share one id)."""
-    return RequestContext(request_id=request_id)
+    its retry loop — all attempts of one request share one id AND one
+    tenant identity)."""
+    return RequestContext(request_id=request_id, tenant_id=tenant_id)
 
 
 def current():

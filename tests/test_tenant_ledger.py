@@ -1,0 +1,396 @@
+"""Per-tenant metering tests (ISSUE 16): the Space-Saving top-K sketch
+(bounded cardinality, eviction folding, the conservation invariant),
+the fleet snapshot merge, engine-token coherence, the bounded aggregate
+mirror on the metrics registry (and the top-K table's deliberate
+ABSENCE from /metrics), tenant identity propagation (headers, client
+ctor, loadgen stamping), the serving-edge fallback chain over a live
+toy server, and the telemetry_agg fleet rollup."""
+import http.client
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.inference.fleet import EchoPredictor, ToyEngine
+from paddle_tpu.inference.serving import InferenceClient, InferenceServer
+from paddle_tpu.observability import metrics, request_trace, trace
+from paddle_tpu.observability import tenant_ledger as tl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    """Full stack on, clean registries, everything off again after.
+    Reset BEFORE attach: attach() declares the schema zeros a reset
+    would wipe."""
+    metrics.reset()
+    trace.clear()
+    obs.flight.clear()
+    obs.attach(crash_hook=False)
+    yield
+    obs.detach()
+    metrics.reset()
+    trace.clear()
+    obs.flight.clear()
+
+
+# --------------------------------------------------------------------------
+# identity hygiene + env knobs
+# --------------------------------------------------------------------------
+
+def test_sanitize_tenant():
+    assert tl.sanitize_tenant("acme-prod_1.eu:a") == "acme-prod_1.eu:a"
+    assert tl.sanitize_tenant(None) is None
+    assert tl.sanitize_tenant("") is None
+    assert tl.sanitize_tenant("bad id") is None          # whitespace
+    assert tl.sanitize_tenant("x" * 65) is None          # overlong
+    assert tl.sanitize_tenant("a\nb") is None            # header-split
+    assert tl.sanitize_tenant(123) == "123"              # stringified
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_TENANT_LEDGER", raising=False)
+    assert tl.enabled()
+    monkeypatch.setenv("PADDLE_TPU_TENANT_LEDGER", "0")
+    assert not tl.enabled()
+    monkeypatch.setenv("PADDLE_TPU_TENANT_TOPK", "7")
+    assert tl.topk() == 7
+    assert tl.TenantLedger().k == 7
+    monkeypatch.setenv("PADDLE_TPU_TENANT_TOPK", "bogus")
+    assert tl.topk() == tl.DEFAULT_TOPK
+    monkeypatch.setenv("PADDLE_TPU_TENANT_TOPK", "-3")
+    assert tl.topk() == 1                                # floor at 1
+
+
+# --------------------------------------------------------------------------
+# the sketch: bounds, eviction folding, conservation
+# --------------------------------------------------------------------------
+
+def test_space_saving_bounds_and_folds():
+    led = tl.TenantLedger(k=4)
+    for i in range(100):
+        led.record_request(f"t{i}", "ok")
+        led.record_decode(f"t{i}", 3, count_engine_tokens=False)
+    snap = led.snapshot()
+    assert snap["schema"] == tl.SCHEMA_VERSION
+    assert snap["tracked"] == 4 and len(snap["tenants"]) == 4
+    assert snap["distinct_seen"] == 100
+    assert snap["other"]["folds"] == 96
+    # evicted tenants' EXACT counts live in ~other, nothing dropped
+    assert snap["other"]["requests"]["ok"] == 96
+    assert snap["other"]["decode_tokens"] == 96 * 3
+    assert snap["totals"]["requests"]["ok"] == 100
+    assert snap["totals"]["decode_tokens"] == 300
+    assert tl.conservation_delta(snap) == {}
+    # Space-Saving over-estimate bound: a late newcomer inherited the
+    # victim's weight, and says so via err > 0
+    assert any(e["err"] > 0 for e in snap["tenants"].values())
+
+
+def test_heavy_hitter_survives_churn():
+    led = tl.TenantLedger(k=4)
+    for burst in range(25):
+        led.record_request("whale", "ok")
+        led.record_decode("whale", 50, count_engine_tokens=False)
+        led.record_request(f"minnow-{burst}", "ok")
+    snap = led.snapshot()
+    assert "whale" in snap["tenants"]
+    assert snap["tenants"]["whale"]["decode_tokens"] == 25 * 50
+    assert tl.conservation_delta(snap) == {}
+
+
+def test_conservation_mixed_fields():
+    led = tl.TenantLedger(k=3)
+    for i in range(20):
+        t = f"t{i % 7}" if i % 3 else f"burst-{i}"
+        led.record_request(t, ("ok", "shed", "error")[i % 3])
+        led.record_prefill(t, computed=11 + i, saved=i % 5)
+        led.record_decode(t, 1 + i % 4, count_engine_tokens=False)
+        led.record_decode_slot_ms(t, 0.37 * (i + 1))
+        led.record_page_seconds(t, 0.011 * (i + 1))
+    assert led.conservation() == {}
+    snap = led.snapshot()
+    assert snap["totals"]["kv_page_seconds"] > 0
+    assert snap["totals"]["decode_slot_ms"] > 0
+    # a cooked snapshot must FAIL the check (the gate can actually trip)
+    snap["totals"]["decode_tokens"] += 5
+    assert tl.conservation_delta(snap) == {"decode_tokens": 5}
+
+
+def test_status_discipline_and_anon_fallback():
+    led = tl.TenantLedger(k=4)
+    led.record_request("t1", "timeout")      # → error (bounded statuses)
+    led.record_request("t1", "exploded")     # → error
+    led.record_request("bad id!", "ok")      # hostile id → anon
+    led.record_request(None, "ok")           # absent id → anon
+    snap = led.snapshot()
+    assert snap["tenants"]["t1"]["requests"] == {"error": 2}
+    assert snap["tenants"][tl.ANON_TENANT]["requests"] == {"ok": 2}
+
+
+def test_latency_reservoirs_top_k_only():
+    led = tl.TenantLedger(k=2)
+    led.record_request("a", "ok")
+    led.record_request("b", "ok")
+    for ms in (10.0, 20.0, 30.0):
+        led.observe_ttft("a", ms)
+        led.observe_itl("a", ms / 10)
+    # an untracked tenant's sample is dropped, never admits it
+    led.observe_ttft("stranger", 999.0)
+    snap = led.snapshot()
+    a = snap["tenants"]["a"]
+    assert a["ttft_ms"]["n"] == 3 and a["ttft_ms"]["max"] == 30.0
+    assert a["itl_ms"]["p50"] == pytest.approx(2.0)
+    assert "ttft_ms" not in snap["tenants"]["b"]
+    assert "stranger" not in snap["tenants"]
+    assert snap["distinct_seen"] == 2
+
+
+# --------------------------------------------------------------------------
+# engine-token coherence + the bounded registry mirror
+# --------------------------------------------------------------------------
+
+def test_engine_token_coherence(telemetry):
+    led = tl.TenantLedger(k=4)
+    led.record_decode("t1", 5)               # owns the engine.tokens inc
+    led.record_decode("t2", 2)
+    led.record_decode("t3", 4, count_engine_tokens=False)  # alien bill
+    snap = led.snapshot()
+    assert snap["totals"]["decode_tokens"] == 11
+    # the in-lock read-back: 7 engine tokens were billed THROUGH this
+    # ledger; the count_engine_tokens=False path left the counter alone
+    assert snap["metrics_engine_tokens"] == 7
+    assert metrics.snapshot()["counters"]["engine.tokens"] == 7
+
+
+def test_schema_zero_values(telemetry):
+    counters = metrics.snapshot()["counters"]
+    for s in ("ok", "shed", "client_error", "error"):
+        assert counters[f"tenant.requests{{status={s}}}"] == 0
+    gauges = metrics.snapshot()["gauges"]
+    assert gauges["tenant.tracked"] == 0
+    assert gauges["tenant.other_tokens"] == 0
+
+
+def test_prometheus_excludes_tenant_table(telemetry):
+    led = tl.TenantLedger(k=2)
+    led.record_request("secret-tenant-alpha", "ok")
+    led.record_request("secret-tenant-beta", "shed")
+    led.record_request("secret-tenant-gamma", "ok")   # evicts one
+    led.record_decode("secret-tenant-alpha", 9)
+    snap = led.snapshot()                    # publishes the gauges
+    assert snap["tracked"] == 2
+    prom = metrics.to_prometheus()
+    # the bounded aggregates ARE scrape-able...
+    assert 'paddle_tpu_tenant_requests{status="ok"}' in prom
+    assert "paddle_tpu_tenant_tracked 2" in prom
+    # ...but no tenant id ever mints a metric series (cardinality
+    # discipline: the top-K table lives ONLY in /debug/tenants + dumps)
+    assert "secret-tenant" not in prom
+    counters = metrics.snapshot()["counters"]
+    assert counters["tenant.requests{status=ok}"] == 2
+    assert counters["tenant.requests{status=shed}"] == 1
+
+
+# --------------------------------------------------------------------------
+# fleet merge
+# --------------------------------------------------------------------------
+
+def _mini_ledger(spec, k=4):
+    led = tl.TenantLedger(k=k)
+    for t, (ok, toks) in spec.items():
+        for _ in range(ok):
+            led.record_request(t, "ok")
+        led.record_decode(t, toks, count_engine_tokens=False)
+        led.record_page_seconds(t, toks * 0.25)
+    return led
+
+
+def test_merge_snapshots_sums_and_conserves():
+    s1 = _mini_ledger({"a": (3, 30), "b": (1, 10)}).snapshot()
+    s2 = _mini_ledger({"a": (2, 20), "c": (4, 40)}).snapshot()
+    fleet = tl.merge_snapshots([s1, s2])
+    assert fleet["merged_from"] == 2
+    assert fleet["tenants"]["a"]["requests"]["ok"] == 5
+    assert fleet["tenants"]["a"]["decode_tokens"] == 50
+    assert fleet["tenants"]["a"]["kv_page_seconds"] == pytest.approx(
+        12.5)
+    assert fleet["totals"]["decode_tokens"] == 100
+    assert fleet["distinct_seen"] == 4
+    assert tl.conservation_delta(fleet) == {}
+    # latency summaries are NOT additive → deliberately absent
+    assert all("ttft_ms" not in e for e in fleet["tenants"].values())
+
+
+def test_merge_truncates_union_to_k():
+    snaps = [_mini_ledger({f"t{i}-{j}": (1, 10 + i + j)
+                           for j in range(4)}, k=4).snapshot()
+             for i in range(3)]
+    fleet = tl.merge_snapshots(snaps, k=4)
+    assert len(fleet["tenants"]) == 4
+    # the 8 truncated tenants' counts folded into ~other, books balance
+    assert fleet["other"]["folds"] == 8
+    assert fleet["totals"]["requests"]["ok"] == 12
+    assert tl.conservation_delta(fleet) == {}
+
+
+def test_merge_sums_engine_tokens(telemetry):
+    led = tl.TenantLedger(k=4)
+    led.record_decode("t1", 6)
+    s = led.snapshot()
+    fleet = tl.merge_snapshots([s, dict(s)])
+    assert fleet["metrics_engine_tokens"] == 12
+
+
+# --------------------------------------------------------------------------
+# identity propagation: headers, client ctor, loadgen stamping
+# --------------------------------------------------------------------------
+
+def test_request_context_header_roundtrip():
+    ctx = request_trace.new_context(tenant_id="acme-1")
+    h = ctx.to_headers()
+    assert h[request_trace.HEADER_TENANT_ID] == "acme-1"
+    back = request_trace.RequestContext.from_headers(h)
+    assert back.tenant_id == "acme-1"
+    assert back.child().tenant_id == "acme-1"            # survives hops
+    # hostile header values are dropped at parse, not propagated
+    h[request_trace.HEADER_TENANT_ID] = "bad id\r\nX-Evil: 1"
+    assert request_trace.RequestContext.from_headers(h).tenant_id is None
+    assert request_trace.new_context().tenant_id is None
+
+
+def test_client_tenant_validation():
+    with pytest.raises(ValueError):
+        InferenceClient("http://h:1", tenant_id="bad id!")
+    with pytest.raises(ValueError):
+        InferenceClient("http://h:1", tenant_id="x" * 65)
+    c = InferenceClient("http://h:1", tenant_id="team.red:eu-1")
+    assert c.tenant_id == "team.red:eu-1"
+    assert InferenceClient("http://h:1").tenant_id is None
+
+
+def test_loadgen_stamps_tenant_header():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+    assert loadgen.tenant_name(3) == "tenant-3"
+    # the stamped id is always ledger-legal (never degrades to anon)
+    assert tl.sanitize_tenant(loadgen.tenant_name(7)) == "tenant-7"
+
+
+# --------------------------------------------------------------------------
+# the serving edge: fallback chain + /debug/tenants over a live server
+# --------------------------------------------------------------------------
+
+def _stream_generate(address, body, headers=()):
+    host, port = address.split("//", 1)[1].rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(dict(headers))
+    conn.request("POST", "/generate", body=json.dumps(body),
+                 headers=hdrs)
+    resp = conn.getresponse()
+    status = resp.status
+    for line in resp:
+        line = line.strip()
+        if line and json.loads(line).get("done"):
+            break
+    conn.close()
+    return status
+
+
+def test_serving_edge_fallback_chain(telemetry):
+    srv = InferenceServer(engine=ToyEngine(max_slots=4,
+                                           token_time=0.001),
+                          predictor=EchoPredictor(),
+                          request_timeout=30.0).start()
+    try:
+        body = {"input_ids": [1, 2, 3], "max_new_tokens": 2}
+        # 1) explicit header wins
+        assert _stream_generate(srv.address, body,
+                                {"X-Tenant-Id": "acme"}) == 200
+        # 2) no header → prefix-fingerprint cohort key
+        assert _stream_generate(
+            srv.address, body,
+            {"X-Prefix-Fingerprint": "abc123"}) == 200
+        # 3) nothing at all → anon (the ledger never sees an
+        #    unattributed request)
+        assert _stream_generate(srv.address, body) == 200
+        with urllib.request.urlopen(srv.address + "/debug/tenants",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        rows = snap["tenants"]
+        for t in ("acme", "fp:abc123", tl.ANON_TENANT):
+            assert rows[t]["requests"]["ok"] == 1
+            assert rows[t]["decode_tokens"] > 0
+            assert rows[t]["ttft_ms"]["n"] >= 1
+        assert tl.conservation_delta(snap) == {}
+        # the toy engine bills decode THROUGH the adopted ledger, so
+        # the in-lock read-back matches the books exactly
+        assert snap["metrics_engine_tokens"] \
+            == snap["totals"]["decode_tokens"]
+        # the ledger also rides /debug/telemetry for the exporter
+        with urllib.request.urlopen(srv.address + "/debug/telemetry",
+                                    timeout=10) as r:
+            tele = json.loads(r.read())
+        assert tele["tenants"]["totals"]["requests"]["ok"] == 3
+    finally:
+        srv.shutdown()
+
+
+def test_debug_tenants_404_when_disabled(telemetry, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TENANT_LEDGER", "0")
+    srv = InferenceServer(predictor=EchoPredictor(),
+                          request_timeout=30.0).start()
+    try:
+        assert srv.tenant_ledger is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.address + "/debug/tenants",
+                                   timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------------
+# fleet rollup: tools/telemetry_agg.py
+# --------------------------------------------------------------------------
+
+def test_telemetry_agg_rollup_tenants(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_tagg", os.path.join(REPO, "tools", "telemetry_agg.py"))
+    agg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(agg)
+
+    def dump_line(host, pid, tenants_snap):
+        return {"phase": "telemetry_dump", "t": "2026-08-04T00:00:00",
+                "schema": "telemetry_dump/v1", "host": host,
+                "pid": pid, "rank": None, "run_id": f"proc_{pid}",
+                "seq": 1, "reason": "periodic", "wall": 1000.0,
+                "trace_wall_epoch": 999.0, "trace_events": [],
+                "flight_events": [],
+                "metrics": {"counters": {}, "gauges": {},
+                            "histograms": {}},
+                "tenants": tenants_snap}
+
+    s1 = _mini_ledger({"a": (3, 30), "b": (1, 10)}).snapshot()
+    s2 = _mini_ledger({"a": (2, 20), "c": (4, 40)}).snapshot()
+    for name, pid, snap in (("a", 11, s1), ("b", 22, s2)):
+        with open(tmp_path / f"telemetry_{name}_{pid}.jsonl", "w") as f:
+            f.write(json.dumps(dump_line(name, pid, snap)) + "\n")
+    roll = agg.rollup(agg.load_dumps(str(tmp_path)))
+    tenants = roll["tenants"]
+    assert sorted(tenants["per_process"]) == ["a:11", "b:22"]
+    fleet = tenants["fleet"]
+    assert fleet["tenants"]["a"]["requests"]["ok"] == 5
+    assert fleet["totals"]["decode_tokens"] == 100
+    assert tl.conservation_delta(fleet) == {}

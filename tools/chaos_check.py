@@ -57,7 +57,17 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
     burst.  Router failover/ejection counters and the
     `router.replicas{state}` gauges must be visible in the telemetry
     snapshot AND in a `tools/telemetry_agg.py` rollup of the fleet's
-    dumps.
+    dumps.  ISSUE 16: every replica's tenant-ledger book and the
+    rollup's fleet merge must conserve (Σ per-tenant decode tokens +
+    `~other` == `engine.tokens`) despite the kill/drain, the router's
+    per-tenant ok counts must equal the clients' own tallies, and a
+    10k-distinct-tenant sweep must stay within the K-entry bound.
+
+Both `fleet` and `surge` additionally prove the metering plane's
+bounded cardinality and conservation under churn; `surge` cross-checks
+the loadgen per-tenant breakdown against the router's edge ledger and
+reads the live `/debug/tenants` fleet merge; `prefix` gates per-tenant
+prefix-saved attribution on that same surface.
 
 Exit 0 = recovered; exit 1 = a reflex failed.  CI runs this alongside
 the `chaos`-marked pytest matrix (kept out of tier-1 — see pytest.ini).
@@ -669,6 +679,10 @@ def run_prefix_chaos(seed=0, new_tokens=8):
                            "max_new_tokens": new_tokens})
         conn.request("POST", "/generate", body=body, headers={
             "Content-Type": "application/json",
+            # the two system prompts alternate: each tenant's SECOND
+            # request re-prefills its shared prefix from the cache, so
+            # /debug/tenants must attribute the saved tokens to it
+            "X-Tenant-Id": f"tenant-{i % 2}",
             # fingerprint of NOTHING this prompt shares: must route
             # somewhere and still stream the exact reference tokens
             "X-Prefix-Fingerprint": "feedfacefeedface"})
@@ -686,6 +700,43 @@ def run_prefix_chaos(seed=0, new_tokens=8):
         if out is None or not np.array_equal(
                 np.asarray(out, np.int32), refs[i]):
             poisoned_ok = False
+    # per-tenant prefix-saved attribution over the LIVE fleet
+    # (ISSUE 16): each tenant's shared 16-token (2-page) system prompt
+    # is prefilled twice against ONE pinned replica (the router's
+    # affinity/least-loaded choice between equally-idle replicas is
+    # probe-timing dependent, and this gate is about metering, not
+    # routing) — the second request must ride the radix cache, and the
+    # router's /debug/tenants fleet merge must show BOTH tenants with
+    # computed prefill AND nonzero prefill_saved_tokens, books
+    # conserved
+    import urllib.request as _urlreq
+
+    from paddle_tpu.observability import tenant_ledger as _tl
+    for i, p in enumerate(prompts[4:8]):
+        conn = http.client.HTTPConnection(
+            *servers[0]._httpd.server_address[:2], timeout=30)
+        conn.request("POST", "/generate", body=json.dumps({
+            "input_ids": [int(x) for x in p],
+            "max_new_tokens": new_tokens}),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant-Id": f"tenant-{i % 2}"})
+        resp = conn.getresponse()
+        for line in resp:
+            line = line.strip()
+            if line and json.loads(line).get("done"):
+                break
+        conn.close()
+    with _urlreq.urlopen(router.address + "/debug/tenants",
+                         timeout=10) as r:
+        tenant_debug = json.loads(r.read())
+    fleet_rows = (tenant_debug.get("fleet") or {}).get("tenants") or {}
+    attribution_ok = all(
+        fleet_rows.get(f"tenant-{i}", {}).get("prefill_tokens", 0) > 0
+        and fleet_rows.get(f"tenant-{i}", {})
+        .get("prefill_saved_tokens", 0) >= 16
+        for i in range(2))
+    tenant_conserves = not _tl.conservation_delta(
+        tenant_debug.get("fleet") or {})
     # kill a client mid-stream through the router: the replica must
     # cancel the sequence and reclaim its (non-cache) pages
     cancelled_before = metrics.snapshot()["counters"].get(
@@ -737,13 +788,21 @@ def run_prefix_chaos(seed=0, new_tokens=8):
         "stream_kill_first_line": bool(first_line),
         "stream_kill_cancelled": bool(kill_cancelled),
         "replica_page_leaks": replica_leaks,
+        "tenant_attribution": {
+            t: {f: row.get(f, 0) for f in ("prefill_tokens",
+                                           "prefill_saved_tokens")}
+            for t, row in fleet_rows.items()
+            if t.startswith("tenant-")},
+        "tenant_attribution_ok": bool(attribution_ok),
+        "tenant_conserves": bool(tenant_conserves),
         "recovered": (
             ref_leak == 0 and bool(survivors_ok)
             and cache_stats.get("hits", 0) > 0 and bool(pressure_ok)
             and bool(no_live_refs) and drain_leak == 0
             and ref_leak_count == 0 and bool(poisoned_ok)
             and bool(first_line) and bool(kill_cancelled)
-            and all(n == 0 for n in replica_leaks)),
+            and all(n == 0 for n in replica_leaks)
+            and bool(attribution_ok) and bool(tenant_conserves)),
     }
     return report
 
@@ -797,31 +856,37 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
                for i in range(n_generate)]
 
     def one_predict(i):
+        # every client carries a tenant identity (ISSUE 16): the
+        # client-side ok counts per tenant reconcile against the
+        # router's ledger below
+        tenant = f"tenant-{i % 3}"
         cli = InferenceClient(fleet.router.address, timeout=30,
-                              retries=1)
+                              retries=1, tenant_id=tenant)
         x = np.full((2, 2), float(i), np.float32)
         try:
             out = cli.predict(x=x)
             ok = bool(np.array_equal(out["y"], x))
-            row = ("predict", "ok" if ok else "corrupt", None)
+            row = ("predict", "ok" if ok else "corrupt", None, tenant)
         except urllib.error.HTTPError as e:
             row = ("predict",
                    "shed" if e.code in (429, 503) else "error",
-                   e.headers.get("Retry-After"))
+                   e.headers.get("Retry-After"), tenant)
         except Exception as e:  # noqa: BLE001 — report, don't crash
-            row = ("predict", "error", type(e).__name__)
+            row = ("predict", "error", type(e).__name__, tenant)
         with lock:
             results.append(row)
 
     def one_generate(i):
+        tenant = f"tenant-{i % 3}"
         cli = InferenceClient(fleet.router.address, timeout=30,
-                              retries=1)
+                              retries=1, tenant_id=tenant)
         prompt = prompts[i]
         expected = [toy_token(prompt, k) for k in range(new_tokens)]
         try:
             r = cli.generate(prompt, max_new_tokens=new_tokens)
             exact = r["tokens"] == expected
-            row = ("generate", "ok" if exact else "replayed", None)
+            row = ("generate", "ok" if exact else "replayed", None,
+                   tenant)
         except StreamInterrupted as e:
             # the clean mid-stream cut: a strict prefix, resumable
             prefix_ok = (e.tokens == expected[:len(e.tokens)]
@@ -829,13 +894,13 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
                          == list(prompt) + e.tokens)
             row = ("generate",
                    "interrupted" if prefix_ok else "replayed",
-                   len(e.tokens))
+                   len(e.tokens), tenant)
         except urllib.error.HTTPError as e:
             row = ("generate",
                    "shed" if e.code in (429, 503) else "error",
-                   e.code)
+                   e.code, tenant)
         except Exception as e:  # noqa: BLE001 — report, don't crash
-            row = ("generate", "error", type(e).__name__)
+            row = ("generate", "error", type(e).__name__, tenant)
         with lock:
             results.append(row)
 
@@ -877,6 +942,20 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     # the router process's own dump joins the replicas' in tel_dir
     TelemetryExporter(outdir=tel_dir, run_id="router").dump_once(
         reason="chaos_final")
+    # the router's edge ledger (ISSUE 16), read BEFORE the adversarial
+    # sweep below evicts the burst tenants from its top-K table
+    router_ledger = fleet.router.tenant_ledger
+    router_tenants_snap = (router_ledger.snapshot()
+                           if router_ledger is not None else {})
+    # bounded cardinality under adversarial identity churn: 10k
+    # distinct tenant ids against the LIVE router ledger must stay at
+    # O(K) entries with the books still balancing
+    sweep_n = 10_000
+    sweep_snap = {}
+    if router_ledger is not None:
+        for i in range(sweep_n):
+            router_ledger.record_request(f"sweep-{i}", "ok")
+        sweep_snap = router_ledger.snapshot()
     snap = metrics.snapshot()
     fleet.stop()
     obs.detach()
@@ -884,7 +963,7 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     counters = snap["counters"]
     gauges = snap["gauges"]
     by = {}
-    for kind, status, _extra in results:
+    for kind, status, _extra, _tenant in results:
         by.setdefault(kind, {}).setdefault(status, 0)
         by[kind][status] += 1
     pred = by.get("predict", {})
@@ -944,6 +1023,47 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
         if rc != 0:
             dumps_clean = False
 
+    # tenant metering gates (ISSUE 16), under the kill/drain chaos:
+    #   a) every replica book conserves internally (Σ tracked + other
+    #      == totals) AND its decode total equals the engine.tokens
+    #      counter read inside the same snapshot — the in-lock pairing
+    #      means a kill mid-stream can never skew a dump;
+    #   b) the telemetry_agg fleet merge of the replica books conserves
+    #      too (Σ tenant decode tokens + other == engine.tokens
+    #      fleet-wide);
+    #   c) the client-side ok counts per tenant equal the router
+    #      ledger's ok books exactly (failovers/retries collapse to the
+    #      one final outcome on both sides);
+    #   d) the 10k-distinct-id sweep above stayed within K entries.
+    _tl = obs.tenant_ledger
+    roll_tenants = roll.get("tenants") or {}
+    replica_books = {ident: s
+                     for ident, s in (roll_tenants.get("per_process")
+                                      or {}).items() if ":r" in ident}
+    tenant_replicas_conserve = bool(replica_books) and all(
+        not _tl.conservation_delta(s)
+        and s.get("metrics_engine_tokens")
+        == s.get("totals", {}).get("decode_tokens")
+        for s in replica_books.values())
+    fleet_book = roll_tenants.get("fleet") or {}
+    tenant_fleet_conserves = bool(fleet_book) \
+        and not _tl.conservation_delta(fleet_book) \
+        and fleet_book.get("metrics_engine_tokens") \
+        == fleet_book.get("totals", {}).get("decode_tokens")
+    client_ok = {}
+    for _kind, status, _extra, tenant in results:
+        if status == "ok":
+            client_ok[tenant] = client_ok.get(tenant, 0) + 1
+    router_ok = {
+        t: e["requests"]["ok"]
+        for t, e in (router_tenants_snap.get("tenants") or {}).items()
+        if t.startswith("tenant-") and "ok" in (e.get("requests") or {})}
+    tenant_client_match = client_ok == router_ok
+    tenant_sweep_bounded = bool(sweep_snap) \
+        and sweep_snap.get("tracked", 1 << 30) <= sweep_snap.get("k", 0) \
+        and sweep_snap.get("distinct_seen", 0) >= sweep_n \
+        and not _tl.conservation_delta(sweep_snap)
+
     report = {
         "scenario": "fleet",
         "replicas": n_replicas,
@@ -964,6 +1084,15 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
         "timeseries_continuity": continuity,
         "continuity_ok": bool(continuity_ok),
         "dumps_schema_clean": bool(dumps_clean),
+        "tenant_replicas_conserve": bool(tenant_replicas_conserve),
+        "tenant_fleet_conserves": bool(tenant_fleet_conserves),
+        "tenant_client_ok": client_ok,
+        "tenant_router_ok": router_ok,
+        "tenant_client_match": bool(tenant_client_match),
+        "tenant_sweep": {"distinct": sweep_snap.get("distinct_seen"),
+                         "tracked": sweep_snap.get("tracked"),
+                         "k": sweep_snap.get("k")},
+        "tenant_sweep_bounded": bool(tenant_sweep_bounded),
         "fleet_events": [e["kind"] for e in fleet.events],
         "recovered": (
             errors == 0 and accounted
@@ -976,6 +1105,10 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
             and bool(roll_has_router)
             and bool(itl_in_rollup) and bool(continuity_ok)
             and bool(dumps_clean)
+            and bool(tenant_replicas_conserve)
+            and bool(tenant_fleet_conserves)
+            and bool(tenant_client_match)
+            and bool(tenant_sweep_bounded)
             # the drain-first ordering actually held for the SIGTERM
             and fleet.events.index(
                 next(e for e in fleet.events
@@ -1076,6 +1209,21 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
         with _urlreq.urlopen(fleet.router.address + "/debug/telemetry",
                              timeout=10) as r:
             debug_snap = json.loads(r.read())
+        # tenant metering over the LIVE fleet (ISSUE 16): the router's
+        # /debug/tenants merges the surviving replicas' books
+        with _urlreq.urlopen(fleet.router.address + "/debug/tenants",
+                             timeout=10) as r:
+            tenant_debug = json.loads(r.read())
+        # bounded cardinality under identity churn: 10k distinct ids
+        # against the live router ledger (AFTER the debug snapshot —
+        # the sweep evicts the real tenants from the top-K table)
+        sweep_n = 10_000
+        sweep_snap = {}
+        if fleet.router.tenant_ledger is not None:
+            for i in range(sweep_n):
+                fleet.router.tenant_ledger.record_request(
+                    f"sweep-{i}", "ok")
+            sweep_snap = fleet.router.tenant_ledger.snapshot()
         scaler.stop()
         snap = metrics.snapshot()
     finally:
@@ -1143,6 +1291,34 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
     client_itl = s.get("itl_ms")
     phases_ok = all(ph in s.get("phases", {})
                     for ph in ("warm", "surge", "cool"))
+    # tenant metering gates (ISSUE 16): the router's edge book must
+    # agree with the loadgen's own per-tenant breakdown EXACTLY on ok
+    # counts (retried sheds bill per hop attempt, but each client row
+    # that ends ok is exactly one router ok); the /debug/tenants fleet
+    # merge and the router book must both conserve; and the 10k-id
+    # sweep must have stayed within K entries
+    _tl = obs.tenant_ledger
+    expected_tenants = {loadgen.tenant_name(i) for i in range(3)}
+    router_book = tenant_debug.get("router") or {}
+    fleet_book = tenant_debug.get("fleet") or {}
+    router_rows = router_book.get("tenants") or {}
+    tenants_tracked = expected_tenants.issubset(router_rows)
+    client_ok = {t: st["status"].get("ok", 0)
+                 for t, st in (s.get("tenants") or {}).items()
+                 if st["status"].get("ok", 0)}
+    router_ok = {t: e["requests"]["ok"]
+                 for t, e in router_rows.items()
+                 if t in expected_tenants
+                 and "ok" in (e.get("requests") or {})}
+    tenant_client_match = client_ok == router_ok
+    tenant_conserves = (not _tl.conservation_delta(router_book)
+                        and not _tl.conservation_delta(fleet_book)
+                        and fleet_book.get("totals", {})
+                        .get("decode_tokens", 0) > 0)
+    tenant_sweep_bounded = bool(sweep_snap) \
+        and sweep_snap.get("tracked", 1 << 30) <= sweep_snap.get("k", 0) \
+        and sweep_snap.get("distinct_seen", 0) >= sweep_n \
+        and not _tl.conservation_delta(sweep_snap)
     report = {
         "scenario": "surge",
         "phases": [f"{p.name}:{p.duration_s}s@{p.rps}rps"
@@ -1175,6 +1351,15 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
         "client_tpot_ms": s.get("tpot_ms"),
         "phase_breakdown": s.get("phases"),
         "telemetry_ok": bool(telemetry_ok),
+        "tenants_tracked": bool(tenants_tracked),
+        "tenant_client_ok": client_ok,
+        "tenant_router_ok": router_ok,
+        "tenant_client_match": bool(tenant_client_match),
+        "tenant_conserves": bool(tenant_conserves),
+        "tenant_sweep": {"distinct": sweep_snap.get("distinct_seen"),
+                         "tracked": sweep_snap.get("tracked"),
+                         "k": sweep_snap.get("k")},
+        "tenant_sweep_bounded": bool(tenant_sweep_bounded),
         "recovered": (
             s["admitted_failures"] == 0 and s["replayed"] == 0
             and s["ok"] > 0 and s["shed"] + s["ok"] > 0
@@ -1188,7 +1373,11 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
             and counters.get("autoscaler.decisions{action=down}", 0) >= 1
             and gauges.get("autoscaler.replicas{state=actual}") == 1
             and client_itl is not None and bool(phases_ok)
-            and bool(telemetry_ok)),
+            and bool(telemetry_ok)
+            and bool(tenants_tracked)
+            and bool(tenant_client_match)
+            and bool(tenant_conserves)
+            and bool(tenant_sweep_bounded)),
     }
     return report
 

@@ -41,7 +41,10 @@ definition instead of three burst loops):
     `tpot_ms` (per-stream mean time/output token) percentiles — the
     cross-check for the server's `serving.itl_ms` histogram — plus a
     per-phase (warm/surge/cool) `phases` breakdown with each phase's
-    status counts and ok-latency percentiles.
+    status counts and ok-latency percentiles.  ISSUE 16: every request
+    carries an `X-Tenant-Id: tenant-<i>` header and the summary adds a
+    per-tenant `tenants` breakdown — the client-side ground truth the
+    chaos gates reconcile against the server's tenant ledger.
 
 The client side is stdlib-only (http.client + json); numpy is imported
 lazily only to build/parse /predict npz bodies, and nothing here
@@ -69,7 +72,15 @@ import urllib.parse
 
 __all__ = ["Phase", "surge_phases", "diurnal_phases",
            "SharedPrefixWorkload", "OpenLoopRunner", "LoadReport",
-           "prefix_fingerprint"]
+           "prefix_fingerprint", "tenant_name"]
+
+
+def tenant_name(idx):
+    """The X-Tenant-Id a spec's integer `tenant` index is stamped as —
+    one definition shared by the runner and the chaos gates that
+    cross-check client rows against the server's tenant ledger
+    (ISSUE 16)."""
+    return f"tenant-{int(idx)}"
 
 
 class Phase:
@@ -248,7 +259,17 @@ class LoadReport:
         all_gaps = []              # every inter-token gap, all streams
         tpot = []                  # per-stream mean time/output token
         phases: dict = {}
+        tenants: dict = {}
         for row in self.rows:
+            # per-tenant breakdown (ISSUE 16): what THIS client billed
+            # each X-Tenant-Id — the ground truth the chaos gates
+            # cross-check against the server-side tenant ledger
+            tstat = tenants.setdefault(tenant_name(row["tenant"]), {
+                "requests": 0, "status": {}, "tokens": 0})
+            tstat["requests"] += 1
+            tstat["status"][row["status"]] = \
+                tstat["status"].get(row["status"], 0) + 1
+            tstat["tokens"] += row.get("tokens", 0) or 0
             k, s = row["kind"], row["status"]
             by_kind.setdefault(k, {}).setdefault(s, 0)
             by_kind[k][s] += 1
@@ -309,6 +330,7 @@ class LoadReport:
             "itl_ms": self._pcts(all_gaps) if all_gaps else None,
             "tpot_ms": self._pcts(tpot) if tpot else None,
             "phases": phase_out,
+            "tenants": dict(sorted(tenants.items())),
         }
 
 
@@ -429,7 +451,8 @@ class OpenLoopRunner:
         body = json.dumps({
             "input_ids": spec["prompt"],
             "max_new_tokens": spec["max_new_tokens"]}).encode()
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": "application/json",
+                   "X-Tenant-Id": tenant_name(spec["tenant"])}
         fp = prefix_fingerprint(spec["prompt"])
         if fp is not None:
             headers["X-Prefix-Fingerprint"] = fp
@@ -533,7 +556,9 @@ class OpenLoopRunner:
                 conn.request(
                     "POST", "/predict", body=data,
                     headers={"Content-Type":
-                             "application/octet-stream"})
+                             "application/octet-stream",
+                             "X-Tenant-Id":
+                             tenant_name(spec["tenant"])})
                 resp = conn.getresponse()
                 if resp.status in (429, 503):
                     wait = self._retry_wait(dict(resp.headers))
@@ -568,7 +593,9 @@ class OpenLoopRunner:
         try:
             conn.request("POST", "/generate",
                          body=b"\xff" * self.oversize_bytes,
-                         headers={"Content-Type": "application/json"})
+                         headers={"Content-Type": "application/json",
+                                  "X-Tenant-Id":
+                                  tenant_name(spec["tenant"])})
             resp = conn.getresponse()
             resp.read()
             return "client_error" if resp.status == 400 \

@@ -27,7 +27,12 @@ Outputs:
     `host:pid[:rN]` ident — the per-replica serving view (ISSUE 9:
     replica ranks ride the dump filename, so a fleet's rollup shows
     each replica's admission and engine state side by side with the
-    router's `router.replicas{state}` gauges).
+    router's `router.replicas{state}` gauges).  When dumps carry a
+    `tenants` ledger snapshot (ISSUE 16), the rollup adds a `tenants`
+    section: each process's last snapshot under `per_process`, plus a
+    `fleet` Space-Saving merge (matched tenants summed, union
+    truncated back to K by folding the smallest into `~other` — the
+    conservation invariant survives the merge).
 
 Exit codes: 0 ok, 1 usage/IO error, 2 schema errors in any stream
 (same discipline as tools/analyze_chip_log.py).
@@ -61,6 +66,7 @@ def _load_obs_module(name):
 
 _export = _load_obs_module("export")
 _metrics_mod = _load_obs_module("metrics")
+_tenant_mod = _load_obs_module("tenant_ledger")
 
 
 # ------------------------------ loading ------------------------------
@@ -375,7 +381,20 @@ def rollup(streams):
         if isinstance(tls, list) and tls:
             timelines[ident] = tls
 
-    return {"schema": "telemetry_rollup/v1",
+    # tenant ledgers (ISSUE 16): each process dumps its FULL ledger
+    # snapshot (not incremental), so the last dump per process IS the
+    # process's book; the fleet view is a correct Space-Saving merge —
+    # matched tenants sum, the union truncates back to K with the
+    # smallest folded into `~other`, conservation preserved
+    tenants = {}
+    per_tenant = {ident: e["tenants"] for ident, e in sorted(last.items())
+                  if isinstance(e.get("tenants"), dict)}
+    if per_tenant:
+        tenants = {"per_process": per_tenant,
+                   "fleet": _tenant_mod.merge_snapshots(
+                       list(per_tenant.values()))}
+
+    out = {"schema": "telemetry_rollup/v1",
             "processes": sorted(last),
             "counters": dict(sorted(counters.items())),
             "histograms": dict(sorted(hists.items())),
@@ -384,6 +403,9 @@ def rollup(streams):
             "timeseries": ts_out,
             "request_timelines": timelines,
             "slo": slo_out}
+    if tenants:
+        out["tenants"] = tenants
+    return out
 
 
 # ------------------------------ CLI ------------------------------
