@@ -180,12 +180,22 @@ class Autoscaler:
             occupancy = max(
                 occupancy,
                 (st["inflight"] + st["queued"]) / max(1, st["limit"]))
+        # observed spawn->routable (ISSUE 17): what a scale-up actually
+        # costs right now, measured by the fleet lifecycle plane from
+        # completed spawns.  None until the first spawn completed;
+        # getattr keeps duck-typed test fleets working unchanged.
+        spawn_ms = getattr(self.fleet, "observed_spawn_ms", None)
+        spawn_ms = spawn_ms() if callable(spawn_ms) else None
+        if spawn_ms is not None:
+            spawn_ms = round(float(spawn_ms), 3)
+            _metrics.set_gauge("autoscaler.observed_spawn_ms", spawn_ms)
         return {
             "burn_rate": round(burn, 4),
             "occupancy": round(occupancy, 4),
             "queue_depth": queued,
             "actual": self.fleet.replica_count(),
             "routable": router.routable_count(),
+            "observed_spawn_ms": spawn_ms,
         }
 
     # ------------------------------------------------------------------

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 from ...observability import metrics as _metrics
 from ...observability import tenant_ledger as _tledger
 from ...observability import trace as _trace
+from ...observability import xla_cost as _xla_cost
 from ...observability.timeseries import DecisionRing, RequestTimeline
 from ...resilience.overload import _env_num
 from .paging import PagePool
@@ -581,6 +582,8 @@ class InferenceEngine:
                              axis=-1).astype(jnp.int32)
             return tok, [c[0] for c in new], [c[1] for c in new]
 
+        label = f"prefill_s{sb}" + ("" if which == "target" else f"_{which}")
+        prefill = _xla_cost.instrument(prefill, label)
         self._programs[key] = prefill
         return prefill
 
@@ -619,6 +622,8 @@ class InferenceEngine:
                 v_pools = [put(p, b) for p, b in zip(v_pools, vbufs)]
                 return k_pools, v_pools
 
+            label = f"pack_s{sb}" + ("" if which == "target" else f"_{which}")
+            pack = _xla_cost.instrument(pack, label)
             self._programs[key] = pack
             return pack
 
@@ -651,6 +656,7 @@ class InferenceEngine:
                 vp[li], vs[li] = put(vp[li], vs[li], vbufs[li])
             return kp, vp, ks, vs
 
+        pack_q = _xla_cost.instrument(pack_q, f"pack_s{sb}_q")
         self._programs[key] = pack_q
         return pack_q
 
@@ -709,6 +715,8 @@ class InferenceEngine:
                                       plen, start)
                 return finish(logits, new)
 
+            cprefill_q = _xla_cost.instrument(
+                cprefill_q, f"cprefill_s{sb}_p{npp}_q")
             self._programs[key] = cprefill_q
             return cprefill_q
 
@@ -729,6 +737,9 @@ class InferenceEngine:
                                   start)
             return finish(logits, new)
 
+        label = f"cprefill_s{sb}_p{npp}" + (
+            "" if which == "target" else f"_{which}")
+        cprefill = _xla_cost.instrument(cprefill, label)
         self._programs[key] = cprefill
         return cprefill
 
@@ -770,6 +781,7 @@ class InferenceEngine:
                        lengths), None, length=n)
             return jnp.swapaxes(toks, 0, 1), kps, vps, kss, vss
 
+        decode = _xla_cost.instrument(decode, f"decode_n{n}")
         self._programs[key] = decode
         return decode
 
@@ -864,6 +876,7 @@ class InferenceEngine:
             counts = acc + 1       # committed tokens = g[:, :acc+1]
             return g, counts, kps, vps, kss, vss, dkp, dvp
 
+        spec = _xla_cost.instrument(spec, f"spec_k{k}")
         self._programs[key] = spec
         return spec
 

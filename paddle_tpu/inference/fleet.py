@@ -63,6 +63,7 @@ import uuid
 
 import numpy as np
 
+from ..observability import lifecycle as _lifecycle
 from ..resilience.overload import _env_num
 
 __all__ = ["ReplicaFleet", "ToyEngine", "EchoPredictor", "toy_token"]
@@ -309,6 +310,11 @@ class ReplicaFleet:
         self._store_addr = None
         self._elastic = None
         self.events = []  # ordered lifecycle log (tests assert on it)
+        # spawn-to-routable phase records (ISSUE 17): the supervisor
+        # stamps what only it can see (Popen, announce file observed);
+        # the router stamps first_probe_up/first_routable_request and
+        # attaches each replica's own ledger record at first probe-up
+        self.lifecycle = _lifecycle.FleetLifecycle()
         if router is not None:
             self.router = router
         else:
@@ -317,6 +323,7 @@ class ReplicaFleet:
             kw = dict(router_kwargs or {})
             kw.setdefault("request_timeout", self.request_timeout)
             self.router = Router(**kw)
+        self.router.lifecycle = self.lifecycle
 
     # --- heartbeat plumbing (fleet/elastic.py reuse) ----------------------
     def _start_store(self):
@@ -434,6 +441,12 @@ class ReplicaFleet:
         handle.drain_requested = False
         cmd = self._replica_cmd(handle)
         env = self._replica_environ(handle)
+        # open the spawn record + pass the supervisor's wall anchor to
+        # the child (the cross-process half of the clock-skew join: the
+        # child back-dates proc_spawn by the wall delta so its imports
+        # phase covers fork + interpreter start)
+        spawn_wall = self.lifecycle.spawn(handle.rid, rank=handle.rank)
+        env["PADDLE_TPU_SPAWN_WALL"] = f"{spawn_wall:.6f}"
         with self._lock:
             if self._stopping.is_set() or handle.removed:
                 return False  # stopping, or the rank was retired while
@@ -458,6 +471,7 @@ class ReplicaFleet:
                     with open(handle.announce) as f:
                         info = json.load(f)
                     handle.address = info["address"]
+                    self.lifecycle.stamp(handle.rid, "announce")
                     return handle.address
                 except (ValueError, KeyError, OSError):
                     pass  # torn read mid-rename: retry next tick
@@ -577,6 +591,12 @@ class ReplicaFleet:
     def replica_ranks(self):
         with self._lock:
             return sorted(self._handles)
+
+    def observed_spawn_ms(self):
+        """Median observed spawn -> first_probe_up wall over recent
+        spawns (ISSUE 17) — what the autoscaler's predictive signal is
+        actually buying.  None before any spawn completed."""
+        return self.lifecycle.observed_spawn_ms()
 
     def add_replica(self, timeout=None):
         """Grow the fleet by one replica: fresh rank, spawn, await the
@@ -810,6 +830,13 @@ def _replica_main(argv=None):
     from .serving import InferenceServer
 
     obs.attach(crash_hook=False)
+    # lifecycle (ISSUE 17): anchor at the supervisor's Popen wall time
+    # (PADDLE_TPU_SPAWN_WALL) so the imports phase covers fork +
+    # interpreter start + the imports above, then stamp each startup
+    # phase on THIS process's monotonic clock
+    led = obs.lifecycle.get_ledger()
+    led.begin(spawn_wall=os.environ.get("PADDLE_TPU_SPAWN_WALL"))
+    led.stamp("imports")
     predictor = engine = None
     if args.kind in ("echo", "toy"):
         predictor = EchoPredictor(service_time=args.service_time)
@@ -820,6 +847,7 @@ def _replica_main(argv=None):
         engine = _build_gpt_engine(seed=0, max_slots=args.max_slots)
     elif args.kind == "model":
         pass  # model_path below builds the predictor inside the server
+    led.stamp("weight_load")
 
     srv = InferenceServer(
         model_path=args.model_path if args.kind == "model" else None,
@@ -861,15 +889,32 @@ def _replica_main(argv=None):
                      if srv.tenant_ledger is not None else None),
             # per-request timelines (ISSUE 15): real engines expose
             # them; toy duck-types simply don't ship the key
-            timelines=getattr(srv.engine, "recent_timelines",
-                              None)).start()
+            timelines=getattr(srv.engine, "recent_timelines", None),
+            # lifecycle record (ISSUE 17): each dump carries this
+            # replica's spawn-phase story; full state, last dump wins
+            lifecycle=led.record).start()
 
     srv.start()
+    # warm up BEFORE announcing (ISSUE 17): a tiny generate triggers
+    # the engine's jit compiles so "routable" means "warm" — the
+    # compile cost lands in the warmup phase (attributed per program
+    # by xla_cost.instrument) instead of the first client request.
+    # PADDLE_TPU_REPLICA_WARMUP=0 restores announce-first behavior.
+    if os.environ.get("PADDLE_TPU_REPLICA_WARMUP", "1") != "0" \
+            and args.kind == "gpt" and engine is not None:
+        try:
+            engine.generate([np.arange(1, 5, dtype=np.int32)],
+                            max_new_tokens=2)
+        except Exception as e:
+            print(f"replica {args.rank}: warmup failed "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
+    led.stamp("warmup")
     tmp = args.announce + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"address": srv.address, "pid": os.getpid(),
                    "rank": args.rank}, f)
     os.replace(tmp, args.announce)  # atomic: no torn reads
+    led.stamp("announce")
 
     try:
         while not guard.preempted:

@@ -190,7 +190,7 @@ class _Replica:
     __slots__ = ("id", "address", "breaker", "state", "signals",
                  "missed_heartbeats", "probe_failures", "inflight",
                  "generation", "draining_requested", "ever_up",
-                 "ever_beat")
+                 "ever_beat", "ever_forwarded")
 
     def __init__(self, rid, address, breaker):
         self.id = str(rid)
@@ -205,6 +205,7 @@ class _Replica:
         self.draining_requested = False
         self.ever_up = False         # first admission ≠ re-admission
         self.ever_beat = False       # heartbeats govern only after one
+        self.ever_forwarded = False  # lifecycle first_routable_request
 
     def view(self):  # pt-lint: ok[PT102] (caller holds Router._lock)
         sig = self.signals
@@ -302,6 +303,11 @@ class Router:
         self.timeseries = _ts.TimeSeriesSampler(names=ROUTER_SERIES,
                                                 name="router")
         _ts.set_default_sampler(self.timeseries)
+        # replica lifecycle plane (ISSUE 17): ReplicaFleet wires its
+        # FleetLifecycle here so the probe loop can stamp
+        # first_probe_up / first_routable_request and durably attach
+        # each replica's own phase record.  None for a bare Router.
+        self.lifecycle = None
         for rid, address in dict(replicas or {}).items():
             self.add_replica(rid, address)
         self._probe_stop = threading.Event()
@@ -380,6 +386,16 @@ class Router:
                     # their Space-Saving merge
                     try:
                         body = router.tenant_debug()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, body)
+                if self.path == "/debug/lifecycle":
+                    # the fleet lifecycle view (ISSUE 17): per-spawn
+                    # joined supervisor+replica phase records, the
+                    # spawn-time rollup, and live replica records
+                    try:
+                        body = router.lifecycle_debug()
                     except Exception as e:
                         return self._json(
                             500, {"error": f"{type(e).__name__}: {e}"})
@@ -576,6 +592,8 @@ class Router:
             rep.draining_requested = False
             rep.ever_beat = False  # the new process must beat before
             # heartbeat absence can count against it again
+            rep.ever_forwarded = False  # the relaunch opened a fresh
+            # spawn record: its first forward is a first again
             rep.breaker.record_success()  # fresh process, fresh slate
         self._note("router.replica_relaunched", replica=str(rid),
                    address=str(address))
@@ -691,6 +709,8 @@ class Router:
 
     def _apply_probe(self, rid, gen, ok, payload, alive):
         readmitted = ejected = None
+        came_up = False
+        address = None
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is None or rep.generation != gen:
@@ -740,6 +760,28 @@ class Router:
                 rep.ever_up = True
                 rep.draining_requested = False
                 rep.breaker.record_success()
+                came_up = True
+                address = rep.address
+        if came_up and self.lifecycle is not None:
+            # lifecycle (ISSUE 17): first probe-up closes the
+            # spawn-to-routable interval (first-wins per spawn record —
+            # a relaunch opened a fresh record, so its re-admission
+            # stamps again), then the replica's own phase record is
+            # fetched and attached DURABLY: a scale-down later must not
+            # erase the spawn story the surge gate audits
+            try:
+                if self.lifecycle.stamp(rid, "first_probe_up"):
+                    code, _hdrs, body = self.transport.request(
+                        address, "GET", "/debug/lifecycle",
+                        timeout=max(1.0, self.probe_interval * 4))
+                    if code == 200:
+                        self.lifecycle.attach_replica_record(
+                            rid, json.loads(body or b"{}"))
+            except Exception as e:  # pt-lint: ok[PT005]
+                # observability of observability: a lost record is a
+                # note, never a probe failure
+                self._note("router.lifecycle_attach_failed",
+                           replica=rid, error=type(e).__name__)
         if ejected:
             _metrics.inc("router.ejections")
             self._note("router.replica_ejected", replica=rid)
@@ -871,12 +913,25 @@ class Router:
         return pick
 
     def _begin_forward(self, rid, endpoint):
+        first = False
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is None:
                 return None
             rep.inflight[endpoint] += 1
-            return rep.address
+            if not rep.ever_forwarded:
+                rep.ever_forwarded = True
+                first = True
+            address = rep.address
+        if first and self.lifecycle is not None:
+            # lifecycle (ISSUE 17): the spawn record's first routed
+            # request (first-wins — the flag keeps the common path to
+            # one boolean test, the ledger dedups relaunch races)
+            try:
+                self.lifecycle.stamp(rid, "first_routable_request")
+            except Exception:  # pt-lint: ok[PT005]
+                pass  # never fail a forward for a lost stamp
+        return address
 
     def _end_forward(self, rid, endpoint):
         with self._lock:
@@ -1228,6 +1283,11 @@ class Router:
         }
         if self.tenant_ledger is not None:
             snap["tenants"] = self.tenant_ledger.snapshot()
+        if self.lifecycle is not None:
+            # the fleet's spawn records + rollup (ISSUE 17) — joined
+            # supervisor/replica views, no live re-fetch (the full
+            # fleet view with live replica records is /debug/lifecycle)
+            snap["lifecycle"] = self.lifecycle.fleet_view()
         return snap
 
     def tenant_debug(self):
@@ -1262,6 +1322,39 @@ class Router:
                "fleet": _tledger.merge_snapshots(list(per.values()))}
         if self.tenant_ledger is not None:
             out["router"] = self.tenant_ledger.snapshot()
+        if unreachable:
+            out["unreachable"] = unreachable
+        return out
+
+    def lifecycle_debug(self):
+        """GET /debug/lifecycle body: the fleet lifecycle view.
+
+        `fleet` is the supervisor's joined per-spawn records +
+        percentile rollup (durable — scale-downs keep their story);
+        `replicas` holds each routable replica's LIVE ledger record
+        fetched over HTTP (a replica that has served shows first_token
+        here before the durable record learns it).  An unreachable
+        replica is skipped and named in `unreachable`."""
+        with self._lock:
+            targets = [(rep.id, rep.address)
+                       for rep in self._replicas.values()
+                       if rep.state in ("up", "draining")]
+        per, unreachable = {}, []
+        for rid, address in sorted(targets):
+            try:
+                code, _hdrs, body = self.transport.request(
+                    address, "GET", "/debug/lifecycle",
+                    timeout=max(1.0, self.probe_interval * 4))
+                snap = json.loads(body or b"{}")
+                if code == 200 and isinstance(snap, dict):
+                    per[rid] = snap
+                else:
+                    unreachable.append(rid)
+            except Exception:
+                unreachable.append(rid)
+        out = {"role": "router", "replicas": per}
+        if self.lifecycle is not None:
+            out["fleet"] = self.lifecycle.fleet_view()
         if unreachable:
             out["unreachable"] = unreachable
         return out

@@ -31,6 +31,10 @@ Protocol (stdlib-only, zero heavy deps):
                   page-seconds, TTFT/ITL summaries.  This JSON surface
                   is DELIBERATELY not rendered on /metrics (cardinality
                   discipline — docs/OBSERVABILITY.md).
+  GET  /debug/lifecycle   this process's spawn-phase record (ISSUE 17):
+                  proc_spawn → imports → weight_load → warmup →
+                  announce (→ first_token) with per-phase ms and the
+                  per-program compile sub-ledger.
 
 Tenant identity (ISSUE 16): `X-Tenant-Id` names who to BILL.  Parsed at
 the edge next to `X-Request-Id`; a request without one falls back to
@@ -76,6 +80,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from . import Config, create_predictor
+from ..observability import lifecycle as _lifecycle
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
 from ..observability import tenant_ledger as _tledger
@@ -384,6 +389,17 @@ class InferenceServer:
                         return self._json(
                             500, {"error": f"{type(e).__name__}: {e}"})
                     return self._json(200, body)
+                if self.path == "/debug/lifecycle":
+                    # this process's spawn-phase record (ISSUE 17):
+                    # always answers — a replica that never went
+                    # through the fleet spawn path reports its
+                    # implicit anchor and whatever phases it stamped
+                    try:
+                        body = _lifecycle.get_ledger().record()
+                    except Exception as e:
+                        return self._json(
+                            500, {"error": f"{type(e).__name__}: {e}"})
+                    return self._json(200, body)
                 if self.path.startswith("/debug/requests/"):
                     rid = self.path[len("/debug/requests/"):]
                     dbg = getattr(server.engine, "request_debug",
@@ -561,6 +577,13 @@ class InferenceServer:
                                 if server.tenant_ledger is not None:
                                     server.tenant_ledger.observe_ttft(
                                         ctx.tenant_id, ttft_ms)
+                                # lifecycle (ISSUE 17): the process's
+                                # first-ever emitted token closes the
+                                # spawn story (quiet first-wins —
+                                # concurrent streams race it
+                                # legitimately)
+                                _lifecycle.get_ledger().stamp_once(
+                                    "first_token")
                             self.wfile.write(
                                 json.dumps({"token": int(tok)}).encode()
                                 + b"\n")
@@ -747,6 +770,9 @@ class InferenceServer:
             "flight": _flight.events()[-64:],
         }
         snap["timeseries"] = self.timeseries.stats()
+        # this process's spawn-phase record (ISSUE 17) — the same body
+        # GET /debug/lifecycle serves
+        snap["lifecycle"] = _lifecycle.get_ledger().record()
         if self.tenant_ledger is not None:
             snap["tenants"] = self.tenant_ledger.snapshot()
         if self.anomalies is not None:

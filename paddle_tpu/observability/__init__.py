@@ -25,14 +25,14 @@ what `bench.py --telemetry` calls.
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    export, flight, goodput, metrics, request_trace, slo, step_stats,
-    tenant_ledger, timeseries, trace, xla_cost,
+    export, flight, goodput, lifecycle, metrics, request_trace, slo,
+    step_stats, tenant_ledger, timeseries, trace, xla_cost,
 )
 from .step_stats import StepTimer  # noqa: F401
 
 __all__ = ["metrics", "flight", "step_stats", "trace", "xla_cost",
            "request_trace", "slo", "export", "goodput", "tenant_ledger",
-           "timeseries", "StepTimer", "attach", "detach"]
+           "timeseries", "lifecycle", "StepTimer", "attach", "detach"]
 
 # The snapshot-schema floor `attach()` guarantees: these counters exist
 # (at 0) in every telemetry snapshot even when the path never fired in
@@ -139,6 +139,10 @@ _SCHEMA_COUNTERS = tuple(
     # /debug/tenants and telemetry dumps, never the metrics registry
     + [("tenant.requests", {"status": s})
        for s in ("ok", "shed", "client_error", "error")]
+    # replica lifecycle (ISSUE 17): spawn count + strict-stamp
+    # violations — bounded, per-process (supervisor and replica each
+    # count their own view of a spawn)
+    + [("lifecycle.spawns", {}), ("lifecycle.double_stamps", {})]
 )
 
 # Gauges attach() zeroes so the admission-control state is always
@@ -174,7 +178,17 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
     + tuple(("engine.weight_precision", {"precision": p})
             for p in ("full", "bf16", "int8")) \
     + tuple(("paged.pool_precision", {"precision": p})
-            for p in ("full", "int8"))
+            for p in ("full", "int8")) \
+    + tuple(("lifecycle.phase_ms", {"phase": p})
+            # replica lifecycle (ISSUE 17): ms of the just-closed phase;
+            # proc_spawn is the anchor so it never closes a phase.  The
+            # per-program lifecycle.compile_ms series is bounded by the
+            # ledger's label cap; only the ~total sum is pre-declared
+            for p in lifecycle.PHASES[1:]) \
+    + (("lifecycle.compile_ms", {"program": "~total"}),
+       # autoscaler's observed spawn->routable estimate (ISSUE 17):
+       # 0 until the first spawn completes, then the fleet median
+       "autoscaler.observed_spawn_ms")
 
 
 # Histograms attach() pre-registers EMPTY (full bucket ladder, count 0)

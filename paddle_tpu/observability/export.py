@@ -27,12 +27,17 @@ Schema (`telemetry_dump/v1`) — one line per dump:
                                          # dump (incremental by seq)
      "request_timelines": [...],         # OPTIONAL: recent per-request
                                          # timeline summaries
-     "tenants": {...}}                   # OPTIONAL (ISSUE 16): the
+     "tenants": {...},                   # OPTIONAL (ISSUE 16): the
                                          # process's TenantLedger
                                          # snapshot (full state, not
                                          # incremental — the aggregator
                                          # merges each process's LAST
                                          # dump)
+     "lifecycle": {...}}                 # OPTIONAL (ISSUE 17): the
+                                         # process's lifecycle record
+                                         # (replica) or the fleet view
+                                         # (supervisor); full state,
+                                         # last dump wins
 
 Incremental on purpose: the tracer buffer holds 64k events — a
 per-interval full snapshot would quadratically re-ship history.  Both
@@ -106,7 +111,7 @@ class TelemetryExporter:
 
     def __init__(self, outdir=None, interval_s=None, run_id=None,
                  rank=None, host=None, pid=None, slo=None, extra=None,
-                 timelines=None, tenants=None):
+                 timelines=None, tenants=None, lifecycle=None):
         outdir = outdir or os.environ.get("PADDLE_TPU_TELEMETRY_DIR")
         if not outdir:
             raise ValueError(
@@ -132,6 +137,10 @@ class TelemetryExporter:
         # (ISSUE 16): each dump carries the process's CURRENT tenant
         # book; telemetry_agg merges the fleet's last dumps
         self.tenants = tenants
+        # optional zero-arg callable returning the process's lifecycle
+        # record (ISSUE 17): a replica passes its LifecycleLedger's
+        # record(); the supervisor passes FleetLifecycle.fleet_view()
+        self.lifecycle = lifecycle
         self.extra = dict(extra or {})
         name = f"telemetry_{self.host}_{self.pid}"
         if self.rank is not None:
@@ -213,6 +222,11 @@ class TelemetryExporter:
                     line["tenants"] = self.tenants()
                 except Exception as e:
                     line["tenants_error"] = f"{type(e).__name__}: {e}"
+            if self.lifecycle is not None:
+                try:
+                    line["lifecycle"] = self.lifecycle()
+                except Exception as e:
+                    line["lifecycle_error"] = f"{type(e).__name__}: {e}"
             os.makedirs(self.outdir, exist_ok=True)
             with open(self.path, "a") as f:
                 f.write(json.dumps(line, default=str) + "\n")
@@ -312,7 +326,8 @@ def validate_telemetry_stream(entries) -> list:
                     f"{type(e[key]).__name__}, expected {typ}")
         if e.get("schema") not in (None, SCHEMA_VERSION):
             errors.append(f"entry {i}: unknown schema {e.get('schema')!r}")
-        for key in ("metrics", "slo", "timeseries", "tenants"):
+        for key in ("metrics", "slo", "timeseries", "tenants",
+                    "lifecycle"):
             if key in e and e[key] is not None \
                     and not isinstance(e[key], dict):
                 errors.append(f"entry {i}: key {key!r} not an object")

@@ -12,7 +12,12 @@ the compile ledger records.  Three consumers per capture:
   * the metrics registry: `xla.cost.*{label=...}` gauges (latest compile
     per label wins — the steady-state executable);
   * the flight recorder: an `xla.compile` event, so a crash dump shows
-    the last programs built before the incident.
+    the last programs built before the incident;
+  * the lifecycle ledger: compile WALL time, split trace+lower vs
+    compile (jax folds tracing into `.lower()`, so that is the finest
+    split the API exposes), recorded per program label for replica
+    cold-start attribution (`lifecycle.compile_ms{program}`) plus an
+    `xla.cost.compile_ms{label}` gauge.
 
 `instrument(jitted, label)` wraps a `jax.jit` callable with capture-on-
 first-call-per-signature semantics.  When the telemetry stack is off
@@ -31,6 +36,7 @@ jax is imported lazily: this module loads during
 from __future__ import annotations
 
 import threading
+import time
 
 from . import flight as _flight
 from . import metrics as _metrics
@@ -113,6 +119,19 @@ def _telemetry_on() -> bool:
     return _metrics.enabled() or _trace.enabled()
 
 
+def _feed_lifecycle(label, lower_ms, compile_ms) -> None:
+    """Attribute a compile to the process lifecycle ledger (replica
+    cold-start accounting).  Best-effort: the ledger is observability
+    of observability — it must never fail a compile."""
+    try:
+        from . import lifecycle
+
+        lifecycle.get_ledger().record_compile(label, lower_ms, compile_ms)
+    except Exception:  # pt-lint: ok[PT005]
+        pass           # (the compile_ms span args above already carry
+        # the measurement; a ledger failure must never sink a compile)
+
+
 # sentinel marking a signature whose compile is in flight on another
 # thread (callers fall back to the jitted path until it resolves)
 _PENDING = object()
@@ -187,9 +206,24 @@ class InstrumentedJit:
                 with _trace.span(f"xla.compile:{self.label}",
                                  cat="compile") as sp:
                     try:
-                        compiled = self._jitted.lower(
-                            *args, **kwargs).compile()
+                        # trace+lower vs compile wall split: jax folds
+                        # tracing into .lower(), so lower_ms is the
+                        # finest trace-side split the API exposes
+                        t0 = time.perf_counter()
+                        lowered = self._jitted.lower(*args, **kwargs)
+                        t1 = time.perf_counter()
+                        compiled = lowered.compile()
+                        t2 = time.perf_counter()
                         costs = capture(compiled, self.label)
+                        costs["lower_ms"] = (t1 - t0) * 1e3
+                        costs["compile_ms"] = (t2 - t1) * 1e3
+                        _metrics.set_gauge("xla.cost.compile_ms",
+                                           costs["compile_ms"],
+                                           label=self.label)
+                        _feed_lifecycle(self.label, costs["lower_ms"],
+                                        costs["compile_ms"])
+                        with _last_lock:
+                            _last[self.label] = dict(costs)
                         if sp is not None:
                             sp.args.update(costs)
                     except Exception:

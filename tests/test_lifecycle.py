@@ -1,0 +1,484 @@
+"""Replica lifecycle observability tests (ISSUE 17): the per-process
+phase ledger (ordering, double-stamp loudness, the spawn-wall back-date
+join, the bounded compile sub-ledger), the supervisor-side fleet ledger
+(bounded history, the skewed-clock join producing no negative
+durations, validate/rollup helpers), the attach() schema zeros,
+`GET /debug/lifecycle` end-to-end on a live toy fleet (router + replica
+views, the autoscaler's observed_spawn_ms signal), the exporter's
+`lifecycle` dump-key validation, the tools/telemetry_agg.py fleet
+rollup, and the perf_gate --update round-trip for the new
+`fleet_replica_cold_start_ms` bench row."""
+import importlib.util
+import json
+import os
+import urllib.request
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.inference.autoscaler import Autoscaler
+from paddle_tpu.inference.fleet import ReplicaFleet
+from paddle_tpu.observability import export, lifecycle as lc, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    metrics.reset()
+    obs.flight.clear()
+    obs.attach(crash_hook=False)
+    yield
+    obs.detach()
+    metrics.reset()
+    obs.flight.clear()
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _twin_clocks(mono0=100.0, wall0=1000.0):
+    """A monotonic clock and a wall clock that tick together (one
+    process's pair — the thing the join rule is allowed to difference)."""
+    mono = _Clock(mono0)
+    wall = _Clock(wall0)
+
+    def advance(dt):
+        mono.advance(dt)
+        wall.advance(dt)
+
+    return mono, wall, advance
+
+
+# --------------------------------------------------------------------------
+# the per-process ledger: ordering, durations, the wall-anchor join
+# --------------------------------------------------------------------------
+
+def test_phase_ordering_and_durations():
+    mono, wall, advance = _twin_clocks()
+    led = lc.LifecycleLedger(clock=mono, wall=wall)
+    led.begin()
+    advance(0.5)
+    led.stamp("imports")
+    advance(0.25)
+    led.stamp("weight_load")
+    advance(0.1)
+    led.stamp("warmup")
+    advance(0.01)
+    led.stamp("announce")
+    rec = led.record()
+    assert rec["schema"] == lc.SCHEMA
+    d = rec["durations_ms"]
+    assert d["imports"] == pytest.approx(500.0)
+    assert d["weight_load"] == pytest.approx(250.0)
+    assert d["warmup"] == pytest.approx(100.0)
+    assert d["announce"] == pytest.approx(10.0)
+    assert rec["total_ms"] == pytest.approx(860.0)
+    # phases are monotone on the ledger's own clock
+    seq = [rec["phases"][p]["mono_ms"] for p in lc.PHASES
+           if p in rec["phases"]]
+    assert seq == sorted(seq)
+    assert rec["double_stamps"] == 0
+
+
+def test_spawn_wall_backdates_imports():
+    """The supervisor's wall anchor back-dates proc_spawn so `imports`
+    covers fork + interpreter start, not just post-import code."""
+    mono, wall, advance = _twin_clocks(wall0=1000.0)
+    led = lc.LifecycleLedger(clock=mono, wall=wall)
+    # child came up 0.8s of wall time after the supervisor's Popen
+    led.begin(spawn_wall=1000.0 - 0.8)
+    advance(0.2)
+    led.stamp("imports")
+    rec = led.record()
+    assert rec["spawn_wall"] == pytest.approx(999.2)
+    assert rec["durations_ms"]["imports"] == pytest.approx(1000.0)
+
+
+def test_insane_spawn_wall_ignored():
+    """A skewed supervisor wall clock (child wall BEHIND the anchor, or
+    anchor absurdly old) must not poison the ledger: the back-date is
+    dropped and durations stay >= 0."""
+    for bogus in (1000.0 + 5.0,       # delta < 0: child wall behind
+                  1000.0 - 7200.0,    # delta > 1h: absurd
+                  "not-a-float", None):
+        mono, wall, advance = _twin_clocks(wall0=1000.0)
+        led = lc.LifecycleLedger(clock=mono, wall=wall)
+        led.begin(spawn_wall=bogus)
+        advance(0.1)
+        led.stamp("imports")
+        rec = led.record()
+        assert rec["durations_ms"]["imports"] == pytest.approx(100.0), bogus
+        assert all(v >= 0 for v in rec["durations_ms"].values())
+
+
+def test_double_stamp_is_loud(telemetry):
+    led = lc.LifecycleLedger()
+    led.begin()
+    assert led.stamp("imports") is not None
+    assert led.stamp("imports") is None          # strict: kept first
+    rec = led.record()
+    assert rec["double_stamps"] == 1
+    snap = metrics.snapshot()["counters"]
+    assert snap["lifecycle.double_stamps"] == 1
+    assert any(e["kind"] == "lifecycle.double_stamp"
+               for e in obs.flight.events())
+    # stamp_once is the quiet first-wins variant (first_token races)
+    assert led.stamp_once("first_token") is not None
+    assert led.stamp_once("first_token") is None
+    assert led.record()["double_stamps"] == 1    # unchanged
+
+
+def test_unknown_phase_rejected():
+    with pytest.raises(ValueError):
+        lc.LifecycleLedger().stamp("reticulate_splines")
+
+
+def test_stamp_before_begin_self_anchors():
+    led = lc.LifecycleLedger()
+    led.stamp("imports")                         # no begin(): still usable
+    rec = led.record()
+    assert "proc_spawn" in rec["phases"] and "imports" in rec["phases"]
+
+
+def test_compile_ledger_bounded(telemetry, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LIFECYCLE_COMPILE_CAP", "3")
+    led = lc.LifecycleLedger()
+    led.begin()
+    for i in range(10):
+        led.record_compile(f"prog_{i}", lower_ms=1.0, compile_ms=2.0)
+    rec = led.record()
+    assert len(rec["compiles"]) == 4             # 3 named + ~other
+    assert "~other" in rec["compiles"]
+    assert rec["compiles"]["~other"]["count"] == 7
+    # nothing dropped: the total conserves every compile's wall time
+    assert rec["compile_total_ms"] == pytest.approx(30.0)
+    gauges = metrics.snapshot()["gauges"]
+    assert gauges["lifecycle.compile_ms{program=~total}"] \
+        == pytest.approx(30.0)
+
+
+# --------------------------------------------------------------------------
+# the supervisor-side fleet ledger: join, skew, bounds, rollup
+# --------------------------------------------------------------------------
+
+def _joined_record(rep_wall_skew=0.0, spawn_to_up=1.0):
+    """One complete spawn story: supervisor and replica each on their
+    OWN clock pair, the replica's wall clock skewed by `rep_wall_skew`
+    seconds relative to the supervisor's."""
+    sup_mono, sup_wall, sup_adv = _twin_clocks(100.0, 5000.0)
+    fl = lc.FleetLifecycle(clock=sup_mono, wall=sup_wall)
+    anchor = fl.spawn("r1", rank=1)
+
+    rep_mono, rep_wall, rep_adv = _twin_clocks(7.0, 5000.0 + rep_wall_skew)
+    rep_adv(0.3)                                 # fork + interpreter lag
+    led = lc.LifecycleLedger(clock=rep_mono, wall=rep_wall)
+    led.begin(spawn_wall=anchor)
+    rep_adv(0.2)
+    led.stamp("imports")
+    rep_adv(0.05)
+    led.stamp("weight_load")
+    led.record_compile("decode_n1", lower_ms=3.0, compile_ms=9.0)
+    rep_adv(0.02)
+    led.stamp("warmup")
+    led.stamp("announce")
+
+    sup_adv(spawn_to_up - 0.1)
+    fl.stamp("r1", "announce")
+    sup_adv(0.1)
+    fl.stamp("r1", "first_probe_up")
+    assert fl.attach_replica_record("r1", led.record())
+    fl.stamp("r1", "first_routable_request")
+    return fl
+
+
+def test_join_attributes_phases():
+    fl = _joined_record()
+    recs = fl.records()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert lc.validate_record(rec) == []
+    ph = rec["phases_ms"]
+    # compile and weight_load are ATTRIBUTED phases, never `other`
+    assert ph["compile"] == pytest.approx(12.0)
+    assert ph["weight_load"] == pytest.approx(50.0)
+    assert ph["imports"] == pytest.approx(500.0)  # incl. 300ms fork lag
+    assert ph["probe"] == pytest.approx(100.0)
+    assert ph["other"] >= 0.0
+    assert rec["total_ms"] == pytest.approx(1000.0)
+    assert fl.observed_spawn_ms() == pytest.approx(1000.0)
+
+
+@pytest.mark.parametrize("skew", [-45.0, 45.0])
+def test_skewed_replica_wall_never_negative(skew):
+    """Wall skew between supervisor and replica (either direction) must
+    never produce a negative duration or an invalid record — the join
+    rule only differences same-clock stamps, and the back-date guard
+    drops a behind-anchor wall."""
+    fl = _joined_record(rep_wall_skew=skew)
+    rec = fl.records()[0]
+    assert lc.validate_record(rec) == []
+    assert all(v >= 0 for v in rec["phases_ms"].values())
+    assert all(v >= 0
+               for v in rec["replica"]["durations_ms"].values())
+
+
+def test_fleet_history_bounded(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LIFECYCLE_HISTORY", "5")
+    fl = lc.FleetLifecycle()
+    for i in range(40):
+        fl.spawn(f"r{i % 3}", rank=i % 3)        # relaunches archive too
+        fl.stamp(f"r{i % 3}", "first_probe_up")
+    assert len(fl.records()) <= 10               # 5 active cap + 5 archive
+    view = fl.fleet_view()
+    assert view["spawns"] == 40
+    assert view["observed_spawn_ms"] is not None
+
+
+def test_validate_record_catches_incomplete():
+    assert lc.validate_record(None) == ["not a dict"]
+    fl = lc.FleetLifecycle()
+    fl.spawn("r0", rank=0)
+    rec = fl.records()[0]                        # nothing stamped yet
+    probs = lc.validate_record(rec)
+    assert "supervisor stamp missing: announce" in probs
+    assert "supervisor stamp missing: first_probe_up" in probs
+    assert "replica record missing" in probs
+    # non-monotone supervisor stamps are named
+    bad = {"supervisor_ms": {"announce": 50.0, "first_probe_up": 10.0},
+           "replica": None, "phases_ms": {}}
+    assert any("not monotone" in p for p in lc.validate_record(bad))
+    assert any("negative joined phase" in p for p in lc.validate_record(
+        {"supervisor_ms": {"announce": 1.0, "first_probe_up": 2.0},
+         "replica": None, "phases_ms": {"probe": -3.0}}))
+
+
+def test_rollup_percentiles():
+    recs = [{"phases_ms": {"imports": float(i)}, "total_ms": float(i)}
+            for i in range(1, 21)]
+    roll = lc.rollup_records(recs)
+    assert roll["count"] == 20
+    assert roll["phases"]["imports"]["p50"] == pytest.approx(11.0)
+    assert roll["phases"]["imports"]["max"] == pytest.approx(20.0)
+    assert roll["total_ms"]["p95"] >= 19.0
+    assert lc.rollup_records([]) == {"count": 0, "phases": {}}
+
+
+# --------------------------------------------------------------------------
+# attach() schema: every lifecycle series exists at zero
+# --------------------------------------------------------------------------
+
+def test_schema_zero_values(telemetry):
+    snap = metrics.snapshot()
+    assert snap["counters"]["lifecycle.spawns"] == 0
+    assert snap["counters"]["lifecycle.double_stamps"] == 0
+    for p in lc.PHASES[1:]:
+        assert snap["gauges"][f"lifecycle.phase_ms{{phase={p}}}"] == 0
+    assert snap["gauges"]["lifecycle.compile_ms{program=~total}"] == 0
+    assert snap["gauges"]["autoscaler.observed_spawn_ms"] == 0
+
+
+# --------------------------------------------------------------------------
+# exporter: the `lifecycle` dump key validates like timeseries
+# --------------------------------------------------------------------------
+
+def _dump_entry(**over):
+    e = {"phase": "telemetry_dump", "t": "2026-08-07T00:00:00",
+         "schema": export.SCHEMA_VERSION, "host": "h", "pid": 1,
+         "rank": None, "run_id": "p1", "seq": 1, "reason": "periodic",
+         "wall": 1.0, "trace_wall_epoch": 0.0, "trace_events": [],
+         "flight_events": [],
+         "metrics": {"counters": {}, "gauges": {}, "histograms": {}}}
+    e.update(over)
+    return e
+
+
+def test_validate_lifecycle_dump_key():
+    ok = _dump_entry(lifecycle={"schema": lc.SCHEMA, "phases": {}})
+    assert export.validate_telemetry_stream([ok]) == []
+    bad = _dump_entry(lifecycle=["not", "a", "dict"])
+    errs = export.validate_telemetry_stream([bad])
+    assert any("lifecycle" in e and "not an object" in e for e in errs)
+
+
+def test_exporter_dumps_lifecycle(tmp_path, telemetry):
+    led = lc.LifecycleLedger()
+    led.begin()
+    led.stamp("imports")
+    exp = export.TelemetryExporter(str(tmp_path), lifecycle=led.record)
+    exp.dump_once(reason="test")
+    dump, = [p for p in os.listdir(tmp_path) if p.endswith(".jsonl")]
+    with open(tmp_path / dump) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert lines and lines[-1]["lifecycle"]["schema"] == lc.SCHEMA
+    assert "imports" in lines[-1]["lifecycle"]["durations_ms"]
+    assert export.validate_telemetry_stream(lines) == []
+
+
+# --------------------------------------------------------------------------
+# tools/telemetry_agg.py: fleet rollup sees both dump shapes
+# --------------------------------------------------------------------------
+
+def test_telemetry_agg_rollup_lifecycle(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "_tagg", os.path.join(REPO, "tools", "telemetry_agg.py"))
+    agg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(agg)
+
+    # a replica process dump: its own ledger record
+    led = lc.LifecycleLedger()
+    led.begin()
+    led.stamp("imports")
+    led.record_compile("decode_n1", compile_ms=7.0)
+    rep_dump = _dump_entry(host="a", pid=11, run_id="proc_11",
+                           lifecycle=led.record())
+    # a supervisor dump: a fleet view with one joined record
+    fl = _joined_record()
+    sup_dump = _dump_entry(host="b", pid=22, run_id="proc_22",
+                           lifecycle=fl.fleet_view())
+    for name, d in (("a_11", rep_dump), ("b_22", sup_dump)):
+        with open(tmp_path / f"telemetry_{name}.jsonl", "w") as f:
+            f.write(json.dumps(d) + "\n")
+    roll = agg.rollup(agg.load_dumps(str(tmp_path)))
+    lcr = roll["lifecycle"]
+    assert sorted(lcr["per_process"]) == ["a:11", "b:22"]
+    fleet = lcr["fleet"]
+    # both spawn stories rolled up: the replica-only dump synthesized a
+    # phases row (with compile attributed), the fleet view contributed
+    # its joined record
+    assert fleet["count"] == 2
+    assert fleet["phases"]["imports"]["count"] == 2
+    assert fleet["phases"]["compile"]["max"] == pytest.approx(12.0)
+
+
+# --------------------------------------------------------------------------
+# e2e: a live toy fleet's 1 -> 2 scale-up tells a complete spawn story
+# --------------------------------------------------------------------------
+
+def _get_json(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_debug_lifecycle_e2e_toy_fleet(telemetry):
+    """Acceptance e2e (tier-1 sized): real processes, a real
+    add_replica(), and the full lifecycle plane — per-replica ledgers
+    over /debug/lifecycle, the router's joined fleet view with complete
+    monotone records, and the autoscaler's observed_spawn_ms signal."""
+    import time as _time
+
+    fleet = ReplicaFleet(num_replicas=1, kind="toy", token_time=0.005,
+                         service_time=0.005, max_slots=4,
+                         launch_timeout=60, monitor_interval=0.1)
+    fleet.start()
+    try:
+        rank = fleet.add_replica()
+        assert rank is not None
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline and \
+                fleet.router.routable_count() < 2:
+            _time.sleep(0.05)
+        assert fleet.router.routable_count() == 2
+
+        # a generate through the router stamps first_routable_request
+        # (supervisor side) and first_token (replica side)
+        from paddle_tpu.inference.serving import InferenceClient
+        cli = InferenceClient(fleet.router.address, timeout=20)
+        for _ in range(4):                        # hit both replicas
+            out = cli.generate([1, 2, 3], max_new_tokens=2)
+            assert out["tokens"]
+
+        dbg = _get_json(fleet.router.address + "/debug/lifecycle")
+        assert dbg["role"] == "router"
+        assert len(dbg["replicas"]) == 2
+        for rec in dbg["replicas"].values():
+            assert rec["schema"] == lc.SCHEMA
+            for p in lc.REPLICA_PHASES:
+                assert p in rec["phases"], p
+        assert any("first_token" in rec["phases"]
+                   for rec in dbg["replicas"].values())
+
+        view = dbg["fleet"]
+        assert view["spawns"] == 2
+        assert view["observed_spawn_ms"] is not None
+        assert len(view["records"]) == 2
+        for rec in view["records"]:
+            assert lc.validate_record(rec) == [], rec
+            # cold-start attribution: compile + weight_load are named
+            # (non-`other`) fractions of spawn-to-routable
+            assert "compile" in rec["phases_ms"]
+            assert "weight_load" in rec["phases_ms"]
+            assert rec["phases_ms"]["other"] >= 0.0
+        assert any("first_routable_request" in r["supervisor_ms"]
+                   for r in view["records"])
+
+        # the replica's own endpoint serves its ledger directly
+        up = [v for v in fleet.router.replica_views()
+              if v["state"] == "up"]
+        rep_dbg = _get_json(up[0]["address"] + "/debug/lifecycle")
+        assert rep_dbg["schema"] == lc.SCHEMA
+
+        # /debug/telemetry embeds the fleet view (exporter contract)
+        tele = _get_json(fleet.router.address + "/debug/telemetry")
+        assert tele["lifecycle"]["spawns"] == 2
+
+        # the autoscaler reads the observed estimate and publishes it
+        scaler = Autoscaler(fleet)
+        sig = scaler.signals()
+        assert sig["observed_spawn_ms"] is not None
+        assert sig["observed_spawn_ms"] == pytest.approx(
+            view["observed_spawn_ms"], rel=0.01)
+        assert metrics.snapshot()["gauges"][
+            "autoscaler.observed_spawn_ms"] > 0
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------------
+# perf_gate: the fleet_replica_cold_start_ms row round-trips --update
+# --------------------------------------------------------------------------
+
+def _pg():
+    spec = importlib.util.spec_from_file_location(
+        "_perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_emits_cold_start_metric():
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '"fleet_replica_cold_start_ms"' in src
+
+
+def test_cold_start_row_update_round_trip(tmp_path):
+    """--update starts gating the cold-start row; it is lower-better
+    (the `_ms` suffix), so a later SLOWER spawn fails the gate and a
+    same-or-faster one passes.  Degraded (CPU-proxy) rows neither
+    update nor gate."""
+    pg = _pg()
+    baseline = tmp_path / "baseline.jsonl"
+    baseline.write_text("")
+    row = {"metric": "fleet_replica_cold_start_ms", "value": 1000.0,
+           "unit": "ms", "lower_better": True}
+    assert pg.update_baseline([row], str(baseline)) == 1
+    base = pg.load_baseline(str(baseline))
+    ok = dict(row, value=1050.0)                 # within 10% tolerance
+    failures, _ = pg.gate([ok], base, tolerance=0.10)
+    assert failures == []
+    slow = dict(row, value=1300.0)               # 30% slower spawn
+    failures, report = pg.gate([slow], base, tolerance=0.10)
+    assert len(failures) == 1 and "above" in failures[0], report
+    degraded = dict(row, value=9999.0, degraded=True)
+    assert pg.update_baseline([degraded], str(baseline)) == 0
+    failures, report = pg.gate([degraded], pg.load_baseline(str(baseline)))
+    assert failures == [] and any("SKIP" in l for l in report)

@@ -1134,7 +1134,10 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
     observed mid-surge, and the ramp-down drains replicas strictly
     through the zero-loss protocol (drain_mark before drain_sigterm,
     exit 0) back to min size — with the autoscaler/capacity telemetry
-    visible on the router's /debug/telemetry plane."""
+    visible on the router's /debug/telemetry plane.  The lifecycle
+    gate (ISSUE 17) additionally requires every mid-surge scale-up to
+    leave a complete monotone spawn-phase record and every scale-up
+    decision event to carry `observed_spawn_ms`."""
     import time as _time
     import urllib.request as _urlreq
 
@@ -1214,6 +1217,13 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
         with _urlreq.urlopen(fleet.router.address + "/debug/tenants",
                              timeout=10) as r:
             tenant_debug = json.loads(r.read())
+        # replica lifecycle over the fleet (ISSUE 17): the joined
+        # spawn records, fetched AFTER the ramp-down removed the
+        # surge replicas — which is exactly what the records being
+        # DURABLE (attached at first probe-up) must survive
+        with _urlreq.urlopen(fleet.router.address + "/debug/lifecycle",
+                             timeout=10) as r:
+            lifecycle_debug = json.loads(r.read())
         # bounded cardinality under identity churn: 10k distinct ids
         # against the live router ledger (AFTER the debug snapshot —
         # the sweep evicts the real tenants from the top-K table)
@@ -1277,6 +1287,25 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
         < kinds.index(("drain_sigterm", e["rank"]))
         and e.get("rc") == 0
         for e in removed)
+    # lifecycle gate (ISSUE 17): every mid-surge scale-up must have
+    # yielded a COMPLETE, MONOTONE joined phase record (no phase
+    # missing, no negative duration — validate_record pins both), and
+    # every scale-up decision event must carry the observed
+    # spawn->routable estimate (r0's launch completed before the
+    # scaler's first tick, so even the first scale-up has a sample)
+    lc_records = {r.get("rank"): r for r in
+                  (lifecycle_debug.get("fleet", {}).get("records")
+                   or []) if isinstance(r, dict)}
+    lc_problems = {}
+    for e in scale_ups:
+        rec = lc_records.get(e["rank"])
+        probs = (obs.lifecycle.validate_record(rec)
+                 if rec is not None else ["record missing"])
+        if probs:
+            lc_problems[e["rank"]] = probs
+    lifecycle_ok = bool(scale_ups) and not lc_problems
+    observed_spawn_logged = bool(scale_ups) and all(
+        e.get("observed_spawn_ms") is not None for e in scale_ups)
     gen_p99 = (s["latency_ms"].get("generate") or {}).get("p99")
     debug_gauges = debug_snap.get("metrics", {}).get("gauges", {})
     telemetry_ok = (
@@ -1360,6 +1389,16 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
                          "tracked": sweep_snap.get("tracked"),
                          "k": sweep_snap.get("k")},
         "tenant_sweep_bounded": bool(tenant_sweep_bounded),
+        "lifecycle_ok": bool(lifecycle_ok),
+        "lifecycle_problems": lc_problems,
+        "lifecycle_phases": {
+            rank: {k: round(v, 2)
+                   for k, v in (rec.get("phases_ms") or {}).items()}
+            for rank, rec in sorted(lc_records.items())
+            if isinstance(rank, int)},
+        "observed_spawn_ms_logged": bool(observed_spawn_logged),
+        "observed_spawn_ms": (scale_ups[-1].get("observed_spawn_ms")
+                              if scale_ups else None),
         "recovered": (
             s["admitted_failures"] == 0 and s["replayed"] == 0
             and s["ok"] > 0 and s["shed"] + s["ok"] > 0
@@ -1377,7 +1416,9 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
             and bool(tenants_tracked)
             and bool(tenant_client_match)
             and bool(tenant_conserves)
-            and bool(tenant_sweep_bounded)),
+            and bool(tenant_sweep_bounded)
+            and bool(lifecycle_ok)
+            and bool(observed_spawn_logged)),
     }
     return report
 

@@ -65,6 +65,7 @@ def _load_obs_module(name):
 
 
 _export = _load_obs_module("export")
+_lifecycle_mod = _load_obs_module("lifecycle")
 _metrics_mod = _load_obs_module("metrics")
 _tenant_mod = _load_obs_module("tenant_ledger")
 
@@ -394,6 +395,31 @@ def rollup(streams):
                    "fleet": _tenant_mod.merge_snapshots(
                        list(per_tenant.values()))}
 
+    # replica lifecycle (ISSUE 17): each process dumps its FULL phase
+    # record (full state, last dump wins — same contract as tenants).
+    # A replica dump is its own ledger record; a supervisor dump is a
+    # fleet view with joined per-spawn records.  The fleet rollup is
+    # phase percentiles across every spawn story seen.
+    lifecycle = {}
+    per_lc = {ident: e["lifecycle"] for ident, e in sorted(last.items())
+              if isinstance(e.get("lifecycle"), dict)}
+    if per_lc:
+        spawn_records = []
+        for rec in per_lc.values():
+            if isinstance(rec.get("records"), list):
+                spawn_records.extend(
+                    r for r in rec["records"] if isinstance(r, dict))
+            elif isinstance(rec.get("durations_ms"), dict):
+                row = {"phases_ms": dict(rec["durations_ms"])}
+                row["phases_ms"]["compile"] = float(
+                    rec.get("compile_total_ms", 0.0))
+                if "total_ms" in rec:
+                    row["total_ms"] = rec["total_ms"]
+                spawn_records.append(row)
+        lifecycle = {"per_process": per_lc,
+                     "fleet": _lifecycle_mod.rollup_records(
+                         spawn_records)}
+
     out = {"schema": "telemetry_rollup/v1",
             "processes": sorted(last),
             "counters": dict(sorted(counters.items())),
@@ -405,6 +431,8 @@ def rollup(streams):
             "slo": slo_out}
     if tenants:
         out["tenants"] = tenants
+    if lifecycle:
+        out["lifecycle"] = lifecycle
     return out
 
 
