@@ -851,6 +851,75 @@ def _bench_fleet_cold_start(degraded: bool) -> dict:
     return result
 
 
+def _bench_qos_paid_p99(degraded: bool) -> dict:
+    """Paid-tier isolation under surge (ISSUE 18):
+    `serving_qos_paid_p99_ratio` = the paid class's ok-latency p99
+    under a two-class (50/50 paid/free) surge, over the p99 of the
+    IDENTICAL surge with no class differentiation — what a paid
+    request pays for sharing the fleet with free traffic.  QoS holding
+    means the ratio sits well under 1.0 (class-weighted admission
+    sheds free first, strict-priority dequeue keeps paid moving); 1.0
+    means the classes bought nothing.  Toy replicas on the CPU proxy —
+    queueing dynamics, not chip throughput, are the claim — so the row
+    is degraded-marked either way."""
+    from paddle_tpu.inference.fleet import ReplicaFleet, toy_token
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    def surge(class_split):
+        fleet = ReplicaFleet(num_replicas=1, kind="toy",
+                             token_time=0.02, service_time=0.02,
+                             max_slots=4, launch_timeout=60,
+                             monitor_interval=0.1)
+        fleet.start()
+        try:
+            wl = loadgen.SharedPrefixWorkload(
+                seed=0, tenants=4, system_prompt_tokens=16,
+                suffix_tokens=(3, 6), generate_frac=1.0,
+                max_new_tokens=16, class_split=class_split)
+            phases = loadgen.surge_phases(
+                base_rps=3.0, surge_mult=8.0, warm_s=1.0,
+                surge_s=4.0, cool_s=1.0)
+            runner = loadgen.OpenLoopRunner(
+                fleet.router.address, wl, phases, seed=0,
+                expected_token=toy_token, timeout=30.0, max_retries=2)
+            return runner.run().summary()
+        finally:
+            fleet.stop()
+
+    two = surge({"paid": 0.5, "free": 0.5})   # classes on
+    flat = surge(None)                        # same surge, no classes
+    paid = (two.get("classes") or {}).get("paid") or {}
+    free = (two.get("classes") or {}).get("free") or {}
+    paid_p99 = (paid.get("latency_ms") or {}).get("p99")
+    base_p99 = (flat.get("latency_ms", {}).get("generate") or {}).get(
+        "p99")
+    if not paid_p99 or not base_p99:
+        raise RuntimeError(
+            f"missing p99 (paid={paid_p99}, baseline={base_p99})")
+    result = {
+        "metric": "serving_qos_paid_p99_ratio",
+        "value": round(paid_p99 / base_p99, 3), "unit": "ratio",
+        "lower_better": True, "vs_baseline": 0.0,
+        "paid_p99_ms": round(paid_p99, 1),
+        "single_class_p99_ms": round(base_p99, 1),
+        "paid_shed": paid.get("shed", 0),
+        "free_shed": free.get("shed", 0),
+        "paid_admitted_failures": paid.get("admitted_failures", 0),
+        "workload": "loadgen shared-prefix surge (4 tenants, "
+                    "50/50 paid/free vs single-class)",
+    }
+    result["degraded"] = True  # CPU-proxy toy replicas (see docstring)
+    result["note"] = ("toy-replica queueing proxy: the ratio claims "
+                      "scheduling policy, not chip throughput")
+    return result
+
+
 def _multichip_sharded_probe() -> None:
     """``--multichip-sharded-probe`` (run in a SUBPROCESS on a forced
     8-virtual-device CPU mesh): train a tiny GPT under the default
@@ -1191,6 +1260,16 @@ def run_secondary_benches(degraded: bool = False) -> None:
         _emit({"metric": "fleet_replica_cold_start_ms", "value": 0.0,
                "unit": "ms", "lower_better": True, "vs_baseline": 0.0,
                "degraded": True,
+               "note": f"failed: {type(e).__name__}: {e}"})
+    try:
+        _emit(_bench_qos_paid_p99(degraded))
+    except Exception as e:
+        print(f"qos-paid-p99-bench-failed: {e}", file=sys.stderr)
+        # a failed measurement must not read as "QoS holds": the row
+        # goes out degraded with a loud note, never silently absent
+        _emit({"metric": "serving_qos_paid_p99_ratio", "value": 0.0,
+               "unit": "ratio", "lower_better": True,
+               "vs_baseline": 0.0, "degraded": True,
                "note": f"failed: {type(e).__name__}: {e}"})
     try:
         _bench_multichip_sharded(degraded)

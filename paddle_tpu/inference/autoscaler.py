@@ -168,10 +168,20 @@ class Autoscaler:
         into its depth."""
         router = self.fleet.router
         burn = 0.0
+        paid_burn = 0.0
         report = router.slo.report(publish_gauges=False)
         for ep in report.get("endpoints", {}).values():
             if ep.get("requests"):
                 burn = max(burn, float(ep.get("burn_rate") or 0.0))
+            # the paid tier's OWN burn (ISSUE 18): measured against its
+            # (usually tighter) class objective.  Under surge the
+            # aggregate burn is dominated by deliberately-degraded
+            # free/batch sheds — the fleet must still grow when the
+            # PAID promise is the one burning.
+            crep = (ep.get("classes") or {}).get("paid")
+            if crep and crep.get("requests"):
+                paid_burn = max(paid_burn,
+                                float(crep.get("burn_rate") or 0.0))
         occupancy = 0.0
         queued = 0
         for ctl in (router.admission, router.gen_admission):
@@ -191,6 +201,7 @@ class Autoscaler:
             _metrics.set_gauge("autoscaler.observed_spawn_ms", spawn_ms)
         return {
             "burn_rate": round(burn, 4),
+            "paid_burn_rate": round(paid_burn, 4),
             "occupancy": round(occupancy, 4),
             "queue_depth": queued,
             "actual": self.fleet.replica_count(),
@@ -230,13 +241,19 @@ class Autoscaler:
         sig["d_occupancy"] = None if d_occ is None else round(d_occ, 4)
         sig["d_queue_depth"] = (None if d_queue is None
                                 else round(d_queue, 4))
-        if sig["burn_rate"] >= self.burn_up and not self._burn_crossed:
+        if max(sig["burn_rate"], sig["paid_burn_rate"]) >= self.burn_up \
+                and not self._burn_crossed:
             # the ordering witness the surge chaos asserts against: a
             # predictive scale-up logged BEFORE this event beat the
             # burn-only trigger within the same run
             self._burn_crossed = True
             self._event("burn_threshold_crossed", **sig)
+        # paid-class burn is a first-class scale-up trigger (ISSUE 18):
+        # the fleet grows FOR the paid tier — every decision event
+        # carries `paid_burn_rate`, so the log shows which promise the
+        # action defended
         wants_up = (sig["burn_rate"] >= self.burn_up
+                    or sig["paid_burn_rate"] >= self.burn_up
                     or sig["occupancy"] >= self.occ_up)
         # the LEADING signal: pressure not yet over the bar, but
         # growing fast enough that it will be — fire while the launch
@@ -247,6 +264,7 @@ class Autoscaler:
                            or (d_queue is not None
                                and d_queue >= self.queue_deriv_up)))
         wants_down = (sig["burn_rate"] < self.burn_up
+                      and sig["paid_burn_rate"] < self.burn_up
                       and sig["occupancy"] <= self.occ_down)
         self._up_streak = self._up_streak + 1 if wants_up else 0
         # threshold evidence counts toward the predictive streak too:

@@ -882,17 +882,20 @@ class InferenceEngine:
 
     # --- intake -------------------------------------------------------------
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
-               request_id=None, tenant_id=None) -> RequestHandle:
+               request_id=None, tenant_id=None,
+               priority_class=None) -> RequestHandle:
         """Enqueue one sequence; returns its `RequestHandle`.  Raises
         ValueError when the request can never fit (prompt+max_new over
         the engine's per-sequence or pool capacity) — feasibility is
         checked at the door so the scheduler never deadlocks on an
         unservable request.  `tenant_id` names who the tenant ledger
         bills for this sequence's tokens/slot-time/page-seconds
-        (ISSUE 16; None books under `anon`)."""
+        (ISSUE 16; None books under `anon`); `priority_class` orders
+        admission and preemption (ISSUE 18; None → the default
+        class)."""
         seq = Sequence(input_ids, max_new_tokens,
                        eos_token_id=eos_token_id, request_id=request_id,
-                       tenant_id=tenant_id)
+                       tenant_id=tenant_id, priority_class=priority_class)
         need = -(-(seq.prompt.size + seq.max_new_tokens)
                  // self.config.page_size)
         if need > self.pool.capacity:
@@ -1337,13 +1340,17 @@ class InferenceEngine:
         """Decode-slot occupancy billing (ISSUE 16): every sequence in
         the pass occupied one batch slot for the step's wall time —
         THE contended capacity unit (max_slots), so a tenant holding
-        slots with long sequences shows up even at a low token rate."""
-        if self.tenant_ledger is None or not running:
+        slots with long sequences shows up even at a low token rate.
+        The same charge feeds the scheduler's quota/fairness meter
+        (ISSUE 18) — QoS prices in the unit the ledger bills."""
+        if not running:
             return
         step_ms = (time.perf_counter() - t_step) * 1e3
         for seq in running:
-            self.tenant_ledger.record_decode_slot_ms(
-                seq.tenant_id, step_ms)
+            if self.tenant_ledger is not None:
+                self.tenant_ledger.record_decode_slot_ms(
+                    seq.tenant_id, step_ms)
+            self.scheduler.note_decode_slot_ms(seq.tenant_id, step_ms)
 
     def _accept(self, seq: Sequence, tok: int) -> None:
         """One generated token passes the host: record, deliver,

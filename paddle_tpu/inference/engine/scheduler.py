@@ -36,15 +36,43 @@ without sleeping.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
 
 import numpy as np
 
+from .. import qos as _qos
 from .paging import OutOfPages, PagePool, SCRATCH_PAGE
 
 __all__ = ["Sequence", "Scheduler", "SchedulerOutput"]
+
+_RANKS = tuple(_qos.class_rank(c) for c in _qos.CLASSES)
+
+# sliding window over which per-tenant decode-slot-ms rates (the
+# quota/fairness unit — same unit the TenantLedger bills) are averaged
+_QUOTA_WINDOW_S = 10.0
+
+
+def _parse_quotas(raw):
+    """``class:slots`` pairs (comma-separated) → {class: float slots}.
+    Malformed entries are dropped — a bad env var must not take the
+    scheduler down."""
+    out = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        cls, _, val = part.rpartition(":")
+        cls = _qos.normalize_class(cls)
+        try:
+            val = float(val)
+        except ValueError:
+            continue
+        if cls is not None and val > 0:
+            out[cls] = val
+    return out
 
 WAITING, RUNNING, FINISHED, CANCELLED = (
     "waiting", "running", "finished", "cancelled")
@@ -56,7 +84,8 @@ class Sequence:
     _ids = itertools.count()
 
     def __init__(self, input_ids, max_new_tokens, eos_token_id=None,
-                 request_id=None, arrived_at=0.0, tenant_id=None):
+                 request_id=None, arrived_at=0.0, tenant_id=None,
+                 priority_class=None):
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         if ids.size < 1:
             raise ValueError("empty prompt")
@@ -69,6 +98,10 @@ class Sequence:
                              else int(eos_token_id))
         self.request_id = request_id or f"seq-{next(self._ids)}"
         self.tenant_id = tenant_id   # who the ledger bills (ISSUE 16)
+        # what was promised (ISSUE 18): orders admission and picks
+        # preemption victims; validate-or-drop to the default class
+        self.priority_class = (_qos.normalize_class(priority_class)
+                               or _qos.DEFAULT_CLASS)
         self.arrived_at = float(arrived_at)
         self._page_mark = None       # last page-seconds charge instant
         self.timeline = None       # optional RequestTimeline (ISSUE 15)
@@ -129,7 +162,7 @@ class Scheduler:
     def __init__(self, max_slots: int, pool: PagePool,
                  max_pages_per_seq: int, clock=time.monotonic,
                  prefix_index=None, decision_ring=None,
-                 tenant_ledger=None):
+                 tenant_ledger=None, qos_age_s=None, quotas=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = int(max_slots)
@@ -137,6 +170,22 @@ class Scheduler:
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.clock = clock
         self.prefix_index = prefix_index  # optional PrefixIndex
+        # QoS policy knobs (ISSUE 18): aging bounds starvation (a
+        # waiting sequence gains one rank per qos_age_s seconds), and
+        # `quotas` caps a TENANT's decode-slot rate per class —
+        # {"free": 2.0} = a free tenant may hold at most ~2 decode
+        # slots averaged over the quota window; over-quota tenants are
+        # admitted last and evicted first WITHIN their class
+        # (work-conserving: slots never idle to enforce a quota)
+        if qos_age_s is None:
+            qos_age_s = float(os.environ.get(
+                "PADDLE_TPU_QOS_AGE_S", "") or 30.0)
+        self.qos_age_s = max(0.0, float(qos_age_s))
+        if quotas is None:
+            quotas = _parse_quotas(os.environ.get(
+                "PADDLE_TPU_QOS_QUOTAS", ""))
+        self.quotas = dict(quotas or {})
+        self._slot_ms = {}         # tenant -> deque[(t, slot_ms)]
         # optional timeseries.DecisionRing (ISSUE 15): every admit /
         # evict-recompute / prefix-reclaim decision lands there with
         # the page pressure AT DECISION TIME, so a request's token gap
@@ -202,6 +251,69 @@ class Scheduler:
                 **data)
         except Exception:  # pt-lint: ok[PT005]
             pass           # (observability fan-out guard)
+
+    # --- QoS accounting / ordering (ISSUE 18) -------------------------------
+    def note_decode_slot_ms(self, tenant_id, ms):
+        """One decode step's slot occupancy for one tenant — the engine
+        feeds this alongside the ledger's `record_decode_slot_ms`, so
+        quotas and fairness are priced in the SAME decode-slot-ms unit
+        the tenant is billed in."""
+        with self._lock:
+            q = self._slot_ms.get(tenant_id)
+            if q is None:
+                q = self._slot_ms[tenant_id] = deque()
+            now = self.clock()
+            q.append((now, float(ms)))
+            horizon = now - _QUOTA_WINDOW_S
+            while q and q[0][0] < horizon:
+                q.popleft()
+
+    def _slot_rate_locked(self, tenant_id):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        """Average decode slots this tenant held over the quota window
+        (1.0 = one slot continuously busy for it)."""
+        q = self._slot_ms.get(tenant_id)
+        if not q:
+            return 0.0
+        now = self.clock()
+        horizon = now - _QUOTA_WINDOW_S
+        while q and q[0][0] < horizon:
+            q.popleft()
+        return sum(ms for _, ms in q) / (_QUOTA_WINDOW_S * 1e3)
+
+    def _over_quota_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        quota = self.quotas.get(seq.priority_class)
+        if quota is None or seq.tenant_id is None:
+            return False
+        return self._slot_rate_locked(seq.tenant_id) > quota
+
+    def _eff_rank_locked(self, seq, now):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        """Class rank after starvation aging: +1 rank per `qos_age_s`
+        waited seconds, capped at the top class — a batch sequence
+        eventually outranks a steady paid stream in ADMISSION order
+        (preemption stays on static rank: aging earns a slot, not the
+        right to take someone else's)."""
+        rank = _qos.class_rank(seq.priority_class)
+        if self.qos_age_s <= 0:
+            return rank
+        waited = max(0.0, now - seq.arrived_at)
+        return min(max(_RANKS), rank + int(waited / self.qos_age_s))
+
+    def _admission_order_locked(self, now):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        """Waiting sequences in admission order: highest effective rank
+        first, FIFO within a rank (a preempted sequence keeps its
+        original arrival, so it resumes before newer same-class work),
+        under-quota tenants before over-quota ones at equal rank, and
+        weighted decode-slot fairness as the final tie-break (the
+        tenant with the smallest usage-per-weight goes first)."""
+        def key(pair):
+            idx, seq = pair
+            usage = self._slot_rate_locked(seq.tenant_id) \
+                / _qos.class_weight(seq.priority_class)
+            return (-self._eff_rank_locked(seq, now),
+                    self._over_quota_locked(seq),
+                    seq.arrived_at, round(usage, 6), idx)
+        return [s for _, s in
+                sorted(enumerate(self._waiting), key=key)]
 
     def _charge_pages_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
         """Integrate page-seconds since the last charge at the CURRENT
@@ -322,17 +434,31 @@ class Scheduler:
                         if victim is seq:
                             break
 
-            # 3. FIFO admission into free slots
+            # 3. priority-ordered admission into free slots: strict
+            # priority with starvation aging, FIFO within a class
+            # (ISSUE 18 — pre-QoS this was plain FIFO, which the
+            # single-class case still degenerates to).  A high-class
+            # candidate that cannot get a slot or pages preempts the
+            # lowest-class youngest running sequence via the SAME
+            # recompute-eviction path pressure uses — the victim
+            # resumes warm from the prefix cache, stream intact.
             prefills = []
-            while self._waiting and len(self._running) < self.max_slots:
-                seq = self._waiting[0]
+            while self._waiting:
+                seq = self._admission_order_locked(self.clock())[0]
+                if len(self._running) >= self.max_slots:
+                    victim = self._preempt_for_locked(seq)
+                    if victim is None:
+                        break  # nothing this candidate outranks
+                    evicted.append(victim)
                 prompt = seq.resume_prompt()
                 shared_pages = self._lookup_prefix_locked(seq, prompt)
                 need = self._target_pages(
                     seq, prompt.size + max(1, int(chunk))) \
                     - len(shared_pages)
-                if not self.pool.can_alloc(need):
-                    got = 0
+                starved = False
+                while not self.pool.can_alloc(need):
+                    # LRU tier first (cold cache dies before live
+                    # work), then policy preemption of lower classes
                     if self.prefix_index is not None:
                         got = self.prefix_index.evict_idle(
                             need - self.pool.free_pages)
@@ -340,16 +466,23 @@ class Scheduler:
                             self._decide("prefix_reclaim", pages=got,
                                          requested=need,
                                          for_request=seq.request_id)
-                    if got == 0 or not self.pool.can_alloc(need):
-                        # release the just-pinned prefix refs before
-                        # refusing — strict FIFO: nothing skips the head
-                        if shared_pages:
-                            self.pool.free(shared_pages)
-                            seq.shared_len = 0
-                            seq.shared_nodes = []
-                            seq.cache_state = None
-                        break
-                self._waiting.popleft()
+                            continue
+                    victim = self._preempt_for_locked(seq)
+                    if victim is not None:
+                        evicted.append(victim)
+                        continue
+                    starved = True
+                    break
+                if starved:
+                    # release the just-pinned prefix refs before
+                    # refusing — nothing skips the chosen head
+                    if shared_pages:
+                        self.pool.free(shared_pages)
+                        seq.shared_len = 0
+                        seq.shared_nodes = []
+                        seq.cache_state = None
+                    break
+                self._waiting.remove(seq)
                 seq.pages = shared_pages + self.pool.alloc(need)
                 seq._page_mark = self.clock()  # residency starts NOW
                 seq.slot = self._free_slot_locked()
@@ -406,12 +539,48 @@ class Scheduler:
                 return s
         raise RuntimeError("no free slot (scheduler invariant broken)")
 
-    def _evict_youngest_locked(self):  # pt-lint: ok[PT102] (callers hold _lock)
+    def _evict_youngest_locked(self, below_rank=None):  # pt-lint: ok[PT102] (callers hold _lock)
+        """Class-aware recompute-eviction victim: the LOWEST class
+        first (paid dies last), over-quota tenants before on-quota
+        ones within a class, youngest admission within that — the
+        pre-QoS youngest-first policy, applied per class.  With
+        `below_rank`, only sequences of strictly lower class are
+        eligible (policy preemption must never evict a peer)."""
         cands = [s for s in self._running.values() if not s.done]
+        if below_rank is not None:
+            cands = [s for s in cands
+                     if _qos.class_rank(s.priority_class) < below_rank]
         if not cands:
             return None
-        victim = max(cands, key=lambda s: s.admit_seqno)
+        victim = max(cands, key=lambda s: (
+            -_qos.class_rank(s.priority_class),
+            self._over_quota_locked(s), s.admit_seqno))
         self._evict_locked(victim)
+        return victim
+
+    def _preempt_for_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+        """Policy preemption (ISSUE 18): evict the lowest-class
+        youngest RUNNING sequence so the strictly-higher-class `seq`
+        can take its slot/pages — through the exact recompute-eviction
+        path pressure uses, so the victim resumes warm from the prefix
+        cache and its stream continues bit-identically.  Returns the
+        victim or None (nothing outranked)."""
+        rank = _qos.class_rank(seq.priority_class)
+        victim = self._evict_youngest_locked(below_rank=rank)
+        if victim is None:
+            return None
+        self._decide("evict_preempt", request_id=victim.request_id,
+                     for_request=seq.request_id,
+                     victim_class=victim.priority_class,
+                     for_class=seq.priority_class,
+                     generated=len(victim.tokens))
+        try:
+            from ...observability import metrics as _metrics
+
+            _metrics.inc("qos.preemptions",
+                         **{"class": victim.priority_class})
+        except Exception:  # pt-lint: ok[PT005]
+            pass           # (observability fan-out guard)
         return victim
 
     def _evict_locked(self, seq):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
@@ -469,9 +638,16 @@ class Scheduler:
 
     def stats(self) -> dict:
         with self._lock:
+            by_class = {c: {"running": 0, "waiting": 0}
+                        for c in _qos.CLASSES}
+            for s in self._running.values():
+                by_class[s.priority_class]["running"] += 1
+            for s in self._waiting:
+                by_class[s.priority_class]["waiting"] += 1
             return {
                 "running": len(self._running),
                 "waiting": len(self._waiting),
                 "max_slots": self.max_slots,
                 "occupancy": len(self._running) / self.max_slots,
+                "by_class": by_class,
             }

@@ -184,12 +184,16 @@ class ToyEngine:
             h._finish("cancelled")
 
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
-               request_id=None, tenant_id=None):
+               request_id=None, tenant_id=None, priority_class=None):
+        # priority_class is accepted for signature parity with the real
+        # engine (serving passes it through uniformly); the toy engine
+        # has no scheduler to preempt, so it only records the label
         ids = [int(x) for x in np.asarray(input_ids).reshape(-1)]
         if not ids:
             raise ValueError("empty input_ids")
         h = _ToyHandle(request_id or uuid.uuid4().hex[:16])
         h.tenant_id = tenant_id
+        h.priority_class = priority_class
         h._prompt = ids
         with self._lock:
             if self._stopped:

@@ -85,6 +85,7 @@ from ..observability import trace as _trace
 from ..observability.slo import SLOTracker
 from ..resilience.overload import AdmissionController, ShedError, _env_num
 from ..resilience.retry import CircuitBreaker, CircuitOpenError
+from . import qos as _qos
 from .serving import _retry_after_header
 
 __all__ = ["Router", "HTTPTransport", "ReplicaUnreachable"]
@@ -280,15 +281,25 @@ class Router:
         self.slo = SLOTracker(
             window_s=_env_num("PADDLE_TPU_SLO_WINDOW", 300.0, float),
             clock=clock)
+        paid_avail = _env_num(
+            "PADDLE_TPU_SLO_PAID_AVAILABILITY",
+            _env_num("PADDLE_TPU_SLO_AVAILABILITY", 0.999, float),
+            float)
         for ep, target in (("predict", 1000.0), ("generate", 30000.0)):
+            latency_ms = _env_num(
+                "PADDLE_TPU_SLO_LATENCY_MS" if ep == "predict"
+                else "PADDLE_TPU_SLO_GENERATE_LATENCY_MS",
+                target, float)
             self.slo.objective(
-                ep,
-                latency_target_ms=_env_num(
-                    "PADDLE_TPU_SLO_LATENCY_MS" if ep == "predict"
-                    else "PADDLE_TPU_SLO_GENERATE_LATENCY_MS",
-                    target, float),
+                ep, latency_target_ms=latency_ms,
                 availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
                                       0.999, float))
+            # the paid tier's own promise (ISSUE 18): its burn rate is
+            # what the autoscaler scales for — free/batch inherit the
+            # endpoint objective (degrading them is the DESIGN under
+            # surge, not a page)
+            self.slo.objective(ep, latency_target_ms=latency_ms,
+                               availability=paid_avail, cls="paid")
         # per-tenant metering at the EDGE (ISSUE 16): the router's own
         # book bills every request it answers — including sheds and
         # failed failovers a replica never saw, which is exactly what
@@ -416,6 +427,14 @@ class Router:
                     tid = _tledger.sanitize_tenant(f"fp:{fp}") \
                         if fp else None
                     ctx.tenant_id = tid or _tledger.ANON_TENANT
+                # QoS class resolved ONCE at the edge (ISSUE 18): an
+                # explicit valid X-Priority-Class wins, else the
+                # tenant->class map, else the default tier.  The
+                # resolved class rides the forwarded hop's headers so
+                # router and replica agree on the tier.
+                ctx.priority_class = _qos.resolve_class(
+                    tenant_id=ctx.tenant_id,
+                    explicit=ctx.priority_class)
                 self._rt_ctx = ctx
                 with _rtrace.activate(ctx):
                     if self.path == "/predict":
@@ -433,9 +452,11 @@ class Router:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
-                    deadline = router._deadline()
+                    deadline = router._deadline(ctx)
                     try:
-                        ticket = router.admission.admit(deadline=deadline)
+                        ticket = router.admission.admit(
+                            deadline=deadline,
+                            priority_class=ctx.priority_class)
                     except ShedError as e:
                         status = "shed"
                         return self._json(
@@ -481,7 +502,8 @@ class Router:
                     if ticket is not None:
                         ticket.release(ok=status == "ok")
                     router._finish_request("predict", status, sp, t_req,
-                                           tenant_id=ctx.tenant_id)
+                                           tenant_id=ctx.tenant_id,
+                                           cls=ctx.priority_class)
 
             # --- /generate: streamed forward -------------------------
             def _route_generate(self, ctx):
@@ -521,10 +543,11 @@ class Router:
                             ctx.tenant_id = _tledger.sanitize_tenant(
                                 f"fp:{fingerprint}") \
                                 or _tledger.ANON_TENANT
-                    deadline = router._deadline()
+                    deadline = router._deadline(ctx)
                     try:
                         ticket = router.gen_admission.admit(
-                            deadline=deadline)
+                            deadline=deadline,
+                            priority_class=ctx.priority_class)
                     except ShedError as e:
                         status = "shed"
                         return self._json(
@@ -551,7 +574,8 @@ class Router:
                     if ticket is not None:
                         ticket.release(ok=status == "ok")
                     router._finish_request("generate", status, sp, t_req,
-                                           tenant_id=ctx.tenant_id)
+                                           tenant_id=ctx.tenant_id,
+                                           cls=ctx.priority_class)
 
         self._httpd = _RouterHTTPServer((host, port), Handler)
         self._thread = None
@@ -1212,12 +1236,21 @@ class Router:
     # ------------------------------------------------------------------
     # telemetry
     # ------------------------------------------------------------------
-    def _deadline(self):
-        return (None if self.request_timeout is None
-                else self.clock() + self.request_timeout)
+    def _deadline(self, ctx=None):
+        """Edge deadline: the router's request timeout, tightened by a
+        client-declared X-Deadline-Ms budget (ISSUE 18) — a request
+        that cannot finish inside its own budget should shed with
+        `deadline`, not camp the queue."""
+        deadline = (None if self.request_timeout is None
+                    else self.clock() + self.request_timeout)
+        if ctx is not None and ctx.deadline_ms is not None:
+            client_dl = self.clock() + ctx.deadline_ms / 1e3
+            deadline = (client_dl if deadline is None
+                        else min(deadline, client_dl))
+        return deadline
 
     def _finish_request(self, endpoint, status, sp, t_req,
-                        tenant_id=None):
+                        tenant_id=None, cls=None):
         dt_ms = (time.perf_counter() - t_req) * 1e3
         if sp is not None:
             sp.args["status"] = status
@@ -1238,11 +1271,12 @@ class Router:
         # the availability promise is about the fleet, and a
         # misbehaving client must not buy itself more replicas).
         if status == "ok":
-            self.slo.observe(endpoint, dt_ms, ok=True)
+            self.slo.observe(endpoint, dt_ms, ok=True, cls=cls)
         elif status == "shed":
-            self.slo.record_shed(endpoint, "edge")
+            self.slo.record_shed(endpoint, "edge", cls=cls)
         elif status in ("error", "interrupted", "timeout"):
-            self.slo.observe(endpoint, dt_ms, ok=False, reason=status)
+            self.slo.observe(endpoint, dt_ms, ok=False, reason=status,
+                             cls=cls)
 
     def _publish_state_gauges(self):
         counts = dict.fromkeys(_REPLICA_STATES, 0)

@@ -80,6 +80,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from . import Config, create_predictor
+from . import qos as _qos
 from ..observability import lifecycle as _lifecycle
 from ..observability import metrics as _metrics
 from ..observability import request_trace as _rtrace
@@ -241,6 +242,26 @@ class InferenceServer:
                     "PADDLE_TPU_SLO_TTFT_MS", 5000.0, float),
                 availability=_env_num("PADDLE_TPU_SLO_AVAILABILITY",
                                       0.999, float))
+        # per-class objectives (ISSUE 18): the PAID class carries its
+        # own explicit promise (env-tunable; defaults mirror the
+        # endpoint objective) so its burn is tracked against what IT
+        # was sold, not the blended fleet average; free/batch inherit
+        # the endpoint objective in per-class burn computation
+        paid_avail = _env_num("PADDLE_TPU_SLO_PAID_AVAILABILITY",
+                              _env_num("PADDLE_TPU_SLO_AVAILABILITY",
+                                       0.999, float), float)
+        self.slo.objective(
+            "predict", cls="paid",
+            latency_target_ms=_env_num("PADDLE_TPU_SLO_LATENCY_MS",
+                                       1000.0, float),
+            availability=paid_avail)
+        if engine is not None:
+            self.slo.objective(
+                "generate", cls="paid",
+                latency_target_ms=_env_num(
+                    "PADDLE_TPU_SLO_GENERATE_LATENCY_MS", 30000.0,
+                    float),
+                availability=paid_avail)
         # time-dimension telemetry (ISSUE 15): a registry sampler for
         # /debug/timeseries (+ exporter dumps), and — for engines — an
         # online ITL/TTFT anomaly watchdog fed at the stream edge
@@ -438,6 +459,14 @@ class InferenceServer:
                     tid = _tledger.sanitize_tenant(f"fp:{fp}") \
                         if fp else None
                     ctx.tenant_id = tid or _tledger.ANON_TENANT
+                # QoS class resolution (ISSUE 18): an explicit valid
+                # X-Priority-Class wins, else the PADDLE_TPU_QOS_CLASSES
+                # tenant→class map, else the default class — resolved
+                # ONCE here so admission, the engine scheduler, and the
+                # SLO rows below all see the same promise
+                ctx.priority_class = _qos.resolve_class(
+                    tenant_id=ctx.tenant_id,
+                    explicit=ctx.priority_class)
                 self._rt_ctx = ctx
                 with _rtrace.activate(ctx):
                     if self.path == "/generate":
@@ -491,11 +520,20 @@ class InferenceServer:
                     deadline = (None if server._request_timeout is None
                                 else time.monotonic()
                                 + server._request_timeout)
+                    if ctx.deadline_ms is not None:
+                        # the client's own X-Deadline-Ms: the tighter
+                        # bound wins (admission refuses work it cannot
+                        # finish by then, and reports shed:deadline)
+                        client_dl = time.monotonic() \
+                            + ctx.deadline_ms / 1e3
+                        deadline = (client_dl if deadline is None
+                                    else min(deadline, client_dl))
                     try:
                         with _rtrace.request_phase("admission",
                                                    endpoint="generate"):
                             ticket = server.gen_admission.admit(
-                                deadline=deadline)
+                                deadline=deadline,
+                                priority_class=ctx.priority_class)
                     except ShedError as e:
                         status, slo_reason = "shed", e.reason
                         return self._json(
@@ -511,7 +549,8 @@ class InferenceServer:
                             ids, max_new_tokens=max_new,
                             eos_token_id=eos,
                             request_id=ctx.request_id,
-                            tenant_id=ctx.tenant_id)
+                            tenant_id=ctx.tenant_id,
+                            priority_class=ctx.priority_class)
                     except _DETERMINISTIC_ERRORS as e:
                         status = "client_error"
                         return self._json(
@@ -569,8 +608,9 @@ class InferenceServer:
                                     "serving.phase_ms", ttft_ms,
                                     phase="first_token",
                                     endpoint="generate")
-                                server.slo.observe("ttft", ttft_ms,
-                                                   ok=True)
+                                server.slo.observe(
+                                    "ttft", ttft_ms, ok=True,
+                                    cls=ctx.priority_class)
                                 if server.anomalies is not None:
                                     server.anomalies.observe("ttft",
                                                              ttft_ms)
@@ -616,7 +656,8 @@ class InferenceServer:
                             server.slo.observe(
                                 "ttft",
                                 (time.perf_counter() - t_req) * 1e3,
-                                ok=False, reason="timeout")
+                                ok=False, reason="timeout",
+                                cls=ctx.priority_class)
                 finally:
                     if ticket is not None:
                         ticket.release(ok=status == "ok")
@@ -631,7 +672,8 @@ class InferenceServer:
                         server.tenant_ledger.record_request(
                             ctx.tenant_id, status)
                     server._slo_record(status, slo_reason, dt_ms,
-                                       endpoint="generate")
+                                       endpoint="generate",
+                                       cls=ctx.priority_class)
 
             def _predict_traced(self, ctx):
                 t_req = time.perf_counter()
@@ -700,7 +742,8 @@ class InferenceServer:
                     if server.tenant_ledger is not None:
                         server.tenant_ledger.record_request(
                             ctx.tenant_id, status)
-                    server._slo_record(status, slo_reason, dt_ms)
+                    server._slo_record(status, slo_reason, dt_ms,
+                                       cls=ctx.priority_class)
 
         self._httpd = _ServingHTTPServer((host, port), Handler)
         self._thread = None
@@ -730,19 +773,19 @@ class InferenceServer:
 
     # --- telemetry plane -----------------------------------------------------
     def _slo_record(self, status, reason, latency_ms,
-                    endpoint="predict"):
+                    endpoint="predict", cls=None):
         """Feed the SLO ledger with one finished request.  Client-fault
         400s (and mid-stream disconnects) are excluded — the
         availability objective is a promise about the SERVER, and one
         misbehaving client must not page the on-call for it (mirror of
         the readiness-window rule above)."""
         if status == "ok":
-            self.slo.observe(endpoint, latency_ms, ok=True)
+            self.slo.observe(endpoint, latency_ms, ok=True, cls=cls)
         elif status == "shed":
-            self.slo.record_shed(endpoint, reason)
+            self.slo.record_shed(endpoint, reason, cls=cls)
         elif status in ("timeout", "error"):
             self.slo.observe(endpoint, latency_ms, ok=False,
-                             reason=reason)
+                             reason=reason, cls=cls)
 
     def render_metrics(self) -> str:
         """Prometheus text for GET /metrics (refreshes the slo.* gauges
@@ -800,12 +843,24 @@ class InferenceServer:
             inputs = [arrays[k] for k in _positional_order(arrays)]
         deadline = (None if self._request_timeout is None
                     else time.monotonic() + self._request_timeout)
+        # QoS (ISSUE 18): class + client deadline ride the request
+        # context — do_POST resolved the class once; direct callers
+        # (tests, in-process use) resolve here from the tenant map
+        ctx = _rtrace.current()
+        cls = _qos.resolve_class(
+            tenant_id=None if ctx is None else ctx.tenant_id,
+            explicit=None if ctx is None else ctx.priority_class)
+        if ctx is not None and ctx.deadline_ms is not None:
+            client_dl = time.monotonic() + ctx.deadline_ms / 1e3
+            deadline = (client_dl if deadline is None
+                        else min(deadline, client_dl))
         # phase breakdown (ISSUE 7): "admission" spans the admit call
         # (decision + queue camp; the camp itself is the controller's
         # own nested `serving.queue` span), "queue" is observed from
         # the measured wait, "predict" spans the resilient run
         with _rtrace.request_phase("admission") as asp:
-            ticket = self.admission.admit(deadline=deadline)
+            ticket = self.admission.admit(deadline=deadline,
+                                          priority_class=cls)
             if asp is not None:
                 asp.args["queue_wait_ms"] = round(
                     ticket.queue_wait * 1e3, 3)
@@ -1041,7 +1096,7 @@ class InferenceClient:
     def __init__(self, address: str, timeout: float = 120.0,
                  retries: int = 2, max_retry_wait: float = 5.0,
                  sleep=time.sleep, fingerprint_tokens: int = 64,
-                 tenant_id=None):
+                 tenant_id=None, priority_class=None, deadline_ms=None):
         self.address = address.rstrip("/")
         self.timeout = float(timeout)
         self.retries = max(0, int(retries))
@@ -1057,6 +1112,23 @@ class InferenceClient:
                 f"[A-Za-z0-9._:-]")
         self.tenant_id = (None if tenant_id is None
                           else str(tenant_id))
+        # QoS identity (ISSUE 18): stamped as X-Priority-Class /
+        # X-Deadline-Ms.  Same validate-loudly rule as tenant_id — a
+        # typo'd class silently degrading to the default tier would
+        # mis-serve forever.
+        if priority_class is not None \
+                and _qos.normalize_class(priority_class) is None:
+            raise ValueError(
+                f"invalid priority_class {priority_class!r}: want one "
+                f"of {_qos.CLASSES}")
+        self.priority_class = (None if priority_class is None
+                               else _qos.normalize_class(priority_class))
+        self.deadline_ms = (None if deadline_ms is None
+                            else int(deadline_ms))
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"invalid deadline_ms {deadline_ms!r}: want a positive "
+                f"millisecond budget")
         # prefix-affinity fingerprint length (ISSUE 13): generate()
         # sends a cheap hash of the first N page-aligned prompt tokens
         # so a router can keep repeat tenants where their prefix cache
@@ -1156,6 +1228,10 @@ class InferenceClient:
             # bills the same ledger row.  An ambient hop's tenant wins —
             # re-stamping mid-chain would split one request's bill.
             ctx.tenant_id = self.tenant_id
+        if ctx.priority_class is None and self.priority_class is not None:
+            ctx.priority_class = self.priority_class  # ambient hop wins
+        if ctx.deadline_ms is None and self.deadline_ms is not None:
+            ctx.deadline_ms = self.deadline_ms
         headers = {"Content-Type": "application/json"}
         headers.update(ctx.to_headers())
         if self.fingerprint_tokens:
@@ -1253,6 +1329,10 @@ class InferenceClient:
         ctx = amb.child() if amb is not None else _rtrace.new_context()
         if ctx.tenant_id is None and self.tenant_id is not None:
             ctx.tenant_id = self.tenant_id  # one identity, all attempts
+        if ctx.priority_class is None and self.priority_class is not None:
+            ctx.priority_class = self.priority_class  # ambient hop wins
+        if ctx.deadline_ms is None and self.deadline_ms is not None:
+            ctx.deadline_ms = self.deadline_ms
         headers = {"Content-Type": "application/octet-stream"}
         headers.update(ctx.to_headers())
         for attempt in range(self.retries + 1):

@@ -81,7 +81,15 @@ _SCHEMA_COUNTERS = tuple(
     # overload/preemption runtime (ISSUE 5): admission sheds by reason,
     # preemption signals by name, emergency checkpoints, serving drains
     + [("resilience.shed_requests", {"reason": r})
-       for r in ("queue_full", "deadline", "draining", "no_replicas")]
+       for r in ("queue_full", "queue_timeout", "deadline", "draining",
+                 "no_replicas")]
+    # multi-tenant QoS (ISSUE 18): per-class shed and preemption
+    # counters — the class set mirrors inference.qos.CLASSES (hardcoded
+    # here: observability stays standalone, same discipline as
+    # request_trace's header validation set)
+    + [("qos.shed", {"class": c}) for c in ("paid", "free", "batch")]
+    + [("qos.preemptions", {"class": c})
+       for c in ("paid", "free", "batch")]
     + [("preemption.signals", {"signal": s})
        for s in ("SIGTERM", "SIGINT")]
     + [("preemption.maintenance_events", {}),
@@ -188,7 +196,12 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
     + (("lifecycle.compile_ms", {"program": "~total"}),
        # autoscaler's observed spawn->routable estimate (ISSUE 17):
        # 0 until the first spawn completes, then the fleet median
-       "autoscaler.observed_spawn_ms")
+       "autoscaler.observed_spawn_ms") \
+    + tuple(("slo.burn_rate", {"endpoint": ep, "class": c})
+            # per-class SLO burn (ISSUE 18): zero before traffic, so a
+            # dashboard watching the paid tier has its key from boot
+            for ep in ("predict", "generate")
+            for c in ("paid", "free", "batch"))
 
 
 # Histograms attach() pre-registers EMPTY (full bucket ladder, count 0)

@@ -41,7 +41,8 @@ import uuid
 __all__ = [
     "RequestContext", "new_context", "current", "activate",
     "continue_from_headers", "request_phase", "HEADER_REQUEST_ID",
-    "HEADER_TRACEPARENT", "HEADER_TENANT_ID",
+    "HEADER_TRACEPARENT", "HEADER_TENANT_ID", "HEADER_PRIORITY_CLASS",
+    "HEADER_DEADLINE_MS",
 ]
 
 HEADER_REQUEST_ID = "X-Request-Id"
@@ -50,6 +51,12 @@ HEADER_TRACEPARENT = "traceparent"
 # who to TRACE — the router's shed for a tenant and the replica's
 # decode for the same tenant land in one ledger row
 HEADER_TENANT_ID = "X-Tenant-Id"
+# QoS identity (ISSUE 18): what was PROMISED, carried hop-to-hop next
+# to who to bill — the edge's shed ordering, the scheduler's
+# preemption ladder, and the per-class SLO rows all read the same
+# class the client stamped (or the tenant→class map resolved)
+HEADER_PRIORITY_CLASS = "X-Priority-Class"
+HEADER_DEADLINE_MS = "X-Deadline-Ms"
 
 # 00-<32 hex trace id>-<16 hex span id>-<2 hex flags>
 _TRACEPARENT = re.compile(
@@ -59,9 +66,38 @@ _REQUEST_ID = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
 # tenant ids are ledger keys and debug-table rows: same discipline
 # (mirrors tenant_ledger._TENANT_ID — this module stays standalone)
 _TENANT_ID = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
+# priority classes are metric labels: closed set, validate-or-drop
+# (mirrors inference.qos.CLASSES — this module stays standalone)
+_PRIORITY_CLASSES = frozenset(("paid", "free", "batch"))
+# deadlines are milliseconds-from-now; clamp keeps a hostile header
+# from minting a year-long admission estimate window
+_DEADLINE_MAX_MS = 3_600_000
 
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "paddle_tpu_request", default=None)
+
+
+def _norm_class(value):
+    """Validate-or-drop for `X-Priority-Class`: a known class name or
+    None.  A garbage class must not mint a garbage metric label."""
+    if value is None:
+        return None
+    v = str(value).strip().lower()
+    return v if v in _PRIORITY_CLASSES else None
+
+
+def _norm_deadline_ms(value):
+    """Validate-or-drop for `X-Deadline-Ms`: a positive integer number
+    of milliseconds (clamped), or None."""
+    if value is None:
+        return None
+    try:
+        ms = int(str(value).strip())
+    except (TypeError, ValueError):
+        return None
+    if ms <= 0:
+        return None
+    return min(ms, _DEADLINE_MAX_MS)
 
 
 def _obs_modules():
@@ -80,10 +116,11 @@ class RequestContext:
     `child()` derives the next hop instead of mutating this one."""
 
     __slots__ = ("request_id", "trace_id", "span_id", "parent_id",
-                 "hop", "tenant_id")
+                 "hop", "tenant_id", "priority_class", "deadline_ms")
 
     def __init__(self, request_id=None, trace_id=None, span_id=None,
-                 parent_id=None, hop=0, tenant_id=None):
+                 parent_id=None, hop=0, tenant_id=None,
+                 priority_class=None, deadline_ms=None):
         self.request_id = str(request_id) if request_id \
             else uuid.uuid4().hex[:16]
         self.trace_id = str(trace_id) if trace_id else uuid.uuid4().hex
@@ -95,14 +132,21 @@ class RequestContext:
         # fingerprint, else anon) and every hop below inherits it
         tid = str(tenant_id) if tenant_id is not None else None
         self.tenant_id = tid if tid and _TENANT_ID.match(tid) else None
+        # QoS identity (ISSUE 18): None means "not resolved yet" — the
+        # first edge resolves tenant→class (qos.resolve_class) and
+        # every hop below inherits the resolved class
+        self.priority_class = _norm_class(priority_class)
+        self.deadline_ms = _norm_deadline_ms(deadline_ms)
 
     def child(self) -> "RequestContext":
-        """The next hop: same request/trace/tenant identity, fresh
+        """The next hop: same request/trace/tenant/QoS identity, fresh
         span id, this hop's span recorded as the parent."""
         return RequestContext(request_id=self.request_id,
                               trace_id=self.trace_id,
                               parent_id=self.span_id, hop=self.hop + 1,
-                              tenant_id=self.tenant_id)
+                              tenant_id=self.tenant_id,
+                              priority_class=self.priority_class,
+                              deadline_ms=self.deadline_ms)
 
     def to_headers(self) -> dict:
         h = {
@@ -111,6 +155,10 @@ class RequestContext:
         }
         if self.tenant_id:
             h[HEADER_TENANT_ID] = self.tenant_id
+        if self.priority_class:
+            h[HEADER_PRIORITY_CLASS] = self.priority_class
+        if self.deadline_ms is not None:
+            h[HEADER_DEADLINE_MS] = str(self.deadline_ms)
         return h
 
     def trace_args(self) -> dict:
@@ -122,6 +170,10 @@ class RequestContext:
             args["parent_span_id"] = self.parent_id
         if self.tenant_id:
             args["tenant_id"] = self.tenant_id
+        if self.priority_class:
+            args["priority_class"] = self.priority_class
+        if self.deadline_ms is not None:
+            args["deadline_ms"] = self.deadline_ms
         return args
 
     def to_dict(self) -> dict:
@@ -153,22 +205,32 @@ class RequestContext:
             tid = None  # hostile/garbage tenant: treat as unset — the
             # edge's fallback derivation owns it from here (a garbage
             # header must not mint a garbage ledger key)
+        # QoS headers: validate-or-drop like every identity header (a
+        # garbage class/deadline degrades to "unset", never to a 4xx
+        # and never to a garbage label)
+        pcls = _norm_class(get(HEADER_PRIORITY_CLASS))
+        dms = _norm_deadline_ms(get(HEADER_DEADLINE_MS))
         tp = get(HEADER_TRACEPARENT)
         m = _TRACEPARENT.match(str(tp).strip().lower()) if tp else None
-        if rid is None and m is None and tid is None:
+        if rid is None and m is None and tid is None and pcls is None:
             return None
         if m is not None:
             # the sender's span becomes our parent; we are a new hop
             return cls(request_id=rid, trace_id=m.group(1),
-                       parent_id=m.group(2), hop=1, tenant_id=tid)
-        return cls(request_id=rid, tenant_id=tid)
+                       parent_id=m.group(2), hop=1, tenant_id=tid,
+                       priority_class=pcls, deadline_ms=dms)
+        return cls(request_id=rid, tenant_id=tid, priority_class=pcls,
+                   deadline_ms=dms)
 
 
-def new_context(request_id=None, tenant_id=None) -> RequestContext:
+def new_context(request_id=None, tenant_id=None, priority_class=None,
+                deadline_ms=None) -> RequestContext:
     """Fresh hop-0 context (what a client mints once per request, BEFORE
-    its retry loop — all attempts of one request share one id AND one
-    tenant identity)."""
-    return RequestContext(request_id=request_id, tenant_id=tenant_id)
+    its retry loop — all attempts of one request share one id, one
+    tenant identity, AND one QoS class/deadline)."""
+    return RequestContext(request_id=request_id, tenant_id=tenant_id,
+                          priority_class=priority_class,
+                          deadline_ms=deadline_ms)
 
 
 def current():

@@ -82,17 +82,29 @@ class SLOTracker:
         self.clock = clock
         self._lock = threading.Lock()
         self._objectives: dict = {}
-        self._events: dict = {}    # endpoint -> deque[(t, lat_ms, ok, reason)]
+        # (endpoint, class) -> _Objective: per-class promises (ISSUE
+        # 18) — a class without its own objective inherits the
+        # endpoint's, so per-class burn is always computable
+        self._class_objectives: dict = {}
+        self._events: dict = {}    # endpoint -> deque[(t, lat_ms, ok,
+        #                            reason, cls)]
         self._totals: dict = {}    # endpoint -> [requests, errors] (lifetime)
 
     # --- configuration ------------------------------------------------------
     def objective(self, endpoint, latency_target_ms=1000.0,
-                  availability=0.999):
-        """Declare (or replace) the objective for `endpoint`.  Returns
-        self so server constructors can chain declarations."""
+                  availability=0.999, cls=None):
+        """Declare (or replace) the objective for `endpoint`.  With
+        `cls`, declare the objective one priority class is promised
+        (ISSUE 18) — classes without one inherit the endpoint
+        objective.  Returns self so server constructors can chain
+        declarations."""
         with self._lock:
-            self._objectives[str(endpoint)] = _Objective(
-                latency_target_ms, availability)
+            if cls is not None:
+                self._class_objectives[(str(endpoint), str(cls))] = \
+                    _Objective(latency_target_ms, availability)
+            else:
+                self._objectives[str(endpoint)] = _Objective(
+                    latency_target_ms, availability)
             self._events.setdefault(str(endpoint), collections.deque(
                 maxlen=self.max_events))
             self._totals.setdefault(str(endpoint), [0, 0])
@@ -103,10 +115,13 @@ class SLOTracker:
             return sorted(self._objectives)
 
     # --- feeding ------------------------------------------------------------
-    def observe(self, endpoint, latency_ms, ok=True, reason=None):
+    def observe(self, endpoint, latency_ms, ok=True, reason=None,
+                cls=None):
         """One finished request: latency in ms (None when the request
-        never ran, e.g. a shed), ok=False consumes error budget, and
-        `reason` labels the failure class in the report."""
+        never ran, e.g. a shed), ok=False consumes error budget,
+        `reason` labels the failure class in the report, and `cls`
+        attributes the outcome to a priority class (ISSUE 18) so the
+        report shows WHOSE budget burned."""
         endpoint = str(endpoint)
         now = self.clock()
         with self._lock:
@@ -116,18 +131,20 @@ class SLOTracker:
                     maxlen=self.max_events)
                 self._totals[endpoint] = [0, 0]
             q.append((now, None if latency_ms is None else float(latency_ms),
-                      bool(ok), None if reason is None else str(reason)))
+                      bool(ok), None if reason is None else str(reason),
+                      None if cls is None else str(cls)))
             tot = self._totals[endpoint]
             tot[0] += 1
             if not ok:
                 tot[1] += 1
             self._prune_locked(endpoint, now)
 
-    def record_shed(self, endpoint, reason):
+    def record_shed(self, endpoint, reason, cls=None):
         """An admission shed: never ran, counts against availability,
         reason label preserved (`shed:queue_full` etc.) so the report
         says WHY the budget burned — the chaos gate asserts on this."""
-        self.observe(endpoint, None, ok=False, reason=f"shed:{reason}")
+        self.observe(endpoint, None, ok=False, reason=f"shed:{reason}",
+                     cls=cls)
 
     def _prune_locked(self, endpoint, now):  # pt-lint: ok[PT102] (callers hold _lock)
         q = self._events[endpoint]
@@ -138,8 +155,12 @@ class SLOTracker:
     # --- reporting ----------------------------------------------------------
     def report(self, publish_gauges=True) -> dict:
         """One JSON-ready snapshot: per-endpoint window counts, observed
-        availability, burn rate, latency percentiles vs target.  Also
-        publishes `slo.*{endpoint=...}` gauges unless told not to."""
+        availability, burn rate, latency percentiles vs target — plus a
+        per-priority-class breakdown (`classes`, ISSUE 18) computed
+        against the class objective when one is declared, the endpoint
+        objective otherwise.  Also publishes `slo.*{endpoint=...}` (and
+        `slo.burn_rate{endpoint=...,class=...}`) gauges unless told not
+        to."""
         now = self.clock()
         out = {"schema": SCHEMA_VERSION, "window_s": self.window_s,
                "endpoints": {}}
@@ -149,42 +170,27 @@ class SLOTracker:
                               list(self._events.get(ep, ())),
                               list(self._totals.get(ep, (0, 0))))
                          for ep in set(self._objectives) | set(self._events)}
+            class_objectives = dict(self._class_objectives)
         for ep, (obj, events, totals) in sorted(endpoints.items()):
             events = [e for e in events if e[0] >= now - self.window_s]
-            n = len(events)
-            errors = [e for e in events if not e[2]]
-            by_reason: dict = {}
-            for e in errors:
-                key = e[3] or "error"
-                by_reason[key] = by_reason.get(key, 0) + 1
-            lats = sorted(e[1] for e in events if e[1] is not None)
-            rep = {"requests": n, "errors": len(errors),
-                   "errors_by_reason": by_reason,
-                   "lifetime_requests": totals[0],
-                   "lifetime_errors": totals[1]}
-            if n:
-                rep["availability"] = round(1.0 - len(errors) / n, 6)
-            if lats:
-                q = _quantiles(lats)
-                rep["latency_ms"] = q
-            if obj is not None:
-                budget = 1.0 - obj.availability
-                rep["objective"] = {
-                    "latency_target_ms": obj.latency_target_ms,
-                    "availability": obj.availability,
-                    "error_budget": round(budget, 6)}
-                if n:
-                    err_rate = len(errors) / n
-                    burn = err_rate / budget
-                    rep["burn_rate"] = round(burn, 4)
-                    rep["burn_severity"] = (
-                        "page" if burn >= _BURN_FAST else
-                        "ticket" if burn >= _BURN_SLOW else "ok")
-                if lats:
-                    within = sum(1 for v in lats
-                                 if v <= obj.latency_target_ms)
-                    rep["latency_target_met_frac"] = round(
-                        within / len(lats), 6)
+            rep = _summarize(events, obj)
+            rep["lifetime_requests"] = totals[0]
+            rep["lifetime_errors"] = totals[1]
+            classes = sorted({e[4] for e in events
+                              if len(e) > 4 and e[4]})
+            if classes:
+                rep["classes"] = {}
+                for c in classes:
+                    cobj = class_objectives.get((ep, c), obj)
+                    crep = _summarize(
+                        [e for e in events if len(e) > 4 and e[4] == c],
+                        cobj)
+                    rep["classes"][c] = crep
+                    if publish_gauges and metrics is not None \
+                            and "burn_rate" in crep:
+                        metrics.set_gauge(
+                            "slo.burn_rate", crep["burn_rate"],
+                            endpoint=ep, **{"class": c})
             out["endpoints"][ep] = rep
             if publish_gauges and metrics is not None:
                 if "burn_rate" in rep:
@@ -193,8 +199,45 @@ class SLOTracker:
                 if "availability" in rep:
                     metrics.set_gauge("slo.availability",
                                       rep["availability"], endpoint=ep)
-                metrics.set_gauge("slo.window_requests", n, endpoint=ep)
+                metrics.set_gauge("slo.window_requests", rep["requests"],
+                                  endpoint=ep)
         return out
+
+
+def _summarize(events, obj) -> dict:
+    """Window stats for one slice of events (an endpoint, or one
+    priority class within it) against one objective."""
+    n = len(events)
+    errors = [e for e in events if not e[2]]
+    by_reason: dict = {}
+    for e in errors:
+        key = e[3] or "error"
+        by_reason[key] = by_reason.get(key, 0) + 1
+    lats = sorted(e[1] for e in events if e[1] is not None)
+    rep = {"requests": n, "errors": len(errors),
+           "errors_by_reason": by_reason}
+    if n:
+        rep["availability"] = round(1.0 - len(errors) / n, 6)
+    if lats:
+        rep["latency_ms"] = _quantiles(lats)
+    if obj is not None:
+        budget = 1.0 - obj.availability
+        rep["objective"] = {
+            "latency_target_ms": obj.latency_target_ms,
+            "availability": obj.availability,
+            "error_budget": round(budget, 6)}
+        if n:
+            burn = (len(errors) / n) / budget
+            rep["burn_rate"] = round(burn, 4)
+            rep["burn_severity"] = (
+                "page" if burn >= _BURN_FAST else
+                "ticket" if burn >= _BURN_SLOW else "ok")
+        if lats:
+            within = sum(1 for v in lats
+                         if v <= obj.latency_target_ms)
+            rep["latency_target_met_frac"] = round(
+                within / len(lats), 6)
+    return rep
 
 
 def _quantiles(sorted_lats) -> dict:

@@ -26,17 +26,30 @@ With one, the server does bounded work and says "no" cheaply:
   * **draining**: `begin_drain()` flips the controller into shutdown
     mode — new and queued requests are shed (`draining`, HTTP 503),
     in-flight ones finish; `drain(timeout)` blocks until they have.
+  * **QoS classes** (ISSUE 18): admission is class-aware.  The wait
+    queue is partitioned by nested weighted shares (batch may occupy
+    at most its share, free+batch theirs, paid the whole depth), the
+    dequeue order is strict priority (paid > free > batch, FIFO within
+    a class) with an aging knob that promotes a starved waiter one
+    rank per `qos_age_s` so batch still eventually runs, a full queue
+    sheds the lowest-class youngest waiter to make room for a
+    higher-class arrival (shed lowest FIRST — never the paid request),
+    and `Retry-After` scales by class so free/batch back off honestly
+    longer under the same pressure estimate.
 
-Every shed increments `resilience.shed_requests{reason=...}` and lands
-a flight instant; `serving.inflight` / `serving.queue_depth` /
-`serving.admission_limit` gauges track the live state.  Clock is
-injectable — tests run the whole machine without wall-clock waits.
+Every shed increments `resilience.shed_requests{reason=...}` (and
+`qos.shed{class=...}`) and lands a flight instant; `serving.inflight` /
+`serving.queue_depth` / `serving.admission_limit` gauges track the live
+state.  Clock is injectable — tests run the whole machine without
+wall-clock waits.
 
 Env knobs (read when the matching ctor arg is None):
   PADDLE_TPU_MAX_INFLIGHT    concurrency limit        (default 4)
   PADDLE_TPU_QUEUE_DEPTH     bounded queue length     (default 16)
   PADDLE_TPU_QUEUE_TIMEOUT   max queue wait, seconds  (default 10)
   PADDLE_TPU_LATENCY_TARGET  AIMD latency target, seconds (default off)
+  PADDLE_TPU_QOS_AGE_S       starvation aging: +1 rank per this many
+                             queued seconds (default 30; 0 disables)
 """
 from __future__ import annotations
 
@@ -45,7 +58,11 @@ import os
 import threading
 import time
 
+from ..inference import qos as _qos
+
 __all__ = ["AdmissionController", "ShedError", "AdmissionTicket"]
+
+_MAX_RANK = max(_qos.class_rank(c) for c in _qos.CLASSES)
 
 
 def _env_num(var, default, cast):
@@ -61,8 +78,9 @@ def _env_num(var, default, cast):
 
 class ShedError(RuntimeError):
     """A request was refused at admission.  `reason` is one of
-    `queue_full` / `deadline` / `draining` (plus `no_replicas` at the
-    fleet router's edge); `retry_after` is the server's estimate
+    `queue_full` / `queue_timeout` / `deadline` / `draining` (plus
+    `no_replicas` at the fleet router's edge); `retry_after` is the
+    server's estimate
     (seconds) of when retrying could succeed — serving surfaces it as
     an HTTP `Retry-After` header.  Overload sheds map to 429 (back off
     and retry), draining / no_replicas to 503 (this instance cannot
@@ -108,11 +126,26 @@ class AdmissionTicket:
         return False
 
 
+class _Waiter:
+    """One queued request's QoS bookkeeping: its class/rank, when it
+    enqueued (FIFO within a class + the aging promotion both read it),
+    and whether a higher-class arrival displaced it out of a full
+    queue (it sheds itself on wakeup)."""
+
+    __slots__ = ("cls", "rank", "enq", "displaced")
+
+    def __init__(self, cls, rank, enq):
+        self.cls = cls
+        self.rank = rank
+        self.enq = enq
+        self.displaced = False
+
+
 class AdmissionController:
     def __init__(self, max_inflight=None, queue_depth=None,
                  queue_timeout=None, latency_target=None, min_limit=1,
                  ewma_alpha=0.3, decrease_factor=0.7, name="serving",
-                 clock=time.monotonic):
+                 clock=time.monotonic, qos_age_s=None):
         if max_inflight is None:
             max_inflight = _env_num("PADDLE_TPU_MAX_INFLIGHT", 4, int)
         if queue_depth is None:
@@ -122,6 +155,8 @@ class AdmissionController:
         if latency_target is None:
             latency_target = _env_num("PADDLE_TPU_LATENCY_TARGET", 0.0,
                                       float) or None
+        if qos_age_s is None:
+            qos_age_s = _env_num("PADDLE_TPU_QOS_AGE_S", 30.0, float)
         self.max_inflight = max(1, int(max_inflight))
         self.queue_depth = max(0, int(queue_depth))
         self.queue_timeout = float(queue_timeout)
@@ -131,14 +166,18 @@ class AdmissionController:
         self.decrease_factor = float(decrease_factor)
         self.name = str(name)
         self.clock = clock
+        self.qos_age_s = max(0.0, float(qos_age_s))
         self._cv = threading.Condition(threading.Lock())
         self._limit = self.max_inflight
         self._inflight = 0
         self._queued = 0
+        self._waiters = []     # live _Waiter records (insertion order)
         self._draining = False
         self._ewma = None      # EWMA of observed request latency (s)
         self._good = 0         # on-target completions since last bump
-        self._shed = {"queue_full": 0, "deadline": 0, "draining": 0}
+        self._shed = {"queue_full": 0, "queue_timeout": 0,
+                      "deadline": 0, "draining": 0}
+        self._shed_by_class = {c: 0 for c in _qos.CLASSES}
         self._completed = 0
         self._failed = 0
         self._publish_gauges()
@@ -181,6 +220,10 @@ class AdmissionController:
 
     def stats(self):
         with self._cv:
+            queued_by_class = {c: 0 for c in _qos.CLASSES}
+            for w in self._waiters:
+                if not w.displaced:
+                    queued_by_class[w.cls] += 1
             return {
                 "inflight": self._inflight,
                 "queued": self._queued,
@@ -192,29 +235,99 @@ class AdmissionController:
                 "completed": self._completed,
                 "failed": self._failed,
                 "shed": dict(self._shed),
+                "queued_by_class": queued_by_class,
+                "shed_by_class": dict(self._shed_by_class),
             }
 
+    # --- QoS queue policy (callers hold _cv) --------------------------------
+    def _class_cap_locked(self, rank):  # pt-lint: ok[PT102] (callers hold _cv)
+        """Nested weighted partition cap for classes at-or-below
+        `rank`: batch may occupy at most its weighted share of the
+        queue, free+batch theirs, and the top class the whole depth —
+        so a flood of low-class arrivals can never camp the queue a
+        paid request needs."""
+        total = sum(_qos.class_weight(c) for c in _qos.CLASSES)
+        share = sum(_qos.class_weight(c) for c in _qos.CLASSES
+                    if _qos.class_rank(c) <= rank)
+        if share >= total:
+            return self.queue_depth
+        return min(self.queue_depth,
+                   max(1, math.ceil(self.queue_depth * share / total)))
+
+    def _effective_rank_locked(self, w, now):  # pt-lint: ok[PT102] (callers hold _cv)
+        """Rank after aging: one rank per `qos_age_s` queued seconds,
+        capped at the top — bounds starvation (a batch waiter
+        eventually outranks a steady paid stream and runs)."""
+        if self.qos_age_s <= 0:
+            return w.rank
+        return min(_MAX_RANK,
+                   w.rank + int((now - w.enq) / self.qos_age_s))
+
+    def _head_waiter_locked(self, now):  # pt-lint: ok[PT102] (callers hold _cv)
+        """Strict-priority dequeue order: highest effective rank wins,
+        FIFO within a rank."""
+        best, best_key = None, None
+        for w in self._waiters:
+            if w.displaced:
+                continue
+            key = (self._effective_rank_locked(w, now), -w.enq)
+            if best is None or key > best_key:
+                best, best_key = w, key
+        return best
+
+    def _retry_after_locked(self, cls, base=None):  # pt-lint: ok[PT102] (callers hold _cv)
+        """Class-aware backoff: the same pressure estimate, scaled so
+        free/batch clients honestly wait longer before retrying than
+        the paid tier they would otherwise race."""
+        base = self._estimate_wait() if base is None else base
+        return base * _qos.retry_after_factor(cls)
+
     # --- admission ----------------------------------------------------------
-    def admit(self, deadline=None):
+    def admit(self, deadline=None, priority_class=None):
         """Admit one request (blocking while the queue drains ahead of
         it) and return an `AdmissionTicket`, or raise `ShedError`.
         `deadline` is an absolute `clock()` instant the caller must
         finish by; admission refuses work it estimates cannot finish in
-        time."""
+        time.  `priority_class` orders everything: queue partition,
+        dequeue order, who gets displaced from a full queue, and the
+        `Retry-After` a shed carries."""
+        cls = _qos.normalize_class(priority_class) or _qos.DEFAULT_CLASS
+        rank = _qos.class_rank(cls)
         with self._cv:
             if self._draining:
-                self._shed_locked("draining", self._drain_retry_after())
+                self._shed_locked("draining", self._drain_retry_after(),
+                                  cls=cls)
             # queue_full only applies to requests that would actually
             # have to queue — a free slot admits regardless of depth 0
-            if self._inflight >= self._limit and \
-                    self._queued >= self.queue_depth:
-                self._shed_locked("queue_full", self._estimate_wait())
+            if self._inflight >= self._limit:
+                cap = self._class_cap_locked(rank)
+                while True:
+                    active = [w for w in self._waiters if not w.displaced]
+                    at_or_below = sum(1 for w in active if w.rank <= rank)
+                    if len(active) < self.queue_depth and \
+                            at_or_below < cap:
+                        break
+                    # full for this class: shed the lowest-class
+                    # YOUNGEST waiter that this request outranks —
+                    # lowest class degrades first, oldest work survives
+                    victim = min(
+                        (w for w in active if w.rank < rank),
+                        key=lambda w: (w.rank, -w.enq), default=None)
+                    if victim is None:
+                        self._shed_locked(
+                            "queue_full", self._retry_after_locked(cls),
+                            cls=cls)
+                    victim.displaced = True
+                    self._cv.notify_all()
             est = self._estimate_wait()
             if deadline is not None and self.clock() + est > deadline:
                 self._shed_locked(
-                    "deadline", est,
+                    "deadline", self._retry_after_locked(cls, est),
+                    cls=cls,
                     detail=f"estimated completion {est:.3f}s past deadline")
             self._queued += 1
+            waiter = _Waiter(cls, rank, self.clock())
+            self._waiters.append(waiter)
             self._publish_gauges()
             wait_t0 = self.clock()
             qspan = None
@@ -226,28 +339,53 @@ class AdmissionController:
                 timeout_at = self.clock() + self.queue_timeout
                 if deadline is not None:
                     timeout_at = min(timeout_at, deadline)
-                if self._inflight >= self._limit:
-                    # this request will actually wait: its queue camp is
-                    # a span on the request trace (request id attached
-                    # via the active RequestContext)
-                    qspan = self._begin_queue_span()
-                while self._inflight >= self._limit:
+                while True:
+                    if waiter.displaced:
+                        self._shed_locked(
+                            "queue_full", self._retry_after_locked(cls),
+                            cls=cls,
+                            detail="displaced by a higher-class arrival")
                     if self._draining:
                         self._shed_locked("draining",
-                                          self._drain_retry_after())
-                    remaining = timeout_at - self.clock()
+                                          self._drain_retry_after(),
+                                          cls=cls)
+                    now = self.clock()
+                    if self._inflight < self._limit and \
+                            self._head_waiter_locked(now) is waiter:
+                        break
+                    remaining = timeout_at - now
                     if remaining <= 0:
+                        if deadline is not None and now >= deadline:
+                            # the request's own deadline was the
+                            # binding bound: report the actionable
+                            # reason, not a generic queue timeout
+                            self._shed_locked(
+                                "deadline",
+                                self._retry_after_locked(cls), cls=cls,
+                                detail="queue wait exhausted the deadline")
                         self._shed_locked(
-                            "deadline", self._estimate_wait(),
-                            detail="queue wait exhausted the deadline")
+                            "queue_timeout",
+                            self._retry_after_locked(cls), cls=cls,
+                            detail="queue wait exceeded the operator "
+                                   "queue timeout")
+                    if qspan is None:
+                        # this request will actually wait: its queue
+                        # camp is a span on the request trace (request
+                        # id attached via the active RequestContext)
+                        qspan = self._begin_queue_span()
                     self._cv.wait(remaining)
                 self._inflight += 1
             finally:
                 self._end_queue_span(qspan)
                 self._queued -= 1
+                try:
+                    self._waiters.remove(waiter)
+                except ValueError:
+                    pass
                 self._publish_gauges()
                 # a shed waiter leaving the queue can be the drain()
-                # waiter's last blocker — wake it to re-check
+                # waiter's last blocker — wake it to re-check; an
+                # admitted head must also pass the baton to the next
                 self._cv.notify_all()
             queue_wait = self.clock() - wait_t0
         return AdmissionTicket(self, self.clock(), queue_wait=queue_wait)
@@ -366,17 +504,22 @@ class AdmissionController:
         except Exception:  # pt-lint: ok[PT005]
             pass           # (observability fan-out guard, as below)
 
-    def _shed_locked(self, reason, retry_after, detail=""):  # pt-lint: ok[PT102] (callers hold _cv)
+    def _shed_locked(self, reason, retry_after, detail="", cls=None):  # pt-lint: ok[PT102] (callers hold _cv)
         self._shed[reason] = self._shed.get(reason, 0) + 1
+        if cls is not None:
+            self._shed_by_class[cls] = self._shed_by_class.get(cls, 0) + 1
         try:
             from ..observability import flight as _flight
             from ..observability import metrics as _metrics
 
             _metrics.inc("resilience.shed_requests", reason=reason)
+            if cls is not None:
+                _metrics.inc("qos.shed", **{"class": cls})
             _flight.record("resilience.request_shed", reason=reason,
                            retry_after=round(float(retry_after), 3),
                            inflight=self._inflight, queued=self._queued,
-                           limit=self._limit)
+                           limit=self._limit,
+                           **({"cls": cls} if cls else {}))
         except Exception:  # pt-lint: ok[PT005]
             pass           # (observability fan-out guard: a telemetry
             # error here would turn a cheap shed into a 500)

@@ -1,0 +1,623 @@
+"""Multi-tenant QoS tests (ISSUE 18): priority classes as the shared
+vocabulary (`inference/qos.py`), class identity on the request-trace
+headers (validate-or-drop), class-aware edge admission (partitioned
+queue, displacement, strict-priority dequeue, starvation aging,
+class-scaled Retry-After, the queue_timeout/deadline reason split),
+preemptive decode scheduling through the recompute-eviction path
+(bit-identical resume across the bf16 / int8-KV / speculative tiers,
+warm re-admission), per-tenant decode-slot quotas, per-class SLO
+burn, loadgen class cohorts, and the `serving_qos_paid_p99_ratio`
+perf-gate round trip.  Deterministic, CPU-only; fake clocks wherever
+waiting would otherwise be real.
+"""
+import importlib.util
+import json
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.inference import qos
+from paddle_tpu.inference.engine import (
+    EngineConfig, InferenceEngine, PagePool, Scheduler, Sequence,
+)
+from paddle_tpu.inference.engine.scheduler import RUNNING, WAITING
+from paddle_tpu.inference.serving import InferenceClient
+from paddle_tpu.observability import request_trace as rtrace
+from paddle_tpu.observability.slo import SLOTracker
+from paddle_tpu.resilience.overload import AdmissionController, ShedError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------------------
+# the class vocabulary
+# --------------------------------------------------------------------------
+
+def test_class_order_and_knobs():
+    """paid > free > batch is the one ordering every layer prices."""
+    assert qos.CLASSES == ("paid", "free", "batch")
+    assert qos.class_rank("paid") > qos.class_rank("free") \
+        > qos.class_rank("batch")
+    assert qos.class_weight("paid") > qos.class_weight("free") \
+        > qos.class_weight("batch")
+    assert qos.retry_after_factor("paid") < qos.retry_after_factor(
+        "free") < qos.retry_after_factor("batch")
+    # unknown input behaves like the default class, never crashes
+    assert qos.class_rank("???") == qos.class_rank(qos.DEFAULT_CLASS)
+
+
+def test_normalize_class_validate_or_drop():
+    assert qos.normalize_class(" Paid ") == "paid"
+    assert qos.normalize_class("FREE") == "free"
+    assert qos.normalize_class(None) is None
+    assert qos.normalize_class("platinum") is None
+    assert qos.normalize_class("") is None
+
+
+def test_class_map_from_env_and_resolution_order():
+    rules = qos.class_map_from_env(
+        "tenant-0:paid, team-*:batch, bogus, x:platinum, *:free")
+    # malformed / unknown-class entries dropped, order preserved
+    assert rules == [("tenant-0", "paid"), ("team-*", "batch"),
+                     ("*", "free")]
+    # explicit (validated) class always wins
+    assert qos.resolve_class("tenant-0", explicit="batch",
+                             rules=rules) == "batch"
+    # garbage explicit falls through to the map
+    assert qos.resolve_class("tenant-0", explicit="platinum",
+                             rules=rules) == "paid"
+    # first match wins; no match -> default
+    assert qos.resolve_class("team-7", rules=rules) == "batch"
+    assert qos.resolve_class("anyone", rules=rules) == "free"
+    assert qos.resolve_class("anyone", rules=[]) == qos.DEFAULT_CLASS
+
+
+# --------------------------------------------------------------------------
+# request-trace identity headers
+# --------------------------------------------------------------------------
+
+def test_priority_headers_round_trip():
+    ctx = rtrace.new_context(tenant_id="t0", priority_class="paid",
+                             deadline_ms=1500)
+    h = ctx.to_headers()
+    assert h[rtrace.HEADER_PRIORITY_CLASS] == "paid"
+    assert h[rtrace.HEADER_DEADLINE_MS] == "1500"
+    back = rtrace.RequestContext.from_headers(h)
+    assert back.priority_class == "paid"
+    assert back.deadline_ms == 1500
+    # the forwarded hop keeps both (the router's child() carries them)
+    child = back.child()
+    assert child.priority_class == "paid" and child.deadline_ms == 1500
+
+
+def test_priority_headers_validate_or_drop():
+    h = rtrace.new_context().to_headers()
+    h[rtrace.HEADER_PRIORITY_CLASS] = "platinum; DROP TABLE"
+    h[rtrace.HEADER_DEADLINE_MS] = "-5"
+    bad = rtrace.RequestContext.from_headers(h)
+    assert bad.priority_class is None
+    assert bad.deadline_ms is None
+    h[rtrace.HEADER_DEADLINE_MS] = "999999999999"
+    huge = rtrace.RequestContext.from_headers(h)
+    assert huge.deadline_ms == 3_600_000  # clamped, not trusted
+
+
+def test_inference_client_validates_loudly():
+    """A misconfigured CLIENT raises at construction — silent dropping
+    is for untrusted wire input, not for the caller's own config."""
+    with pytest.raises(ValueError, match="priority_class"):
+        InferenceClient("http://localhost:1", priority_class="platinum")
+    with pytest.raises(ValueError, match="deadline_ms"):
+        InferenceClient("http://localhost:1", deadline_ms=0)
+    cli = InferenceClient("http://localhost:1", priority_class="PAID",
+                          deadline_ms=250)
+    assert cli.priority_class == "paid" and cli.deadline_ms == 250
+
+
+# --------------------------------------------------------------------------
+# class-aware edge admission
+# --------------------------------------------------------------------------
+
+def _ctl(**kw):
+    kw.setdefault("max_inflight", 1)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("queue_timeout", 10.0)
+    return AdmissionController(**kw)
+
+
+def _waiter_thread(ctl, cls, out, deadline=None):
+    def run():
+        try:
+            t = ctl.admit(deadline=deadline, priority_class=cls)
+            out.append(("ok", cls, t))
+        except ShedError as e:
+            out.append(("shed", cls, e))
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th
+
+
+def _wait_queued(ctl, n, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if ctl.stats()["queued"] >= n:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"never saw {n} queued: {ctl.stats()}")
+
+
+def test_strict_priority_dequeue():
+    """With the slot held, a batch waiter then a paid waiter queue up;
+    the freed slot goes to paid first — FIFO only within a class."""
+    ctl = _ctl()
+    holder = ctl.admit(priority_class="paid")
+    out = []
+    t1 = _waiter_thread(ctl, "batch", out)
+    _wait_queued(ctl, 1)
+    t2 = _waiter_thread(ctl, "paid", out)
+    _wait_queued(ctl, 2)
+    holder.release()
+    # paid admits first; release it so batch can follow
+    for _ in range(500):
+        if out:
+            break
+        time.sleep(0.005)
+    assert out and out[0][:2] == ("ok", "paid")
+    out[0][2].release()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert [o[:2] for o in out] == [("ok", "paid"), ("ok", "batch")]
+
+
+def test_queue_partition_caps_lower_classes():
+    """The nested weighted shares: batch may hold at most its share of
+    the queue; free+batch theirs; paid the whole depth.  A batch flood
+    can never camp the slots a paid request needs."""
+    ctl = _ctl(queue_depth=7)
+    with ctl._cv:
+        batch_cap = ctl._class_cap_locked(qos.class_rank("batch"))
+        free_cap = ctl._class_cap_locked(qos.class_rank("free"))
+        paid_cap = ctl._class_cap_locked(qos.class_rank("paid"))
+    # weights 4/2/1: batch 1/7, free+batch 3/7, paid everything
+    assert batch_cap == 1 and free_cap == 3 and paid_cap == 7
+    assert batch_cap < free_cap < paid_cap
+
+
+def test_higher_class_arrival_displaces_lowest_youngest():
+    """A full queue sheds the lowest-class YOUNGEST waiter to make room
+    for a paid arrival — the displaced waiter sheds politely (429 +
+    Retry-After), it does not fail."""
+    ctl = _ctl(queue_depth=1)
+    holder = ctl.admit(priority_class="free")
+    out = []
+    _waiter_thread(ctl, "batch", out)
+    _wait_queued(ctl, 1)
+    t2 = _waiter_thread(ctl, "paid", out)
+    # paid takes the queue spot; the displaced batch waiter sheds
+    for _ in range(500):
+        if any(o[0] == "shed" for o in out):
+            break
+        time.sleep(0.005)
+    sheds = [o for o in out if o[0] == "shed"]
+    assert sheds and sheds[0][1] == "batch"
+    assert sheds[0][2].reason == "queue_full"
+    assert sheds[0][2].http_status == 429
+    holder.release()
+    t2.join(timeout=5)
+    assert ("ok", "paid") in [o[:2] for o in out]
+    stats = ctl.stats()
+    assert stats["shed_by_class"]["batch"] == 1
+    assert stats["shed_by_class"]["paid"] == 0
+
+
+def test_paid_never_displaced_by_anyone():
+    """Nothing outranks the top class: a second paid arrival into a
+    paid-full queue shed ITSELF (queue_full), never the waiter."""
+    ctl = _ctl(queue_depth=1)
+    holder = ctl.admit(priority_class="paid")
+    out = []
+    t1 = _waiter_thread(ctl, "paid", out)
+    _wait_queued(ctl, 1)
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(priority_class="paid")
+    assert ei.value.reason == "queue_full"
+    holder.release()
+    t1.join(timeout=5)
+    assert out and out[0][:2] == ("ok", "paid")
+
+
+def test_aging_bounds_starvation():
+    """A batch waiter gains one rank per qos_age_s: after enough queued
+    time it outranks a newly-arrived paid request and runs — strict
+    priority, but never forever."""
+    clock = _Clock()
+    ctl = _ctl(clock=clock, qos_age_s=1.0)
+    holder = ctl.admit(priority_class="paid")
+    out = []
+    t1 = _waiter_thread(ctl, "batch", out)
+    _wait_queued(ctl, 1)
+    clock.advance(2.5)  # batch effective rank: 0 + 2 == paid's
+    t2 = _waiter_thread(ctl, "paid", out)
+    _wait_queued(ctl, 2)
+    holder.release()
+    for _ in range(500):
+        if out:
+            break
+        time.sleep(0.005)
+    # the STARVED batch waiter wins the freed slot (FIFO at equal
+    # effective rank) — with aging off it would have waited forever
+    assert out and out[0][:2] == ("ok", "batch")
+    out[0][2].release()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    assert ("ok", "paid") in [o[:2] for o in out]
+
+
+def test_retry_after_scales_by_class():
+    """The same pressure estimate, class-scaled: a shed batch client is
+    told to back off 4x longer than a shed paid one."""
+    clock = _Clock()
+    ctl = _ctl(queue_depth=0, clock=clock)
+    t = ctl.admit(priority_class="paid")
+    clock.advance(1.0)
+    t.release()               # EWMA = 1.0s -> estimate is nonzero
+    holder = ctl.admit(priority_class="paid")
+    sheds = {}
+    for cls in ("paid", "batch"):
+        with pytest.raises(ShedError) as ei:
+            ctl.admit(priority_class=cls)
+        sheds[cls] = ei.value.retry_after
+    holder.release()
+    assert sheds["paid"] > 0
+    assert sheds["batch"] == pytest.approx(4.0 * sheds["paid"])
+
+
+def test_shed_reason_split_queue_timeout_vs_deadline():
+    """The bugfix: a plain operator queue-timeout sheds
+    `queue_timeout`; a queue wait bounded by the request's own deadline
+    sheds `deadline` — the client's actionable signal differs (retry
+    later vs give up)."""
+    ctl = AdmissionController(max_inflight=1, queue_depth=4,
+                              queue_timeout=0.15)
+    holder = ctl.admit(priority_class="free")
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(priority_class="free")  # no deadline of its own
+    assert ei.value.reason == "queue_timeout"
+    with pytest.raises(ShedError) as ei:
+        ctl.admit(deadline=time.monotonic() + 0.05,
+                  priority_class="free")  # its deadline binds first
+    assert ei.value.reason == "deadline"
+    holder.release()
+    stats = ctl.stats()
+    assert stats["shed"]["queue_timeout"] == 1
+    assert stats["shed"]["deadline"] == 1
+
+
+# --------------------------------------------------------------------------
+# preemptive decode scheduling
+# --------------------------------------------------------------------------
+
+def _sched(clock, max_slots=1, quotas=None):
+    pool = PagePool(num_pages=32, page_size=8)
+    return Scheduler(max_slots=max_slots, pool=pool,
+                     max_pages_per_seq=8, clock=clock,
+                     qos_age_s=30.0, quotas=quotas or {}), pool
+
+
+def test_paid_preempts_running_free():
+    clock = _Clock()
+    sch, pool = _sched(clock)
+    free = Sequence(np.arange(8), 4, priority_class="free")
+    sch.submit(free)
+    assert sch.schedule().prefills == [free]
+    assert free.state == RUNNING
+    paid = Sequence(np.arange(8), 4, priority_class="paid")
+    sch.submit(paid)
+    out = sch.schedule()
+    assert paid in out.prefills
+    assert out.evicted == [free]
+    # the victim went through the recompute-eviction path: pages freed,
+    # back at the FRONT of the waiting queue, resumable
+    assert free.state == WAITING and free.pages == [] \
+        and free.evictions == 1
+    assert sch.stats()["by_class"]["paid"]["running"] == 1
+    assert sch.stats()["by_class"]["free"]["waiting"] == 1
+
+
+def test_preemption_never_evicts_a_class_peer():
+    clock = _Clock()
+    sch, _ = _sched(clock)
+    a = Sequence(np.arange(8), 4, priority_class="free")
+    sch.submit(a)
+    sch.schedule()
+    b = Sequence(np.arange(8), 4, priority_class="free")
+    sch.submit(b)
+    out = sch.schedule()
+    assert out.evicted == [] and a.state == RUNNING \
+        and b.state == WAITING
+
+
+def test_aging_earns_a_slot_not_someone_elses():
+    """The policy rule: ADMISSION order uses the aged rank (a starved
+    batch sequence beats a fresh paid one to a FREE slot), but
+    preemption victims are chosen on STATIC rank only — an aged batch
+    request must never evict a running free one."""
+    clock = _Clock()
+    sch, _ = _sched(clock)
+    free = Sequence(np.arange(8), 4, priority_class="free")
+    sch.submit(free)
+    sch.schedule()
+    batch = Sequence(np.arange(8), 4, priority_class="batch")
+    sch.submit(batch)
+    clock.advance(95.0)  # batch effective rank aged past paid's
+    out = sch.schedule()
+    assert out.evicted == [] and batch.state == WAITING  # no eviction
+    # ...admission ORDER does honor the aged rank: with room for both,
+    # the starved batch sequence prefills ahead of a fresh paid one
+    clock2 = _Clock()
+    sch2, _ = _sched(clock2, max_slots=2)
+    batch2 = Sequence(np.arange(8), 4, priority_class="batch")
+    sch2.submit(batch2)
+    clock2.advance(95.0)
+    paid = Sequence(np.arange(8), 4, priority_class="paid")
+    sch2.submit(paid)
+    out = sch2.schedule()
+    assert out.prefills == [batch2, paid] and out.evicted == []
+
+
+def test_over_quota_tenant_admitted_last_within_class():
+    """Per-tenant decode-slot quotas, priced in decode-slot-ms: the
+    tenant over its class's slot budget queues behind on-quota peers of
+    the SAME class (work-conserving — it still runs when slots are
+    spare), and quota never reorders ACROSS classes."""
+    clock = _Clock()
+    sch, _ = _sched(clock, quotas={"free": 0.25})
+    clock.advance(1.0)
+    # tenant "hog" burned a full slot over the 10s quota window
+    sch.note_decode_slot_ms("hog", 10_000.0)
+    hog = Sequence(np.arange(8), 4, tenant_id="hog",
+                   priority_class="free")
+    polite = Sequence(np.arange(8), 4, tenant_id="polite",
+                      priority_class="free")
+    sch.submit(hog)      # hog arrived FIRST...
+    clock.advance(0.1)
+    sch.submit(polite)
+    out = sch.schedule()
+    assert out.prefills == [polite]  # ...but admits after the on-quota
+    # quota does not trump class: an over-quota PAID still beats free
+    with sch._lock:
+        assert sch._over_quota_locked(hog)
+        assert not sch._over_quota_locked(polite)
+
+
+# --------------------------------------------------------------------------
+# preemption-resume bit-identity across decode tiers
+# --------------------------------------------------------------------------
+
+def _tier_model(seed=0, hidden=32, layers=2):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(seed)
+    cfg = GPTConfig(vocab_size=128, hidden_size=hidden,
+                    num_layers=layers, num_heads=4, max_seq_len=64)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize("tier", ["bf16", "int8kv", "spec"])
+def test_preemption_resume_bit_identical_across_tiers(tier):
+    """Policy preemption rides the recompute-eviction path: a paid
+    submission mid-decode evicts the free youngest, and the preempted
+    free stream resumes WARM from the prefix cache and finishes
+    bit-identical to an unloaded same-tier reference — on the bf16,
+    int8-KV, and speculative tiers alike."""
+    model = _tier_model()
+    draft = None
+    kw = dict(page_size=8, max_slots=2, decode_chunk=2, max_seq_len=64)
+    if tier == "int8kv":
+        kw["kv_precision"] = "int8"
+    elif tier == "spec":
+        kw["spec_tokens"] = 3
+        draft = _tier_model(seed=7, hidden=16, layers=1)
+    rs = np.random.RandomState(3)
+    free_prompts = [rs.randint(0, 128, (n,)).astype(np.int32)
+                    for n in (12, 14)]
+    paid_prompt = rs.randint(0, 128, (11,)).astype(np.int32)
+
+    ref_eng = InferenceEngine(model, EngineConfig(
+        prefix_cache=False, **kw), draft_model=draft)
+    refs = ref_eng.generate(free_prompts + [paid_prompt],
+                            max_new_tokens=8)
+    assert ref_eng.pool.used_pages == 0
+
+    eng = InferenceEngine(model, EngineConfig(prefix_cache=True, **kw),
+                          draft_model=draft)
+    free_handles = [eng.submit(p, max_new_tokens=8,
+                               priority_class="free")
+                    for p in free_prompts]
+    for _ in range(3):
+        eng.step()  # both slots running, a few chunks decoded
+    paid_handle = eng.submit(paid_prompt, max_new_tokens=8,
+                             priority_class="paid")
+    handles = free_handles + [paid_handle]
+    idle = 0
+    while any(not h.done.is_set() for h in handles) and idle < 2000:
+        idle = idle if eng.step() else idle + 1
+    for h, ref in zip(handles, refs):
+        assert np.array_equal(h.result(timeout=1.0), ref), tier
+
+    ring = eng.decisions.events()
+    preempts = [e for e in ring if e.get("kind") == "evict_preempt"]
+    assert preempts, f"no policy preemption happened ({tier})"
+    assert all(e["victim_class"] == "free" and e["for_class"] == "paid"
+               for e in preempts)
+    # warm re-admission: every preempted request's resume rode the
+    # prefix cache (its own prefill pages were still committed)
+    victims = {e["request_id"] for e in preempts}
+    readmits = [e for e in ring if e.get("kind") == "admit"
+                and e.get("request_id") in victims
+                and e.get("evictions", 0) > 0]
+    assert readmits
+    assert all(e["cache_state"] in ("hit", "partial")
+               for e in readmits), readmits
+    # zero page/refcount leak once the cache lets go
+    eng.clear_prefix_cache()
+    assert eng.pool.used_pages == 0
+    assert len(eng.pool.ref_counts()) == 0
+
+
+# --------------------------------------------------------------------------
+# per-class SLO burn
+# --------------------------------------------------------------------------
+
+def test_slo_per_class_burn_and_objective_inheritance():
+    clock = _Clock()
+    t = SLOTracker(window_s=60.0, clock=clock)
+    t.objective("predict", latency_target_ms=100, availability=0.99)
+    t.objective("predict", latency_target_ms=50, availability=0.999,
+                cls="paid")
+    t.observe("predict", 40.0, ok=True, cls="paid")
+    t.observe("predict", 40.0, ok=True, cls="free")
+    t.observe("predict", None, ok=False, reason="error", cls="free")
+    t.record_shed("predict", "queue_timeout", cls="free")
+    rep = t.report(publish_gauges=False)
+    classes = rep["endpoints"]["predict"]["classes"]
+    # paid judged against ITS objective (tighter budget), zero burn
+    assert classes["paid"]["burn_rate"] == 0.0
+    assert classes["paid"]["objective"]["availability"] == 0.999
+    # free INHERITS the endpoint objective; 2/3 errors over a 1% budget
+    assert classes["free"]["objective"]["availability"] == 0.99
+    assert classes["free"]["burn_rate"] == pytest.approx(
+        (2 / 3) / 0.01, rel=1e-3)
+    assert classes["free"]["errors_by_reason"][
+        "shed:queue_timeout"] == 1
+
+
+def test_slo_class_gauges_published():
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics
+
+    obs.attach(crash_hook=False)
+    try:
+        metrics.reset()
+        obs.attach(crash_hook=False)
+        snap = metrics.snapshot()
+        # the attach() schema declares the QoS keys at zero — absence
+        # is the one thing dashboards can never alert on
+        for c in ("paid", "free", "batch"):
+            assert snap["counters"][f"qos.shed{{class={c}}}"] == 0
+            assert snap["counters"][f"qos.preemptions{{class={c}}}"] == 0
+            assert snap["gauges"][
+                f"slo.burn_rate{{class={c},endpoint=generate}}"] == 0.0
+        t = SLOTracker(window_s=60.0)
+        t.objective("generate", 100, 0.999)
+        t.observe("generate", 10.0, ok=True, cls="paid")
+        t.report()
+        snap = metrics.snapshot()
+        assert "slo.burn_rate{class=paid,endpoint=generate}" \
+            in snap["gauges"]
+    finally:
+        obs.detach()
+
+
+# --------------------------------------------------------------------------
+# loadgen class cohorts
+# --------------------------------------------------------------------------
+
+def _loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "_loadgen", os.path.join(REPO, "tools", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_class_cohorts_deterministic():
+    lg = _loadgen()
+    got = lg._assign_classes(8, {"paid": 0.25, "free": 0.5,
+                                 "batch": 0.25})
+    assert got == ["paid", "paid", "free", "free", "free", "free",
+                   "batch", "batch"]
+    assert lg._assign_classes(3, None) == [None, None, None]
+    # the class is a property of the TENANT: every request a tenant
+    # makes carries the same class
+    wl = lg.SharedPrefixWorkload(seed=0, tenants=4,
+                                 class_split={"paid": 0.5, "free": 0.5})
+    seen = {}
+    rng = random.Random(0)
+    for _ in range(40):
+        s = wl.sample(rng)
+        cls = seen.setdefault(s["tenant"], s["priority_class"])
+        assert s["priority_class"] == cls
+    assert set(seen.values()) == {"paid", "free"}
+
+
+# --------------------------------------------------------------------------
+# bench row + perf-gate round trip
+# --------------------------------------------------------------------------
+
+def _pg():
+    spec = importlib.util.spec_from_file_location(
+        "_perf_gate", os.path.join(REPO, "tools", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+QOS_METRIC = "serving_qos_paid_p99_ratio"
+
+
+def test_bench_emits_qos_ratio_metric():
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert f'"{QOS_METRIC}"' in src
+
+
+def test_qos_ratio_update_round_trip(tmp_path):
+    """--update appends the (lower-better) ratio row; a later run gates
+    it: holding or improving passes, paid p99 degrading relative to the
+    single-class baseline beyond tolerance fails."""
+    pg = _pg()
+    baseline = tmp_path / "baseline.jsonl"
+    baseline.write_text("")
+    row = {"metric": QOS_METRIC, "value": 0.5, "unit": "ratio",
+           "lower_better": True}
+    assert pg.update_baseline([row], str(baseline)) == 1
+    base = pg.load_baseline(str(baseline))
+    ok = [{"metric": QOS_METRIC, "value": 0.52, "unit": "ratio",
+           "lower_better": True}]
+    failures, _ = pg.gate(ok, base, tolerance=0.10)
+    assert failures == []
+    bad = [{"metric": QOS_METRIC, "value": 0.9, "unit": "ratio",
+            "lower_better": True}]
+    failures, report = pg.gate(bad, base, tolerance=0.10)
+    assert len(failures) == 1 and QOS_METRIC in failures[0], report
+    # degraded (CPU-proxy) rows neither update nor gate
+    degraded = [{"metric": QOS_METRIC, "value": 5.0, "unit": "ratio",
+                 "lower_better": True, "degraded": True}]
+    assert pg.update_baseline(degraded, str(baseline)) == 0
+    failures, report = pg.gate(degraded, base)
+    assert failures == [] and any("SKIP" in ln for ln in report)
+
+
+def test_chaos_check_has_qos_scenario():
+    with open(os.path.join(REPO, "tools", "chaos_check.py")) as f:
+        src = f.read()
+    assert '"qos"' in src and "def run_qos_chaos" in src

@@ -297,7 +297,8 @@ def run_overload(requests=24, max_inflight=2, queue_depth=3,
                       and len(shed) == shed_counted
                       and all(r[2] is not None for r in shed)
                       and sum(slo_shed_reasons.values()) == shed_counted
-                      and all(k in ("queue_full", "deadline", "draining")
+                      and all(k in ("queue_full", "queue_timeout", "deadline",
+                                  "draining")
                               for k in slo_shed_reasons)
                       and '_bucket{' in metrics_text
                       and bool(drained)),
@@ -581,7 +582,8 @@ def run_engine_chaos(seed=0, n_seqs=8, new_tokens=10,
             and bool(first_line) and bool(polite_ok)
             and err_n == 0 and ok_n > 0 and shed_n > 0
             and sum(slo_shed_reasons.values()) >= shed_n
-            and all(k in ("queue_full", "deadline", "draining")
+            and all(k in ("queue_full", "queue_timeout", "deadline",
+                                  "draining")
                     for k in slo_shed_reasons)),
     }
     return report
@@ -1423,11 +1425,277 @@ def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
     return report
 
 
+def _qos_engine_preemption(seed=0, new_tokens=12, kv_precision=None):
+    """In-process preemption bit-identity (ISSUE 18): fill every decode
+    slot with FREE-class sequences, let them decode a few chunks, then
+    submit PAID ones — the scheduler must preempt the free youngest
+    through the SAME recompute-eviction path pressure uses, the paid
+    requests must run, and every preempted free stream must finish
+    bit-identical to an unloaded reference, re-admitted WARM from the
+    prefix cache.  Pool AND refcount table empty after drain + cache
+    clear.  ``kv_precision='int8'`` reruns the contract on the
+    quantized tier."""
+    import numpy as np
+
+    from paddle_tpu.inference.engine import EngineConfig, InferenceEngine
+    from paddle_tpu.observability import metrics
+
+    model = _build_engine_model(seed)
+    rs = np.random.RandomState(seed + 7)
+    # prompts span >1 page so the prefix cache can retain their
+    # prefill — the warm-resume gate below needs cacheable prompts
+    free_prompts = [rs.randint(0, 256, (12 + 2 * i,)).astype(np.int32)
+                    for i in range(4)]
+    paid_prompts = [rs.randint(0, 256, (11 + 2 * i,)).astype(np.int32)
+                    for i in range(2)]
+    base = dict(page_size=8, max_slots=4, decode_chunk=2,
+                max_seq_len=96, kv_precision=kv_precision)
+
+    # unloaded reference with the cache OFF: preemption (and the cache)
+    # may change WHEN a victim's tokens appear, never WHICH
+    ref_eng = InferenceEngine(model, EngineConfig(
+        **base, prefix_cache=False))
+    free_refs = ref_eng.generate(free_prompts, max_new_tokens=new_tokens)
+    paid_refs = ref_eng.generate(paid_prompts, max_new_tokens=new_tokens)
+    ref_leak = ref_eng.pool.used_pages
+
+    pre = metrics.snapshot()["counters"].get(
+        "qos.preemptions{class=free}", 0)
+    eng = InferenceEngine(model, EngineConfig(**base, prefix_cache=True))
+    free_handles = [eng.submit(p, max_new_tokens=new_tokens,
+                               priority_class="free")
+                    for p in free_prompts]
+    for _ in range(4):
+        eng.step()      # free fills all 4 slots, decodes a few chunks
+    paid_handles = [eng.submit(p, max_new_tokens=new_tokens,
+                               priority_class="paid")
+                    for p in paid_prompts]
+    handles = free_handles + paid_handles
+    idle = 0
+    while any(not h.done.is_set() for h in handles) and idle < 2000:
+        idle = idle if eng.step() else idle + 1
+    free_ok = all(np.array_equal(h.result(timeout=1.0), free_refs[i])
+                  for i, h in enumerate(free_handles))
+    paid_ok = all(np.array_equal(h.result(timeout=1.0), paid_refs[i])
+                  for i, h in enumerate(paid_handles))
+
+    ring = eng.decisions.events()
+    preempts = [e for e in ring if e.get("kind") == "evict_preempt"]
+    # the policy rule: a preemption victim is NEVER a class peer or
+    # better — here every victim must be free, evicted FOR a paid
+    victims_free = bool(preempts) and all(
+        e.get("victim_class") == "free"
+        and e.get("for_class") == "paid" for e in preempts)
+    mid_decode = any(e.get("generated", 0) > 0 for e in preempts)
+    # warm resume: every preempted request's RE-admission (evictions>0)
+    # must ride the radix cache, not recompute its prefix cold
+    victim_ids = {e.get("request_id") for e in preempts}
+    readmits = [e for e in ring
+                if e.get("kind") == "admit"
+                and e.get("request_id") in victim_ids
+                and e.get("evictions", 0) > 0]
+    warm_resume = bool(readmits) and all(
+        e.get("cache_state") in ("hit", "partial") for e in readmits)
+    preempt_count = metrics.snapshot()["counters"].get(
+        "qos.preemptions{class=free}", 0) - pre
+
+    # drain accounting: after completion every live page belongs to the
+    # cache alone; clearing it must empty pool AND refcount table
+    pool_stats = eng.pool.stats()
+    no_live_refs = pool_stats["logical_pages"] == pool_stats["used"]
+    eng.clear_prefix_cache()
+    drain_leak = eng.pool.used_pages
+    refcount_leak = len(eng.pool.ref_counts())
+
+    return {
+        "kv_precision": kv_precision or "bf16",
+        "free_streams_bit_identical": bool(free_ok),
+        "paid_streams_bit_identical": bool(paid_ok),
+        "preempt_events": len(preempts),
+        "preemptions_counted": preempt_count,
+        "victims_all_free_for_paid": bool(victims_free),
+        "preempted_mid_decode": bool(mid_decode),
+        "warm_resume": bool(warm_resume),
+        "ref_page_leak": ref_leak,
+        "drain_page_leak": drain_leak,
+        "refcount_leak": refcount_leak,
+        "recovered": (
+            bool(free_ok) and bool(paid_ok) and len(preempts) >= 1
+            and preempt_count >= 1 and bool(victims_free)
+            and bool(mid_decode) and bool(warm_resume)
+            and ref_leak == 0 and drain_leak == 0
+            and refcount_leak == 0 and bool(no_live_refs)),
+    }
+
+
+def run_qos_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
+                  surge_s=10.0, cool_s=6.0, paid_p99_bound_ms=15000.0):
+    """QoS chaos (ISSUE 18): a two-class 10× surge against a BOUNDED
+    autoscaled toy fleet (max 2 replicas — the point is degradation
+    under real scarcity, not scaling out of it), plus the in-process
+    preemption bit-identity contract on both KV tiers.  `recovered`
+    means: the paid tier holds bounded p99 with ZERO admitted failures
+    and zero replays; the free tier degrades GRACEFULLY (sheds counted
+    — and strictly more than paid's — zero failures, zero replays);
+    per-class SLO rows are live on the router's /debug/telemetry;
+    every autoscaler decision event carries the paid-class burn it
+    acted on; preempted free streams resume bit-identical (bf16 AND
+    int8 KV) warm from the prefix cache; and zero page/refcount leak
+    after drain."""
+    import time as _time
+    import urllib.request as _urlreq
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.autoscaler import Autoscaler
+    from paddle_tpu.inference.fleet import ReplicaFleet, toy_token
+    from paddle_tpu.observability import metrics
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import loadgen
+    finally:
+        sys.path.pop(0)
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)  # re-declare the schema post-reset
+    prev_window = os.environ.get("PADDLE_TPU_SLO_WINDOW")
+    os.environ["PADDLE_TPU_SLO_WINDOW"] = "10.0"
+    fleet = scaler = None
+    try:
+        fleet = ReplicaFleet(num_replicas=1, kind="toy",
+                             token_time=0.02, service_time=0.02,
+                             max_slots=4, launch_timeout=60,
+                             monitor_interval=0.1)
+        fleet.start()
+        # a BOUNDED fleet: one spare replica, then the surge must be
+        # absorbed by class policy — shed free first, keep paid whole
+        scaler = Autoscaler(
+            fleet, min_replicas=1, max_replicas=2,
+            burn_up=2.0, occ_up=0.9, occ_down=0.15,
+            up_sustain=2, down_sustain=8, cooldown_s=2.0,
+            interval=0.2, drain_grace=5.0)
+        scaler.start()
+        # half the tenant cohort paid, half free — no misbehaving
+        # clients: every shed here is pure CLASS policy
+        workload = loadgen.SharedPrefixWorkload(
+            seed=seed, tenants=4, system_prompt_tokens=16,
+            suffix_tokens=(3, 6), generate_frac=0.7,
+            max_new_tokens=20,
+            class_split={"paid": 0.5, "free": 0.5})
+        phases = loadgen.surge_phases(
+            base_rps=base_rps, surge_mult=surge_mult, warm_s=warm_s,
+            surge_s=surge_s, cool_s=cool_s)
+        runner = loadgen.OpenLoopRunner(
+            fleet.router.address, workload, phases, seed=seed,
+            expected_token=toy_token, timeout=30.0, max_retries=2)
+        load_report = runner.run()
+        deadline = _time.monotonic() + 45.0
+        while _time.monotonic() < deadline and \
+                fleet.replica_count() > 1:
+            _time.sleep(0.2)
+        returned_to_min = fleet.replica_count() == 1
+        with _urlreq.urlopen(fleet.router.address + "/debug/telemetry",
+                             timeout=10) as r:
+            debug_snap = json.loads(r.read())
+        scaler.stop()
+        snap = metrics.snapshot()
+    finally:
+        if prev_window is None:
+            os.environ.pop("PADDLE_TPU_SLO_WINDOW", None)
+        else:
+            os.environ["PADDLE_TPU_SLO_WINDOW"] = prev_window
+        if scaler is not None:
+            scaler.stop()
+        if fleet is not None:
+            fleet.stop()
+        obs.detach()
+
+    s = load_report.summary()
+    counters = snap["counters"]
+    paid = s["classes"].get("paid") or {}
+    free = s["classes"].get("free") or {}
+    paid_p99 = (paid.get("latency_ms") or {}).get("p99")
+    # graceful degradation, per tier: paid NEVER fails once admitted
+    # and its sheds (allowed under total exhaustion) stay strictly
+    # below free's — free absorbs the surge, politely
+    paid_ok = (paid.get("admitted", 0) > 0
+               and paid.get("admitted_failures", 1) == 0
+               and paid.get("status", {}).get("replayed", 0) == 0
+               and paid_p99 is not None
+               and paid_p99 <= paid_p99_bound_ms)
+    free_ok = (free.get("shed", 0) > 0
+               and free.get("admitted_failures", 1) == 0
+               and free.get("status", {}).get("replayed", 0) == 0)
+    class_policy_ok = free.get("shed", 0) > paid.get("shed", 0)
+    # the shed ledger: class-labelled sheds visible fleet-wide
+    shed_free_counted = counters.get("qos.shed{class=free}", 0) > 0
+    # per-class SLO rows on the router's debug plane, for BOTH tiers
+    slo_eps = (debug_snap.get("slo") or {}).get("endpoints") or {}
+    slo_classes_ok = any(
+        set((ep.get("classes") or {})) >= {"paid", "free"}
+        for ep in slo_eps.values())
+    # every decision event logs the paid-class burn it acted on — and
+    # the surge must have produced at least one actual decision
+    events = [e for e in scaler.events if e.get("kind") != "tick_error"]
+    paid_burn_logged = bool(events) and all(
+        "paid_burn_rate" in e for e in events)
+    scale_ups = [e for e in events
+                 if e["kind"] in ("scale_up", "scale_up_predictive")]
+
+    # in-process preemption bit-identity, both KV tiers
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    try:
+        engine_bf16 = _qos_engine_preemption(seed=seed)
+        engine_int8 = _qos_engine_preemption(seed=seed,
+                                             kv_precision="int8")
+    finally:
+        obs.detach()
+
+    report = {
+        "scenario": "qos",
+        "phases": [f"{p.name}:{p.duration_s}s@{p.rps}rps"
+                   for p in phases],
+        "requests": s["requests"],
+        "ok": s["ok"],
+        "shed": s["shed"],
+        "replayed": s["replayed"],
+        "admitted_failures": s["admitted_failures"],
+        "failure_detail": s["failure_detail"],
+        "classes": s["classes"],
+        "paid_p99_ms": paid_p99,
+        "paid_ok": bool(paid_ok),
+        "free_graceful": bool(free_ok),
+        "free_sheds_exceed_paid": bool(class_policy_ok),
+        "qos_shed_counters": {
+            c: counters.get(f"qos.shed{{class={c}}}", 0)
+            for c in ("paid", "free", "batch")},
+        "slo_classes_on_debug_plane": bool(slo_classes_ok),
+        "scale_ups": len(scale_ups),
+        "peak_replicas": scaler.peak_replicas,
+        "returned_to_min": bool(returned_to_min),
+        "decision_events": len(events),
+        "paid_burn_rate_logged": bool(paid_burn_logged),
+        "engine": engine_bf16,
+        "engine_int8": engine_int8,
+        "recovered": (
+            bool(paid_ok) and bool(free_ok) and bool(class_policy_ok)
+            and s["replayed"] == 0 and bool(shed_free_counted)
+            and bool(slo_classes_ok) and bool(paid_burn_logged)
+            and len(scale_ups) >= 1 and bool(returned_to_min)
+            and bool(engine_bf16["recovered"])
+            and bool(engine_int8["recovered"])),
+    }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario",
                     choices=("train", "overload", "preemption", "engine",
-                             "fleet", "prefix", "surge"),
+                             "fleet", "prefix", "surge", "qos"),
                     default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
@@ -1450,6 +1718,8 @@ def main(argv=None):
         report = run_fleet_chaos(seed=args.seed)
     elif args.scenario == "surge":
         report = run_surge_chaos(seed=args.seed)
+    elif args.scenario == "qos":
+        report = run_qos_chaos(seed=args.seed)
     elif args.scenario == "prefix":
         report = run_prefix_chaos(seed=args.seed)
     elif args.scenario == "preemption":

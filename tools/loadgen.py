@@ -123,6 +123,27 @@ def diurnal_phases(base_rps=4.0, peak_mult=2.5, period_s=20.0,
     return out
 
 
+def _assign_classes(tenants, class_split):
+    """Deterministic tenant->class cohort assignment.  `class_split`
+    maps class name -> fraction (need not sum to 1; fractions are
+    normalized); tenants fill contiguous cohorts in the split's
+    declared order.  With no split every tenant maps to None (no
+    header stamped — the server's default tier applies)."""
+    if not class_split:
+        return [None] * int(tenants)
+    bounds, acc = [], 0.0
+    for cls, frac in class_split.items():
+        acc += max(0.0, float(frac))
+        bounds.append((str(cls), acc))
+    total = acc or 1.0
+    out = []
+    for i in range(int(tenants)):
+        x = (i + 0.5) / max(1, int(tenants)) * total
+        out.append(next((cls for cls, b in bounds if x <= b),
+                        bounds[-1][0]))
+    return out
+
+
 def prefix_fingerprint(ids, tokens=64, granule=16):
     """stdlib twin of `InferenceClient.prefix_fingerprint` (same sha1
     over little-endian int64 tokens, same page-granule floor), so
@@ -152,7 +173,7 @@ class SharedPrefixWorkload:
                  suffix_tokens=(3, 8), vocab=200, generate_frac=0.75,
                  max_new_tokens=12, predict_shape=(2, 2),
                  misbehave_disconnect=0.0, misbehave_ignore_retry=0.0,
-                 misbehave_oversize=0.0):
+                 misbehave_oversize=0.0, class_split=None):
         self.seed = int(seed)
         self.vocab = int(vocab)
         self.generate_frac = float(generate_frac)
@@ -168,6 +189,14 @@ class SharedPrefixWorkload:
             [rng.randrange(self.vocab)
              for _ in range(int(system_prompt_tokens))]
             for _ in range(int(tenants))]
+        # QoS class cohorts (ISSUE 18): a class is a property of the
+        # TENANT (the billing entity buys a tier), not the request —
+        # `class_split` maps class -> fraction of tenants, assigned as
+        # contiguous deterministic cohorts so the same seed always
+        # yields the same paid/free/batch population.  None (default)
+        # stamps no X-Priority-Class header at all.
+        self.tenant_classes = _assign_classes(
+            len(self.tenant_prompts), class_split)
         self._counter = 0
 
     def sample(self, rng):
@@ -192,6 +221,7 @@ class SharedPrefixWorkload:
             "kind": kind,
             "behavior": behavior,
             "tenant": tenant,
+            "priority_class": self.tenant_classes[tenant],
             "prompt": list(self.tenant_prompts[tenant]) + suffix,
             "max_new_tokens": self.max_new_tokens,
             "value": float(self._counter % 97),
@@ -260,7 +290,24 @@ class LoadReport:
         tpot = []                  # per-stream mean time/output token
         phases: dict = {}
         tenants: dict = {}
+        classes: dict = {}
         for row in self.rows:
+            # per-priority-class breakdown (ISSUE 18): what EACH tier
+            # experienced — admitted/shed counts and latency
+            # percentiles per class are the client-side ground truth
+            # the qos chaos gate asserts graceful degradation against
+            cls = row.get("priority_class")
+            if cls:
+                cstat = classes.setdefault(cls, {
+                    "requests": 0, "status": {}, "tokens": 0,
+                    "_lat": []})
+                cstat["requests"] += 1
+                cstat["status"][row["status"]] = \
+                    cstat["status"].get(row["status"], 0) + 1
+                cstat["tokens"] += row.get("tokens", 0) or 0
+                if row["status"] == "ok" \
+                        and row.get("latency_s") is not None:
+                    cstat["_lat"].append(row["latency_s"] * 1e3)
             # per-tenant breakdown (ISSUE 16): what THIS client billed
             # each X-Tenant-Id — the ground truth the chaos gates
             # cross-check against the server-side tenant ledger
@@ -305,6 +352,16 @@ class LoadReport:
             row["admitted_failures"] = sum(
                 pstat["status"].get(s, 0) for s in self._FAILURES)
             phase_out[ph] = row
+        class_out = {}
+        for cls, cstat in sorted(classes.items()):
+            row = {k: v for k, v in cstat.items() if k != "_lat"}
+            if cstat["_lat"]:
+                row["latency_ms"] = self._pcts(cstat["_lat"])
+            row["admitted"] = cstat["status"].get("ok", 0)
+            row["shed"] = cstat["status"].get("shed", 0)
+            row["admitted_failures"] = sum(
+                cstat["status"].get(s, 0) for s in self._FAILURES)
+            class_out[cls] = row
         return {
             "requests": len(self.rows),
             "wall_s": round(self.wall_s, 3),
@@ -331,6 +388,7 @@ class LoadReport:
             "tpot_ms": self._pcts(tpot) if tpot else None,
             "phases": phase_out,
             "tenants": dict(sorted(tenants.items())),
+            "classes": class_out,
         }
 
 
@@ -410,6 +468,7 @@ class OpenLoopRunner:
                 "id": spec["id"], "kind": spec["kind"],
                 "behavior": spec["behavior"], "tenant": spec["tenant"],
                 "phase": spec.get("phase"),
+                "priority_class": spec.get("priority_class"),
                 "status": status, "latency_s": latency_s,
                 "tokens": tokens, "detail": detail,
                 "itl_ms": itl_ms})
@@ -453,6 +512,8 @@ class OpenLoopRunner:
             "max_new_tokens": spec["max_new_tokens"]}).encode()
         headers = {"Content-Type": "application/json",
                    "X-Tenant-Id": tenant_name(spec["tenant"])}
+        if spec.get("priority_class"):
+            headers["X-Priority-Class"] = spec["priority_class"]
         fp = prefix_fingerprint(spec["prompt"])
         if fp is not None:
             headers["X-Prefix-Fingerprint"] = fp
@@ -553,12 +614,13 @@ class OpenLoopRunner:
         for attempt in range(attempts):
             conn = self._connect()
             try:
-                conn.request(
-                    "POST", "/predict", body=data,
-                    headers={"Content-Type":
-                             "application/octet-stream",
-                             "X-Tenant-Id":
-                             tenant_name(spec["tenant"])})
+                headers = {"Content-Type": "application/octet-stream",
+                           "X-Tenant-Id": tenant_name(spec["tenant"])}
+                if spec.get("priority_class"):
+                    headers["X-Priority-Class"] = \
+                        spec["priority_class"]
+                conn.request("POST", "/predict", body=data,
+                             headers=headers)
                 resp = conn.getresponse()
                 if resp.status in (429, 503):
                     wait = self._retry_wait(dict(resp.headers))
@@ -630,16 +692,31 @@ def main(argv=None):
     ap.add_argument("--misbehave", type=float, default=0.05,
                     help="total misbehaving-client fraction, split "
                          "across disconnect/ignore-retry/oversize")
+    ap.add_argument("--class-split", default=None, metavar="SPEC",
+                    help="tenant QoS cohorts, e.g. "
+                         "'paid=0.25,free=0.5,batch=0.25' — stamps "
+                         "X-Priority-Class per tenant (default: none)")
     ap.add_argument("--timeout", type=float, default=30.0)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     third = args.misbehave / 3.0
+    class_split = None
+    if args.class_split:
+        class_split = {}
+        for part in args.class_split.split(","):
+            if "=" not in part:
+                continue
+            cls, _, frac = part.partition("=")
+            try:
+                class_split[cls.strip()] = float(frac)
+            except ValueError:
+                continue
     wl = SharedPrefixWorkload(
         seed=args.seed, tenants=args.tenants,
         generate_frac=args.generate_frac,
         max_new_tokens=args.max_new_tokens,
         misbehave_disconnect=third, misbehave_ignore_retry=third,
-        misbehave_oversize=third)
+        misbehave_oversize=third, class_split=class_split)
     phases = (diurnal_phases(args.base_rps,
                              period_s=args.warm_s + args.surge_s
                              + args.cool_s)

@@ -331,7 +331,8 @@ def rollup(streams):
         if isinstance(slo, dict):
             for ep, rep in (slo.get("endpoints") or {}).items():
                 agg = slo_window.setdefault(ep, {
-                    "requests": 0, "errors": 0, "errors_by_reason": {}})
+                    "requests": 0, "errors": 0, "errors_by_reason": {},
+                    "classes": {}})
                 agg["requests"] += int(rep.get("requests", 0))
                 agg["errors"] += int(rep.get("errors", 0))
                 for reason, c in (rep.get("errors_by_reason")
@@ -340,15 +341,30 @@ def rollup(streams):
                     br[reason] = br.get(reason, 0) + int(c)
                 if isinstance(rep.get("objective"), dict):
                     slo_objectives[ep] = rep["objective"]
+                # per-priority-class rows (ISSUE 18): summed across
+                # processes like the endpoint rows, burn recomputed
+                # against the CLASS objective (each dump carries it)
+                for c, crep in (rep.get("classes") or {}).items():
+                    if not isinstance(crep, dict):
+                        continue
+                    cagg = agg["classes"].setdefault(c, {
+                        "requests": 0, "errors": 0,
+                        "errors_by_reason": {}})
+                    cagg["requests"] += int(crep.get("requests", 0))
+                    cagg["errors"] += int(crep.get("errors", 0))
+                    for reason, n in (crep.get("errors_by_reason")
+                                      or {}).items():
+                        cbr = cagg["errors_by_reason"]
+                        cbr[reason] = cbr.get(reason, 0) + int(n)
+                    if isinstance(crep.get("objective"), dict):
+                        slo_objectives[(ep, c)] = crep["objective"]
 
     for k, h in hists.items():
         h.update(_hist_percentiles(h))
         if h.get("count"):
             h["mean"] = round(h["total"] / h["count"], 6)
-    slo_out = {}
-    for ep, agg in slo_window.items():
+    def _slo_row(agg, obj):
         rep = dict(agg)
-        obj = slo_objectives.get(ep)
         if agg["requests"]:
             rep["availability"] = round(
                 1.0 - agg["errors"] / agg["requests"], 6)
@@ -358,6 +374,19 @@ def rollup(streams):
                     / float(obj["error_budget"]), 4)
         if obj:
             rep["objective"] = obj
+        return rep
+
+    slo_out = {}
+    for ep, agg in slo_window.items():
+        classes = agg.pop("classes", {})
+        rep = _slo_row(agg, slo_objectives.get(ep))
+        if classes:
+            # class rows inherit the endpoint objective when no class
+            # objective rode the dumps (same rule as SLOTracker.report)
+            rep["classes"] = {
+                c: _slo_row(cagg, slo_objectives.get(
+                    (ep, c), slo_objectives.get(ep)))
+                for c, cagg in sorted(classes.items())}
         slo_out[ep] = rep
 
     # the time dimension (ISSUE 15): per-process series re-assembled
