@@ -1,13 +1,13 @@
 """Framework-aware static analysis for the TPU build.
 
-Three layers, one report format (``file:line RULE message``):
+Five layers, one report format (``file:line RULE message``):
 
   * :mod:`.trace_safety` — AST trace-safety lint (PT001–PT007): tracer
     leaks, concretization under jit, PRNG key reuse, bad static args,
     silent exception swallows, mutable defaults, unmarked slow tests.
   * :mod:`.lock_check` — lock-discipline race checker (PT101/PT102):
     attributes written under ``with self._lock:`` must not be touched
-    outside it.
+    outside it.  Consumes the guard map :mod:`.threadmodel` infers.
   * :mod:`.hlo_audit` — jaxpr/StableHLO audit (PT201–PT203): host
     transfers, silent f64 promotion, un-donated train-step buffers.
 
@@ -16,6 +16,11 @@ Three layers, one report format (``file:line RULE message``):
     collective anti-patterns, hot-loop host syncs — quantified per
     representative program and held to committed per-model budgets
     (``tools/perf_budget.json``).
+  * :mod:`.concurrency_audit` — whole-program concurrency auditor
+    (PT501–PT505) over :mod:`.threadmodel`'s inferred thread roots and
+    lock models: blocking calls under locks, lock-order inversions,
+    unguarded cross-thread state, guard drift (including annotations
+    that contradict inference), condition-variable misuse.
 
 Plus :mod:`.manifest_check` (PT301): OPS_MANIFEST.json claims vs the
 live module surface.

@@ -1,0 +1,564 @@
+"""Layer 5: whole-program concurrency auditor (rules PT501–PT505).
+
+Layer 2 (PT101/PT102) checks attributes someone already wrote a
+``with self._lock:`` around at least once — it cannot see the shared
+attribute nobody thought to guard, the blocking call made while a lock
+is held, or two locks taken in opposite orders.  Those are exactly the
+bug classes every serving-fleet review has caught by hand (the PR 9
+monitor blocking under its own supervision lock, PR 14's mid-sweep
+membership races).  This layer *infers* the concurrency structure from
+:mod:`.threadmodel` — thread roots, per-class lock models, held-lock
+sets per access — and reports:
+
+  PT501  a blocking call executed while a lock is held: ``time.sleep``,
+         ``subprocess``/``Popen.wait``/``.join()``, socket/HTTP
+         requests, ``queue.get()``/``cv.wait()``/``Event.wait()``
+         without a timeout, ``open()`` file I/O — the monitor-stall
+         class.  Interprocedural one level: ``with self._lock:
+         self._helper()`` flags when the helper's body blocks.
+         Waiting on a condition variable whose OWN lock is the only
+         one held is exempt (the wait releases it).
+  PT502  a lock-order inversion: a cycle in the acquisition-order
+         graph (lock B taken while A held on one path, A while B held
+         on another), including cross-class edges when a guarded
+         method calls into another lock-owning object
+         (``self.attr.m()`` with ``attr``'s class known).
+  PT503  an attribute reachable from ≥2 inferred thread roots, written
+         at least once outside construction, with NO lock observed
+         guarding any access — the shared state nobody thought about.
+  PT504  guard drift: the same attribute guarded by lock A at some
+         sites and lock B at others; or read under a lock while every
+         write is lock-free; or a helper annotated "callers hold the
+         lock" (``# pt-lint: ok[PT101,...]`` on its ``def``) actually
+         called somewhere with no lock held — the annotation
+         contradicts what inference derives, loudly.
+  PT505  condition-variable misuse: ``cv.wait()`` outside a ``while``
+         predicate loop (an ``if`` does not survive spurious wakeups),
+         or ``notify``/``notify_all`` without holding the cv.
+
+The pass is whole-program over ``paddle_tpu/`` + ``tools/`` (tests are
+fixture-heavy by design and excluded), stdlib-only, and flows through
+the standard `Violation`/suppression/baseline machinery: annotate a
+deliberate lock-free reader with ``# pt-lint: ok[PT503] (why)`` and
+the gate stays green with an EMPTY baseline.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import threadmodel as tm
+from .report import Suppressions, Violation
+
+__all__ = ["analyze_project", "analyze_source", "audit_classes",
+           "RULE_IDS", "CONC_ROOTS"]
+
+RULE_IDS = ("PT501", "PT502", "PT503", "PT504", "PT505")
+
+# the serving/observability production tree; tests/ is deliberately out
+# (its fixtures create threads and races on purpose)
+CONC_ROOTS = ("paddle_tpu", "tools")
+
+EXTERNAL_ROOT = "<caller>"
+
+# --- blocking-call classification (PT501) ---------------------------------
+# tails that always block (no timeout makes them safe enough to hold a
+# lock across): sleeps, process waits, sockets/HTTP, file IO
+_ALWAYS_BLOCKING = {
+    "sleep": "time.sleep",
+    "communicate": "Popen.communicate",
+    "run": None,            # subprocess.run only (see below)
+    "call": None,           # subprocess.call
+    "check_call": None,
+    "check_output": None,
+    "Popen": "process spawn",
+    "urlopen": "HTTP request",
+    "getresponse": "HTTP response read",
+    "create_connection": "socket connect",
+    "recv": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "sendall": "socket send",
+    "request": "HTTP request",
+}
+_SUBPROCESS_ONLY = {"run", "call", "check_call", "check_output"}
+# tails that block only when called with NO timeout (arg or kwarg)
+_TIMEOUT_BLOCKING = {"wait", "join", "get", "acquire"}
+
+
+def _blocking_reason(cls: tm.ClassModel, call: tm.RawCall):
+    """Why this raw call is considered blocking, or None."""
+    tail, name = call.tail, call.name
+    if name == "open":
+        # the bare builtin only — `self.index.open()` is not file I/O
+        return "file open()"
+    if tail in _ALWAYS_BLOCKING:
+        if tail in _SUBPROCESS_ONLY:
+            return (f"subprocess.{tail}" if name.startswith("subprocess.")
+                    else None)
+        if tail == "sleep" and not (
+                name in ("time.sleep", "sleep")
+                or name.endswith(".sleep")):
+            return None
+        return _ALWAYS_BLOCKING[tail] or name
+    if tail in _TIMEOUT_BLOCKING:
+        if call.has_timeout:
+            return None
+        if tail == "acquire":
+            # lock.acquire() is PT502's domain (ordering), not a stall
+            return None
+        if tail == "get":
+            # q.get() blocks; d.get(k[, default]) does not — a zero-arg
+            # no-kwarg .get() cannot be the dict method
+            return None if call.has_args else "queue.get() without timeout"
+        if tail in ("wait", "join"):
+            if call.has_args:
+                # wait(5.0)/join(2.0): a positional timeout
+                return None
+            return f".{tail}() without timeout"
+    return None
+
+
+def _cv_self_wait_exempt(cls: tm.ClassModel, call: tm.RawCall) -> bool:
+    """`with self._cv: self._cv.wait()` releases the lock it holds —
+    blocking there is the POINT.  Exempt when the only held locks are
+    the cv's own identity."""
+    if call.tail not in ("wait", "wait_for") or call.recv_attr is None:
+        return False
+    if cls.locks.get(call.recv_attr) != "cond":
+        return False
+    cv_id = cls.canon(call.recv_attr)
+    held = cls.canon_set(call.locks)
+    return held <= {cv_id}
+
+
+# ---------------------------------------------------------------------------
+# per-class rule passes
+# ---------------------------------------------------------------------------
+
+def _audit_pt501(cls: tm.ClassModel, out: list):
+    for m in cls.methods.values():
+        if m.name in tm.SKIP_METHODS or \
+                m.name in cls.construction_only:
+            continue
+        for call in m.raw_calls:
+            held = cls.held_at(m.name, call.locks)
+            if not held:
+                continue
+            reason = _blocking_reason(cls, call)
+            if reason is None or _cv_self_wait_exempt(cls, call):
+                continue
+            # a cv.wait under its own lock PLUS another lock still
+            # stalls the other lock's waiters — keep those
+            if call.tail in ("wait", "wait_for") and \
+                    cls.locks.get(call.recv_attr) == "cond":
+                held = held - {cls.canon(call.recv_attr)}
+                if not held:
+                    continue
+            locks = ",".join(sorted(held))
+            out.append(Violation(
+                cls.file, call.line, "PT501",
+                f"{cls.name}.{call.method} blocks ({reason}) while "
+                f"holding `{locks}`"))
+        # one level deep: a locked call into a same-class helper whose
+        # body blocks (lexically lock-free there, so the body site
+        # itself stays clean)
+        for site in m.calls:
+            held = cls.held_at(m.name, site.locks)
+            if not held:
+                continue
+            callee = cls.methods.get(site.callee)
+            if callee is None or callee.name in tm.SKIP_METHODS:
+                continue
+            callee_own = cls.presumed.get(callee.name, frozenset())
+            if callee_own:
+                continue  # the helper's body reports itself (presumed)
+            for call in callee.raw_calls:
+                if cls.canon_set(call.locks):
+                    continue  # the helper's own locked sites report
+                    # at the helper (with its own held set)
+                reason = _blocking_reason(cls, call)
+                if reason is None:
+                    continue
+                out.append(Violation(
+                    cls.file, site.line, "PT501",
+                    f"{cls.name}.{site.method} holds `"
+                    f"{','.join(sorted(held))}` across call to "
+                    f"`{site.callee}` which blocks ({reason})"))
+                break  # one finding per call site, not per sleep
+
+
+def _lock_node(cls: tm.ClassModel, lock: str) -> str:
+    return f"{cls.name}.{cls.canon(lock)}"
+
+
+def _collect_lock_edges(classes_by_name: dict, cls: tm.ClassModel,
+                        edges: dict):
+    """Acquisition-order edges `held -> taken`, same-class and one
+    level cross-class (`self.attr.m()` with a lock-owning attr type)."""
+    for m in cls.methods.values():
+        for acq in m.acquires:
+            held = cls.held_at(m.name, acq.held)
+            taken = cls.canon(acq.lock)
+            for h in held:
+                if h == taken:
+                    continue
+                edges.setdefault(
+                    (_lock_node(cls, h), _lock_node(cls, taken)),
+                    (cls.file, acq.line,
+                     f"{cls.name}.{acq.method}"))
+        for ext in m.ext_calls:
+            held = cls.held_at(m.name, ext.locks)
+            if not held:
+                continue
+            target_cls = classes_by_name.get(
+                cls.attr_types.get(ext.attr))
+            if target_cls is None:
+                continue
+            callee = target_cls.methods.get(ext.meth)
+            if callee is None:
+                continue
+            taken_locks = {target_cls.canon(a.lock)
+                           for a in callee.acquires}
+            taken_locks |= target_cls.propagated_locks(callee.name)
+            for h in held:
+                for t in taken_locks:
+                    edges.setdefault(
+                        (_lock_node(cls, h), _lock_node(target_cls, t)),
+                        (cls.file, ext.line,
+                         f"{cls.name}.{ext.method} -> "
+                         f"{target_cls.name}.{ext.meth}"))
+
+
+def _find_cycles(edges: dict) -> list:
+    """Elementary cycles in the acquisition graph (DFS, deduplicated by
+    rotation-normalized node set) — the graph is tiny."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles, seen = [], set()
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                lo = path.index(min(path))
+                norm = tuple(path[lo:] + path[:lo])
+                if norm not in seen:
+                    seen.add(norm)
+                    cycles.append(list(path))
+            elif nxt not in on_path and nxt > start:
+                # only walk nodes > start: each cycle is found exactly
+                # once, from its smallest node
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def _audit_pt502(classes: list, out: list):
+    classes_by_name: dict = {}
+    for cls in classes:
+        # first definition wins; ambiguous names simply resolve to one
+        # of the candidates (a lint, not a type checker)
+        classes_by_name.setdefault(cls.name, cls)
+    edges: dict = {}
+    for cls in classes:
+        _collect_lock_edges(classes_by_name, cls, edges)
+    for cycle in _find_cycles(edges):
+        # anchor the finding at the first edge of the cycle
+        first = edges.get((cycle[0], cycle[1 % len(cycle)]))
+        if first is None:
+            continue
+        file, line, where = first
+        order = " -> ".join(cycle + [cycle[0]])
+        out.append(Violation(
+            file, line, "PT502",
+            f"lock-order inversion: acquisition cycle {order} "
+            f"(first edge in {where})"))
+
+
+def _roots_reaching(cls: tm.ClassModel) -> dict:
+    """method name -> set of root labels whose transitive same-class
+    call closure includes it.  Public methods are additionally entries
+    from the constructing/calling thread (EXTERNAL_ROOT)."""
+    callees: dict = {name: {c.callee for c in m.calls}
+                     for name, m in cls.methods.items()}
+    reach: dict = {name: set() for name in cls.methods}
+
+    def mark(root_label, start):
+        stack, visited = [start], set()
+        while stack:
+            name = stack.pop()
+            if name in visited or name not in reach:
+                continue
+            visited.add(name)
+            reach[name].add(root_label)
+            stack.extend(callees.get(name, ()))
+
+    handler_only = bool(cls.thread_roots) and all(
+        "HTTP handler" in why for why in cls.thread_roots.values())
+    for root, why in cls.thread_roots.items():
+        if "HTTP handler" in why:
+            # one label for ALL handler methods: a handler instance is
+            # per-request (BaseHTTPRequestHandler), so do_GET/do_POST
+            # of the SAME instance never race each other — the handler
+            # root only counts as concurrent against Thread roots or
+            # external callers
+            mark("root:<http-handler>", root)
+        else:
+            mark(f"root:{root}", root)
+    for name, m in cls.methods.items():
+        if not name.startswith("_") and not m.is_pseudo \
+                and name not in cls.thread_roots:
+            # a pure request-handler class has no external entry:
+            # nothing but the server ever calls it
+            if not handler_only:
+                mark(EXTERNAL_ROOT, name)
+    return reach
+
+
+def _audit_pt503_pt504(cls: tm.ClassModel, sup: Suppressions,
+                       out: list):
+    if not cls.methods:
+        return
+    reach = _roots_reaching(cls)
+    # gather per-attribute access facts (construction excluded by the
+    # model; lock/threadsafe attrs are infrastructure, not state)
+    per_attr: dict = {}
+    for m in cls.methods.values():
+        if m.name in tm.SKIP_METHODS or \
+                m.name in cls.construction_only:
+            continue
+        for a in m.accesses:
+            if a.attr in cls.locks or a.attr in cls.threadsafe:
+                continue
+            locks = cls.effective_locks(m, a)
+            per_attr.setdefault(a.attr, []).append((a, m, locks))
+
+    for attr in sorted(per_attr):
+        accesses = per_attr[attr]
+        locked = [(a, m, lk) for (a, m, lk) in accesses if lk]
+        unlocked = [(a, m, lk) for (a, m, lk) in accesses if not lk]
+        writes = [(a, m, lk) for (a, m, lk) in accesses if a.write]
+
+        # -- PT503: shared, written, never guarded ------------------
+        if cls.thread_roots and writes and not locked:
+            roots = set()
+            for (a, m, _lk) in accesses:
+                roots |= reach.get(m.name, set())
+            if len(roots) >= 2:
+                a, m, _lk = writes[0]
+                names = ", ".join(sorted(roots))
+                out.append(Violation(
+                    cls.file, a.line, "PT503",
+                    f"{cls.name}.{attr} is reachable from "
+                    f"{len(roots)} thread roots ({names}), written in "
+                    f"{m.name}, and no lock guards any access"))
+                continue  # drift questions are moot without any lock
+
+        # -- PT504 (a): same attr under two disjoint lock sets ------
+        drift = None
+        for (a1, m1, lk1) in locked:
+            for (a2, m2, lk2) in locked:
+                if a2.line <= a1.line:
+                    continue
+                if lk1.isdisjoint(lk2):
+                    drift = (a1, m1, lk1, a2, m2, lk2)
+                    break
+            if drift:
+                break
+        if drift:
+            a1, m1, lk1, a2, m2, lk2 = drift
+            out.append(Violation(
+                cls.file, a2.line, "PT504",
+                f"{cls.name}.{attr} guard drift: guarded by "
+                f"`{','.join(sorted(lk1))}` in {m1.name} but "
+                f"`{','.join(sorted(lk2))}` in {m2.name}"))
+            continue
+
+        # -- PT504 (b): read under a lock, written only lock-free ---
+        locked_reads = [(a, m, lk) for (a, m, lk) in locked
+                        if not a.write]
+        locked_writes = [(a, m, lk) for (a, m, lk) in locked if a.write]
+        unlocked_writes = [(a, m, lk) for (a, m, lk) in unlocked
+                           if a.write]
+        if locked_reads and unlocked_writes and not locked_writes:
+            a, m, _lk = unlocked_writes[0]
+            ra, rm, rlk = locked_reads[0]
+            out.append(Violation(
+                cls.file, a.line, "PT504",
+                f"{cls.name}.{attr} guard drift: read under "
+                f"`{','.join(sorted(rlk))}` in {rm.name} but written "
+                f"with no lock in {m.name}"))
+
+    # -- PT504 (c): "callers hold the lock" annotation vs inference --
+    for name, m in cls.methods.items():
+        if m.is_pseudo or m.name in tm.SKIP_METHODS:
+            continue
+        claims = sup.guard_claims(m.lineno) & {"PT101", "PT102"}
+        if not claims:
+            continue
+        for site in cls.call_sites_of(name):
+            if site.method in cls.construction_only:
+                continue  # pre-sharing call — no lock needed yet
+            held = cls.held_at(site.method, site.locks)
+            if held:
+                continue
+            out.append(Violation(
+                cls.file, site.line, "PT504",
+                f"{cls.name}.{site.method} calls `{name}` with no "
+                f"lock held, but its annotation claims callers hold "
+                f"the lock — the annotation contradicts inference"))
+
+
+def _audit_pt505(cls: tm.ClassModel, file_tree, out: list):
+    conds = {a for a, kind in cls.locks.items() if kind == "cond"}
+    if not conds:
+        return
+    # notify/notify_all need the cv held
+    for m in cls.methods.values():
+        if m.name in tm.SKIP_METHODS:
+            continue
+        for call in m.raw_calls:
+            if call.recv_attr not in conds:
+                continue
+            held = cls.held_at(m.name, call.locks)
+            cv_id = cls.canon(call.recv_attr)
+            if call.tail in ("notify", "notify_all") and \
+                    cv_id not in held:
+                out.append(Violation(
+                    cls.file, call.line, "PT505",
+                    f"{cls.name}.{call.method} calls "
+                    f"`{call.recv_attr}.{call.tail}()` without "
+                    f"holding the condition"))
+    # cv.wait() must sit inside a while-predicate loop (spurious
+    # wakeups; an `if` checks the predicate once).  wait_for loops
+    # internally and is exempt.  This needs the AST shape, not just
+    # the model: find the wait calls and their enclosing statements.
+    if file_tree is None:
+        return
+    for node in ast.walk(file_tree):
+        if not isinstance(node, ast.ClassDef) or \
+                node.name != cls.name or node.lineno != cls.lineno:
+            continue
+        _check_waits_in_while(cls, node, conds, out)
+        break
+
+
+def _check_waits_in_while(cls, cls_node, conds, out):
+    def visit(node, in_while, func):
+        for child in ast.iter_child_nodes(node):
+            child_in_while = in_while
+            if isinstance(child, ast.While):
+                child_in_while = True
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                visit(child, False, child.name)
+                continue
+            elif isinstance(child, ast.ClassDef):
+                continue
+            if isinstance(child, ast.Call):
+                f = child.func
+                if isinstance(f, ast.Attribute) and f.attr == "wait" \
+                        and tm.self_attr(f.value) in conds \
+                        and not in_while:
+                    out.append(Violation(
+                        cls.file, child.lineno, "PT505",
+                        f"{cls.name}.{func} calls "
+                        f"`{tm.self_attr(f.value)}.wait()` outside a "
+                        f"`while` predicate loop (an `if` does not "
+                        f"survive spurious wakeups)"))
+            visit(child, child_in_while, func)
+
+    for fn in cls_node.body:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and fn.name not in tm.SKIP_METHODS:
+            visit(fn, False, fn.name)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def audit_classes(models: list, suppressions: dict,
+                  trees: dict | None = None) -> list:
+    """Run PT501–PT505 over already-built ClassModels.  `suppressions`
+    maps file path -> Suppressions; `trees` maps file path -> ast tree
+    (for the PT505 while-shape check)."""
+    out: list = []
+    for cls in models:
+        tm.apply_presumed_locks(cls, suppressions.get(cls.file))
+    for cls in models:
+        sup = suppressions.get(cls.file)
+        _audit_pt501(cls, out)
+        _audit_pt503_pt504(cls, sup or _EMPTY_SUP, out)
+        _audit_pt505(cls, (trees or {}).get(cls.file), out)
+    _audit_pt502(models, out)
+    filtered = []
+    for v in out:
+        sup = suppressions.get(v.file)
+        if sup is not None and sup.suppressed(v.line, v.rule):
+            continue
+        filtered.append(v)
+    filtered.sort(key=Violation.sort_key)
+    return filtered
+
+
+class _NoSuppressions:
+    @staticmethod
+    def suppressed(line, rule):
+        return False
+
+    @staticmethod
+    def listed_rules(line):
+        return set()
+
+    @staticmethod
+    def guard_claims(line):
+        return set()
+
+
+_EMPTY_SUP = _NoSuppressions()
+
+
+def analyze_source(source: str, path: str,
+                   tree: ast.Module | None = None) -> list:
+    """Single-file audit (tests and the one-file CLI path): the whole
+    program IS this file."""
+    if tree is None:
+        tree = ast.parse(source)
+    fm = tm.build_file_model(source, path, tree=tree)
+    sup = Suppressions(source, tree)
+    return audit_classes(fm.classes, {path: sup}, {path: tree})
+
+
+def analyze_files(file_items) -> list:
+    """Audit a set of (abs_path, rel_path) files as ONE program —
+    cross-class PT502 edges resolve across file boundaries."""
+    models, sups, trees = [], {}, {}
+    out: list = []
+    for abs_path, rel in file_items:
+        try:
+            with open(abs_path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError):
+            continue  # the runner's PT000 covers unparsable files
+        fm = tm.build_file_model(source, rel, tree=tree)
+        models.extend(fm.classes)
+        sups[rel] = Suppressions(source, tree)
+        trees[rel] = tree
+    out.extend(audit_classes(models, sups, trees))
+    return out
+
+
+def analyze_project(repo_root: str, roots=CONC_ROOTS) -> list:
+    """The default whole-program pass: every .py under the production
+    roots (tests excluded — fixture threads race on purpose)."""
+    from .runner import iter_python_files
+
+    wanted = []
+    for rel in iter_python_files(repo_root, roots=roots):
+        wanted.append((os.path.join(repo_root, rel), rel))
+    return analyze_files(wanted)

@@ -9,75 +9,58 @@ watchdog thread: a write that *usually* runs on one thread quietly
 starts racing when a daemon thread (watchdog poll, serving handler,
 heartbeat) touches the same attribute.
 
-Inference, per class:
+The guard map itself — which attributes each lock protects, which
+locks are held at each access — is no longer inferred here: it is
+PRODUCED by the shared :mod:`.threadmodel` (the same model Layer 5's
+``concurrency_audit`` consumes for PT501–PT505), so an annotation and
+the inference can never disagree silently.  This module keeps only the
+PT101/PT102 *judgment*:
 
-  * lock attributes — ``self.X = threading.Lock()/RLock()/Condition()``
-    (or any assignment to a name containing "lock"/"cv"/"cond");
-  * guarded set — attributes *written* at least once inside a
-    ``with self.<lock>:`` body anywhere in the class;
-  * violations — any access to a guarded attribute outside a lock body:
-    PT101 for writes, PT102 for reads.
+  * guarded set — attributes *written* at least once with a lock
+    effectively held anywhere in the class;
+  * violations — any access to a guarded attribute with NO lock
+    effectively held: PT101 for writes, PT102 for reads.
 
-Deliberately excluded: ``__init__``/``__del__``/``__new__`` bodies
-(construction precedes sharing), the lock attributes themselves, and
-calls to the class's own methods (``self.beat()`` is a call, not state
-access — the callee's body is analyzed on its own).  Nested functions
+"Effectively held" is the model's call: lexical ``with self.<lock>:``
+scope, plus locks a private helper's every internal call site holds,
+plus locks the repo's conventions presume callers hold (a ``*_locked``
+name, or a ``def``-line ``# pt-lint: ok[PT101,PT102] (caller holds
+_lock)`` guard claim — see ``threadmodel.apply_presumed_locks``).  A
+guard claim that inference CONTRADICTS is Layer 5's PT504.
+
+Deliberately excluded: ``__init__``-family bodies and helpers
+reachable only from them (construction precedes sharing), the lock
+attributes themselves, internally-synchronized Event/Queue attributes,
+and calls to the class's own methods (``self.beat()`` is a call, not
+state access — the callee's body is analyzed on its own).  Closures
 reset the lock context: a closure handed to another thread does NOT
 inherit the ``with`` that created it.
 
-The same inference runs at module level for the module-global
-``_lock``/``_cache`` idiom (autotune): globals written under a
-module-level lock become guarded; functions touching them outside the
-lock are flagged.  Helpers that are only ever called with the lock held
-annotate their ``def`` line with ``# pt-lint: ok[PT101,PT102]``.
+The module-level pass for the module-global ``_lock``/``_cache`` idiom
+(autotune) still lives here: globals written under a module-level lock
+become guarded; functions touching them outside the lock are flagged.
 """
 from __future__ import annotations
 
 import ast
 
+from . import threadmodel as tm
 from .report import Violation
 
 __all__ = ["analyze_source", "analyze_file", "RULE_IDS"]
 
 RULE_IDS = ("PT101", "PT102")
 
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
-               "BoundedSemaphore"}
-_SKIP_METHODS = {"__init__", "__new__", "__del__", "__init_subclass__"}
-# method calls that mutate their receiver: `self._events.append(x)` is
-# a WRITE to _events for guarded-set inference, same as subscript
-# assignment — the exact mutation a racing reader tears
-_MUTATORS = {
-    "append", "appendleft", "extend", "extendleft", "insert", "pop",
-    "popleft", "popitem", "remove", "clear", "update", "add",
-    "discard", "setdefault", "sort", "reverse",
-}
-# attributes holding these ctors are internally synchronized — calling
-# set()/clear()/put() on an Event/Queue needs no external lock, so they
-# never enter the guarded set
-_THREADSAFE_CTORS = {"Event", "Queue", "SimpleQueue", "LifoQueue",
-                     "PriorityQueue", "local", "Barrier"}
+_LOCK_CTORS = set(tm.LOCK_CTORS)
+_MUTATORS = tm.MUTATORS
 
 
 def _dotted(node) -> str:
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
+    return tm.dotted(node)
 
 
 def _is_lock_ctor(node) -> bool:
-    return isinstance(node, ast.Call) and \
-        _dotted(node.func).rsplit(".", 1)[-1] in _LOCK_CTORS
-
-
-def _lock_name_like(name: str) -> bool:
-    low = name.lower()
-    return "lock" in low or low.endswith(("_cv", "_cond", "_mutex"))
+    return tm.is_lock_ctor(node)
 
 
 class _Access:
@@ -91,137 +74,41 @@ class _Access:
         self.func = func
 
 
-def _self_attr(node):
-    """'X' when node is `self.X`, else None."""
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
-
-
-def _with_locks(stmt: ast.With, lock_names, owner="self"):
-    """Lock attrs among this with-statement's context managers."""
+def _with_locks(stmt: ast.With, lock_names):
+    """Module-level lock names among this with-statement's managers."""
     held = set()
     for item in stmt.items:
         expr = item.context_expr
-        if owner == "self":
-            attr = _self_attr(expr)
-            if attr is not None and attr in lock_names:
-                held.add(attr)
-        else:
-            if isinstance(expr, ast.Name) and expr.id in lock_names:
-                held.add(expr.id)
+        if isinstance(expr, ast.Name) and expr.id in lock_names:
+            held.add(expr.id)
     return held
 
 
-def _scan_method(fn, lock_names, accesses, method_names):
-    """Collect self.X accesses in one method with lock-held context."""
-
-    def walk(node, locked):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node is not fn:
-            # a closure does not inherit the lock it was created under
-            for child in node.body:
-                walk(child, False)
-            return
-        if isinstance(node, ast.With):
-            held = _with_locks(node, lock_names)
-            for item in node.items:
-                walk(item.context_expr, locked)
-            for child in node.body:
-                walk(child, locked or bool(held))
-            return
-        if isinstance(node, ast.Attribute):
-            attr = _self_attr(node)
-            if attr is not None:
-                write = isinstance(node.ctx, (ast.Store, ast.Del))
-                accesses.append(_Access(attr, write, locked,
-                                        node.lineno, fn.name))
-            for child in ast.iter_child_nodes(node):
-                walk(child, locked)
-            return
-        if isinstance(node, ast.Subscript) and isinstance(
-                node.ctx, (ast.Store, ast.Del)):
-            # self._map[k] = v mutates _map: record the write, then the
-            # normal walk records the Load of the container
-            attr = _self_attr(node.value)
-            if attr is not None:
-                accesses.append(_Access(attr, True, locked,
-                                        node.lineno, fn.name))
-        if isinstance(node, ast.Call):
-            # self.method(...) is a call, not state access — skip the
-            # func attribute but scan the arguments
-            attr = _self_attr(node.func)
-            if attr is not None and attr in method_names:
-                for child in list(node.args) + [
-                        kw.value for kw in node.keywords]:
-                    walk(child, locked)
-                return
-            # self._events.append(x): a mutating method on a container
-            # attribute is a write to that attribute
-            if isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _MUTATORS:
-                attr = _self_attr(node.func.value)
-                if attr is not None:
-                    accesses.append(_Access(attr, True, locked,
-                                            node.lineno, fn.name))
-        if isinstance(node, ast.AugAssign):
-            # x += 1 parses the target as Store only; it is a read AND
-            # a write — record both so `self.n += 1` outside the lock
-            # is caught as the read-modify-write race it is
-            attr = _self_attr(node.target)
-            if attr is not None:
-                accesses.append(_Access(attr, False, locked,
-                                        node.lineno, fn.name))
-        for child in ast.iter_child_nodes(node):
-            walk(child, locked)
-
-    for stmt in fn.body:
-        walk(stmt, False)
-
-
-def _analyze_class(cls: ast.ClassDef, path: str, out: list) -> None:
-    methods = [n for n in cls.body
-               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-    method_names = {m.name for m in methods}
-    lock_names, threadsafe = set(), set()
-    for node in ast.walk(cls):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                attr = _self_attr(t)
-                if attr is None:
-                    continue
-                if _is_lock_ctor(node.value) or (
-                        _lock_name_like(attr)
-                        and isinstance(node.value, ast.Call)):
-                    lock_names.add(attr)
-                elif isinstance(node.value, ast.Call) and _dotted(
-                        node.value.func).rsplit(".", 1)[-1] in \
-                        _THREADSAFE_CTORS:
-                    threadsafe.add(attr)
-    if not lock_names:
+def _analyze_class(cls: tm.ClassModel, out: list) -> None:
+    """PT101/PT102 over one inferred ClassModel."""
+    if not cls.locks:
         return
-    accesses: list = []
-    for m in methods:
-        if m.name in _SKIP_METHODS:
+    flat = []  # (attr, write, effectively_locked, line, method)
+    for name, meth in cls.methods.items():
+        if name in tm.SKIP_METHODS or name in cls.construction_only:
             continue
-        _scan_method(m, lock_names, accesses, method_names)
-    guarded = {a.attr for a in accesses
-               if a.write and a.locked and a.attr not in lock_names
-               and a.attr not in threadsafe}
-    for a in accesses:
-        if a.attr not in guarded or a.locked or a.attr in lock_names:
+        for a in meth.accesses:
+            if a.attr in cls.locks or a.attr in cls.threadsafe:
+                continue
+            flat.append((a.attr, a.write,
+                         bool(cls.effective_locks(meth, a)),
+                         a.line, name))
+    guarded = {attr for attr, write, locked, _l, _m in flat
+               if write and locked}
+    for attr, write, locked, line, meth_name in flat:
+        if attr not in guarded or locked:
             continue
-        if a.write:
-            out.append(Violation(
-                path, a.line, "PT101",
-                f"{cls.name}.{a.func} writes lock-guarded attribute "
-                f"`{a.attr}` outside `with self.<lock>:`"))
-        else:
-            out.append(Violation(
-                path, a.line, "PT102",
-                f"{cls.name}.{a.func} reads lock-guarded attribute "
-                f"`{a.attr}` outside `with self.<lock>:`"))
+        rule = "PT101" if write else "PT102"
+        verb = "writes" if write else "reads"
+        out.append(Violation(
+            cls.file, line, rule,
+            f"{cls.name}.{meth_name} {verb} lock-guarded attribute "
+            f"`{attr}` outside `with self.<lock>:`"))
 
 
 def _local_bindings(fn) -> set:
@@ -286,7 +173,7 @@ def _analyze_module_level(tree: ast.Module, path: str, out: list) -> None:
                     and node is not fn:
                 return
             if isinstance(node, ast.With):
-                held = _with_locks(node, lock_names, owner="global")
+                held = _with_locks(node, lock_names)
                 for child in node.body:
                     walk(child, locked or bool(held))
                 return
@@ -324,13 +211,19 @@ def _analyze_module_level(tree: ast.Module, path: str, out: list) -> None:
 
 
 def analyze_source(source: str, path: str,
-                   tree: ast.Module | None = None) -> list:
+                   tree: ast.Module | None = None,
+                   suppressions=None) -> list:
+    """PT101/PT102 for one file.  `suppressions` (duck-typed, see
+    ``threadmodel.apply_presumed_locks``) feeds def-line guard-claim
+    annotations into the presumed-lock inference; without it only the
+    ``*_locked`` naming convention establishes a presumption."""
     if tree is None:
         tree = ast.parse(source)
+    fm = tm.build_file_model(source, path, tree=tree)
     out: list = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            _analyze_class(node, path, out)
+    for cls in fm.classes:
+        tm.apply_presumed_locks(cls, suppressions)
+        _analyze_class(cls, out)
     _analyze_module_level(tree, path, out)
     out.sort(key=Violation.sort_key)
     return out

@@ -76,6 +76,9 @@ class Suppressions:
     def __init__(self, source: str, tree: ast.AST | None = None):
         # line -> set of rule ids (empty set = suppress every rule)
         self._lines: dict = {}
+        # line -> the free-text reason following the marker ("(callers
+        # hold _lock)") — Layer 5 machine-reads the caller-holds idiom
+        self._reasons: dict = {}
         for i, line in enumerate(source.splitlines(), start=1):
             m = _SUPPRESS_RE.search(line)
             if m:
@@ -83,6 +86,7 @@ class Suppressions:
                 self._lines[i] = (
                     set() if rules is None
                     else {r.strip() for r in rules.split(",") if r.strip()})
+                self._reasons[i] = line[m.end():].strip()
         # (start, end, rules) ranges from def/class headers carrying a
         # suppression comment — covers the whole body
         self._ranges: list = []
@@ -108,6 +112,33 @@ class Suppressions:
             if start <= line <= end and self._matches(rules, rule):
                 return True
         return False
+
+    def listed_rules(self, line: int) -> set:
+        """Rule ids EXPLICITLY named in a suppression on `line` or the
+        line above (a bare ``ok`` contributes nothing)."""
+        out: set = set()
+        for probe in (line, line - 1):
+            rules = self._lines.get(probe)
+            if rules:
+                out |= rules
+        return out
+
+    def guard_claims(self, line: int) -> set:
+        """Rule ids whose suppression on `line`/line-above carries a
+        caller-holds-the-lock reason — the repo's documented idiom
+        ``# pt-lint: ok[PT102] (callers hold _lock)``.  Layer 5 treats
+        these as machine-read guard facts: the helper's body is
+        analyzed as if the named lock were held, and PT504 reports any
+        call site where inference shows NO lock actually held.  A
+        waiver with any other reason ("set once at construction") stays
+        a plain suppression."""
+        out: set = set()
+        for probe in (line, line - 1):
+            rules = self._lines.get(probe)
+            if rules and re.search(r"\bholds?\b",
+                                   self._reasons.get(probe, "")):
+                out |= rules
+        return out
 
     def apply(self, violations):
         return [v for v in violations

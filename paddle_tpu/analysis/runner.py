@@ -60,13 +60,18 @@ def analyze_one_file(abs_path: str, rel_path: str,
     out = []
     # one parse shared by every layer (and the suppression index):
     # parsing dominates the fast path's cost
+    sup = Suppressions(source, tree)
     if "ast" in layers:
         out.extend(trace_safety.analyze_source(source, rel_path,
                                                tree=tree))
     if "lock" in layers:
+        # the suppression index doubles as the guard-claim source: a
+        # def-line ok[PT101] "caller holds the lock" annotation feeds
+        # the presumed-lock inference, not just post-hoc filtering
         out.extend(lock_check.analyze_source(source, rel_path,
-                                             tree=tree))
-    return Suppressions(source, tree).apply(out)
+                                             tree=tree,
+                                             suppressions=sup))
+    return sup.apply(out)
 
 
 def analyze_repo(repo_root: str, roots=DEFAULT_ROOTS,
@@ -76,6 +81,14 @@ def analyze_repo(repo_root: str, roots=DEFAULT_ROOTS,
     for rel in iter_python_files(repo_root, roots):
         out.extend(analyze_one_file(os.path.join(repo_root, rel), rel,
                                     layers))
+    if "conc" in layers:
+        # Layer 5 is whole-program (lock-order cycles cross files), so
+        # it runs once over the tree, not per file; it applies
+        # suppressions internally and scopes itself to the serving/
+        # tooling roots (tests spin up racing threads on purpose)
+        from .concurrency_audit import analyze_project
+
+        out.extend(analyze_project(repo_root))
     if "manifest" in layers:
         from .manifest_check import audit_manifest
 

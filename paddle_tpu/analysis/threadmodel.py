@@ -1,0 +1,715 @@
+"""Inferred concurrency structure — the shared model behind Layers 2+5.
+
+Layer 2 (`lock_check`, PT101/PT102) used to infer its guarded-attribute
+map privately; Layer 5 (`concurrency_audit`, PT501–PT505) needs the
+same facts plus more (which *thread roots* exist, which locks are HELD
+at each access, who calls whom).  Both layers now consume ONE model —
+built here — so an annotation and the inference can never disagree
+silently: there is no second copy of the guard map to drift.
+
+Per class, the model records:
+
+  * **lock attributes** — ``self.X = threading.Lock()/RLock()/
+    Condition()/Semaphore()`` (or any lock-named attribute bound to a
+    call).  A ``Condition(self._lock)`` built over an existing lock is
+    *aliased* to it: holding either name is holding the same mutex, so
+    lock identity (PT502/PT504) and "is the cv's own lock held"
+    (PT501/PT505) canonicalize through :meth:`ClassModel.canon`.
+  * **thread roots** — methods that run on a thread other than the
+    constructing one: ``threading.Thread(target=self.m)`` /
+    ``Timer(t, self.m)`` targets (including targets reached through a
+    callable attribute like ``self._spawner = spawner or self._spawn``),
+    nested ``def`` handed to ``Thread(target=...)`` inside a method,
+    ``run()`` of a ``Thread`` subclass, and ``do_GET``-style HTTP
+    handler methods (each request runs on its own
+    ``ThreadingHTTPServer`` thread).
+  * **accesses** — every ``self.X`` read/write with the SET of lock
+    attributes lexically held (``with self.<lock>:``), per method.
+    ``__init__``-family bodies are excluded (construction precedes
+    sharing); closures reset the lock context (a closure handed to
+    another thread does not inherit the ``with`` that created it) and
+    are modeled as pseudo-methods (``m.<locals>.f``).
+  * **calls** — same-class ``self.m(...)`` call sites with held locks
+    (the one-level interprocedural edge: a private helper whose every
+    internal call site holds lock L is analyzed as if its body ran
+    under L), cross-object ``self.attr.m(...)`` sites (PT502's
+    cross-class acquisition edges), and raw calls with enough shape
+    (dotted name, receiver attribute, timeout-arg presence) for the
+    blocking-call classifier.
+
+The model is stdlib-`ast` only and never imports the analyzed code.
+"""
+from __future__ import annotations
+
+import ast
+
+__all__ = [
+    "Access", "CallSite", "ExtCall", "RawCall", "Acquire",
+    "MethodModel", "ClassModel", "FileModel", "build_file_model",
+    "apply_presumed_locks",
+    "LOCK_CTORS", "THREADSAFE_CTORS", "SKIP_METHODS", "MUTATORS",
+]
+
+LOCK_CTORS = {"Lock": "lock", "RLock": "lock", "Condition": "cond",
+              "Semaphore": "sema", "BoundedSemaphore": "sema"}
+# attributes holding these ctors are internally synchronized — calling
+# set()/clear()/put() on an Event/Queue needs no external lock
+THREADSAFE_CTORS = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+                    "PriorityQueue", "local", "Barrier"}
+SKIP_METHODS = {"__init__", "__new__", "__del__", "__init_subclass__"}
+# method calls that mutate their receiver: `self._events.append(x)` is
+# a WRITE to _events, same as subscript assignment
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "update", "add",
+    "discard", "setdefault", "sort", "reverse", "move_to_end",
+}
+# HTTP-handler entry points: ThreadingHTTPServer runs each request's
+# handler on its own thread, so every do_* method is a thread root
+_HANDLER_METHODS = {"do_GET", "do_POST", "do_PUT", "do_DELETE",
+                    "do_HEAD", "do_PATCH", "do_OPTIONS", "handle",
+                    "handle_one_request"}
+_THREAD_CTORS = {"Thread", "Timer"}
+# calls that hand their callable argument to a foreign thread (or an
+# async signal context).  A bound method passed to anything ELSE —
+# sorted(key=self.rank), map(self.f, xs) — runs synchronously and is
+# NOT a thread root.
+_HANDOFF_CALLS = {"submit", "add_done_callback", "start_new_thread",
+                  "signal", "run_in_executor", "spawn_thread"}
+_PROPERTY_DECOS = {"property", "cached_property"}
+
+
+def dotted(node) -> str:
+    """'a.b.c' for a Name/Attribute chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def self_attr(node):
+    """'X' when node is `self.X`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def lock_name_like(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or low.endswith(("_cv", "_cond", "_mutex"))
+
+
+def is_lock_ctor(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        dotted(node.func).rsplit(".", 1)[-1] in LOCK_CTORS
+
+
+class Access:
+    """One `self.X` read or write; `locks` is the frozenset of lock
+    attribute names (canonicalized) lexically held at the site."""
+
+    __slots__ = ("attr", "write", "locks", "line", "method")
+
+    def __init__(self, attr, write, locks, line, method):
+        self.attr = attr
+        self.write = bool(write)
+        self.locks = frozenset(locks)
+        self.line = int(line)
+        self.method = method
+
+    @property
+    def locked(self) -> bool:
+        return bool(self.locks)
+
+
+class CallSite:
+    """`self.m(...)` — a same-class method call with held locks."""
+
+    __slots__ = ("callee", "locks", "line", "method")
+
+    def __init__(self, callee, locks, line, method):
+        self.callee = callee
+        self.locks = frozenset(locks)
+        self.line = int(line)
+        self.method = method
+
+
+class ExtCall:
+    """`self.attr.m(...)` — a call into another object held in an
+    attribute; PT502 resolves `attr`'s class through the project model
+    to build cross-class lock-acquisition edges."""
+
+    __slots__ = ("attr", "meth", "locks", "line", "method")
+
+    def __init__(self, attr, meth, locks, line, method):
+        self.attr = attr
+        self.meth = meth
+        self.locks = frozenset(locks)
+        self.line = int(line)
+        self.method = method
+
+
+class RawCall:
+    """Any call, with enough shape for the blocking classifier:
+    `name` is the full dotted callee ('' for computed callees),
+    `recv_attr` is 'X' when the receiver is `self.X`, `tail` the final
+    component, `has_args`/`has_timeout` describe the argument list."""
+
+    __slots__ = ("name", "recv_attr", "tail", "locks", "line", "method",
+                 "has_args", "has_timeout")
+
+    def __init__(self, name, recv_attr, tail, locks, line, method,
+                 has_args, has_timeout):
+        self.name = name
+        self.recv_attr = recv_attr
+        self.tail = tail
+        self.locks = frozenset(locks)
+        self.line = int(line)
+        self.method = method
+        self.has_args = bool(has_args)
+        self.has_timeout = bool(has_timeout)
+
+
+class Acquire:
+    """One `with self.<lock>:` entry: the lock taken and the locks
+    already held — the PT502 acquisition-order edge."""
+
+    __slots__ = ("lock", "held", "line", "method")
+
+    def __init__(self, lock, held, line, method):
+        self.lock = lock
+        self.held = frozenset(held)
+        self.line = int(line)
+        self.method = method
+
+
+class MethodModel:
+    __slots__ = ("name", "lineno", "accesses", "calls", "ext_calls",
+                 "raw_calls", "acquires", "is_pseudo")
+
+    def __init__(self, name, lineno, is_pseudo=False):
+        self.name = name
+        self.lineno = int(lineno)
+        self.accesses: list = []
+        self.calls: list = []
+        self.ext_calls: list = []
+        self.raw_calls: list = []
+        self.acquires: list = []
+        self.is_pseudo = bool(is_pseudo)  # closure pseudo-method
+
+
+class ClassModel:
+    """The inferred concurrency structure of one class."""
+
+    __slots__ = ("name", "file", "lineno", "locks", "cond_alias",
+                 "threadsafe", "methods", "attr_types", "callable_attrs",
+                 "thread_roots", "bases", "properties", "presumed",
+                 "construction_only")
+
+    def __init__(self, name, file, lineno):
+        self.name = name
+        self.file = file
+        self.lineno = int(lineno)
+        self.locks: dict = {}          # attr -> kind (lock/cond/sema)
+        self.cond_alias: dict = {}     # cond attr -> underlying lock attr
+        self.threadsafe: set = set()
+        self.methods: dict = {}        # name -> MethodModel
+        self.attr_types: dict = {}     # attr -> class name (self.x = C())
+        self.callable_attrs: dict = {} # attr -> {method names it may call}
+        self.thread_roots: dict = {}   # method name -> reason
+        self.bases: list = []
+        self.properties: set = set()   # @property methods (reads, not
+                                       # bound-method escapes)
+        self.presumed: dict = {}       # method -> frozenset of locks the
+                                       # repo's conventions say callers
+                                       # hold (see apply_presumed_locks)
+        self.construction_only: set = set()  # private helpers called
+                                       # ONLY from __init__ — their
+                                       # accesses precede sharing, like
+                                       # __init__'s own
+
+    def canon(self, lock: str) -> str:
+        """Canonical lock identity: a Condition built over an existing
+        lock IS that lock (holding either is holding the same mutex)."""
+        return self.cond_alias.get(lock, lock)
+
+    def canon_set(self, locks) -> frozenset:
+        return frozenset(self.canon(x) for x in locks)
+
+    def holds(self, locks, lock: str) -> bool:
+        """Is `lock` (by identity, through cv aliasing) held?"""
+        return self.canon(lock) in self.canon_set(locks)
+
+    # ---- interprocedural (one level): call-site lock propagation ----
+    def call_sites_of(self, name):
+        """All same-class call sites of method `name` (every method's
+        body, including pseudo-methods)."""
+        sites = []
+        for m in self.methods.values():
+            for c in m.calls:
+                if c.callee == name:
+                    sites.append(c)
+        return sites
+
+    def propagated_locks(self, name) -> frozenset:
+        """Locks a PRIVATE helper can assume held: the intersection of
+        the locks held at its internal call sites, when every site
+        holds at least one lock and nothing else can reach it (public
+        name or thread root ⇒ no assumption).  One level only — the
+        call sites' own lexical locks, not their callers'."""
+        if not name.startswith("_") or name.startswith("__") \
+                or name in self.thread_roots:
+            return frozenset()
+        sites = self.call_sites_of(name)
+        if not sites:
+            return frozenset()
+        held = None
+        for c in sites:
+            locks = self.canon_set(c.locks)
+            if not locks:
+                return frozenset()
+            held = locks if held is None else (held & locks)
+        return held or frozenset()
+
+    def effective_locks(self, method: MethodModel, access) -> frozenset:
+        """Lexical locks at the access plus the helper's propagated
+        call-site locks plus whatever the repo's conventions presume
+        callers hold (`*_locked` suffix / def-level ok[PT101] claim)."""
+        return self.canon_set(access.locks) | \
+            self.propagated_locks(method.name) | \
+            self.presumed.get(method.name, frozenset())
+
+    def held_at(self, method_name: str, locks) -> frozenset:
+        """Canonical held set at a call/access site: lexical locks plus
+        the containing method's propagated + presumed locks."""
+        return self.canon_set(locks) | \
+            self.propagated_locks(method_name) | \
+            self.presumed.get(method_name, frozenset())
+
+
+class FileModel:
+    __slots__ = ("path", "tree", "classes")
+
+    def __init__(self, path, tree, classes):
+        self.path = path
+        self.tree = tree
+        self.classes = classes  # list[ClassModel], source order
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+def _call_has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _thread_target(call: ast.Call):
+    """The target/callback expression of a Thread/Timer ctor, or None."""
+    tail = dotted(call.func).rsplit(".", 1)[-1]
+    if tail not in _THREAD_CTORS:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("target", "function"):
+            return kw.value
+    if tail == "Timer" and len(call.args) >= 2:
+        return call.args[1]
+    if tail == "Thread" and call.args:
+        # Thread(group, target, ...) — positional target is arg 1;
+        # nobody passes group positionally, so treat arg 0 as target
+        # only if it is not None
+        a = call.args[0]
+        if not (isinstance(a, ast.Constant) and a.value is None):
+            return a
+        if len(call.args) >= 2:
+            return call.args[1]
+    return None
+
+
+class _MethodScanner:
+    """Walk one method body collecting the model facts.  `locks` in
+    every record is the RAW attribute-name set; canonicalization (cv
+    aliasing) happens at query time on the ClassModel."""
+
+    def __init__(self, cls: ClassModel, meth: MethodModel,
+                 pseudo_out: list):
+        self.cls = cls
+        self.m = meth
+        self.pseudo_out = pseudo_out  # (name, FunctionDef) closures
+        self._local_targets: set = set()  # nested defs passed to Thread
+
+    def scan(self, fn):
+        for stmt in fn.body:
+            self._walk(stmt, frozenset(), fn)
+        return self._local_targets
+
+    # -- helpers --
+    def _with_locks(self, stmt: ast.With):
+        held = set()
+        for item in stmt.items:
+            attr = self_attr(item.context_expr)
+            if attr is None:
+                continue
+            if attr in self.cls.locks:
+                held.add(attr)
+            elif lock_name_like(attr):
+                # `with self._lock:` where the lock is defined in a
+                # base class — register it on first use (the ctor scan
+                # only sees this class's body)
+                self.cls.locks[attr] = "lock"
+                held.add(attr)
+        return held
+
+    def _record_call(self, node: ast.Call, locks):
+        name = dotted(node.func)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        recv_attr = None
+        callee_attr = self_attr(node.func)
+        if isinstance(node.func, ast.Attribute):
+            recv_attr = self_attr(node.func.value)
+        if callee_attr is not None and callee_attr in self.cls.methods:
+            self.m.calls.append(CallSite(callee_attr, locks,
+                                         node.lineno, self.m.name))
+        elif callee_attr is not None and \
+                callee_attr in self.cls.callable_attrs:
+            # self._spawner(...) where _spawner may be a bound method:
+            # a call site for every method it can name
+            for target in self.cls.callable_attrs[callee_attr]:
+                self.m.calls.append(CallSite(target, locks,
+                                             node.lineno, self.m.name))
+        elif recv_attr is not None and recv_attr not in self.cls.locks:
+            self.m.ext_calls.append(ExtCall(recv_attr, tail, locks,
+                                            node.lineno, self.m.name))
+        self.m.raw_calls.append(RawCall(
+            name, recv_attr, tail, locks, node.lineno, self.m.name,
+            has_args=bool(node.args),
+            has_timeout=_call_has_timeout(node)))
+        # Thread(target=self.X or nested def) discovered anywhere;
+        # non-method names resolve at finalize (they are pruned there)
+        target = _thread_target(node)
+        if target is not None:
+            t_attr = self_attr(target)
+            if t_attr is not None:
+                self.cls.thread_roots.setdefault(
+                    t_attr, "Thread/Timer target")
+            elif isinstance(target, ast.Name):
+                self._local_targets.add(target.id)
+        elif tail in _HANDOFF_CALLS and (node.args or node.keywords):
+            # a bound method handed to a thread-handoff callable
+            # (executor.submit, signal.signal) runs on a foreign
+            # thread/async context.  Property reads passed as plain
+            # values (sorted(key=...), range(self.ndim)) do not.
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                a_attr = self_attr(arg)
+                if a_attr is not None and a_attr in self.cls.methods \
+                        and a_attr not in self.cls.properties:
+                    self.cls.thread_roots.setdefault(
+                        a_attr, "escaped bound method (callback)")
+
+    def _walk(self, node, locks, fn):
+        if isinstance(node, ast.ClassDef):
+            # a nested class (the Handler-in-__init__ idiom) is its own
+            # ClassModel — its `self` is not ours
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            # a closure does not inherit the lock it was created under;
+            # it becomes a pseudo-method (possibly a thread root)
+            self.pseudo_out.append((f"{self.m.name}.<locals>."
+                                    f"{node.name}", node))
+            return
+        if isinstance(node, ast.With):
+            held = self._with_locks(node)
+            for lk in sorted(held):
+                if lk not in locks:
+                    self.m.acquires.append(Acquire(lk, locks,
+                                                   node.lineno,
+                                                   self.m.name))
+            for item in node.items:
+                self._walk(item.context_expr, locks, fn)
+            inner = locks | frozenset(held)
+            for child in node.body:
+                self._walk(child, inner, fn)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = self_attr(node)
+            if attr is not None:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                self.m.accesses.append(Access(attr, write, locks,
+                                              node.lineno, self.m.name))
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, locks, fn)
+            return
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            # self._map[k] = v mutates _map: a write, then the normal
+            # walk records the Load of the container
+            attr = self_attr(node.value)
+            if attr is not None:
+                self.m.accesses.append(Access(attr, True, locks,
+                                              node.lineno, self.m.name))
+        if isinstance(node, ast.Call):
+            attr = self_attr(node.func)
+            self._record_call(node, locks)
+            if attr is not None and (attr in self.cls.methods
+                                     or attr in self.cls.callable_attrs):
+                # self.method(...) is a call, not state access — skip
+                # the func attribute but scan the arguments
+                for child in list(node.args) + [kw.value
+                                               for kw in node.keywords]:
+                    self._walk(child, locks, fn)
+                return
+            # self._events.append(x): a mutating method on a container
+            # attribute is a write to that attribute
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                r_attr = self_attr(node.func.value)
+                if r_attr is not None:
+                    self.m.accesses.append(Access(r_attr, True, locks,
+                                                  node.lineno,
+                                                  self.m.name))
+        if isinstance(node, ast.AugAssign):
+            # x += 1 parses the target as Store only; it is a read AND
+            # a write — record both so `self.n += 1` outside the lock
+            # is caught as the read-modify-write race it is
+            attr = self_attr(node.target)
+            if attr is not None:
+                self.m.accesses.append(Access(attr, False, locks,
+                                              node.lineno, self.m.name))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, locks, fn)
+
+
+def _uncalled_self_refs(node) -> set:
+    """`self.X` Load references in `node` that are not the callee of a
+    call — a bound method escaping as a value."""
+    called = {id(x.func) for x in ast.walk(node)
+              if isinstance(x, ast.Call)}
+    return {self_attr(x) for x in ast.walk(node)
+            if isinstance(x, ast.Attribute) and id(x) not in called
+            and isinstance(getattr(x, "ctx", None), ast.Load)
+            and self_attr(x)}
+
+
+def _scan_class_attrs(cls_node: ast.ClassDef, model: ClassModel):
+    """First pass: lock/threadsafe/typed/callable attribute discovery
+    (anywhere in the class — __init__ included)."""
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(self_attr(t) is not None for t in node.targets):
+            # `other.cb = self.m`: a bound method escaping to a foreign
+            # object — it may be invoked from a foreign thread.  Names
+            # that are not methods are pruned at finalize.
+            for name in _uncalled_self_refs(node.value):
+                model.thread_roots.setdefault(
+                    name, "escaped bound method (assigned callback)")
+            continue
+        for t in node.targets:
+            attr = self_attr(t)
+            if attr is None:
+                continue
+            v = node.value
+            if is_lock_ctor(v):
+                ctor = dotted(v.func).rsplit(".", 1)[-1]
+                model.locks[attr] = LOCK_CTORS[ctor]
+                if ctor == "Condition":
+                    # Condition(self._lock): aliased to the real lock
+                    under = self_attr(v.args[0]) if v.args else None
+                    for kw in v.keywords:
+                        if kw.arg == "lock":
+                            under = self_attr(kw.value)
+                    if under:
+                        model.cond_alias[attr] = under
+            elif lock_name_like(attr) and isinstance(v, ast.Call):
+                model.locks.setdefault(attr, "lock")
+            elif isinstance(v, ast.Call):
+                ctor = dotted(v.func).rsplit(".", 1)[-1]
+                if ctor in THREADSAFE_CTORS:
+                    model.threadsafe.add(attr)
+                elif ctor and ctor[:1].isupper():
+                    model.attr_types[attr] = ctor
+            # callable attr: every `self.m` (uncalled bound method)
+            # appearing in a non-Call RHS is a method this attr may
+            # invoke (`self._spawner = spawner or self._spawn`)
+            if not isinstance(v, ast.Call):
+                names = {self_attr(x) for x in ast.walk(v)
+                         if isinstance(x, ast.Attribute)
+                         and isinstance(getattr(x, "ctx", None),
+                                        ast.Load)}
+                methods = {n for n in names if n}
+                if methods:
+                    model.callable_attrs.setdefault(attr, set()).update(
+                        methods)
+
+
+def _build_class(cls_node: ast.ClassDef, path: str) -> ClassModel:
+    model = ClassModel(cls_node.name, path, cls_node.lineno)
+    model.bases = [dotted(b).rsplit(".", 1)[-1] for b in cls_node.bases
+                   if dotted(b)]
+    method_nodes = [n for n in cls_node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))]
+    for fn in method_nodes:
+        model.methods[fn.name] = MethodModel(fn.name, fn.lineno)
+        for deco in fn.decorator_list:
+            if dotted(deco).rsplit(".", 1)[-1] in _PROPERTY_DECOS:
+                model.properties.add(fn.name)
+    _scan_class_attrs(cls_node, model)
+    # callable_attrs may name methods: keep only real ones
+    for attr, names in list(model.callable_attrs.items()):
+        real = {n for n in names if n in model.methods}
+        if real:
+            model.callable_attrs[attr] = real
+        else:
+            del model.callable_attrs[attr]
+    # scan bodies (skip the construction family), lifting closures
+    # into pseudo-methods with a reset lock context
+    pending = []
+    init_callees: set = set()
+    for fn in method_nodes:
+        if fn.name in SKIP_METHODS:
+            # still scan __init__ for Thread(target=...) roots and
+            # nested closures, but record no accesses from it; KEEP the
+            # callee names so init-only helpers can be recognized
+            sink = MethodModel(fn.name, fn.lineno)
+            sc = _MethodScanner(model, sink, pending)
+            local_targets = sc.scan(fn)
+            init_callees.update(c.callee for c in sink.calls)
+            _resolve_local_targets(model, pending, local_targets)
+            continue
+        meth = model.methods[fn.name]
+        sc = _MethodScanner(model, meth, pending)
+        local_targets = sc.scan(fn)
+        _resolve_local_targets(model, pending, local_targets)
+    while pending:
+        name, fn = pending.pop(0)
+        pm = MethodModel(name, fn.lineno, is_pseudo=True)
+        model.methods[name] = pm
+        sc = _MethodScanner(model, pm, pending)
+        local_targets = sc.scan(fn)
+        _resolve_local_targets(model, pending, local_targets)
+    # thread roots: resolve + enrich
+    for name in list(model.thread_roots):
+        if name not in model.methods or name in model.properties:
+            del model.thread_roots[name]  # not a method / a property
+            # read that merely LOOKED like a bound-method escape
+    if "Thread" in model.bases and "run" in model.methods:
+        model.thread_roots.setdefault("run", "Thread subclass run()")
+    for name in model.methods:
+        if name in _HANDLER_METHODS or (
+                name.split(".")[-1] in _HANDLER_METHODS):
+            model.thread_roots.setdefault(
+                name, "HTTP handler (per-request thread)")
+    # a private helper reachable ONLY from __init__ (directly or
+    # through other construction-only helpers) runs before the object
+    # is shared — its accesses are construction, not races
+    changed = True
+    while changed:
+        changed = False
+        for name, meth in model.methods.items():
+            if name in model.construction_only or meth.is_pseudo \
+                    or not name.startswith("_") \
+                    or name in model.thread_roots:
+                continue
+            sites = model.call_sites_of(name)
+            if any(s.method not in model.construction_only
+                   for s in sites):
+                continue
+            if name in init_callees or sites:
+                model.construction_only.add(name)
+                changed = True
+    return model
+
+
+def _resolve_local_targets(model, pending, local_targets):
+    """Nested defs handed to Thread(target=...): by now they sit in
+    `pending` under their pseudo-names — mark them as roots."""
+    if not local_targets:
+        return
+    for name, _fn in pending:
+        if name.rsplit(".", 1)[-1] in local_targets:
+            model.thread_roots.setdefault(name, "Thread target (closure)")
+    for name in model.methods:
+        if name.rsplit(".", 1)[-1] in local_targets and \
+                model.methods[name].is_pseudo:
+            model.thread_roots.setdefault(name, "Thread target (closure)")
+
+
+def apply_presumed_locks(cls: ClassModel, suppressions=None) -> None:
+    """Populate ``cls.presumed``: locks a helper may assume held because
+    the repo's conventions say callers hold them — a method named
+    ``*_locked``, or one whose ``def`` line carries an explicit
+    ``# pt-lint: ok[PT101]``/``ok[PT102]`` suppression (the documented
+    "callers hold the lock" idiom).  The lock IDENTITY is still
+    inferred, never trusted: the intersection of locks actually held at
+    the helper's locked call sites, falling back to the class's sole
+    mutex when it has exactly one.  Closures (pseudo-methods) inherit
+    their parent's presumption unless they are thread roots — a
+    sort-key closure built inside a locked helper runs under the lock;
+    a closure handed to ``Thread`` does not.
+
+    `suppressions` is duck-typed (``listed_rules(line) -> set``) so this
+    module stays importable without :mod:`.report`."""
+    sole = {cls.canon(lk) for lk in cls.locks}
+    sole_set = frozenset(sole) if len(sole) == 1 else frozenset()
+
+    def claimed(name, m):
+        if name.rsplit(".", 1)[-1].endswith("_locked"):
+            return True
+        if suppressions is not None and not m.is_pseudo:
+            return bool(suppressions.guard_claims(m.lineno)
+                        & {"PT101", "PT102"})
+        return False
+
+    claimers = [name for name, m in cls.methods.items()
+                if name not in cls.thread_roots and claimed(name, m)]
+
+    def infer_identity(name):
+        # intersect over call sites that hold SOMETHING (lexically or
+        # by the caller's own presumption) — the fixpoint lets a claim
+        # chain through helpers: step (lock) -> _a (claimed) -> _b
+        held = None
+        for c in cls.call_sites_of(name):
+            locks = cls.canon_set(c.locks) | \
+                cls.presumed.get(c.method, frozenset())
+            if locks:
+                held = locks if held is None else (held & locks)
+        return held or sole_set
+
+    for _round in range(len(claimers) + 1):
+        changed = False
+        for name in claimers:
+            new = infer_identity(name)
+            if new != cls.presumed.get(name):
+                cls.presumed[name] = new
+                changed = True
+        if not changed:
+            break
+    # sync closures inherit their parent's presumption (a sort-key
+    # closure built inside a locked helper runs under the lock); a
+    # closure handed to Thread does not
+    for name, m in cls.methods.items():
+        if not m.is_pseudo or name in cls.thread_roots \
+                or name in cls.presumed:
+            continue
+        inherited = cls.presumed.get(name.split(".<locals>.", 1)[0])
+        if inherited:
+            cls.presumed[name] = inherited
+
+
+def build_file_model(source: str, path: str,
+                     tree: ast.Module | None = None) -> FileModel:
+    if tree is None:
+        tree = ast.parse(source)
+    classes = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            classes.append(_build_class(node, path))
+    return FileModel(path, tree, classes)

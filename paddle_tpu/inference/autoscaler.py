@@ -376,11 +376,16 @@ class Autoscaler:
     def describe(self):
         with self._lock:
             events = list(self.events)
+        # decision state is owned by _tick_lock, not the events lock —
+        # taken AFTER _lock is released, so no nesting edge
+        with self._tick_lock:
+            target = self._target
+            peak = self.peak_replicas
         return {
             "min": self.min_replicas, "max": self.max_replicas,
-            "target": self._target,
+            "target": target,
             "actual": self.fleet.replica_count(),
-            "peak": self.peak_replicas,
+            "peak": peak,
             "burn_up": self.burn_up, "occ_up": self.occ_up,
             "occ_down": self.occ_down,
             "cooldown_s": self.cooldown_s,

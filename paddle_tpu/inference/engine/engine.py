@@ -397,7 +397,7 @@ class InferenceEngine:
         self._publish_tier_gauges()
 
     # --- quantized-tier construction ----------------------------------------
-    def _page_bytes(self) -> int:
+    def _page_bytes(self) -> int:  # pt-lint: ok[PT102] (_draft binding is set once at construction; only its set-once geometry keys are read here — the mutable k/v pools stay under _lock)
         """HBM bytes ONE page costs across all layers (K+V pools plus
         the scale sidecar under the int8 kv tier, plus the draft
         model's pools when speculative decoding shares the page table)
@@ -527,7 +527,7 @@ class InferenceEngine:
                     attn_start=None if start is None else Tensor(start))
         return logits._value, [tuple(x._value for x in c) for c in new]
 
-    def _run_draft(self, params, buffers, ids, caches, pos, start):
+    def _run_draft(self, params, buffers, ids, caches, pos, start):  # pt-lint: ok[PT102] (_draft binding and its "model" key are set once at construction and never rebound)
         from ...core import flags
         from ...core.tensor import Tensor
 
@@ -543,7 +543,7 @@ class InferenceEngine:
         return logits._value, [tuple(x._value for x in c) for c in new]
 
     # --- compiled programs --------------------------------------------------
-    def _which(self, which):
+    def _which(self, which):  # pt-lint: ok[PT102] (_draft binding and its geometry keys are set once at construction)
         """(run_fn, layers, hkv, hd, dtype) for "target"/"draft"."""
         if which == "draft":
             d = self._draft
@@ -584,6 +584,7 @@ class InferenceEngine:
 
         label = f"prefill_s{sb}" + ("" if which == "target" else f"_{which}")
         prefill = _xla_cost.instrument(prefill, label)
+        # pt-lint: ok[PT503] (benign memo race: dict set is atomic in CPython; worst case two threads jit the same program once each)
         self._programs[key] = prefill
         return prefill
 
@@ -1546,6 +1547,7 @@ class InferenceEngine:
         while self._running:  # pt-lint: ok[PT102]
             if not self.step():
                 with self._work:
+                    # pt-lint: ok[PT504] (wakeup re-check: _running/scheduler are OWNED by _lock; reading them under the _work cv is the standard missed-notify guard — a stale read costs one 50ms wait)
                     if self._running and not self.scheduler.has_work():
                         self._work.wait(timeout=0.05)
 
@@ -1591,6 +1593,7 @@ class InferenceEngine:
         # /health and /ready (serving.py embeds engine.stats() there)
         st["weight_precision"] = cfg.weight_precision or "full"
         st["kv_precision"] = cfg.kv_precision or "full"
+        # pt-lint: ok[PT102] (None-check of the set-once _draft binding)
         st["spec_tokens"] = cfg.spec_tokens if self._draft else 0
         st["page_bytes"] = self._page_bytes()
         st["prefix_cache"] = self.prefix_cache_stats()

@@ -330,7 +330,7 @@ class ReplicaFleet:
         self.router.lifecycle = self.lifecycle
 
     # --- heartbeat plumbing (fleet/elastic.py reuse) ----------------------
-    def _start_store(self):
+    def _start_store(self):  # pt-lint: ok[PT503] (startup phase: runs once from start() before the monitor/relaunch threads exist; the heartbeats escape on the last line is the publish barrier)
         """TCPStore master for the heartbeat registry; replicas beat
         through their own `ElasticManager`.  Heartbeats are an extra
         liveness signal, not a hard dependency — when the native store
@@ -430,11 +430,15 @@ class ReplicaFleet:
             log.close()  # the child holds its own fd
 
     def _launch(self, handle):
-        """Spawn one replica process.  The spawn happens under the
-        fleet lock with a stopping check so a relaunch thread racing
-        `stop()` cannot create an orphan: once stop() has set the flag
-        and passed the lock barrier, no further spawn can start, and
-        any spawn that won the race is visible to stop()'s sweep.
+        """Spawn one replica process.  The fork+exec runs OUTSIDE the
+        fleet lock (it costs tens of milliseconds — holding the lock
+        across it stalls the monitor sweep and every router membership
+        change behind process creation), but the anti-orphan invariant
+        vs `stop()` still holds: the proc is installed under the lock
+        with a stopping re-check, and when stop() won the race — its
+        sweep snapshot cannot have seen this proc — the spawner kills
+        its own child right here.  Either the sweep owns the process or
+        we do; there is no window where nobody does.
         Returns False when stopping."""
         handle.announce = os.path.join(
             self.workdir, f"replica_{handle.rank}"
@@ -454,9 +458,22 @@ class ReplicaFleet:
         with self._lock:
             if self._stopping.is_set() or handle.removed:
                 return False  # stopping, or the rank was retired while
-                # a relaunch was in flight — spawning now would orphan
-                # a process no sweep ever kills
-            handle.proc = self._spawner(handle, cmd, env)
+                # a relaunch was in flight — don't even spawn
+        proc = self._spawner(handle, cmd, env)
+        with self._lock:
+            if not (self._stopping.is_set() or handle.removed):
+                handle.proc = proc
+                proc = None  # installed: stop()/remove's sweep owns it
+        if proc is not None:
+            # stop() or remove_replica() raced the spawn: their sweeps
+            # never saw this proc, so reaping it is OUR job
+            try:
+                proc.kill()
+                proc.wait(timeout=5.0)
+            except Exception:  # pt-lint: ok[PT005]
+                pass           # already dead / unkillable zombie —
+                # nothing more a supervisor can do with it
+            return False
         self._event("replica_spawned", rank=handle.rank,
                     restarts=handle.restarts)
         return True
@@ -487,6 +504,7 @@ class ReplicaFleet:
 
     # --- lifecycle --------------------------------------------------------
     def start(self, wait_ready=True, ready_timeout=None):
+        # pt-lint: ok[PT503] (startup phase: workdir is pinned before any replica or monitor thread exists, and never rebound after)
         self.workdir = self.workdir or tempfile.mkdtemp(
             prefix="paddle_tpu_fleet_")
         os.makedirs(self.workdir, exist_ok=True)

@@ -950,11 +950,13 @@ class InferenceServer:
 
     # --- lifecycle -----------------------------------------------------------
     def start(self):
+        # pt-lint: ok[PT503] (ordered flag: set True before the serving thread exists, cleared only by shutdown(); a torn read is impossible for a bool and a stale one only delays the drain a poll)
         self._serving = True  # before the thread runs: a shutdown()
         # racing start() must wait for the loop, not skip it
         if self.engine is not None:
             self.engine.start()
         self.timeseries.start()
+        # pt-lint: ok[PT503] (set-once before the thread starts; shutdown() only joins it — CPython attribute store is atomic)
         self._thread = threading.Thread(
             target=self.serve_forever, daemon=True,
             name="paddle-tpu-serving")

@@ -153,6 +153,8 @@ class TelemetryExporter:
         self._ts_seen = 0
         self._stop = threading.Event()
         self._thread = None
+        self._io_lock = threading.Lock()  # serializes file appends
+        # only — never held while reading/advancing telemetry state
 
     # --- dumping -------------------------------------------------------------
     def dump_once(self, reason="on_demand") -> str:
@@ -227,7 +229,14 @@ class TelemetryExporter:
                     line["lifecycle"] = self.lifecycle()
                 except Exception as e:
                     line["lifecycle_error"] = f"{type(e).__name__}: {e}"
-            os.makedirs(self.outdir, exist_ok=True)
+        # the disk append runs OUTSIDE _lock: digest() rides the fleet
+        # heartbeat and must never wait behind file IO.  _io_lock
+        # serializes appends so two concurrent dumps cannot interleave
+        # partial lines (the seq/cursor partition above is already
+        # consistent — _lock owns it).
+        os.makedirs(self.outdir, exist_ok=True)
+        with self._io_lock:
+            # pt-lint: ok[PT501] (dedicated IO lock: held only across this append, no state read waits on it)
             with open(self.path, "a") as f:
                 f.write(json.dumps(line, default=str) + "\n")
         return self.path

@@ -197,7 +197,7 @@ class TenantLedger:
         self._distinct_seen = 0   # distinct ids ever admitted
 
     # --- recording ---------------------------------------------------------
-    def _entry(self, tenant):
+    def _entry(self, tenant):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
         """The tracked entry for `tenant`, admitting (and possibly
         evicting) per Space-Saving.  Caller holds the lock."""
         e = self._tenants.get(tenant)

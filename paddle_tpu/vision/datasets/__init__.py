@@ -281,9 +281,12 @@ class Flowers(Dataset):
         return self._tar
 
     def close(self):
-        if self._tar is not None:
-            self._tar.close()
-            self._tar = None
+        # under the lock: close() racing a __getitem__ on another
+        # worker thread must not yank the handle mid-extract
+        with self._tar_lock:
+            if self._tar is not None:
+                self._tar.close()
+                self._tar = None
 
     def __getstate__(self):
         state = dict(self.__dict__)

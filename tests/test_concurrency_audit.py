@@ -1,0 +1,643 @@
+"""Layer 5 concurrency-auditor tests (PT501–PT505).
+
+Same contract as test_analysis.py: every rule's firing condition is
+pinned by one positive AND one negative fixture, the live serving
+modules must audit clean (that IS the CI gate for this layer), and the
+suppression round-trip (finding -> annotate -> clean) is exercised so
+an annotation typo can't silently disarm the gate.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.analysis import concurrency_audit as ca
+from paddle_tpu.analysis import threadmodel as tm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(violations):
+    return {v.rule for v in violations}
+
+
+def run(src):
+    return ca.analyze_source(textwrap.dedent(src), "fix.py")
+
+
+# ----------------------- PT501 blocking call under lock -----------------
+
+
+PT501_POS = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def poll(self):
+            with self._lock:
+                time.sleep(1.0)      # PT501: stall under the lock
+                self._n += 1
+"""
+
+PT501_NEG = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def poll(self):
+            time.sleep(1.0)          # sleep BEFORE taking the lock
+            with self._lock:
+                self._n += 1
+"""
+
+
+def test_pt501_positive():
+    v = [x for x in run(PT501_POS) if x.rule == "PT501"]
+    assert len(v) == 1, run(PT501_POS)
+    assert "time.sleep" in v[0].message and "_lock" in v[0].message
+
+
+def test_pt501_negative():
+    assert "PT501" not in rules_of(run(PT501_NEG))
+
+
+PT501_INTERPROCEDURAL = """
+    import threading
+    import time
+
+    class Monitor:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def step(self):
+            with self._lock:
+                self._refresh()      # PT501 at THIS call site
+
+        def background(self):
+            self._refresh()          # also called lock-free, so the
+                                     # helper gets no propagated lock
+
+        def _refresh(self):
+            time.sleep(0.5)
+            self._n = 1
+"""
+
+
+def test_pt501_interprocedural_one_level():
+    v = [x for x in run(PT501_INTERPROCEDURAL) if x.rule == "PT501"]
+    assert len(v) == 1, run(PT501_INTERPROCEDURAL)
+    assert "_refresh" in v[0].message and "step" in v[0].message
+    # anchored at step's call site, not inside the helper body
+    assert v[0].line == PT501_INTERPROCEDURAL.count("\n", 0,
+        PT501_INTERPROCEDURAL.index("# PT501 at THIS")) + 1
+
+
+def test_pt501_timeouts_and_own_cv_wait_are_exempt():
+    src = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait(timeout=1.0)
+
+            def join_child(self, t):
+                with self._cv:
+                    t.join(2.0)      # positional timeout: bounded
+    """
+    assert "PT501" not in rules_of(run(src))
+
+
+# ----------------------- PT502 lock-order inversion ---------------------
+
+
+PT502_POS = """
+    import threading
+
+    class Triple:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            self._c_lock = threading.Lock()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def bc(self):
+            with self._b_lock:
+                with self._c_lock:
+                    pass
+
+        def ca(self):
+            with self._c_lock:
+                with self._a_lock:
+                    pass
+"""
+
+PT502_NEG = """
+    import threading
+
+    class Triple:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+            self._c_lock = threading.Lock()
+
+        def ab(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def bc(self):
+            with self._b_lock:
+                with self._c_lock:
+                    pass
+
+        def ac(self):
+            with self._a_lock:      # consistent global order a<b<c
+                with self._c_lock:
+                    pass
+"""
+
+
+def test_pt502_three_lock_cycle():
+    v = [x for x in run(PT502_POS) if x.rule == "PT502"]
+    assert len(v) == 1, run(PT502_POS)
+    for lk in ("_a_lock", "_b_lock", "_c_lock"):
+        assert f"Triple.{lk}" in v[0].message
+
+
+def test_pt502_consistent_order_clean():
+    assert "PT502" not in rules_of(run(PT502_NEG))
+
+
+def test_pt502_cross_class_edge():
+    src = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.owner = Owner()
+
+            def put(self):
+                with self._lock:
+                    self.owner.flush()   # takes Owner._lock under ours
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.store = Store()
+
+            def flush(self):
+                with self._lock:
+                    pass
+
+            def drain(self):
+                with self._lock:
+                    self.store.put()     # opposite order -> cycle
+    """
+    v = [x for x in run(src) if x.rule == "PT502"]
+    assert len(v) == 1, run(src)
+    assert "Store._lock" in v[0].message and "Owner._lock" in v[0].message
+
+
+# ----------------------- PT503 unguarded cross-thread state -------------
+
+
+PT503_POS = """
+    import threading
+
+    class Exporter:
+        def __init__(self):
+            self.stats = {}
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            self.stats["n"] = 1      # written on the loop thread
+
+        def do_GET(self):            # second root: per-request handler
+            body = self.stats
+            return body
+"""
+
+PT503_NEG = """
+    import threading
+
+    class Exporter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.stats = {}
+            self._thread = None
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            with self._lock:
+                self.stats["n"] = 1
+
+        def do_GET(self):
+            with self._lock:
+                body = dict(self.stats)
+            return body
+"""
+
+
+def test_pt503_positive_http_handler_second_root():
+    v = [x for x in run(PT503_POS) if x.rule == "PT503"]
+    assert len(v) == 1, run(PT503_POS)
+    assert "stats" in v[0].message
+    assert "root:_loop" in v[0].message
+    assert "root:<http-handler>" in v[0].message
+
+
+def test_pt503_negative_guarded():
+    assert "PT503" not in rules_of(run(PT503_NEG))
+
+
+def test_pt503_handler_only_class_has_no_external_root():
+    # a pure request-handler class: do_GET/do_POST run on per-request
+    # handler INSTANCES, so same-instance attrs never race
+    src = """
+        class Handler:
+            def do_GET(self):
+                self.body = "x"
+
+            def do_POST(self):
+                self.body = "y"
+    """
+    assert "PT503" not in rules_of(run(src))
+
+
+# ----------------------- PT504 guard drift ------------------------------
+
+
+PT504_POS = """
+    import threading
+
+    class Split:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._aux_lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            with self._aux_lock:     # PT504: different lock, same attr
+                return self._n
+"""
+
+PT504_NEG = """
+    import threading
+
+    class Split:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._aux_lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            with self._lock:
+                return self._n
+"""
+
+
+def test_pt504_positive_disjoint_locks():
+    v = [x for x in run(PT504_POS) if x.rule == "PT504"]
+    assert len(v) == 1, run(PT504_POS)
+    assert "_aux_lock" in v[0].message and "_lock" in v[0].message
+
+
+def test_pt504_negative_same_lock():
+    assert "PT504" not in rules_of(run(PT504_NEG))
+
+
+def test_pt504_annotation_contradicts_inference():
+    # the machine-read guard-claim grammar: a def-line ok[PT102]
+    # "callers hold the lock" annotation is a CLAIM, and a call site
+    # inference proves lock-free contradicts it — loudly
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._entry(k)[0] = v
+
+            def peek(self, k):
+                return self._entry(k)   # no lock held here
+
+            def _entry(self, k):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+                if k not in self._rows:
+                    self._rows[k] = [None]
+                return self._rows[k]
+    """
+    v = [x for x in run(src) if x.rule == "PT504"]
+    assert len(v) == 1, run(src)
+    assert "peek" in v[0].message
+    assert "contradicts inference" in v[0].message
+
+
+def test_pt504_honoured_annotation_is_clean():
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self._entry(k)[0] = v
+
+            def peek(self, k):
+                with self._lock:
+                    return self._entry(k)
+
+            def _entry(self, k):  # pt-lint: ok[PT101,PT102] (callers hold _lock)
+                if k not in self._rows:
+                    self._rows[k] = [None]
+                return self._rows[k]
+    """
+    assert rules_of(run(src)) == set()
+
+
+# ----------------------- PT505 condition-variable misuse ----------------
+
+
+PT505_POS_IF = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._open = False
+
+        def pass_through(self):
+            with self._cv:
+                if not self._open:   # PT505: `if`, not `while`
+                    self._cv.wait()
+"""
+
+PT505_POS_NOTIFY = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._open = False
+
+        def release(self):
+            self._open = True
+            self._cv.notify_all()    # PT505: cv not held
+"""
+
+PT505_NEG = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._open = False
+
+        def pass_through(self):
+            with self._cv:
+                while not self._open:
+                    self._cv.wait()
+
+        def release(self):
+            with self._cv:
+                self._open = True
+                self._cv.notify_all()
+"""
+
+
+def test_pt505_wait_under_if_not_while():
+    v = [x for x in run(PT505_POS_IF) if x.rule == "PT505"]
+    assert len(v) == 1, run(PT505_POS_IF)
+    assert "spurious wakeups" in v[0].message
+
+
+def test_pt505_notify_without_cv_held():
+    v = [x for x in run(PT505_POS_NOTIFY) if x.rule == "PT505"]
+    assert len(v) == 1, run(PT505_POS_NOTIFY)
+    assert "notify_all" in v[0].message
+
+
+def test_pt505_negative():
+    assert "PT505" not in rules_of(run(PT505_NEG))
+
+
+# ----------------------- inference internals ----------------------------
+
+
+def test_threadmodel_condition_aliasing():
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+    """)
+    fm = tm.build_file_model(src, "fix.py")
+    (cls,) = fm.classes
+    assert cls.canon("_cv") == "_lock"
+    assert cls.holds({"_cv"}, "_lock")
+
+
+def test_threadmodel_construction_only_helpers():
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._setup()
+
+            def _setup(self):
+                self._n = 0
+    """)
+    fm = tm.build_file_model(src, "fix.py")
+    (cls,) = fm.classes
+    assert "_setup" in cls.construction_only
+
+
+def test_threadmodel_locked_suffix_presumes_sole_lock():
+    src = textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self._n += 1
+    """)
+    fm = tm.build_file_model(src, "fix.py")
+    (cls,) = fm.classes
+    tm.apply_presumed_locks(cls)
+    assert cls.presumed["_bump_locked"] == frozenset({"_lock"})
+
+
+# ----------------------- suppression round-trip -------------------------
+
+
+def test_suppression_round_trip_finding_annotate_clean():
+    dirty = """
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def poll(self):
+                with self._lock:
+                    time.sleep(1.0)
+                    self._n += 1
+    """
+    assert "PT501" in rules_of(run(dirty))
+    annotated = dirty.replace(
+        "time.sleep(1.0)",
+        "time.sleep(1.0)  # pt-lint: ok[PT501] (test-only stub)")
+    assert "PT501" not in rules_of(run(annotated))
+    # the annotation is rule-scoped: it must NOT disarm other rules
+    wrong_rule = dirty.replace(
+        "time.sleep(1.0)",
+        "time.sleep(1.0)  # pt-lint: ok[PT503] (wrong rule id)")
+    assert "PT501" in rules_of(run(wrong_rule))
+
+
+# ----------------------- live serving modules audit clean ---------------
+
+
+def test_live_serving_modules_audit_clean():
+    """The modules the ISSUE names: router, fleet, scheduler (engine),
+    autoscaler, overload/QoS — plus observability.  Zero unsuppressed
+    PT501–PT505 findings, with the baseline EMPTY."""
+    files = [
+        "paddle_tpu/inference/router.py",
+        "paddle_tpu/inference/fleet.py",
+        "paddle_tpu/inference/autoscaler.py",
+        "paddle_tpu/inference/qos.py",
+        "paddle_tpu/inference/serving.py",
+        "paddle_tpu/inference/engine/engine.py",
+        "paddle_tpu/observability/export.py",
+        "paddle_tpu/observability/timeseries.py",
+    ]
+    for rel in files:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+    v = ca.analyze_files(
+        [(os.path.join(REPO, rel), rel) for rel in files])
+    assert v == [], "\n".join(
+        f"{x.file}:{x.line} {x.rule} {x.message}" for x in v)
+
+
+def test_whole_program_audit_clean():
+    v = ca.analyze_project(REPO)
+    assert v == [], "\n".join(
+        f"{x.file}:{x.line} {x.rule} {x.message}" for x in v)
+
+
+def test_baseline_is_empty():
+    with open(os.path.join(REPO, "tools", "lint_baseline.json")) as f:
+        baseline = json.load(f)
+    assert baseline.get("counts") == {}
+
+
+# ----------------------- CLI integration --------------------------------
+
+
+def test_cli_conc_in_default_check_layers():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pt_lint.py"),
+         "--check", "--layers", "ast,lock,conc"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "conc" in proc.stdout
+
+
+def test_cli_select_and_emit_json(tmp_path):
+    out = tmp_path / "findings.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pt_lint.py"),
+         "--layers", "conc",
+         "--select", "PT501,PT502,PT503,PT504,PT505",
+         "--emit", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = json.loads(out.read_text())
+    assert rows == []  # the tree is clean; the file must still exist
+
+
+def test_conc_gate_catches_new_violation_in_synthetic_tree(tmp_path):
+    """The gate wiring end-to-end on a synthetic repo root: a PT501
+    under paddle_tpu/ surfaces through analyze_repo(layers=("conc",))
+    and diffs as NEW against an empty baseline."""
+    import paddle_tpu.analysis as A
+
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+        import threading
+        import time
+
+        class Stall:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(1.0)
+                    self._n += 1
+    """))
+    v = A.analyze_repo(str(tmp_path), layers=("conc",))
+    assert rules_of(v) == {"PT501"}, A.render_report(v)
+    new, known, stale = A.diff_against_baseline(v, {})
+    assert len(new) == 1 and not known and not stale

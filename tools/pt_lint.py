@@ -4,6 +4,11 @@ Layers (see docs/STATIC_ANALYSIS.md for the rule catalog):
 
   ast       PT001–PT007  trace-safety lint (stdlib ast, fast)
   lock      PT101/PT102  lock-discipline race checker (fast)
+  conc      PT501–PT505  whole-program concurrency auditor: inferred
+                         thread roots, blocking calls under locks,
+                         lock-order cycles, unguarded cross-thread
+                         state, guard drift, condition-variable misuse
+                         (stdlib ast, fast)
   manifest  PT301        OPS_MANIFEST.json vs live module surface
                          (imports paddle_tpu — a few seconds)
   jaxpr     PT201–PT203  jaxpr/StableHLO audit of the exported op
@@ -16,13 +21,16 @@ Layers (see docs/STATIC_ANALYSIS.md for the rule catalog):
                          per-model budgets (tools/perf_budget.json)
 
 Usage:
-  python tools/pt_lint.py                  # report everything (ast+lock)
+  python tools/pt_lint.py                  # report (ast+lock+conc)
   python tools/pt_lint.py --check          # gate: exit 2 on NEW
                                            # violations vs the baseline
-                                           # (runs ast+lock+manifest)
+                                           # (runs ast+lock+conc+manifest)
   python tools/pt_lint.py --update-baseline
   python tools/pt_lint.py --jaxpr --check  # include the slow layer
   python tools/pt_lint.py --layers ast     # pick layers explicitly
+  python tools/pt_lint.py --select PT501,PT502 --emit out.json
+                                           # concurrency findings only,
+                                           # machine-readable JSON
   python tools/pt_lint.py --perf           # perf audit, fast subset
                                            # (train/sharded-train/
                                            #  decode/call-sites)
@@ -193,12 +201,17 @@ def main(argv=None) -> int:
                     help="baseline path (default tools/lint_baseline."
                          "json)")
     ap.add_argument("--layers", default=None,
-                    help="comma list among ast,lock,manifest,jaxpr "
-                         "(default: ast,lock; --check adds manifest)")
+                    help="comma list among ast,lock,conc,manifest,"
+                         "jaxpr (default: ast,lock,conc; --check adds "
+                         "manifest)")
     ap.add_argument("--jaxpr", action="store_true",
                     help="include the jaxpr/HLO audit layer (slow)")
     ap.add_argument("--select", default=None,
                     help="only report these rule ids (comma list)")
+    ap.add_argument("--emit", metavar="OUT", default=None,
+                    help="also write the (post --select) findings as a "
+                         "JSON array of {file,line,rule,message} rows "
+                         "('-' for stdout)")
     ap.add_argument("--perf", action="store_true",
                     help="run the static performance auditor "
                          "(PT400-PT405) instead of the source layers")
@@ -229,8 +242,9 @@ def main(argv=None) -> int:
     else:
         # --update-baseline must record the SAME layer set --check
         # gates on, or a manifest finding could never be baselined
-        layers = ("ast", "lock", "manifest") \
-            if (args.check or args.update_baseline) else ("ast", "lock")
+        layers = ("ast", "lock", "conc", "manifest") \
+            if (args.check or args.update_baseline) \
+            else ("ast", "lock", "conc")
     if args.jaxpr and "jaxpr" not in layers:
         layers = layers + ("jaxpr",)
 
@@ -249,6 +263,19 @@ def main(argv=None) -> int:
     if args.select:
         wanted = {x.strip() for x in args.select.split(",")}
         violations = [v for v in violations if v.rule in wanted]
+
+    if args.emit:
+        import json
+
+        rows = [{"file": v.file, "line": v.line, "rule": v.rule,
+                 "message": v.message} for v in violations]
+        payload = json.dumps(rows, indent=2, sort_keys=True) + "\n"
+        if args.emit == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.emit, "w") as f:
+                f.write(payload)
+            print(f"pt_lint: {len(rows)} finding(s) -> {args.emit}")
 
     if args.update_baseline:
         analysis.save_baseline(args.baseline, violations)
