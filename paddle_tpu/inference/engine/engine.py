@@ -884,7 +884,8 @@ class InferenceEngine:
     # --- intake -------------------------------------------------------------
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
                request_id=None, tenant_id=None,
-               priority_class=None) -> RequestHandle:
+               priority_class=None, deadline=None,
+               prebilled_tokens=0) -> RequestHandle:
         """Enqueue one sequence; returns its `RequestHandle`.  Raises
         ValueError when the request can never fit (prompt+max_new over
         the engine's per-sequence or pool capacity) — feasibility is
@@ -892,11 +893,18 @@ class InferenceEngine:
         unservable request.  `tenant_id` names who the tenant ledger
         bills for this sequence's tokens/slot-time/page-seconds
         (ISSUE 16; None books under `anon`); `priority_class` orders
-        admission and preemption (ISSUE 18; None → the default
-        class)."""
+        admission and preemption (ISSUE 18; None → the default class);
+        `deadline` (absolute monotonic) lets admission shed a request
+        whose budget expired while queued with an honest
+        `deadline_exceeded` instead of prefilling dead work;
+        `prebilled_tokens` marks the first N accepted tokens as
+        already billed by a prior replica (ISSUE 20 mid-stream resume
+        — the decode books must conserve across the failover)."""
         seq = Sequence(input_ids, max_new_tokens,
                        eos_token_id=eos_token_id, request_id=request_id,
-                       tenant_id=tenant_id, priority_class=priority_class)
+                       tenant_id=tenant_id, priority_class=priority_class,
+                       deadline=deadline,
+                       prebilled_tokens=prebilled_tokens)
         need = -(-(seq.prompt.size + seq.max_new_tokens)
                  // self.config.page_size)
         if need > self.pool.capacity:
@@ -1360,7 +1368,13 @@ class InferenceEngine:
         seq.tokens.append(int(tok))
         if seq.timeline is not None:
             seq.timeline.token()
-        if self.tenant_ledger is not None:
+        if len(seq.tokens) <= seq.prebilled_tokens:
+            # resume verify token (ISSUE 20): the dead replica already
+            # billed this position — re-deriving it must not double a
+            # tenant's decode book (neither branch below runs, so
+            # engine.tokens and the per-tenant total stay in lockstep)
+            pass
+        elif self.tenant_ledger is not None:
             # the ledger incs engine.tokens INSIDE its lock so the
             # counter and per-tenant decode totals move atomically (a
             # concurrent snapshot can never see them skewed)

@@ -85,7 +85,8 @@ class Sequence:
 
     def __init__(self, input_ids, max_new_tokens, eos_token_id=None,
                  request_id=None, arrived_at=0.0, tenant_id=None,
-                 priority_class=None):
+                 priority_class=None, deadline=None,
+                 prebilled_tokens=0):
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         if ids.size < 1:
             raise ValueError("empty prompt")
@@ -103,6 +104,16 @@ class Sequence:
         self.priority_class = (_qos.normalize_class(priority_class)
                                or _qos.DEFAULT_CLASS)
         self.arrived_at = float(arrived_at)
+        # absolute monotonic instant (scheduler clock) after which this
+        # request is worthless to its client (ISSUE 20 / ROADMAP 4):
+        # admission sheds an already-expired sequence instead of
+        # prefilling work nobody will wait for
+        self.deadline = None if deadline is None else float(deadline)
+        # mid-stream failover billing (ISSUE 20): the first N accepted
+        # tokens were already billed by the replica that died — the
+        # resume replica re-derives them (the divergence check's verify
+        # token) but must not bill them again
+        self.prebilled_tokens = max(0, int(prebilled_tokens))
         self._page_mark = None       # last page-seconds charge instant
         self.timeline = None       # optional RequestTimeline (ISSUE 15)
         self.state = WAITING
@@ -444,7 +455,34 @@ class Scheduler:
             # resumes warm from the prefix cache, stream intact.
             prefills = []
             while self._waiting:
-                seq = self._admission_order_locked(self.clock())[0]
+                now = self.clock()
+                seq = self._admission_order_locked(now)[0]
+                if seq.deadline is not None and now >= seq.deadline:
+                    # engine-side deadline shed (ISSUE 20 satellite /
+                    # ROADMAP 4): the budget expired while queued —
+                    # prefilling now only steals pages from requests
+                    # someone still wants.  Honest reason, counted.
+                    self._waiting.remove(seq)
+                    self._by_id.pop(seq.request_id, None)
+                    seq.state = FINISHED
+                    seq.finish_reason = "deadline_exceeded"
+                    finished.append(seq)
+                    self._decide("deadline_shed",
+                                 request_id=seq.request_id,
+                                 waited_s=round(now - seq.arrived_at, 4),
+                                 **{"class": seq.priority_class})
+                    if seq.timeline is not None:
+                        seq.timeline.event("deadline_shed",
+                                           waited_s=round(
+                                               now - seq.arrived_at, 4))
+                    try:
+                        from ...observability import metrics as _metrics
+
+                        _metrics.inc("resilience.shed_requests",
+                                     reason="deadline_exceeded")
+                    except Exception:  # pt-lint: ok[PT005]
+                        pass           # (observability fan-out guard)
+                    continue
                 if len(self._running) >= self.max_slots:
                     victim = self._preempt_for_locked(seq)
                     if victim is None:

@@ -184,10 +184,13 @@ class ToyEngine:
             h._finish("cancelled")
 
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=None,
-               request_id=None, tenant_id=None, priority_class=None):
-        # priority_class is accepted for signature parity with the real
-        # engine (serving passes it through uniformly); the toy engine
-        # has no scheduler to preempt, so it only records the label
+               request_id=None, tenant_id=None, priority_class=None,
+               deadline=None, prebilled_tokens=0):
+        # priority_class / deadline / prebilled_tokens are accepted for
+        # signature parity with the real engine (serving passes them
+        # through uniformly); the toy engine has no scheduler to
+        # preempt or shed, so it honors only the billing marker —
+        # chaos gates the conservation invariant against toy books too
         ids = [int(x) for x in np.asarray(input_ids).reshape(-1)]
         if not ids:
             raise ValueError("empty input_ids")
@@ -211,7 +214,10 @@ class ToyEngine:
                         time.sleep(self.token_time)
                     tok = toy_token(ids, i)
                     h.tokens.append(tok)
-                    if self.tenant_ledger is not None:
+                    if i < int(prebilled_tokens):
+                        pass  # resume verify token: billed by the
+                        # replica that died (ISSUE 20), never twice
+                    elif self.tenant_ledger is not None:
                         self.tenant_ledger.record_decode(tenant_id)
                     h._q.put(tok)
                     if eos_token_id is not None and tok == eos_token_id:

@@ -40,13 +40,19 @@ import os
 __all__ = [
     "CLASSES", "DEFAULT_CLASS", "class_rank", "normalize_class",
     "class_map_from_env", "resolve_class", "retry_after_factor",
-    "class_weight", "ENV_CLASS_MAP",
+    "class_weight", "ENV_CLASS_MAP", "ENV_RESUME_CLASSES",
+    "resume_classes_from_env",
 ]
 
 # strict order, highest first — rank = distance from the end
 CLASSES = ("paid", "free", "batch")
 DEFAULT_CLASS = "free"
 ENV_CLASS_MAP = "PADDLE_TPU_QOS_CLASSES"
+# which classes the router's mid-stream resume (ISSUE 20) serves:
+# comma-separated class names; unset/empty = every class.  The knob
+# exists so an operator can declare `batch` streams not worth the
+# resume re-prefill — they fall back to the clean `interrupted` record
+ENV_RESUME_CLASSES = "PADDLE_TPU_STREAM_RESUME_CLASSES"
 
 _RANK = {c: len(CLASSES) - 1 - i for i, c in enumerate(CLASSES)}
 
@@ -105,6 +111,23 @@ def class_map_from_env(env=None) -> list:
             continue
         rules.append((pattern, cls))
     return rules
+
+
+def resume_classes_from_env(env=None) -> frozenset:
+    """Parse `PADDLE_TPU_STREAM_RESUME_CLASSES` into the set of classes
+    eligible for mid-stream resume (ISSUE 20).  Unset or empty means
+    ALL classes; unknown names are dropped (validate-or-drop, like
+    every class input) — and if every entry is garbage the policy
+    falls back to all-classes rather than silently disabling resume
+    fleet-wide on a typo."""
+    raw = (env if env is not None
+           else os.environ.get(ENV_RESUME_CLASSES, "")) or ""
+    if not raw.strip():
+        return frozenset(CLASSES)
+    picked = frozenset(
+        c for c in (normalize_class(p) for p in raw.split(","))
+        if c is not None)
+    return picked or frozenset(CLASSES)
 
 
 def resolve_class(tenant_id=None, explicit=None, rules=None):

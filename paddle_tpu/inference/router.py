@@ -21,10 +21,19 @@ Routing (docs/SERVING.md):
     `heartbeat_miss_k` heartbeats is skipped/ejected; in-flight
     non-streamed requests transparently retry on a healthy replica
     under the SAME `X-Request-Id` (ISSUE 7 discipline).  Streamed
-    `/generate` requests fail over only while ZERO tokens have been
-    delivered; after that the client gets one clean `interrupted`
+    `/generate` requests fail over freely while ZERO tokens have been
+    delivered; once tokens ARE delivered, a replica loss triggers a
+    deterministic mid-stream RESUME (ISSUE 20): the router resubmits
+    `prompt + delivered[:-1]` to another replica (valid by the greedy
+    determinism contract), requires the leg's first token to reproduce
+    `delivered[-1]` (divergence check, token swallowed, billed
+    nowhere), then keeps streaming — zero replay, same request id,
+    `"resumed": n` on the final record.  Resume is bounded
+    (`stream_resume_max` legs), deadline-aware and class-gated; any
+    refusal or divergence falls back LOUDLY to one clean `interrupted`
     record carrying the resumable `output_ids` prefix — never replayed
-    tokens (`InferenceClient` raises `StreamInterrupted`).
+    tokens (`InferenceClient` raises `StreamInterrupted`, or resumes
+    client-side itself with `resume=True`).
   * **drain-aware** — `mark_draining()` stops routing BEFORE the
     replica's own drain begins (the fleet calls it ahead of SIGTERM, so
     clients never see a thundering herd of 503s); a replica whose
@@ -37,13 +46,19 @@ Routing (docs/SERVING.md):
 Telemetry: `router.replicas{state=up|draining|ejected|down}` and
 `router.capacity{endpoint}` gauges (live routable capacity, ISSUE 14),
 `router.failovers` / `router.ejections` / `router.readmissions` and
-`router.requests{endpoint,status}` counters (attach() schema), and
-`router.request`/`router.forward` spans carrying request identity.
+`router.requests{endpoint,status}` counters (attach() schema),
+`router.stream_resumes{outcome=ok|diverged|exhausted}` counters with
+the `router.resume_gap_ms` histogram attributing the client-visible
+resume seam, and `router.request`/`router.forward` spans carrying
+request identity.
 The router also keeps a fleet-level `SLOTracker` (`router.slo`) fed
 from every finished edge request — sheds and unsaved failures burn
 budget here even when each replica's own ledger is clean; its burn
 rate is the `inference.autoscaler.Autoscaler`'s primary scale signal.
-Fault point `router.forward` fires per forward attempt (chaos).
+Fault points: `router.forward` fires per forward attempt,
+`router.stream_read` per streamed line read (severs a stream
+mid-flight deterministically), `router.resume_verify` at the
+divergence check (forces the loud fallback) — all chaos-drivable.
 
 Prefix-affinity routing (ISSUE 13, docs/SERVING.md): /generate
 requests may carry an `X-Prefix-Fingerprint` header (the client's
@@ -63,6 +78,8 @@ Env knobs (read when the matching ctor arg is None):
   PADDLE_TPU_HEARTBEAT_MISS_K   probes/beats missed before ejection (3)
   PADDLE_TPU_FAILOVER_RETRIES   extra replicas tried per request    (2)
   PADDLE_TPU_ROUTER_AFFINITY_SLACK  affine-pick load slack       (0.25)
+  PADDLE_TPU_STREAM_RESUME_MAX      mid-stream resume legs/stream  (2)
+  PADDLE_TPU_STREAM_RESUME_CLASSES  classes served by resume     (all)
 
 Transport and clock are injectable — unit tests drive the whole state
 machine with fake replicas and no sockets (tests/test_router.py).
@@ -101,6 +118,7 @@ _REPLICA_STATES = ("up", "draining", "ejected", "down")
 ROUTER_SERIES = (
     "router.requests", "router.capacity",
     "router.replicas{state=up}", "router.failovers",
+    "router.stream_resumes",
     "serving.inflight", "serving.queue_depth",
     "autoscaler.replicas{state=actual}",
 )
@@ -241,7 +259,8 @@ class Router:
                  max_inflight=None, queue_depth=None, transport=None,
                  heartbeats=None, clock=time.monotonic,
                  breaker_threshold=3, breaker_reset=2.0,
-                 affinity_slack=None):
+                 affinity_slack=None, stream_resume_max=None,
+                 stream_resume_classes=None):
         if heartbeat_miss_k is None:
             heartbeat_miss_k = _env_num("PADDLE_TPU_HEARTBEAT_MISS_K",
                                         3, int)
@@ -251,8 +270,20 @@ class Router:
         if affinity_slack is None:
             affinity_slack = _env_num(
                 "PADDLE_TPU_ROUTER_AFFINITY_SLACK", 0.25, float)
+        if stream_resume_max is None:
+            stream_resume_max = _env_num("PADDLE_TPU_STREAM_RESUME_MAX",
+                                         2, int)
         self.heartbeat_miss_k = max(1, int(heartbeat_miss_k))
         self.failover_retries = max(0, int(failover_retries))
+        # mid-stream failover (ISSUE 20): how many resume legs one
+        # /generate stream may consume, and which QoS classes are worth
+        # the resume re-prefill at all (unset = every class)
+        self.stream_resume_max = max(0, int(stream_resume_max))
+        self.stream_resume_classes = (
+            _qos.resume_classes_from_env()
+            if stream_resume_classes is None
+            else frozenset(_qos.normalize_class(c)
+                           for c in stream_resume_classes) - {None})
         self.affinity_slack = max(0.0, float(affinity_slack))
         self._affinity = OrderedDict()  # fingerprint -> rid (LRU)
         self.probe_interval = float(probe_interval)
@@ -516,10 +547,11 @@ class Router:
                     n = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(n)
                     try:
+                        parsed = json.loads(body or b"{}")
                         prompt = [int(x) for x in
-                                  json.loads(body or b"{}")
-                                  .get("input_ids", [])]
+                                  parsed.get("input_ids", [])]
                     except Exception:
+                        parsed = {}
                         prompt = []  # replica will 400 it; no prefix
                     # prefix-affinity fingerprint: the client's header
                     # wins; otherwise derive one from the parsed prompt
@@ -558,7 +590,10 @@ class Router:
                     try:
                         status = router.forward_generate(
                             body, prompt, ctx, self,
-                            fingerprint=fingerprint)
+                            fingerprint=fingerprint,
+                            max_new_tokens=parsed.get(
+                                "max_new_tokens"),
+                            eos_token_id=parsed.get("eos_token_id"))
                     except Exception as e:
                         # best effort: before any stream bytes this is
                         # a clean 500; afterwards the socket just
@@ -1068,18 +1103,32 @@ class Router:
             breaker.record_success()
 
     def forward_generate(self, body, prompt_ids, ctx, handler,
-                         fingerprint=None):
+                         fingerprint=None, max_new_tokens=None,
+                         eos_token_id=None):
         """Proxy one /generate stream to the client behind `handler`.
 
-        Failover contract (ISSUE 9 (b)): attempts rotate replicas
-        under ONE request id while ZERO token lines have been written
-        to the client; the moment one token is delivered, a replica
-        failure turns into a single clean `interrupted` record carrying
-        `output_ids` = prompt + delivered tokens (the resumable
-        prefix) — the stream NEVER replays a token.  Returns the
-        request's status label.  `fingerprint` biases the pick toward
-        the prefix-affine replica (see `_pick`); the header rides
-        through to the replica untouched."""
+        Failover contract (ISSUE 9 (b) + ISSUE 20): attempts rotate
+        replicas under ONE request id while ZERO token lines have been
+        written to the client.  Once tokens ARE delivered, a replica
+        failure triggers a deterministic mid-stream RESUME: the router
+        resubmits `prompt + delivered[:-1]` as the next leg's prompt
+        (valid by the greedy determinism contract — delivered tokens
+        are the argmax continuations) with the budget reduced
+        accordingly, still under the same request id; the resume
+        replica tail-prefills (usually a prefix-cache hit) and must
+        reproduce `delivered[-1]` as its FIRST token — the divergence
+        check.  The verify token is swallowed (the client already has
+        it), so the stream continues from token N with zero replay and
+        no client-visible seam beyond latency; the final record gains
+        a `"resumed": n` field.  Resume is bounded
+        (`stream_resume_max` legs), deadline-aware (never past the
+        edge deadline) and class-gated (`stream_resume_classes`); any
+        refusal, divergence, or replica exhaustion falls back LOUDLY
+        to the single clean `interrupted` record carrying `output_ids`
+        = prompt + delivered tokens — the stream NEVER replays or
+        invents a token.  Returns the request's status label.
+        `fingerprint` biases every pick toward the prefix-affine
+        replica (see `_pick`); the header rides through untouched."""
         from ..resilience import faults as _faults
 
         hop = ctx.child()
@@ -1087,12 +1136,26 @@ class Router:
         headers.update(hop.to_headers())
         if fingerprint is not None:
             headers["X-Prefix-Fingerprint"] = str(fingerprint)
+        prompt_ids = [int(x) for x in prompt_ids]
+        max_new = max(1, int(max_new_tokens
+                             if max_new_tokens is not None else 32))
+        deadline_abs = self._deadline(ctx)
         tried: set = set()
         last_shed = None
         started = False          # client response headers sent?
         delivered: list = []     # token values already written out
+        resumes = 0              # resume legs begun (ISSUE 20)
+        verify_expect = None     # resume leg must reproduce this first
+        pending_ok = False       # resume leg awaiting its first token
+        last_token_at = None     # resume-gap clock anchor
+        cur_body = body          # current leg's request body
         attempts = self.failover_retries + 1
-        for attempt in range(attempts):
+        fresh_tries = 0
+        while True:
+            if not delivered and not started:
+                if fresh_tries >= attempts:
+                    break
+                fresh_tries += 1
             rid = self._pick("generate", exclude=tried,
                              fingerprint=fingerprint)
             if rid is None:
@@ -1101,16 +1164,18 @@ class Router:
             address = self._begin_forward(rid, "generate")
             if address is None:
                 continue
+            resuming = bool(delivered or started)
             sp = _trace.begin("router.forward", cat="router",
                               replica=rid, endpoint="generate",
-                              attempt=attempt, **ctx.trace_args())
+                              attempt=len(tried) - 1, resume=resumes,
+                              **ctx.trace_args())
             stream = None
             try:
                 _faults.fire("router.forward", replica=rid,
                              endpoint="generate")
                 self._breaker_allow(rid)
                 stream = self.transport.stream(
-                    address, "/generate", body, headers=headers,
+                    address, "/generate", cur_body, headers=headers,
                     timeout=self.request_timeout)
             except CircuitOpenError:
                 self._end_forward(rid, "generate")
@@ -1120,7 +1185,7 @@ class Router:
                 self._forward_failed(rid, e)
                 self._end_forward(rid, "generate")
                 _trace.end(sp)
-                if attempt < attempts - 1:
+                if not resuming and fresh_tries < attempts:
                     _metrics.inc("router.failovers")
                 continue
             try:
@@ -1128,10 +1193,18 @@ class Router:
                 if stream.status in (429, 503):
                     data = stream.read_body()
                     self._maybe_mark_draining(rid, data)
-                    last_shed = (stream.status, dict(stream.headers),
-                                 data)
-                    continue
+                    if not resuming:
+                        last_shed = (stream.status,
+                                     dict(stream.headers), data)
+                    continue  # a shed resume leg: try the next replica
                 if stream.status != 200:
+                    if resuming:
+                        # a deterministic 4xx/5xx on the ROUTER-built
+                        # resume body is a fleet problem, not a client
+                        # one: fall back to the interrupted record
+                        raise ReplicaUnreachable(
+                            f"{rid}: resume leg answered "
+                            f"{stream.status}")
                     # deterministic replica answer (400 etc.): pass
                     # through — it would fail identically anywhere
                     data = stream.read_body()
@@ -1143,18 +1216,67 @@ class Router:
                 while True:
                     # replica-read and client-write failures MUST be
                     # told apart (both raise OSError subclasses): a
-                    # dead replica fails over / interrupts cleanly, a
-                    # dead client cancels upstream — so the two I/O
-                    # directions get separate try blocks
+                    # dead replica fails over / resumes / interrupts
+                    # cleanly, a dead client cancels upstream — so the
+                    # two I/O directions get separate try blocks
                     try:
                         line = next(lines)
+                        _faults.fire("router.stream_read", replica=rid,
+                                     delivered=len(delivered))
                     except StopIteration:
                         break
-                    except (OSError, http.client.HTTPException) as e:
+                    except (_faults.InjectedFault, OSError,
+                            http.client.HTTPException) as e:
                         raise ReplicaUnreachable(
                             f"{rid}: {type(e).__name__}: {e}") from e
                     if not line.strip():
                         continue
+                    evt = _safe_json(line)
+                    has_token = "token" in evt
+                    if verify_expect is not None and has_token:
+                        # divergence check (ISSUE 20): the resume
+                        # leg's first token re-derives delivered[-1];
+                        # it is swallowed either way — the client
+                        # already has it, and a mismatch must fall
+                        # back to the clean interrupted record, never
+                        # stream a wrong token
+                        got = int(evt["token"])
+                        injected = False
+                        try:
+                            _faults.fire("router.resume_verify",
+                                         replica=rid, got=got)
+                        except _faults.InjectedFault:
+                            injected = True
+                        if injected or got != verify_expect:
+                            _metrics.inc("router.stream_resumes",
+                                         outcome="diverged")
+                            self._note("router.resume_diverged",
+                                       replica=rid,
+                                       expected=int(verify_expect),
+                                       got=got, injected=injected,
+                                       delivered=len(delivered))
+                            return self._interrupt_stream(
+                                handler, ctx, rid, prompt_ids,
+                                delivered,
+                                "resume diverged from delivered "
+                                "prefix")
+                        verify_expect = None
+                        self._resume_established(
+                            rid, last_token_at, len(delivered))
+                        last_token_at = self.clock()
+                        continue   # swallowed: the client has it
+                    if pending_ok and has_token:
+                        # resume leg with nothing to verify (the break
+                        # landed between headers and the first token):
+                        # established at its first real token
+                        pending_ok = False
+                        self._resume_established(
+                            rid, last_token_at, len(delivered))
+                    if evt.get("done") and resumes:
+                        # the client learns its stream absorbed
+                        # failovers (loadgen counts resumed_streams)
+                        evt["resumed"] = resumes
+                        line = json.dumps(evt).encode() + b"\n"
                     try:
                         if not started:
                             started = True
@@ -1175,9 +1297,9 @@ class Router:
                                    replica=rid,
                                    error=f"{type(e).__name__}: {e}")
                         return "client_error"
-                    evt = _safe_json(line)
-                    if "token" in evt:
+                    if has_token:
                         delivered.append(int(evt["token"]))
+                        last_token_at = self.clock()
                     if evt.get("done"):
                         done_seen = True
                         break
@@ -1191,34 +1313,48 @@ class Router:
                     http.client.HTTPException) as e:
                 self._forward_failed(rid, e)
                 if not delivered and not started:
-                    if attempt < attempts - 1:
+                    if fresh_tries < attempts:
                         _metrics.inc("router.failovers")
                     continue  # zero tokens delivered: safe to fail over
-                # tokens already delivered: one clean interrupted
-                # record with the resumable prefix, never a replay
-                final = {
-                    "interrupted": True,
-                    "error": f"replica failed mid-stream: "
-                             f"{type(e).__name__}",
-                    "finish_reason": "replica_lost",
-                    "request_id": ctx.request_id,
-                    "tokens_delivered": len(delivered),
-                    "output_ids": list(prompt_ids) + delivered,
-                }
-                try:
-                    handler.wfile.write(
-                        json.dumps(final).encode() + b"\n")
-                    handler.wfile.flush()
-                except (BrokenPipeError, ConnectionError, OSError):  # pt-lint: ok[PT005]
-                    pass  # client gone too: nothing left to tell it
-                self._note("router.stream_interrupted", replica=rid,
-                           delivered=len(delivered))
-                return "interrupted"
+                # tokens already delivered: deterministic mid-stream
+                # resume (ISSUE 20), bounded / deadline- / class-gated
+                refusal = self._resume_refusal(ctx, resumes,
+                                               deadline_abs)
+                if refusal is not None:
+                    _metrics.inc("router.stream_resumes",
+                                 outcome="exhausted")
+                    self._note("router.resume_refused", replica=rid,
+                               reason=refusal,
+                               delivered=len(delivered))
+                    return self._interrupt_stream(
+                        handler, ctx, rid, prompt_ids, delivered,
+                        f"replica failed mid-stream: "
+                        f"{type(e).__name__}")
+                resumes += 1
+                cur_body, verify_expect = self._resume_body(
+                    prompt_ids, delivered, max_new, eos_token_id,
+                    resumes)
+                pending_ok = verify_expect is None
+                if last_token_at is None:
+                    last_token_at = self.clock()
+                self._note("router.stream_resume", replica=rid,
+                           leg=resumes, delivered=len(delivered),
+                           error=f"{type(e).__name__}: {e}")
+                continue
             finally:
                 self._end_forward(rid, "generate")
                 _trace.end(sp)
                 if stream is not None:
                     stream.close()
+        if started or delivered:
+            # mid-stream loss with no replica left to resume on
+            _metrics.inc("router.stream_resumes", outcome="exhausted")
+            self._note("router.resume_refused", reason="no_replica",
+                       delivered=len(delivered))
+            return self._interrupt_stream(
+                handler, ctx, None, prompt_ids, delivered,
+                "replica failed mid-stream: no replica available "
+                "for resume")
         # nothing started: we can still answer with a clean status
         try:
             code, hdrs, data = self._no_replica_shed(last_shed)
@@ -1232,6 +1368,89 @@ class Router:
                       headers=[("Retry-After", hdrs["Retry-After"])]
                       if "Retry-After" in hdrs else ())
         return "shed"
+
+    # --- mid-stream resume internals (ISSUE 20) -----------------------
+    def _resume_refusal(self, ctx, resumes, deadline_abs):
+        """Why a mid-stream resume must NOT be attempted, or None when
+        it may: budget spent, class not served, or the edge deadline
+        already passed (resuming a stream nobody will wait for only
+        burns a tail-prefill)."""
+        if resumes >= self.stream_resume_max:
+            return "budget"
+        cls = ctx.priority_class or _qos.DEFAULT_CLASS
+        if cls not in self.stream_resume_classes:
+            return "class"
+        if deadline_abs is not None and self.clock() >= deadline_abs:
+            return "deadline"
+        return None
+
+    @staticmethod
+    def _resume_body(prompt_ids, delivered, max_new, eos_token_id,
+                     leg):
+        """The resume leg's request body + the verify token.
+
+        `prompt + delivered[:-1]` is resubmitted as the prompt — by
+        the greedy determinism contract its argmax continuation is
+        exactly `delivered[-1]`, which the resume replica re-derives
+        as its first token (the divergence check; billed nowhere,
+        `prebilled_tokens=1`).  The budget grows by that one verify
+        token so the stream still ends at the original `max_new` —
+        including the edge where every budgeted token was already
+        delivered and only the final record was lost (a one-token
+        leg that finishes `length`/`eos` immediately)."""
+        if delivered:
+            ids = list(prompt_ids) + [int(t) for t in delivered[:-1]]
+            budget = max_new - len(delivered) + 1
+            verify = int(delivered[-1])
+        else:
+            # broke between the response headers and the first token:
+            # a plain full-budget resubmit, nothing to verify
+            ids = list(prompt_ids)
+            budget = max_new
+            verify = None
+        body = {"input_ids": ids,
+                "max_new_tokens": max(1, int(budget)),
+                "resume": int(leg),
+                "prebilled_tokens": 0 if verify is None else 1}
+        if eos_token_id is not None:
+            body["eos_token_id"] = int(eos_token_id)
+        return json.dumps(body).encode(), verify
+
+    def _resume_established(self, rid, last_token_at, n_delivered):
+        """A resume leg reconnected the stream: count it and attribute
+        the client-visible gap (last delivered token -> the resumed
+        leg's verify/first token)."""
+        _metrics.inc("router.stream_resumes", outcome="ok")
+        gap_ms = None
+        if last_token_at is not None:
+            gap_ms = max(0.0, (self.clock() - last_token_at) * 1e3)
+            _metrics.observe("router.resume_gap_ms", gap_ms)
+        self._note("router.stream_resumed", replica=rid,
+                   delivered=n_delivered,
+                   gap_ms=None if gap_ms is None
+                   else round(gap_ms, 3))
+
+    def _interrupt_stream(self, handler, ctx, rid, prompt_ids,
+                          delivered, why):
+        """The LOUD fallback: one clean `interrupted` record carrying
+        the resumable prefix — never a replayed or invented token."""
+        final = {
+            "interrupted": True,
+            "error": why,
+            "finish_reason": "replica_lost",
+            "request_id": ctx.request_id,
+            "tokens_delivered": len(delivered),
+            "output_ids": list(prompt_ids) + [int(t)
+                                              for t in delivered],
+        }
+        try:
+            handler.wfile.write(json.dumps(final).encode() + b"\n")
+            handler.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError):  # pt-lint: ok[PT005]
+            pass  # client gone too: nothing left to tell it
+        self._note("router.stream_interrupted", replica=rid,
+                   delivered=len(delivered))
+        return "interrupted"
 
     # ------------------------------------------------------------------
     # telemetry

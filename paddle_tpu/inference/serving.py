@@ -512,6 +512,13 @@ class InferenceServer:
                             raise ValueError("empty input_ids")
                         max_new = int(req.get("max_new_tokens", 32))
                         eos = req.get("eos_token_id")
+                        # mid-stream failover resume (ISSUE 20): the
+                        # router resubmits prompt+delivered under the
+                        # same request id; `prebilled_tokens` marks the
+                        # verify token the dead replica already billed
+                        is_resume = bool(req.get("resume"))
+                        prebilled = max(0, int(req.get(
+                            "prebilled_tokens", 0)))
                     except Exception as e:
                         status = "client_error"
                         return self._json(
@@ -550,7 +557,9 @@ class InferenceServer:
                             eos_token_id=eos,
                             request_id=ctx.request_id,
                             tenant_id=ctx.tenant_id,
-                            priority_class=ctx.priority_class)
+                            priority_class=ctx.priority_class,
+                            deadline=deadline,
+                            prebilled_tokens=prebilled)
                     except _DETERMINISTIC_ERRORS as e:
                         status = "client_error"
                         return self._json(
@@ -595,15 +604,26 @@ class InferenceServer:
                                 # (docs/OBSERVABILITY.md, ISSUE 13)
                                 first_at = time.perf_counter()
                                 ttft_ms = (first_at - t_req) * 1e3
+                                cache_state = getattr(
+                                    handle, "cache_state",
+                                    "miss") or "miss"
                                 _metrics.observe(
                                     "serving.ttft_ms", ttft_ms,
                                     endpoint="generate",
                                     # getattr: engine duck-types
                                     # (ToyEngine) may predate the
                                     # prefix cache — label them miss
-                                    cache=getattr(handle,
-                                                  "cache_state",
-                                                  "miss") or "miss")
+                                    cache=cache_state)
+                                if is_resume:
+                                    # ISSUE 20 acceptance: resumed
+                                    # streams should tail-prefill off
+                                    # the radix index — this label is
+                                    # the direct evidence (hit/partial
+                                    # = the failover cost only the
+                                    # uncached tail)
+                                    _metrics.inc(
+                                        "serving.resume_prefill",
+                                        cache=cache_state)
                                 _metrics.observe(
                                     "serving.phase_ms", ttft_ms,
                                     phase="first_token",
@@ -1198,30 +1218,35 @@ class InferenceClient:
         return min(max(ra, 0.05), self.max_retry_wait)
 
     def generate(self, input_ids, max_new_tokens=32, eos_token_id=None,
-                 on_token=None) -> dict:
+                 on_token=None, resume=False) -> dict:
         """Stream one sequence through POST /generate.
 
         Tokens are consumed INCREMENTALLY off the ndjson stream —
         `on_token(tok)` (optional) fires for each as it arrives, before
         the generation finishes.  Returns the final record:
         ``{"output_ids": np.int32 array, "tokens": [...],
-        "finish_reason": ..., "request_id": ...}``.
+        "finish_reason": ..., "request_id": ..., "resumed": n}``
+        (`resumed` counts router-side mid-stream failovers this stream
+        absorbed, ISSUE 20 — 0 on the common path).
 
         Retry discipline (ISSUE 7): ONE request identity is minted
         BEFORE the retry loop — a 429/503 shed retries under the same
         `X-Request-Id` (honoring Retry-After, capped), so server spans
         and the engine's sequence correlate every attempt.  Sheds can
         only happen before the stream starts (the status line is the
-        admission decision), so retrying never replays tokens."""
-        import urllib.error
-        import urllib.request
+        admission decision), so retrying never replays tokens.
 
-        body = {"input_ids": [int(x) for x in
-                              np.asarray(input_ids).reshape(-1)],
-                "max_new_tokens": int(max_new_tokens)}
-        if eos_token_id is not None:
-            body["eos_token_id"] = int(eos_token_id)
-        data = json.dumps(body).encode()
+        With ``resume=True`` (ISSUE 20 satellite, default off): a
+        `StreamInterrupted` — the router's resume-EXHAUSTED fallback —
+        is absorbed by re-issuing the carried `output_ids` prefix as
+        the next leg's prompt under the SAME request id, with
+        `max_new_tokens` reduced by what already arrived (the greedy
+        determinism contract makes the delivered tokens the prompt's
+        true continuation).  Bounded by `PADDLE_TPU_STREAM_RESUME_MAX`
+        legs; when the budget runs out the final `StreamInterrupted`
+        propagates carrying the FULL merged token prefix."""
+        ids = [int(x) for x in np.asarray(input_ids).reshape(-1)]
+        max_new = int(max_new_tokens)
         amb = _rtrace.current()
         ctx = amb.child() if amb is not None else _rtrace.new_context()
         if ctx.tenant_id is None and self.tenant_id is not None:
@@ -1234,6 +1259,57 @@ class InferenceClient:
             ctx.priority_class = self.priority_class  # ambient hop wins
         if ctx.deadline_ms is None and self.deadline_ms is not None:
             ctx.deadline_ms = self.deadline_ms
+        legs = (_env_num("PADDLE_TPU_STREAM_RESUME_MAX", 2, int)
+                if resume else 0)
+        legs_used = 0
+        prior: list = []           # tokens delivered by earlier legs
+        cur_ids, cur_max = ids, max_new
+        while True:
+            try:
+                out = self._generate_attempt(cur_ids, cur_max,
+                                             eos_token_id, on_token,
+                                             ctx)
+            except StreamInterrupted as e:
+                delivered = list(e.tokens)
+                if legs_used >= legs or e.output_ids is None:
+                    # resume off / budget spent: surface the FULL
+                    # merged resumable prefix, not just this leg's
+                    e.tokens = prior + delivered
+                    raise
+                legs_used += 1
+                prior.extend(delivered)
+                cur_ids = [int(x) for x in e.output_ids]
+                cur_max = cur_max - len(delivered)
+                if cur_max < 1:
+                    # every budgeted token already arrived; only the
+                    # final record was lost — synthesize it (greedy
+                    # contract: the delivered prefix IS the answer)
+                    return {
+                        "output_ids": np.asarray(cur_ids, np.int32),
+                        "tokens": prior,
+                        "finish_reason": "length",
+                        "request_id": e.request_id or ctx.request_id,
+                        "tenant_id": ctx.tenant_id,
+                        "resumed": legs_used,
+                    }
+                continue
+            out["tokens"] = prior + out["tokens"]
+            out["resumed"] = int(out.get("resumed", 0) or 0) + legs_used
+            return out
+
+    def _generate_attempt(self, ids, max_new_tokens, eos_token_id,
+                          on_token, ctx) -> dict:
+        """One /generate leg under an existing request identity: the
+        pre-ISSUE-20 generate() body.  Raises StreamInterrupted with
+        THIS leg's delivered tokens; generate() merges legs."""
+        import urllib.error
+        import urllib.request
+
+        body = {"input_ids": [int(x) for x in ids],
+                "max_new_tokens": int(max_new_tokens)}
+        if eos_token_id is not None:
+            body["eos_token_id"] = int(eos_token_id)
+        data = json.dumps(body).encode()
         headers = {"Content-Type": "application/json"}
         headers.update(ctx.to_headers())
         if self.fingerprint_tokens:
@@ -1310,6 +1386,10 @@ class InferenceClient:
                 "finish_reason": final.get("finish_reason"),
                 "request_id": final.get("request_id"),
                 "tenant_id": ctx.tenant_id,
+                # router-side mid-stream failovers absorbed (ISSUE 20):
+                # 0 on the common path, stamped on the final record by
+                # the router when a resume leg served part of the stream
+                "resumed": int(final.get("resumed", 0) or 0),
             }
 
     def predict(self, *arrays, **named) -> dict:

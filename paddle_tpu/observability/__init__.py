@@ -65,7 +65,8 @@ _SCHEMA_COUNTERS = tuple(
     + [("resilience.faults", {"point": p})
        for p in ("checkpoint.write", "collective.call", "dataloader.batch",
                  "jit.compile", "train.step", "serving.request",
-                 "store.op", "router.forward", "replica.crash")]
+                 "store.op", "router.forward", "router.stream_read",
+                 "router.resume_verify", "replica.crash")]
     + [("resilience.retries", {"policy": p})
        for p in ("collective", "elastic.heartbeat", "serving",
                  "dataloader", "jit.compile")]
@@ -82,7 +83,7 @@ _SCHEMA_COUNTERS = tuple(
     # preemption signals by name, emergency checkpoints, serving drains
     + [("resilience.shed_requests", {"reason": r})
        for r in ("queue_full", "queue_timeout", "deadline", "draining",
-                 "no_replicas")]
+                 "no_replicas", "deadline_exceeded")]
     # multi-tenant QoS (ISSUE 18): per-class shed and preemption
     # counters — the class set mirrors inference.qos.CLASSES (hardcoded
     # here: observability stays standalone, same discipline as
@@ -123,6 +124,13 @@ _SCHEMA_COUNTERS = tuple(
     + [("router.requests", {"endpoint": ep, "status": s})
        for ep in ("predict", "generate")
        for s in ("ok", "client_error", "shed", "interrupted", "error")]
+    # mid-stream failover (ISSUE 20): router-side resume outcomes and
+    # the replica-side resume-prefill cache attribution — a healthy
+    # fleet shows zeros, never absent keys
+    + [("router.stream_resumes", {"outcome": o})
+       for o in ("ok", "diverged", "exhausted")]
+    + [("serving.resume_prefill", {"cache": c})
+       for c in ("hit", "partial", "miss")]
     # prefix caching (ISSUE 13): admission-time cache outcomes and LRU
     # reclaims on the engine side, affinity pick outcomes on the router
     # side (counted only for fingerprinted /generate requests)
@@ -209,6 +217,10 @@ _SCHEMA_GAUGES = ("serving.inflight", "serving.queue_depth",
 # the first observation — the ITL acceptance surface (ISSUE 15).
 _SCHEMA_HISTS = (
     ("serving.itl_ms", {"endpoint": "generate"}),
+    # mid-stream failover (ISSUE 20): the client-visible gap between
+    # the last token the dead replica delivered and the first token
+    # the resume replica delivered — THE latency cost of a resume
+    ("router.resume_gap_ms", {}),
 )
 
 

@@ -16,6 +16,14 @@ Named fault points (instrumented call sites `fire()` these):
   serving.request    inference/serving.py           per predict call
   store.op           distributed/fleet/elastic.py   heartbeat store traffic
   router.forward     inference/router.py            per forward attempt
+  router.stream_read inference/router.py            per streamed /generate
+                     line read off a replica (an injection here severs
+                     the stream mid-flight — the deterministic stand-in
+                     for a replica dying with tokens delivered)
+  router.resume_verify inference/router.py          per resume
+                     first-token divergence check (an injection forces
+                     the mismatch branch — the loud `interrupted`
+                     fallback, never a wrong token)
   replica.crash      inference/fleet.py             replica main loop tick
                      (kind="error" → the replica exits non-zero; any
                      other kind → immediate os._exit, a simulated
@@ -49,7 +57,8 @@ __all__ = [
 FAULT_POINTS = (
     "checkpoint.write", "collective.call", "dataloader.batch",
     "jit.compile", "train.step", "serving.request", "store.op",
-    "router.forward", "replica.crash",
+    "router.forward", "router.stream_read", "router.resume_verify",
+    "replica.crash",
 )
 
 _ENV_SPEC = "PADDLE_TPU_FAULTS"

@@ -246,6 +246,142 @@ def test_scheduler_rejects_oversized_and_duplicate():
         sch.submit(_seq(2, rid="dup"))
 
 
+def test_scheduler_sheds_expired_deadline_at_admission(monkeypatch):
+    """Deadline shedding (ISSUE 20 satellite): a waiting sequence whose
+    deadline passed while queued is shed AT ADMISSION with the honest
+    `deadline_exceeded` finish reason — it never takes a slot or burns
+    a prefill the nobody-is-waiting-for answer would waste — while
+    sequences with live (or no) deadlines admit normally."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics
+
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    try:
+        clock = [0.0]
+        pool = PagePool(num_pages=64, page_size=4)
+        sch = Scheduler(2, pool, max_pages_per_seq=8,
+                        clock=lambda: clock[0])
+        late = Sequence(np.arange(1, 5, dtype=np.int32), 4,
+                        request_id="late", deadline=2.0)
+        live = Sequence(np.arange(1, 5, dtype=np.int32), 4,
+                        request_id="live", deadline=50.0)
+        plain = Sequence(np.arange(1, 5, dtype=np.int32), 4,
+                         request_id="plain")
+        for s in (late, live, plain):
+            sch.submit(s)
+        clock[0] = 5.0              # the queue outlived late's deadline
+        out = sch.schedule()
+        assert [s.request_id for s in out.prefills] == \
+            ["live", "plain"]
+        (shed,) = out.finished
+        assert shed.request_id == "late"
+        assert shed.finish_reason == "deadline_exceeded"
+        assert sch.waiting_sequences == 0
+        snap = metrics.snapshot()["counters"]
+        assert snap[
+            "resilience.shed_requests{reason=deadline_exceeded}"] == 1
+    finally:
+        obs.detach()
+        metrics.reset()
+
+
+def test_engine_deadline_shed_closes_handle(gpt_model):
+    """End to end through the engine: an expired-deadline submission
+    comes back as a finished handle with `deadline_exceeded` — a clean
+    final record for the serving layer, not a hang or a decode."""
+    import time as _time
+
+    eng = InferenceEngine(gpt_model, EngineConfig(
+        page_size=8, max_slots=2, max_seq_len=64))
+    h = eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4,
+                   request_id="expired",
+                   deadline=_time.monotonic() - 1.0)
+    for _ in range(50):
+        eng.step()
+        if h.done.is_set():
+            break
+    assert h.done.is_set()
+    assert h.finish_reason == "deadline_exceeded"
+    assert h.tokens == []           # no token was ever decoded
+    assert_drained(eng)
+
+
+def test_ledger_conservation_across_resume(gpt_model):
+    """Exactly-once billing across a mid-stream resume (ISSUE 20): the
+    dying replica's book keeps the tokens it delivered, the resume
+    replica bills only NEW tokens (its re-derived verify token rides in
+    prebilled — billed nowhere), and the fleet merge conserves decode
+    tokens and KV page-seconds — while the resumed output stays
+    bit-exact with the uninterrupted reference (greedy determinism)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.observability import tenant_ledger as tl
+
+    metrics.reset()
+    obs.attach(crash_hook=False)
+    try:
+        total = 8
+        prompt = np.arange(1, 9, dtype=np.int32)
+        ref = np.asarray(gpt_model.generate(
+            P.to_tensor(prompt[None, :], "int32"),
+            max_new_tokens=total)._value)[0]
+
+        # leg 1: "replica A" delivers a few tokens, then dies (cancel
+        # stands in for the kill — billing-wise identical)
+        eng_a = InferenceEngine(gpt_model, EngineConfig(
+            page_size=8, max_slots=2, max_seq_len=64))
+        assert eng_a.tenant_ledger is not None
+        h1 = eng_a.submit(prompt, max_new_tokens=total,
+                          tenant_id="t0", request_id="r1")
+        while len(h1.tokens) < 3 and not h1.done.is_set():
+            eng_a.step()
+        delivered = list(h1.tokens)
+        assert 3 <= len(delivered) < total
+        eng_a.cancel("r1")
+        eng_a.step()               # slot/pages release, books close
+
+        # leg 2: "replica B" tail-prefills prompt+delivered[:-1] and
+        # re-derives delivered[-1] as its first (prebilled) token
+        eng_b = InferenceEngine(gpt_model, EngineConfig(
+            page_size=8, max_slots=2, max_seq_len=64))
+        ids = np.concatenate(
+            [prompt, np.asarray(delivered[:-1], np.int32)])
+        h2 = eng_b.submit(ids,
+                          max_new_tokens=total - len(delivered) + 1,
+                          tenant_id="t0", request_id="r1",
+                          prebilled_tokens=1)
+        for _ in range(500):
+            eng_b.step()
+            if h2.done.is_set():
+                break
+        assert h2.done.is_set()
+        assert h2.tokens[0] == delivered[-1]    # the verify token
+        assert np.array_equal(h2.result(), ref)  # bit-exact splice
+
+        sa = eng_a.tenant_ledger.snapshot()
+        sb = eng_b.tenant_ledger.snapshot()
+        # each book billed its own leg; the verify token nowhere
+        assert sa["totals"]["decode_tokens"] == len(delivered)
+        assert sb["totals"]["decode_tokens"] == total - len(delivered)
+        fleet = tl.merge_snapshots([sa, sb])
+        assert fleet["totals"]["decode_tokens"] == total
+        assert fleet["tenants"]["t0"]["decode_tokens"] == total
+        assert tl.conservation_delta(fleet) == {}
+        # KV page-seconds accrued on BOTH legs; the merge is the sum
+        assert sa["totals"]["kv_page_seconds"] > 0
+        assert sb["totals"]["kv_page_seconds"] > 0
+        assert fleet["totals"]["kv_page_seconds"] == pytest.approx(
+            sa["totals"]["kv_page_seconds"]
+            + sb["totals"]["kv_page_seconds"])
+        # engine.tokens (both books share the process counter) agrees
+        assert metrics.snapshot()["counters"].get(
+            "engine.tokens", 0) == total
+    finally:
+        obs.detach()
+        metrics.reset()
+
+
 # ------------------------------ kernel ------------------------------
 
 def test_paged_attention_kernel_matches_reference():

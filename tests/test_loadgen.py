@@ -4,6 +4,7 @@ fingerprint parity with InferenceClient, misbehavior assignment,
 report accounting, and one small real-socket e2e against a toy
 InferenceServer (well-behaved + disconnecting + oversized clients,
 with token-replay verification active)."""
+import json
 import os
 import random
 import sys
@@ -133,6 +134,55 @@ def test_report_accounting():
     assert s["tokens_per_sec"] == pytest.approx(23 / 2.0)
     assert s["latency_ms"]["generate"]["n"] == 4  # ok rows only
     assert "generate:replayed:token 1 wrong" in s["failure_detail"]
+
+
+def test_report_counts_resumed_streams_as_real_oks():
+    """A stream the router resumed mid-flight (ISSUE 20) lands as a
+    REAL ok — counted in resumed_streams, never in admitted_failures —
+    while a resume that replayed a token is still a failure."""
+    rows = [
+        {"kind": "generate", "behavior": "well_behaved",
+         "status": "ok", "latency_s": 0.02, "tokens": 8,
+         "detail": None, "id": 0, "tenant": 0, "resumed": 1},
+        {"kind": "generate", "behavior": "well_behaved",
+         "status": "ok", "latency_s": 0.02, "tokens": 8,
+         "detail": None, "id": 1, "tenant": 0, "resumed": 0},
+        {"kind": "generate", "behavior": "well_behaved",
+         "status": "replayed", "latency_s": 0.02, "tokens": 3,
+         "detail": "token 2 wrong", "id": 2, "tenant": 0,
+         "resumed": 1},
+    ]
+    s = loadgen.LoadReport(rows, wall_s=1.0).summary()
+    assert s["ok"] == 2
+    assert s["resumed_streams"] == 2     # one ok + one failed resume
+    assert s["admitted_failures"] == 1   # the replay, nothing else
+
+
+def test_consume_stream_reads_resumed_from_done_record():
+    """The stream consumer extracts `resumed` from the final record and
+    still holds the exact-prefix bar for resumed streams."""
+    runner = loadgen.OpenLoopRunner("127.0.0.1:1",
+                                    loadgen.SharedPrefixWorkload())
+    prompt = [3, 4]
+    toks = [11, 12, 13]
+
+    def resp(final):
+        lines = [json.dumps({"token": t}).encode() + b"\n"
+                 for t in toks]
+        return iter(lines + [json.dumps(final).encode() + b"\n"])
+
+    spec = {"prompt": prompt, "behavior": "well_behaved",
+            "kind": "generate", "id": 0, "tenant": 0}
+    ok = runner._consume_stream(spec, resp(
+        {"done": True, "output_ids": prompt + toks, "resumed": 2}),
+        conn=None)
+    assert ok[0] == "ok" and ok[4] == 2
+    # a resumed stream with a corrupted final record is still caught
+    # (and still counts as resumed — the failure is not laundered)
+    bad = runner._consume_stream(spec, resp(
+        {"done": True, "output_ids": prompt + toks + [99],
+         "resumed": 1}), conn=None)
+    assert bad[0] == "replayed" and bad[4] == 1
 
 
 # --------------------------------------------------------------------------

@@ -39,9 +39,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(autouse=True)
 def _telemetry():
+    metrics.reset()
     obs.attach(crash_hook=False)
     yield
     obs.detach()
+    metrics.reset()
 
 
 class _Clock:
@@ -842,3 +844,363 @@ def test_fleet_chaos_scenario():
         sys.path.pop(0)
     report = chaos_check.run_fleet_chaos(seed=0)
     assert report["recovered"], report
+
+
+# --------------------------------------------------------------------------
+# deterministic mid-stream resume (ISSUE 20)
+# --------------------------------------------------------------------------
+
+def _pos_token(prompt, i):
+    """Position-only token fn: the greedy determinism contract in
+    miniature — any replica handed the delivered prefix re-derives the
+    SAME continuation (what the real engine guarantees via greedy
+    argmax), so a resume leg's first token matches the verify token."""
+    return (37 * (len(prompt) + i)) % 997
+
+
+class _ContractReplica(_FakeReplica):
+    """Fake replica honoring the greedy determinism contract AND the
+    resume request shape: obeys max_new_tokens, logs parsed bodies."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.bodies = []
+
+    def stream(self, path, body, headers):
+        if self.dead:
+            raise ReplicaUnreachable("fake replica down")
+        self.requests.append((path, dict(headers or {})))
+        req = json.loads(body or b"{}")
+        self.bodies.append(req)
+        prompt = req.get("input_ids", [])
+        n = int(req.get("max_new_tokens", self.stream_tokens))
+        toks = [_pos_token(prompt, i) for i in range(n)]
+        lines = [json.dumps({"token": t}).encode() + b"\n"
+                 for t in toks]
+        lines.append(json.dumps({
+            "done": True, "finish_reason": "length",
+            "output_ids": list(prompt) + toks}).encode() + b"\n")
+        return _FakeStream(200, lines,
+                           die_after=self.stream_die_after)
+
+
+def _eng(active):
+    return dict(max_slots=4, waiting_sequences=0,
+                active_sequences=active,
+                batch_occupancy=active / 4.0)
+
+
+def test_stream_mid_failure_resumes_on_survivor():
+    """The tentpole: a replica dying with 3 tokens delivered becomes
+    INVISIBLE — the router resubmits prompt+delivered[:-1] to the
+    survivor under the same request id, swallows the re-derived verify
+    token, and the client sees one seamless 8-token stream ending in a
+    done record (annotated `resumed: 1`), never an interrupted one."""
+    reps = {"a": _ContractReplica(engine=_eng(0)),
+            "b": _ContractReplica(engine=_eng(1))}
+    r = _router(reps, failover_retries=0, stream_resume_max=2)
+    try:
+        ctx = rtrace.new_context()
+        assert r._pick("generate") == "a"   # emptiest engine first
+        reps["a"].stream_die_after = 3      # 3 tokens out, then death
+        h = _FakeHandler()
+        prompt = [3, 4]
+        status = r.forward_generate(_gen_body(prompt), prompt, ctx, h,
+                                    max_new_tokens=8)
+        assert status == "ok"
+        lines = h.lines()
+        toks = [ln["token"] for ln in lines if "token" in ln]
+        # the full greedy stream, exactly once: no replay, no gap
+        assert toks == [_pos_token(prompt, i) for i in range(8)]
+        final = lines[-1]
+        assert final["done"] is True
+        assert final["resumed"] == 1
+        assert final["output_ids"] == prompt + toks
+        assert not any(ln.get("interrupted") for ln in lines)
+        # the resume leg's shape: delivered[:-1] resubmitted, budget
+        # reduced (+1 verify), the verify token billed nowhere
+        (leg,) = reps["b"].bodies
+        assert leg["input_ids"] == prompt + toks[:2]
+        assert leg["max_new_tokens"] == 8 - 3 + 1
+        assert leg["prebilled_tokens"] == 1
+        assert leg["resume"] == 1
+        # same request id end to end
+        assert reps["b"].requests[0][1]["X-Request-Id"] == \
+            ctx.request_id
+        snap = metrics.snapshot()
+        assert snap["counters"][
+            "router.stream_resumes{outcome=ok}"] == 1
+        assert snap["counters"].get("router.failovers", 0) == 0
+        assert snap["histograms"]["router.resume_gap_ms"]["count"] >= 1
+    finally:
+        _close(r)
+
+
+def test_stream_resume_divergence_falls_back_loudly():
+    """A resume leg whose first token does NOT re-derive delivered[-1]
+    must fall back to the clean interrupted record — the wrong token is
+    never streamed (replica b is toy_token-based: content-dependent, so
+    it diverges from the position-only contract replica)."""
+    reps = {"a": _ContractReplica(engine=_eng(0)),
+            "b": _FakeReplica(engine=_eng(1))}
+    r = _router(reps, failover_retries=0, stream_resume_max=2)
+    try:
+        ctx = rtrace.new_context()
+        assert r._pick("generate") == "a"
+        reps["a"].stream_die_after = 3
+        h = _FakeHandler()
+        prompt = [3, 4]
+        status = r.forward_generate(_gen_body(prompt), prompt, ctx, h,
+                                    max_new_tokens=8)
+        assert status == "interrupted"
+        lines = h.lines()
+        toks = [ln["token"] for ln in lines if "token" in ln]
+        # only the verified prefix was ever streamed
+        assert toks == [_pos_token(prompt, i) for i in range(3)]
+        final = lines[-1]
+        assert final["interrupted"] is True
+        assert final["output_ids"] == prompt + toks
+        assert final["tokens_delivered"] == 3
+        snap = metrics.snapshot()["counters"]
+        assert snap["router.stream_resumes{outcome=diverged}"] == 1
+        assert snap["router.stream_resumes{outcome=ok}"] == 0
+    finally:
+        _close(r)
+
+
+def test_resume_verify_fault_injection_forces_fallback():
+    """The faults-plane divergence drill: router.resume_verify injected
+    on an otherwise-healthy resume forces the loud fallback — the chaos
+    harness can rehearse divergence without a broken model."""
+    from paddle_tpu.resilience import faults
+
+    reps = {"a": _ContractReplica(engine=_eng(0)),
+            "b": _ContractReplica(engine=_eng(1))}
+    r = _router(reps, failover_retries=0, stream_resume_max=2)
+    try:
+        ctx = rtrace.new_context()
+        assert r._pick("generate") == "a"
+        reps["a"].stream_die_after = 3
+        h = _FakeHandler()
+        prompt = [3, 4]
+        with faults.inject("router.resume_verify"):
+            status = r.forward_generate(_gen_body(prompt), prompt,
+                                        ctx, h, max_new_tokens=8)
+        assert status == "interrupted"
+        final = h.lines()[-1]
+        assert final["interrupted"] is True
+        assert final["tokens_delivered"] == 3
+        snap = metrics.snapshot()["counters"]
+        assert snap["router.stream_resumes{outcome=diverged}"] == 1
+    finally:
+        faults.clear()
+        _close(r)
+
+
+def test_stream_resume_budget_exhausted_interrupts():
+    """Bounded resumption: with stream_resume_max=1, a SECOND
+    mid-stream death lands on the interrupted record carrying every
+    delivered token (both legs), and no third replica is tried."""
+    reps = {"a": _ContractReplica(engine=_eng(0)),
+            "b": _ContractReplica(engine=_eng(1)),
+            "c": _ContractReplica(engine=_eng(2))}
+    r = _router(reps, failover_retries=0, stream_resume_max=1)
+    try:
+        ctx = rtrace.new_context()
+        assert r._pick("generate") == "a"
+        reps["a"].stream_die_after = 3
+        reps["b"].stream_die_after = 3  # verify + 2 more, then death
+        h = _FakeHandler()
+        prompt = [3, 4]
+        status = r.forward_generate(_gen_body(prompt), prompt, ctx, h,
+                                    max_new_tokens=8)
+        assert status == "interrupted"
+        lines = h.lines()
+        toks = [ln["token"] for ln in lines if "token" in ln]
+        # 3 from leg 1, verify swallowed, 2 more from leg 2 — in order
+        assert toks == [_pos_token(prompt, i) for i in range(5)]
+        final = lines[-1]
+        assert final["interrupted"] is True
+        assert final["output_ids"] == prompt + toks
+        assert reps["c"].requests == []   # budget spent: no third leg
+        snap = metrics.snapshot()["counters"]
+        assert snap["router.stream_resumes{outcome=ok}"] == 1
+        assert snap["router.stream_resumes{outcome=exhausted}"] == 1
+    finally:
+        _close(r)
+
+
+def test_stream_resume_class_gated():
+    """An operator may declare batch streams not worth the resume
+    re-prefill: the class gate falls straight back to the interrupted
+    record without touching another replica."""
+    reps = {"a": _ContractReplica(engine=_eng(0)),
+            "b": _ContractReplica(engine=_eng(1))}
+    r = _router(reps, failover_retries=0, stream_resume_max=2,
+                stream_resume_classes=("paid", "free"))
+    try:
+        ctx = rtrace.new_context(priority_class="batch")
+        assert r._pick("generate") == "a"
+        reps["a"].stream_die_after = 3
+        h = _FakeHandler()
+        prompt = [3, 4]
+        status = r.forward_generate(_gen_body(prompt), prompt, ctx, h,
+                                    max_new_tokens=8)
+        assert status == "interrupted"
+        assert reps["b"].requests == []
+        snap = metrics.snapshot()["counters"]
+        assert snap["router.stream_resumes{outcome=exhausted}"] == 1
+    finally:
+        _close(r)
+
+
+def test_resume_refusal_reasons():
+    clock = _Clock()
+    reps = {"a": _FakeReplica()}
+    r = _router(reps, clock=clock, stream_resume_max=1,
+                stream_resume_classes=("paid",))
+    try:
+        paid = rtrace.new_context(priority_class="paid")
+        assert r._resume_refusal(paid, 0, None) is None
+        assert r._resume_refusal(paid, 1, None) == "budget"
+        # the default class (free) is outside the configured set
+        assert r._resume_refusal(rtrace.new_context(), 0, None) \
+            == "class"
+        clock.t = 100.0
+        assert r._resume_refusal(paid, 0, 99.0) == "deadline"
+        assert r._resume_refusal(paid, 0, 101.0) is None
+    finally:
+        _close(r)
+
+
+def test_resume_env_knobs(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_STREAM_RESUME_MAX", "5")
+    monkeypatch.setenv("PADDLE_TPU_STREAM_RESUME_CLASSES",
+                       "paid, BATCH, nonsense")
+    reps = {"a": _FakeReplica()}
+    r = _router(reps)
+    try:
+        assert r.stream_resume_max == 5
+        assert r.stream_resume_classes == frozenset({"paid", "batch"})
+    finally:
+        _close(r)
+
+
+def test_resume_schema_zeros_present_in_snapshot():
+    snap = metrics.snapshot()
+    c = snap["counters"]
+    for outcome in ("ok", "diverged", "exhausted"):
+        assert f"router.stream_resumes{{outcome={outcome}}}" in c
+    for cache in ("hit", "partial", "miss"):
+        assert f"serving.resume_prefill{{cache={cache}}}" in c
+    assert "resilience.shed_requests{reason=deadline_exceeded}" in c
+    assert "resilience.faults{point=router.stream_read}" in c
+    assert "resilience.faults{point=router.resume_verify}" in c
+    assert "router.resume_gap_ms" in snap["histograms"]
+
+
+def test_client_resume_continues_stream_same_request_id():
+    """InferenceClient.generate(resume=True) turns StreamInterrupted
+    into a client-side resume: the carried output_ids are resubmitted
+    with the budget reduced, under the SAME X-Request-Id, and the
+    caller sees one seamless result with `resumed` counted."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    prompt = [5, 1]
+    leg1 = [toy_token(prompt, i) for i in range(2)]
+    seen = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            seen.append((req, self.headers.get("X-Request-Id")))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.end_headers()
+            if len(seen) == 1:
+                for t in leg1:
+                    self.wfile.write(
+                        json.dumps({"token": t}).encode() + b"\n")
+                self.wfile.write(json.dumps({
+                    "interrupted": True, "error": "replica failed",
+                    "finish_reason": "replica_lost",
+                    "output_ids": prompt + leg1,
+                    "tokens_delivered": len(leg1)}).encode() + b"\n")
+                return
+            ids = list(req["input_ids"])
+            leg2 = [toy_token(ids, i)
+                    for i in range(req["max_new_tokens"])]
+            for t in leg2:
+                self.wfile.write(
+                    json.dumps({"token": t}).encode() + b"\n")
+            self.wfile.write(json.dumps({
+                "done": True, "finish_reason": "length",
+                "output_ids": ids + leg2}).encode() + b"\n")
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    try:
+        cli = InferenceClient(f"http://{host}:{port}", timeout=10,
+                              retries=0)
+        out = cli.generate(prompt, max_new_tokens=6, resume=True)
+        assert len(seen) == 2
+        req2, rid2 = seen[1]
+        assert seen[0][1] == rid2                    # same request id
+        assert req2["input_ids"] == prompt + leg1    # carried prefix
+        assert req2["max_new_tokens"] == 6 - len(leg1)
+        assert out["resumed"] == 1
+        assert out["finish_reason"] == "length"
+        assert out["tokens"][:2] == leg1
+        assert len(out["tokens"]) == 6
+        assert list(out["output_ids"]) == prompt + out["tokens"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+@pytest.mark.chaos
+def test_resume_chaos_scenario():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import chaos_check
+    finally:
+        sys.path.pop(0)
+    report = chaos_check.run_resume_chaos(seed=0)
+    assert report["recovered"], report
+
+
+def test_perf_gate_resume_gap_metric_round_trip(tmp_path):
+    """serving_stream_resume_gap_ms is gateable lower-better: --update
+    registers the baseline, an equal rerun passes, a blow-up beyond
+    tolerance exits 2, and --update rolls the ceiling (ISSUE 20)."""
+    gate = os.path.join(REPO, "tools", "perf_gate.py")
+    base = tmp_path / "baseline.jsonl"
+    res = tmp_path / "results.json"
+    row = {"metric": "serving_stream_resume_gap_ms", "value": 40.0,
+           "unit": "ms", "resumes": 4}
+    base.write_text(json.dumps(row) + "\n")
+
+    def run(value):
+        res.write_text(json.dumps(dict(row, value=value)) + "\n")
+        return subprocess.run(
+            [sys.executable, gate, str(res), "--baseline", str(base),
+             "--static-budget", ""],
+            capture_output=True, text=True)
+
+    assert run(40.0).returncode == 0
+    assert run(41.0).returncode == 0         # within tolerance
+    p = run(400.0)
+    assert p.returncode == 2 and "regression" in p.stderr
+    res.write_text(json.dumps(dict(row, value=20.0)) + "\n")
+    p = subprocess.run(
+        [sys.executable, gate, str(res), "--baseline", str(base),
+         "--static-budget", "", "--update"],
+        capture_output=True, text=True)
+    assert p.returncode == 0 and "updated" in p.stdout
+    assert run(21.0).returncode == 0
+    assert run(40.0).returncode == 2

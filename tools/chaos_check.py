@@ -62,6 +62,17 @@ Scenarios (--scenario, all CPU, all deterministic given --seed):
     `~other` == `engine.tokens`) despite the kill/drain, the router's
     per-tenant ok counts must equal the clients' own tallies, and a
     10k-distinct-tenant sweep must stay within the K-entry bound.
+  * `resume` (ISSUE 20): kill -9 one replica of a 3-replica GPT fleet
+    mid-burst — the router must RESUME every broken stream on a
+    survivor (prompt + delivered prefix resubmitted under the same
+    X-Request-Id, first-token divergence check armed) so that ZERO
+    streams surface as interrupted, zero tokens replay, and every
+    stream is bit-exact with a local same-seed reference engine.
+    Resume legs must ride the survivors' warmed radix prefix cache
+    (`serving.resume_prefill{cache=hit|partial}` in the fleet rollup),
+    the `router.stream_resumes`/`router.resume_gap_ms` series must
+    survive `telemetry_agg`, and every replica book + the fleet merge
+    must still bill resumed tokens exactly once.
 
 Both `fleet` and `surge` additionally prove the metering plane's
 bounded cardinality and conservation under churn; `surge` cross-checks
@@ -1122,6 +1133,235 @@ def run_fleet_chaos(seed=0, n_replicas=3, n_predict=12, n_generate=9,
     return report
 
 
+def run_resume_chaos(seed=0, n_replicas=3, n_generate=12,
+                     new_tokens=72, max_waves=3):
+    """Mid-stream failover chaos (ISSUE 20): kill -9 one replica of a
+    3-replica GPT fleet mid-burst.  `recovered` means ZERO interrupted
+    streams and zero replayed tokens — every stream, including the
+    router-resumed ones, is bit-exact with a local same-seed reference
+    engine (the greedy determinism contract end to end) — with at
+    least one resume established (`router.stream_resumes{outcome=ok}`)
+    and none diverged, the resumed legs riding the survivors' radix
+    prefix cache (`serving.resume_prefill{cache=hit|partial}`), the
+    resume-gap histogram populated, and every replica book + the fleet
+    merge still conserving decode tokens exactly once across the
+    broken-and-resumed streams.  Because a kill may land between
+    streams (nothing in flight → plain zero-token failover, nothing to
+    resume), the burst runs in up to `max_waves` waves, each killing a
+    different live replica, until a resume is observed."""
+    import glob as _glob
+    import tempfile as _tempfile
+    import threading
+    import time as _time
+    import urllib.error
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference.fleet import ReplicaFleet, _build_gpt_engine
+    from paddle_tpu.inference.serving import (
+        InferenceClient, StreamInterrupted,
+    )
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.observability.export import TelemetryExporter
+
+    obs.attach(crash_hook=False)
+    metrics.reset()
+    obs.attach(crash_hook=False)  # re-declare the schema post-reset
+    tel_dir = _tempfile.mkdtemp(prefix="chaos_resume_tel_")
+    fleet = ReplicaFleet(
+        num_replicas=n_replicas, kind="gpt", max_slots=4,
+        request_timeout=60.0, launch_timeout=180,
+        telemetry_dir=tel_dir,
+        replica_env={"PADDLE_TPU_TELEMETRY_INTERVAL": "0.5"})
+    fleet.start()
+    rs = np.random.RandomState(seed)
+    # every prompt opens with one shared 16-token (2-page) system
+    # prefix: the resume leg's tail-prefill re-walks it through the
+    # survivor's radix cache (warmed below), so resumes land hit/partial
+    sysp = rs.randint(0, 250, (16,)).tolist()
+
+    # the greedy-determinism oracle: the SAME seeded model the replicas
+    # build — what an uninterrupted stream would have said, bit-exact
+    ref_eng = _build_gpt_engine(seed=0)
+
+    def expected(prompt, n):
+        out = ref_eng.generate([np.asarray(prompt, np.int32)],
+                               max_new_tokens=n)[0]
+        return [int(t) for t in np.asarray(out)[len(prompt):]]
+
+    # warm EVERY replica's radix cache with the shared prefix directly
+    # (bypassing router affinity, which would pin one replica): any
+    # survivor a stream resumes onto already holds the prefix pages
+    for view in fleet.router.replica_views():
+        InferenceClient(view["address"], timeout=60, retries=1,
+                        tenant_id="warm").generate(
+            sysp + [3, 1], max_new_tokens=2)
+
+    results = []
+    lock = threading.Lock()
+    delivered_counts = [0] * n_generate  # tokens seen at client edge
+
+    def _note_token(i):
+        with lock:
+            delivered_counts[i] += 1
+
+    def one_generate(i, prompt, exp):
+        tenant = f"tenant-{i % 3}"
+        cli = InferenceClient(fleet.router.address, timeout=60,
+                              retries=1, tenant_id=tenant)
+        try:
+            r = cli.generate(prompt, max_new_tokens=new_tokens,
+                             on_token=lambda _t: _note_token(i))
+            row = ("ok" if r["tokens"] == exp else "replayed",
+                   int(r.get("resumed", 0) or 0), tenant)
+        except StreamInterrupted as e:
+            prefix_ok = (e.tokens == exp[:len(e.tokens)]
+                         and list(e.output_ids)
+                         == list(prompt) + list(e.tokens))
+            row = ("interrupted" if prefix_ok else "replayed",
+                   0, tenant)
+        except urllib.error.HTTPError as e:
+            row = ("shed" if e.code in (429, 503) else "error",
+                   0, tenant)
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            row = (f"error:{type(e).__name__}", 0, tenant)
+        with lock:
+            results.append(row)
+
+    def busiest_rank(fallback):
+        # the kill must land on a replica with streams IN FLIGHT or
+        # there is nothing to resume — target the router's live
+        # inflight books (ids are "r<rank>", stable across relaunches)
+        best, best_n = fallback, -1
+        for v in fleet.router.replica_views():
+            n = sum((v.get("inflight") or {}).values())
+            if n > best_n:
+                best, best_n = int(v["id"][1:]), n
+        return best
+
+    waves_run = 0
+    for wave in range(max_waves):
+        waves_run += 1
+        rr = np.random.RandomState(seed + 101 * wave)
+        prompts = [sysp + rr.randint(0, 250, (3 + i % 5,)).tolist()
+                   for i in range(n_generate)]
+        exps = [expected(p, new_tokens) for p in prompts]
+        with lock:
+            delivered_counts[:] = [0] * n_generate
+        threads = [threading.Thread(target=one_generate,
+                                    args=(i, prompts[i], exps[i]))
+                   for i in range(n_generate)]
+        for t in threads:
+            t.start()
+            _time.sleep(0.02)
+        # the kill must land MID-stream (a zero-delivered break takes
+        # the plain failover path and proves nothing about resume), so
+        # wait until the burst is OBSERVABLY flowing — enough streams
+        # past their second token that every replica is mid-delivery —
+        # rather than guessing a wall-clock offset that machine load
+        # would invalidate; then kill -9 the most-loaded replica
+        flow_deadline = _time.monotonic() + 60.0
+        while _time.monotonic() < flow_deadline:
+            with lock:
+                flowing = sum(1 for c in delivered_counts if c >= 2)
+            if flowing >= 2 * n_replicas:
+                break
+            _time.sleep(0.02)
+        fleet.kill_replica(busiest_rank(wave % n_replicas))
+        for t in threads:
+            t.join(timeout=120)
+        # supervisor respawn: full capacity before the next wave
+        fleet.wait_ready(n=n_replicas, timeout=120)
+        if metrics.snapshot()["counters"].get(
+                "router.stream_resumes{outcome=ok}", 0) >= 1:
+            break
+
+    # the router process's own dump (stream_resumes counters + the
+    # resume-gap histogram live HERE) joins the replica dumps
+    TelemetryExporter(outdir=tel_dir, run_id="router").dump_once(
+        reason="chaos_final")
+    snap = metrics.snapshot()
+    fleet.stop()
+    obs.detach()
+
+    counters = snap["counters"]
+    by = {}
+    resumed_streams = 0
+    for status, resumed, _tenant in results:
+        by.setdefault(status, 0)
+        by[status] += 1
+        if resumed:
+            resumed_streams += 1
+    launched = waves_run * n_generate
+    resumes_ok = counters.get("router.stream_resumes{outcome=ok}", 0)
+    resumes_div = counters.get(
+        "router.stream_resumes{outcome=diverged}", 0)
+    gap_hist = snap["histograms"].get("router.resume_gap_ms", {})
+
+    # fleet rollup: resume counters/hist must survive telemetry_agg,
+    # and every replica book + the merge still conserves exactly-once
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import telemetry_agg
+    finally:
+        sys.path.pop(0)
+    roll = telemetry_agg.rollup(telemetry_agg.load_dumps(tel_dir))
+    roll_c = roll.get("counters", {})
+    roll_resumes_ok = roll_c.get(
+        "router.stream_resumes{outcome=ok}", 0)
+    # serving.resume_prefill lives in the REPLICA processes — it only
+    # reaches us through their telemetry dumps, never the local snap
+    roll_prefill_warm = sum(
+        roll_c.get(f"serving.resume_prefill{{cache={c}}}", 0)
+        for c in ("hit", "partial"))
+    roll_gap = roll.get("histograms", {}).get(
+        "router.resume_gap_ms") or {}
+    _tl = obs.tenant_ledger
+    roll_tenants = roll.get("tenants") or {}
+    replica_books = {ident: s
+                     for ident, s in (roll_tenants.get("per_process")
+                                      or {}).items() if ":r" in ident}
+    books_conserve = bool(replica_books) and all(
+        not _tl.conservation_delta(s)
+        and s.get("metrics_engine_tokens")
+        == s.get("totals", {}).get("decode_tokens")
+        for s in replica_books.values())
+    fleet_book = roll_tenants.get("fleet") or {}
+    fleet_conserves = bool(fleet_book) \
+        and not _tl.conservation_delta(fleet_book) \
+        and fleet_book.get("metrics_engine_tokens") \
+        == fleet_book.get("totals", {}).get("decode_tokens")
+
+    report = {
+        "scenario": "resume",
+        "replicas": n_replicas,
+        "waves": waves_run,
+        "streams": launched,
+        "by_status": by,
+        "resumed_streams_client": resumed_streams,
+        "stream_resumes_ok": resumes_ok,
+        "stream_resumes_diverged": resumes_div,
+        "resume_gap_count": gap_hist.get("count", 0),
+        "rollup_resumes_ok": roll_resumes_ok,
+        "rollup_prefill_warm": roll_prefill_warm,
+        "rollup_gap_count": roll_gap.get("count", 0),
+        "books_conserve": bool(books_conserve),
+        "fleet_conserves": bool(fleet_conserves),
+        "recovered": (
+            # the tentpole bar: replica death invisible — every stream
+            # finished ok and bit-exact, NONE interrupted or replayed
+            by.get("ok", 0) == launched
+            and len(results) == launched
+            and resumes_ok >= 1 and resumes_div == 0
+            and resumed_streams >= 1
+            and gap_hist.get("count", 0) >= 1
+            and roll_resumes_ok >= 1
+            and roll_prefill_warm >= 1
+            and roll_gap.get("count", 0) >= 1
+            and bool(books_conserve) and bool(fleet_conserves)),
+    }
+    return report
+
+
 def run_surge_chaos(seed=0, base_rps=4.0, surge_mult=10.0, warm_s=3.0,
                     surge_s=10.0, cool_s=6.0, max_replicas=3,
                     p99_bound_ms=15000.0):
@@ -1695,7 +1935,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario",
                     choices=("train", "overload", "preemption", "engine",
-                             "fleet", "prefix", "surge", "qos"),
+                             "fleet", "prefix", "surge", "qos",
+                             "resume"),
                     default="train")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
@@ -1716,6 +1957,8 @@ def main(argv=None):
                                    and q["recovered"])
     elif args.scenario == "fleet":
         report = run_fleet_chaos(seed=args.seed)
+    elif args.scenario == "resume":
+        report = run_resume_chaos(seed=args.seed)
     elif args.scenario == "surge":
         report = run_surge_chaos(seed=args.seed)
     elif args.scenario == "qos":

@@ -370,6 +370,13 @@ class LoadReport:
             "ok": status.get("ok", 0),
             "shed": status.get("shed", 0),
             "interrupted": status.get("interrupted", 0),
+            # streams that absorbed ≥1 mid-stream failover (ISSUE 20):
+            # the router resumed them invisibly — every token still
+            # verified incrementally and the final record still had to
+            # be the exact prompt+tokens prefix, so a resumed "ok" is a
+            # REAL ok, never a laundered admitted failure
+            "resumed_streams": sum(
+                1 for r in self.rows if (r.get("resumed") or 0) > 0),
             "abandoned": status.get("abandoned", 0),
             "client_errors": status.get("client_error", 0),
             "replayed": status.get("replayed", 0),
@@ -462,7 +469,7 @@ class OpenLoopRunner:
 
     # ------------------------------------------------------------------
     def _record(self, spec, status, latency_s=None, tokens=0,
-                detail=None, itl_ms=None):
+                detail=None, itl_ms=None, resumed=0):
         with self._lock:
             self._rows.append({
                 "id": spec["id"], "kind": spec["kind"],
@@ -471,16 +478,18 @@ class OpenLoopRunner:
                 "priority_class": spec.get("priority_class"),
                 "status": status, "latency_s": latency_s,
                 "tokens": tokens, "detail": detail,
-                "itl_ms": itl_ms})
+                "itl_ms": itl_ms, "resumed": resumed})
 
     def _fire(self, spec):
         t0 = time.monotonic()
         itl = None
+        resumed = 0
         try:
             if spec["behavior"] == "oversize":
                 status, tokens, detail = self._oversize(spec), 0, None
             elif spec["kind"] == "generate":
-                status, tokens, detail, itl = self._generate(spec)
+                status, tokens, detail, itl, resumed = \
+                    self._generate(spec)
             else:
                 status, detail = self._predict(spec)
                 tokens = 0
@@ -488,7 +497,8 @@ class OpenLoopRunner:
             status, tokens = "error", 0
             detail = f"{type(e).__name__}: {e}"
         self._record(spec, status, latency_s=time.monotonic() - t0,
-                     tokens=tokens, detail=detail, itl_ms=itl)
+                     tokens=tokens, detail=detail, itl_ms=itl,
+                     resumed=resumed)
 
     def _retry_wait(self, headers):
         """Defensive Retry-After parse, clamped into
@@ -518,7 +528,7 @@ class OpenLoopRunner:
         if fp is not None:
             headers["X-Prefix-Fingerprint"] = fp
         attempts = self.max_retries + 1
-        last = ("error", 0, "no attempt ran", None)
+        last = ("error", 0, "no attempt ran", None, 0)
         for attempt in range(attempts):
             conn = self._connect()
             try:
@@ -528,7 +538,7 @@ class OpenLoopRunner:
                 if resp.status in (429, 503):
                     wait = self._retry_wait(dict(resp.headers))
                     resp.read()
-                    last = ("shed", 0, f"http {resp.status}", None)
+                    last = ("shed", 0, f"http {resp.status}", None, 0)
                     if attempt < attempts - 1:
                         if spec["behavior"] != "ignore_retry_after":
                             time.sleep(wait)
@@ -537,10 +547,11 @@ class OpenLoopRunner:
                 if resp.status != 200:
                     return (("client_error" if resp.status == 400
                              else "error"), 0, f"http {resp.status}",
-                            None)
+                            None, 0)
                 return self._consume_stream(spec, resp, conn)
             except OSError as e:
-                last = ("error", 0, f"{type(e).__name__}: {e}", None)
+                last = ("error", 0, f"{type(e).__name__}: {e}",
+                        None, 0)
             finally:
                 conn.close()
         return last
@@ -552,8 +563,13 @@ class OpenLoopRunner:
         server's `serving.itl_ms` histogram in the surge scenario.
         Disconnect clients bail after the first token — the server
         must notice the dead socket and cancel the sequence (its pages
-        return to the pool).  Returns (status, n_tokens, detail,
-        itl_ms_list)."""
+        return to the pool).  A `"resumed": n` on the final record
+        (ISSUE 20) is counted, not trusted: a resumed stream earns
+        "ok" exactly like any other — every token verified
+        incrementally, final `output_ids` an exact prompt+tokens
+        match — so a replay or invention across the resume seam is
+        caught the same way.  Returns (status, n_tokens, detail,
+        itl_ms_list, resumed)."""
         prompt, tokens = spec["prompt"], []
         gaps = []
         last_t = None
@@ -576,10 +592,10 @@ class OpenLoopRunner:
                         tok != self.expected_token(prompt,
                                                    len(tokens) - 1):
                     return "replayed", len(tokens), \
-                        f"token {len(tokens) - 1} wrong", gaps
+                        f"token {len(tokens) - 1} wrong", gaps, 0
                 if spec["behavior"] == "disconnect":
                     conn.close()   # die mid-stream, deliberately
-                    return "abandoned", len(tokens), None, gaps
+                    return "abandoned", len(tokens), None, gaps, 0
             elif evt.get("interrupted"):
                 # the clean mid-stream cut: every delivered token
                 # already verified above; the record must carry the
@@ -589,15 +605,15 @@ class OpenLoopRunner:
                 return (("interrupted" if prefix_ok else "replayed"),
                         len(tokens),
                         None if prefix_ok else "bad resumable prefix",
-                        gaps)
+                        gaps, 0)
             elif evt.get("done"):
                 out_ok = list(evt.get("output_ids") or []) \
                     == list(prompt) + tokens
                 return (("ok" if out_ok else "replayed"), len(tokens),
                         None if out_ok else "final record mismatch",
-                        gaps)
+                        gaps, int(evt.get("resumed", 0) or 0))
         return ("error", len(tokens),
-                "stream ended without final record", gaps)
+                "stream ended without final record", gaps, 0)
 
     # --- /predict (npz body; numpy is the one lazy non-stdlib need) ---
     def _predict(self, spec):
